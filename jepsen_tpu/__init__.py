@@ -1,0 +1,31 @@
+"""jepsen_tpu — a TPU-native distributed-systems testing framework.
+
+A ground-up rebuild of the capabilities of the Jepsen framework
+(reference: rachit77/jepsen — Clojure core `jepsen/src/jepsen/*.clj`,
+Tendermint suite, merkleeyes Go ABCI app) designed TPU-first:
+
+- Histories are columnar arrays (struct-of-arrays), not linked lists.
+- Consistency models are jit'd pure functions over packed integer states.
+- The linearizability search (knossos.linear / knossos.wgl equivalents)
+  is a batched, device-sharded frontier expansion running under jax.jit
+  over a `jax.sharding.Mesh` — millions of candidate configurations are
+  vmap'd per chip, with visited-set dedupe riding ICI collectives.
+- The host side (generators, clients, nemeses, cluster control, storage,
+  CLI) is pure Python, mirroring the reference's layer map (SURVEY.md §1).
+
+Package layout:
+    history     op schema, EDN codec, canonicalisation, columnar encoding
+    models      consistency models (register, cas-register, mutex, queues, set)
+    checker/    Checker protocol + full checker suite incl. linearizability
+    parallel/   the TPU search engine, mesh/sharding utilities
+    ops/        low-level device kernels (dedupe, hashing, bitset ops)
+    generator/  pure generator DSL + deterministic simulator + interpreter
+    control/    remote-execution backends (ssh, docker, dummy)
+    nemesis/    fault injection
+    tests/      reusable workloads (linearizable register, bank, long-fork, ...)
+    tendermint/ the bundled worked example: Tendermint BFT test suite
+"""
+
+__version__ = "0.1.0"
+
+from jepsen_tpu.history import Op, History  # noqa: F401

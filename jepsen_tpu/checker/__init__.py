@@ -1,0 +1,31 @@
+"""Checker suite — validity analysis over histories.
+
+Re-exports the protocol + combinators (`core`), the linear-time checkers
+(`suite`), and the linearizability dispatcher (`linearizable`). Reference:
+jepsen/src/jepsen/checker.clj (834 LoC) — see each module's docstring for
+the file:line parity map.
+"""
+
+from jepsen_tpu.checker.core import (  # noqa: F401
+    Checker,
+    FnChecker,
+    UNKNOWN,
+    check_safe,
+    compose,
+    concurrency_limit,
+    merge_valid,
+    noop,
+    unbridled_optimism,
+    valid_priority,
+)
+from jepsen_tpu.checker.suite import (  # noqa: F401
+    counter,
+    queue,
+    set_checker,
+    set_full,
+    stats,
+    total_queue,
+    unhandled_exceptions,
+    unique_ids,
+)
+from jepsen_tpu.checker.linearizable import linearizable  # noqa: F401

@@ -1,0 +1,135 @@
+"""Checker protocol and combinators (reference: jepsen/src/jepsen/checker.clj:26-113).
+
+A checker consumes a test map, an indexed history, and an opts dict, and
+produces a results dict whose `"valid?"` key is True, False, or
+`"unknown"`. This is the plugin boundary the TPU linearizability engine
+slots in behind (SURVEY.md §2.10: "the plugin boundary the TPU backend
+targets").
+
+Validity lattice (checker.clj merge-valid): False > "unknown" > True —
+any invalid makes the composition invalid; any unknown (absent invalid)
+makes it unknown.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Dict, Optional
+
+from jepsen_tpu.util import bounded_pmap
+
+UNKNOWN = "unknown"
+
+
+def valid_priority(v) -> int:
+    if v is False:
+        return 0
+    if v == UNKNOWN:
+        return 1
+    return 2
+
+
+def merge_valid(vs) -> Any:
+    """Worst-of validity (checker.clj:31-45)."""
+    out = True
+    for v in vs:
+        if valid_priority(v) < valid_priority(out):
+            out = v
+    return out
+
+
+class Checker:
+    """Protocol: (check test history opts) -> results dict
+    (checker.clj:49-64)."""
+
+    def check(self, test, history, opts: Optional[dict] = None) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # name used by compose results and stores
+    @property
+    def checker_name(self) -> str:
+        return type(self).__name__.lower()
+
+
+class FnChecker(Checker):
+    def __init__(self, fn, name="fn"):
+        self._fn = fn
+        self._name = name
+
+    def check(self, test, history, opts=None):
+        return self._fn(test, history, opts or {})
+
+    @property
+    def checker_name(self):
+        return self._name
+
+
+def check_safe(checker: Checker, test, history, opts=None) -> Dict[str, Any]:
+    """Run a checker, converting exceptions into
+    {"valid?": "unknown", "error": <trace>} (checker.clj:66-75) so one
+    broken checker never loses a test's results."""
+    try:
+        return checker.check(test, history, opts or {})
+    except Exception:  # noqa: BLE001
+        return {"valid?": UNKNOWN, "error": traceback.format_exc()}
+
+
+class Compose(Checker):
+    """Map of name -> checker, all run (in parallel — checker.clj:84-96
+    runs via pmap); results nested under each name plus merged validity."""
+
+    def __init__(self, checkers: Dict[str, Checker]):
+        self.checkers = dict(checkers)
+
+    def check(self, test, history, opts=None):
+        names = list(self.checkers)
+        results = bounded_pmap(
+            lambda n: check_safe(self.checkers[n], test, history, opts), names
+        )
+        out = dict(zip(names, results))
+        out["valid?"] = merge_valid(r.get("valid?", UNKNOWN) for r in results)
+        return out
+
+
+def compose(checkers: Dict[str, Checker]) -> Compose:
+    return Compose(checkers)
+
+
+class ConcurrencyLimit(Checker):
+    """At most `limit` concurrent executions of the wrapped checker across
+    threads — bounds memory-hungry checks (checker.clj:98-113)."""
+
+    def __init__(self, limit: int, checker: Checker):
+        self.checker = checker
+        self._sem = threading.Semaphore(limit)
+
+    def check(self, test, history, opts=None):
+        with self._sem:
+            return self.checker.check(test, history, opts)
+
+
+def concurrency_limit(limit: int, checker: Checker) -> ConcurrencyLimit:
+    return ConcurrencyLimit(limit, checker)
+
+
+class Noop(Checker):
+    """Always valid, no analysis (checker.clj noop)."""
+
+    def check(self, test, history, opts=None):
+        return {"valid?": True}
+
+
+class UnbridledOptimism(Checker):
+    """Everything is awesome (checker.clj:115-119)."""
+
+    def check(self, test, history, opts=None):
+        return {"valid?": True, "everything": "awesome"}
+
+
+def noop():
+    return Noop()
+
+
+def unbridled_optimism():
+    return UnbridledOptimism()

@@ -1,0 +1,77 @@
+"""Linearizability checker — the `checker/linearizable` dispatcher.
+
+Mirrors the reference's algorithm dispatch (jepsen/src/jepsen/checker.clj:182-213):
+`:algorithm` selects the engine —
+
+    "wgl"          host depth-first WGL search (jepsen_tpu.checker.wgl)
+    "linear"       host JIT-linearization frontier (jepsen_tpu.checker.linear)
+    "jax"          the TPU engine (jepsen_tpu.parallel.engine) — batched,
+                   device-sharded frontier expansion; the north star
+    "competition"  jax when the model packs to fixed-width ints, else wgl
+                   (the reference's competition races linear vs wgl,
+                   checker.clj:199; here the race is decided statically)
+
+Results mirror knossos: {"valid?", "op", "final-paths", "configs",
+"analyzer"}. Like the reference, final-paths/configs are truncated to 10
+(checker.clj:210-213 — "Writing these can take *hours*").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jepsen_tpu import models as model_ns
+from jepsen_tpu.checker.core import Checker
+from jepsen_tpu.history import History, Intern
+
+
+def _truncate(result: dict, n: int = 10) -> dict:
+    for k in ("final-paths", "configs"):
+        if isinstance(result.get(k), list):
+            result[k] = result[k][:n]
+    return result
+
+
+class Linearizable(Checker):
+    def __init__(self, model=None, algorithm: str = "competition"):
+        self.model = model
+        self.algorithm = algorithm
+
+    def check(self, test, history, opts=None):
+        model = self.model or (test or {}).get("model")
+        if model is None:
+            raise ValueError("The linearizable checker requires a model")
+        algo = self.algorithm or "competition"
+        h = history if isinstance(history, History) else History.wrap(history)
+
+        if algo == "competition":
+            # decide statically: packable models race onto the device
+            packable = model_ns.pack_spec(model, Intern()) is not None
+            algo = "jax" if packable and _engine_available() else "wgl"
+
+        if algo == "wgl":
+            from jepsen_tpu.checker import wgl
+            r = wgl.analysis(model, h)
+        elif algo == "linear":
+            from jepsen_tpu.checker import linear
+            r = linear.analysis(model, h)
+        elif algo == "jax":
+            from jepsen_tpu.parallel import engine
+            r = engine.analysis(model, h)
+        else:
+            raise ValueError(f"unknown linearizability algorithm {algo!r}")
+        r["analyzer"] = algo
+        return _truncate(r)
+
+
+def _engine_available() -> bool:
+    try:
+        import jax
+        from jepsen_tpu.parallel import engine  # noqa: F401
+        return len(jax.devices()) > 0
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def linearizable(model=None, algorithm: str = "competition") -> Linearizable:
+    return Linearizable(model, algorithm)

@@ -1,0 +1,436 @@
+"""The linear-time checker suite (reference: jepsen/src/jepsen/checker.clj:115-792).
+
+Faithful re-implementations of the reference's cheap checkers: stats,
+unhandled-exceptions, queue, set, set-full, total-queue, unique-ids,
+counter. All O(n) single passes over the history; vectorisation isn't
+worth the obscurity at these sizes — the exponential work lives in
+`jepsen_tpu.checker.linearizable` / `jepsen_tpu.parallel.engine`.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import Counter
+from typing import Optional
+
+from jepsen_tpu import models as model_ns
+from jepsen_tpu.checker.core import Checker, UNKNOWN, merge_valid
+from jepsen_tpu.util import integer_interval_set_str
+
+
+def _is_client(o) -> bool:
+    return isinstance(o.get("process"), int)
+
+
+class UnhandledExceptions(Checker):
+    """Ranks ops carrying errors/exceptions by frequency
+    (checker.clj:121-148). Always valid; purely informational."""
+
+    def check(self, test, history, opts=None):
+        exes = [o for o in history
+                if o.get("type") in ("info", "fail") and o.get("error")]
+        if not exes:
+            return {"valid?": True}
+        groups: dict = {}
+        for o in exes:
+            key = str(o.get("error")).split("\n")[0][:200]
+            groups.setdefault(key, []).append(o)
+        ranked = sorted(groups.values(), key=len, reverse=True)
+        return {
+            "valid?": True,
+            "exceptions": [
+                {"class": str(ops[0].get("error")).split("\n")[0][:200],
+                 "count": len(ops),
+                 "example": dict(ops[0])}
+                for ops in ranked
+            ],
+        }
+
+
+def _stats_map(completions) -> dict:
+    ok = sum(1 for o in completions if o.get("type") == "ok")
+    fail = sum(1 for o in completions if o.get("type") == "fail")
+    info = sum(1 for o in completions if o.get("type") == "info")
+    return {
+        "valid?": ok > 0,
+        "count": ok + fail + info,
+        "ok-count": ok,
+        "fail-count": fail,
+        "info-count": info,
+    }
+
+
+class Stats(Checker):
+    """ok/fail/info counts overall and by :f; valid iff every :f has some
+    ok ops (checker.clj:150-180)."""
+
+    def check(self, test, history, opts=None):
+        comps = [o for o in history
+                 if o.get("type") != "invoke" and o.get("process") != "nemesis"]
+        by_f: dict = {}
+        for o in comps:
+            by_f.setdefault(o.get("f"), []).append(o)
+        groups = {f: _stats_map(ops) for f, ops in sorted(by_f.items(),
+                                                          key=lambda kv: str(kv[0]))}
+        out = _stats_map(comps)
+        out["by-f"] = groups
+        out["valid?"] = merge_valid(g["valid?"] for g in groups.values()) \
+            if groups else UNKNOWN
+        return out
+
+
+class Queue(Checker):
+    """Every dequeue must come from somewhere: assume every non-failing
+    enqueue succeeded and only OK dequeues succeeded, then fold the model
+    over that history (checker.clj:215-235). Use with UnorderedQueue."""
+
+    def __init__(self, model):
+        self.model = model
+
+    def check(self, test, history, opts=None):
+        m = self.model
+        for o in history:
+            f = o.get("f")
+            take = (f == "enqueue" and o.get("type") == "invoke") or \
+                   (f == "dequeue" and o.get("type") == "ok")
+            if not take:
+                continue
+            m = m.step(o)
+            if model_ns.is_inconsistent(m):
+                return {"valid?": False, "error": m.msg}
+        return {"valid?": True, "final-queue": m}
+
+
+class Set(Checker):
+    """:add ops followed by a final :read; every successful add must be
+    present; only attempted elements may appear (checker.clj:237-288)."""
+
+    def check(self, test, history, opts=None):
+        attempts = {o.get("value") for o in history
+                    if o.get("type") == "invoke" and o.get("f") == "add"}
+        adds = {o.get("value") for o in history
+                if o.get("type") == "ok" and o.get("f") == "add"}
+        final_read = None
+        for o in history:
+            if o.get("type") == "ok" and o.get("f") == "read":
+                final_read = o.get("value")
+        if final_read is None:
+            return {"valid?": UNKNOWN, "error": "Set was never read"}
+        final_read = set(final_read)
+        ok = final_read & attempts
+        unexpected = final_read - attempts
+        lost = adds - final_read
+        recovered = ok - adds
+        return {
+            "valid?": not lost and not unexpected,
+            "attempt-count": len(attempts),
+            "acknowledged-count": len(adds),
+            "ok-count": len(ok),
+            "lost-count": len(lost),
+            "recovered-count": len(recovered),
+            "unexpected-count": len(unexpected),
+            "ok": _interval_or_list(ok),
+            "lost": _interval_or_list(lost),
+            "unexpected": _interval_or_list(unexpected),
+            "recovered": _interval_or_list(recovered),
+        }
+
+
+def _interval_or_list(xs):
+    if all(isinstance(x, int) for x in xs):
+        return integer_interval_set_str(xs)
+    return sorted(xs, key=repr)
+
+
+class _SetFullElement:
+    """Per-element timeline state (checker.clj:291-338 SetFullElement)."""
+
+    __slots__ = ("element", "known", "last_present", "last_absent")
+
+    def __init__(self, element):
+        self.element = element
+        self.known = None          # op confirming existence (add ok / read)
+        self.last_present = None   # most recent read invocation observing it
+        self.last_absent = None    # most recent read invocation missing it
+
+    def add_ok(self, op):
+        if self.known is None:
+            self.known = op
+
+    def read_present(self, inv, op):
+        if self.known is None:
+            self.known = op
+        if self.last_present is None or self.last_present["index"] < inv["index"]:
+            self.last_present = inv
+
+    def read_absent(self, inv, op):
+        if self.last_absent is None or self.last_absent["index"] < inv["index"]:
+            self.last_absent = inv
+
+
+def _set_full_element_results(e: _SetFullElement) -> dict:
+    """checker.clj:343-404 semantics, including both asymmetries: an
+    element must be known for absence to matter, and an absent read
+    concurrent with the add counts as never-read, not lost."""
+    def idx(op, default=-1):
+        return op["index"] if op is not None else default
+
+    stable = bool(e.last_present is not None
+                  and idx(e.last_absent) < idx(e.last_present))
+    lost = bool(e.known is not None
+                and e.last_absent is not None
+                and idx(e.last_present) < idx(e.last_absent)
+                and e.known["index"] < e.last_absent["index"])
+    never_read = not (stable or lost)
+    known_time = e.known.get("time", 0) if e.known else 0
+
+    stable_latency = lost_latency = None
+    if stable:
+        stable_time = (e.last_absent.get("time") or 0) + 1 if e.last_absent else 0
+        stable_latency = max(0, stable_time - (known_time or 0)) // 1_000_000
+    if lost:
+        lost_time = (e.last_present.get("time") or 0) + 1 if e.last_present else 0
+        lost_latency = max(0, lost_time - (known_time or 0)) // 1_000_000
+    return {
+        "element": e.element,
+        "outcome": "stable" if stable else ("lost" if lost else "never-read"),
+        "stable-latency": stable_latency,
+        "lost-latency": lost_latency,
+        "known": dict(e.known) if e.known else None,
+        "last-absent": dict(e.last_absent) if e.last_absent else None,
+    }
+
+
+def _frequency_distribution(points, xs):
+    xs = sorted(xs)
+    if not xs:
+        return None
+    n = len(xs)
+    return {p: xs[min(n - 1, int(math.floor(n * p)))] for p in points}
+
+
+class SetFull(Checker):
+    """Per-element visibility-timeline set analysis (checker.clj:470-589).
+
+    Options: linearizable (bool) — elements must be visible immediately
+    after their add completes; stale elements then invalidate the test.
+    """
+
+    def __init__(self, linearizable: bool = False):
+        self.linearizable = linearizable
+
+    def check(self, test, history, opts=None):
+        elements: dict = {}   # value -> _SetFullElement
+        inv_by_process: dict = {}
+        for o in history:
+            t, f = o.get("type"), o.get("f")
+            if t == "invoke":
+                inv_by_process[o.get("process")] = o
+                if f == "add":
+                    v = o.get("value")
+                    if v not in elements:
+                        elements[v] = _SetFullElement(v)
+            elif t == "ok":
+                inv = inv_by_process.pop(o.get("process"), o)
+                if f == "add":
+                    e = elements.get(o.get("value"))
+                    if e is not None:
+                        e.add_ok(o)
+                elif f == "read":
+                    read = set(o.get("value") or ())
+                    for v, e in elements.items():
+                        # only elements whose add was invoked before this
+                        # read's invocation can be judged absent
+                        if v in read:
+                            e.read_present(inv, o)
+                        else:
+                            e.read_absent(inv, o)
+            else:
+                inv_by_process.pop(o.get("process"), None)
+
+        rs = [_set_full_element_results(e) for e in elements.values()]
+        outcomes: dict = {}
+        for r in rs:
+            outcomes.setdefault(r["outcome"], []).append(r)
+        stable = outcomes.get("stable", [])
+        lost = outcomes.get("lost", [])
+        never_read = outcomes.get("never-read", [])
+        stale = [r for r in stable if r["stable-latency"] and r["stable-latency"] > 0]
+        worst_stale = sorted(stale, key=lambda r: r["stable-latency"],
+                             reverse=True)[:8]
+        if lost:
+            valid = False
+        elif not stable:
+            valid = UNKNOWN
+        elif self.linearizable and stale:
+            valid = False
+        else:
+            valid = True
+        out = {
+            "valid?": valid,
+            "attempt-count": len(rs),
+            "stable-count": len(stable),
+            "lost-count": len(lost),
+            "lost": sorted((r["element"] for r in lost), key=repr),
+            "never-read-count": len(never_read),
+            "never-read": sorted((r["element"] for r in never_read), key=repr),
+            "stale-count": len(stale),
+            "stale": sorted((r["element"] for r in stale), key=repr),
+            "worst-stale": worst_stale,
+        }
+        points = (0, 0.5, 0.95, 0.99, 1)
+        sl = _frequency_distribution(points, [r["stable-latency"] for r in rs
+                                              if r["stable-latency"] is not None])
+        ll = _frequency_distribution(points, [r["lost-latency"] for r in rs
+                                              if r["lost-latency"] is not None])
+        if sl:
+            out["stable-latencies"] = sl
+        if ll:
+            out["lost-latencies"] = ll
+        return out
+
+
+class TotalQueue(Checker):
+    """What goes in must come out — multiset conservation over
+    enqueue/dequeue (checker.clj:625-684). Drain ops (:f :drain with ok
+    values lists) are expanded into dequeues first."""
+
+    def check(self, test, history, opts=None):
+        attempts: Counter = Counter()
+        enqueues: Counter = Counter()
+        dequeues: Counter = Counter()
+        for o in history:
+            f, t = o.get("f"), o.get("type")
+            if f == "enqueue":
+                if t == "invoke":
+                    attempts[o.get("value")] += 1
+                elif t == "ok":
+                    enqueues[o.get("value")] += 1
+            elif f == "dequeue" and t == "ok":
+                dequeues[o.get("value")] += 1
+            elif f == "drain" and t == "ok":
+                for v in o.get("value") or ():
+                    dequeues[v] += 1
+        ok = dequeues & attempts
+        unexpected = Counter({v: c for v, c in dequeues.items()
+                              if v not in attempts})
+        duplicated = dequeues - attempts - unexpected
+        lost = enqueues - dequeues
+        recovered = ok - enqueues
+        return {
+            "valid?": not lost and not unexpected,
+            "attempt-count": sum(attempts.values()),
+            "acknowledged-count": sum(enqueues.values()),
+            "ok-count": sum(ok.values()),
+            "unexpected-count": sum(unexpected.values()),
+            "duplicated-count": sum(duplicated.values()),
+            "lost-count": sum(lost.values()),
+            "recovered-count": sum(recovered.values()),
+            "lost": dict(lost),
+            "unexpected": dict(unexpected),
+            "duplicated": dict(duplicated),
+            "recovered": dict(recovered),
+        }
+
+
+class UniqueIds(Checker):
+    """A unique-id generator must emit distinct ids
+    (checker.clj:686-731)."""
+
+    def check(self, test, history, opts=None):
+        attempted = sum(1 for o in history
+                        if o.get("type") == "invoke" and o.get("f") == "generate")
+        acks = [o.get("value") for o in history
+                if o.get("type") == "ok" and o.get("f") == "generate"]
+        counts = Counter(acks)
+        dups = {v: c for v, c in counts.items() if c > 1}
+        rng = [min(acks, key=_cmp_key), max(acks, key=_cmp_key)] if acks else None
+        return {
+            "valid?": not dups,
+            "attempted-count": attempted,
+            "acknowledged-count": len(acks),
+            "duplicated-count": len(dups),
+            "duplicated": dict(sorted(dups.items(), key=lambda kv: -kv[1])[:48]),
+            "range": rng,
+        }
+
+
+def _cmp_key(x):
+    return (0, x) if isinstance(x, (int, float)) else (1, repr(x))
+
+
+class CounterChecker(Checker):
+    """Monotonically-increasing counter: each read must land within
+    [sum of ok adds at invoke, sum of attempted adds at completion]
+    (checker.clj:734-792 — exact bound-update discipline mirrored)."""
+
+    def check(self, test, history, opts=None):
+        # the reference preprocesses with history/complete and drops failed
+        # ops *and their invocations* (remove :fails? / op/fail?,
+        # checker.clj:756-759) — a failed add never inflates the bounds
+        failed_invokes = set()
+        open_by_process: dict = {}
+        for i, o in enumerate(history):
+            p = o.get("process")
+            if o.get("type") == "invoke":
+                open_by_process[p] = i
+            else:
+                j = open_by_process.pop(p, None)
+                if o.get("type") == "fail" and j is not None:
+                    failed_invokes.add(j)
+
+        lower = 0
+        upper = 0
+        pending_reads: dict = {}  # process -> [lower_at_invoke, value]
+        reads = []
+        for i, o in enumerate(history):
+            t, f, p = o.get("type"), o.get("f"), o.get("process")
+            if t == "fail" or i in failed_invokes:
+                continue
+            if (t, f) == ("invoke", "read"):
+                pending_reads[p] = [lower, o.get("value")]
+            elif (t, f) == ("ok", "read"):
+                r = pending_reads.pop(p, [lower, o.get("value")])
+                reads.append([r[0], o.get("value"), upper])
+            elif (t, f) == ("invoke", "add"):
+                v = o.get("value") or 0
+                assert v >= 0, "counter checker assumes non-negative adds"
+                upper += v
+            elif (t, f) == ("ok", "add"):
+                lower += o.get("value") or 0
+        errors = [r for r in reads
+                  if not (r[0] <= r[1] <= r[2]) if r[1] is not None]
+        return {"valid?": not errors, "reads": reads, "errors": errors}
+
+
+# constructor-style API mirroring jepsen.checker names
+def stats():
+    return Stats()
+
+
+def unhandled_exceptions():
+    return UnhandledExceptions()
+
+
+def queue(model):
+    return Queue(model)
+
+
+def set_checker():
+    return Set()
+
+
+def set_full(linearizable: bool = False):
+    return SetFull(linearizable)
+
+
+def total_queue():
+    return TotalQueue()
+
+
+def unique_ids():
+    return UniqueIds()
+
+
+def counter():
+    return CounterChecker()

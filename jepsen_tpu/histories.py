@@ -1,0 +1,123 @@
+"""Synthetic history generators — test + benchmark corpora.
+
+The reference benchmarks knossos on register histories ("knossos
+benchmark corpus: etcd/cockroach register histories", BASELINE.json
+configs; knossos.history generators). We generate equivalent corpora in
+process: concurrent cas-register histories that are *valid by
+construction* (every effect applied at a legal linearization point), with
+optional crashes and failures, plus adversarial corruption for invalid
+cases. Deterministic under a seed.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from jepsen_tpu.history import History, Op
+
+
+def rand_register_history(
+    n_ops: int = 100,
+    n_processes: int = 5,
+    n_values: int = 5,
+    cas: bool = True,
+    crash_p: float = 0.05,
+    fail_p: float = 0.05,
+    seed: int = 45100,
+) -> History:
+    """A random, linearizable-by-construction cas-register history.
+
+    Simulation: a true register value evolves; each op's effect is applied
+    at its completion instant (a legal linearization point inside its
+    [invoke, complete] window). Crashed ops (:info) either applied at
+    crash time or never — both legal. Failed ops never applied.
+    Concurrency comes from interleaving invocations and completions of
+    different processes. Default seed 45100 is the reference's test seed
+    (jepsen/src/jepsen/generator/test.clj:30-47).
+    """
+    rng = random.Random(seed)
+    h = History()
+    value = None            # true register state
+    pending: dict = {}      # process -> op dict
+    free = list(range(n_processes))
+    next_process = n_processes  # crashed processes are replaced with fresh ids
+    started = 0
+    t = 0
+
+    def emit(typ, process, f, val, **kw):
+        nonlocal t
+        t += rng.randint(1, 1000)
+        o = Op(type=typ, process=process, f=f, value=val, time=t, **kw)
+        h.append(o)
+        return o
+
+    while started < n_ops or pending:
+        can_start = started < n_ops and free
+        if can_start and (not pending or rng.random() < 0.5):
+            p = free.pop(rng.randrange(len(free)))
+            r = rng.random()
+            if cas and r < 0.3:
+                f, v = "cas", [rng.randrange(n_values), rng.randrange(n_values)]
+            elif r < 0.6:
+                f, v = "write", rng.randrange(n_values)
+            else:
+                f, v = "read", None
+            emit("invoke", p, f, v)
+            pending[p] = {"f": f, "value": v}
+            started += 1
+        else:
+            p = rng.choice(list(pending))
+            op_info = pending.pop(p)
+            f, v = op_info["f"], op_info["value"]
+            roll = rng.random()
+            if roll < crash_p:
+                # crashed: maybe applied, maybe not; process id retired
+                if rng.random() < 0.5:
+                    value = _apply(value, f, v)[0]
+                emit("info", p, f, v, error="indeterminate")
+                free.append(next_process)
+                next_process += 1
+            elif roll < crash_p + fail_p and f != "read":
+                emit("fail", p, f, v)
+                free.append(p)
+            else:
+                value, result, ok = _apply_and_result(value, f, v)
+                if ok:
+                    emit("ok", p, f, result)
+                else:
+                    emit("fail", p, f, v)
+                free.append(p)
+    return h.index()
+
+
+def _apply(value, f, v):
+    if f == "write":
+        return v, True
+    if f == "cas":
+        old, new = v
+        if value == old:
+            return new, True
+        return value, False
+    return value, True
+
+
+def _apply_and_result(value, f, v):
+    if f == "read":
+        return value, value, True
+    new_value, ok = _apply(value, f, v)
+    return (new_value, v, True) if ok else (value, v, False)
+
+
+def corrupt_history(h: History, seed: int = 0,
+                    n_corruptions: int = 1) -> History:
+    """Flip ok-read values to likely-inconsistent ones — adversarial
+    invalid(ish) histories; pair with a checker oracle, don't assume."""
+    rng = random.Random(seed)
+    out = History.wrap(Op(dict(o)) for o in h)
+    reads = [i for i, o in enumerate(out)
+             if o.get("type") == "ok" and o.get("f") == "read"
+             and o.get("value") is not None]
+    for i in rng.sample(reads, min(n_corruptions, len(reads))):
+        out[i]["value"] = (out[i]["value"] or 0) + 1000
+    return out.index()

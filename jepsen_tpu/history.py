@@ -1,0 +1,454 @@
+"""Operation histories: schema, canonicalisation, columnar encoding.
+
+The history is the framework's central artifact: an ordered list of ops
+
+    {:index i, :time nanos, :process p, :type t, :f f, :value v}
+
+exactly the schema the reference produces (op contract documented at
+jepsen/src/jepsen/generator.clj:371-380 and knossos.history, used by
+jepsen/src/jepsen/core.clj:230 `history/index`). Types:
+
+    invoke  a client begins an operation
+    ok      it completed and took effect
+    fail    it completed and did NOT take effect
+    info    indeterminate (crashed) — may or may not have taken effect;
+            the process is dead and its op stays concurrent with
+            everything after it (knossos crash semantics)
+
+This module provides:
+  * `Op` — a dict with attribute access (op.type, op["type"] both work),
+  * `History` — a list of ops + canonicalisation (index/pair/complete,
+    the knossos.history equivalents) and EDN/JSONL IO,
+  * `calls()` — invocation/completion pairing into `Call` records, the
+    input to linearizability checking,
+  * `Columns` — struct-of-arrays encoding with interning tables, the
+    host↔device boundary: everything past this point is integer arrays.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+from jepsen_tpu import edn
+from jepsen_tpu.edn import Keyword
+
+TYPES = ("invoke", "ok", "fail", "info")
+_TYPE_CODE = {t: i for i, t in enumerate(TYPES)}
+NEMESIS = "nemesis"  # the nemesis pseudo-process
+NEMESIS_CODE = -2  # integer encoding of :nemesis in columnar form
+
+
+class Op(dict):
+    """An operation: a dict with attribute sugar.
+
+    Extra keys (:error, :debug, anything a client attaches) ride along,
+    matching the reference's open-map ops.
+    """
+
+    __slots__ = ()
+
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError:
+            if k in ("index", "time", "process", "type", "f", "value", "error"):
+                return None
+            raise AttributeError(k)
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+    # -- predicates (knossos.op equivalents: invoke?/ok?/fail?/info?,
+    #    used pervasively e.g. jepsen/src/jepsen/checker.clj:154-156)
+    @property
+    def is_invoke(self):
+        return self.get("type") == "invoke"
+
+    @property
+    def is_ok(self):
+        return self.get("type") == "ok"
+
+    @property
+    def is_fail(self):
+        return self.get("type") == "fail"
+
+    @property
+    def is_info(self):
+        return self.get("type") == "info"
+
+    def __repr__(self):
+        core = {k: self.get(k) for k in ("index", "type", "process", "f", "value")
+                if k in self}
+        extra = {k: v for k, v in self.items() if k not in core}
+        core.update(extra)
+        inner = ", ".join(f"{k}={v!r}" for k, v in core.items())
+        return f"Op({inner})"
+
+
+def op(type=None, process=None, f=None, value=None, **kw) -> Op:
+    """Construct an Op. `op('invoke', 0, 'read', None)`."""
+    o = Op(kw)
+    if type is not None:
+        o["type"] = type
+    if process is not None:
+        o["process"] = process
+    if f is not None:
+        o["f"] = f
+    o["value"] = value
+    return o
+
+
+# Test-fixture constructors (knossos.core/invoke-op, ok-op, fail-op —
+# used by the reference's checker tests, jepsen/test/jepsen/checker_test.clj:7)
+def invoke_op(process, f, value, **kw) -> Op:
+    return op("invoke", process, f, value, **kw)
+
+
+def ok_op(process, f, value, **kw) -> Op:
+    return op("ok", process, f, value, **kw)
+
+
+def fail_op(process, f, value, **kw) -> Op:
+    return op("fail", process, f, value, **kw)
+
+
+def info_op(process, f, value, **kw) -> Op:
+    return op("info", process, f, value, **kw)
+
+
+# --------------------------------------------------------------- conversion
+
+
+def _from_edn(x: Any) -> Any:
+    """EDN values -> plain Python. Keywords become strings."""
+    if isinstance(x, Keyword):
+        return x.name
+    if isinstance(x, list):
+        return [_from_edn(e) for e in x]
+    if isinstance(x, tuple):
+        return tuple(_from_edn(e) for e in x)
+    if isinstance(x, dict):
+        return {_from_edn(k): _from_edn(v) for k, v in x.items()}
+    if isinstance(x, frozenset):
+        return frozenset(_from_edn(e) for e in x)
+    return x
+
+
+def op_from_edn(form: dict) -> Op:
+    return Op(_from_edn(form))
+
+
+def _to_edn(x: Any) -> Any:
+    if isinstance(x, str):
+        return Keyword(x)
+    return x
+
+
+def op_to_edn_str(o: Op) -> str:
+    """Render an op as the reference's EDN map (keyword keys; keyword-ish
+    string values for :type/:f/:process where the reference uses keywords)."""
+    parts = []
+    for k, v in o.items():
+        parts.append(":" + str(k))
+        if k in ("type", "f") and isinstance(v, str):
+            parts.append(":" + v)
+        elif k == "process" and v == NEMESIS:
+            parts.append(":nemesis")
+        else:
+            parts.append(edn.dumps(v))
+    return "{" + ", ".join(
+        f"{parts[i]} {parts[i+1]}" for i in range(0, len(parts), 2)
+    ) + "}"
+
+
+class History(list):
+    """A list of `Op` with canonicalisation and IO helpers."""
+
+    # ------------------------------------------------------------- creation
+    @classmethod
+    def wrap(cls, ops: Iterable) -> "History":
+        h = cls()
+        for o in ops:
+            h.append(o if isinstance(o, Op) else Op(o))
+        return h
+
+    # ----------------------------------------------------------------- IO
+    @classmethod
+    def from_edn(cls, text: str) -> "History":
+        """Parse a reference-format history.edn (one op map per line, as
+        written by jepsen/src/jepsen/store.clj:351-362)."""
+        return cls.wrap(op_from_edn(f) for f in edn.iter_forms(text))
+
+    @classmethod
+    def load(cls, path: str) -> "History":
+        with open(path) as fh:
+            text = fh.read()
+        if path.endswith(".jsonl"):
+            return cls.wrap(Op(json.loads(line)) for line in text.splitlines() if line.strip())
+        return cls.from_edn(text)
+
+    def to_edn(self) -> str:
+        return "\n".join(op_to_edn_str(o) for o in self) + "\n"
+
+    def to_jsonl(self) -> str:
+        return "\n".join(json.dumps(o, default=_json_default) for o in self) + "\n"
+
+    def save(self, path: str):
+        with open(path, "w") as fh:
+            fh.write(self.to_jsonl() if path.endswith(".jsonl") else self.to_edn())
+
+    # --------------------------------------------------------- canonicalise
+    def index(self) -> "History":
+        """Assign :index 0..n-1 in order (knossos.history/index, called at
+        jepsen/src/jepsen/core.clj:230 before any checker runs)."""
+        for i, o in enumerate(self):
+            o["index"] = i
+        return self
+
+    def pairs(self) -> "History":
+        """Pair invocations with completions: each op gets a :pair-index
+        pointing at its counterpart (completion of the same process), or -1
+        for unpaired ops (knossos.history pairing semantics).
+
+        A process executes at most one op at a time, so matching is by
+        process: an invoke pairs with the next ok/fail/info of the same
+        process. Nemesis ops pair the same way.
+        """
+        if any(o.get("index") is None for o in self):
+            self.index()
+        open_by_process: dict = {}
+        for o in self:
+            p = o.get("process")
+            if o.is_invoke:
+                o["pair-index"] = -1
+                open_by_process[p] = o
+            else:
+                inv = open_by_process.pop(p, None)
+                if inv is not None:
+                    inv["pair-index"] = o["index"]
+                    o["pair-index"] = inv["index"]
+                else:
+                    o["pair-index"] = -1
+        return self
+
+    def complete(self) -> "History":
+        """knossos.history/complete semantics (used by the reference at
+        jepsen/src/jepsen/checker.clj:756 and checker/timeline.clj:172):
+        fill each invocation's :value from its ok completion when the
+        invocation's value is nil (reads learn their value at completion).
+        """
+        self.pairs()
+        by_index = {o["index"]: o for o in self if o.get("index") is not None}
+        for o in self:
+            if o.is_invoke and o.get("pair-index", -1) >= 0:
+                comp = by_index[o["pair-index"]]
+                if comp.is_ok and o.get("value") is None:
+                    o["value"] = comp.get("value")
+        return self
+
+    # ------------------------------------------------------------- queries
+    def invocations(self) -> Iterator[Op]:
+        return (o for o in self if o.is_invoke)
+
+    def completions(self) -> Iterator[Op]:
+        return (o for o in self if not o.is_invoke)
+
+    def oks(self) -> Iterator[Op]:
+        return (o for o in self if o.is_ok)
+
+    def client_ops(self) -> "History":
+        return History.wrap(o for o in self if isinstance(o.get("process"), int))
+
+    def processes(self) -> list:
+        seen, out = set(), []
+        for o in self:
+            p = o.get("process")
+            if p not in seen:
+                seen.add(p)
+                out.append(p)
+        return out
+
+    def filter_f(self, *fs) -> "History":
+        fset = set(fs)
+        return History.wrap(o for o in self if o.get("f") in fset)
+
+    # ------------------------------------------------------------ columnar
+    def columns(self, value_encoder: Optional[Callable] = None) -> "Columns":
+        return Columns.from_history(self, value_encoder)
+
+
+def _json_default(x):
+    if isinstance(x, frozenset):
+        return sorted(x, key=repr)
+    return str(x)
+
+
+# ------------------------------------------------------------------- Calls
+
+
+@dataclass
+class Call:
+    """An invocation/completion pair — the unit of linearizability checking.
+
+    crashed=True means the completion was :info (or missing): the op may or
+    may not have taken effect and stays concurrent with the rest of the
+    history (knossos crash semantics — SURVEY.md §7.3 hard part #2).
+    """
+
+    index: int          # dense call id, 0..m-1 in invocation order
+    process: Any
+    f: str
+    value: Any          # invocation value (args)
+    result: Any         # completion value (None if crashed)
+    invoke_index: int   # position of invocation in the history
+    complete_index: int # position of completion; crashed -> len(history)
+    crashed: bool
+
+    def __repr__(self):
+        tail = " CRASHED" if self.crashed else f" -> {self.result!r}"
+        return f"Call#{self.index}(p{self.process} {self.f} {self.value!r}{tail})"
+
+
+def calls(history: History, drop_failed: bool = True) -> list:
+    """Pair invocations with completions into Call records.
+
+    With drop_failed (the default), failed ops are dropped — they did not
+    take effect (knossos `without-failures` preprocessing); otherwise they
+    are kept with failed=True. Nemesis and non-client ops are skipped.
+    Crashed (:info) calls get complete_index = len(history).
+    """
+    n = len(history)
+    open_by_process: dict = {}
+    out: list = []
+    failed: set = set()
+    for i, o in enumerate(history):
+        p = o.get("process")
+        if not isinstance(p, int):
+            continue
+        if o.is_invoke:
+            c = Call(
+                index=-1, process=p, f=o.get("f"), value=o.get("value"),
+                result=None, invoke_index=i, complete_index=n, crashed=True,
+            )
+            open_by_process[p] = c
+            out.append(c)
+        else:
+            c = open_by_process.pop(p, None)
+            if c is None:
+                continue
+            if o.is_ok:
+                c.result = o.get("value")
+                c.complete_index = i
+                c.crashed = False
+            elif o.is_fail:
+                c.complete_index = i
+                c.crashed = False
+                failed.add(id(c))
+    if drop_failed:
+        out = [c for c in out if id(c) not in failed]
+    for j, c in enumerate(out):
+        c.index = j
+    return out
+
+
+def prune_wildcard_calls(cs: list) -> list:
+    """Drop calls that cannot constrain a linearizability search: crashed
+    reads. A crashed read's value is unknown, so its model step is the
+    identity and always succeeds — it may be linearized at any point or
+    never, and removing it is sound. This avoids exponential blowup from
+    forever-open crashed calls (each open crashed call doubles the
+    frontier's mask space; cf. the reference's tractability caps,
+    jepsen/src/jepsen/tests/linearizable_register.clj:30-32). Crashed
+    mutating ops (writes, cas, acquire/release, dequeue) must stay — even
+    value-less ones mutate state. Re-numbers the surviving dense indices."""
+    out = [c for c in cs if not (c.crashed and c.f == "read")]
+    for j, c in enumerate(out):
+        c.index = j
+    return out
+
+
+# ---------------------------------------------------------------- Columns
+
+
+class Intern:
+    """Bidirectional value <-> int table. nil is always code -1."""
+
+    def __init__(self):
+        self._to_code: dict = {}
+        self._values: list = []
+
+    def code(self, v) -> int:
+        if v is None:
+            return -1
+        key = _hashable(v)
+        c = self._to_code.get(key)
+        if c is None:
+            c = len(self._values)
+            self._to_code[key] = c
+            self._values.append(v)
+        return c
+
+    def value(self, code: int):
+        return None if code < 0 else self._values[code]
+
+    def __len__(self):
+        return len(self._values)
+
+
+def _hashable(v):
+    if isinstance(v, list):
+        return tuple(_hashable(e) for e in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    if isinstance(v, set):
+        return frozenset(_hashable(e) for e in v)
+    return v
+
+
+@dataclass
+class Columns:
+    """Struct-of-arrays history encoding — the host↔device boundary.
+
+    Every field is a dense numpy array over ops, with interning tables
+    mapping :f and :value back to Python objects. This is what ships to
+    the TPU engine (jepsen_tpu.parallel.engine); nothing past this point
+    touches Python objects. Replaces the reference's per-op persistent
+    maps with a layout XLA can tile.
+    """
+
+    index: np.ndarray      # i64
+    time: np.ndarray       # i64 nanos (-1 if absent)
+    process: np.ndarray    # i32; :nemesis -> -2, other non-ints -> -3
+    type: np.ndarray       # u8, code into TYPES
+    f: np.ndarray          # i32 into f_table
+    value: np.ndarray      # i32 into value_table (-1 = nil / unencodable)
+    f_table: Intern = field(default_factory=Intern)
+    value_table: Intern = field(default_factory=Intern)
+
+    @classmethod
+    def from_history(cls, h: History, value_encoder: Optional[Callable] = None):
+        n = len(h)
+        idx = np.empty(n, np.int64)
+        tim = np.empty(n, np.int64)
+        proc = np.empty(n, np.int32)
+        typ = np.empty(n, np.uint8)
+        fcol = np.empty(n, np.int32)
+        val = np.empty(n, np.int32)
+        ftab, vtab = Intern(), Intern()
+        enc = value_encoder or (lambda v: vtab.code(v))
+        for i, o in enumerate(h):
+            idx[i] = o.get("index", i)
+            tim[i] = o.get("time", -1) if o.get("time") is not None else -1
+            p = o.get("process")
+            proc[i] = p if isinstance(p, int) else (NEMESIS_CODE if p == NEMESIS else -3)
+            typ[i] = _TYPE_CODE.get(o.get("type"), 255)
+            fcol[i] = ftab.code(o.get("f"))
+            val[i] = enc(o.get("value"))
+        return cls(idx, tim, proc, typ, fcol, val, ftab, vtab)
+
+    def __len__(self):
+        return len(self.index)

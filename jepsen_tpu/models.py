@@ -1,0 +1,310 @@
+"""Consistency models (knossos.model equivalents).
+
+A model is an immutable object with `step(op) -> model'` raising/returning
+`Inconsistent` when the op cannot apply — exactly the knossos.model
+contract the reference consumes (`model/step`, `model/inconsistent?` at
+jepsen/src/jepsen/checker.clj:230-233; `model/cas-register` at
+tendermint/src/jepsen/tendermint/core.clj:363).
+
+Two tiers:
+
+  * Host models (`Register`, `CASRegister`, `Mutex`, `UnorderedQueue`,
+    `FIFOQueue`, `GSet`) — general Python objects, used by the CPU
+    reference checker (`jepsen_tpu.checker.wgl`) and by simple checkers
+    like `checker.queue`.
+
+  * Packed models — fixed-width integer state + a pure `jnp` step
+    function, the TPU tier (SURVEY.md §7.1 step 4: "Model as jit'd pure
+    function: step(state, op) -> (state', ok); state packed into
+    fixed-width ints"). `pack_spec(model)` returns a `PackedSpec` when the
+    model family is device-packable; the linearizability dispatcher falls
+    back to the host checker otherwise (SURVEY.md §7.3 hard part #4).
+
+Op convention for model steps: ops are `history.Op`-like with .f and
+.value; read ops carry the *returned* value (filled by
+`History.complete()`), or None when unknown (crashed reads).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Tuple
+
+
+class Inconsistent:
+    """The op cannot be applied to this state (knossos.model/inconsistent)."""
+
+    __slots__ = ("msg",)
+
+    def __init__(self, msg: str):
+        self.msg = msg
+
+    def __repr__(self):
+        return f"Inconsistent({self.msg!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, Inconsistent)
+
+    def __hash__(self):
+        return hash("Inconsistent")
+
+
+def inconsistent(msg: str) -> Inconsistent:
+    return Inconsistent(msg)
+
+
+def is_inconsistent(m) -> bool:
+    return isinstance(m, Inconsistent)
+
+
+class Model:
+    """Base: immutable; subclasses implement step and value-based equality."""
+
+    def step(self, op) -> "Model | Inconsistent":
+        raise NotImplementedError
+
+    # Subclasses must be hashable on their state — the visited cache
+    # (both host DFS and device hash set) depends on it.
+
+
+@dataclass(frozen=True)
+class Register(Model):
+    """A single read/write register (knossos.model/register)."""
+
+    value: Any = None
+
+    def step(self, op):
+        if op.f == "write":
+            return Register(op.value)
+        if op.f == "read":
+            if op.value is None or op.value == self.value:
+                return self
+            return inconsistent(f"read {op.value!r} from register {self.value!r}")
+        return inconsistent(f"unknown op f={op.f}")
+
+
+@dataclass(frozen=True)
+class CASRegister(Model):
+    """Read/write/compare-and-set register (knossos.model/cas-register) —
+    the model of the Tendermint cas-register workload
+    (tendermint/src/jepsen/tendermint/core.clj:363)."""
+
+    value: Any = None
+
+    def step(self, op):
+        if op.f == "write":
+            return CASRegister(op.value)
+        if op.f == "cas":
+            if op.value is None:
+                return self  # crashed CAS with unknown args: can't constrain
+            old, new = op.value
+            if self.value == old:
+                return CASRegister(new)
+            return inconsistent(f"cas {old!r}->{new!r} on {self.value!r}")
+        if op.f == "read":
+            if op.value is None or op.value == self.value:
+                return self
+            return inconsistent(f"read {op.value!r} from {self.value!r}")
+        return inconsistent(f"unknown op f={op.f}")
+
+
+@dataclass(frozen=True)
+class Mutex(Model):
+    """Lock with acquire/release (knossos.model/mutex)."""
+
+    locked: bool = False
+
+    def step(self, op):
+        if op.f == "acquire":
+            if self.locked:
+                return inconsistent("acquire on locked mutex")
+            return Mutex(True)
+        if op.f == "release":
+            if not self.locked:
+                return inconsistent("release on unlocked mutex")
+            return Mutex(False)
+        return inconsistent(f"unknown op f={op.f}")
+
+
+@dataclass(frozen=True)
+class UnorderedQueue(Model):
+    """Queue where dequeue may return any pending element
+    (knossos.model/unordered-queue, used by the reference's queue checker
+    test, jepsen/test/jepsen/checker_test.clj:70)."""
+
+    pending: frozenset = frozenset()  # of (value, count) — multiset as tuples
+
+    @staticmethod
+    def of(*vals):
+        from collections import Counter
+        return UnorderedQueue(frozenset(Counter(vals).items()))
+
+    def _counter(self):
+        from collections import Counter
+        return Counter(dict(self.pending))
+
+    def step(self, op):
+        c = self._counter()
+        if op.f == "enqueue":
+            c[op.value] += 1
+            return UnorderedQueue(frozenset(c.items()))
+        if op.f == "dequeue":
+            if op.value is None:
+                return self  # unknown dequeue result: unconstrained
+            if c.get(op.value, 0) > 0:
+                c[op.value] -= 1
+                if c[op.value] == 0:
+                    del c[op.value]
+                return UnorderedQueue(frozenset(c.items()))
+            return inconsistent(f"dequeue {op.value!r} not pending")
+        return inconsistent(f"unknown op f={op.f}")
+
+
+@dataclass(frozen=True)
+class FIFOQueue(Model):
+    """Strict FIFO queue (knossos.model/fifo-queue)."""
+
+    items: tuple = ()
+
+    def step(self, op):
+        if op.f == "enqueue":
+            return FIFOQueue(self.items + (op.value,))
+        if op.f == "dequeue":
+            if not self.items:
+                return inconsistent("dequeue from empty queue")
+            head, rest = self.items[0], self.items[1:]
+            if op.value is None or head == op.value:
+                return FIFOQueue(rest)
+            return inconsistent(f"dequeue {op.value!r}, head is {head!r}")
+        return inconsistent(f"unknown op f={op.f}")
+
+
+@dataclass(frozen=True)
+class GSet(Model):
+    """Grow-only set with add/read (knossos.model/set)."""
+
+    items: frozenset = frozenset()
+
+    def step(self, op):
+        if op.f == "add":
+            return GSet(self.items | {op.value})
+        if op.f == "read":
+            if op.value is None:
+                return self
+            got = frozenset(op.value)
+            if got == self.items:
+                return self
+            return inconsistent(
+                f"read {sorted(got, key=repr)} != {sorted(self.items, key=repr)}"
+            )
+        return inconsistent(f"unknown op f={op.f}")
+
+
+# Clojure-flavoured constructors matching knossos.model names
+def register(value=None):
+    return Register(value)
+
+
+def cas_register(value=None):
+    return CASRegister(value)
+
+
+def mutex():
+    return Mutex()
+
+
+def unordered_queue():
+    return UnorderedQueue()
+
+
+def fifo_queue():
+    return FIFOQueue()
+
+
+def gset():
+    return GSet()
+
+
+# =====================================================================
+# Packed (device) tier
+# =====================================================================
+
+# f-codes shared by host encoder and device step functions
+F_READ, F_WRITE, F_CAS, F_ACQUIRE, F_RELEASE = 0, 1, 2, 3, 4
+
+
+@dataclass
+class PackedSpec:
+    """Device encoding of a model family.
+
+    state0        initial state as int32
+    f_codes       map f-name -> small int code
+    encode_call   (f, invoke_value, result, crashed) ->
+                  (f_code, arg0, arg1, wildcard) ints; values interned via
+                  the supplied Intern table
+    step_name     key into jepsen_tpu.parallel.steps registry of pure
+                  jnp step functions step(state, f, a0, a1, wild) ->
+                  (state', ok) — kept as a name so specs stay picklable
+                  and the engine jit-caches per family, not per key.
+    """
+
+    state0: int
+    step_name: str
+    encode_call: Callable[..., Tuple[int, int, int, bool]]
+    f_codes: dict
+
+
+def pack_spec(model: Model, intern) -> Optional[PackedSpec]:
+    """Return the PackedSpec for device-packable models, else None.
+
+    Packable today: Register / CASRegister (state = interned value id,
+    nil = -1) and Mutex (state = 0/1). Queue/set families have unbounded
+    state and stay on the host checker (SURVEY.md §7.3 #4).
+    """
+    if isinstance(model, (Register, CASRegister)):
+        state0 = intern.code(model.value)
+
+        def encode_call(f, value, result, crashed):
+            if f == "read":
+                # reads learn their value at completion; crashed/nil reads
+                # are wildcards (any state linearizes them)
+                v = result if result is not None else value
+                if crashed or v is None:
+                    return (F_READ, -1, -1, True)
+                return (F_READ, intern.code(v), -1, False)
+            if f == "write":
+                if value is None:
+                    return (F_READ, -1, -1, True)  # unknown write: no-op? —
+                    # a write with unknown value can't occur in practice;
+                    # treat as wildcard read to stay sound-ish
+                return (F_WRITE, intern.code(value), -1, False)
+            if f == "cas":
+                if value is None:
+                    return (F_READ, -1, -1, True)
+                old, new = value
+                return (F_CAS, intern.code(old), intern.code(new), False)
+            raise ValueError(f"register family: unknown f {f!r}")
+
+        return PackedSpec(
+            state0=state0,
+            step_name="register",
+            encode_call=encode_call,
+            f_codes={"read": F_READ, "write": F_WRITE, "cas": F_CAS},
+        )
+
+    if isinstance(model, Mutex):
+        def encode_call(f, value, result, crashed):
+            if f == "acquire":
+                return (F_ACQUIRE, -1, -1, False)
+            if f == "release":
+                return (F_RELEASE, -1, -1, False)
+            raise ValueError(f"mutex: unknown f {f!r}")
+
+        return PackedSpec(
+            state0=1 if model.locked else 0,
+            step_name="mutex",
+            encode_call=encode_call,
+            f_codes={"acquire": F_ACQUIRE, "release": F_RELEASE},
+        )
+
+    return None

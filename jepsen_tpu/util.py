@@ -1,0 +1,269 @@
+"""Kitchen-sink utilities (reference: jepsen/src/jepsen/util.clj, 886 LoC).
+
+Host-side analogues of the reference helpers the rest of the framework
+leans on: parallel map with meaningful-exception selection, quorum math,
+relative-time clock, retry/timeout control flow, latency pairing, nemesis
+interval extraction, fixed points, and integer interval-set printing.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time as _time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+
+# ----------------------------------------------------------- quorum math
+def majority(n: int) -> int:
+    """Smallest majority of n nodes (util.clj:80-84). majority(5) = 3."""
+    return n // 2 + 1
+
+
+def minority(n: int) -> int:
+    return (n - 1) // 2
+
+
+def minority_third(n: int) -> int:
+    """Largest f such that 3f < n — BFT-style fault bound (util.clj:86-89)."""
+    return max(0, int(math.ceil(n / 3)) - 1)
+
+
+# ------------------------------------------------------- parallel helpers
+def real_pmap(f: Callable, coll: Sequence) -> list:
+    """Thread-per-element map; raises the most *meaningful* exception if
+    several fail (util.clj:61-73 — prefers a real error over e.g. the
+    BrokenBarrier noise its siblings produce when one thread dies)."""
+    coll = list(coll)
+    if not coll:
+        return []
+    results: list = [None] * len(coll)
+    errors: list = []
+
+    def run(i, x):
+        try:
+            results[i] = f(x)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(i, x), daemon=True)
+               for i, x in enumerate(coll)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise _meaningful_exception(errors)
+    return results
+
+
+def _meaningful_exception(errors: list) -> BaseException:
+    """Prefer non-interrupt-ish exceptions (util.clj:48-59 semantics)."""
+    boring = (InterruptedError, BrokenPipeError, TimeoutError)
+    for e in errors:
+        if not isinstance(e, boring):
+            return e
+    return errors[0]
+
+
+def bounded_pmap(f: Callable, coll: Iterable, bound: Optional[int] = None) -> list:
+    """Parallel map with at most `bound` concurrent workers
+    (util.clj bounded-pmap; used by jepsen.independent/checker,
+    independent.clj:282-304)."""
+    import os
+    coll = list(coll)
+    bound = bound or (os.cpu_count() or 4) + 2
+    if not coll:
+        return []
+    with ThreadPoolExecutor(max_workers=min(bound, len(coll))) as pool:
+        return list(pool.map(f, coll))
+
+
+# -------------------------------------------------------------- time
+_NANOS = 1_000_000_000
+
+_local_clock_origin = None
+_origin_lock = threading.Lock()
+
+
+def relative_time_nanos() -> int:
+    """Nanoseconds since the first call in this process — every op's :time
+    is relative to test start (util.clj:324-342)."""
+    global _local_clock_origin
+    now = _time.monotonic_ns()
+    if _local_clock_origin is None:
+        with _origin_lock:
+            if _local_clock_origin is None:
+                _local_clock_origin = now
+    return now - _local_clock_origin
+
+
+def reset_relative_time():
+    global _local_clock_origin
+    with _origin_lock:
+        _local_clock_origin = _time.monotonic_ns()
+
+
+def nanos_to_secs(ns: float) -> float:
+    return ns / _NANOS
+
+
+def secs_to_nanos(s: float) -> int:
+    return int(s * _NANOS)
+
+
+def ms_to_nanos(ms: float) -> int:
+    return int(ms * 1_000_000)
+
+
+# ----------------------------------------------------------- control flow
+class RetryFailed(Exception):
+    pass
+
+
+def with_retry(f: Callable[[], Any], retries: int = 3,
+               backoff: float = 0.0,
+               exceptions: tuple = (Exception,)) -> Any:
+    """Retry f up to `retries` extra times (util.clj with-retry macro)."""
+    attempt = 0
+    while True:
+        try:
+            return f()
+        except exceptions:
+            attempt += 1
+            if attempt > retries:
+                raise
+            if backoff:
+                _time.sleep(backoff)
+
+
+def timeout(seconds: float, timeout_val: Any, f: Callable[[], Any]) -> Any:
+    """Run f with a deadline; return timeout_val if it doesn't finish
+    (util.clj:365-380 `timeout` macro). The worker thread is abandoned on
+    timeout (daemon), matching the reference's thread-interrupt best-effort."""
+    result: list = []
+    error: list = []
+
+    def run():
+        try:
+            result.append(f())
+        except BaseException as e:  # noqa: BLE001
+            error.append(e)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(seconds)
+    if t.is_alive():
+        return timeout_val
+    if error:
+        raise error[0]
+    return result[0]
+
+
+def fixed_point(f: Callable[[Any], Any], x: Any, max_iters: int = 10_000) -> Any:
+    """Iterate f until it stops changing (util.clj:880-886)."""
+    for _ in range(max_iters):
+        x2 = f(x)
+        if x2 == x:
+            return x
+        x = x2
+    raise RuntimeError("fixed_point: did not converge")
+
+
+# -------------------------------------------------- history-derived stats
+def history_to_latencies(history) -> list:
+    """Attach :latency (completion time - invoke time, nanos) to each
+    invocation; returns [(invoke_op, completion_op, latency_ns)]
+    (util.clj:653-687)."""
+    out = []
+    open_by_process: dict = {}
+    for o in history:
+        p = o.get("process")
+        if o.get("type") == "invoke":
+            open_by_process[p] = o
+        else:
+            inv = open_by_process.pop(p, None)
+            if inv is not None and inv.get("time") is not None and o.get("time") is not None:
+                lat = o["time"] - inv["time"]
+                inv["latency"] = lat
+                out.append((inv, o, lat))
+    return out
+
+
+def nemesis_intervals(history, fs_start=("start",), fs_stop=("stop",)) -> list:
+    """[(start_op, stop_op_or_None)] intervals of nemesis activity
+    (util.clj:689-734). Pairs each nemesis start with the next stop."""
+    out = []
+    opened = []
+    for o in history:
+        if o.get("process") != "nemesis" or o.get("type") == "invoke":
+            continue
+        if o.get("f") in fs_start:
+            opened.append(o)
+        elif o.get("f") in fs_stop:
+            while opened:
+                out.append((opened.pop(0), o))
+    for o in opened:
+        out.append((o, None))
+    return out
+
+
+# --------------------------------------------------- interval set printing
+def integer_interval_set_str(xs: Iterable[int]) -> str:
+    """Compact print of an int set: #{1..3 5 7..9} (util.clj:582-607)."""
+    xs = sorted(set(xs))
+    if not xs:
+        return "#{}"
+    runs = []
+    lo = hi = xs[0]
+    for x in xs[1:]:
+        if x == hi + 1:
+            hi = x
+        else:
+            runs.append((lo, hi))
+            lo = hi = x
+    runs.append((lo, hi))
+    parts = [str(lo) if lo == hi else f"{lo}..{hi}" for lo, hi in runs]
+    return "#{" + " ".join(parts) + "}"
+
+
+# ------------------------------------------------------------ misc
+def coll(x) -> list:
+    """Ensure a list (util.clj coll)."""
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple, set, frozenset)):
+        return list(x)
+    return [x]
+
+
+def name_of(x) -> str:
+    """Keyword-ish name of a value."""
+    if hasattr(x, "name"):
+        return x.name
+    return str(x)
+
+
+class LazyAtom:
+    """Thread-safe lazily-initialised mutable box (util.clj:761-795)."""
+
+    def __init__(self, init: Callable[[], Any]):
+        self._init = init
+        self._lock = threading.Lock()
+        self._set = False
+        self._value = None
+
+    def deref(self):
+        if not self._set:
+            with self._lock:
+                if not self._set:
+                    self._value = self._init()
+                    self._set = True
+        return self._value
+
+    def swap(self, f, *args):
+        with self._lock:
+            self.deref()
+            self._value = f(self._value, *args)
+            return self._value

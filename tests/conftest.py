@@ -1,0 +1,16 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware isn't available in CI; sharded code paths are
+exercised on 8 virtual CPU devices instead (the driver separately
+dry-runs the multi-chip path via __graft_entry__.dryrun_multichip).
+Must run before the first `import jax` anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,190 @@
+"""Checker-suite tests — history fixtures asserted against exact result
+maps, modeled on the reference's jepsen/test/jepsen/checker_test.clj."""
+
+from jepsen_tpu import checker
+from jepsen_tpu.checker.core import UNKNOWN, merge_valid
+from jepsen_tpu.history import History, invoke_op, ok_op, fail_op, info_op
+from jepsen_tpu.models import UnorderedQueue
+
+
+def _h(*ops):
+    return History.wrap(ops).index()
+
+
+def test_merge_valid_lattice():
+    # false > :unknown > true (checker.clj:31-45)
+    assert merge_valid([True, True]) is True
+    assert merge_valid([True, UNKNOWN]) == UNKNOWN
+    assert merge_valid([UNKNOWN, False]) is False
+    assert merge_valid([]) is True
+
+
+def test_compose():
+    c = checker.compose({"a": checker.noop(), "b": checker.unbridled_optimism()})
+    r = c.check({}, _h())
+    assert r["valid?"] is True
+    assert r["a"]["valid?"] is True
+
+
+def test_check_safe_catches():
+    class Boom(checker.Checker):
+        def check(self, test, history, opts=None):
+            raise RuntimeError("boom")
+
+    r = checker.check_safe(Boom(), {}, _h())
+    assert r["valid?"] == UNKNOWN
+    assert "boom" in r["error"]
+
+
+def test_stats():
+    h = _h(
+        invoke_op(0, "read", None),
+        ok_op(0, "read", 1),
+        invoke_op(0, "write", 1),
+        fail_op(0, "write", 1),
+        invoke_op(1, "write", 2),
+        info_op(1, "write", 2),
+    )
+    r = checker.stats().check({}, h)
+    assert r["ok-count"] == 1 and r["fail-count"] == 1 and r["info-count"] == 1
+    assert r["by-f"]["read"]["valid?"] is True
+    assert r["by-f"]["write"]["valid?"] is False  # no ok writes
+    assert r["valid?"] is False
+
+
+def test_queue_checker():
+    # mirrors checker_test.clj's queue test: enqueues assumed successful,
+    # only ok dequeues counted
+    h = _h(
+        invoke_op(0, "enqueue", 1),
+        ok_op(0, "enqueue", 1),
+        invoke_op(1, "dequeue", None),
+        ok_op(1, "dequeue", 1),
+    )
+    r = checker.queue(UnorderedQueue()).check({}, h)
+    assert r["valid?"] is True
+
+    bad = _h(
+        invoke_op(0, "dequeue", None),
+        ok_op(0, "dequeue", 9),
+    )
+    r = checker.queue(UnorderedQueue()).check({}, bad)
+    assert r["valid?"] is False
+
+
+def test_set_checker():
+    h = _h(
+        invoke_op(0, "add", 0),
+        ok_op(0, "add", 0),
+        invoke_op(1, "add", 1),
+        info_op(1, "add", 1),      # unknown: recovered if read
+        invoke_op(2, "add", 2),
+        ok_op(2, "add", 2),
+        invoke_op(3, "read", None),
+        ok_op(3, "read", [0, 1]),  # 2 lost, 1 recovered
+    )
+    r = checker.set_checker().check({}, h)
+    assert r["valid?"] is False
+    assert r["lost-count"] == 1
+    assert r["recovered-count"] == 1
+    assert r["unexpected-count"] == 0
+    assert r["attempt-count"] == 3
+
+
+def test_set_checker_never_read():
+    r = checker.set_checker().check({}, _h(invoke_op(0, "add", 0),
+                                           ok_op(0, "add", 0)))
+    assert r["valid?"] == UNKNOWN
+
+
+def test_set_full():
+    h = _h(
+        invoke_op(0, "add", 0, time=0),
+        ok_op(0, "add", 0, time=1),
+        invoke_op(1, "read", None, time=2),
+        ok_op(1, "read", [0], time=3),
+        invoke_op(0, "add", 1, time=4),
+        ok_op(0, "add", 1, time=5),
+        invoke_op(1, "read", None, time=6),
+        ok_op(1, "read", [0], time=7),   # 1 is absent after its add
+        invoke_op(1, "read", None, time=8),
+        ok_op(1, "read", [0], time=9),
+    )
+    r = checker.set_full().check({}, h)
+    assert r["valid?"] is False
+    assert r["lost"] == [1]
+    assert r["stable-count"] == 1
+
+
+def test_total_queue():
+    h = _h(
+        invoke_op(0, "enqueue", 1),
+        ok_op(0, "enqueue", 1),
+        invoke_op(0, "enqueue", 2),
+        ok_op(0, "enqueue", 2),
+        invoke_op(1, "dequeue", None),
+        ok_op(1, "dequeue", 1),
+        invoke_op(1, "dequeue", None),
+        ok_op(1, "dequeue", 1),    # duplicated!
+    )
+    r = checker.total_queue().check({}, h)
+    assert r["valid?"] is False      # 2 lost
+    assert r["lost"] == {2: 1}
+    assert r["duplicated"] == {1: 1}
+
+
+def test_unique_ids():
+    h = _h(
+        invoke_op(0, "generate", None),
+        ok_op(0, "generate", 10),
+        invoke_op(0, "generate", None),
+        ok_op(0, "generate", 11),
+        invoke_op(0, "generate", None),
+        ok_op(0, "generate", 10),
+    )
+    r = checker.unique_ids().check({}, h)
+    assert r["valid?"] is False
+    assert r["duplicated"] == {10: 2}
+    assert r["range"] == [10, 11]
+
+
+def test_counter():
+    h = _h(
+        invoke_op(0, "add", 1),
+        ok_op(0, "add", 1),
+        invoke_op(1, "read", None),
+        ok_op(1, "read", 1),
+        invoke_op(0, "add", 2),      # pending add: upper bound grows
+        invoke_op(1, "read", None),
+        ok_op(1, "read", 3),         # 1 <= 3 <= 3: ok
+        ok_op(0, "add", 2),
+        invoke_op(1, "read", None),
+        ok_op(1, "read", 9),         # out of bounds
+    )
+    r = checker.counter().check({}, h)
+    assert r["valid?"] is False
+    assert len(r["errors"]) == 1
+    assert r["errors"][0][1] == 9
+
+
+def test_counter_failed_add_not_counted():
+    h = _h(
+        invoke_op(0, "add", 5),
+        fail_op(0, "add", 5),
+        invoke_op(1, "read", None),
+        ok_op(1, "read", 0),
+    )
+    r = checker.counter().check({}, h)
+    assert r["valid?"] is True
+
+
+def test_unhandled_exceptions():
+    h = _h(
+        invoke_op(0, "read", None),
+        info_op(0, "read", None, error="indeterminate: timeout"),
+        invoke_op(0, "read", None),
+        info_op(0, "read", None, error="indeterminate: timeout"),
+    )
+    r = checker.unhandled_exceptions().check({}, h)
+    assert r["valid?"] is True
+    assert r["exceptions"][0]["count"] == 2

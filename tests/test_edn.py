@@ -1,0 +1,56 @@
+from jepsen_tpu import edn
+from jepsen_tpu.edn import Keyword, Symbol, Tagged
+
+
+def test_scalars():
+    assert edn.loads("nil") is None
+    assert edn.loads("true") is True
+    assert edn.loads("false") is False
+    assert edn.loads("42") == 42
+    assert edn.loads("-7") == -7
+    assert edn.loads("3.5") == 3.5
+    assert edn.loads("1e3") == 1000.0
+    assert edn.loads("123N") == 123
+    assert edn.loads('"hi\\nthere"') == "hi\nthere"
+    assert edn.loads(":foo") == Keyword("foo")
+    assert edn.loads(":foo/bar").name == "foo/bar"
+    assert edn.loads("sym") == Symbol("sym")
+    assert edn.loads("\\a") == "a"
+    assert edn.loads("\\newline") == "\n"
+
+
+def test_collections():
+    assert edn.loads("[1 2 3]") == [1, 2, 3]
+    assert edn.loads("(1 2)") == [1, 2]
+    assert edn.loads("#{1 2 3}") == frozenset({1, 2, 3})
+    assert edn.loads("{:a 1, :b [2 3]}") == {Keyword("a"): 1, Keyword("b"): [2, 3]}
+    # nested maps with collection keys
+    assert edn.loads("{[1 2] 3}") == {(1, 2): 3}
+
+
+def test_comments_and_discard():
+    assert edn.loads("; comment\n42") == 42
+    assert edn.loads("#_ignored 42") == 42
+    assert edn.loads_all("1 2 ;x\n3") == [1, 2, 3]
+
+
+def test_tagged():
+    t = edn.loads('#inst "2017-09-01T00:00:00Z"')
+    assert isinstance(t, Tagged)
+    assert t.tag == "inst"
+
+
+def test_reference_op_line():
+    # exact shape from the reference README output (/root/reference/README.md:38-43)
+    line = "{:process 85, :type :invoke, :f :read, :value nil, :index 110, :time 53268946400}"
+    m = edn.loads(line)
+    assert m[Keyword("process")] == 85
+    assert m[Keyword("type")] == Keyword("invoke")
+    assert m[Keyword("value")] is None
+    assert m[Keyword("index")] == 110
+
+
+def test_roundtrip():
+    forms = [None, True, 42, "s", [1, [2]], {Keyword("k"): 1}, frozenset({1})]
+    for f in forms:
+        assert edn.loads(edn.dumps(f)) == f

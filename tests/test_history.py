@@ -1,0 +1,96 @@
+import numpy as np
+
+from jepsen_tpu.history import (
+    Columns, History, Op, calls, invoke_op, ok_op, fail_op, info_op,
+)
+
+
+def _h(*ops):
+    return History.wrap(ops).index()
+
+
+def test_op_attr_access():
+    o = Op(type="invoke", process=0, f="read", value=None)
+    assert o.type == "invoke"
+    assert o.f == "read"
+    assert o.value is None
+    assert o.is_invoke
+    o.value = 3
+    assert o["value"] == 3
+
+
+def test_index_and_pairs():
+    h = _h(
+        invoke_op(0, "write", 1),
+        invoke_op(1, "read", None),
+        ok_op(0, "write", 1),
+        ok_op(1, "read", 1),
+    )
+    h.pairs()
+    assert h[0]["pair-index"] == 2
+    assert h[2]["pair-index"] == 0
+    assert h[1]["pair-index"] == 3
+
+
+def test_complete_fills_read_values():
+    h = _h(
+        invoke_op(0, "read", None),
+        ok_op(0, "read", 7),
+    ).complete()
+    assert h[0]["value"] == 7
+
+
+def test_calls_pairing():
+    h = _h(
+        invoke_op(0, "write", 1),
+        invoke_op(1, "read", None),
+        ok_op(0, "write", 1),
+        info_op(1, "read", None),       # crashed
+        invoke_op(2, "cas", [1, 2]),
+        fail_op(2, "cas", [1, 2]),      # failed: dropped
+    )
+    cs = calls(h)
+    assert len(cs) == 2
+    w, r = cs
+    assert w.f == "write" and not w.crashed and w.complete_index == 2
+    assert r.f == "read" and r.crashed and r.complete_index == len(h)
+
+
+def test_edn_round_trip():
+    h = _h(
+        invoke_op(0, "write", 1, time=10),
+        ok_op(0, "write", 1, time=20),
+        info_op("nemesis", "start", None, time=30),
+    )
+    text = h.to_edn()
+    h2 = History.from_edn(text)
+    assert len(h2) == 3
+    assert h2[0]["type"] == "invoke"
+    assert h2[0]["process"] == 0
+    assert h2[2]["process"] == "nemesis"
+
+
+def test_columns():
+    h = _h(
+        invoke_op(0, "write", 5, time=1),
+        ok_op(0, "write", 5, time=2),
+        invoke_op("nemesis", "start", None, time=3),
+    )
+    c = Columns.from_history(h)
+    assert len(c) == 3
+    assert c.process[2] == -2
+    assert c.type[0] == 0 and c.type[1] == 1
+    assert c.f_table.value(c.f[0]) == "write"
+    assert c.value_table.value(c.value[0]) == 5
+    assert c.value[2] == -1
+    assert c.index.dtype == np.int64
+
+
+def test_calls_keep_failed():
+    h = _h(
+        invoke_op(0, "write", 1),
+        fail_op(0, "write", 1),
+    )
+    assert calls(h) == []
+    kept = calls(h, drop_failed=False)
+    assert len(kept) == 1 and kept[0].complete_index == 1

@@ -1,0 +1,220 @@
+"""Linearizability: known fixtures + differential testing of the host
+engines (wgl vs linear frontier). The TPU engine is differentially tested
+against both in test_engine.py. Fixture histories follow the classic
+knossos examples."""
+
+import pytest
+
+from jepsen_tpu.checker import linear, wgl
+from jepsen_tpu.histories import corrupt_history, rand_register_history
+from jepsen_tpu.history import History, invoke_op, ok_op, fail_op, info_op
+from jepsen_tpu.models import CASRegister, Register
+
+
+def _h(*ops):
+    return History.wrap(ops).index()
+
+
+ENGINES = [wgl.analysis, linear.analysis]
+
+
+@pytest.mark.parametrize("analysis", ENGINES)
+def test_empty(analysis):
+    assert analysis(Register(), _h())["valid?"] is True
+
+
+@pytest.mark.parametrize("analysis", ENGINES)
+def test_sequential_valid(analysis):
+    h = _h(
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(0, "read", None),
+        ok_op(0, "read", 1),
+    )
+    assert analysis(Register(), h)["valid?"] is True
+
+
+@pytest.mark.parametrize("analysis", ENGINES)
+def test_sequential_invalid(analysis):
+    h = _h(
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(0, "read", None),
+        ok_op(0, "read", 2),
+    )
+    r = analysis(Register(), h)
+    assert r["valid?"] is False
+    assert r["op"] is not None
+
+
+@pytest.mark.parametrize("analysis", ENGINES)
+def test_concurrent_reorder_valid(analysis):
+    # read of 2 is concurrent with write(2): valid only via reordering
+    h = _h(
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(1, "write", 2),
+        invoke_op(2, "read", None),
+        ok_op(2, "read", 2),
+        ok_op(1, "write", 2),
+    )
+    assert analysis(Register(), h)["valid?"] is True
+
+
+@pytest.mark.parametrize("analysis", ENGINES)
+def test_stale_read_invalid(analysis):
+    # w1 completes, then w2 completes, then a read of 1 begins: stale
+    h = _h(
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(0, "write", 2),
+        ok_op(0, "write", 2),
+        invoke_op(1, "read", None),
+        ok_op(1, "read", 1),
+    )
+    assert analysis(Register(), h)["valid?"] is False
+
+
+@pytest.mark.parametrize("analysis", ENGINES)
+def test_crashed_write_may_apply(analysis):
+    # crashed write(2); later read sees 2: valid (it may have applied)
+    h = _h(
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(1, "write", 2),
+        info_op(1, "write", 2),
+        invoke_op(2, "read", None),
+        ok_op(2, "read", 2),
+    )
+    assert analysis(Register(), h)["valid?"] is True
+
+
+@pytest.mark.parametrize("analysis", ENGINES)
+def test_crashed_write_may_not_apply(analysis):
+    # crashed write(2); later read sees 1: also valid
+    h = _h(
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(1, "write", 2),
+        info_op(1, "write", 2),
+        invoke_op(2, "read", None),
+        ok_op(2, "read", 1),
+    )
+    assert analysis(Register(), h)["valid?"] is True
+
+
+@pytest.mark.parametrize("analysis", ENGINES)
+def test_crashed_op_stays_concurrent_forever(analysis):
+    # crashed write(2) applies *after* an intervening write(3):
+    # crashed ops remain concurrent with everything after them
+    h = _h(
+        invoke_op(0, "write", 2),
+        info_op(0, "write", 2),
+        invoke_op(1, "write", 3),
+        ok_op(1, "write", 3),
+        invoke_op(2, "read", None),
+        ok_op(2, "read", 3),
+        invoke_op(2, "read", None),
+        ok_op(2, "read", 2),
+    )
+    assert analysis(Register(), h)["valid?"] is True
+
+
+@pytest.mark.parametrize("analysis", ENGINES)
+def test_failed_op_never_applies(analysis):
+    h = _h(
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(1, "write", 2),
+        fail_op(1, "write", 2),
+        invoke_op(2, "read", None),
+        ok_op(2, "read", 2),
+    )
+    assert analysis(Register(), h)["valid?"] is False
+
+
+@pytest.mark.parametrize("analysis", ENGINES)
+def test_cas_register(analysis):
+    h = _h(
+        invoke_op(0, "write", 0),
+        ok_op(0, "write", 0),
+        invoke_op(1, "cas", [0, 1]),
+        ok_op(1, "cas", [0, 1]),
+        invoke_op(2, "cas", [1, 2]),
+        ok_op(2, "cas", [1, 2]),
+        invoke_op(0, "read", None),
+        ok_op(0, "read", 2),
+    )
+    assert analysis(CASRegister(), h)["valid?"] is True
+
+    bad = _h(
+        invoke_op(0, "write", 0),
+        ok_op(0, "write", 0),
+        invoke_op(1, "cas", [5, 1]),
+        ok_op(1, "cas", [5, 1]),
+    )
+    assert analysis(CASRegister(), bad)["valid?"] is False
+
+
+@pytest.mark.parametrize("analysis", ENGINES)
+def test_concurrent_cas_both_orders(analysis):
+    # two concurrent CASes where only one order linearizes
+    h = _h(
+        invoke_op(0, "write", 0),
+        ok_op(0, "write", 0),
+        invoke_op(1, "cas", [0, 1]),
+        invoke_op(2, "cas", [1, 2]),
+        ok_op(1, "cas", [0, 1]),
+        ok_op(2, "cas", [1, 2]),
+        invoke_op(0, "read", None),
+        ok_op(0, "read", 2),
+    )
+    assert analysis(CASRegister(), h)["valid?"] is True
+
+
+def test_differential_wgl_vs_linear_random():
+    """The two host engines must agree on random histories, valid and
+    corrupted (SURVEY.md §4.8: differential testing is the oracle
+    strategy for checker work)."""
+    for seed in range(25):
+        h = rand_register_history(
+            n_ops=40, n_processes=4, n_values=3,
+            crash_p=0.08, fail_p=0.08, seed=seed,
+        )
+        r1 = wgl.analysis(CASRegister(), h)
+        r2 = linear.analysis(CASRegister(), h)
+        assert r1["valid?"] is True, f"seed {seed}: construction is valid, wgl says {r1}"
+        assert r2["valid?"] is True, f"seed {seed}: construction is valid, linear says {r2}"
+
+        bad = corrupt_history(h, seed=seed, n_corruptions=2)
+        b1 = wgl.analysis(CASRegister(), bad)
+        b2 = linear.analysis(CASRegister(), bad)
+        assert b1["valid?"] == b2["valid?"], \
+            f"seed {seed}: wgl={b1['valid?']} linear={b2['valid?']}"
+
+
+def test_linearizable_dispatcher():
+    from jepsen_tpu.checker import linearizable
+    h = _h(
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(0, "read", None),
+        ok_op(0, "read", 1),
+    )
+    r = linearizable(Register(), algorithm="wgl").check({}, h)
+    assert r["valid?"] is True
+    assert r["analyzer"] == "wgl"
+
+
+@pytest.mark.parametrize("analysis", ENGINES)
+def test_crashed_acquire_not_pruned(analysis):
+    # a crashed acquire (value=None) mutates state and must NOT be pruned:
+    # this history is only valid if the crashed acquire took effect
+    from jepsen_tpu.models import Mutex
+    h = _h(
+        invoke_op(0, "acquire", None),
+        info_op(0, "acquire", None),
+        invoke_op(1, "release", None),
+        ok_op(1, "release", None),
+    )
+    assert analysis(Mutex(), h)["valid?"] is True

@@ -1,0 +1,87 @@
+from jepsen_tpu import models
+from jepsen_tpu.history import Intern, Op
+from jepsen_tpu.models import (
+    CASRegister, FIFOQueue, GSet, Mutex, Register, UnorderedQueue,
+    is_inconsistent,
+)
+
+
+def step(m, f, value=None):
+    return m.step(Op(f=f, value=value))
+
+
+def test_register():
+    m = Register()
+    m = step(m, "write", 3)
+    assert m == Register(3)
+    assert step(m, "read", 3) == m
+    assert is_inconsistent(step(m, "read", 4))
+    assert step(m, "read", None) == m  # unknown read is a wildcard
+
+
+def test_cas_register():
+    m = CASRegister(1)
+    assert step(m, "cas", [1, 2]) == CASRegister(2)
+    assert is_inconsistent(step(m, "cas", [3, 4]))
+    assert step(m, "write", 9) == CASRegister(9)
+    assert is_inconsistent(step(m, "read", 2))
+    assert step(m, "read", 1) == m
+
+
+def test_mutex():
+    m = Mutex()
+    m2 = step(m, "acquire")
+    assert m2 == Mutex(True)
+    assert is_inconsistent(step(m2, "acquire"))
+    assert step(m2, "release") == Mutex(False)
+    assert is_inconsistent(step(m, "release"))
+
+
+def test_unordered_queue():
+    m = UnorderedQueue()
+    m = step(m, "enqueue", 1)
+    m = step(m, "enqueue", 2)
+    m2 = step(m, "dequeue", 2)  # out of order is fine
+    assert not is_inconsistent(m2)
+    assert is_inconsistent(step(m2, "dequeue", 2))
+    assert not is_inconsistent(step(m2, "dequeue", 1))
+    # multiset: duplicate elements
+    m3 = step(step(m, "enqueue", 1), "dequeue", 1)
+    assert not is_inconsistent(step(m3, "dequeue", 1))
+
+
+def test_fifo_queue():
+    m = FIFOQueue()
+    m = step(m, "enqueue", 1)
+    m = step(m, "enqueue", 2)
+    assert is_inconsistent(step(m, "dequeue", 2))
+    m = step(m, "dequeue", 1)
+    assert not is_inconsistent(step(m, "dequeue", 2))
+
+
+def test_gset():
+    m = GSet()
+    m = step(m, "add", 1)
+    m = step(m, "add", 2)
+    assert not is_inconsistent(step(m, "read", [1, 2]))
+    assert is_inconsistent(step(m, "read", [1]))
+    assert not is_inconsistent(step(m, "read", None))
+
+
+def test_pack_spec_register():
+    intern = Intern()
+    spec = models.pack_spec(CASRegister(), intern)
+    assert spec is not None
+    assert spec.state0 == -1  # nil
+    f, a0, a1, wild = spec.encode_call("cas", [1, 2], None, False)
+    assert f == models.F_CAS and not wild
+    assert intern.value(a0) == 1 and intern.value(a1) == 2
+    f, a0, a1, wild = spec.encode_call("read", None, 5, False)
+    assert f == models.F_READ and intern.value(a0) == 5
+    f, a0, a1, wild = spec.encode_call("read", None, None, True)
+    assert wild
+
+
+def test_pack_spec_unpackable():
+    assert models.pack_spec(UnorderedQueue(), Intern()) is None
+    assert models.pack_spec(GSet(), Intern()) is None
