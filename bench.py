@@ -1,0 +1,74 @@
+"""Benchmark: linearizability checking throughput, device engine vs host.
+
+The north-star metric (BASELINE.md): ops/sec of linearizability checking
+on a 10k-op Tendermint-shaped cas-register history. The reference's
+cas-register workload rotates keys every 120 ops with 2n=10 worker
+threads (tendermint/src/jepsen/tendermint/core.clj:351-361), so a 10k-op
+history is ~84 independent per-key subhistories — exactly what
+jepsen.independent feeds the checker per key. The CPU baseline is this
+repo's host JIT-linearization engine (the same algorithm knossos.linear
+runs), timed on a sample of keys; the device number is the batched dense
+TPU engine checking all keys in one program (including host->device
+encode time).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+N_KEYS = 84
+OPS_PER_KEY = 120          # reference per-key cap
+N_PROCESSES = 14           # concurrent workers per key
+BUSY = 0.8                 # high overlap: realistic contention windows
+HOST_SAMPLE_KEYS = 4
+SEED = 2024
+
+
+def main():
+    from jepsen_tpu.histories import rand_register_history
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.parallel import engine
+    from jepsen_tpu.checker import linear
+
+    model = CASRegister()
+    keys = [rand_register_history(
+        n_ops=OPS_PER_KEY, n_processes=N_PROCESSES, n_values=5,
+        crash_p=0.005, fail_p=0.05, busy=BUSY, seed=SEED + k)
+        for k in range(N_KEYS)]
+    total_ops = N_KEYS * OPS_PER_KEY
+
+    # --- host baseline: same algorithm, per-key, sample + extrapolate
+    t0 = time.perf_counter()
+    for h in keys[:HOST_SAMPLE_KEYS]:
+        rh = linear.analysis(model, h)
+        assert rh["valid?"] is True, rh
+    host_secs = time.perf_counter() - t0
+    host_ops_per_sec = HOST_SAMPLE_KEYS * OPS_PER_KEY / host_secs
+
+    # --- device engine: all keys in one batched program
+    engine.check_batch(model, keys)  # warm-up: jit compile
+    t0 = time.perf_counter()
+    rs = engine.check_batch(model, keys)
+    dev_secs = time.perf_counter() - t0
+    assert all(r["valid?"] is True for r in rs), rs[:3]
+    dev_ops_per_sec = total_ops / dev_secs
+
+    print(json.dumps({
+        "metric": "linearizability check throughput "
+                  "(10k-op multi-key cas-register history)",
+        "value": round(dev_ops_per_sec, 1),
+        "unit": "ops/sec",
+        "vs_baseline": round(dev_ops_per_sec / host_ops_per_sec, 2),
+    }))
+    print(f"# device: {dev_secs:.3f}s for {total_ops} ops across {N_KEYS} "
+          f"keys (incl. encode); host: {host_secs:.3f}s for "
+          f"{HOST_SAMPLE_KEYS * OPS_PER_KEY} ops "
+          f"({host_ops_per_sec:.0f} ops/s)", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,7 @@ def rand_register_history(
     cas: bool = True,
     crash_p: float = 0.05,
     fail_p: float = 0.05,
+    busy: float = 0.5,
     seed: int = 45100,
 ) -> History:
     """A random, linearizable-by-construction cas-register history.
@@ -53,8 +54,10 @@ def rand_register_history(
         return o
 
     while started < n_ops or pending:
+        # `busy` biases toward opening new calls before completing pending
+        # ones: higher busy -> more concurrency -> wider search windows
         can_start = started < n_ops and free
-        if can_start and (not pending or rng.random() < 0.5):
+        if can_start and (not pending or rng.random() < busy):
             p = free.pop(rng.randrange(len(free)))
             r = rng.random()
             if cas and r < 0.3:

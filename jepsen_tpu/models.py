@@ -252,6 +252,11 @@ class PackedSpec:
     step_name: str
     encode_call: Callable[..., Tuple[int, int, int, bool]]
     f_codes: dict
+    # dense-engine state domain: states are the contiguous ints
+    # [state_lo, state_lo + n_states(intern)); register family uses
+    # interned value codes with nil = -1, mutex uses {0, 1}
+    state_lo: int = -1
+    n_states: Callable = None  # (intern) -> int
 
 
 def pack_spec(model: Model, intern) -> Optional[PackedSpec]:
@@ -290,6 +295,8 @@ def pack_spec(model: Model, intern) -> Optional[PackedSpec]:
             step_name="register",
             encode_call=encode_call,
             f_codes={"read": F_READ, "write": F_WRITE, "cas": F_CAS},
+            state_lo=-1,
+            n_states=lambda intern: len(intern) + 1,
         )
 
     if isinstance(model, Mutex):
@@ -305,6 +312,8 @@ def pack_spec(model: Model, intern) -> Optional[PackedSpec]:
             step_name="mutex",
             encode_call=encode_call,
             f_codes={"acquire": F_ACQUIRE, "release": F_RELEASE},
+            state_lo=0,
+            n_states=lambda intern: 2,
         )
 
     return None

@@ -1,0 +1,5 @@
+"""TPU execution tier: packed histories, jit'd model steps, and the
+device-sharded linearizability search engine (the north star —
+BASELINE.json: batched frontier expansion over (model-state,
+linearized-op-bitset) configurations, vmap'd per chip, deduped over the
+ICI mesh)."""

@@ -1,0 +1,198 @@
+"""Dense linearizability engine: the config space as one tensor.
+
+The sparse engine (parallel.engine) carries an explicit frontier and
+pays a sort per closure round. For the workloads the reference actually
+runs — per-key histories capped at ~20 concurrent processes
+(jepsen/src/jepsen/tests/linearizable_register.clj:30-32,
+tendermint/src/jepsen/tendermint/core.clj:351-361) — the whole
+configuration space (model-state × linearized-mask) is small enough to
+hold **densely**: a boolean tensor
+
+    B[s, m] = "config (state s, window-mask m) is reachable"
+
+with shape [S, 2^C] (S = distinct values + nil, C = open-call window).
+Then the search is pure tensor algebra, exactly what a TPU wants:
+
+  * closure round: for every open slot j, configs without bit j extend by
+    linearizing call j. The state transition is a one-hot matrix
+    P[j, s, s'] (computed on device from the slot tables), so the whole
+    round is einsum('jst,sm->jtm', P, B&~bit_j) — an MXU matmul batch —
+    followed by a static gather that ORs the result in at m|bit_j.
+  * return-of-slot-s filter: B'[:, m] = B[:, m | bit_s] for m without
+    bit s, else 0 — a static index shuffle.
+  * no frontier capacity, no dedupe, no overflow: the tensor IS the
+    visited set, fully materialised.
+
+Work per closure round is S·2^C·C·S MACs — for S=8, C=13 that's ~4M,
+microseconds on the MXU — vs a ~N·C·log sort in sparse mode. The host
+chooses dense when S·2^C fits a budget (see `fits_dense`), sparse
+otherwise; both implement the spec in jepsen_tpu.checker.linear.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from jepsen_tpu.parallel.encode import EncodedHistory
+from jepsen_tpu.parallel.steps import STEPS
+
+DENSE_BUDGET = 1 << 22  # max S * 2^C cells per key
+
+
+def fits_dense(n_states: int, n_slots: int, budget: int = DENSE_BUDGET) -> bool:
+    return n_slots <= 20 and n_states * (1 << n_slots) <= budget
+
+
+def _check_dense_impl(xs, state0, step_name: str, S: int, C: int,
+                      lo: int = -1):
+    """Scan over return events on the dense tensor. xs fields as in the
+    sparse engine ([R, C] slot tables + [R] ev_slot). Returns
+    (valid, fail_event)."""
+    step = STEPS[step_name]
+    M = 1 << C
+    m_idx = jnp.arange(M, dtype=jnp.int32)
+    # static per-slot tables over the mask axis
+    bit_of = (jnp.int32(1) << jnp.arange(C, dtype=jnp.int32))       # [C]
+    has_bit = ((m_idx[None, :] >> jnp.arange(C)[:, None]) & 1) == 1  # [C, M]
+    xor_j = m_idx[None, :] ^ bit_of[:, None]                         # [C, M]
+    state_codes = jnp.arange(S, dtype=jnp.int32) + lo
+
+    # step vmapped over (slots, states): tables [C, S]
+    step_js = jax.vmap(
+        jax.vmap(step, in_axes=(0, None, None, None, None)),  # states
+        in_axes=(None, 0, 0, 0, 0),                           # slots
+    )
+
+    def closure_cond(c):
+        _, changed = c
+        return changed
+
+    def make_closure_body(ev):
+        nxt, okj = step_js(state_codes, ev["slot_f"], ev["slot_a0"],
+                           ev["slot_a1"], ev["slot_wild"])
+        legal = okj & ev["slot_occ"][:, None]                 # [C, S]
+        # one-hot transition: P[j, s, s'] (s' index = next code + 1)
+        P = (jax.nn.one_hot(nxt - lo, S, dtype=jnp.float32)
+             * legal[..., None].astype(jnp.float32))          # [C, S, S]
+
+        def body(c):
+            B, _ = c
+            # ext[j, s, m]: config (s, m) can still linearize slot j
+            ext = (B[None, :, :] & ~has_bit[:, None, :]).astype(jnp.float32)
+            contrib = jnp.einsum("jst,jsm->jtm", P, ext) > 0   # [C, S, M]
+            # contribution lands at m | bit_j == m ^ bit_j for m with bit set
+            shifted = jnp.take_along_axis(
+                contrib, jnp.broadcast_to(xor_j[:, None, :], contrib.shape),
+                axis=2)
+            shifted = shifted & has_bit[:, None, :]
+            B2 = B | jnp.any(shifted, axis=0)
+            return B2, jnp.any(B2 != B)
+        return body
+
+    def scan_step(carry, ev):
+        B, ok, fail_r, r_idx = carry
+        run = ok & (ev["ev_slot"] >= 0)
+        B2, _ = lax.while_loop(
+            closure_cond, make_closure_body(ev), (B, run))
+        # filter: keep configs with bit s, clearing it
+        s = jnp.maximum(ev["ev_slot"], 0)
+        bit_s = jnp.int32(1) << s
+        no_s = (m_idx & bit_s) == 0                            # [M]
+        B3 = jnp.take(B2, m_idx | bit_s, axis=1) & no_s[None, :]
+        alive = jnp.any(B3)
+        failed_here = run & ~alive
+        B_o = jnp.where(run, B3, B)
+        ok_o = jnp.where(run, ~failed_here, ok)
+        fail_o = jnp.where(failed_here & (fail_r < 0), r_idx, fail_r)
+        return (B_o, ok_o, fail_o, r_idx + 1), 0
+
+    B0 = jnp.zeros((S, 1 << C), bool).at[state0 - lo, 0].set(True)
+    carry0 = (B0, jnp.array(True), jnp.int32(-1), jnp.int32(0))
+    (B, ok, fail_r, _), _ = lax.scan(scan_step, carry0, xs)
+    valid = ok & jnp.any(B)
+    return valid, fail_r
+
+
+_check_dense = jax.jit(_check_dense_impl,
+                       static_argnames=("step_name", "S", "C", "lo"))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("step_name", "S", "C", "lo"))
+def _check_dense_batch(xs, state0, step_name: str, S: int, C: int,
+                       lo: int = -1):
+    return jax.vmap(
+        lambda x, s0: _check_dense_impl(x, s0, step_name, S, C, lo)
+    )(xs, state0)
+
+
+def _xs_dense(e: EncodedHistory, C: int) -> dict:
+    def padc(a, fill):
+        out = np.full((a.shape[0], C), fill, a.dtype)
+        out[:, : a.shape[1]] = a
+        return jnp.asarray(out)
+
+    return {
+        "slot_f": padc(e.slot_f, -1),
+        "slot_a0": padc(e.slot_a0, -1),
+        "slot_a1": padc(e.slot_a1, -1),
+        "slot_wild": padc(e.slot_wild, False),
+        "slot_occ": padc(e.slot_occ, False),
+        "ev_slot": jnp.asarray(e.ev_slot),
+    }
+
+
+def n_states(e: EncodedHistory) -> int:
+    return e.n_states
+
+
+def check_encoded_dense(e: EncodedHistory) -> dict:
+    """Check one encoded history with the dense engine."""
+    if e.n_returns == 0:
+        return {"valid?": True, "engine": "dense"}
+    S = n_states(e)
+    C = e.n_slots
+    valid, fail_r = _check_dense(_xs_dense(e, C), jnp.int32(e.state0),
+                                 e.step_name, S, C, e.state_lo)
+    out = {"valid?": bool(valid), "engine": "dense",
+           "states": S, "slots": C}
+    if not out["valid?"]:
+        r = int(fail_r)
+        c = e.calls[int(e.ret_call[r])]
+        out["op"] = {"process": c.process, "f": c.f,
+                     "value": c.result if c.f == "read" else c.value,
+                     "index": c.invoke_index}
+        out["fail-event"] = r
+    return out
+
+
+def check_batch_dense(encs, mesh=None) -> list:
+    """Batch of per-key encoded histories on the dense engine (vmap over
+    keys; key axis sharded over `mesh` when divisible). Kept as the
+    readable unpacked reference — production dispatch uses bitdense."""
+    if not encs:
+        return []
+    from jepsen_tpu.parallel.encode import pad_batch
+    step_name = encs[0].step_name
+    xs, state0, S, C, R = pad_batch(encs, mesh=mesh)
+    valid, fail_r = _check_dense_batch(xs, state0, step_name, S, C,
+                                       encs[0].state_lo)
+    valid = np.asarray(valid)
+    fail_r = np.asarray(fail_r)
+    out = []
+    for k, e in enumerate(encs):
+        r = {"valid?": bool(valid[k]), "engine": "dense"}
+        if not r["valid?"]:
+            ri = int(fail_r[k])
+            c = e.calls[int(e.ret_call[ri])]
+            r["op"] = {"process": c.process, "f": c.f,
+                       "value": c.result if c.f == "read" else c.value,
+                       "index": c.invoke_index}
+        out.append(r)
+    return out

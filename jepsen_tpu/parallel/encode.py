@@ -1,0 +1,204 @@
+"""Host-side encoding: history -> static event/slot tables for the device.
+
+The JIT-linearization search (spec: jepsen_tpu.checker.linear) processes
+only **return** events; between two returns the per-config state space is
+closed under "linearize any open, unlinearized call". The set of *open*
+calls at any moment is determined by the history alone — only *which are
+linearized* varies per configuration. So all slot bookkeeping happens
+here, once, on the host:
+
+  * every call gets a **window slot** (smallest free at invoke; freed
+    after its return filters the frontier; crashed calls hold their slot
+    forever),
+  * every return event r gets a snapshot of the slot table just before
+    it: which slots are occupied and the packed op (f, a0, a1, wild) in
+    each.
+
+On device a configuration is then just (state: i32, mask: 2×u32) where
+mask bit j = "the call in slot j has linearized" — the fixed-width
+replacement for knossos.linear.config's per-config BitSet
+(BASELINE.json north_star). Max window = 64 slots; histories needing
+more (pathological crash pile-ups) fall back to the host engines
+(SURVEY.md §7.3 hard part #1/#4).
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from jepsen_tpu import models as model_ns
+from jepsen_tpu.history import (
+    History, Intern, calls as history_calls, prune_wildcard_calls,
+)
+
+MAX_SLOTS = 64
+
+
+@dataclass
+class EncodedHistory:
+    """Static device input for one key's history. R return events, C slots."""
+
+    slot_f: np.ndarray      # [R, C] i32, f-code of op in slot (-1 empty)
+    slot_a0: np.ndarray     # [R, C] i32
+    slot_a1: np.ndarray     # [R, C] i32
+    slot_wild: np.ndarray   # [R, C] bool
+    slot_occ: np.ndarray    # [R, C] bool
+    ev_slot: np.ndarray     # [R] i32, slot of the returning call
+    ret_call: np.ndarray    # [R] i32, dense call id returning (reporting)
+    state0: int
+    step_name: str
+    n_calls: int
+    n_slots: int            # C actually used (<= MAX_SLOTS)
+    calls: list             # surviving Call records (host-side reporting)
+    intern: Intern          # value table (host-side reporting)
+    state_lo: int = -1      # dense state domain: [state_lo, state_lo + S)
+    n_states: int = 0
+
+    @property
+    def n_returns(self) -> int:
+        return len(self.ev_slot)
+
+
+class EncodeError(Exception):
+    """History can't go to the device; callers fall back to host engines."""
+
+
+def encode(model, history, pad_slots: Optional[int] = None) -> EncodedHistory:
+    """Encode (model, history) for the device engine.
+
+    Raises EncodeError if the model isn't packable or the open-call
+    window exceeds MAX_SLOTS.
+    """
+    intern = Intern()
+    spec = model_ns.pack_spec(model, intern)
+    if spec is None:
+        raise EncodeError(f"model {type(model).__name__} is not device-packable")
+
+    h = history if isinstance(history, History) else History.wrap(history)
+    cs = prune_wildcard_calls(history_calls(h))
+
+    # events in history order; kind 0=invoke first on ties (an invoke at
+    # the same index as a return cannot precede it in a real history —
+    # indices are unique — so tie order is moot but deterministic)
+    events = []
+    for c in cs:
+        events.append((c.invoke_index, 0, c.index))
+        if not c.crashed:
+            events.append((c.complete_index, 1, c.index))
+    events.sort()
+
+    # encode per-call packed ops
+    enc_f = np.empty(len(cs), np.int32)
+    enc_a0 = np.empty(len(cs), np.int32)
+    enc_a1 = np.empty(len(cs), np.int32)
+    enc_wild = np.empty(len(cs), bool)
+    for c in cs:
+        f, a0, a1, wild = spec.encode_call(c.f, c.value, c.result, c.crashed)
+        enc_f[c.index] = f
+        enc_a0[c.index] = a0
+        enc_a1[c.index] = a1
+        enc_wild[c.index] = wild
+
+    # slot assignment + per-return snapshots
+    free: list = []  # min-heap of free slots
+    n_slots = 0
+    slot_of_call = {}
+    slot_call = np.full(MAX_SLOTS, -1, np.int32)  # current occupant
+    R = sum(1 for _, k, _ in events if k == 1)
+    C_alloc = MAX_SLOTS
+    slot_f = np.full((R, C_alloc), -1, np.int32)
+    slot_a0 = np.full((R, C_alloc), -1, np.int32)
+    slot_a1 = np.full((R, C_alloc), -1, np.int32)
+    slot_wild = np.zeros((R, C_alloc), bool)
+    slot_occ = np.zeros((R, C_alloc), bool)
+    ev_slot = np.empty(R, np.int32)
+    ret_call = np.empty(R, np.int32)
+
+    r = 0
+    for _, kind, cid in events:
+        if kind == 0:
+            s = heapq.heappop(free) if free else n_slots
+            if s == n_slots:
+                n_slots += 1
+                if n_slots > MAX_SLOTS:
+                    raise EncodeError(
+                        f"open-call window exceeds {MAX_SLOTS} slots "
+                        f"(too many concurrent/crashed calls); use the "
+                        f"host engine or partition the history per key")
+            slot_of_call[cid] = s
+            slot_call[s] = cid
+        else:
+            # snapshot just before the return
+            occ = slot_call >= 0
+            ids = np.where(occ, slot_call, 0)
+            slot_occ[r] = occ
+            slot_f[r] = np.where(occ, enc_f[ids], -1)
+            slot_a0[r] = np.where(occ, enc_a0[ids], -1)
+            slot_a1[r] = np.where(occ, enc_a1[ids], -1)
+            slot_wild[r] = np.where(occ, enc_wild[ids], False)
+            s = slot_of_call[cid]
+            ev_slot[r] = s
+            ret_call[r] = cid
+            r += 1
+            slot_call[s] = -1
+            heapq.heappush(free, s)
+
+    C = pad_slots or n_slots
+    C = max(1, min(MAX_SLOTS, max(C, n_slots)))
+    return EncodedHistory(
+        slot_f=slot_f[:, :C], slot_a0=slot_a0[:, :C], slot_a1=slot_a1[:, :C],
+        slot_wild=slot_wild[:, :C], slot_occ=slot_occ[:, :C],
+        ev_slot=ev_slot, ret_call=ret_call,
+        state0=spec.state0, step_name=spec.step_name,
+        n_calls=len(cs), n_slots=n_slots, calls=cs, intern=intern,
+        state_lo=spec.state_lo,
+        n_states=spec.n_states(intern) if spec.n_states else len(intern) + 1,
+    )
+
+
+def pad_batch(encs: list, mesh=None):
+    """Pad per-key encoded histories to one (K, R, C) batch and build the
+    scanned arrays; with a mesh (and K divisible by its first axis) the
+    key axis is device_put-sharded across it. Shared by the sparse,
+    dense, and bitdense batch checkers. Returns (xs, state0, S, C, R)."""
+    import jax
+    import jax.numpy as jnp
+
+    S = max(e.n_states for e in encs)
+    C = max(e.slot_f.shape[1] for e in encs)
+    R = max(e.n_returns for e in encs)
+    K = len(encs)
+
+    def pad(attr, fill, dtype):
+        out = np.full((K, R, C), fill, dtype)
+        for k, e in enumerate(encs):
+            a = getattr(e, attr)
+            out[k, : a.shape[0], : a.shape[1]] = a
+        return jnp.asarray(out)
+
+    xs = {
+        "slot_f": pad("slot_f", -1, np.int32),
+        "slot_a0": pad("slot_a0", -1, np.int32),
+        "slot_a1": pad("slot_a1", -1, np.int32),
+        "slot_wild": pad("slot_wild", False, bool),
+        "slot_occ": pad("slot_occ", False, bool),
+    }
+    ev = np.full((K, R), -1, np.int32)
+    for k, e in enumerate(encs):
+        ev[k, : e.n_returns] = e.ev_slot
+    xs["ev_slot"] = jnp.asarray(ev)
+    state0 = jnp.asarray(np.array([e.state0 for e in encs], np.int32))
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        ax = mesh.axis_names[0]
+        if K % mesh.shape[ax] == 0:
+            xs = {k: jax.device_put(v, NamedSharding(
+                mesh, P(*((ax,) + (None,) * (v.ndim - 1)))))
+                for k, v in xs.items()}
+            state0 = jax.device_put(state0, NamedSharding(mesh, P(ax)))
+    return xs, state0, S, C, R

@@ -1,0 +1,382 @@
+"""The TPU linearizability engine — batched frontier expansion under jit.
+
+This is the north star (BASELINE.json): the knossos linear/wgl search
+re-designed for the MXU/VPU instead of translated. The algorithm is the
+JIT-linearization frontier of `jepsen_tpu.checker.linear` (its docstring
+is the spec; differential tests pin the two together), mapped to XLA:
+
+  * a configuration is (state: i32, mask: 2×u32) — 96 bits, fixed width;
+  * the frontier is a fixed-capacity struct-of-arrays [N] with a live
+    mask; capacity doubles on overflow by re-jitting (SURVEY.md §7.3
+    hard part #1: capacity-tiered buffers);
+  * one closure round = a single vmap'd evaluation of the model step
+    over all N×C (config, open-slot) pairs — millions of candidate
+    configs per chip per round;
+  * dedupe is sort-based (lexsort + adjacent-compare + cumsum scatter):
+    static shapes, no host round-trips. The sorted frontier *is* the
+    visited set — in this formulation the full config set at the current
+    event subsumes knossos's visited cache;
+  * the outer loop over return events is a lax.scan; the inner closure
+    a lax.while_loop. Nothing data-dependent escapes the device: the
+    host gets back (valid, fail_event, stats) scalars only.
+
+Multi-chip: `check_batch` vmaps over keys and shards the key axis over a
+mesh (data parallel — P5 in SURVEY.md §2.20); `jepsen_tpu.parallel.sharded`
+shards the *frontier* axis with collective dedupe for giant single keys.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from jepsen_tpu.parallel import encode as enc_mod
+from jepsen_tpu.parallel.encode import EncodedHistory, EncodeError
+from jepsen_tpu.parallel.steps import STEPS
+
+
+# ------------------------------------------------------------ device core
+
+
+def _slot_bits(C: int):
+    js = jnp.arange(C, dtype=jnp.uint32)
+    one = jnp.uint32(1)
+    bit_lo = jnp.where(js < 32, one << jnp.minimum(js, 31),
+                       jnp.uint32(0)).astype(jnp.uint32)
+    bit_hi = jnp.where(js >= 32, one << jnp.minimum(js - 32, jnp.uint32(31)),
+                       jnp.uint32(0)).astype(jnp.uint32)
+    return bit_lo, bit_hi
+
+
+def _dedupe_compact(st, ml, mh, live, N):
+    """Sort rows by (dead, state, mask), flag first occurrences, compact
+    into a fresh [N] frontier. Returns (state, ml, mh, live, count,
+    overflow)."""
+    M = st.shape[0]
+    order = jnp.lexsort((mh, ml, st, (~live).astype(jnp.int8)))
+    st_s = st[order]
+    ml_s = ml[order]
+    mh_s = mh[order]
+    live_s = live[order]
+    prev_same = jnp.concatenate([
+        jnp.zeros(1, bool),
+        (st_s[1:] == st_s[:-1]) & (ml_s[1:] == ml_s[:-1])
+        & (mh_s[1:] == mh_s[:-1]),
+    ])
+    uniq = live_s & ~prev_same
+    count = jnp.sum(uniq)
+    pos = jnp.where(uniq, jnp.cumsum(uniq) - 1, M + N)  # OOB -> dropped
+    new_st = jnp.zeros(N, jnp.int32).at[pos].set(st_s, mode="drop")
+    new_ml = jnp.zeros(N, jnp.uint32).at[pos].set(ml_s, mode="drop")
+    new_mh = jnp.zeros(N, jnp.uint32).at[pos].set(mh_s, mode="drop")
+    new_live = jnp.arange(N) < count
+    return new_st, new_ml, new_mh, new_live, count, count > N
+
+
+def _check_impl(xs, state0, step_name: str, N: int):
+    """Scan over return events. xs: dict of [R, ...] arrays. Returns
+    (valid, fail_event, overflow, max_frontier, steps_evaluated)."""
+    step = STEPS[step_name]
+    C = xs["slot_f"].shape[1]
+    bit_lo, bit_hi = _slot_bits(C)
+
+    # model step vmapped over configs x slots
+    step_cc = jax.vmap(
+        jax.vmap(step, in_axes=(None, 0, 0, 0, 0)),  # over slots
+        in_axes=(0, None, None, None, None),         # over configs
+    )
+
+    def closure_cond(c):
+        _, _, _, _, changed, overflow, _ = c
+        return changed & ~overflow
+
+    def make_closure_body(ev):
+        def body(c):
+            st, ml, mh, live, _, _, iters = c
+            cand_st, cand_ok = step_cc(
+                st, ev["slot_f"], ev["slot_a0"], ev["slot_a1"], ev["slot_wild"]
+            )
+            already = ((ml[:, None] & bit_lo[None, :])
+                       | (mh[:, None] & bit_hi[None, :])) != 0
+            legal = (live[:, None] & ev["slot_occ"][None, :]
+                     & ~already & cand_ok)
+            cand_ml = ml[:, None] | bit_lo[None, :]
+            cand_mh = mh[:, None] | bit_hi[None, :]
+            all_st = jnp.concatenate([st, cand_st.reshape(-1)])
+            all_ml = jnp.concatenate([ml, cand_ml.reshape(-1)])
+            all_mh = jnp.concatenate([mh, cand_mh.reshape(-1)])
+            all_live = jnp.concatenate([live, legal.reshape(-1)])
+            old_count = jnp.sum(live)
+            st2, ml2, mh2, live2, count, ovf = _dedupe_compact(
+                all_st, all_ml, all_mh, all_live, N)
+            return st2, ml2, mh2, live2, count > old_count, ovf, iters + 1
+        return body
+
+    def scan_step(carry, ev):
+        st, ml, mh, live, ok, fail_r, r_idx, maxf, steps_n = carry
+        is_pad = ev["ev_slot"] < 0
+        run = ok & ~is_pad
+
+        # closure: expand until no new configs (skipped when run=False:
+        # the initial `changed` flag is `run`)
+        st2, ml2, mh2, live2, _, ovf, iters = lax.while_loop(
+            closure_cond, make_closure_body(ev),
+            (st, ml, mh, live, run, jnp.array(False), jnp.int32(0)),
+        )
+
+        # filter: returning call must have linearized; then free its slot
+        s = jnp.maximum(ev["ev_slot"], 0).astype(jnp.uint32)
+        one = jnp.uint32(1)
+        blo = jnp.where(s < 32, one << jnp.minimum(s, 31),
+                        jnp.uint32(0)).astype(jnp.uint32)
+        bhi = jnp.where(s >= 32,
+                        one << jnp.minimum(jnp.where(s >= 32, s - 32, 0),
+                                           jnp.uint32(31)),
+                        jnp.uint32(0)).astype(jnp.uint32)
+        has = ((ml2 & blo) | (mh2 & bhi)) != 0
+        live3 = live2 & has
+        ml3 = jnp.where(live3, ml2 & ~blo, ml2)
+        mh3 = jnp.where(live3, mh2 & ~bhi, mh2)
+        n_live = jnp.sum(live3)
+        failed_here = run & (n_live == 0)
+
+        new_ok = jnp.where(run, ~failed_here & ~ovf, ok)
+        new_fail = jnp.where(failed_here & (fail_r < 0), r_idx, fail_r)
+        st_o = jnp.where(run, st2, st)
+        ml_o = jnp.where(run, ml3, ml)
+        mh_o = jnp.where(run, mh3, mh)
+        live_o = jnp.where(run, live3, live)
+        maxf = jnp.maximum(maxf, jnp.where(run, jnp.sum(live2), 0))
+        # count closure iterations only; the host multiplies by N*C in
+        # Python (int32 would overflow at large capacities)
+        steps_n = steps_n + jnp.where(run, iters, 0)
+        return (st_o, ml_o, mh_o, live_o, new_ok, new_fail,
+                r_idx + 1, maxf, steps_n), ovf
+
+    st0 = jnp.zeros(N, jnp.int32).at[0].set(state0)
+    ml0 = jnp.zeros(N, jnp.uint32)
+    mh0 = jnp.zeros(N, jnp.uint32)
+    live0 = jnp.arange(N) < 1
+    carry0 = (st0, ml0, mh0, live0, jnp.array(True), jnp.int32(-1),
+              jnp.int32(0), jnp.int32(1), jnp.int32(0))
+    carry, ovfs = lax.scan(scan_step, carry0, xs)
+    _, _, _, live, ok, fail_r, _, maxf, steps_n = carry
+    overflow = jnp.any(ovfs)
+    valid = ok & (jnp.sum(live) > 0) & ~overflow
+    return valid, fail_r, overflow, maxf, steps_n
+
+
+_check_device = jax.jit(_check_impl, static_argnames=("step_name", "N"))
+
+
+@functools.partial(jax.jit, static_argnames=("step_name", "N"))
+def _check_device_batch(xs, state0, step_name: str, N: int):
+    return jax.vmap(
+        lambda x, s0: _check_impl(x, s0, step_name, N)
+    )(xs, state0)
+
+
+# ------------------------------------------------------------- host API
+
+
+def _xs_from_encoded(e: EncodedHistory) -> dict:
+    return {
+        "slot_f": jnp.asarray(e.slot_f),
+        "slot_a0": jnp.asarray(e.slot_a0),
+        "slot_a1": jnp.asarray(e.slot_a1),
+        "slot_wild": jnp.asarray(e.slot_wild),
+        "slot_occ": jnp.asarray(e.slot_occ),
+        "ev_slot": jnp.asarray(e.ev_slot),
+    }
+
+
+def check_encoded(e: EncodedHistory, capacity: int = 1024,
+                  max_capacity: int = 1 << 20) -> dict:
+    """Check one encoded history, doubling frontier capacity on overflow
+    (re-jit per capacity tier; tiers are cached by jax.jit)."""
+    if e.n_returns == 0:
+        return {"valid?": True, "max-frontier": 0, "capacity": 0}
+    xs = _xs_from_encoded(e)
+    N = max(64, capacity)
+    while True:
+        valid, fail_r, overflow, maxf, steps_n = _check_device(
+            xs, jnp.int32(e.state0), e.step_name, N)
+        if not bool(overflow):
+            break
+        if N * 2 > max_capacity:
+            return {"valid?": "unknown",
+                    "error": f"frontier overflow at capacity {N}",
+                    "capacity": N}
+        N *= 2
+    out = {
+        "valid?": bool(valid),
+        "max-frontier": int(maxf),
+        "capacity": N,
+        "explored": int(steps_n) * N * len(e.slot_f[0]),
+    }
+    if not out["valid?"]:
+        r = int(fail_r)
+        cid = int(e.ret_call[r])
+        c = e.calls[cid]
+        out["op"] = {"process": c.process, "f": c.f,
+                     "value": c.result if c.f == "read" else c.value,
+                     "index": c.invoke_index}
+        out["fail-event"] = r
+    return out
+
+
+def analysis(model, history, capacity: int = 1024) -> dict:
+    """knossos-style (model, history) -> result on the device engine.
+
+    Falls back to the host WGL engine when the model can't pack or the
+    open-call window exceeds the device limit. On failure, counter-example
+    paths are reconstructed host-side on the failing prefix (SURVEY.md
+    §7.3 hard part #3: breadcrumbs stay implicit; a host re-search of the
+    short failing prefix supplies :final-paths).
+    """
+    from jepsen_tpu.history import History
+    h = history if isinstance(history, History) else History.wrap(history)
+    try:
+        e = enc_mod.encode(model, h)
+    except EncodeError as err:
+        from jepsen_tpu.checker import wgl
+        r = wgl.analysis(model, h)
+        r["fallback"] = str(err)
+        return r
+    from jepsen_tpu.parallel import bitdense
+    if bitdense.fits_bitdense(bitdense.n_states(e), e.n_slots):
+        r = bitdense.check_encoded_bitdense(e)
+    else:
+        r = check_encoded(e, capacity=capacity)
+    if r["valid?"] is False and e.n_calls <= 500:
+        from jepsen_tpu.checker import wgl
+        fail_idx = e.calls[int(e.ret_call[r["fail-event"]])].complete_index
+        host = wgl.check_calls(model, _prefix_calls(e.calls, fail_idx),
+                               fail_idx + 1)
+        if host.get("valid?") is False:
+            r["final-paths"] = host.get("final-paths", [])
+            r["configs"] = host.get("configs", [])
+    return r
+
+
+def _prefix_calls(cs, fail_idx):
+    """Calls restricted to the failing prefix: everything invoked up to
+    fail_idx, with completions after it treated as still-open (crashed)."""
+    from jepsen_tpu.history import Call
+    out = []
+    for c in cs:
+        if c.invoke_index > fail_idx:
+            continue
+        if c.complete_index > fail_idx:
+            c2 = Call(c.index, c.process, c.f, c.value, None,
+                      c.invoke_index, fail_idx + 1, True)
+        else:
+            c2 = Call(c.index, c.process, c.f, c.value, c.result,
+                      c.invoke_index, c.complete_index, c.crashed)
+        out.append(c2)
+    for j, c in enumerate(out):
+        c.index = j
+    return out
+
+
+# ----------------------------------------------------- batched (per-key)
+
+
+def encode_batch(model, histories, pad_slots: Optional[int] = None,
+                 encs: Optional[list] = None):
+    """Encode many per-key histories to one padded batch (the reference's
+    per-key data parallelism, jepsen.independent — SURVEY.md §2.20 P5:
+    'one key's history per TPU program instance')."""
+    if encs is None:
+        encs = [enc_mod.encode(model, h, pad_slots=pad_slots)
+                for h in histories]
+    C = max(e.slot_f.shape[1] for e in encs)
+    R = max(e.n_returns for e in encs)
+    K = len(encs)
+
+    def pad(attr, fill, dtype):
+        out = np.full((K, R, C), fill, dtype)
+        for k, e in enumerate(encs):
+            arr = getattr(e, attr)
+            out[k, : arr.shape[0], : arr.shape[1]] = arr
+        return jnp.asarray(out)
+
+    xs = {
+        "slot_f": pad("slot_f", -1, np.int32),
+        "slot_a0": pad("slot_a0", -1, np.int32),
+        "slot_a1": pad("slot_a1", -1, np.int32),
+        "slot_wild": pad("slot_wild", False, bool),
+        "slot_occ": pad("slot_occ", False, bool),
+    }
+    ev = np.full((K, R), -1, np.int32)
+    for k, e in enumerate(encs):
+        ev[k, : e.n_returns] = e.ev_slot
+    xs["ev_slot"] = jnp.asarray(ev)
+    state0 = jnp.asarray(np.array([e.state0 for e in encs], np.int32))
+    return encs, xs, state0
+
+
+def check_batch(model, histories, capacity: int = 512,
+                max_capacity: int = 1 << 18, mesh=None) -> list:
+    """Check many per-key histories in one device program: vmap over the
+    key axis; with a mesh (and K divisible by its size) the key axis is
+    sharded across devices — data parallelism over ICI. Dispatches to the
+    bit-packed dense engine (parallel.bitdense) when the COMBINED padded
+    batch dims fit its budget, sparse frontier mode otherwise."""
+    if not histories:
+        return []
+    from jepsen_tpu.parallel import bitdense
+    pre = [enc_mod.encode(model, h) for h in histories]
+    # the batch pads every key to (max S, max C): gate on the combined
+    # dims, not per key — individually-fitting keys can combine into an
+    # over-budget program
+    S_max = max(bitdense.n_states(e) for e in pre)
+    C_max = max(e.n_slots for e in pre)
+    if bitdense.fits_bitdense(S_max, C_max):
+        return bitdense.check_batch_bitdense(pre, mesh=mesh)
+    encs, xs, state0 = encode_batch(model, histories, encs=pre)
+    step_name = encs[0].step_name
+    K = len(encs)
+    N = max(64, capacity)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        ax = mesh.axis_names[0]
+        n_dev = mesh.shape[ax]
+        if K % n_dev == 0:
+            xs = {k: jax.device_put(v, NamedSharding(
+                mesh, P(*((ax,) + (None,) * (v.ndim - 1)))))
+                for k, v in xs.items()}
+            state0 = jax.device_put(state0, NamedSharding(mesh, P(ax)))
+    while True:
+        valid, fail_r, overflow, maxf, steps_n = _check_device_batch(
+            xs, state0, step_name, N)
+        if not bool(jnp.any(overflow)) or N * 2 > max_capacity:
+            break
+        N *= 2
+    valid = np.asarray(valid)
+    fail_r = np.asarray(fail_r)
+    overflow = np.asarray(overflow)
+    maxf = np.asarray(maxf)
+    out = []
+    for k, e in enumerate(encs):
+        if bool(overflow[k]):
+            out.append({"valid?": "unknown",
+                        "error": f"frontier overflow at capacity {N}"})
+            continue
+        r = {"valid?": bool(valid[k]), "max-frontier": int(maxf[k]),
+             "capacity": N}
+        if not r["valid?"]:
+            ri = int(fail_r[k])
+            cid = int(e.ret_call[ri])
+            c = e.calls[cid]
+            r["op"] = {"process": c.process, "f": c.f,
+                       "value": c.result if c.f == "read" else c.value,
+                       "index": c.invoke_index}
+        out.append(r)
+    return out

@@ -1,0 +1,218 @@
+"""Frontier-sharded linearizability search over a device mesh.
+
+`engine.check_batch` parallelises over *keys* (data parallel). This
+module parallelises over the *frontier* of a single giant key — the
+capability CPU knossos fundamentally lacks (SURVEY.md §5.7: "shard the
+search frontier, not the sequence"):
+
+  * each of the D devices on the mesh owns N/D configuration rows;
+  * the closure expands locally (vmap over local configs × slots);
+  * dedupe is global: every config is **owned** by the device
+    `hash(config) % D`. Candidates are all-gathered over the mesh axis
+    (ICI), each device keeps the rows it owns, then sort-dedupes
+    locally. A config can therefore exist on exactly one device — the
+    union of per-device frontiers is the exact global config set. This
+    is the "device-sharded hash set deduped over the ICI mesh" of
+    BASELINE.json, realised with XLA collectives instead of NCCL;
+  * liveness / convergence / overflow decisions ride `psum`s.
+
+The whole event scan runs inside one `shard_map` region: slot tables are
+replicated, frontier arrays stay device-local, and the only cross-device
+traffic is the closure's all-gather + psums.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from jepsen_tpu.parallel.encode import EncodedHistory
+from jepsen_tpu.parallel.engine import _slot_bits, _xs_from_encoded
+from jepsen_tpu.parallel.steps import STEPS
+
+AXIS = "frontier"
+
+
+def _hash_config(st, ml, mh):
+    h = (st.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)) \
+        ^ (ml * jnp.uint32(0x85EBCA77)) ^ (mh * jnp.uint32(0xC2B2AE3D))
+    h ^= h >> 15
+    h = h * jnp.uint32(0x2C1B3C6D)
+    h ^= h >> 12
+    return h
+
+
+def _owned_dedupe_compact(st, ml, mh, live, Nd, n_dev, my_idx):
+    """Keep rows owned by this device, sort-dedupe, compact to [Nd]."""
+    owner = _hash_config(st, ml, mh) % jnp.uint32(n_dev)
+    live = live & (owner == my_idx)
+    M = st.shape[0]
+    order = jnp.lexsort((mh, ml, st, (~live).astype(jnp.int8)))
+    st_s, ml_s, mh_s, live_s = st[order], ml[order], mh[order], live[order]
+    prev_same = jnp.concatenate([
+        jnp.zeros(1, bool),
+        (st_s[1:] == st_s[:-1]) & (ml_s[1:] == ml_s[:-1])
+        & (mh_s[1:] == mh_s[:-1]),
+    ])
+    uniq = live_s & ~prev_same
+    count = jnp.sum(uniq)
+    pos = jnp.where(uniq, jnp.cumsum(uniq) - 1, M + Nd)
+    new_st = jnp.zeros(Nd, jnp.int32).at[pos].set(st_s, mode="drop")
+    new_ml = jnp.zeros(Nd, jnp.uint32).at[pos].set(ml_s, mode="drop")
+    new_mh = jnp.zeros(Nd, jnp.uint32).at[pos].set(mh_s, mode="drop")
+    new_live = jnp.arange(Nd) < count
+    return new_st, new_ml, new_mh, new_live, count, count > Nd
+
+
+def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int):
+    """Runs INSIDE shard_map: per-device view, mesh axis AXIS."""
+    step = STEPS[step_name]
+    C = xs["slot_f"].shape[1]
+    bit_lo, bit_hi = _slot_bits(C)
+    my_idx = lax.axis_index(AXIS).astype(jnp.uint32)
+
+    step_cc = jax.vmap(
+        jax.vmap(step, in_axes=(None, 0, 0, 0, 0)),
+        in_axes=(0, None, None, None, None),
+    )
+
+    def closure_cond(c):
+        _, _, _, _, changed, overflow = c
+        return changed & ~overflow
+
+    def make_closure_body(ev):
+        def body(c):
+            st, ml, mh, live, _, _ = c
+            cand_st, cand_ok = step_cc(
+                st, ev["slot_f"], ev["slot_a0"], ev["slot_a1"], ev["slot_wild"])
+            already = ((ml[:, None] & bit_lo[None, :])
+                       | (mh[:, None] & bit_hi[None, :])) != 0
+            legal = (live[:, None] & ev["slot_occ"][None, :]
+                     & ~already & cand_ok)
+            # candidates ride the ICI ring: all-gather, keep owned rows
+            g_st = lax.all_gather(cand_st.reshape(-1), AXIS, tiled=True)
+            g_ml = lax.all_gather((ml[:, None] | bit_lo[None, :]).reshape(-1),
+                                  AXIS, tiled=True)
+            g_mh = lax.all_gather((mh[:, None] | bit_hi[None, :]).reshape(-1),
+                                  AXIS, tiled=True)
+            g_live = lax.all_gather(legal.reshape(-1), AXIS, tiled=True)
+            all_st = jnp.concatenate([st, g_st])
+            all_ml = jnp.concatenate([ml, g_ml])
+            all_mh = jnp.concatenate([mh, g_mh])
+            all_live = jnp.concatenate([live, g_live])
+            old_n = lax.psum(jnp.sum(live), AXIS)
+            st2, ml2, mh2, live2, cnt, ovf = _owned_dedupe_compact(
+                all_st, all_ml, all_mh, all_live, Nd, n_dev, my_idx)
+            new_n = lax.psum(cnt, AXIS)
+            g_ovf = lax.psum(ovf.astype(jnp.int32), AXIS) > 0
+            return st2, ml2, mh2, live2, new_n > old_n, g_ovf
+        return body
+
+    def scan_step(carry, ev):
+        st, ml, mh, live, ok, fail_r, r_idx, maxf = carry
+        run = ok & (ev["ev_slot"] >= 0)
+        st2, ml2, mh2, live2, _, ovf = lax.while_loop(
+            closure_cond, make_closure_body(ev),
+            (st, ml, mh, live, run, jnp.array(False)),
+        )
+        s = jnp.maximum(ev["ev_slot"], 0).astype(jnp.uint32)
+        one = jnp.uint32(1)
+        blo = jnp.where(s < 32, one << jnp.minimum(s, 31),
+                        jnp.uint32(0)).astype(jnp.uint32)
+        bhi = jnp.where(s >= 32,
+                        one << jnp.minimum(jnp.where(s >= 32, s - 32, 0),
+                                           jnp.uint32(31)),
+                        jnp.uint32(0)).astype(jnp.uint32)
+        has = ((ml2 & blo) | (mh2 & bhi)) != 0
+        live3 = live2 & has
+        ml3 = jnp.where(live3, ml2 & ~blo, ml2)
+        mh3 = jnp.where(live3, mh2 & ~bhi, mh2)
+        n_live = lax.psum(jnp.sum(live3), AXIS)
+        failed_here = run & (n_live == 0)
+        # clearing the slot bit changed every survivor's hash — re-route
+        # each config to its new owner device before the next closure
+        g_st = lax.all_gather(st2, AXIS, tiled=True)
+        g_ml = lax.all_gather(ml3, AXIS, tiled=True)
+        g_mh = lax.all_gather(mh3, AXIS, tiled=True)
+        g_live = lax.all_gather(live3, AXIS, tiled=True)
+        st2, ml3, mh3, live3, _, r_ovf = _owned_dedupe_compact(
+            g_st, g_ml, g_mh, g_live, Nd, n_dev, my_idx)
+        ovf = ovf | (run & (lax.psum(r_ovf.astype(jnp.int32), AXIS) > 0))
+        new_ok = jnp.where(run, ~failed_here & ~ovf, ok)
+        new_fail = jnp.where(failed_here & (fail_r < 0), r_idx, fail_r)
+        st_o = jnp.where(run, st2, st)
+        ml_o = jnp.where(run, ml3, ml)
+        mh_o = jnp.where(run, mh3, mh)
+        live_o = jnp.where(run, live3, live)
+        maxf = jnp.maximum(maxf, jnp.where(run,
+                                           lax.psum(jnp.sum(live2), AXIS), 0))
+        return (st_o, ml_o, mh_o, live_o, new_ok, new_fail,
+                r_idx + 1, maxf), ovf
+
+    # initial config lives on its hash-owner device
+    st0v = jnp.full(Nd, state0, jnp.int32)
+    owner0 = _hash_config(jnp.int32(state0), jnp.uint32(0),
+                          jnp.uint32(0)) % jnp.uint32(n_dev)
+    live0 = (jnp.arange(Nd) < 1) & (owner0 == my_idx)
+    carry0 = (st0v, jnp.zeros(Nd, jnp.uint32), jnp.zeros(Nd, jnp.uint32),
+              live0, jnp.array(True), jnp.int32(-1), jnp.int32(0),
+              jnp.int32(1))
+    carry, ovfs = lax.scan(scan_step, carry0, xs)
+    _, _, _, live, ok, fail_r, _, maxf = carry
+    overflow = jnp.any(ovfs)
+    valid = ok & (lax.psum(jnp.sum(live), AXIS) > 0) & ~overflow
+    return valid, fail_r, overflow, maxf
+
+
+@functools.partial(jax.jit, static_argnames=("step_name", "Nd", "n_dev", "mesh"))
+def _check_sharded(xs, state0, step_name: str, Nd: int, n_dev: int,
+                   mesh: Mesh):
+    fn = jax.shard_map(
+        lambda x, s0: _sharded_impl(x, s0, step_name, Nd, n_dev),
+        mesh=mesh,
+        in_specs=(P(), P()),       # tables + state replicated
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
+    )
+    return fn(xs, state0)
+
+
+def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
+                          capacity: int = 8192,
+                          max_capacity: int = 1 << 22) -> dict:
+    """Check one encoded history with the frontier sharded over `mesh`'s
+    first axis. `capacity` is the GLOBAL frontier capacity."""
+    if e.n_returns == 0:
+        return {"valid?": True, "max-frontier": 0, "capacity": 0}
+    # flatten whatever mesh we're given onto a 1-D mesh named AXIS
+    mesh = Mesh(np.asarray(mesh.devices).reshape(-1), (AXIS,))
+    n_dev = mesh.shape[AXIS]
+    xs = _xs_from_encoded(e)
+    N = max(64 * n_dev, capacity)
+    while True:
+        Nd = (N + n_dev - 1) // n_dev
+        valid, fail_r, overflow, maxf = _check_sharded(
+            xs, jnp.int32(e.state0), e.step_name, Nd, n_dev, mesh)
+        if not bool(overflow):
+            break
+        if N * 2 > max_capacity:
+            return {"valid?": "unknown",
+                    "error": f"frontier overflow at capacity {N}",
+                    "capacity": N}
+        N *= 2
+    out = {"valid?": bool(valid), "max-frontier": int(maxf),
+           "capacity": N, "devices": n_dev}
+    if not out["valid?"]:
+        r = int(fail_r)
+        c = e.calls[int(e.ret_call[r])]
+        out["op"] = {"process": c.process, "f": c.f,
+                     "value": c.result if c.f == "read" else c.value,
+                     "index": c.invoke_index}
+        out["fail-event"] = r
+    return out

@@ -1,0 +1,64 @@
+"""Jit'd pure model step functions — the device tier of jepsen_tpu.models.
+
+Each step has signature
+
+    step(state: i32, f: i32, a0: i32, a1: i32, wild: bool) -> (state': i32, ok: bool)
+
+operating on scalars (the engine vmaps over configs × slots). States and
+args are interned int32s (nil = -1). `wild` marks calls whose outcome is
+unknown (crashed reads): they apply as the identity and always succeed.
+
+Branch-free by construction — everything is jnp.where over the handful
+of f-codes (models.F_*), exactly what the VPU wants; no data-dependent
+control flow survives into XLA (SURVEY.md §7: "No data-dependent Python
+control flow inside jit").
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from jepsen_tpu.models import F_ACQUIRE, F_CAS, F_READ, F_RELEASE, F_WRITE
+
+
+def register_step(state, f, a0, a1, wild):
+    """Register / CAS-register family (models.Register, models.CASRegister;
+    knossos.model register/cas-register semantics).
+
+    read  a0=observed value: ok iff wild or state == a0; state unchanged
+    write a0=new value:      always ok; state = a0
+    cas   a0=old, a1=new:    ok iff state == a0; state = a1
+    """
+    is_read = f == F_READ
+    is_write = f == F_WRITE
+    is_cas = f == F_CAS
+    ok = jnp.where(
+        wild,
+        True,
+        jnp.where(is_read, state == a0,
+                  jnp.where(is_write, True,
+                            jnp.where(is_cas, state == a0, False))),
+    )
+    new_state = jnp.where(
+        wild | is_read, state,
+        jnp.where(is_write, a0, jnp.where(is_cas, a1, state)),
+    )
+    return jnp.where(ok, new_state, state), ok
+
+
+def mutex_step(state, f, a0, a1, wild):
+    """Mutex (models.Mutex): state 0=unlocked, 1=locked."""
+    is_acq = f == F_ACQUIRE
+    is_rel = f == F_RELEASE
+    ok = jnp.where(
+        wild, True,
+        jnp.where(is_acq, state == 0, jnp.where(is_rel, state == 1, False)),
+    )
+    new_state = jnp.where(wild, state, jnp.where(is_acq, 1, 0))
+    return jnp.where(ok, new_state, state), ok
+
+
+STEPS = {
+    "register": register_step,
+    "mutex": mutex_step,
+}

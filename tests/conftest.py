@@ -14,3 +14,9 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The env var alone is not enough in this image: the 'axon' TPU plugin
+# re-registers itself regardless, so pin the platform via jax.config too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,183 @@
+"""TPU engine tests: encoding, fixtures, differential vs host oracles,
+batch/vmap, and the 8-virtual-device mesh path (conftest forces
+JAX_PLATFORMS=cpu with xla_force_host_platform_device_count=8)."""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checker import linear, wgl
+from jepsen_tpu.histories import corrupt_history, rand_register_history
+from jepsen_tpu.history import History, invoke_op, ok_op, fail_op, info_op
+from jepsen_tpu.models import CASRegister, Mutex, Register, UnorderedQueue
+from jepsen_tpu.parallel import encode as enc_mod
+from jepsen_tpu.parallel import engine
+
+
+def _h(*ops):
+    return History.wrap(ops).index()
+
+
+# ------------------------------------------------------------- encoding
+
+
+def test_encode_basic():
+    h = _h(
+        invoke_op(0, "write", 1),
+        invoke_op(1, "read", None),
+        ok_op(0, "write", 1),
+        ok_op(1, "read", 1),
+    )
+    e = enc_mod.encode(CASRegister(), h)
+    assert e.n_returns == 2
+    assert e.n_calls == 2
+    assert e.n_slots == 2
+    # first return: both calls open -> both slots occupied
+    assert e.slot_occ[0].sum() == 2
+    # second return: only the read's slot occupied
+    assert e.slot_occ[1].sum() == 1
+    assert e.step_name == "register"
+
+
+def test_encode_crashed_call_holds_slot():
+    h = _h(
+        invoke_op(0, "write", 1),
+        info_op(0, "write", 1),
+        invoke_op(1, "write", 2),
+        ok_op(1, "write", 2),
+    )
+    e = enc_mod.encode(Register(), h)
+    assert e.n_returns == 1
+    assert e.n_slots == 2   # crashed write keeps slot 0
+    assert e.slot_occ[0].sum() == 2
+
+
+def test_encode_unpackable_model():
+    with pytest.raises(enc_mod.EncodeError):
+        enc_mod.encode(UnorderedQueue(), _h())
+
+
+# ------------------------------------------------------------- fixtures
+
+
+FIXTURES = [
+    # (model, history ops, expected valid?)
+    (Register(), [
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(0, "read", None), ok_op(0, "read", 1)], True),
+    (Register(), [
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(0, "read", None), ok_op(0, "read", 2)], False),
+    (Register(), [
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(1, "write", 2), invoke_op(2, "read", None),
+        ok_op(2, "read", 2), ok_op(1, "write", 2)], True),
+    (Register(), [
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(1, "write", 2), info_op(1, "write", 2),
+        invoke_op(2, "read", None), ok_op(2, "read", 2)], True),
+    (Register(), [
+        invoke_op(0, "write", 2), info_op(0, "write", 2),
+        invoke_op(1, "write", 3), ok_op(1, "write", 3),
+        invoke_op(2, "read", None), ok_op(2, "read", 3),
+        invoke_op(2, "read", None), ok_op(2, "read", 2)], True),
+    (Register(), [
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(1, "write", 2), fail_op(1, "write", 2),
+        invoke_op(2, "read", None), ok_op(2, "read", 2)], False),
+    (CASRegister(), [
+        invoke_op(0, "write", 0), ok_op(0, "write", 0),
+        invoke_op(1, "cas", [0, 1]), invoke_op(2, "cas", [1, 2]),
+        ok_op(1, "cas", [0, 1]), ok_op(2, "cas", [1, 2]),
+        invoke_op(0, "read", None), ok_op(0, "read", 2)], True),
+    (CASRegister(), [
+        invoke_op(0, "write", 0), ok_op(0, "write", 0),
+        invoke_op(1, "cas", [5, 1]), ok_op(1, "cas", [5, 1])], False),
+    (Mutex(), [
+        invoke_op(0, "acquire", None), info_op(0, "acquire", None),
+        invoke_op(1, "release", None), ok_op(1, "release", None)], True),
+    (Mutex(), [
+        invoke_op(0, "acquire", None), ok_op(0, "acquire", None),
+        invoke_op(1, "acquire", None), ok_op(1, "acquire", None)], False),
+]
+
+
+@pytest.mark.parametrize("model,ops,expect", FIXTURES)
+def test_engine_fixtures(model, ops, expect):
+    r = engine.analysis(model, _h(*ops))
+    assert r["valid?"] is expect, r
+
+
+def test_engine_counterexample_op():
+    h = _h(
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(0, "read", None), ok_op(0, "read", 2),
+    )
+    r = engine.analysis(Register(), h)
+    assert r["valid?"] is False
+    assert r["op"]["f"] == "read" and r["op"]["value"] == 2
+    # host re-search attaches a final path
+    assert "final-paths" in r
+
+
+def test_engine_empty():
+    assert engine.analysis(Register(), _h())["valid?"] is True
+
+
+# ----------------------------------------------------------- differential
+
+
+def test_differential_vs_host():
+    for seed in range(20):
+        h = rand_register_history(
+            n_ops=60, n_processes=5, n_values=4,
+            crash_p=0.06, fail_p=0.06, seed=seed + 1000,
+        )
+        expect = wgl.analysis(CASRegister(), h)["valid?"]
+        got = engine.analysis(CASRegister(), h)
+        assert got["valid?"] is expect, f"seed {seed}: {got}"
+
+        bad = corrupt_history(h, seed=seed, n_corruptions=1)
+        e1 = wgl.analysis(CASRegister(), bad)["valid?"]
+        e2 = linear.analysis(CASRegister(), bad)["valid?"]
+        e3 = engine.analysis(CASRegister(), bad)["valid?"]
+        assert e1 == e2 == e3, f"seed {seed}: wgl={e1} linear={e2} jax={e3}"
+
+
+# ------------------------------------------------------------- batching
+
+
+def test_check_batch():
+    hs = [rand_register_history(n_ops=30, n_processes=3, crash_p=0.05,
+                                seed=s) for s in range(8)]
+    bad = corrupt_history(hs[3], seed=3, n_corruptions=2)
+    expected = [wgl.analysis(CASRegister(), h)["valid?"] for h in hs[:3]] + \
+               [wgl.analysis(CASRegister(), bad)["valid?"]] + \
+               [wgl.analysis(CASRegister(), h)["valid?"] for h in hs[4:]]
+    batch = hs[:3] + [bad] + hs[4:]
+    rs = engine.check_batch(CASRegister(), batch)
+    assert [r["valid?"] for r in rs] == expected
+
+
+def test_check_batch_sharded_mesh():
+    import jax
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices())
+    assert devs.size == 8, "conftest should provide 8 virtual CPU devices"
+    mesh = Mesh(devs, ("keys",))
+    hs = [rand_register_history(n_ops=24, n_processes=3, crash_p=0.0,
+                                seed=100 + s) for s in range(8)]
+    rs = engine.check_batch(CASRegister(), hs, mesh=mesh)
+    assert all(r["valid?"] is True for r in rs)
+
+
+def test_dispatcher_jax_route():
+    from jepsen_tpu.checker import linearizable
+    h = _h(
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(0, "read", None), ok_op(0, "read", 1),
+    )
+    r = linearizable(Register(), algorithm="jax").check({}, h)
+    assert r["valid?"] is True and r["analyzer"] == "jax"
+    # competition now resolves to jax (engine importable, devices present)
+    r = linearizable(Register(), algorithm="competition").check({}, h)
+    assert r["analyzer"] == "jax"
