@@ -1,0 +1,115 @@
+"""Client protocol (reference: jepsen/src/jepsen/client.clj).
+
+A client applies operations to the system under test. Lifecycle
+(client.clj:9-27): `open` a network connection, `setup` initial state
+once, `invoke` many ops, `teardown`, `close`. One client instance per
+process; a crashed (:info) process abandons its client and a fresh one
+is opened for the replacement process (interpreter semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from jepsen_tpu.history import Op
+
+
+class Client:
+    def open(self, test, node) -> "Client":
+        """Return a client bound to the given node. Called before any
+        invocations; must return a fresh (or this) client."""
+        return self
+
+    def setup(self, test) -> None:
+        """One-time database setup."""
+
+    def invoke(self, test, op: Op) -> Op:
+        """Apply op to the system; return the completion op with :type
+        ok/fail/info. Exceptions become :info (indeterminate)."""
+        raise NotImplementedError
+
+    def teardown(self, test) -> None:
+        """One-time cleanup."""
+
+    def close(self, test) -> None:
+        """Release the connection."""
+
+    def is_reusable(self, test) -> bool:
+        """May this client be reused across processes? (client.clj:29-44
+        Reusable protocol; default false)."""
+        return False
+
+
+class Validate(Client):
+    """Wraps a client, checking completion invariants: :type in
+    {ok, fail, info}, same :process and :f as the invocation
+    (client.clj:64-114)."""
+
+    def __init__(self, client: Client):
+        self.client = client
+
+    def open(self, test, node):
+        return Validate(self.client.open(test, node))
+
+    def setup(self, test):
+        self.client.setup(test)
+
+    def invoke(self, test, op):
+        res = self.client.invoke(test, op)
+        problems = []
+        if not isinstance(res, dict):
+            problems.append(f"should be a dict, was {res!r}")
+        else:
+            if res.get("type") not in ("ok", "fail", "info"):
+                problems.append(
+                    f":type should be ok, fail, or info, was {res.get('type')!r}")
+            if res.get("process") != op.get("process"):
+                problems.append(
+                    f"should have the same :process as the invocation "
+                    f"({op.get('process')!r}), was {res.get('process')!r}")
+            if res.get("f") != op.get("f"):
+                problems.append(
+                    f"should have the same :f as the invocation "
+                    f"({op.get('f')!r}), was {res.get('f')!r}")
+        if problems:
+            raise RuntimeError(
+                "Client returned an invalid completion for " + repr(op)
+                + ": " + "; ".join(problems))
+        return res
+
+    def teardown(self, test):
+        self.client.teardown(test)
+
+    def close(self, test):
+        self.client.close(test)
+
+    def is_reusable(self, test):
+        return self.client.is_reusable(test)
+
+
+def validate(client: Client) -> Validate:
+    return Validate(client)
+
+
+def is_reusable(client: Optional[Client], test) -> bool:
+    return client is not None and client.is_reusable(test)
+
+
+class Noop(Client):
+    """Does nothing; every op is :ok (client.clj:46-53)."""
+
+    def invoke(self, test, op):
+        o = Op(op)
+        o["type"] = "ok"
+        return o
+
+    def is_reusable(self, test):
+        return True
+
+
+def noop() -> Noop:
+    return Noop()
+
+
+def closable(c: Any) -> bool:
+    return hasattr(c, "close")

@@ -1,0 +1,1096 @@
+"""Pure-functional generator DSL (reference: jepsen/src/jepsen/generator.clj).
+
+A *generator* is an immutable value that produces operations for worker
+threads. The protocol (generator.clj:382-390):
+
+    gen_op(gen, test, ctx)            -> (op, gen') | (PENDING, gen') | None
+    gen_update(gen, test, ctx, event) -> gen'
+
+where `ctx` carries the simulated/real clock and the set of free worker
+threads (generator.clj:453-464). The following Python values are
+generators out of the box, mirroring the reference's protocol extensions
+(generator.clj:545-590):
+
+    None            the exhausted generator
+    dict / Op       a one-shot op map: emits once, filled in from ctx
+    callable        an infinite generator: called (with (test, ctx) if it
+                    accepts two args, else no args) for a fresh op-ish
+                    value each time; never updated
+    list / tuple    a sequence of generators, run one after the other
+
+Everything else is one of the combinator classes below. All combinators
+are immutable: op/update return fresh instances, so generators can be
+reused, checkpointed, and replayed deterministically.
+
+Randomness goes through this module's `rand` (a `random.Random`), which
+`fixed_rand(seed)` rebinds for reproducible tests — the analogue of the
+reference's `with-fixed-rand-int` (generator/test.clj:30-47).
+"""
+
+from __future__ import annotations
+
+import inspect
+import random
+from typing import Any, Callable, Dict, Iterable, Optional
+
+from jepsen_tpu.history import Op
+from jepsen_tpu.util import secs_to_nanos
+
+NEMESIS = "nemesis"
+
+
+class _Pending:
+    __slots__ = ()
+
+    def __repr__(self):
+        return ":pending"
+
+
+PENDING = _Pending()
+
+# ------------------------------------------------------------------ rand
+
+rand = random.Random()
+
+
+class fixed_rand:
+    """Context manager rebinding this module's RNG to a seeded stream —
+    determinism for tests (generator/test.clj:30-47, seed 45100)."""
+
+    def __init__(self, seed: int = 45100):
+        self.seed = seed
+
+    def __enter__(self):
+        global rand
+        self._saved = rand
+        rand = random.Random(self.seed)
+        return rand
+
+    def __exit__(self, *exc):
+        global rand
+        rand = self._saved
+        return False
+
+
+# --------------------------------------------------------------- context
+
+
+def _thread_key(t):
+    # stable ordering over ints + the :nemesis keyword
+    return (1, str(t)) if isinstance(t, str) else (0, t)
+
+
+class Ctx:
+    """Generator context: time (nanos), free threads, worker map
+    (thread -> process it is currently executing). Immutable
+    (generator.clj:453-464)."""
+
+    __slots__ = ("time", "free_threads", "workers")
+
+    def __init__(self, time: int, free_threads: tuple, workers: dict):
+        self.time = time
+        self.free_threads = free_threads  # sorted tuple, acts as a fair set
+        self.workers = workers
+
+    @classmethod
+    def for_test(cls, test: dict) -> "Ctx":
+        n = test.get("concurrency", 2)
+        threads = tuple(sorted([NEMESIS, *range(n)], key=_thread_key))
+        return cls(0, threads, {t: t for t in threads})
+
+    # -- functional updates
+    def with_time(self, t: int) -> "Ctx":
+        return Ctx(t, self.free_threads, self.workers)
+
+    def busy(self, thread) -> "Ctx":
+        return Ctx(self.time,
+                   tuple(t for t in self.free_threads if t != thread),
+                   self.workers)
+
+    def free(self, thread) -> "Ctx":
+        if thread in self.free_threads:
+            return self
+        ft = tuple(sorted((*self.free_threads, thread), key=_thread_key))
+        return Ctx(self.time, ft, self.workers)
+
+    def with_worker(self, thread, process) -> "Ctx":
+        w = dict(self.workers)
+        w[thread] = process
+        return Ctx(self.time, self.free_threads, w)
+
+    def restrict(self, pred: Callable[[Any], bool]) -> "Ctx":
+        """Context restricted to threads satisfying pred
+        (on-threads-context, generator.clj:845-863)."""
+        return Ctx(self.time,
+                   tuple(t for t in self.free_threads if pred(t)),
+                   {t: p for t, p in self.workers.items() if pred(t)})
+
+    # -- queries (generator.clj:474-527)
+    def all_threads(self) -> list:
+        return list(self.workers)
+
+    def all_processes(self) -> list:
+        return list(self.workers.values())
+
+    def free_processes(self) -> list:
+        return [self.workers[t] for t in self.free_threads]
+
+    def some_free_process(self):
+        """A uniformly random free process — the fair scheduler
+        (generator.clj:480-487)."""
+        n = len(self.free_threads)
+        if n == 0:
+            return None
+        return self.workers[self.free_threads[rand.randrange(n)]]
+
+    def thread_to_process(self, thread):
+        return self.workers.get(thread)
+
+    def process_to_thread(self, process):
+        for t, p in self.workers.items():
+            if p == process:
+                return t
+        return None
+
+    def next_process(self, thread):
+        """Process id to assign a thread whose process crashed: current
+        process + number of numeric processes in the worker map
+        (generator.clj:519-527)."""
+        if isinstance(thread, str):
+            return thread
+        return (self.workers[thread]
+                + sum(1 for p in self.workers.values() if isinstance(p, int)))
+
+    def __repr__(self):
+        return (f"Ctx(time={self.time}, free={list(self.free_threads)}, "
+                f"workers={self.workers})")
+
+
+def context(test: dict) -> Ctx:
+    return Ctx.for_test(test)
+
+
+def rand_int_seq(seed: Optional[int] = None):
+    """Reproducible stream of random ints (generator.clj:466-472)."""
+    r = random.Random(seed if seed is not None else rand.randrange(2**31))
+    while True:
+        yield r.randrange(-(2**63), 2**63)
+
+
+# ------------------------------------------------------------- protocol
+
+
+def fill_in_op(o: dict, ctx: Ctx):
+    """Fill :time, :process, :type from context; PENDING if no process is
+    free (generator.clj:531-543)."""
+    p = ctx.some_free_process()
+    if p is None:
+        return PENDING
+    o = Op(o)
+    if o.get("time") is None:
+        o["time"] = ctx.time
+    if o.get("process") is None:
+        o["process"] = p
+    if o.get("type") is None:
+        o["type"] = "invoke"
+    return o
+
+
+class Generator:
+    """Base class for combinator generators."""
+
+    def op(self, test, ctx):  # -> (op|PENDING, gen') | None
+        raise NotImplementedError
+
+    def update(self, test, ctx, event):  # -> gen'
+        return self
+
+
+def _fn_wants_args(f) -> bool:
+    try:
+        sig = inspect.signature(f)
+    except (ValueError, TypeError):
+        return False
+    params = [p for p in sig.parameters.values()
+              if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+    return len(params) >= 2
+
+
+class _Fn(Generator):
+    """Wrapper giving function generators seq-continuation semantics
+    (generator.clj:556-563): each call produces a fresh op-ish value; the
+    fn itself is the continuation."""
+
+    __slots__ = ("f", "wants")
+
+    def __init__(self, f, wants=None):
+        self.f = f
+        self.wants = _fn_wants_args(f) if wants is None else wants
+
+    def op(self, test, ctx):
+        x = self.f(test, ctx) if self.wants else self.f()
+        if x is None:
+            return None
+        return gen_op(_Seq(x, (x, self), 0), test, ctx)
+
+    def update(self, test, ctx, event):
+        return self
+
+
+class _Seq(Generator):
+    """Sequence-of-generators with an O(1) cursor: `head` is the live
+    state of items[idx]; the untouched tail is never copied
+    (generator.clj:571-590 Seqable semantics; updates go to the first
+    generator only)."""
+
+    __slots__ = ("head", "items", "idx")
+
+    def __init__(self, head, items, idx):
+        self.head = head
+        self.items = items  # tuple, never mutated
+        self.idx = idx
+
+    def op(self, test, ctx):
+        head, idx = self.head, self.idx
+        while True:
+            res = gen_op(head, test, ctx)
+            if res is not None:
+                o, g2 = res
+                if idx == len(self.items) - 1:
+                    return o, g2  # last element: collapse to its state
+                return o, _Seq(g2, self.items, idx)
+            idx += 1
+            if idx >= len(self.items):
+                return None
+            head = self.items[idx]
+
+    def update(self, test, ctx, event):
+        return _Seq(gen_update(self.head, test, ctx, event),
+                    self.items, self.idx)
+
+
+def gen_op(gen, test, ctx: Ctx):
+    """Protocol dispatch for `op` (generator.clj:545-590)."""
+    if gen is None:
+        return None
+    if isinstance(gen, Generator):
+        return gen.op(test, ctx)
+    if isinstance(gen, dict):
+        o = fill_in_op(gen, ctx)
+        return (o, gen) if o is PENDING else (o, None)
+    if callable(gen):
+        return _Fn(gen).op(test, ctx)
+    if isinstance(gen, (list, tuple)):
+        if not gen:
+            return None
+        items = tuple(gen)
+        return _Seq(items[0], items, 0).op(test, ctx)
+    raise TypeError(f"not a generator: {gen!r}")
+
+
+def gen_update(gen, test, ctx: Ctx, event):
+    """Protocol dispatch for `update` (generator.clj:545-590)."""
+    if gen is None or isinstance(gen, dict) or callable(gen):
+        return gen
+    if isinstance(gen, Generator):
+        return gen.update(test, ctx, event)
+    if isinstance(gen, (list, tuple)):
+        if not gen:
+            return None
+        items = tuple(gen)
+        return _Seq(gen_update(items[0], test, ctx, event), items, 0)
+    raise TypeError(f"not a generator: {gen!r}")
+
+
+# ----------------------------------------------------------- validation
+
+
+class InvalidOp(Exception):
+    def __init__(self, problems, res, ctx):
+        self.problems = problems
+        self.res = res
+        self.ctx = ctx
+        super().__init__(
+            "Generator produced an invalid (op, gen') tuple: "
+            + "; ".join(problems) + f" -- {res!r}")
+
+
+class Validate(Generator):
+    """Checks well-formedness of emitted ops: type in
+    {invoke, info, sleep, log}, numeric time, a free process
+    (generator.clj:622-676)."""
+
+    __slots__ = ("gen",)
+
+    def __init__(self, gen):
+        self.gen = gen
+
+    def op(self, test, ctx):
+        res = gen_op(self.gen, test, ctx)
+        if res is None:
+            return None
+        if not (isinstance(res, tuple) and len(res) == 2):
+            raise InvalidOp(["should return a tuple of two elements"], res, ctx)
+        o, g2 = res
+        if o is not PENDING:
+            problems = []
+            if not isinstance(o, dict):
+                problems.append("should be either PENDING or a dict")
+            else:
+                if o.get("type") not in ("invoke", "info", "sleep", "log"):
+                    problems.append(
+                        ":type should be invoke, info, sleep, or log")
+                if not isinstance(o.get("time"), (int, float)):
+                    problems.append(":time should be a number")
+                if o.get("process") is None:
+                    problems.append("no :process")
+                elif o.get("process") not in ctx.free_processes():
+                    problems.append(f"process {o.get('process')!r} is not free")
+            if problems:
+                raise InvalidOp(problems, res, ctx)
+        return o, Validate(g2)
+
+    def update(self, test, ctx, event):
+        return Validate(gen_update(self.gen, test, ctx, event))
+
+
+def validate(gen):
+    return Validate(gen)
+
+
+class GeneratorThrew(Exception):
+    def __init__(self, kind, ctx, event=None):
+        self.kind = kind
+        self.ctx = ctx
+        self.event = event
+        super().__init__(f"Generator threw during {kind}")
+
+
+class FriendlyExceptions(Generator):
+    """Wraps op/update exceptions with generator context
+    (generator.clj:678-718)."""
+
+    __slots__ = ("gen",)
+
+    def __init__(self, gen):
+        self.gen = gen
+
+    def op(self, test, ctx):
+        try:
+            res = gen_op(self.gen, test, ctx)
+        except GeneratorThrew:
+            raise
+        except Exception as e:
+            raise GeneratorThrew("op", ctx) from e
+        if res is None:
+            return None
+        o, g2 = res
+        return o, FriendlyExceptions(g2)
+
+    def update(self, test, ctx, event):
+        try:
+            return FriendlyExceptions(gen_update(self.gen, test, ctx, event))
+        except GeneratorThrew:
+            raise
+        except Exception as e:
+            raise GeneratorThrew("update", ctx, event) from e
+
+
+def friendly_exceptions(gen):
+    return FriendlyExceptions(gen)
+
+
+class Trace(Generator):
+    """Logs every op/update (generator.clj:720-763)."""
+
+    __slots__ = ("k", "gen", "log")
+
+    def __init__(self, k, gen, log=None):
+        self.k = k
+        self.gen = gen
+        self.log = log or (lambda *a: print(*a))
+
+    def op(self, test, ctx):
+        res = gen_op(self.gen, test, ctx)
+        self.log(self.k, "op", ctx, res and res[0])
+        if res is None:
+            return None
+        o, g2 = res
+        return o, Trace(self.k, g2, self.log)
+
+    def update(self, test, ctx, event):
+        self.log(self.k, "update", ctx, event)
+        return Trace(self.k, gen_update(self.gen, test, ctx, event), self.log)
+
+
+def trace(k, gen, log=None):
+    return Trace(k, gen, log)
+
+
+# -------------------------------------------------------- map / filter
+
+
+class Map(Generator):
+    """Transforms ops with f; PENDING/None bypass (generator.clj:765-788)."""
+
+    __slots__ = ("f", "gen")
+
+    def __init__(self, f, gen):
+        self.f = f
+        self.gen = gen
+
+    def op(self, test, ctx):
+        res = gen_op(self.gen, test, ctx)
+        if res is None:
+            return None
+        o, g2 = res
+        return (o if o is PENDING else self.f(o)), Map(self.f, g2)
+
+    def update(self, test, ctx, event):
+        return Map(self.f, gen_update(self.gen, test, ctx, event))
+
+
+def map(f, gen):  # noqa: A001 - mirrors the reference's name
+    return Map(f, gen)
+
+
+def f_map(fm: Dict, gen):
+    """Rewrites :f through the map fm (generator.clj:790-796)."""
+    def transform(o):
+        o = Op(o)
+        o["f"] = fm.get(o.get("f"), o.get("f"))
+        return o
+    return Map(transform, gen)
+
+
+class Filter(Generator):
+    """Passes only ops matching (f op); PENDING bypasses
+    (generator.clj:799-818)."""
+
+    __slots__ = ("f", "gen")
+
+    def __init__(self, f, gen):
+        self.f = f
+        self.gen = gen
+
+    def op(self, test, ctx):
+        g = self.gen
+        while True:
+            res = gen_op(g, test, ctx)
+            if res is None:
+                return None
+            o, g2 = res
+            if o is PENDING or self.f(o):
+                return o, Filter(self.f, g2)
+            g = g2
+
+    def update(self, test, ctx, event):
+        return Filter(self.f, gen_update(self.gen, test, ctx, event))
+
+
+def filter(f, gen):  # noqa: A001
+    return Filter(f, gen)
+
+
+class IgnoreUpdates(Generator):
+    __slots__ = ("gen",)
+
+    def __init__(self, gen):
+        self.gen = gen
+
+    def op(self, test, ctx):
+        return gen_op(self.gen, test, ctx)
+
+    def update(self, test, ctx, event):
+        return self
+
+
+class OnUpdate(Generator):
+    """Custom update handler: (f this test ctx event) -> gen'
+    (generator.clj:828-843)."""
+
+    __slots__ = ("f", "gen")
+
+    def __init__(self, f, gen):
+        self.f = f
+        self.gen = gen
+
+    def op(self, test, ctx):
+        res = gen_op(self.gen, test, ctx)
+        if res is None:
+            return None
+        o, g2 = res
+        return o, OnUpdate(self.f, g2)
+
+    def update(self, test, ctx, event):
+        return self.f(self, test, ctx, event)
+
+
+def on_update(f, gen):
+    return OnUpdate(f, gen)
+
+
+# ------------------------------------------------------- thread routing
+
+
+class OnThreads(Generator):
+    """Restricts the wrapped generator to threads satisfying f; updates
+    routed only for matching threads (generator.clj:865-882)."""
+
+    __slots__ = ("f", "gen")
+
+    def __init__(self, f, gen):
+        self.f = f
+        self.gen = gen
+
+    def op(self, test, ctx):
+        res = gen_op(self.gen, test, ctx.restrict(self.f))
+        if res is None:
+            return None
+        o, g2 = res
+        return o, OnThreads(self.f, g2)
+
+    def update(self, test, ctx, event):
+        thread = ctx.process_to_thread(event.get("process"))
+        if thread is not None and self.f(thread):
+            return OnThreads(
+                self.f, gen_update(self.gen, test, ctx.restrict(self.f), event))
+        return self
+
+
+def on_threads(f, gen):
+    return OnThreads(f, gen)
+
+
+on = on_threads  # backwards-compat alias (generator.clj:884)
+
+
+def clients(client_gen, nemesis_gen=None):
+    """Restrict to client threads; two-arity combines with a nemesis
+    generator (generator.clj:1093-1103)."""
+    cg = OnThreads(lambda t: t != NEMESIS, client_gen)
+    if nemesis_gen is None:
+        return cg
+    return any(cg, nemesis(nemesis_gen))
+
+
+def nemesis(nemesis_gen, client_gen=None):
+    """Restrict to the nemesis thread (generator.clj:1105-1115)."""
+    ng = OnThreads(lambda t: t == NEMESIS, nemesis_gen)
+    if client_gen is None:
+        return ng
+    return any(ng, clients(client_gen))
+
+
+# -------------------------------------------------------- soonest race
+
+
+def soonest_op_map(m1: Optional[dict], m2: Optional[dict]) -> Optional[dict]:
+    """Earlier of two candidate {op, ..., weight} maps; PENDING loses;
+    time ties break randomly proportional to weights, and the winner's
+    weight becomes the sum (generator.clj:886-928)."""
+    if m1 is None:
+        return m2
+    if m2 is None:
+        return m1
+    o1, o2 = m1["op"], m2["op"]
+    if o1 is PENDING:
+        return m2
+    if o2 is PENDING:
+        return m1
+    t1, t2 = o1.get("time"), o2.get("time")
+    if t1 == t2:
+        w1 = m1.get("weight", 1)
+        w2 = m2.get("weight", 1)
+        w = w1 + w2
+        winner = m1 if rand.randrange(w) < w1 else m2
+        winner = dict(winner)
+        winner["weight"] = w
+        return winner
+    return m1 if t1 < t2 else m2
+
+
+class Any(Generator):
+    """Ops from whichever sub-generator is soonest; updates to all
+    (generator.clj:930-945)."""
+
+    __slots__ = ("gens",)
+
+    def __init__(self, gens):
+        self.gens = list(gens)
+
+    def op(self, test, ctx):
+        soonest = None
+        for i, g in enumerate(self.gens):
+            res = gen_op(g, test, ctx)
+            if res is not None:
+                soonest = soonest_op_map(
+                    soonest, {"op": res[0], "gen": res[1], "i": i})
+        if soonest is None:
+            return None
+        gens = list(self.gens)
+        gens[soonest["i"]] = soonest["gen"]
+        return soonest["op"], Any(gens)
+
+    def update(self, test, ctx, event):
+        return Any([gen_update(g, test, ctx, event) for g in self.gens])
+
+
+def any(*gens):  # noqa: A001
+    if len(gens) == 0:
+        return None
+    if len(gens) == 1:
+        return gens[0]
+    return Any(gens)
+
+
+class EachThread(Generator):
+    """An independent copy of the generator per thread; each copy's
+    context contains exactly its own thread (generator.clj:956-1007)."""
+
+    __slots__ = ("fresh_gen", "gens")
+
+    def __init__(self, fresh_gen, gens=None):
+        self.fresh_gen = fresh_gen
+        self.gens = gens or {}
+
+    def _thread_ctx(self, ctx, thread, free=True):
+        return Ctx(ctx.time, (thread,) if free else (),
+                   {thread: ctx.workers[thread]})
+
+    def op(self, test, ctx):
+        soonest = None
+        for thread in ctx.free_threads:
+            g = self.gens.get(thread, self.fresh_gen)
+            res = gen_op(g, test, self._thread_ctx(ctx, thread))
+            if res is not None:
+                soonest = soonest_op_map(
+                    soonest, {"op": res[0], "gen": res[1], "thread": thread})
+        if soonest is not None:
+            gens = dict(self.gens)
+            gens[soonest["thread"]] = soonest["gen"]
+            return soonest["op"], EachThread(self.fresh_gen, gens)
+        if len(ctx.free_threads) != len(ctx.workers):
+            return PENDING, self  # busy threads may still free up
+        return None  # every thread exhausted
+
+    def update(self, test, ctx, event):
+        thread = ctx.process_to_thread(event.get("process"))
+        if thread is None:
+            return self
+        g = self.gens.get(thread, self.fresh_gen)
+        tctx = Ctx(ctx.time,
+                   tuple(t for t in ctx.free_threads if t == thread),
+                   {thread: event.get("process")})
+        gens = dict(self.gens)
+        gens[thread] = gen_update(g, test, tctx, event)
+        return EachThread(self.fresh_gen, gens)
+
+
+def each_thread(gen):
+    return EachThread(gen)
+
+
+class Reserve(Generator):
+    """Dedicated thread ranges per generator plus a default
+    (generator.clj:1009-1089)."""
+
+    __slots__ = ("ranges", "all_ranges", "gens")
+
+    def __init__(self, ranges, all_ranges, gens):
+        self.ranges = ranges          # list of frozenset of threads
+        self.all_ranges = all_ranges  # union
+        self.gens = gens              # len(ranges)+1; last = default
+
+    def op(self, test, ctx):
+        soonest = None
+        for i, threads in enumerate(self.ranges):
+            rctx = ctx.restrict(lambda t, ts=threads: t in ts)
+            res = gen_op(self.gens[i], test, rctx)
+            if res is not None:
+                soonest = soonest_op_map(
+                    soonest,
+                    {"op": res[0], "gen": res[1], "weight": len(threads),
+                     "i": i})
+        dctx = ctx.restrict(lambda t: t not in self.all_ranges)
+        res = gen_op(self.gens[-1], test, dctx)
+        if res is not None:
+            soonest = soonest_op_map(
+                soonest,
+                {"op": res[0], "gen": res[1], "weight": len(dctx.workers),
+                 "i": len(self.ranges)})
+        if soonest is None:
+            return None
+        gens = list(self.gens)
+        gens[soonest["i"]] = soonest["gen"]
+        return soonest["op"], Reserve(self.ranges, self.all_ranges, gens)
+
+    def update(self, test, ctx, event):
+        thread = ctx.process_to_thread(event.get("process"))
+        i = len(self.ranges)
+        for j, threads in enumerate(self.ranges):
+            if thread in threads:
+                i = j
+                break
+        gens = list(self.gens)
+        gens[i] = gen_update(gens[i], test, ctx, event)
+        return Reserve(self.ranges, self.all_ranges, gens)
+
+
+def reserve(*args):
+    """reserve(5, write_gen, 10, cas_gen, default_gen): first 5 threads
+    run write_gen, next 10 cas_gen, rest the default
+    (generator.clj:1056-1089)."""
+    assert len(args) >= 1 and len(args) % 2 == 1, "need pairs + default"
+    default = args[-1]
+    pairs = [(args[i], args[i + 1]) for i in range(0, len(args) - 1, 2)]
+    ranges, gens, n = [], [], 0
+    for count, g in pairs:
+        ranges.append(frozenset(range(n, n + count)))
+        gens.append(g)
+        n += count
+    all_ranges = frozenset().union(*ranges) if ranges else frozenset()
+    gens.append(default)
+    return Reserve(ranges, all_ranges, gens)
+
+
+# ----------------------------------------------------------- selection
+
+
+class Mix(Generator):
+    """Uniform random mixture; ignores updates (generator.clj:1124-1154)."""
+
+    __slots__ = ("i", "gens")
+
+    def __init__(self, i, gens):
+        self.i = i
+        self.gens = gens
+
+    def op(self, test, ctx):
+        gens = list(self.gens)
+        i = self.i
+        while gens:
+            res = gen_op(gens[i], test, ctx)
+            if res is not None:
+                o, g2 = res
+                gens[i] = g2
+                return o, Mix(rand.randrange(len(gens)), gens)
+            del gens[i]
+            if not gens:
+                return None
+            i = rand.randrange(len(gens))
+        return None
+
+    def update(self, test, ctx, event):
+        return self
+
+
+def mix(gens: Iterable):
+    gens = list(gens)
+    if not gens:
+        return None
+    return Mix(rand.randrange(len(gens)), gens)
+
+
+class Limit(Generator):
+    """At most n ops (generator.clj:1156-1170)."""
+
+    __slots__ = ("remaining", "gen")
+
+    def __init__(self, remaining, gen):
+        self.remaining = remaining
+        self.gen = gen
+
+    def op(self, test, ctx):
+        if self.remaining <= 0:
+            return None
+        res = gen_op(self.gen, test, ctx)
+        if res is None:
+            return None
+        o, g2 = res
+        return o, Limit(self.remaining - (0 if o is PENDING else 1), g2)
+
+    def update(self, test, ctx, event):
+        return Limit(self.remaining, gen_update(self.gen, test, ctx, event))
+
+
+def limit(n, gen):
+    return Limit(n, gen)
+
+
+def once(gen):
+    return Limit(1, gen)
+
+
+def log(msg):
+    """One :log op; the worker prints it (generator.clj:1177-1181)."""
+    return {"type": "log", "value": msg}
+
+
+class Repeat(Generator):
+    """Re-emits from the *unchanged* underlying generator forever or n
+    times — the inverse of `once` (generator.clj:1183-1210)."""
+
+    __slots__ = ("remaining", "gen")
+
+    def __init__(self, remaining, gen):
+        self.remaining = remaining  # -1 = infinite
+        self.gen = gen
+
+    def op(self, test, ctx):
+        if self.remaining == 0:
+            return None
+        res = gen_op(self.gen, test, ctx)
+        if res is None:
+            return None
+        o, _ = res
+        dec = 0 if o is PENDING else 1
+        return o, Repeat(self.remaining - dec, self.gen)
+
+    def update(self, test, ctx, event):
+        return Repeat(self.remaining, gen_update(self.gen, test, ctx, event))
+
+
+def repeat(n_or_gen, gen=None):
+    if gen is None:
+        return Repeat(-1, n_or_gen)
+    assert n_or_gen >= 0
+    return Repeat(n_or_gen, gen)
+
+
+class ProcessLimit(Generator):
+    """Emits ops while the union of observed worker processes stays ≤ n
+    (generator.clj:1212-1237)."""
+
+    __slots__ = ("n", "procs", "gen")
+
+    def __init__(self, n, procs, gen):
+        self.n = n
+        self.procs = procs
+        self.gen = gen
+
+    def op(self, test, ctx):
+        res = gen_op(self.gen, test, ctx)
+        if res is None:
+            return None
+        o, g2 = res
+        if o is PENDING:
+            return o, ProcessLimit(self.n, self.procs, g2)
+        procs = self.procs | frozenset(ctx.all_processes())
+        if len(procs) > self.n:
+            return None
+        return o, ProcessLimit(self.n, procs, g2)
+
+    def update(self, test, ctx, event):
+        return ProcessLimit(self.n, self.procs,
+                            gen_update(self.gen, test, ctx, event))
+
+
+def process_limit(n, gen):
+    return ProcessLimit(n, frozenset(), gen)
+
+
+class TimeLimit(Generator):
+    """Emits ops for dt (nanos) past the first emitted op's time
+    (generator.clj:1239-1263)."""
+
+    __slots__ = ("limit", "cutoff", "gen")
+
+    def __init__(self, limit, cutoff, gen):
+        self.limit = limit
+        self.cutoff = cutoff
+        self.gen = gen
+
+    def op(self, test, ctx):
+        res = gen_op(self.gen, test, ctx)
+        if res is None:
+            return None
+        o, g2 = res
+        if o is PENDING:
+            return o, TimeLimit(self.limit, self.cutoff, g2)
+        cutoff = self.cutoff if self.cutoff is not None else o["time"] + self.limit
+        if o["time"] >= cutoff:
+            return None
+        return o, TimeLimit(self.limit, cutoff, g2)
+
+    def update(self, test, ctx, event):
+        return TimeLimit(self.limit, self.cutoff,
+                         gen_update(self.gen, test, ctx, event))
+
+
+def time_limit(dt_secs, gen):
+    return TimeLimit(secs_to_nanos(dt_secs), None, gen)
+
+
+# -------------------------------------------------------- time shaping
+
+
+class Stagger(Generator):
+    """Schedules ops at uniformly random intervals in [0, 2*dt)
+    (generator.clj:1265-1305)."""
+
+    __slots__ = ("dt", "next_time", "gen")
+
+    def __init__(self, dt, next_time, gen):
+        self.dt = dt  # nanos, already doubled
+        self.next_time = next_time
+        self.gen = gen
+
+    def op(self, test, ctx):
+        res = gen_op(self.gen, test, ctx)
+        if res is None:
+            return None
+        o, g2 = res
+        if o is PENDING:
+            return o, self
+        nt = self.next_time if self.next_time is not None else ctx.time
+        nt2 = nt + int(rand.random() * self.dt)
+        if nt <= o["time"]:
+            return o, Stagger(self.dt, nt2, g2)
+        o = Op(o)
+        o["time"] = nt
+        return o, Stagger(self.dt, nt2, g2)
+
+    def update(self, test, ctx, event):
+        return Stagger(self.dt, self.next_time,
+                       gen_update(self.gen, test, ctx, event))
+
+
+def stagger(dt_secs, gen):
+    return Stagger(secs_to_nanos(2 * dt_secs), None, gen)
+
+
+class Delay(Generator):
+    """Ops exactly dt apart, catching up if behind
+    (generator.clj:1344-1370)."""
+
+    __slots__ = ("dt", "next_time", "gen")
+
+    def __init__(self, dt, next_time, gen):
+        self.dt = dt
+        self.next_time = next_time
+        self.gen = gen
+
+    def op(self, test, ctx):
+        res = gen_op(self.gen, test, ctx)
+        if res is None:
+            return None
+        o, g2 = res
+        if o is PENDING:
+            return o, Delay(self.dt, self.next_time, g2)
+        nt = self.next_time if self.next_time is not None else o["time"]
+        o = Op(o)
+        o["time"] = max(o["time"], nt)
+        return o, Delay(self.dt, nt + self.dt, g2)
+
+    def update(self, test, ctx, event):
+        return Delay(self.dt, self.next_time,
+                     gen_update(self.gen, test, ctx, event))
+
+
+def delay(dt_secs, gen):
+    return Delay(secs_to_nanos(dt_secs), None, gen)
+
+
+def sleep(dt_secs):
+    """One :sleep op; its worker idles dt seconds
+    (generator.clj:1372-1376)."""
+    return {"type": "sleep", "value": dt_secs}
+
+
+# ------------------------------------------------------------ barriers
+
+
+class Synchronize(Generator):
+    """PENDING until every thread is free, then becomes the wrapped
+    generator (generator.clj:1378-1398)."""
+
+    __slots__ = ("gen",)
+
+    def __init__(self, gen):
+        self.gen = gen
+
+    def op(self, test, ctx):
+        if len(ctx.free_threads) == len(ctx.workers):
+            return gen_op(self.gen, test, ctx)
+        return PENDING, self
+
+    def update(self, test, ctx, event):
+        return Synchronize(gen_update(self.gen, test, ctx, event))
+
+
+def synchronize(gen):
+    return Synchronize(gen)
+
+
+def phases(*gens):
+    """Run each generator to completion with a barrier between
+    (generator.clj:1400-1405)."""
+    return [Synchronize(g) for g in gens]
+
+
+def then(a, b):
+    """b, then (synchronize a) — argument order matches the reference's
+    ->> pipelining (generator.clj:1407-1416)."""
+    return [b, Synchronize(a)]
+
+
+class UntilOk(Generator):
+    """Yields ops until one completes :ok (generator.clj:1418-1436)."""
+
+    __slots__ = ("gen", "done")
+
+    def __init__(self, gen, done=False):
+        self.gen = gen
+        self.done = done
+
+    def op(self, test, ctx):
+        if self.done:
+            return None
+        res = gen_op(self.gen, test, ctx)
+        if res is None:
+            return None
+        o, g2 = res
+        return o, UntilOk(g2, self.done)
+
+    def update(self, test, ctx, event):
+        if event.get("type") == "ok":
+            return UntilOk(self.gen, True)
+        return UntilOk(gen_update(self.gen, test, ctx, event), self.done)
+
+
+def until_ok(gen):
+    return UntilOk(gen)
+
+
+class FlipFlop(Generator):
+    """Alternates A, B, A, B...; stops when either is exhausted; ignores
+    updates (generator.clj:1438-1452)."""
+
+    __slots__ = ("gens", "i")
+
+    def __init__(self, gens, i=0):
+        self.gens = gens
+        self.i = i
+
+    def op(self, test, ctx):
+        res = gen_op(self.gens[self.i], test, ctx)
+        if res is None:
+            return None
+        o, g2 = res
+        gens = list(self.gens)
+        gens[self.i] = g2
+        ni = self.i if o is PENDING else (self.i + 1) % len(gens)
+        return o, FlipFlop(gens, ni)
+
+    def update(self, test, ctx, event):
+        return self
+
+
+def flip_flop(a, b):
+    return FlipFlop([a, b])
+
+
+def concat(*gens):
+    """Sequence generators one after another (generator.clj:775-780)."""
+    return list(gens)

@@ -1,0 +1,247 @@
+"""The execution engine: turns a pure generator into a real concurrent
+history (reference: jepsen/src/jepsen/generator/interpreter.clj).
+
+One thread per worker (clients + nemesis), coupled to a single-threaded
+scheduler loop by queues:
+
+  * each worker has a 1-slot inbox (interpreter.clj:110);
+  * all workers share one completion queue (interpreter.clj:197);
+  * the scheduler polls completions FIRST — they are latency-sensitive;
+    waiting would introduce false concurrency (interpreter.clj:212-215);
+  * when the generator is PENDING or ahead of the clock, the scheduler
+    polls with a bounded timeout (max 1000 us, interpreter.clj:166-170);
+  * a worker that throws converts the op to :info with
+    "indeterminate: ..." (interpreter.clj:142-157);
+  * threads whose process crashed get a fresh process id
+    (interpreter.clj:233-236) and a fresh client on next use
+    (interpreter.clj:40-60);
+  * :sleep and :log ops are executed but excluded from the history
+    (interpreter.clj:172-179).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time as _time
+import traceback
+from typing import Optional
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu.generator import (
+    Ctx, NEMESIS, PENDING, friendly_exceptions, gen_op, gen_update, validate,
+)
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.util import relative_time_nanos
+
+MAX_PENDING_INTERVAL_US = 1000  # interpreter.clj:166-170
+
+
+class Worker:
+    """Lifecycle protocol; every method runs on one thread
+    (interpreter.clj:19-31)."""
+
+    def open(self, test, worker_id) -> "Worker":
+        return self
+
+    def invoke(self, test, op: Op) -> Op:
+        raise NotImplementedError
+
+    def close(self, test) -> None:
+        pass
+
+
+class ClientWorker(Worker):
+    """Wraps a Client; opens a fresh client whenever the op's process
+    differs from the current one and the client isn't reusable
+    (interpreter.clj:33-67)."""
+
+    def __init__(self, node):
+        self.node = node
+        self.process = None
+        self.client: Optional[jclient.Client] = None
+
+    def invoke(self, test, op):
+        if (self.process != op.get("process")
+                and not jclient.is_reusable(self.client, test)):
+            self.close(test)
+            try:
+                self.client = jclient.validate(test["client"]).open(
+                    test, self.node)
+                self.process = op.get("process")
+            except Exception as e:  # noqa: BLE001
+                self.client = None
+                o = Op(op)
+                o["type"] = "fail"
+                o["error"] = ["no-client", str(e)]
+                return o
+        return self.client.invoke(test, op)
+
+    def close(self, test):
+        if self.client is not None:
+            self.client.close(test)
+            self.client = None
+
+
+class NemesisWorker(Worker):
+    """Routes ops to the test's nemesis (interpreter.clj:69-76)."""
+
+    def invoke(self, test, op):
+        return test["nemesis"].invoke(test, op)
+
+
+class ClientNemesisWorker(Worker):
+    """Spawns ClientWorkers for integer ids (node chosen by id mod
+    #nodes) and a NemesisWorker for the nemesis id
+    (interpreter.clj:80-97)."""
+
+    def open(self, test, worker_id):
+        if isinstance(worker_id, int):
+            nodes = test.get("nodes") or [None]
+            return ClientWorker(nodes[worker_id % len(nodes)])
+        return NemesisWorker()
+
+
+def client_nemesis_worker() -> ClientNemesisWorker:
+    return ClientNemesisWorker()
+
+
+class _WorkerHandle:
+    def __init__(self, worker_id, inbox, thread):
+        self.id = worker_id
+        self.in_q = inbox
+        self.thread = thread
+
+
+def spawn_worker(test, out_q: "queue.Queue", worker: Worker, worker_id) -> _WorkerHandle:
+    """Spawn a worker thread with a 1-slot inbox; completions go to the
+    shared out_q (interpreter.clj:99-164)."""
+    in_q: "queue.Queue" = queue.Queue(maxsize=1)
+
+    def run():
+        w = worker.open(test, worker_id)
+        try:
+            while True:
+                op = in_q.get()
+                try:
+                    t = op.get("type")
+                    if t == "exit":
+                        return
+                    if t == "sleep":
+                        _time.sleep(op["value"])
+                        out_q.put(op)
+                    elif t == "log":
+                        print(op.get("value"))
+                        out_q.put(op)
+                    else:
+                        out_q.put(w.invoke(test, op))
+                except BaseException as e:  # noqa: BLE001
+                    # Convert a crash into an indeterminate :info op
+                    # (interpreter.clj:142-157).
+                    o = Op(op)
+                    o["type"] = "info"
+                    o["error"] = f"indeterminate: {e}"
+                    o["exception"] = traceback.format_exc()
+                    out_q.put(o)
+        finally:
+            w.close(test)
+
+    th = threading.Thread(target=run, name=f"jepsen worker {worker_id}",
+                          daemon=True)
+    th.start()
+    return _WorkerHandle(worker_id, in_q, th)
+
+
+def goes_in_history(op) -> bool:
+    """:log and :sleep are executed but not journaled
+    (interpreter.clj:172-179)."""
+    return op.get("type") not in ("sleep", "log")
+
+
+def run(test) -> History:
+    """Evaluate all ops from test["generator"], dispatching to worker
+    threads driving test["client"] / test["nemesis"]; returns the
+    recorded history (interpreter.clj:181-292)."""
+    ctx = Ctx.for_test(test)
+    completions: "queue.Queue" = queue.Queue()
+    workers = [spawn_worker(test, completions, client_nemesis_worker(), wid)
+               for wid in ctx.all_threads()]
+    inboxes = {w.id: w.in_q for w in workers}
+    gen = validate(friendly_exceptions(test.get("generator")))
+
+    outstanding = 0
+    poll_timeout_us = 0
+    history: list = []
+    try:
+        while True:
+            op_done = _poll(completions, poll_timeout_us)
+            if op_done is not None:
+                # Completion-first path (interpreter.clj:215-241).
+                thread = ctx.process_to_thread(op_done.get("process"))
+                now = relative_time_nanos()
+                op_done = Op(op_done)
+                op_done["time"] = now
+                ctx = ctx.with_time(now).free(thread)
+                gen = gen_update(gen, test, ctx, op_done)
+                if thread != NEMESIS and op_done.get("type") == "info":
+                    ctx = ctx.with_worker(thread, ctx.next_process(thread))
+                if goes_in_history(op_done):
+                    history.append(op_done)
+                outstanding -= 1
+                poll_timeout_us = 0
+                continue
+
+            now = relative_time_nanos()
+            ctx = ctx.with_time(now)
+            res = gen_op(gen, test, ctx)
+            if res is None:
+                if outstanding > 0:
+                    poll_timeout_us = MAX_PENDING_INTERVAL_US
+                    continue
+                for w in workers:
+                    w.in_q.put({"type": "exit"})
+                for w in workers:
+                    w.thread.join()
+                return History.wrap(history)
+
+            op, gen2 = res
+            if op is PENDING:
+                # Keep the pre-op generator (interpreter.clj:264).
+                poll_timeout_us = MAX_PENDING_INTERVAL_US
+                continue
+
+            if now < op["time"]:
+                # Not time yet; wait on completions until then
+                # (interpreter.clj:268-275).
+                poll_timeout_us = (op["time"] - now) // 1000
+                continue
+
+            thread = ctx.process_to_thread(op.get("process"))
+            # Hand the worker its own copy: Python clients may mutate the
+            # op in place, which must not corrupt the journaled invocation
+            # (immutable maps make this a non-issue in the reference).
+            inboxes[thread].put(Op(op))
+            ctx = Ctx(op["time"], ctx.free_threads, ctx.workers).busy(thread)
+            gen = gen_update(gen2, test, ctx, op)
+            if goes_in_history(op):
+                history.append(op)
+            outstanding += 1
+            poll_timeout_us = 0
+    except BaseException:
+        # Abnormal exit: ask every worker to exit via its queue
+        # (interpreter.clj:294-310).
+        for w in workers:
+            try:
+                w.in_q.put_nowait({"type": "exit"})
+            except queue.Full:
+                pass
+        raise
+
+
+def _poll(q: "queue.Queue", timeout_us: int):
+    try:
+        if timeout_us <= 0:
+            return q.get_nowait()
+        return q.get(timeout=timeout_us / 1e6)
+    except queue.Empty:
+        return None

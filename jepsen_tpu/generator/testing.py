@@ -1,0 +1,128 @@
+"""Deterministic generator simulation — no threads, no wall clock
+(reference: jepsen/src/jepsen/generator/test.clj).
+
+`simulate` runs a generator against a completion function
+`(ctx, invoke) -> completion op`, maintaining a sorted in-flight set and
+the context's clock, exactly as generator/test.clj:49-106 does. The
+completion policies `quick` / `perfect` / `perfect_info` / `imperfect`
+mirror generator/test.clj:108-180. Randomness is pinned with
+`fixed_rand(RAND_SEED)` so simulations are reproducible.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.generator import (
+    Ctx, PENDING, fixed_rand, gen_op, gen_update, validate, NEMESIS,
+)
+
+DEFAULT_TEST: dict = {}
+RAND_SEED = 45100  # generator/test.clj:43-47
+PERFECT_LATENCY = 10  # nanos; generator/test.clj:124-126
+
+
+def default_context(n: int = 2) -> Ctx:
+    """n worker threads + one nemesis (generator/test.clj:15-23)."""
+    return Ctx.for_test({"concurrency": n})
+
+
+def invocations(history) -> History:
+    return History.wrap(o for o in history if o.get("type") == "invoke")
+
+
+def simulate(gen, complete_fn: Callable, ctx: Optional[Ctx] = None,
+             test: Optional[dict] = None, seed: int = RAND_SEED) -> History:
+    """Simulate the series of ops from a generator given a completion
+    function (generator/test.clj:49-106). Returns the full history
+    (invocations interleaved with completions by time)."""
+    ctx = ctx if ctx is not None else default_context()
+    test = test if test is not None else DEFAULT_TEST
+
+    with fixed_rand(seed):
+        ops: list = []
+        in_flight: list = []  # kept sorted by :time
+        g = validate(gen)
+        while True:
+            res = gen_op(g, test, ctx)
+            if res is None:
+                ops.extend(in_flight)
+                return History.wrap(ops)
+            invoke, g2 = res
+
+            if (invoke is not PENDING
+                    and (not in_flight
+                         or invoke["time"] <= in_flight[0]["time"])):
+                # Invocation happens before every in-flight completion.
+                thread = ctx.process_to_thread(invoke["process"])
+                ctx = ctx.with_time(max(ctx.time, invoke["time"])).busy(thread)
+                g = gen_update(g2, test, ctx, invoke)
+                complete = complete_fn(ctx, Op(invoke))
+                in_flight.append(complete)
+                in_flight.sort(key=lambda o: o["time"])
+                ops.append(invoke)
+            else:
+                # Must complete something first (keeps original g on
+                # PENDING, as the interpreter does, interpreter.clj:264).
+                assert in_flight, "generator pending and nothing in flight"
+                o = in_flight.pop(0)
+                thread = ctx.process_to_thread(o["process"])
+                ctx = ctx.with_time(max(ctx.time, o["time"])).free(thread)
+                g = gen_update(g, test, ctx, o)
+                if thread != NEMESIS and o.get("type") == "info":
+                    ctx = ctx.with_worker(thread, ctx.next_process(thread))
+                ops.append(o)
+
+
+def quick_ops(gen, ctx: Optional[Ctx] = None) -> History:
+    """Every op completes :ok instantly with zero latency
+    (generator/test.clj:108-115)."""
+    return simulate(gen, lambda c, inv: _with(inv, type="ok"), ctx)
+
+
+def quick(gen, ctx: Optional[Ctx] = None) -> History:
+    return invocations(quick_ops(gen, ctx))
+
+
+def perfect_star(gen, ctx: Optional[Ctx] = None) -> History:
+    """Every op succeeds in 10ns; full history
+    (generator/test.clj:128-139)."""
+    return simulate(gen,
+                    lambda c, inv: _with(inv, type="ok",
+                                         time=inv["time"] + PERFECT_LATENCY),
+                    ctx)
+
+
+def perfect(gen, ctx: Optional[Ctx] = None) -> History:
+    return invocations(perfect_star(gen, ctx))
+
+
+def perfect_info(gen, ctx: Optional[Ctx] = None) -> History:
+    """Every op crashes :info in 10ns; invocations only
+    (generator/test.clj:150-161)."""
+    return invocations(
+        simulate(gen,
+                 lambda c, inv: _with(inv, type="info",
+                                      time=inv["time"] + PERFECT_LATENCY),
+                 ctx))
+
+
+def imperfect(gen, ctx: Optional[Ctx] = None) -> History:
+    """Threads rotate fail -> info -> ok -> fail...; 10ns each; full
+    history (generator/test.clj:163-180)."""
+    state: dict = {}
+    rotation = {None: "fail", "fail": "info", "info": "ok", "ok": "fail"}
+
+    def complete(c: Ctx, inv: Op) -> Op:
+        t = c.process_to_thread(inv["process"])
+        state[t] = rotation[state.get(t)]
+        return _with(inv, type=state[t], time=inv["time"] + PERFECT_LATENCY)
+
+    return simulate(gen, complete, ctx)
+
+
+def _with(o: Op, **kw) -> Op:
+    o = Op(o)
+    o.update(kw)
+    return o

@@ -1,0 +1,399 @@
+"""Nemesis protocol and fault-injection primitives
+(reference: jepsen/src/jepsen/nemesis.clj).
+
+A nemesis is a special client that injects faults into the cluster
+rather than applying ops to the data plane. Protocol
+(nemesis.clj:10-20): setup / invoke / teardown, plus an optional `fs()`
+reflection method enumerating which :f values it handles (used by
+composition and the combined packages).
+
+Grudge-based network partitions: a *grudge* is a map
+node -> collection-of-nodes-to-drop (nemesis.clj:100-135); `partitioner`
+applies one via net.drop_all (nemesis.clj:137-163).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from jepsen_tpu import generator as _generator
+from jepsen_tpu.history import Op
+from jepsen_tpu.util import majority
+
+
+class Nemesis:
+    def setup(self, test) -> "Nemesis":
+        return self
+
+    def invoke(self, test, op: Op) -> Op:
+        raise NotImplementedError
+
+    def teardown(self, test) -> None:
+        pass
+
+    def fs(self) -> Optional[set]:
+        """The set of :f values this nemesis handles (Reflection,
+        nemesis.clj:17-20); None = unknown."""
+        return None
+
+
+class Noop(Nemesis):
+    """Does nothing (nemesis.clj:22-27)."""
+
+    def invoke(self, test, op):
+        return _ok(op)
+
+    def fs(self):
+        return set()
+
+
+def noop() -> Noop:
+    return Noop()
+
+
+class Validate(Nemesis):
+    """Checks completions parallel jepsen.client/validate
+    (nemesis.clj:29-70)."""
+
+    def __init__(self, nemesis: Nemesis):
+        self.nemesis = nemesis
+
+    def setup(self, test):
+        return Validate(self.nemesis.setup(test))
+
+    def invoke(self, test, op):
+        res = self.nemesis.invoke(test, op)
+        if not isinstance(res, dict):
+            raise RuntimeError(
+                f"Nemesis returned {res!r} for {op!r}: not an op map")
+        return res
+
+    def teardown(self, test):
+        self.nemesis.teardown(test)
+
+    def fs(self):
+        return self.nemesis.fs()
+
+
+def validate(nemesis: Nemesis) -> Validate:
+    return Validate(nemesis)
+
+
+def _ok(op: Op, value=None) -> Op:
+    o = Op(op)
+    o["type"] = "info"  # nemesis completions are :info by convention
+    if value is not None:
+        o["value"] = value
+    return o
+
+
+# ------------------------------------------------------------- grudges
+
+
+def complete_grudge(components: Sequence[Sequence]) -> Dict:
+    """Takes a collection of node components; returns a grudge where
+    every node drops traffic from every node outside its component
+    (nemesis.clj:100-112)."""
+    out: Dict = {}
+    all_nodes = [n for comp in components for n in comp]
+    for comp in components:
+        others = [n for n in all_nodes if n not in comp]
+        for node in comp:
+            out[node] = list(others)
+    return out
+
+
+def bridge(nodes: Sequence) -> Dict:
+    """Splits nodes into two halves joined by a single bridge node that
+    can see both (nemesis.clj:114-135)."""
+    ns = list(nodes)
+    m = len(ns) // 2
+    bridge_node, left, right = ns[m], ns[:m], ns[m + 1:]
+    grudge = {}
+    for node in left:
+        grudge[node] = list(right)
+    for node in right:
+        grudge[node] = list(left)
+    grudge[bridge_node] = []
+    return grudge
+
+
+def split_one(nodes: Sequence, node=None) -> List[List]:
+    """Isolate one node (given or random) from the rest
+    (nemesis.clj:165-172 `partition-random-node`)."""
+    ns = list(nodes)
+    n = node if node is not None else _generator.rand.choice(ns)
+    return [[n], [x for x in ns if x != n]]
+
+
+def split_halves(nodes: Sequence) -> List[List]:
+    """Random [minority-half, majority-half] (nemesis.clj:85-98 bisect
+    over a shuffle)."""
+    ns = list(nodes)
+    _generator.rand.shuffle(ns)
+    return bisect(ns)
+
+
+def bisect(xs: Sequence) -> List[List]:
+    """Split into [smaller-half, larger-half] (nemesis.clj:79-83)."""
+    xs = list(xs)
+    m = len(xs) // 2
+    return [xs[:m], xs[m:]]
+
+
+def majorities_ring(nodes: Sequence) -> Dict:
+    """A grudge where every node sees a majority, but no two nodes see
+    the same majority — the overlapping-rings partition. Exact for ≤5
+    nodes, stochastic for larger clusters (nemesis.clj:183-261)."""
+    ns = list(nodes)
+    n = len(ns)
+    if n <= 5:
+        return _majorities_ring_perfect(ns)
+    return _majorities_ring_stochastic(ns)
+
+
+def _majorities_ring_perfect(ns: List) -> Dict:
+    n = len(ns)
+    m = majority(n)
+    grudge = {}
+    for i, node in enumerate(ns):
+        # node i sees the m nodes centred on it in ring order
+        visible = {ns[(i + d) % n] for d in range(-(m // 2), m - m // 2)}
+        visible.add(node)
+        grudge[node] = [x for x in ns if x not in visible]
+    return grudge
+
+
+def _majorities_ring_stochastic(ns: List) -> Dict:
+    n = len(ns)
+    m = majority(n)
+    for _ in range(1000):
+        grudge = {}
+        ok = True
+        seen_majorities = set()
+        for node in ns:
+            others = [x for x in ns if x != node]
+            _generator.rand.shuffle(others)
+            visible = frozenset([node] + others[:m - 1])
+            if visible in seen_majorities:
+                ok = False
+                break
+            seen_majorities.add(visible)
+            grudge[node] = [x for x in ns if x not in visible]
+        if ok:
+            return grudge
+    raise RuntimeError("couldn't find distinct majorities")
+
+
+class Partitioner(Nemesis):
+    """Responds to {:f :start, :value grudge-or-nil} by partitioning the
+    network per the grudge (or (grudge-fn nodes)), and {:f :stop} by
+    healing (nemesis.clj:137-163)."""
+
+    def __init__(self, grudge_fn: Optional[Callable] = None):
+        self.grudge_fn = grudge_fn
+
+    def setup(self, test):
+        _net(test).heal(test)
+        return self
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        if f == "start":
+            grudge = op.get("value")
+            if grudge is None:
+                assert self.grudge_fn is not None, \
+                    "no grudge in op and no grudge function"
+                grudge = self.grudge_fn(test["nodes"])
+            _net(test).drop_all(test, grudge)
+            return _ok(op, value=f"Cut off {grudge!r}")
+        if f == "stop":
+            _net(test).heal(test)
+            return _ok(op, value="fully connected")
+        raise ValueError(f"partitioner doesn't handle :f {f!r}")
+
+    def teardown(self, test):
+        _net(test).heal(test)
+
+    def fs(self):
+        return {"start", "stop"}
+
+
+def _net(test):
+    net = test.get("net")
+    assert net is not None, "test map has no :net"
+    return net
+
+
+def partitioner(grudge_fn: Optional[Callable] = None) -> Partitioner:
+    return Partitioner(grudge_fn)
+
+
+def partition_halves() -> Partitioner:
+    """Partition into two halves at :start (nemesis.clj:165-170)."""
+    return Partitioner(lambda nodes: complete_grudge(bisect(_shuffled(nodes))))
+
+
+def partition_random_halves() -> Partitioner:
+    return partition_halves()
+
+
+def partition_random_node() -> Partitioner:
+    """Isolate a single random node (nemesis.clj:172-180)."""
+    return Partitioner(lambda nodes: complete_grudge(split_one(nodes)))
+
+
+def partition_majorities_ring() -> Partitioner:
+    """Every node sees a distinct majority (nemesis.clj:255-261)."""
+    return Partitioner(majorities_ring)
+
+
+def _shuffled(nodes):
+    ns = list(nodes)
+    _generator.rand.shuffle(ns)
+    return ns
+
+
+# ----------------------------------------------------------- processes
+
+
+class NodeStartStopper(Nemesis):
+    """On {:f start}, runs stop-fn! on targeted nodes (e.g. kill/pause);
+    on {:f stop}, runs start-fn! on the affected nodes
+    (nemesis.clj:370-429 `node-start-stopper`)."""
+
+    def __init__(self, targeter: Callable, start_fn: Callable, stop_fn: Callable):
+        self.targeter = targeter
+        self.start_fn = start_fn
+        self.stop_fn = stop_fn
+        self.affected: Optional[list] = None  # None = not disrupting
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        if f == "start":
+            if self.affected is not None:
+                # compare-and-set! guard (nemesis.clj:388-396): refuse to
+                # stack disruptions, which would leak stopped nodes.
+                return _ok(op, value="nemesis already disrupting "
+                                     + repr(self.affected))
+            nodes = self.targeter(test["nodes"])
+            if not isinstance(nodes, (list, tuple)):
+                nodes = [nodes]
+            self.affected = list(nodes)
+            results = {n: self.start_fn(test, n) for n in nodes}
+            return _ok(op, value=results)
+        if f == "stop":
+            results = {n: self.stop_fn(test, n) for n in (self.affected or [])}
+            self.affected = None
+            return _ok(op, value=results)
+        raise ValueError(f"node-start-stopper doesn't handle :f {f!r}")
+
+    def teardown(self, test):
+        # Resume anything still disrupted so a stopped process never
+        # outlives the test.
+        for n in (self.affected or []):
+            try:
+                self.stop_fn(test, n)
+            except Exception:  # noqa: BLE001
+                pass
+        self.affected = None
+
+    def fs(self):
+        return {"start", "stop"}
+
+
+def hammer_time(targeter=None, process: str = "db") -> NodeStartStopper:
+    """SIGSTOP/SIGCONT the given process name on a random node
+    (nemesis.clj:411-429)."""
+    targeter = targeter or (lambda nodes: _generator.rand.choice(list(nodes)))
+
+    def pause(test, node):
+        _control(test).on(node, ["killall", "-s", "STOP", process])
+        return "paused"
+
+    def resume(test, node):
+        _control(test).on(node, ["killall", "-s", "CONT", process])
+        return "resumed"
+
+    return NodeStartStopper(targeter, pause, resume)
+
+
+def _control(test):
+    c = test.get("control")
+    assert c is not None, "test map has no :control (remote runner)"
+    return c
+
+
+class Truncator(Nemesis):
+    """Truncates the tail of a file on random nodes: {:f :truncate,
+    :value {node: {:file f, :bytes n}}} (nemesis.clj:431-457)."""
+
+    def invoke(self, test, op):
+        plan = op.get("value") or {}
+        for node, spec in plan.items():
+            _control(test).on(
+                node, ["truncate", "-c", "-s", f"-{spec['bytes']}",
+                       spec["file"]])
+        return _ok(op)
+
+    def fs(self):
+        return {"truncate"}
+
+
+def truncate_file() -> Truncator:
+    return Truncator()
+
+
+# --------------------------------------------------------- composition
+
+
+class Compose(Nemesis):
+    """Routes ops to sub-nemeses by :f (nemesis.clj:263-346). Takes a
+    sequence of (route, nemesis) pairs where route is either a set of fs
+    handled directly, or a dict renaming outer fs to the inner fs the
+    sub-nemesis understands (the reference's {fs-or-fmap: nemesis} map
+    form; Python dicts are unhashable as keys, so pairs it is —
+    `compose` also accepts a dict whose keys are frozensets/tuples)."""
+
+    def __init__(self, routes):
+        self.routes = list(routes)  # [(set-or-dict, nemesis)]
+
+    def setup(self, test):
+        return Compose([(k, n.setup(test)) for k, n in self.routes])
+
+    def _route(self, f):
+        for k, n in self.routes:
+            if isinstance(k, dict):
+                if f in k:
+                    return n, k[f]
+            elif f in k:
+                return n, f
+        raise ValueError(f"no nemesis handles :f {f!r} "
+                         f"(have {[k for k, _ in self.routes]!r})")
+
+    def invoke(self, test, op):
+        n, inner_f = self._route(op.get("f"))
+        inner = Op(op)
+        inner["f"] = inner_f
+        res = n.invoke(test, inner)
+        out = Op(res)
+        out["f"] = op.get("f")
+        return out
+
+    def teardown(self, test):
+        for _, n in self.routes:
+            n.teardown(test)
+
+    def fs(self):
+        out = set()
+        for k, _ in self.routes:
+            out |= set(k)
+        return out
+
+
+def compose(nemeses) -> Compose:
+    """nemeses: dict {hashable-route: nemesis} or iterable of
+    (route, nemesis) pairs (routes may be dicts in pair form)."""
+    if isinstance(nemeses, dict):
+        return Compose(nemeses.items())
+    return Compose(nemeses)

@@ -1,0 +1,332 @@
+"""Generator DSL tests — property-level port of the reference's
+jepsen.generator-test (jepsen/test/jepsen/generator_test.clj), using the
+deterministic simulator (jepsen/src/jepsen/generator/test.clj)."""
+
+import pytest
+
+import jepsen_tpu.generator as gen
+from jepsen_tpu.generator import Ctx, PENDING, fixed_rand
+from jepsen_tpu.generator.testing import (
+    default_context, imperfect, invocations, perfect, perfect_star,
+    perfect_info, quick, quick_ops, simulate, PERFECT_LATENCY,
+)
+
+
+def ctx2():
+    return default_context(2)
+
+
+# ----------------------------------------------------------- base impls
+
+
+def test_nil_generator():
+    assert quick(None) == []
+
+
+def test_map_one_shot():
+    h = quick({"f": "write"})
+    assert len(h) == 1
+    op = h[0]
+    assert op["f"] == "write"
+    assert op["type"] == "invoke"
+    assert op["time"] == 0
+    assert op["process"] in (0, 1, "nemesis")
+
+
+def test_map_with_explicit_fields():
+    h = quick({"f": "w", "process": 1, "time": 5, "type": "invoke"})
+    assert h[0]["process"] == 1
+    assert h[0]["time"] == 5
+
+
+def test_fn_generator_is_infinite():
+    h = quick(gen.limit(5, lambda: {"f": "read"}))
+    assert len(h) == 5
+    assert all(o["f"] == "read" for o in h)
+
+
+def test_fn_generator_two_arity():
+    def g(test, ctx):
+        return {"f": "read", "value": ctx.time}
+    h = quick(gen.limit(3, g))
+    assert len(h) == 3
+
+
+def test_seq_generator():
+    h = quick([{"f": "a"}, {"f": "b"}, {"f": "c"}])
+    assert [o["f"] for o in h] == ["a", "b", "c"]
+
+
+def test_nested_seqs():
+    h = quick([[{"f": "a"}, {"f": "b"}], {"f": "c"}])
+    assert [o["f"] for o in h] == ["a", "b", "c"]
+
+
+# ---------------------------------------------------------- combinators
+
+
+def test_limit_and_once():
+    assert len(quick(gen.limit(3, lambda: {"f": "x"}))) == 3
+    assert len(quick(gen.once(lambda: {"f": "x"}))) == 1
+
+
+def test_repeat_map():
+    # maps are one-shot; repeat makes them emit many times
+    h = quick(gen.repeat(4, {"f": "read"}))
+    assert len(h) == 4
+    assert all(o["f"] == "read" for o in h)
+
+
+def test_repeat_infinite_with_limit():
+    h = quick(gen.limit(7, gen.repeat({"f": "read"})))
+    assert len(h) == 7
+
+
+def test_map_transform():
+    h = quick(gen.map(lambda o: {**o, "value": 42},
+                      gen.limit(2, lambda: {"f": "w", "value": None})))
+    assert all(o["value"] == 42 for o in h)
+
+
+def test_f_map():
+    h = quick(gen.f_map({"start": "kill"}, gen.limit(2, lambda: {"f": "start"})))
+    assert all(o["f"] == "kill" for o in h)
+
+
+def test_filter():
+    i = [0]
+
+    def g():
+        i[0] += 1
+        return {"f": "x", "value": i[0]}
+
+    h = quick(gen.limit(3, gen.filter(lambda o: o["value"] % 2 == 0, g)))
+    assert [o["value"] for o in h] == [2, 4, 6]
+
+
+def test_mix_draws_from_all():
+    h = quick(gen.limit(200, gen.mix([lambda: {"f": "a"},
+                                      lambda: {"f": "b"}])))
+    fs = {o["f"] for o in h}
+    assert fs == {"a", "b"}
+    # roughly uniform
+    n_a = sum(1 for o in h if o["f"] == "a")
+    assert 40 <= n_a <= 160
+
+
+def test_mix_exhaustion_compacts():
+    h = quick(gen.mix([gen.limit(2, lambda: {"f": "a"}),
+                       gen.limit(3, lambda: {"f": "b"})]))
+    assert len(h) == 5
+    assert sum(1 for o in h if o["f"] == "a") == 2
+
+
+def test_any_prefers_soonest():
+    # 'a' is scheduled later via delay; 'b' fires first
+    g = gen.any(gen.delay(1, gen.limit(1, lambda: {"f": "a"})),
+                gen.limit(1, lambda: {"f": "b"}))
+    h = perfect(g)
+    assert len(h) == 2
+
+
+def test_flip_flop():
+    g = gen.flip_flop(lambda: {"f": "a"}, lambda: {"f": "b"})
+    h = quick(gen.limit(6, g))
+    assert [o["f"] for o in h] == ["a", "b", "a", "b", "a", "b"]
+
+
+def test_flip_flop_stops_on_exhaustion():
+    g = gen.flip_flop(gen.limit(2, lambda: {"f": "a"}),
+                      gen.limit(9, lambda: {"f": "b"}))
+    h = quick(g)
+    assert [o["f"] for o in h] == ["a", "b", "a", "b"]
+
+
+# ------------------------------------------------------- thread routing
+
+
+def test_clients_excludes_nemesis():
+    h = quick(gen.clients(gen.limit(10, lambda: {"f": "r"})))
+    assert all(o["process"] != "nemesis" for o in h)
+
+
+def test_nemesis_only():
+    h = quick(gen.nemesis(gen.limit(5, lambda: {"f": "kill"})))
+    assert all(o["process"] == "nemesis" for o in h)
+
+
+def test_clients_nemesis_two_arity():
+    h = quick(gen.clients(gen.limit(10, lambda: {"f": "r"}),
+                          gen.limit(3, lambda: {"f": "kill"})))
+    client_ops = [o for o in h if o["process"] != "nemesis"]
+    nem_ops = [o for o in h if o["process"] == "nemesis"]
+    assert len(client_ops) == 10
+    assert len(nem_ops) == 3
+    assert all(o["f"] == "kill" for o in nem_ops)
+
+
+def test_each_thread():
+    h = quick(gen.each_thread(gen.once({"f": "read"})))
+    # one op per thread: 2 workers + nemesis
+    assert len(h) == 3
+    assert {o["process"] for o in h} == {0, 1, "nemesis"}
+
+
+def test_reserve():
+    ctx = default_context(4)
+    g = gen.reserve(2, gen.limit(100, lambda: {"f": "write"}),
+                    gen.limit(100, lambda: {"f": "read"}))
+    h = perfect(gen.time_limit(1, g), ctx)
+    writes = {o["process"] for o in h if o["f"] == "write"}
+    reads = {o["process"] for o in h if o["f"] == "read"}
+    assert writes and writes <= {0, 1}
+    # default gets threads 2,3 + nemesis
+    assert reads and reads <= {2, 3, "nemesis"}
+
+
+def test_on_threads_restricts_context():
+    g = gen.on_threads(lambda t: t == 0, gen.limit(5, lambda: {"f": "r"}))
+    h = quick(g)
+    assert all(o["process"] == 0 for o in h)
+
+
+# --------------------------------------------------------- time shaping
+
+
+def test_stagger_spreads_ops():
+    g = gen.stagger(1, gen.limit(10, lambda: {"f": "r"}))
+    h = perfect(g)
+    times = [o["time"] for o in h]
+    assert times == sorted(times)
+    assert times[-1] > 0  # spread out, not all at 0
+
+
+def test_delay_fixed_rate():
+    g = gen.delay(1, gen.limit(4, lambda: {"f": "r"}))
+    h = perfect(g)
+    times = [o["time"] for o in h]
+    s = int(1e9)
+    assert times == [0, s, 2 * s, 3 * s]
+
+
+def test_time_limit():
+    g = gen.time_limit(1, gen.delay(0.3, lambda: {"f": "r"}))
+    h = perfect(g)
+    # ops at 0, .3, .6, .9 s; 1.2 is past the limit
+    assert len(h) == 4
+
+
+def test_process_limit():
+    # every op crashes -> each completion burns a process; with
+    # concurrency 2 + nemesis = 3 processes seen immediately, crashed
+    # client threads get fresh ids until the union exceeds n.
+    g = gen.clients(gen.process_limit(4, lambda: {"f": "r"}))
+    h = perfect_info(g)
+    assert 0 < len(h) <= 4
+
+
+# ------------------------------------------------------------- barriers
+
+
+def test_phases_synchronize():
+    g = gen.phases(gen.limit(4, lambda: {"f": "a"}),
+                   gen.limit(2, lambda: {"f": "b"}))
+    h = perfect_star(g)
+    # every 'b' invocation comes after every 'a' completion
+    a_completions = [o["time"] for o in h
+                     if o["f"] == "a" and o["type"] == "ok"]
+    b_invokes = [o["time"] for o in h
+                 if o["f"] == "b" and o["type"] == "invoke"]
+    assert b_invokes and a_completions
+    assert min(b_invokes) >= max(a_completions)
+
+
+def test_then():
+    g = gen.then(gen.once({"f": "b"}), gen.limit(3, lambda: {"f": "a"}))
+    h = perfect(g)
+    assert [o["f"] for o in h] == ["a", "a", "a", "b"]
+
+
+def test_until_ok():
+    # imperfect completes fail, info, ok, fail... per thread
+    g = gen.on_threads(lambda t: t == 0,
+                       gen.until_ok(lambda: {"f": "r"}))
+    h = imperfect(g)
+    oks = [o for o in h if o["type"] == "ok"]
+    assert len(oks) == 1
+    # nothing after the first ok
+    assert h[-1]["type"] == "ok"
+
+
+# ------------------------------------------------------------ validation
+
+
+def test_validate_rejects_bad_type():
+    with pytest.raises(gen.InvalidOp):
+        quick({"f": "w", "type": "bogus"})
+
+
+def test_validate_rejects_busy_process():
+    # two back-to-back ops pinned to process 0: the second is requested
+    # while process 0 is still executing the first (perfect latency 10ns)
+    g = [{"f": "a", "process": 0}, {"f": "b", "process": 0}]
+    with pytest.raises(gen.InvalidOp):
+        perfect(g)
+
+
+def test_friendly_exceptions_wrap():
+    def boom():
+        raise ValueError("boom")
+
+    with pytest.raises(gen.GeneratorThrew):
+        quick(gen.friendly_exceptions(gen.Map(lambda o: boom(),
+                                              gen.once({"f": "x"}))))
+
+
+# --------------------------------------------------------- determinism
+
+
+def test_simulate_deterministic():
+    # mix draws its initial index at construction time, so construction
+    # must be seeded too for bitwise-identical histories
+    def make():
+        with fixed_rand(7):
+            return gen.stagger(0.1, gen.limit(50, gen.mix(
+                [lambda: {"f": "a"}, lambda: {"f": "b"}])))
+    h1 = perfect_star(make())
+    h2 = perfect_star(make())
+    assert h1 == h2
+
+
+def test_crashed_processes_get_fresh_ids():
+    h = perfect_info(gen.clients(gen.limit(6, lambda: {"f": "r"})))
+    procs = [o["process"] for o in h]
+    # processes never reused after crashing
+    assert len(set(procs)) == len(procs)
+
+
+def test_perfect_latency_completions():
+    h = perfect_star(gen.clients(gen.limit(2, lambda: {"f": "r"})))
+    invs = [o for o in h if o["type"] == "invoke"]
+    oks = [o for o in h if o["type"] == "ok"]
+    assert len(invs) == 2 and len(oks) == 2
+    for inv, ok in zip(invs, oks):
+        assert ok["time"] - inv["time"] <= 2 * PERFECT_LATENCY
+
+
+# ---------------------------------------------------------- on_update
+
+
+def test_on_update_swaps_generator():
+    # after the first completion event, switch to reads
+    def handler(this, test, ctx, event):
+        if event.get("type") == "ok":
+            return gen.limit(2, lambda: {"f": "read"})
+        return this
+
+    g = gen.on_update(handler, gen.repeat({"f": "write"}))
+    h = perfect(gen.clients(g))
+    fs = [o["f"] for o in h]
+    assert fs[0] == "write"
+    assert fs.count("read") == 2
+    assert len(fs) <= 4

@@ -1,0 +1,159 @@
+"""Interpreter tests — real threads, fake clients (reference:
+jepsen/test/jepsen/generator/interpreter_test.clj)."""
+
+import threading
+
+import pytest
+
+import jepsen_tpu.generator as gen
+from jepsen_tpu.client import Client
+from jepsen_tpu.generator import interpreter
+from jepsen_tpu.history import Op
+from jepsen_tpu.nemesis import Nemesis
+from jepsen_tpu.util import reset_relative_time
+
+
+class OkClient(Client):
+    """Completes every op :ok instantly (interpreter_test.clj:18-24)."""
+
+    def open(self, test, node):
+        return OkClient()
+
+    def invoke(self, test, op):
+        o = Op(op)
+        o["type"] = "ok"
+        return o
+
+
+class InfoNemesis(Nemesis):
+    def invoke(self, test, op):
+        o = Op(op)
+        o["type"] = "info"
+        return o
+
+
+def base_test(**kw):
+    reset_relative_time()
+    t = {
+        "concurrency": 4,
+        "nodes": ["n1", "n2"],
+        "client": OkClient(),
+        "nemesis": InfoNemesis(),
+    }
+    t.update(kw)
+    return t
+
+
+def test_basic_run_structure():
+    n = 100
+    test = base_test(generator=gen.clients(
+        gen.limit(n, lambda: {"f": "read"})))
+    h = interpreter.run(test)
+    invs = [o for o in h if o["type"] == "invoke"]
+    oks = [o for o in h if o["type"] == "ok"]
+    assert len(invs) == n
+    assert len(oks) == n
+    # histories pair up: every invoke has a later completion of the same
+    # process
+    seen = {}
+    for o in h:
+        p = o["process"]
+        if o["type"] == "invoke":
+            assert p not in seen
+            seen[p] = o
+        else:
+            assert p in seen
+            del seen[p]
+    assert not seen
+
+
+def test_times_monotonic():
+    test = base_test(generator=gen.clients(
+        gen.limit(50, lambda: {"f": "read"})))
+    h = interpreter.run(test)
+    times = [o["time"] for o in h]
+    assert times == sorted(times)
+
+
+def test_nemesis_routing():
+    test = base_test(generator=gen.nemesis(
+        gen.limit(3, lambda: {"f": "kill"})))
+    h = interpreter.run(test)
+    assert len(h) == 6
+    assert all(o["process"] == "nemesis" for o in h)
+    assert [o["type"] for o in h] == ["invoke", "info"] * 3
+
+
+class CrashyClient(Client):
+    """Every other invoke raises (interpreter_test.clj:145-177)."""
+
+    counter = None  # shared across opens
+
+    def __init__(self, counter=None):
+        self.counter = counter
+
+    def open(self, test, node):
+        return CrashyClient(self.counter)
+
+    def invoke(self, test, op):
+        with self.counter["lock"]:
+            self.counter["n"] += 1
+            n = self.counter["n"]
+        if n % 2 == 0:
+            raise RuntimeError(f"crash {n}")
+        o = Op(op)
+        o["type"] = "ok"
+        return o
+
+
+def test_worker_crash_becomes_info_and_process_renumbered():
+    counter = {"n": 0, "lock": threading.Lock()}
+    test = base_test(
+        client=CrashyClient(counter),
+        generator=gen.clients(gen.limit(20, lambda: {"f": "w"})))
+    h = interpreter.run(test)
+    infos = [o for o in h if o["type"] == "info"]
+    assert infos, "expected some crashes"
+    for o in infos:
+        assert o["error"].startswith("indeterminate: ")
+    # a crashed process id never invokes again
+    crashed = {o["process"] for o in infos}
+    later_invokes = {}
+    for i, o in enumerate(h):
+        if o["type"] == "invoke":
+            later_invokes.setdefault(o["process"], []).append(i)
+    for p in crashed:
+        info_idx = max(i for i, o in enumerate(h)
+                       if o["process"] == p and o["type"] == "info")
+        assert all(i < info_idx for i in later_invokes[p])
+
+
+def test_log_and_sleep_excluded_from_history():
+    test = base_test(generator=gen.clients(
+        [gen.log("hello"), gen.sleep(0.01), gen.once({"f": "read"})]))
+    h = interpreter.run(test)
+    assert all(o.get("f") == "read" for o in h)
+    assert len(h) == 2
+
+
+def test_generator_exception_propagates():
+    def boom(test, ctx):
+        raise ValueError("generator boom")
+
+    test = base_test(generator=gen.clients(boom))
+    with pytest.raises(gen.GeneratorThrew):
+        interpreter.run(test)
+
+
+def test_throughput_floor():
+    """The reference asserts >5000 ops/s on a dev box
+    (interpreter_test.clj:137-142); we assert a conservative floor."""
+    import time
+    n = 2000
+    test = base_test(concurrency=10,
+                     generator=gen.clients(gen.limit(n, lambda: {"f": "r"})))
+    t0 = time.time()
+    h = interpreter.run(test)
+    dt = time.time() - t0
+    assert len(h) == 2 * n
+    assert n / dt > 1000, f"throughput {n/dt:.0f} ops/s below floor"
