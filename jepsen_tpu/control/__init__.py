@@ -1,0 +1,476 @@
+"""Remote control: drive commands on cluster nodes
+(reference: jepsen/src/jepsen/control.clj).
+
+The `Remote` protocol (control.clj:19-36) has five operations:
+connect / disconnect / execute / upload / download. Transports:
+
+    SshRemote     OpenSSH subprocess (the reference uses clj-ssh/JSch,
+                  control.clj:330-357); gated on an `ssh` binary
+    DockerRemote  docker exec / docker cp (control/docker.clj:75-90)
+    K8sRemote     kubectl exec / cp (control/k8s.clj:79-111)
+    LocalRemote   run on this host via subprocess — the single-machine
+                  harness used by tests and the in-memory cluster
+    DummyRemote   no-ops that log (control.clj:346-355, `--no-ssh`)
+
+Ambient state rides a thread-local `Scope` (the reference's dynamic
+vars *host*/*session*/*sudo*/*dir*, control.clj:38-50), so client code
+reads as:
+
+    with c.on_host(session, "n1"):
+        c.exec("grep", "-q", "foo", "/etc/hosts")
+
+Command construction mirrors the escaping DSL (control.clj:82-125):
+arguments are escaped unless wrapped in `lit`; `exec` joins them into
+one shell line, applies sudo/cd wrappers, runs, and raises
+`RemoteError` on nonzero exit with captured out/err.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import shutil
+import subprocess
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from jepsen_tpu.util import real_pmap
+
+
+class Lit:
+    """A literal string, passed to the shell unescaped
+    (control.clj:96-100)."""
+
+    def __init__(self, s: str):
+        self.s = s
+
+    def __str__(self):
+        return self.s
+
+
+def lit(s: str) -> Lit:
+    return Lit(s)
+
+
+def escape(x) -> str:
+    """Escape one argument (control.clj:102-125): Lit passes through;
+    everything else is stringified and shell-quoted if needed."""
+    if isinstance(x, Lit):
+        return x.s
+    if isinstance(x, (list, tuple)):
+        return " ".join(escape(e) for e in x)
+    s = str(x)
+    if s == "":
+        return "''"
+    if all(c.isalnum() or c in "-_./=:,@+%^" for c in s):
+        return s
+    return shlex.quote(s)
+
+
+def wrap_sudo(cmd: str, sudo: Optional[str]) -> str:
+    """Wrap a command in sudo -u (control.clj:127-137)."""
+    if not sudo:
+        return cmd
+    return f"sudo -S -u {escape(sudo)} bash -c {shlex.quote(cmd)}"
+
+
+def wrap_cd(cmd: str, dir_: Optional[str]) -> str:
+    if not dir_:
+        return cmd
+    return f"cd {escape(dir_)} && {cmd}"
+
+
+class RemoteError(RuntimeError):
+    def __init__(self, cmd, exit_code, out, err, host=None):
+        self.cmd = cmd
+        self.exit = exit_code
+        self.out = out
+        self.err = err
+        self.host = host
+        super().__init__(
+            f"command failed on {host!r} (exit {exit_code}): {cmd}\n"
+            f"stdout: {out}\nstderr: {err}")
+
+
+@dataclass
+class Result:
+    cmd: str
+    exit: int
+    out: str
+    err: str
+
+    def throw_on_nonzero(self, host=None) -> "Result":
+        if self.exit != 0:
+            raise RemoteError(self.cmd, self.exit, self.out, self.err, host)
+        return self
+
+
+class Remote:
+    """Transport protocol (control.clj:19-36)."""
+
+    def connect(self, conn_spec: dict) -> "Remote":
+        """Return a connected remote for the given spec
+        ({host, port, username, ...})."""
+        return self
+
+    def disconnect(self) -> None:
+        pass
+
+    def execute(self, ctx: dict, cmd: str) -> Result:
+        """Run one shell line; ctx may carry {sudo, dir}."""
+        raise NotImplementedError
+
+    def upload(self, local_paths, remote_path) -> None:
+        raise NotImplementedError
+
+    def download(self, remote_paths, local_path) -> None:
+        raise NotImplementedError
+
+
+# ------------------------------------------------------------- scoping
+
+
+class Scope(threading.local):
+    """The ambient control state (control.clj:38-50)."""
+
+    def __init__(self):
+        self.host: Optional[str] = None
+        self.session: Optional[Remote] = None
+        self.sudo: Optional[str] = None
+        self.dir: Optional[str] = None
+        self.trace: bool = False
+        self.retries: int = 3
+
+
+scope = Scope()
+
+
+class _Binding:
+    def __init__(self, **kw):
+        self.kw = kw
+        self.saved: dict = {}
+
+    def __enter__(self):
+        for k, v in self.kw.items():
+            self.saved[k] = getattr(scope, k)
+            setattr(scope, k, v)
+        return scope
+
+    def __exit__(self, *exc):
+        for k, v in self.saved.items():
+            setattr(scope, k, v)
+        return False
+
+
+def on_host(session: Remote, host: str):
+    """Bind the ambient session/host (the reference's `on`/`with-session`)."""
+    return _Binding(session=session, host=host)
+
+
+def su(user: str = "root"):
+    return _Binding(sudo=user)
+
+
+def cd(dir_: str):
+    return _Binding(dir=dir_)
+
+
+def trace_on():
+    return _Binding(trace=True)
+
+
+def exec_(*args) -> str:
+    """Run a command on the current session; returns trimmed stdout;
+    raises RemoteError on nonzero exit (control.clj:196-215)."""
+    assert scope.session is not None, "no session bound; use on_host(...)"
+    cmd = " ".join(escape(a) for a in args)
+    if scope.trace:
+        print(f"[control] {scope.host}: {cmd}")
+    ctx = {"sudo": scope.sudo, "dir": scope.dir}
+    res = scope.session.execute(ctx, cmd)
+    res.throw_on_nonzero(scope.host)
+    return res.out.strip()
+
+
+# Alias matching the reference's c/exec
+exec = exec_  # noqa: A001
+
+
+def upload(local_paths, remote_path):
+    assert scope.session is not None
+    return scope.session.upload(local_paths, remote_path)
+
+
+def download(remote_paths, local_path):
+    assert scope.session is not None
+    return scope.session.download(remote_paths, local_path)
+
+
+# ------------------------------------------------------------ remotes
+
+
+def _run_local(argv_or_str, shell=False, stdin=None, timeout=600) -> Result:
+    p = subprocess.run(
+        argv_or_str, shell=shell, input=stdin, capture_output=True,
+        text=True, timeout=timeout)
+    cmd = argv_or_str if isinstance(argv_or_str, str) else " ".join(argv_or_str)
+    return Result(cmd, p.returncode, p.stdout, p.stderr)
+
+
+class LocalRemote(Remote):
+    """Runs commands on this machine — the single-host harness. sudo/cd
+    wrappers apply exactly as on a real node."""
+
+    def __init__(self, host="localhost"):
+        self.host = host
+
+    def connect(self, conn_spec):
+        return LocalRemote(conn_spec.get("host", "localhost"))
+
+    def execute(self, ctx, cmd):
+        full = wrap_cd(cmd, ctx.get("dir"))
+        # sudo only if requested AND we aren't already that user
+        sudo = ctx.get("sudo")
+        if sudo and sudo != _current_user():
+            full = wrap_sudo(full, sudo)
+        return _run_local(["bash", "-c", full])
+
+    def upload(self, local_paths, remote_path):
+        for p in _coll(local_paths):
+            shutil.copy(p, remote_path)
+
+    def download(self, remote_paths, local_path):
+        for p in _coll(remote_paths):
+            dst = (os.path.join(local_path, os.path.basename(p))
+                   if os.path.isdir(local_path) else local_path)
+            shutil.copy(p, dst)
+
+
+def _current_user() -> str:
+    try:
+        import getpass
+        return getpass.getuser()
+    except Exception:  # noqa: BLE001
+        return ""
+
+
+class DummyRemote(Remote):
+    """Does nothing, records commands — the reference's
+    {:dummy? true} / --no-ssh remote (control.clj:346-355). Lets the
+    full test lifecycle run with no cluster."""
+
+    def __init__(self):
+        self.log: List[str] = []
+
+    def connect(self, conn_spec):
+        return self
+
+    def execute(self, ctx, cmd):
+        self.log.append(cmd)
+        return Result(cmd, 0, "", "")
+
+    def upload(self, local_paths, remote_path):
+        self.log.append(f"upload {local_paths} -> {remote_path}")
+
+    def download(self, remote_paths, local_path):
+        self.log.append(f"download {remote_paths} -> {local_path}")
+
+
+class SshRemote(Remote):
+    """OpenSSH subprocess transport with retry on transient failures
+    (control.clj:173-194,314-357). Requires `ssh`/`scp` binaries."""
+
+    TRANSIENT = ("Connection reset", "Connection refused",
+                 "Broken pipe", "timed out")
+
+    def __init__(self, conn_spec: Optional[dict] = None):
+        self.spec = conn_spec or {}
+
+    def connect(self, conn_spec):
+        if shutil.which("ssh") is None:
+            raise RuntimeError("no `ssh` binary on PATH")
+        return SshRemote(conn_spec)
+
+    def _base(self, prog="ssh") -> List[str]:
+        s = self.spec
+        argv = [prog, "-o", "StrictHostKeyChecking=no",
+                "-o", "UserKnownHostsFile=/dev/null", "-o", "LogLevel=ERROR"]
+        if s.get("port"):
+            argv += (["-P", str(s["port"])] if prog == "scp"
+                     else ["-p", str(s["port"])])
+        if s.get("private-key-path"):
+            argv += ["-i", s["private-key-path"]]
+        return argv
+
+    def _dest(self) -> str:
+        s = self.spec
+        user = s.get("username", "root")
+        return f"{user}@{s['host']}"
+
+    def execute(self, ctx, cmd):
+        full = wrap_sudo(wrap_cd(cmd, ctx.get("dir")), ctx.get("sudo"))
+        last = None
+        for attempt in range(3):
+            res = _run_local(self._base() + [self._dest(), full])
+            last = res
+            if res.exit == 255 and any(t in res.err for t in self.TRANSIENT):
+                time.sleep(0.5 * (attempt + 1))
+                continue
+            return res
+        return last
+
+    def upload(self, local_paths, remote_path):
+        argv = self._base("scp") + [*_coll(local_paths),
+                                    f"{self._dest()}:{remote_path}"]
+        _run_local(argv).throw_on_nonzero(self.spec.get("host"))
+
+    def download(self, remote_paths, local_path):
+        argv = self._base("scp") + [f"{self._dest()}:{p}"
+                                    for p in _coll(remote_paths)] + [local_path]
+        _run_local(argv).throw_on_nonzero(self.spec.get("host"))
+
+
+class DockerRemote(Remote):
+    """docker exec / docker cp (control/docker.clj:75-90)."""
+
+    def __init__(self, container: Optional[str] = None):
+        self.container = container
+
+    def connect(self, conn_spec):
+        if shutil.which("docker") is None:
+            raise RuntimeError("no `docker` binary on PATH")
+        return DockerRemote(conn_spec["host"])
+
+    def execute(self, ctx, cmd):
+        full = wrap_sudo(wrap_cd(cmd, ctx.get("dir")), ctx.get("sudo"))
+        return _run_local(["docker", "exec", self.container,
+                           "bash", "-c", full])
+
+    def upload(self, local_paths, remote_path):
+        for p in _coll(local_paths):
+            _run_local(["docker", "cp", p,
+                        f"{self.container}:{remote_path}"]
+                       ).throw_on_nonzero(self.container)
+
+    def download(self, remote_paths, local_path):
+        for p in _coll(remote_paths):
+            _run_local(["docker", "cp", f"{self.container}:{p}",
+                        local_path]).throw_on_nonzero(self.container)
+
+
+class K8sRemote(Remote):
+    """kubectl exec / cp (control/k8s.clj:79-111)."""
+
+    def __init__(self, pod: Optional[str] = None, namespace: str = "default",
+                 container: Optional[str] = None):
+        self.pod = pod
+        self.namespace = namespace
+        self.container = container
+
+    def connect(self, conn_spec):
+        if shutil.which("kubectl") is None:
+            raise RuntimeError("no `kubectl` binary on PATH")
+        return K8sRemote(conn_spec["host"],
+                         conn_spec.get("namespace", "default"),
+                         conn_spec.get("container"))
+
+    def _kargs(self) -> List[str]:
+        out = ["-n", self.namespace]
+        if self.container:
+            out += ["-c", self.container]
+        return out
+
+    def execute(self, ctx, cmd):
+        full = wrap_sudo(wrap_cd(cmd, ctx.get("dir")), ctx.get("sudo"))
+        return _run_local(["kubectl", "exec", *self._kargs(), self.pod,
+                           "--", "bash", "-c", full])
+
+    def upload(self, local_paths, remote_path):
+        for p in _coll(local_paths):
+            _run_local(["kubectl", "cp", *self._kargs()[:2], p,
+                        f"{self.namespace}/{self.pod}:{remote_path}"]
+                       ).throw_on_nonzero(self.pod)
+
+    def download(self, remote_paths, local_path):
+        for p in _coll(remote_paths):
+            _run_local(["kubectl", "cp", *self._kargs()[:2],
+                        f"{self.namespace}/{self.pod}:{p}", local_path]
+                       ).throw_on_nonzero(self.pod)
+
+
+# -------------------------------------------------- sessions & fan-out
+
+
+def remote_for_test(test: dict) -> Remote:
+    """Pick the transport from the test map: an explicit :remote, else
+    dummy when ssh:{dummy: true} (cli.clj:76-77), else SSH."""
+    if test.get("remote") is not None:
+        return test["remote"]
+    ssh = test.get("ssh") or {}
+    if ssh.get("dummy"):
+        return DummyRemote()
+    return SshRemote()
+
+
+def session(test: dict, node: str) -> Remote:
+    base = remote_for_test(test)
+    spec = dict(test.get("ssh") or {})
+    spec["host"] = node
+    return base.connect(spec)
+
+
+class Sessions:
+    """One connected session per node, opened in parallel
+    (core.clj:349-359 with-ssh)."""
+
+    def __init__(self, test: dict):
+        self.test = test
+        self.sessions: Dict[str, Remote] = {}
+
+    def __enter__(self):
+        nodes = self.test.get("nodes") or []
+        opened = real_pmap(lambda n: (n, session(self.test, n)), nodes)
+        self.sessions = dict(opened)
+        self.test["sessions"] = self.sessions
+        return self
+
+    def __exit__(self, *exc):
+        for s in self.sessions.values():
+            try:
+                s.disconnect()
+            except Exception:  # noqa: BLE001
+                pass
+        self.sessions = {}
+        self.test.pop("sessions", None)
+        return False
+
+    def on(self, node: str, args: Sequence) -> str:
+        """Run one escaped command on one node (used by nemeses)."""
+        with on_host(self.sessions[node], node):
+            return exec_(*args)
+
+
+def with_sessions(test: dict) -> Sessions:
+    return Sessions(test)
+
+
+def on_nodes(test: dict, f, nodes: Optional[Sequence] = None) -> Dict:
+    """Evaluate (f test node) in parallel on each node with the node's
+    session bound; returns {node: result} (control.clj:419-447)."""
+    nodes = list(nodes if nodes is not None else test.get("nodes") or [])
+    sessions = test.get("sessions") or {}
+
+    def run(node):
+        s = sessions.get(node)
+        if s is None:
+            s = session(test, node)
+        with on_host(s, node):
+            return node, f(test, node)
+
+    return dict(real_pmap(run, nodes))
+
+
+def _coll(x) -> List:
+    return list(x) if isinstance(x, (list, tuple)) else [x]

@@ -1,0 +1,207 @@
+"""Node-management utilities (reference: jepsen/src/jepsen/control/util.clj):
+file tests, archive installs with cached downloads, daemon lifecycle via
+pidfiles, port waits, and grepkill. All run through the ambient control
+session (jepsen_tpu.control)."""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+from jepsen_tpu import control as c
+from jepsen_tpu.control import RemoteError, lit
+
+WGET_CACHE_DIR = "/tmp/jepsen/wget-cache"  # control/util.clj cache dir
+
+
+def file_exists(path: str) -> bool:
+    """(control/util.clj:13-20 exists?)"""
+    try:
+        c.exec_("stat", path)
+        return True
+    except RemoteError:
+        return False
+
+
+def ls(path: str = ".") -> list:
+    try:
+        return c.exec_("ls", "-1", path).splitlines()
+    except RemoteError:
+        return []
+
+
+def ls_full(path: str) -> list:
+    p = path if path.endswith("/") else path + "/"
+    return [p + f for f in ls(p)]
+
+
+def tmp_file(ext: str = "") -> str:
+    return c.exec_("mktemp", f"--suffix={ext}")
+
+
+def tmp_dir() -> str:
+    return c.exec_("mktemp", "-d")
+
+
+def wget(url: str, force: bool = False, cache: bool = True) -> str:
+    """Download url to the current dir; with cache, keep a shared copy
+    under WGET_CACHE_DIR keyed by url (control/util.clj:106-180)."""
+    filename = url.rstrip("/").split("/")[-1]
+    if cache:
+        key = url.replace("/", "_")
+        cached = f"{WGET_CACHE_DIR}/{key}"
+        if force or not file_exists(cached):
+            c.exec_("mkdir", "-p", WGET_CACHE_DIR)
+            # Download to a temp name and mv into place atomically: a
+            # failed `wget -O cached` leaves a partial/empty file that
+            # would poison every future cached install.
+            try:
+                c.exec_("wget", "-O", cached + ".part", url)
+            except RemoteError:
+                c.exec_("rm", "-f", cached + ".part")
+                raise
+            c.exec_("mv", cached + ".part", cached)
+        c.exec_("cp", cached, filename)
+    else:
+        if force:
+            c.exec_("rm", "-f", filename)
+        if not file_exists(filename):
+            c.exec_("wget", url)
+    return filename
+
+
+def install_archive(url: str, dest: str, force: bool = False,
+                    user: Optional[str] = None) -> str:
+    """Download (or file:// copy) a tarball/zip and extract it to dest,
+    flattening a single top-level directory (control/util.clj:182-247)."""
+    c.exec_("rm", "-rf", dest) if force else None
+    if file_exists(dest) and not force:
+        return dest
+    c.exec_("mkdir", "-p", dest)
+    tmp = tmp_dir()
+    try:
+        if url.startswith("file://"):
+            archive = url[len("file://"):]
+        else:
+            with c.cd(tmp):
+                archive = tmp + "/" + wget(url)
+        with c.cd(tmp):
+            if archive.endswith(".zip"):
+                c.exec_("unzip", "-o", archive, "-d", tmp)
+            else:
+                c.exec_("tar", "--no-same-owner", "--no-same-permissions",
+                        "--extract", "--file", archive, "--directory", tmp,
+                        "--exclude", archive.split("/")[-1])
+            entries = [e for e in ls(tmp)
+                       if tmp + "/" + e != archive
+                       and e != archive.split("/")[-1]]
+            if len(entries) == 1 and _is_dir(tmp + "/" + entries[0]):
+                src = tmp + "/" + entries[0]
+                c.exec_("sh", "-c",
+                        lit(f"mv {c.escape(src)}/* {c.escape(dest)}/"))
+            else:
+                for e in entries:
+                    c.exec_("mv", tmp + "/" + e, dest + "/")
+        if user:
+            c.exec_("chown", "-R", user, dest)
+        return dest
+    finally:
+        c.exec_("rm", "-rf", tmp)
+
+
+def _is_dir(path: str) -> bool:
+    try:
+        c.exec_("test", "-d", path)
+        return True
+    except RemoteError:
+        return False
+
+
+# ------------------------------------------------------------- daemons
+
+
+def start_daemon(opts: dict, bin_: str, *args) -> bool:
+    """Start bin as a daemon with a pidfile; returns False when already
+    running (control/util.clj:282-328 start-daemon!). opts:
+    {chdir, logfile, pidfile, env}."""
+    pidfile = opts["pidfile"]
+    logfile = opts.get("logfile", "/dev/null")
+    chdir = opts.get("chdir", "/")
+    if daemon_running(pidfile):
+        return False
+    env = " ".join(f"{k}={c.escape(v)}" for k, v in
+                   (opts.get("env") or {}).items())
+    argv = " ".join(c.escape(a) for a in args)
+    # The background job must be a SIMPLE command (`nohup ... &`), not an
+    # `&&` chain: bash backgrounds a whole chain in a subshell that keeps
+    # the caller's stdout pipe open until the daemon exits, hanging any
+    # transport that waits for EOF. `cd` runs as its own statement.
+    cmd = (f"cd {c.escape(chdir)}; "
+           f"{env + ' ' if env else ''}nohup {c.escape(bin_)} {argv} "
+           f"< /dev/null >> {c.escape(logfile)} 2>&1 "
+           f"& echo $! > {c.escape(pidfile)}")
+    c.exec_("bash", "-c", lit(c.escape(cmd)))
+    return True
+
+
+def daemon_running(pidfile: str) -> bool:
+    """Is the pidfile's process alive? (control/util.clj:330-339)"""
+    try:
+        pid = c.exec_("cat", pidfile)
+    except RemoteError:
+        return False
+    if not pid.strip():
+        return False
+    try:
+        c.exec_("ps", "-p", pid.strip())
+        return True
+    except RemoteError:
+        return False
+
+
+def stop_daemon(pidfile: str, signal: str = "TERM", timeout_s: float = 10):
+    """Kill the pidfile's process and remove the pidfile
+    (control/util.clj:341-348)."""
+    try:
+        pid = c.exec_("cat", pidfile).strip()
+    except RemoteError:
+        return
+    if pid:
+        try:
+            c.exec_("kill", f"-{signal}", pid)
+        except RemoteError:
+            pass
+        deadline = time.time() + timeout_s
+        while time.time() < deadline and daemon_running(pidfile):
+            time.sleep(0.1)
+        if daemon_running(pidfile):
+            try:
+                c.exec_("kill", "-KILL", pid)
+            except RemoteError:
+                pass
+    c.exec_("rm", "-f", pidfile)
+
+
+def grepkill(pattern: str, signal: str = "KILL"):
+    """Kill processes matching pattern (control/util.clj:258-280)."""
+    try:
+        c.exec_("pkill", f"-{signal}", "-f", pattern)
+    except RemoteError as e:
+        if e.exit != 1:  # 1 = no processes matched
+            raise
+
+
+def await_tcp_port(port: int, host: str = "localhost",
+                   timeout_s: float = 60, interval_s: float = 0.5):
+    """Block until the port accepts connections
+    (control/util.clj:350-361)."""
+    deadline = time.time() + timeout_s
+    while True:
+        try:
+            c.exec_("bash", "-c",
+                    lit(c.escape(f"exec 3<>/dev/tcp/{host}/{port}")))
+            return
+        except RemoteError:
+            if time.time() > deadline:
+                raise TimeoutError(f"port {host}:{port} never opened")
+            time.sleep(interval_s)

@@ -1,0 +1,142 @@
+"""Database lifecycle protocols (reference: jepsen/src/jepsen/db.clj).
+
+`DB` (db.clj:11-13) sets up / tears down the system under test on each
+node; optional capability protocols: `Process` start/kill (db.clj:18-24),
+`Pause` pause/resume (db.clj:26-29), `Primary` discovery/promotion
+(db.clj:31-38), `LogFiles` (db.clj:40-41). `cycle` retries setup 3x on
+failure (db.clj:117-158)."""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from jepsen_tpu import control as c
+from jepsen_tpu.util import real_pmap
+
+
+class DB:
+    def setup(self, test, node) -> None:
+        """Install and start the database on node."""
+
+    def teardown(self, test, node) -> None:
+        """Tear down and remove all traces of the database."""
+
+
+class Process:
+    """Optional: databases whose processes can be started/killed
+    (db.clj:18-24)."""
+
+    def start(self, test, node):
+        raise NotImplementedError
+
+    def kill(self, test, node):
+        raise NotImplementedError
+
+
+class Pause:
+    """Optional: SIGSTOP/SIGCONT (db.clj:26-29)."""
+
+    def pause(self, test, node):
+        raise NotImplementedError
+
+    def resume(self, test, node):
+        raise NotImplementedError
+
+
+class Primary:
+    """Optional: primary discovery and promotion (db.clj:31-38)."""
+
+    def primaries(self, test) -> List:
+        raise NotImplementedError
+
+    def setup_primary(self, test, node) -> None:
+        pass
+
+
+class LogFiles:
+    """Optional: log paths to snarf at teardown (db.clj:40-41)."""
+
+    def log_files(self, test, node) -> List[str]:
+        return []
+
+
+class Noop(DB):
+    """No-op database (db.clj:43-47)."""
+
+
+def noop() -> Noop:
+    return Noop()
+
+
+class SetupFailed(Exception):
+    pass
+
+
+def cycle(db: DB, test: dict, retries: int = 3) -> None:
+    """Teardown then setup on every node in parallel, then promote a
+    primary on the first node for Primary DBs; the whole cycle retries
+    up to `retries` times on SetupFailed (db.clj:117-158)."""
+    last: Optional[BaseException] = None
+    for _ in range(retries):
+        try:
+            c.on_nodes(test, db.teardown)
+            c.on_nodes(test, db.setup)
+            if isinstance(db, Primary) and test.get("nodes"):
+                primary = test["nodes"][0]  # core.clj:66-69 primary
+                c.on_nodes(test, lambda t, n: db.setup_primary(t, n),
+                           [primary])
+            return
+        except SetupFailed as e:
+            last = e
+            time.sleep(1)
+    raise last if last else SetupFailed("db cycle failed")
+
+
+class Tcpdump(DB, LogFiles):
+    """Captures packets on each node for the duration of a test — the
+    capture-as-a-DB wrapper (db.clj:49-115). Compose with a real DB via
+    Composite([Tcpdump(...), real_db])."""
+
+    def __init__(self, filter_: str = "", pcap: str = "/tmp/jepsen.pcap",
+                 interface: str = "any"):
+        self.filter = filter_
+        self.pcap = pcap
+        self.interface = interface
+        self.pidfile = "/tmp/jepsen-tcpdump.pid"
+
+    def setup(self, test, node):
+        from jepsen_tpu.control import util as cu
+        cu.start_daemon({"pidfile": self.pidfile, "logfile": "/dev/null"},
+                        "tcpdump", "-i", self.interface, "-w", self.pcap,
+                        *(self.filter.split() if self.filter else []))
+
+    def teardown(self, test, node):
+        from jepsen_tpu.control import util as cu
+        cu.stop_daemon(self.pidfile)
+
+    def log_files(self, test, node):
+        return [self.pcap]
+
+
+class Composite(DB, LogFiles):
+    """Run several DBs in order on setup, reverse order on teardown."""
+
+    def __init__(self, dbs: List[DB]):
+        self.dbs = list(dbs)
+
+    def setup(self, test, node):
+        for db in self.dbs:
+            db.setup(test, node)
+
+    def teardown(self, test, node):
+        for db in reversed(self.dbs):
+            db.teardown(test, node)
+
+    def log_files(self, test, node):
+        out = []
+        for db in self.dbs:
+            lf = getattr(db, "log_files", None)
+            if lf is not None:
+                out.extend(lf(test, node))
+        return out

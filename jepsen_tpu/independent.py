@@ -1,0 +1,298 @@
+"""Per-key independence: lift a single-key test to a map of keys
+(reference: jepsen/src/jepsen/independent.clj).
+
+Expensive checkers (linearizability is exponential) only handle short
+histories; independence splits one long multi-key history into many
+short per-key subhistories. Ops carry `KV(k, v)` tuple values
+(independent.clj:21-29); `subhistory` filters + unwraps per key
+(independent.clj:250-261); `checker` lifts a checker over every key
+(independent.clj:263-314).
+
+TPU mapping (SURVEY.md §2.20 P5): the per-key subhistories are the
+natural *batch axis* for the device engine — when the lifted checker is
+`Linearizable` with a packable model, all keys are checked in ONE
+batched device program (jepsen_tpu.parallel.engine.check_batch) instead
+of bounded-pmap'd host processes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional, Sequence
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker.core import Checker, check_safe, merge_valid
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.util import bounded_pmap
+
+DIR = "independent"  # results subdirectory (independent.clj:17-19)
+
+
+class KV(tuple):
+    """A [k v] tuple value produced by independent generators — the
+    MapEntry analogue (independent.clj:21-29). Subclasses tuple so it
+    serializes like a 2-vector, as the reference's history.edn does."""
+
+    __slots__ = ()
+
+    def __new__(cls, k, v):
+        return super().__new__(cls, (k, v))
+
+    @property
+    def key(self):
+        return self[0]
+
+    @property
+    def value(self):
+        return self[1]
+
+
+def ktuple(k, v) -> KV:
+    return KV(k, v)
+
+
+def is_tuple(v) -> bool:
+    return isinstance(v, KV)
+
+
+def kv_history(history) -> History:
+    """Reinterpret 2-element list/tuple op values as KV tuples — for
+    histories loaded from EDN/JSONL, where the reference serializes
+    MapEntry values as plain [k v] vectors."""
+    out = History()
+    for o in history:
+        v = o.get("value")
+        if (not isinstance(v, KV) and isinstance(v, (list, tuple))
+                and len(v) == 2):
+            o = Op(o)
+            o["value"] = KV(v[0], v[1])
+        out.append(o)
+    return out
+
+
+def tuple_gen(k, g):
+    """Wraps a generator so its ops carry KV(k, value) values
+    (independent.clj:94-99)."""
+    def wrap(op):
+        o = Op(op)
+        o["value"] = KV(k, o.get("value"))
+        return o
+    return gen.map(wrap, g)
+
+
+def sequential_generator(keys: Iterable, fgen: Callable):
+    """One key at a time: generator for k1 until exhausted, then k2...
+    (independent.clj:31-47). fgen must be pure."""
+    return [tuple_gen(k, fgen(k)) for k in keys]
+
+
+def _group_threads(n: int, ctx: gen.Ctx):
+    """Partition sorted worker threads into groups of n
+    (independent.clj:49-76)."""
+    threads = sorted(t for t in ctx.all_threads() if not isinstance(t, str))
+    count = len(threads)
+    groups = count // n
+    assert n <= count, (
+        f"With {count} worker threads, concurrent_generator cannot run a "
+        f"key with {n} threads concurrently. Raise :concurrency to at "
+        f"least {n}.")
+    assert count == n * groups, (
+        f"concurrent_generator has {count} threads but can only use "
+        f"{n * groups} of them to run {groups} concurrent keys with {n} "
+        f"threads apiece. Make :concurrency a multiple of {n}.")
+    return [threads[i * n:(i + 1) * n] for i in range(groups)]
+
+
+class ConcurrentGenerator(gen.Generator):
+    """Splits client threads into groups of n; each group works one key;
+    exhausted groups lazily pull the next key
+    (independent.clj:101-236)."""
+
+    def __init__(self, n, fgen, keys, group_threads=None, thread_group=None,
+                 gens=None):
+        self.n = n
+        self.fgen = fgen
+        self.keys = list(keys)
+        self.group_threads = group_threads  # list[list[thread]]
+        self.thread_group = thread_group    # {thread: group}
+        self.gens = gens                    # list[gen|None] per group
+
+    def _init(self, ctx):
+        gt = self.group_threads or _group_threads(self.n, ctx)
+        tg = self.thread_group or {t: g for g, ts in enumerate(gt) for t in ts}
+        keys = self.keys
+        gens = self.gens
+        if gens is None:
+            gens = [tuple_gen(k, self.fgen(k)) for k in keys[:len(gt)]]
+            gens += [None] * (len(gt) - len(gens))
+            keys = keys[len(gt):]
+        return gt, tg, keys, gens
+
+    def op(self, test, ctx):
+        gt, tg, keys, gens = self._init(ctx)
+        free_groups = {tg[t] for t in ctx.free_threads if t in tg}
+        soonest = None
+        gens = list(gens)
+        for group in free_groups:
+            while True:
+                g = gens[group]
+                gctx = ctx.restrict(lambda t, ts=set(gt[group]): t in ts)
+                res = gen.gen_op(g, test, gctx)
+                if res is not None:
+                    o, g2 = res
+                    soonest = gen.soonest_op_map(
+                        soonest, {"op": o, "group": group, "gen": g2,
+                                  "weight": len(gt[group])})
+                    break
+                # exhausted: replace with next key's generator, if any
+                if keys:
+                    k, keys = keys[0], keys[1:]
+                    gens[group] = tuple_gen(k, self.fgen(k))
+                    continue
+                gens[group] = None
+                break
+        if soonest is not None and soonest["op"] is not gen.PENDING:
+            out = list(gens)
+            out[soonest["group"]] = soonest["gen"]
+            return soonest["op"], ConcurrentGenerator(
+                self.n, self.fgen, keys, gt, tg, out)
+        if any(g is not None for g in gens):
+            # busy groups may still have ops
+            return gen.PENDING, ConcurrentGenerator(
+                self.n, self.fgen, keys, gt, tg, gens)
+        return None
+
+    def update(self, test, ctx, event):
+        if self.thread_group is None:
+            return self  # not initialized yet; nothing to route
+        thread = ctx.process_to_thread(event.get("process"))
+        group = self.thread_group.get(thread)
+        if group is None or self.gens is None:
+            return self
+        gens = list(self.gens)
+        gens[group] = gen.gen_update(gens[group], test, ctx, event)
+        return ConcurrentGenerator(self.n, self.fgen, self.keys,
+                                   self.group_threads, self.thread_group, gens)
+
+
+
+def concurrent_generator(n: int, keys: Iterable, fgen: Callable):
+    """Groups of n client threads per key; nemesis excluded by design
+    (independent.clj:211-236)."""
+    assert n > 0 and isinstance(n, int)
+    return gen.clients(ConcurrentGenerator(n, fgen, keys))
+
+
+# ------------------------------------------------------------ analysis
+
+
+def history_keys(history) -> list:
+    """The set of KV keys in a history, in first-seen order
+    (independent.clj:238-248)."""
+    seen = set()
+    out = []
+    for o in history:
+        v = o.get("value")
+        if isinstance(v, KV) and v.key not in seen:
+            seen.add(v.key)
+            out.append(v.key)
+    return out
+
+
+def subhistory(k, history) -> History:
+    """All ops without a differing key, tuples unwrapped
+    (independent.clj:250-261). Un-keyed ops (nemesis, logging) appear in
+    every subhistory."""
+    out = History()
+    for o in history:
+        v = o.get("value")
+        if not isinstance(v, KV):
+            out.append(o)
+        elif v.key == k:
+            o2 = Op(o)
+            o2["value"] = v.value
+            out.append(o2)
+    return out
+
+
+class IndependentChecker(Checker):
+    """Lifts a checker over per-key subhistories: valid iff valid for
+    all keys; results under {"results": {k: ...}, "failures": [...]}
+    (independent.clj:263-314).
+
+    When the wrapped checker is a device-capable Linearizable, the keys
+    are checked as one batched device program (the P5 batch axis)
+    rather than one host search per key."""
+
+    def __init__(self, checker: Checker, batch_device: bool = True):
+        self.checker = checker
+        self.batch_device = batch_device
+
+    def check(self, test, history, opts=None):
+        opts = opts or {}
+        ks = history_keys(history)
+        subs = {k: subhistory(k, history) for k in ks}
+
+        results = self._batched_device_results(test, subs)
+        if results is None:
+            pairs = bounded_pmap(
+                lambda k: (k, check_safe(
+                    self.checker, test, subs[k],
+                    {**opts,
+                     "subdirectory": list(opts.get("subdirectory", []))
+                     + [DIR, k],
+                     "history-key": k})),
+                ks)
+            results = dict(pairs)
+
+        self._persist(test, opts, subs, results)
+        failures = [k for k, r in results.items() if r.get("valid?") is not True]
+        return {
+            "valid?": merge_valid(r.get("valid?") for r in results.values()),
+            "results": results,
+            "failures": failures,
+        }
+
+    # -- device batch fast path
+    def _batched_device_results(self, test, subs) -> Optional[dict]:
+        from jepsen_tpu.checker.linearizable import Linearizable
+        c = self.checker
+        if not (self.batch_device and isinstance(c, Linearizable)
+                and c.algorithm in ("jax", "competition") and subs):
+            return None
+        model = c.model or (test or {}).get("model")
+        if model is None:
+            return None
+        try:
+            from jepsen_tpu import models as model_ns
+            from jepsen_tpu.history import Intern
+            from jepsen_tpu.parallel import engine
+            if model_ns.pack_spec(model, Intern()) is None:
+                return None
+            ks = list(subs)
+            rs = engine.check_batch(model, [subs[k] for k in ks])
+            return {k: {**r, "analyzer": "jax"} for k, r in zip(ks, rs)}
+        except Exception:  # noqa: BLE001 - fall back to host per-key path
+            return None
+
+    # -- results/history persistence per key (independent.clj:292-300)
+    def _persist(self, test, opts, subs, results):
+        store = (test or {}).get("store")
+        if store is None:
+            return
+        for k in subs:
+            try:
+                store.write_file([DIR, str(k), "results.edn"],
+                                 _edn_pprint(results[k]))
+                store.write_file([DIR, str(k), "history.edn"],
+                                 subs[k].to_edn())
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def _edn_pprint(x) -> str:
+    from jepsen_tpu import edn
+    return edn.dumps(x) + "\n"
+
+
+def checker(c: Checker, batch_device: bool = True) -> IndependentChecker:
+    return IndependentChecker(c, batch_device)

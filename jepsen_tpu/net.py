@@ -1,0 +1,181 @@
+"""Network fault plane (reference: jepsen/src/jepsen/net.clj).
+
+The `Net` protocol (net.clj:15-26): drop / heal / slow / flaky / fast,
+plus the `PartitionAll` fast path `drop_all(grudge)` applying a whole
+grudge map at once (net/proto.clj:5-12). Implementations:
+
+    IPTables  iptables for drops + tc/netem for latency/loss
+              (net.clj:58-111) — the production impl on Linux nodes
+    MemNet    an in-memory connectivity matrix for tests and the
+              in-process fake cluster (no root, no iptables); clients
+              may consult `reachable` to simulate partitions
+    NoopNet   ignores everything (net.clj:48-56)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence
+
+from jepsen_tpu import control as c
+from jepsen_tpu.control import RemoteError, lit
+
+
+class Net:
+    def drop(self, test, src, dest):
+        """Drop traffic from src to dest."""
+        raise NotImplementedError
+
+    def drop_all(self, test, grudge: Dict):
+        """Apply a whole grudge map {node: [nodes-to-drop-from]} at once
+        (net/proto.clj:5-12 PartitionAll); default = per-edge drops."""
+        for node, drop_from in (grudge or {}).items():
+            for src in drop_from:
+                self.drop(test, src, node)
+
+    def heal(self, test):
+        """End all partitions / faults."""
+        raise NotImplementedError
+
+    def slow(self, test, opts: Optional[dict] = None):
+        """Add latency to the network (net.clj:21-23)."""
+        raise NotImplementedError
+
+    def flaky(self, test):
+        """Introduce probabilistic loss (net.clj:24-25)."""
+        raise NotImplementedError
+
+    def fast(self, test):
+        """Remove slow/flaky shaping (net.clj:26)."""
+        raise NotImplementedError
+
+
+class NoopNet(Net):
+    def drop(self, test, src, dest):
+        pass
+
+    def heal(self, test):
+        pass
+
+    def slow(self, test, opts=None):
+        pass
+
+    def flaky(self, test):
+        pass
+
+    def fast(self, test):
+        pass
+
+
+def noop() -> NoopNet:
+    return NoopNet()
+
+
+class IPTables(Net):
+    """iptables drops + tc netem shaping (net.clj:58-111). Runs on each
+    node through the control session."""
+
+    def drop(self, test, src, dest):
+        c.on_nodes(test, lambda t, n: c.exec_(
+            "iptables", "-A", "INPUT", "-s", _ip(src), "-j", "DROP",
+            "-w"), [dest])
+
+    def drop_all(self, test, grudge):
+        def apply(t, node):
+            drop_from = grudge.get(node) or []
+            if not drop_from:
+                return
+            # one iptables invocation per node, comma-joined sources
+            # (net.clj:92-99 batched grudge fast path)
+            srcs = ",".join(_ip(s) for s in drop_from)
+            c.exec_("iptables", "-A", "INPUT", "-s", srcs, "-j", "DROP",
+                    "-w")
+        c.on_nodes(test, apply, list(grudge or {}))
+
+    def heal(self, test):
+        def h(t, node):
+            c.exec_("iptables", "-F", "-w")
+            c.exec_("iptables", "-X", "-w")
+        c.on_nodes(test, h)
+
+    def slow(self, test, opts=None):
+        o = opts or {}
+        mean = o.get("mean", 50)       # ms (net.clj:76-84 defaults)
+        variance = o.get("variance", 10)
+        dist = o.get("distribution", "normal")
+        c.on_nodes(test, lambda t, n: c.exec_(
+            "tc", "qdisc", "add", "dev", "eth0", "root", "netem", "delay",
+            f"{mean}ms", f"{variance}ms", "distribution", dist))
+
+    def flaky(self, test):
+        c.on_nodes(test, lambda t, n: c.exec_(
+            "tc", "qdisc", "add", "dev", "eth0", "root", "netem", "loss",
+            "20%", "75%"))
+
+    def fast(self, test):
+        def f(t, node):
+            try:
+                c.exec_("tc", "qdisc", "del", "dev", "eth0", "root")
+            except RemoteError:
+                pass  # no qdisc installed
+        c.on_nodes(test, f)
+
+
+def iptables() -> IPTables:
+    return IPTables()
+
+
+def _ip(node: str) -> str:
+    return node  # hostnames resolve on the nodes (control/net.clj:8-20)
+
+
+class MemNet(Net):
+    """In-memory connectivity matrix — the fault plane for the
+    in-process fake cluster. `reachable(src, dest)` is consulted by fake
+    clients to simulate partitions; slow/flaky set latency/loss knobs
+    the fake transport may honor."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.dropped: set = set()   # (src, dest) pairs
+        self.latency_ms: float = 0.0
+        self.loss: float = 0.0
+
+    def drop(self, test, src, dest):
+        with self.lock:
+            self.dropped.add((src, dest))
+
+    def drop_all(self, test, grudge):
+        with self.lock:
+            for node, drop_from in (grudge or {}).items():
+                for src in drop_from:
+                    self.dropped.add((src, node))
+
+    def heal(self, test):
+        with self.lock:
+            self.dropped.clear()
+
+    def slow(self, test, opts=None):
+        with self.lock:
+            self.latency_ms = (opts or {}).get("mean", 50)
+
+    def flaky(self, test):
+        with self.lock:
+            self.loss = 0.2
+
+    def fast(self, test):
+        with self.lock:
+            self.latency_ms = 0.0
+            self.loss = 0.0
+
+    def reachable(self, src, dest) -> bool:
+        with self.lock:
+            return (src, dest) not in self.dropped
+
+    def partitioned(self) -> bool:
+        with self.lock:
+            return bool(self.dropped)
+
+
+def mem() -> MemNet:
+    return MemNet()

@@ -1,0 +1,82 @@
+"""Operating-system setup protocol (reference: jepsen/src/jepsen/os.clj
++ os/debian.clj etc.).
+
+`OS` (os.clj:4-8): prepare a node's operating system before the DB is
+installed — package installs, hostfiles, users. The debian impl mirrors
+os/debian.clj:13-201 (apt pipeline + base packages); it requires a root
+session on a debian-family node and is exercised only against a real
+cluster."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from jepsen_tpu import control as c
+from jepsen_tpu.control import RemoteError, lit
+
+
+class OS:
+    def setup(self, test, node) -> None:
+        """Prepare the OS."""
+
+    def teardown(self, test, node) -> None:
+        """Clean up any OS changes."""
+
+
+class Noop(OS):
+    """Does nothing (os.clj:10-14)."""
+
+
+def noop() -> Noop:
+    return Noop()
+
+
+BASE_PACKAGES = [
+    # os/debian.clj:141-160 base package set (the subset that matters
+    # for running DB tarballs + nemeses)
+    "curl", "wget", "unzip", "iptables", "iputils-ping", "logrotate",
+    "man-db", "faketime", "ntpdate", "netcat-openbsd", "rsyslog", "psmisc",
+    "tar", "gzip",
+]
+
+
+class Debian(OS):
+    """Debian-family setup: noninteractive apt, hostfile, base packages
+    (os/debian.clj:13-201)."""
+
+    def __init__(self, extra_packages: Sequence[str] = ()):
+        self.extra_packages = list(extra_packages)
+
+    def setup(self, test, node):
+        with c.su():
+            self._hostfile(test, node)
+            c.exec_("env", "DEBIAN_FRONTEND=noninteractive",
+                    "apt-get", "install", "-y", "--no-install-recommends",
+                    *(BASE_PACKAGES + self.extra_packages))
+
+    def teardown(self, test, node):
+        pass
+
+    def _hostfile(self, test, node):
+        # os/debian.clj hostname wiring: every node resolves every
+        # other. IPs come from an explicit test["node-ips"] map when
+        # given (the usual case for fresh clusters with no DNS), else
+        # from resolution on the node itself. Failure to obtain an IP
+        # is an error -- writing a hostfile that silently omits peers
+        # is exactly the failure mode this exists to prevent.
+        nodes = test.get("nodes") or []
+        node_ips = test.get("node-ips") or {}
+        lines = ["127.0.0.1 localhost"]
+        for n in nodes:
+            ip = node_ips.get(n)
+            if ip is None:
+                out = c.exec_("getent", "hosts", n)  # raises on failure
+                ip = out.split()[0]
+            lines.append(f"{ip} {n}")
+        content = "\\n".join(lines)
+        c.exec_("bash", "-c", lit(c.escape(
+            f"printf '{content}\\n' > /etc/hosts")))
+
+
+def debian(extra_packages: Sequence[str] = ()) -> Debian:
+    return Debian(extra_packages)

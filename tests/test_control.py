@@ -1,0 +1,219 @@
+"""Control-plane tests: escaping, local/dummy remotes, fan-out, daemon
+helpers, net fault plane, db cycle (reference: control.clj /
+control/util.clj / net.clj / db.clj test strategy — dummy remote per
+SURVEY.md §4.5)."""
+
+import os
+import tempfile
+import time
+
+import pytest
+
+from jepsen_tpu import control as c
+from jepsen_tpu import db as jdb
+from jepsen_tpu import net as jnet
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.control import (
+    DummyRemote, LocalRemote, RemoteError, escape, lit,
+)
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.history import Op
+
+
+# ------------------------------------------------------------ escaping
+
+
+def test_escape_plain():
+    assert escape("foo") == "foo"
+    assert escape(42) == "42"
+    assert escape("a/b-c_d.e") == "a/b-c_d.e"
+
+
+def test_escape_quoting():
+    assert escape("hello world") == "'hello world'"
+    assert "it's" in __import__("shlex").split(escape("it's"))
+    assert escape("") == "''"
+
+
+def test_escape_lit_passthrough():
+    assert escape(lit("a | b")) == "a | b"
+
+
+def test_escape_nested_collection():
+    assert escape(["a", "b c"]) == "a 'b c'"
+
+
+# -------------------------------------------------------- local remote
+
+
+def local_session():
+    return LocalRemote().connect({"host": "localhost"})
+
+
+def test_local_exec():
+    with c.on_host(local_session(), "localhost"):
+        assert c.exec_("echo", "hello") == "hello"
+
+
+def test_local_exec_escaping():
+    with c.on_host(local_session(), "localhost"):
+        assert c.exec_("echo", "two words") == "two words"
+        assert c.exec_("printf", "%s", "a;b|c") == "a;b|c"
+
+
+def test_local_exec_error():
+    with c.on_host(local_session(), "localhost"):
+        with pytest.raises(RemoteError) as ei:
+            c.exec_("false")
+        assert ei.value.exit == 1
+
+
+def test_local_cd():
+    with c.on_host(local_session(), "localhost"):
+        with c.cd("/tmp"):
+            assert c.exec_("pwd") == "/tmp"
+
+
+def test_local_lit_pipeline():
+    with c.on_host(local_session(), "localhost"):
+        out = c.exec_("bash", "-c", "echo -e 'b\\na' | sort | head -1")
+        assert out == "a"
+
+
+def test_upload_download(tmp_path):
+    src = tmp_path / "src.txt"
+    src.write_text("payload")
+    dst = tmp_path / "dst.txt"
+    s = local_session()
+    s.upload([str(src)], str(dst))
+    assert dst.read_text() == "payload"
+    back = tmp_path / "back.txt"
+    s.download([str(dst)], str(back))
+    assert back.read_text() == "payload"
+
+
+# -------------------------------------------------------- dummy remote
+
+
+def test_dummy_remote_records():
+    d = DummyRemote()
+    with c.on_host(d.connect({}), "n1"):
+        assert c.exec_("rm", "-rf", "/") == ""  # harmless on a dummy
+    assert d.log == ["rm -rf /"]
+
+
+def test_remote_for_test_dummy():
+    t = {"ssh": {"dummy": True}}
+    assert isinstance(c.remote_for_test(t), DummyRemote)
+
+
+# -------------------------------------------------------------- fanout
+
+
+def test_on_nodes_parallel():
+    d = DummyRemote()
+    test = {"nodes": ["n1", "n2", "n3"], "remote": d}
+
+    def f(t, node):
+        return c.exec_("hostname") or node
+
+    out = c.on_nodes(test, f)
+    assert set(out) == {"n1", "n2", "n3"}
+
+
+def test_sessions_context():
+    test = {"nodes": ["n1", "n2"], "remote": DummyRemote()}
+    with c.with_sessions(test) as s:
+        assert set(s.sessions) == {"n1", "n2"}
+        s.on("n1", ["uptime"])
+    assert "sessions" not in test
+
+
+# ------------------------------------------------------ daemon helpers
+
+
+def test_daemon_lifecycle(tmp_path):
+    pidfile = str(tmp_path / "d.pid")
+    logfile = str(tmp_path / "d.log")
+    with c.on_host(local_session(), "localhost"):
+        started = cu.start_daemon(
+            {"pidfile": pidfile, "logfile": logfile, "chdir": "/tmp"},
+            "sleep", "30")
+        assert started
+        time.sleep(0.2)
+        assert cu.daemon_running(pidfile)
+        # second start is a no-op
+        assert not cu.start_daemon({"pidfile": pidfile}, "sleep", "30")
+        cu.stop_daemon(pidfile)
+        assert not cu.daemon_running(pidfile)
+        assert not os.path.exists(pidfile)
+
+
+def test_file_exists(tmp_path):
+    f = tmp_path / "x"
+    with c.on_host(local_session(), "localhost"):
+        assert not cu.file_exists(str(f))
+        f.write_text("1")
+        assert cu.file_exists(str(f))
+
+
+def test_await_tcp_port_timeout():
+    with c.on_host(local_session(), "localhost"):
+        with pytest.raises(TimeoutError):
+            cu.await_tcp_port(1, timeout_s=0.5, interval_s=0.1)
+
+
+# ----------------------------------------------------------- net + db
+
+
+def test_memnet_partition_via_nemesis():
+    net = jnet.mem()
+    test = {"nodes": ["n1", "n2", "n3", "n4", "n5"], "net": net}
+    p = nem.partition_random_halves().setup(test)
+    assert not net.partitioned()
+    r = p.invoke(test, Op({"type": "invoke", "f": "start", "value": None,
+                           "process": "nemesis"}))
+    assert r["type"] == "info"
+    assert net.partitioned()
+    # some cross-half pair is unreachable, intra-half reachable
+    dropped = net.dropped
+    assert dropped
+    r = p.invoke(test, Op({"type": "invoke", "f": "stop", "value": None,
+                           "process": "nemesis"}))
+    assert not net.partitioned()
+
+
+def test_majorities_ring_grudge_properties():
+    nodes = ["n1", "n2", "n3", "n4", "n5"]
+    g = nem.majorities_ring(nodes)
+    assert set(g) == set(nodes)
+    for node, dropped in g.items():
+        visible = set(nodes) - set(dropped)
+        assert node in visible
+        assert len(visible) >= 3  # every node sees a majority
+    # no two nodes see the same majority
+    views = {frozenset(set(nodes) - set(d)) for d in g.values()}
+    assert len(views) == len(nodes)
+
+
+def test_db_cycle_with_noop():
+    test = {"nodes": ["n1", "n2"], "remote": DummyRemote()}
+    jdb.cycle(jdb.noop(), test)
+
+
+def test_db_cycle_retries_setup_failed():
+    class Flaky(jdb.DB):
+        def __init__(self):
+            self.attempts = 0
+
+        def setup(self, test, node):
+            if node == "n1":
+                self.attempts += 1
+                if self.attempts < 3:
+                    raise jdb.SetupFailed("not yet")
+
+        def teardown(self, test, node):
+            pass
+
+    test = {"nodes": ["n1"], "remote": DummyRemote()}
+    jdb.cycle(Flaky(), test)  # succeeds on third attempt
