@@ -1,0 +1,196 @@
+"""Command-line runner (reference: jepsen/src/jepsen/cli.clj).
+
+Subcommands mirror `jepsen.cli/single-test-cmd` + serve
+(cli.clj:343-419, 324-341):
+
+    test      build a test from flags and run it
+    analyze   re-check the latest (or given) stored history
+    serve     browse stored results over HTTP
+
+Exit-code contract (cli.clj:120-130): 0 = valid, 1 = invalid,
+2 = unknown validity, 254 = bad arguments, 255 = crash.
+
+A suite supplies `run_cli(test_fn)` where (test_fn options) -> test map;
+options include the parsed flags below. The `--concurrency` flag accepts
+the reference's "3n" syntax — a multiple of the node count
+(cli.clj:55-102 parse-concurrency).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import traceback
+from typing import Callable, Dict, Optional
+
+from jepsen_tpu import core as jcore
+from jepsen_tpu import store as jstore
+from jepsen_tpu.history import History
+
+EXIT_VALID = 0
+EXIT_INVALID = 1
+EXIT_UNKNOWN = 2
+EXIT_BAD_ARGS = 254
+EXIT_CRASH = 255
+
+
+def parse_concurrency(s: str, n_nodes: int) -> int:
+    """'10' -> 10; '3n' -> 3 * node count (cli.clj:132-150)."""
+    s = str(s).strip()
+    if s.endswith("n"):
+        return int(s[:-1] or 1) * max(1, n_nodes)
+    return int(s)
+
+
+def parse_nodes(args) -> list:
+    if args.node:
+        return list(args.node)
+    if args.nodes_file:
+        with open(args.nodes_file) as fh:
+            return [ln.strip() for ln in fh if ln.strip()]
+    return ["n1", "n2", "n3", "n4", "n5"]  # cli.clj default node set
+
+
+def base_parser(prog: str = "jepsen") -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog=prog)
+    sub = p.add_subparsers(dest="command")
+
+    def common(sp):
+        sp.add_argument("--node", action="append",
+                        help="node name (repeatable)")
+        sp.add_argument("--nodes-file", help="file with one node per line")
+        sp.add_argument("--username", default="root")
+        sp.add_argument("--password", default="root")
+        sp.add_argument("--private-key-path")
+        sp.add_argument("--ssh-port", type=int, default=22)
+        sp.add_argument("--no-ssh", action="store_true",
+                        help="use the dummy remote (no cluster needed)")
+        sp.add_argument("--concurrency", default="1n",
+                        help="worker count; '3n' = 3 per node")
+        sp.add_argument("--time-limit", type=float, default=60,
+                        help="seconds of main workload")
+        sp.add_argument("--test-count", type=int, default=1)
+        sp.add_argument("--workload", default=None)
+        sp.add_argument("--nemesis", default=None)
+
+    t = sub.add_parser("test", help="run a test")
+    common(t)
+    a = sub.add_parser("analyze", help="re-check a stored history")
+    common(a)
+    a.add_argument("--run-dir", help="store/<name>/<timestamp> to re-check")
+    s = sub.add_parser("serve", help="serve stored results over HTTP")
+    s.add_argument("--port", type=int, default=8080)
+    s.add_argument("--host", default="0.0.0.0")
+    return p
+
+
+def options_from_args(args) -> Dict:
+    nodes = parse_nodes(args)
+    ssh = {
+        "username": args.username,
+        "password": args.password,
+        "port": args.ssh_port,
+        "private-key-path": args.private_key_path,
+        "dummy": bool(args.no_ssh),
+    }
+    return {
+        "nodes": nodes,
+        "ssh": ssh,
+        "concurrency": parse_concurrency(args.concurrency, len(nodes)),
+        "time-limit": args.time_limit,
+        "test-count": args.test_count,
+        "workload": args.workload,
+        "nemesis": args.nemesis,
+    }
+
+
+def validity_exit_code(results: Dict) -> int:
+    v = (results or {}).get("valid?")
+    if v is True:
+        return EXIT_VALID
+    if v is False:
+        return EXIT_INVALID
+    return EXIT_UNKNOWN
+
+
+def run_test_cmd(test_fn: Callable[[Dict], Dict], args) -> int:
+    opts = options_from_args(args)
+    for _ in range(opts["test-count"]):  # cli.clj:375-386 loop
+        test = test_fn(opts)
+        completed = jcore.run(test)
+        code = validity_exit_code(completed.get("results"))
+        print(json.dumps({"valid?": completed["results"].get("valid?"),
+                          "store": completed["store"].dir}, default=str))
+        if code != EXIT_VALID:
+            # exit on first non-valid run, as the reference does
+            return code
+    return EXIT_VALID
+
+
+def run_analyze_cmd(test_fn: Callable[[Dict], Dict], args) -> int:
+    """Reload the latest stored run and re-check it against a freshly
+    built test map (cli.clj:388-419)."""
+    run_dir = args.run_dir or jstore.latest()
+    if run_dir is None:
+        print("no stored runs to analyze", file=sys.stderr)
+        return EXIT_BAD_ARGS
+    stored = jstore.load_run(run_dir)
+    history = stored.get("history")
+    if history is None:
+        print(f"no history.edn under {run_dir}", file=sys.stderr)
+        return EXIT_BAD_ARGS
+    opts = options_from_args(args)
+    test = test_fn(opts)
+    # merge stored test config under the fresh test map (cli.clj:396-400)
+    for k, v in (stored.get("test") or {}).items():
+        test.setdefault(k, v)
+    results = jcore.analyze(test, History.wrap(history))
+    print(json.dumps({"valid?": results.get("valid?"), "run": run_dir},
+                     default=str))
+    return validity_exit_code(results)
+
+
+def run_serve_cmd(args) -> int:
+    from jepsen_tpu import web
+    web.serve(host=args.host, port=args.port)
+    return EXIT_VALID
+
+
+def run_cli(test_fn: Optional[Callable[[Dict], Dict]] = None,
+            argv: Optional[list] = None, prog: str = "jepsen") -> int:
+    """Main dispatcher (cli.clj:246-322). test_fn builds a test map from
+    parsed options; defaults to the noop test."""
+    if test_fn is None:
+        test_fn = lambda opts: jcore.make_test(  # noqa: E731
+            {"nodes": opts["nodes"], "ssh": opts["ssh"],
+             "concurrency": opts["concurrency"]})
+    parser = base_parser(prog)
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        return EXIT_BAD_ARGS if e.code not in (0, None) else 0
+    if args.command is None:
+        parser.print_help()
+        return EXIT_BAD_ARGS
+    try:
+        if args.command == "test":
+            return run_test_cmd(test_fn, args)
+        if args.command == "analyze":
+            return run_analyze_cmd(test_fn, args)
+        if args.command == "serve":
+            return run_serve_cmd(args)
+        return EXIT_BAD_ARGS
+    except KeyboardInterrupt:
+        raise
+    except Exception:  # noqa: BLE001
+        traceback.print_exc()
+        return EXIT_CRASH
+
+
+def main(argv: Optional[list] = None) -> int:
+    return run_cli(None, argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

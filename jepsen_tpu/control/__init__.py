@@ -297,6 +297,12 @@ class SshRemote(Remote):
         s = self.spec
         argv = [prog, "-o", "StrictHostKeyChecking=no",
                 "-o", "UserKnownHostsFile=/dev/null", "-o", "LogLevel=ERROR"]
+        if s.get("password") and shutil.which("sshpass"):
+            # password auth rides sshpass; without it, BatchMode below
+            # fails fast instead of hanging on an interactive prompt
+            argv = ["sshpass", "-p", s["password"], *argv]
+        else:
+            argv += ["-o", "BatchMode=yes"]
         if s.get("port"):
             argv += (["-P", str(s["port"])] if prog == "scp"
                      else ["-p", str(s["port"])])

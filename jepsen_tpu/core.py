@@ -1,0 +1,187 @@
+"""Test orchestration: the full lifecycle (reference:
+jepsen/src/jepsen/core.clj `run!`, core.clj:276-382).
+
+A *test map* is one flat dict carrying config and live objects alike
+(the contract documented at core.clj:277-300):
+
+    name         test name for the store directory
+    nodes        list of node names
+    concurrency  number of client worker threads
+    ssh / remote transport config (ssh: {"dummy": True} for no cluster)
+    os           OS protocol impl (jepsen_tpu.os)
+    db           DB protocol impl (jepsen_tpu.db)
+    net          Net protocol impl (jepsen_tpu.net)
+    client       Client protocol impl
+    nemesis      Nemesis protocol impl
+    generator    the workload
+    checker      Checker protocol impl
+    model        optional model for checkers
+
+`run(test)` executes the 10-step lifecycle: logging, sessions, OS setup,
+DB cycle, client/nemesis setup, interpreter, log snarfing, teardown,
+history save, analysis. `analyze(test, history)` is the re-check path
+(core.clj:223-238) — the fastest dev loop, no cluster needed.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from jepsen_tpu import control as c
+from jepsen_tpu import db as jdb
+from jepsen_tpu import store as jstore
+from jepsen_tpu.checker.core import check_safe
+from jepsen_tpu.generator import interpreter
+from jepsen_tpu.history import History
+from jepsen_tpu.util import real_pmap, reset_relative_time
+
+log = logging.getLogger("jepsen")
+
+
+DEFAULTS: Dict[str, Any] = {
+    # tests.clj:12-25 noop-test defaults
+    "name": "noop",
+    "nodes": ["n1", "n2", "n3", "n4", "n5"],
+    "concurrency": 5,
+    "ssh": {"dummy": True},
+}
+
+
+def make_test(overrides: Optional[Dict] = None) -> Dict:
+    """Base test map merged with overrides (tests.clj:12-25 pattern)."""
+    from jepsen_tpu import client as jclient
+    from jepsen_tpu import net as jnet
+    from jepsen_tpu import nemesis as jnemesis
+    from jepsen_tpu import os as jos
+    from jepsen_tpu.checker.core import noop as noop_checker
+
+    t = dict(DEFAULTS)
+    t.update({
+        "os": jos.noop(),
+        "db": jdb.noop(),
+        "net": jnet.noop(),
+        "client": jclient.noop(),
+        "nemesis": jnemesis.noop(),
+        "generator": None,
+        "checker": noop_checker(),
+    })
+    t.update(overrides or {})
+    return t
+
+
+def primary(test: Dict):
+    """The first node (core.clj:66-69)."""
+    nodes = test.get("nodes") or []
+    return nodes[0] if nodes else None
+
+
+def snarf_logs(test: Dict):
+    """Download DB log files from each node into the store
+    (core.clj:103-149)."""
+    db = test.get("db")
+    store: Optional[jstore.Store] = test.get("store")
+    if store is None or db is None:
+        return
+    lf = getattr(db, "log_files", None)
+    if lf is None:
+        return
+
+    def snarf(t, node):
+        for path in lf(test, node) or []:
+            try:
+                c.download([path], store.path(node, path.split("/")[-1]))
+            except Exception as e:  # noqa: BLE001
+                log.warning("couldn't snarf %s from %s: %s", path, node, e)
+
+    c.on_nodes(test, snarf)
+
+
+def run_case(test: Dict) -> History:
+    """Client/nemesis setup, interpreter, teardown (core.clj:182-221)."""
+    client = test.get("client")
+    nemesis = test.get("nemesis")
+    nodes = test.get("nodes") or [None]
+
+    # open + setup one client per node (core.clj:182-199)
+    setup_clients = []
+    try:
+        if client is not None:
+            setup_clients = real_pmap(
+                lambda n: client.open(test, n), nodes)
+            for cl in setup_clients[:1]:
+                cl.setup(test)  # setup once (client.clj contract)
+        if nemesis is not None:
+            test["nemesis"] = nemesis = nemesis.setup(test)
+
+        return interpreter.run(test)
+    finally:
+        try:
+            if nemesis is not None:
+                nemesis.teardown(test)
+        finally:
+            for cl in setup_clients[:1]:
+                try:
+                    cl.teardown(test)
+                except Exception:  # noqa: BLE001
+                    pass
+            for cl in setup_clients:
+                try:
+                    cl.close(test)
+                except Exception:  # noqa: BLE001
+                    pass
+
+
+def analyze(test: Dict, history: History) -> Dict:
+    """Index the history, run the checker, persist results
+    (core.clj:223-238)."""
+    history.index()
+    checker = test.get("checker")
+    if checker is None:
+        results = {"valid?": True}
+    else:
+        results = check_safe(checker, test, history)
+    store: Optional[jstore.Store] = test.get("store")
+    if store is not None:
+        store.save_2(results)
+    test["results"] = results
+    return results
+
+
+def run(test: Dict) -> Dict:
+    """The full lifecycle (core.clj:276-382). Returns the completed test
+    map with :history and :results."""
+    test = dict(test)
+    store = jstore.Store(test.get("name", "test"))
+    test["store"] = store
+    store.start_logging()
+    reset_relative_time()
+    log.info("Running test: %s", test.get("name"))
+    try:
+        with c.with_sessions(test):
+            os_ = test.get("os")
+            db = test.get("db")
+            try:
+                if os_ is not None:
+                    c.on_nodes(test, os_.setup)
+                if db is not None:
+                    jdb.cycle(db, test)
+                history = run_case(test)
+                log.info("Run complete, writing history")
+                test["history"] = history
+                store.save_1(test, history)
+                snarf_logs(test)
+            finally:
+                try:
+                    if db is not None:
+                        c.on_nodes(test, db.teardown)
+                    if os_ is not None:
+                        c.on_nodes(test, os_.teardown)
+                except Exception as e:  # noqa: BLE001
+                    log.warning("teardown failed: %s", e)
+        log.info("Analyzing history")
+        results = analyze(test, test["history"])
+        log.info("Analysis complete: valid? = %s", results.get("valid?"))
+        return test
+    finally:
+        store.stop_logging()

@@ -102,16 +102,44 @@ def _group_threads(n: int, ctx: gen.Ctx):
     return [threads[i * n:(i + 1) * n] for i in range(groups)]
 
 
+class LazyKeys:
+    """Memoized view over a (possibly infinite) key iterable. Generator
+    instances hold an *index* into the shared cache, so a discarded
+    generator branch (the soonest-op race in gen.any calls op on every
+    alternative and keeps one) never consumes keys — pulling index i
+    always yields the same key. Thread-safe."""
+
+    def __init__(self, iterable):
+        self._it = iter(iterable)
+        self._cache: list = []
+        self._done = False
+        self._lock = __import__("threading").Lock()
+
+    def get(self, i: int):
+        """Key at index i, or None past the end."""
+        with self._lock:
+            while len(self._cache) <= i and not self._done:
+                try:
+                    self._cache.append(next(self._it))
+                except StopIteration:
+                    self._done = True
+            return self._cache[i] if i < len(self._cache) else None
+
+    def has(self, i: int) -> bool:
+        return self.get(i) is not None
+
+
 class ConcurrentGenerator(gen.Generator):
     """Splits client threads into groups of n; each group works one key;
-    exhausted groups lazily pull the next key
-    (independent.clj:101-236)."""
+    exhausted groups lazily pull the next key. Key sequences may be
+    infinite (independent.clj:101-236)."""
 
-    def __init__(self, n, fgen, keys, group_threads=None, thread_group=None,
-                 gens=None):
+    def __init__(self, n, fgen, keys, key_idx=0, group_threads=None,
+                 thread_group=None, gens=None):
         self.n = n
         self.fgen = fgen
-        self.keys = list(keys)
+        self.keys = keys if isinstance(keys, LazyKeys) else LazyKeys(keys)
+        self.key_idx = key_idx  # next unconsumed key index
         self.group_threads = group_threads  # list[list[thread]]
         self.thread_group = thread_group    # {thread: group}
         self.gens = gens                    # list[gen|None] per group
@@ -119,16 +147,21 @@ class ConcurrentGenerator(gen.Generator):
     def _init(self, ctx):
         gt = self.group_threads or _group_threads(self.n, ctx)
         tg = self.thread_group or {t: g for g, ts in enumerate(gt) for t in ts}
-        keys = self.keys
+        idx = self.key_idx
         gens = self.gens
         if gens is None:
-            gens = [tuple_gen(k, self.fgen(k)) for k in keys[:len(gt)]]
-            gens += [None] * (len(gt) - len(gens))
-            keys = keys[len(gt):]
-        return gt, tg, keys, gens
+            gens = []
+            for _ in range(len(gt)):
+                k = self.keys.get(idx)
+                if k is None:
+                    gens.append(None)
+                else:
+                    gens.append(tuple_gen(k, self.fgen(k)))
+                    idx += 1
+        return gt, tg, idx, gens
 
     def op(self, test, ctx):
-        gt, tg, keys, gens = self._init(ctx)
+        gt, tg, idx, gens = self._init(ctx)
         free_groups = {tg[t] for t in ctx.free_threads if t in tg}
         soonest = None
         gens = list(gens)
@@ -144,8 +177,9 @@ class ConcurrentGenerator(gen.Generator):
                                   "weight": len(gt[group])})
                     break
                 # exhausted: replace with next key's generator, if any
-                if keys:
-                    k, keys = keys[0], keys[1:]
+                k = self.keys.get(idx)
+                if k is not None:
+                    idx += 1
                     gens[group] = tuple_gen(k, self.fgen(k))
                     continue
                 gens[group] = None
@@ -154,11 +188,11 @@ class ConcurrentGenerator(gen.Generator):
             out = list(gens)
             out[soonest["group"]] = soonest["gen"]
             return soonest["op"], ConcurrentGenerator(
-                self.n, self.fgen, keys, gt, tg, out)
+                self.n, self.fgen, self.keys, idx, gt, tg, out)
         if any(g is not None for g in gens):
             # busy groups may still have ops
             return gen.PENDING, ConcurrentGenerator(
-                self.n, self.fgen, keys, gt, tg, gens)
+                self.n, self.fgen, self.keys, idx, gt, tg, gens)
         return None
 
     def update(self, test, ctx, event):
@@ -171,7 +205,8 @@ class ConcurrentGenerator(gen.Generator):
         gens = list(self.gens)
         gens[group] = gen.gen_update(gens[group], test, ctx, event)
         return ConcurrentGenerator(self.n, self.fgen, self.keys,
-                                   self.group_threads, self.thread_group, gens)
+                                   self.key_idx, self.group_threads,
+                                   self.thread_group, gens)
 
 
 
@@ -196,6 +231,29 @@ def history_keys(history) -> list:
             seen.add(v.key)
             out.append(v.key)
     return out
+
+
+def split_history(history) -> dict:
+    """One pass over the history, bucketing ops per key (un-keyed ops go
+    to every bucket): O(ops + keys), vs. calling subhistory once per key
+    which is O(keys * ops). Returns {k: History} in first-seen order."""
+    subs: dict = {}
+    unkeyed: list = []  # prefix of un-keyed ops for late-appearing keys
+    for o in history:
+        v = o.get("value")
+        if not isinstance(v, KV):
+            unkeyed.append(o)
+            for h in subs.values():
+                h.append(o)
+        else:
+            k = v.key
+            h = subs.get(k)
+            if h is None:
+                h = subs[k] = History(unkeyed)
+            o2 = Op(o)
+            o2["value"] = v.value
+            h.append(o2)
+    return subs
 
 
 def subhistory(k, history) -> History:
@@ -229,8 +287,8 @@ class IndependentChecker(Checker):
 
     def check(self, test, history, opts=None):
         opts = opts or {}
-        ks = history_keys(history)
-        subs = {k: subhistory(k, history) for k in ks}
+        subs = split_history(history)
+        ks = list(subs)
 
         results = self._batched_device_results(test, subs)
         if results is None:
@@ -245,7 +303,9 @@ class IndependentChecker(Checker):
             results = dict(pairs)
 
         self._persist(test, opts, subs, results)
-        failures = [k for k, r in results.items() if r.get("valid?") is not True]
+        # only proven-invalid keys; "unknown" (e.g. a crashed per-key
+        # checker) is not a failure (independent.clj:305-311)
+        failures = [k for k, r in results.items() if r.get("valid?") is False]
         return {
             "valid?": merge_valid(r.get("valid?") for r in results.values()),
             "results": results,

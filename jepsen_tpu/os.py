@@ -51,6 +51,8 @@ class Debian(OS):
         with c.su():
             self._hostfile(test, node)
             c.exec_("env", "DEBIAN_FRONTEND=noninteractive",
+                    "apt-get", "update")
+            c.exec_("env", "DEBIAN_FRONTEND=noninteractive",
                     "apt-get", "install", "-y", "--no-install-recommends",
                     *(BASE_PACKAGES + self.extra_packages))
 

@@ -1,0 +1,186 @@
+"""Test persistence (reference: jepsen/src/jepsen/store.clj).
+
+Run directories live under `store/<test-name>/<timestamp>/`
+(store.clj:118-147) with `latest` and `current` symlinks
+(store.clj:307-333). Each run persists:
+
+    history.edn / history.txt   the op history (store.clj:351-362)
+    test.json                   the serializable slice of the test map
+                                (the fressian analogue; live objects are
+                                stripped per store.clj:160-168)
+    results.edn / results.json  checker output (save-2!, store.clj:385-397)
+    jepsen.log                  the run log
+
+`save_1` persists the history BEFORE analysis so a crashed checker never
+loses it (core.clj:374-376); `save_2` adds results.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import os
+import shutil
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu import edn
+from jepsen_tpu.history import History
+
+BASE_DIR = "store"
+
+NONSERIALIZABLE_KEYS = (
+    # live objects stripped before writing (store.clj:160-168)
+    "db", "os", "net", "client", "checker", "nemesis", "generator", "model",
+    "remote", "sessions", "store", "control",
+    # big run artifacts with their own files (history.edn / results.edn)
+    "history", "results",
+)
+
+
+def _sanitize(name: str) -> str:
+    return "".join(ch if (ch.isalnum() or ch in "-_. ") else "_"
+                   for ch in str(name)).strip() or "test"
+
+
+class Store:
+    """One run's directory with writers for history/results/files."""
+
+    def __init__(self, test_name: str, base_dir: str = BASE_DIR,
+                 time: Optional[_dt.datetime] = None):
+        self.test_name = _sanitize(test_name)
+        t = time or _dt.datetime.now()
+        self.timestamp = t.strftime("%Y%m%dT%H%M%S.%f")[:-3]
+        self.dir = os.path.join(base_dir, self.test_name, self.timestamp)
+        os.makedirs(self.dir, exist_ok=True)
+        self._update_symlinks(base_dir)
+
+    def _update_symlinks(self, base_dir: str):
+        # store.clj:307-333 `latest` per test + global `current`
+        for link_dir, name in ((os.path.join(base_dir, self.test_name),
+                                "latest"),
+                               (base_dir, "current")):
+            link = os.path.join(link_dir, name)
+            try:
+                if os.path.islink(link):
+                    os.unlink(link)
+                os.symlink(os.path.relpath(self.dir, link_dir), link)
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------ paths
+    def path(self, *parts) -> str:
+        p = os.path.join(self.dir, *[_sanitize(str(x)) for x in parts])
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        return p
+
+    def write_file(self, parts: List, content: str):
+        with open(self.path(*parts), "w") as fh:
+            fh.write(content)
+
+    # ------------------------------------------------------------ saves
+    def save_1(self, test: Dict, history: History):
+        """History + test map — before analysis (store.clj:372-383)."""
+        history.save(self.path("history.edn"))
+        self.write_file(["history.txt"],
+                        "\n".join(_op_line(o) for o in history) + "\n")
+        self.write_file(["test.json"],
+                        json.dumps(serializable_test(test), indent=2,
+                                   default=str))
+
+    def save_2(self, results: Dict):
+        """Results — after analysis (store.clj:385-397)."""
+        self.write_file(["results.edn"], edn.dumps(results) + "\n")
+        self.write_file(["results.json"],
+                        json.dumps(results, indent=2, default=str))
+
+    # ---------------------------------------------------------- logging
+    def start_logging(self) -> logging.Logger:
+        """Console + per-run jepsen.log (store.clj:399-439)."""
+        logger = logging.getLogger("jepsen")
+        logger.setLevel(logging.INFO)
+        fh = logging.FileHandler(self.path("jepsen.log"))
+        fh.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s [%(threadName)s] %(message)s"))
+        logger.addHandler(fh)
+        self._log_handler = fh
+        return logger
+
+    def stop_logging(self):
+        h = getattr(self, "_log_handler", None)
+        if h is not None:
+            logging.getLogger("jepsen").removeHandler(h)
+            h.close()
+
+
+def _op_line(o) -> str:
+    return (f"{o.get('index', ''):>8} "
+            f"{str(o.get('process', '')):>8} "
+            f"{o.get('type', ''):>8} "
+            f"{o.get('f', '')!s:>12}  {o.get('value')!r}"
+            + (f"  {o.get('error')}" if o.get("error") else ""))
+
+
+def serializable_test(test: Dict) -> Dict:
+    return {k: v for k, v in (test or {}).items()
+            if k not in NONSERIALIZABLE_KEYS}
+
+
+# ------------------------------------------------------------- loading
+
+
+def tests(base_dir: str = BASE_DIR) -> Dict[str, List[str]]:
+    """Map of test-name -> sorted run timestamps."""
+    out: Dict[str, List[str]] = {}
+    if not os.path.isdir(base_dir):
+        return out
+    for name in sorted(os.listdir(base_dir)):
+        d = os.path.join(base_dir, name)
+        if name == "current" or not os.path.isdir(d) or os.path.islink(d):
+            continue
+        runs = sorted(r for r in os.listdir(d)
+                      if not os.path.islink(os.path.join(d, r)))
+        if runs:
+            out[name] = runs
+    return out
+
+
+def latest(base_dir: str = BASE_DIR) -> Optional[str]:
+    """Directory of the most recent run (store.clj:296-305)."""
+    link = os.path.join(base_dir, "current")
+    if os.path.islink(link):
+        target = os.path.join(base_dir, os.readlink(link))
+        if os.path.isdir(target):
+            return target
+    best = None
+    for name, runs in tests(base_dir).items():
+        for r in runs:
+            d = os.path.join(base_dir, name, r)
+            if best is None or r > os.path.basename(best):
+                best = d
+    return best
+
+
+def load_run(run_dir: str) -> Dict[str, Any]:
+    """Reload a stored run: {test, history, results?}."""
+    out: Dict[str, Any] = {"dir": run_dir}
+    tpath = os.path.join(run_dir, "test.json")
+    if os.path.exists(tpath):
+        with open(tpath) as fh:
+            out["test"] = json.load(fh)
+    hpath = os.path.join(run_dir, "history.edn")
+    if os.path.exists(hpath):
+        out["history"] = History.load(hpath)
+    rpath = os.path.join(run_dir, "results.json")
+    if os.path.exists(rpath):
+        with open(rpath) as fh:
+            out["results"] = json.load(fh)
+    return out
+
+
+def delete(test_name: Optional[str] = None, base_dir: str = BASE_DIR):
+    """Remove stored runs (store.clj delete!)."""
+    target = (os.path.join(base_dir, _sanitize(test_name))
+              if test_name else base_dir)
+    if os.path.isdir(target):
+        shutil.rmtree(target)

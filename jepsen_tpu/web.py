@@ -1,0 +1,151 @@
+"""Results browser (reference: jepsen/src/jepsen/web.clj).
+
+A small HTTP server over the store directory: a home page listing every
+run with its validity (web.clj:48-122), a file browser for run
+directories (web.clj:258-276), and zip download of a whole run
+(web.clj:277-356). Standard library only."""
+
+from __future__ import annotations
+
+import html
+import io
+import json
+import os
+import zipfile
+from http.server import HTTPServer, SimpleHTTPRequestHandler
+from typing import Optional
+from urllib.parse import unquote
+
+from jepsen_tpu import store as jstore
+
+
+def _run_validity(run_dir: str):
+    p = os.path.join(run_dir, "results.json")
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p) as fh:
+            return json.load(fh).get("valid?")
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
+def home_html(base_dir: str) -> str:
+    rows = []
+    for name, runs in sorted(jstore.tests(base_dir).items()):
+        for r in sorted(runs, reverse=True):
+            d = os.path.join(base_dir, name, r)
+            v = _run_validity(d)
+            color = {True: "#9f9", False: "#f99", None: "#eee"}.get(
+                v, "#ff9")
+            rows.append(
+                f"<tr style='background:{color}'>"
+                f"<td>{html.escape(name)}</td>"
+                f"<td><a href='/files/{html.escape(name)}/{html.escape(r)}/'>"
+                f"{html.escape(r)}</a></td>"
+                f"<td>{html.escape(str(v))}</td>"
+                f"<td><a href='/zip/{html.escape(name)}/{html.escape(r)}'>"
+                f"zip</a></td></tr>")
+    return ("<html><head><title>jepsen_tpu</title></head><body>"
+            "<h1>Tests</h1><table border=1 cellpadding=4>"
+            "<tr><th>test</th><th>run</th><th>valid?</th><th></th></tr>"
+            + "".join(rows) + "</table></body></html>")
+
+
+def dir_html(base_dir: str, rel: str) -> str:
+    d = os.path.join(base_dir, rel)
+    entries = sorted(os.listdir(d))
+    items = "".join(
+        f"<li><a href='/files/{html.escape(rel)}/{html.escape(e)}"
+        f"{'/' if os.path.isdir(os.path.join(d, e)) else ''}'>"
+        f"{html.escape(e)}</a></li>"
+        for e in entries)
+    return (f"<html><body><h1>{html.escape(rel)}</h1>"
+            f"<p><a href='/'>home</a></p><ul>{items}</ul></body></html>")
+
+
+def zip_run(base_dir: str, rel: str) -> bytes:
+    root = os.path.join(base_dir, rel)
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for dirpath, _dirs, files in os.walk(root):
+            for f in files:
+                full = os.path.join(dirpath, f)
+                z.write(full, os.path.relpath(full, os.path.dirname(root)))
+    return buf.getvalue()
+
+
+class Handler(SimpleHTTPRequestHandler):
+    base_dir = jstore.BASE_DIR
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _send(self, code: int, content: bytes,
+              ctype: str = "text/html; charset=utf-8",
+              extra: Optional[dict] = None):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(content)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(content)
+
+    def _safe_rel(self, rel: str) -> Optional[str]:
+        rel = unquote(rel).strip("/")
+        full = os.path.realpath(os.path.join(self.base_dir, rel))
+        base = os.path.realpath(self.base_dir)
+        try:
+            if os.path.commonpath([full, base]) != base:
+                return None  # path traversal
+        except ValueError:
+            return None
+        return rel
+
+    def do_GET(self):  # noqa: N802
+        path = self.path.split("?")[0]
+        if path in ("/", "/index.html"):
+            return self._send(200, home_html(self.base_dir).encode())
+        if path.startswith("/files/"):
+            rel = self._safe_rel(path[len("/files/"):])
+            if rel is None:
+                return self._send(403, b"forbidden")
+            full = os.path.join(self.base_dir, rel)
+            if os.path.isdir(full):
+                return self._send(200, dir_html(self.base_dir, rel).encode())
+            if os.path.isfile(full):
+                with open(full, "rb") as fh:
+                    data = fh.read()
+                ctype = ("text/plain; charset=utf-8"
+                         if not full.endswith((".png", ".svg", ".zip"))
+                         else self.guess_type(full))
+                return self._send(200, data, ctype)
+            return self._send(404, b"not found")
+        if path.startswith("/zip/"):
+            rel = self._safe_rel(path[len("/zip/"):])
+            if rel is None or not os.path.isdir(
+                    os.path.join(self.base_dir, rel)):
+                return self._send(404, b"not found")
+            data = zip_run(self.base_dir, rel)
+            name = rel.replace("/", "_") + ".zip"
+            return self._send(
+                200, data, "application/zip",
+                {"Content-Disposition": f"attachment; filename={name}"})
+        return self._send(404, b"not found")
+
+
+def serve(host: str = "0.0.0.0", port: int = 8080,
+          base_dir: str = jstore.BASE_DIR) -> None:
+    """Serve the store directory (web.clj:357 serve!). Blocks."""
+    Handler.base_dir = base_dir
+    httpd = HTTPServer((host, port), Handler)
+    print(f"jepsen_tpu web: http://{host}:{port}/")
+    httpd.serve_forever()
+
+
+def make_server(host: str = "127.0.0.1", port: int = 0,
+                base_dir: str = jstore.BASE_DIR) -> HTTPServer:
+    """Non-blocking variant for tests; caller drives serve_forever."""
+    Handler.base_dir = base_dir
+    return HTTPServer((host, port), Handler)

@@ -1,0 +1,69 @@
+"""Reusable workloads (reference: jepsen/src/jepsen/tests.clj and
+jepsen/src/jepsen/tests/*.clj).
+
+`noop_test` is the base test map; `atom_client` is the in-memory fake
+database (an atomic register implementing read/write/cas,
+tests.clj:27-67) that lets the full lifecycle run with no cluster."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from jepsen_tpu import core as jcore
+from jepsen_tpu.client import Client
+from jepsen_tpu.history import Op
+
+
+class AtomDB:
+    """Shared in-memory register state (tests.clj:27-40 atom-db)."""
+
+    def __init__(self, value=None):
+        self.lock = threading.Lock()
+        self.value = value
+
+
+class AtomClient(Client):
+    """read/write/cas against an AtomDB (tests.clj:42-67 atom-client).
+    Linearizable by construction — a useful control for checker tests."""
+
+    def __init__(self, db: Optional[AtomDB] = None):
+        self.db = db or AtomDB()
+
+    def open(self, test, node):
+        return AtomClient(self.db)
+
+    def invoke(self, test, op):
+        o = Op(op)
+        f = op.get("f")
+        with self.db.lock:
+            if f == "read":
+                o["type"] = "ok"
+                o["value"] = self.db.value
+            elif f == "write":
+                self.db.value = op.get("value")
+                o["type"] = "ok"
+            elif f == "cas":
+                old, new = op.get("value")
+                if self.db.value == old:
+                    self.db.value = new
+                    o["type"] = "ok"
+                else:
+                    o["type"] = "fail"
+            else:
+                raise ValueError(f"unknown f {f!r}")
+        return o
+
+    def is_reusable(self, test):
+        return True
+
+
+def atom_client(db: Optional[AtomDB] = None) -> AtomClient:
+    return AtomClient(db)
+
+
+def noop_test(overrides: Optional[Dict] = None) -> Dict:
+    """The base test map (tests.clj:12-25)."""
+    t = jcore.make_test({"name": "noop"})
+    t.update(overrides or {})
+    return t

@@ -1,0 +1,61 @@
+"""Linearizable register workload (reference:
+jepsen/src/jepsen/tests/linearizable_register.clj).
+
+Per-key cas-register test: reads, writes, and CAS ops over independent
+keys, checked with per-key linearizability. Knossos-era tractability
+caps: 20 ops per key, 20 processes per key by default
+(linearizable_register.clj:30-32,45-53) — the TPU engine raises the
+practical ceiling far beyond that, but the caps remain configurable."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, Optional
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+from jepsen_tpu.checker import linearizable
+from jepsen_tpu.checker.core import compose
+from jepsen_tpu.checker.suite import stats
+from jepsen_tpu.models import CASRegister
+
+
+def r(_test=None, _ctx=None):
+    return {"f": "read", "value": None}
+
+
+def w(_test=None, _ctx=None):
+    return {"f": "write", "value": gen.rand.randrange(5)}
+
+
+def cas(_test=None, _ctx=None):
+    return {"f": "cas",
+            "value": [gen.rand.randrange(5), gen.rand.randrange(5)]}
+
+
+def workload(opts: Optional[Dict] = None) -> Dict:
+    """{generator, checker, model} (linearizable_register.clj:22-53).
+    opts: concurrency-per-key (n), ops-per-key, process-limit,
+    algorithm."""
+    o = opts or {}
+    per_key = o.get("ops-per-key", 20)
+    n = o.get("concurrency-per-key", 2)
+    process_limit = o.get("process-limit", 20)
+    algorithm = o.get("algorithm", "competition")
+
+    def fgen(k):
+        g = gen.mix([r, w, cas])
+        g = gen.limit(per_key, g)
+        g = gen.process_limit(process_limit, g)
+        return g
+
+    keys = itertools.count()
+    return {
+        "generator": independent.concurrent_generator(n, keys, fgen),
+        "checker": compose({
+            "linear": independent.checker(
+                linearizable(CASRegister(), algorithm=algorithm)),
+            "stats": stats(),
+        }),
+        "model": CASRegister(),
+    }

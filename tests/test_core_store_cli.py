@@ -1,0 +1,219 @@
+"""Orchestration, persistence, CLI, and web tests (reference:
+jepsen/test/jepsen/core_test.clj with the dummy remote — SURVEY.md §4.5,
+store_test.clj, cli semantics cli.clj:120-130)."""
+
+import json
+import os
+import threading
+import urllib.request
+
+import pytest
+
+import jepsen_tpu.generator as gen
+from jepsen_tpu import cli as jcli
+from jepsen_tpu import core as jcore
+from jepsen_tpu import store as jstore
+from jepsen_tpu import web as jweb
+from jepsen_tpu.checker import linearizable
+from jepsen_tpu.checker.core import FnChecker
+from jepsen_tpu.history import History
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.workloads import AtomClient, linearizable_register
+
+
+@pytest.fixture(autouse=True)
+def store_in_tmp(tmp_path, monkeypatch):
+    monkeypatch.setattr(jstore, "BASE_DIR", str(tmp_path / "store"))
+    monkeypatch.chdir(tmp_path)
+    yield
+
+
+def register_test(**kw):
+    t = jcore.make_test({
+        "name": "register-test",
+        "client": AtomClient(),
+        "concurrency": 4,
+        "generator": gen.clients(gen.limit(
+            40, gen.mix([linearizable_register.r,
+                         linearizable_register.w,
+                         linearizable_register.cas]))),
+        "checker": linearizable(CASRegister(), algorithm="wgl"),
+    })
+    t.update(kw)
+    return t
+
+
+def test_full_run_lifecycle():
+    completed = jcore.run(register_test())
+    assert completed["results"]["valid?"] is True
+    h = completed["history"]
+    assert len(h) == 80
+    d = completed["store"].dir
+    for f in ("history.edn", "history.txt", "test.json", "results.edn",
+              "results.json", "jepsen.log"):
+        assert os.path.exists(os.path.join(d, f)), f
+
+
+def test_history_roundtrip_from_store():
+    completed = jcore.run(register_test())
+    d = completed["store"].dir
+    h = History.load(os.path.join(d, "history.edn"))
+    assert len(h) == len(completed["history"])
+    r = linearizable(CASRegister(), algorithm="wgl").check({}, h)
+    assert r["valid?"] is True
+
+
+def test_store_latest_and_load():
+    jcore.run(register_test())
+    completed2 = jcore.run(register_test())
+    latest = jstore.latest(jstore.BASE_DIR)
+    assert latest is not None
+    assert os.path.realpath(latest) == os.path.realpath(
+        completed2["store"].dir)
+    loaded = jstore.load_run(latest)
+    assert loaded["results"]["valid?"] is True
+    assert loaded["test"]["name"] == "register-test"
+    # live objects are stripped from the stored test
+    assert "client" not in loaded["test"]
+
+
+def test_checker_crash_yields_unknown():
+    def boom(test, history, opts):
+        raise RuntimeError("checker exploded")
+
+    completed = jcore.run(register_test(checker=FnChecker(boom)))
+    assert completed["results"]["valid?"] == "unknown"
+    assert "checker exploded" in completed["results"]["error"]
+    # history survived the checker crash (save-1 before analyze)
+    assert os.path.exists(
+        os.path.join(completed["store"].dir, "history.edn"))
+
+
+def test_concurrency_parse():
+    assert jcli.parse_concurrency("10", 5) == 10
+    assert jcli.parse_concurrency("3n", 5) == 15
+    assert jcli.parse_concurrency("n", 5) == 5
+
+
+def _register_test_fn(opts):
+    return jcore.make_test({
+        "name": "cli-register",
+        "nodes": opts["nodes"],
+        "concurrency": opts["concurrency"],
+        "client": AtomClient(),
+        "generator": gen.clients(gen.limit(
+            30, gen.mix([linearizable_register.r,
+                         linearizable_register.w]))),
+        "checker": linearizable(CASRegister(), algorithm="wgl"),
+    })
+
+
+def test_cli_test_and_analyze(capsys):
+    code = jcli.run_cli(_register_test_fn,
+                        ["test", "--no-ssh", "--concurrency", "2"])
+    assert code == jcli.EXIT_VALID
+    out = capsys.readouterr().out
+    assert json.loads(out.splitlines()[-1])["valid?"] is True
+
+    code = jcli.run_cli(_register_test_fn, ["analyze", "--no-ssh"])
+    assert code == jcli.EXIT_VALID
+
+
+def test_cli_invalid_exit_code():
+    def bad_test_fn(opts):
+        t = _register_test_fn(opts)
+        t["checker"] = FnChecker(lambda *a: {"valid?": False})
+        return t
+
+    code = jcli.run_cli(bad_test_fn, ["test", "--no-ssh"])
+    assert code == jcli.EXIT_INVALID
+
+
+def test_cli_unknown_exit_code():
+    def unk_test_fn(opts):
+        t = _register_test_fn(opts)
+        t["checker"] = FnChecker(lambda *a: {"valid?": "unknown"})
+        return t
+
+    code = jcli.run_cli(unk_test_fn, ["test", "--no-ssh"])
+    assert code == jcli.EXIT_UNKNOWN
+
+
+def test_cli_bad_args():
+    assert jcli.run_cli(None, []) == jcli.EXIT_BAD_ARGS
+
+
+def test_web_browser():
+    completed = jcore.run(register_test())
+    srv = jweb.make_server(base_dir=jstore.BASE_DIR)
+    th = threading.Thread(target=srv.serve_forever, daemon=True)
+    th.start()
+    try:
+        port = srv.server_address[1]
+        home = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/").read().decode()
+        assert "register-test" in home
+        ts = os.path.basename(completed["store"].dir)
+        hist = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/files/register-test/{ts}/history.txt"
+        ).read().decode()
+        assert "invoke" in hist
+        z = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/zip/register-test/{ts}").read()
+        assert z[:2] == b"PK"
+        # path traversal denied
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/files/../../etc/passwd")
+    finally:
+        srv.shutdown()
+
+
+def test_register_workload_end_to_end():
+    wl = linearizable_register.workload(
+        {"ops-per-key": 10, "algorithm": "wgl"})
+    t = jcore.make_test({
+        "name": "lin-reg",
+        "concurrency": 4,
+        "client": _KeyedAtomClient(),
+        "generator": gen.time_limit(2, wl["generator"]),
+        "checker": wl["checker"],
+    })
+    completed = jcore.run(t)
+    assert completed["results"]["valid?"] is True
+    lin = completed["results"]["linear"]
+    assert len(lin["results"]) >= 2  # several keys exercised
+
+
+class _KeyedAtomClient(AtomClient):
+    """AtomClient over KV-tuple values: one register per key."""
+
+    def __init__(self, data=None, lock=None):
+        self.data = data if data is not None else {}
+        self.lock = lock or threading.Lock()
+
+    def open(self, test, node):
+        return _KeyedAtomClient(self.data, self.lock)
+
+    def invoke(self, test, op):
+        from jepsen_tpu.history import Op
+        from jepsen_tpu.independent import KV
+        o = Op(op)
+        k, v = op["value"]
+        f = op.get("f")
+        with self.lock:
+            cur = self.data.get(k)
+            if f == "read":
+                o["type"] = "ok"
+                o["value"] = KV(k, cur)
+            elif f == "write":
+                self.data[k] = v
+                o["type"] = "ok"
+            elif f == "cas":
+                old, new = v
+                if cur == old:
+                    self.data[k] = new
+                    o["type"] = "ok"
+                else:
+                    o["type"] = "fail"
+        return o
