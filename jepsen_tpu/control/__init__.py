@@ -211,10 +211,13 @@ def download(remote_paths, local_path):
 # ------------------------------------------------------------ remotes
 
 
-def _run_local(argv_or_str, shell=False, stdin=None, timeout=600) -> Result:
+def _run_local(argv_or_str, shell=False, stdin=None, timeout=600,
+               env=None) -> Result:
+    if env is not None:
+        env = {**os.environ, **env}
     p = subprocess.run(
         argv_or_str, shell=shell, input=stdin, capture_output=True,
-        text=True, timeout=timeout)
+        text=True, timeout=timeout, env=env)
     cmd = argv_or_str if isinstance(argv_or_str, str) else " ".join(argv_or_str)
     return Result(cmd, p.returncode, p.stdout, p.stderr)
 
@@ -297,10 +300,13 @@ class SshRemote(Remote):
         s = self.spec
         argv = [prog, "-o", "StrictHostKeyChecking=no",
                 "-o", "UserKnownHostsFile=/dev/null", "-o", "LogLevel=ERROR"]
-        if s.get("password") and shutil.which("sshpass"):
-            # password auth rides sshpass; without it, BatchMode below
-            # fails fast instead of hanging on an interactive prompt
-            argv = ["sshpass", "-p", s["password"], *argv]
+        if (s.get("password") and not s.get("private-key-path")
+                and shutil.which("sshpass")):
+            # password auth rides sshpass -e (password via SSHPASS env,
+            # never on the argv where `ps` would expose it); key auth
+            # never falls back to the password. Without sshpass,
+            # BatchMode below fails fast instead of hanging on a prompt.
+            argv = ["sshpass", "-e", *argv]
         else:
             argv += ["-o", "BatchMode=yes"]
         if s.get("port"):
@@ -309,6 +315,13 @@ class SshRemote(Remote):
         if s.get("private-key-path"):
             argv += ["-i", s["private-key-path"]]
         return argv
+
+    def _env(self):
+        s = self.spec
+        if (s.get("password") and not s.get("private-key-path")
+                and shutil.which("sshpass")):
+            return {"SSHPASS": s["password"]}
+        return None
 
     def _dest(self) -> str:
         s = self.spec
@@ -319,7 +332,8 @@ class SshRemote(Remote):
         full = wrap_sudo(wrap_cd(cmd, ctx.get("dir")), ctx.get("sudo"))
         last = None
         for attempt in range(3):
-            res = _run_local(self._base() + [self._dest(), full])
+            res = _run_local(self._base() + [self._dest(), full],
+                             env=self._env())
             last = res
             if res.exit == 255 and any(t in res.err for t in self.TRANSIENT):
                 time.sleep(0.5 * (attempt + 1))
@@ -330,12 +344,14 @@ class SshRemote(Remote):
     def upload(self, local_paths, remote_path):
         argv = self._base("scp") + [*_coll(local_paths),
                                     f"{self._dest()}:{remote_path}"]
-        _run_local(argv).throw_on_nonzero(self.spec.get("host"))
+        _run_local(argv, env=self._env()).throw_on_nonzero(
+            self.spec.get("host"))
 
     def download(self, remote_paths, local_path):
         argv = self._base("scp") + [f"{self._dest()}:{p}"
                                     for p in _coll(remote_paths)] + [local_path]
-        _run_local(argv).throw_on_nonzero(self.spec.get("host"))
+        _run_local(argv, env=self._env()).throw_on_nonzero(
+            self.spec.get("host"))
 
 
 class DockerRemote(Remote):

@@ -34,7 +34,7 @@ from jepsen_tpu import store as jstore
 from jepsen_tpu.checker.core import check_safe
 from jepsen_tpu.generator import interpreter
 from jepsen_tpu.history import History
-from jepsen_tpu.util import real_pmap, reset_relative_time
+from jepsen_tpu.util import reset_relative_time
 
 log = logging.getLogger("jepsen")
 
@@ -103,14 +103,14 @@ def run_case(test: Dict) -> History:
     nemesis = test.get("nemesis")
     nodes = test.get("nodes") or [None]
 
-    # open + setup one client per node (core.clj:182-199)
-    setup_clients = []
+    # open + setup ONE client on the first node for the setup/teardown
+    # lifecycle (core.clj:182-199); the interpreter opens its own
+    # per-worker clients, so more opens here would be pure churn
+    setup_client = None
     try:
         if client is not None:
-            setup_clients = real_pmap(
-                lambda n: client.open(test, n), nodes)
-            for cl in setup_clients[:1]:
-                cl.setup(test)  # setup once (client.clj contract)
+            setup_client = client.open(test, nodes[0])
+            setup_client.setup(test)
         if nemesis is not None:
             test["nemesis"] = nemesis = nemesis.setup(test)
 
@@ -120,14 +120,13 @@ def run_case(test: Dict) -> History:
             if nemesis is not None:
                 nemesis.teardown(test)
         finally:
-            for cl in setup_clients[:1]:
+            if setup_client is not None:
                 try:
-                    cl.teardown(test)
+                    setup_client.teardown(test)
                 except Exception:  # noqa: BLE001
                     pass
-            for cl in setup_clients:
                 try:
-                    cl.close(test)
+                    setup_client.close(test)
                 except Exception:  # noqa: BLE001
                     pass
 

@@ -287,6 +287,13 @@ class IndependentChecker(Checker):
 
     def check(self, test, history, opts=None):
         opts = opts or {}
+        # Histories reloaded from EDN/JSONL (the `analyze` path) carry
+        # [k v] values as plain lists; by contract every keyed op under
+        # an independent checker is a KV, so if none survived
+        # serialization, re-wrap — otherwise split_history finds zero
+        # keys and the check is vacuously valid.
+        if not any(isinstance(o.get("value"), KV) for o in history):
+            history = kv_history(history)
         subs = split_history(history)
         ks = list(subs)
 

@@ -1,0 +1,63 @@
+"""Cycle-detection workload wrappers (reference:
+jepsen/src/jepsen/tests/cycle.clj, tests/cycle/append.clj,
+tests/cycle/wr.clj).
+
+`checker(analyzer)` lifts a graph analyzer into a Checker
+(cycle.clj:9-16); `append` / `wr` bundle the elle list-append and
+rw-register checkers with matching txn generators into partial tests
+(append.clj:30-58)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from jepsen_tpu import elle
+from jepsen_tpu.checker.core import Checker, FnChecker
+from jepsen_tpu.elle import list_append, rw_register
+
+
+def checker(analyzer: Callable) -> Checker:
+    """A Checker from a history -> (graph, explainer, by_id) analyzer
+    (cycle.clj:9-16)."""
+    return FnChecker(lambda test, history, opts: elle.check(analyzer, history),
+                     name="cycle")
+
+
+class AppendChecker(Checker):
+    """Full list-append checker (append.clj:11-22); default anomalies
+    [G1 G2]."""
+
+    def __init__(self, opts: Optional[Dict] = None):
+        self.opts = {"anomalies": ["G1", "G2"], **(opts or {})}
+
+    def check(self, test, history, opts=None):
+        return list_append.check(self.opts, history)
+
+    @property
+    def checker_name(self):
+        return "append"
+
+
+class WrChecker(Checker):
+    """Full rw-register checker (wr.clj:14-54)."""
+
+    def __init__(self, opts: Optional[Dict] = None):
+        self.opts = opts or {}
+
+    def check(self, test, history, opts=None):
+        return rw_register.check(self.opts, history)
+
+    @property
+    def checker_name(self):
+        return "wr"
+
+
+def append(opts: Optional[Dict] = None) -> Dict:
+    """Partial test {generator, checker} for list-append histories
+    (append.clj:30-58)."""
+    return {"generator": list_append.gen(opts), "checker": AppendChecker(opts)}
+
+
+def wr(opts: Optional[Dict] = None) -> Dict:
+    """Partial test {generator, checker} for rw-register histories."""
+    return {"generator": rw_register.gen(opts), "checker": WrChecker(opts)}

@@ -1,0 +1,292 @@
+"""Elle-equivalent cycle checker tests: graph machinery, list-append,
+rw-register, and the workload wrappers. Fixture style follows the
+reference's checker tests (hand-written histories asserted against exact
+anomaly classifications)."""
+
+from __future__ import annotations
+
+import pytest
+
+from jepsen_tpu import elle
+from jepsen_tpu.elle import Graph, RW, WR, WW, list_append, rw_register
+from jepsen_tpu.elle import txn as txn_mod
+from jepsen_tpu.generator import fixed_rand
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.workloads import cycle as cycle_wl
+
+
+def H(ops):
+    h = History()
+    for i, o in enumerate(ops):
+        op = Op(o)
+        op["index"] = i
+        h.append(op)
+    return h
+
+
+def txn_pair(process, mops_invoke, mops_ok, final="ok"):
+    return [
+        {"type": "invoke", "process": process, "f": "txn",
+         "value": mops_invoke},
+        {"type": final, "process": process, "f": "txn", "value": mops_ok},
+    ]
+
+
+# ----------------------------------------------------------- Graph/SCC
+
+
+class TestSCC:
+    def g3cycle(self):
+        g = Graph()
+        g.add(0, 1, WW)
+        g.add(1, 2, WW)
+        g.add(2, 0, WW)
+        g.add(2, 3, WW)  # dangling tail, not in the SCC
+        return g
+
+    def test_tarjan(self):
+        sccs = elle.tarjan_sccs(self.g3cycle())
+        assert sorted(map(sorted, sccs)) == [[0, 1, 2]]
+
+    def test_device_matches_tarjan(self):
+        sccs = elle.device_sccs(self.g3cycle())
+        assert sorted(map(sorted, sccs)) == [[0, 1, 2]]
+
+    def test_device_random_graphs_match(self):
+        import random
+
+        r = random.Random(7)
+        for _ in range(5):
+            g = Graph()
+            n = 30
+            for _e in range(60):
+                g.add(r.randrange(n), r.randrange(n), WW)
+            a = sorted(map(sorted, elle.tarjan_sccs(g)))
+            b = sorted(map(sorted, elle.device_sccs(g)))
+            assert a == b
+
+    def test_g_single_search(self):
+        g = Graph()
+        g.add(0, 1, RW)
+        g.add(1, 0, WW)
+        cyc = elle.find_cycle_with_one(g, [0, 1], RW, {WW, WR})
+        assert cyc is not None and cyc[0] == cyc[-1]
+
+    def test_cycle_classification_priority(self):
+        g = Graph()
+        g.add(0, 1, WW)
+        g.add(1, 0, WW)
+        found = elle.cycle_anomalies(g, by_id={0: {}, 1: {}})
+        assert list(found) == ["G0"]
+
+
+# --------------------------------------------------------- list-append
+
+
+class TestListAppend:
+    def test_valid_history(self):
+        h = H([*txn_pair(0, [["append", "x", 1], ["r", "x", None]],
+                         [["append", "x", 1], ["r", "x", [1]]]),
+               *txn_pair(1, [["append", "x", 2], ["r", "x", None]],
+                         [["append", "x", 2], ["r", "x", [1, 2]]])])
+        r = list_append.check(None, h)
+        assert r["valid?"] is True
+
+    def test_g0_write_cycle(self):
+        # T0: x<-1 then y<-2;  T1: y<-1 then x<-2 — ww cycle
+        h = H([*txn_pair(0, [["append", "x", 1], ["append", "y", 2]],
+                         [["append", "x", 1], ["append", "y", 2]]),
+               *txn_pair(1, [["append", "y", 1], ["append", "x", 2]],
+                         [["append", "y", 1], ["append", "x", 2]]),
+               *txn_pair(2, [["r", "x", None], ["r", "y", None]],
+                         [["r", "x", [1, 2]], ["r", "y", [1, 2]]])])
+        r = list_append.check({"anomalies": ["G0"]}, h)
+        assert r["valid?"] is False
+        assert "G0" in r["anomaly-types"]
+
+    def test_g1a_aborted_read(self):
+        h = H([*txn_pair(0, [["append", "x", 1]], [["append", "x", 1]],
+                         final="fail"),
+               *txn_pair(1, [["r", "x", None]], [["r", "x", [1]]])])
+        r = list_append.check({"anomalies": ["G1"]}, h)
+        assert r["valid?"] is False
+        assert "G1a" in r["anomaly-types"]
+
+    def test_g1b_intermediate_read(self):
+        h = H([*txn_pair(0, [["append", "x", 1], ["append", "x", 2]],
+                         [["append", "x", 1], ["append", "x", 2]]),
+               *txn_pair(1, [["r", "x", None]], [["r", "x", [1]]])])
+        r = list_append.check({"anomalies": ["G1"]}, h)
+        assert r["valid?"] is False
+        assert "G1b" in r["anomaly-types"]
+
+    def test_g_single(self):
+        # T1 reads x=[] then T2 appends x<-1 and reads y=[]; T1 appends y<-1.
+        # T1 -rw-> T2 (T2 overwrote T1's read of x),
+        # T2 -rw-> T1 (T1 overwrote T2's read of y): cycle w/ rw edges.
+        h = H([*txn_pair(0, [["r", "x", None], ["append", "y", 1]],
+                         [["r", "x", []], ["append", "y", 1]]),
+               *txn_pair(1, [["append", "x", 1], ["r", "y", None]],
+                         [["append", "x", 1], ["r", "y", []]]),
+               *txn_pair(2, [["r", "x", None], ["r", "y", None]],
+                         [["r", "x", [1]], ["r", "y", [1]]])])
+        r = list_append.check({"anomalies": ["G2"]}, h)
+        assert r["valid?"] is False
+        assert any(a in r["anomaly-types"] for a in ("G-single", "G2"))
+
+    def test_internal(self):
+        h = H([*txn_pair(0, [["append", "x", 1], ["r", "x", None]],
+                         [["append", "x", 1], ["r", "x", [5, 9]]])])
+        r = list_append.check(None, h)
+        assert r["valid?"] is False
+        assert "internal" in r["anomaly-types"]
+
+    def test_incompatible_order(self):
+        h = H([*txn_pair(0, [["r", "x", None]], [["r", "x", [1, 2]]]),
+               *txn_pair(1, [["r", "x", None]], [["r", "x", [2, 1]]])])
+        r = list_append.check(None, h)
+        assert r["valid?"] is False
+        assert "incompatible-order" in r["anomaly-types"]
+
+    def test_cycle_has_explanation_steps(self):
+        h = H([*txn_pair(0, [["append", "x", 1], ["append", "y", 2]],
+                         [["append", "x", 1], ["append", "y", 2]]),
+               *txn_pair(1, [["append", "y", 1], ["append", "x", 2]],
+                         [["append", "y", 1], ["append", "x", 2]]),
+               *txn_pair(2, [["r", "x", None], ["r", "y", None]],
+                         [["r", "x", [1, 2]], ["r", "y", [1, 2]]])])
+        r = list_append.check({"anomalies": ["G0"]}, h)
+        case = r["anomalies"]["G0"][0]
+        assert len(case["steps"]) == len(case["cycle"]) - 1
+        assert "--[ww]-->" in case["steps"][0]
+
+
+# --------------------------------------------------------- rw-register
+
+
+class TestRwRegister:
+    def test_valid(self):
+        h = H([*txn_pair(0, [["w", "x", 1]], [["w", "x", 1]]),
+               *txn_pair(1, [["r", "x", None]], [["r", "x", 1]])])
+        r = rw_register.check(None, h)
+        assert r["valid?"] is True
+
+    def test_g1a(self):
+        h = H([*txn_pair(0, [["w", "x", 1]], [["w", "x", 1]], final="fail"),
+               *txn_pair(1, [["r", "x", None]], [["r", "x", 1]])])
+        r = rw_register.check(None, h)
+        assert r["valid?"] is False
+        assert "G1a" in r["anomaly-types"]
+
+    def test_g1b(self):
+        h = H([*txn_pair(0, [["w", "x", 1], ["w", "x", 2]],
+                         [["w", "x", 1], ["w", "x", 2]]),
+               *txn_pair(1, [["r", "x", None]], [["r", "x", 1]])])
+        r = rw_register.check(None, h)
+        assert r["valid?"] is False
+        assert "G1b" in r["anomaly-types"]
+
+    def test_internal(self):
+        h = H([*txn_pair(0, [["w", "x", 1], ["r", "x", None]],
+                         [["w", "x", 1], ["r", "x", 2]])])
+        r = rw_register.check(None, h)
+        assert r["valid?"] is False
+        assert "internal" in r["anomaly-types"]
+
+    def test_g1c_with_wfr(self):
+        # T0 writes x=1, reads y=1; T1 writes y=1, reads x=1:
+        # wr cycle (circular information flow)
+        h = H([*txn_pair(0, [["w", "x", 1], ["r", "y", None]],
+                         [["w", "x", 1], ["r", "y", 1]]),
+               *txn_pair(1, [["w", "y", 1], ["r", "x", None]],
+                         [["w", "y", 1], ["r", "x", 1]])])
+        r = rw_register.check({"anomalies": ["G1"]}, h)
+        assert r["valid?"] is False
+        assert "G1c" in r["anomaly-types"]
+
+    def test_linearizable_keys_ww(self):
+        # sequential non-overlapping writes 1 then 2; a txn that read 1
+        # *after* 2 was written has an rw edge forward and a wr edge back:
+        # stale read -> G-single under linearizable-keys
+        h = H([
+            {"type": "invoke", "process": 0, "f": "txn",
+             "value": [["w", "x", 1]]},
+            {"type": "ok", "process": 0, "f": "txn",
+             "value": [["w", "x", 1]]},
+            {"type": "invoke", "process": 1, "f": "txn",
+             "value": [["w", "x", 2]]},
+            {"type": "ok", "process": 1, "f": "txn",
+             "value": [["w", "x", 2]]},
+            {"type": "invoke", "process": 2, "f": "txn",
+             "value": [["r", "x", None]]},
+            {"type": "ok", "process": 2, "f": "txn",
+             "value": [["r", "x", 1]]},
+        ])
+        r = rw_register.check({"linearizable-keys": True,
+                               "additional-graphs": ["realtime"]}, h)
+        assert r["valid?"] is False
+
+
+# ----------------------------------------------------------- generators
+
+
+class TestTxnGen:
+    def test_append_txns_shape(self):
+        with fixed_rand(7):
+            stream = txn_mod.append_txns({"key-count": 3,
+                                          "min-txn-length": 1,
+                                          "max-txn-length": 4})
+            txns = [next(stream) for _ in range(50)]
+        for t in txns:
+            assert 1 <= len(t) <= 4
+            for f, k, v in t:
+                assert f in ("r", "append")
+                assert (v is None) == (f == "r")
+
+    def test_max_writes_per_key_rotates_keys(self):
+        with fixed_rand(3):
+            stream = txn_mod.wr_txns({"key-count": 2,
+                                      "max-writes-per-key": 4})
+            writes = {}
+            for _ in range(200):
+                for f, k, v in next(stream):
+                    if f == "w":
+                        writes.setdefault(k, []).append(v)
+        assert len(writes) > 2  # keys rotated
+        for vs in writes.values():
+            assert len(vs) <= 4
+            assert vs == sorted(vs)  # fresh increasing values per key
+
+    def test_workload_generator_emits_txn_ops(self):
+        wl = cycle_wl.append({"key-count": 2})
+        with fixed_rand(1):
+            op = wl["generator"]()
+        assert op["f"] == "txn"
+        assert isinstance(op["value"], list)
+
+
+# --------------------------------------------------- end-to-end wrapper
+
+
+class TestWorkloadCheckers:
+    def test_append_checker_via_protocol(self):
+        h = H([*txn_pair(0, [["append", "x", 1]], [["append", "x", 1]]),
+               *txn_pair(1, [["r", "x", None]], [["r", "x", [1]]])])
+        r = cycle_wl.append().get("checker").check({}, h)
+        assert r["valid?"] is True
+
+    def test_wr_checker_via_protocol(self):
+        h = H([*txn_pair(0, [["w", "x", 1]], [["w", "x", 1]])])
+        r = cycle_wl.wr().get("checker").check({}, h)
+        assert r["valid?"] is True
+
+    def test_generic_cycle_checker(self):
+        def analyzer(history):
+            g = Graph()
+            g.add(0, 1, WW)
+            g.add(1, 0, WW)
+            return g, None, {0: {}, 1: {}}
+
+        r = cycle_wl.checker(analyzer).check({}, H([]))
+        assert r["valid?"] is False
+        assert "G0" in r["anomaly-types"]
