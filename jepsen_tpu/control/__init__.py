@@ -300,8 +300,7 @@ class SshRemote(Remote):
         s = self.spec
         argv = [prog, "-o", "StrictHostKeyChecking=no",
                 "-o", "UserKnownHostsFile=/dev/null", "-o", "LogLevel=ERROR"]
-        if (s.get("password") and not s.get("private-key-path")
-                and shutil.which("sshpass")):
+        if self._env() is not None:
             # password auth rides sshpass -e (password via SSHPASS env,
             # never on the argv where `ps` would expose it); key auth
             # never falls back to the password. Without sshpass,

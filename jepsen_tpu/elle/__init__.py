@@ -328,19 +328,28 @@ def realtime_graph(oks: List[dict]) -> Graph:
     """rt edges: T1's completion precedes T2's invocation. Uses the
     reduced form: edge only from each txn to the txns invoked after it and
     before any later completion (transitively implied edges dropped)."""
+    import bisect
+
     g = Graph()
+    for t in oks:
+        g.add_node(t["_id"])
     # oks carry "_invoke_index"/"_complete_index"/"_id" annotations.
-    by_complete = sorted(oks, key=lambda o: o["_complete_index"])
     starts = sorted(oks, key=lambda o: o["_invoke_index"])
-    for t1 in by_complete:
-        nxt = [t for t in starts if t["_invoke_index"] > t1["_complete_index"]]
-        if not nxt:
-            g.add_node(t1["_id"])
+    invs = [t["_invoke_index"] for t in starts]
+    # suffix_min[i] = min complete index among starts[i:]
+    suffix_min = [0] * (len(starts) + 1)
+    suffix_min[len(starts)] = float("inf")
+    for i in range(len(starts) - 1, -1, -1):
+        suffix_min[i] = min(starts[i]["_complete_index"], suffix_min[i + 1])
+    for t1 in oks:
+        i = bisect.bisect_right(invs, t1["_complete_index"])
+        if i >= len(starts):
             continue
-        horizon = min(t["_complete_index"] for t in nxt)
-        for t2 in nxt:
-            if t2["_invoke_index"] <= horizon:
-                g.add(t1["_id"], t2["_id"], RT)
+        horizon = suffix_min[i]
+        for j in range(i, len(starts)):
+            if invs[j] > horizon:
+                break
+            g.add(t1["_id"], starts[j]["_id"], RT)
     return g
 
 

@@ -858,6 +858,40 @@ def repeat(n_or_gen, gen=None):
     return Repeat(n_or_gen, gen)
 
 
+class Cycle(Generator):
+    """Endlessly restarts a sequence of generators once exhausted — the
+    analogue of handing the reference a lazy `(cycle [...])` seq (e.g.
+    the causal test's sleep/start/sleep/stop nemesis loop,
+    causal.clj:124-128)."""
+
+    __slots__ = ("items", "cur")
+
+    def __init__(self, items, cur=None):
+        self.items = tuple(items)
+        self.cur = cur
+
+    def op(self, test, ctx):
+        if not self.items:
+            return None
+        cur = self.cur if self.cur is not None else list(self.items)
+        res = gen_op(cur, test, ctx)
+        if res is None:
+            res = gen_op(list(self.items), test, ctx)
+            if res is None:
+                return None  # every element empty: stop rather than spin
+        o, g2 = res
+        return o, Cycle(self.items, g2)
+
+    def update(self, test, ctx, event):
+        if self.cur is None:
+            return self
+        return Cycle(self.items, gen_update(self.cur, test, ctx, event))
+
+
+def cycle_gen(items):
+    return Cycle(items)
+
+
 class ProcessLimit(Generator):
     """Emits ops while the union of observed worker processes stays ≤ n
     (generator.clj:1212-1237)."""

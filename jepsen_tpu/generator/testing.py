@@ -48,7 +48,10 @@ def simulate(gen, complete_fn: Callable, ctx: Optional[Ctx] = None,
             res = gen_op(g, test, ctx)
             if res is None:
                 ops.extend(in_flight)
-                return History.wrap(ops)
+                # :sleep/:log are executed but stay out of the history,
+                # as in the interpreter (goes-in-history?)
+                return History.wrap(
+                    o for o in ops if o.get("type") not in ("sleep", "log"))
             invoke, g2 = res
 
             if (invoke is not PENDING
@@ -58,7 +61,15 @@ def simulate(gen, complete_fn: Callable, ctx: Optional[Ctx] = None,
                 thread = ctx.process_to_thread(invoke["process"])
                 ctx = ctx.with_time(max(ctx.time, invoke["time"])).busy(thread)
                 g = gen_update(g2, test, ctx, invoke)
-                complete = complete_fn(ctx, Op(invoke))
+                if invoke.get("type") == "sleep":
+                    # the interpreter's worker idles dt seconds
+                    # (interpreter.py handling of :sleep); model that
+                    # instead of handing sleeps to the completion policy
+                    complete = Op(invoke)
+                    complete["time"] = (invoke["time"]
+                                        + int(invoke.get("value", 0) * 1e9))
+                else:
+                    complete = complete_fn(ctx, Op(invoke))
                 in_flight.append(complete)
                 in_flight.sort(key=lambda o: o["time"])
                 ops.append(invoke)

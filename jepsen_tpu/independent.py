@@ -57,12 +57,15 @@ def is_tuple(v) -> bool:
 def kv_history(history) -> History:
     """Reinterpret 2-element list/tuple op values as KV tuples — for
     histories loaded from EDN/JSONL, where the reference serializes
-    MapEntry values as plain [k v] vectors."""
+    MapEntry values as plain [k v] vectors. Only client ops (integer
+    process) are rewrapped: nemesis/info values like ["n1", "n2"] are
+    payloads, not keys."""
     out = History()
     for o in history:
         v = o.get("value")
-        if (not isinstance(v, KV) and isinstance(v, (list, tuple))
-                and len(v) == 2):
+        if (isinstance(o.get("process"), int)
+                and not isinstance(v, KV)
+                and isinstance(v, (list, tuple)) and len(v) == 2):
             o = Op(o)
             o["value"] = KV(v[0], v[1])
         out.append(o)

@@ -1,0 +1,81 @@
+"""Adya G2 predicate anti-dependency workload (reference:
+jepsen/src/jepsen/tests/adya.clj).
+
+Per key, exactly two concurrent :insert transactions race: each reads
+both tables by predicate and inserts into its own table only if both
+reads were empty. Under serializability at most one can commit; two ok
+inserts for a key is a G2 (predicate anti-dependency cycle) witness
+(adya.clj:12-60)."""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, Optional
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+from jepsen_tpu.checker.core import Checker
+
+
+def g2_gen():
+    """Pairs of inserts [key [a-id b-id]] with globally unique ids, two
+    ops per key, two workers per key (adya.clj:50-60)."""
+    ids = itertools.count(1)
+    lock = threading.Lock()
+
+    def next_id() -> int:
+        with lock:
+            return next(ids)
+
+    def fgen(_k):
+        return [
+            gen.once(lambda _t=None, _c=None:
+                     {"f": "insert", "value": [None, next_id()]}),
+            gen.once(lambda _t=None, _c=None:
+                     {"f": "insert", "value": [next_id(), None]}),
+        ]
+
+    return independent.concurrent_generator(2, itertools.count(), fgen)
+
+
+class G2Checker(Checker):
+    """At most one ok :insert per key (adya.clj:62-87). Works on the
+    un-split history: values are [k [a b]] KV tuples."""
+
+    def check(self, test, history, opts=None):
+        keys: Dict = {}
+        for op in history:
+            if op.get("f") != "insert":
+                continue
+            v = op.get("value")
+            k = v.key if isinstance(v, independent.KV) else (
+                v[0] if isinstance(v, (list, tuple)) and len(v) == 2 else None)
+            if k is None:
+                continue
+            if op.is_ok:
+                keys[k] = keys.get(k, 0) + 1
+            else:
+                keys.setdefault(k, 0)
+        insert_count = sum(1 for c in keys.values() if c > 0)
+        illegal = {k: c for k, c in sorted(keys.items(), key=lambda kv:
+                                           repr(kv[0])) if c > 1}
+        return {
+            "valid?": not illegal,
+            "key-count": len(keys),
+            "legal-count": insert_count - len(illegal),
+            "illegal-count": len(illegal),
+            "illegal": illegal,
+        }
+
+    @property
+    def checker_name(self):
+        return "g2"
+
+
+def g2_checker() -> G2Checker:
+    return G2Checker()
+
+
+def workload(opts: Optional[Dict] = None) -> Dict:
+    return {"checker": g2_checker(), "generator": g2_gen()}

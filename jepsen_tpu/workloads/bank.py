@@ -1,0 +1,191 @@
+"""Bank workload: transfers between accounts conserve the total balance
+(reference: jepsen/src/jepsen/tests/bank.clj).
+
+Reads return a map account -> balance; every ok read must cover exactly
+the known accounts, contain no nil balances, sum to :total-amount, and
+(unless negative-balances?) stay non-negative (bank.clj:57-85). The
+checker classifies errors by type with first/worst/last exemplars
+(bank.clj:87-121). Balance totals are summed with numpy across all reads
+at once rather than op-at-a-time."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker.core import Checker, compose
+from jepsen_tpu.history import Op
+
+DEFAULTS = {
+    "max-transfer": 5,
+    "total-amount": 100,
+    "accounts": list(range(8)),
+}
+
+
+def read(_test=None, _ctx=None):
+    return {"f": "read"}
+
+
+def transfer(test, _ctx=None):
+    accounts = (test or {}).get("accounts", DEFAULTS["accounts"])
+    max_transfer = (test or {}).get("max-transfer", DEFAULTS["max-transfer"])
+    return {"f": "transfer",
+            "value": {"from": accounts[gen.rand.randrange(len(accounts))],
+                      "to": accounts[gen.rand.randrange(len(accounts))],
+                      "amount": 1 + gen.rand.randrange(max_transfer)}}
+
+
+def diff_transfer():
+    """Transfers only between distinct accounts (bank.clj:35-39)."""
+    return gen.filter(
+        lambda op: op["value"]["from"] != op["value"]["to"], transfer)
+
+
+def generator():
+    return gen.mix([diff_transfer(), read])
+
+
+def err_badness(test, err: dict) -> float:
+    """Bigger numbers = more egregious (bank.clj:46-54)."""
+    t = err["type"]
+    if t == "unexpected-key":
+        return len(err["unexpected"])
+    if t == "nil-balance":
+        return len(err["nils"])
+    if t == "wrong-total":
+        total_amount = test.get("total-amount", DEFAULTS["total-amount"])
+        return abs((err["total"] - total_amount) / total_amount)
+    if t == "negative-value":
+        return -sum(err["negative"])
+    return 0.0
+
+
+def check_op(accts: set, total, negative_balances: bool, op: Op):
+    """Errors in a single read's balances (bank.clj:57-85)."""
+    value = op.get("value") or {}
+    ks = list(value.keys())
+    balances = list(value.values())
+    if not all(k in accts for k in ks):
+        return {"type": "unexpected-key",
+                "unexpected": [k for k in ks if k not in accts],
+                "op": dict(op)}
+    if any(b is None for b in balances):
+        return {"type": "nil-balance",
+                "nils": {k: v for k, v in value.items() if v is None},
+                "op": dict(op)}
+    if sum(balances) != total:
+        return {"type": "wrong-total", "total": sum(balances),
+                "op": dict(op)}
+    if not negative_balances and any(b < 0 for b in balances):
+        return {"type": "negative-value",
+                "negative": [b for b in balances if b < 0],
+                "op": dict(op)}
+    return None
+
+
+class BankChecker(Checker):
+    """All ok reads sum to :total-amount (bank.clj:87-121)."""
+
+    def __init__(self, opts: Optional[Dict] = None):
+        self.opts = opts or {}
+
+    def check(self, test, history, opts=None):
+        accts = set(test.get("accounts", DEFAULTS["accounts"]))
+        total = test.get("total-amount", DEFAULTS["total-amount"])
+        negative_ok = bool(self.opts.get("negative-balances?"))
+        reads = [o for o in history if o.is_ok and o.get("f") == "read"]
+
+        # fast path: when every read covers exactly the account set with
+        # numeric balances, the totals check is one vectorized sum
+        errors: Dict[str, list] = {}
+        candidates = reads
+        if reads and all(
+                isinstance(o.get("value"), dict)
+                and set(o["value"]) == accts
+                and all(isinstance(v, (int, float))
+                        for v in o["value"].values())
+                for o in reads):
+            mat = np.array([[o["value"][k] for k in sorted(accts, key=repr)]
+                            for o in reads])
+            sums = mat.sum(axis=1)
+            bad = sums != total
+            if not negative_ok:
+                bad = bad | (mat < 0).any(axis=1)
+            candidates = [o for o, b in zip(reads, bad) if b]
+
+        for o in candidates:
+            err = check_op(accts, total, negative_ok, o)
+            if err is not None:
+                errors.setdefault(err["type"], []).append(err)
+
+        first_error = None
+        firsts = [v[0] for v in errors.values()]
+        if firsts:
+            first_error = min(
+                firsts, key=lambda e: e["op"].get("index", 0))
+
+        def summarize(t, errs):
+            out = {"count": len(errs), "first": errs[0],
+                   "worst": max(errs, key=lambda e: err_badness(test, e)),
+                   "last": errs[-1]}
+            if t == "wrong-total":
+                out["lowest"] = min(errs, key=lambda e: e["total"])
+                out["highest"] = max(errs, key=lambda e: e["total"])
+            return out
+
+        return {
+            "valid?": not errors,
+            "read-count": len(reads),
+            "error-count": sum(len(v) for v in errors.values()),
+            "first-error": first_error,
+            "errors": {t: summarize(t, errs) for t, errs in errors.items()},
+        }
+
+    @property
+    def checker_name(self):
+        return "bank"
+
+
+class BalancePlotter(Checker):
+    """Per-node [time, total] balance series (bank.clj:139-177); the
+    rendered plot arrives via jepsen_tpu.checker.perf once the test map
+    carries a store."""
+
+    def check(self, test, history, opts=None):
+        reads = [o for o in history
+                 if o.is_ok and o.get("f") == "read"
+                 and isinstance(o.get("value"), dict)]
+        if not reads:
+            return {"valid?": True}
+        nodes = test.get("nodes") or ["local"]
+        series: Dict[str, list] = {}
+        for o in reads:
+            p = o.get("process")
+            node = nodes[p % len(nodes)] if isinstance(p, int) else str(p)
+            total = sum(v for v in o["value"].values() if v is not None)
+            series.setdefault(node, []).append(
+                [o.get("time", 0) / 1e9, total])
+        try:
+            from jepsen_tpu.checker import perf
+            perf.points_plot(test, opts or {}, "bank.svg",
+                             series, ylabel="Total of all accounts")
+        except Exception:  # noqa: BLE001 - plotting must never fail a test
+            pass
+        return {"valid?": True, "series": series}
+
+    @property
+    def checker_name(self):
+        return "plot"
+
+
+def workload(opts: Optional[Dict] = None) -> Dict:
+    """Partial test map with defaults (bank.clj:179-192)."""
+    o = opts or {}
+    return {
+        **DEFAULTS,
+        "checker": compose({"SI": BankChecker(o), "plot": BalancePlotter()}),
+        "generator": generator(),
+    }

@@ -1,0 +1,146 @@
+"""Causal-register workload (reference: jepsen/src/jepsen/tests/causal.clj).
+
+A per-key causal order of five ops (read-init, write 1, read, write 2,
+read) issued by a single worker; each op carries a :link to the position
+of the causally preceding op, and the register model rejects reads of
+unwritten values, writes out of counter order, and broken links
+(causal.clj:12-88 — its own mini-Model protocol, separate from
+knossos models)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+from jepsen_tpu.checker.core import Checker
+
+
+class Inconsistent:
+    def __init__(self, msg: str):
+        self.msg = msg
+
+    def step(self, op):
+        return self
+
+    def __str__(self):
+        return self.msg
+
+
+def is_inconsistent(m) -> bool:
+    return isinstance(m, Inconsistent)
+
+
+class CausalRegister:
+    """value/counter/last-pos state machine (causal.clj:36-88)."""
+
+    def __init__(self, value=0, counter=0, last_pos=None):
+        self.value = value
+        self.counter = counter
+        self.last_pos = last_pos
+
+    def step(self, op):
+        c = self.counter + 1
+        v = op.get("value")
+        pos = op.get("position")
+        link = op.get("link")
+        if link != "init" and link != self.last_pos:
+            return Inconsistent(
+                f"Cannot link {link} to last-seen position {self.last_pos}")
+        f = op.get("f")
+        if f == "write":
+            if v == c:
+                return CausalRegister(v, c, pos)
+            return Inconsistent(
+                f"expected value {c} attempting to write {v} instead")
+        if f == "read-init":
+            if self.counter == 0 and v not in (0, None):
+                return Inconsistent(f"expected init value 0, read {v}")
+            if v is None or v == self.value:
+                return CausalRegister(self.value, self.counter, pos)
+            return Inconsistent(
+                f"can't read {v} from register {self.value}")
+        if f == "read":
+            if v is None or v == self.value:
+                return CausalRegister(self.value, self.counter, pos)
+            return Inconsistent(
+                f"can't read {v} from register {self.value}")
+        return Inconsistent(f"unknown f {f!r}")
+
+    def __str__(self):
+        return repr(self.value)
+
+
+def causal_register() -> CausalRegister:
+    return CausalRegister()
+
+
+class CausalChecker(Checker):
+    """Steps the model over ok completions in order (causal.clj:90-113)."""
+
+    def __init__(self, model: Optional[CausalRegister] = None):
+        self.model = model or causal_register()
+
+    def check(self, test, history, opts=None):
+        s = self.model
+        for op in history:
+            if not op.is_ok:
+                continue
+            s = s.step(op)
+            if is_inconsistent(s):
+                return {"valid?": False, "error": s.msg}
+        return {"valid?": True, "model": str(s)}
+
+    @property
+    def checker_name(self):
+        return "causal"
+
+
+def check(model: Optional[CausalRegister] = None) -> CausalChecker:
+    return CausalChecker(model)
+
+
+# ------------------------------------------------------------ generators
+
+
+def r(_t=None, _c=None):
+    return {"f": "read"}
+
+
+def ri(_t=None, _c=None):
+    return {"f": "read-init"}
+
+
+def cw1(_t=None, _c=None):
+    return {"f": "write", "value": 1}
+
+
+def cw2(_t=None, _c=None):
+    return {"f": "write", "value": 2}
+
+
+def workload(opts: Optional[Dict] = None) -> Dict:
+    """Per-key causal order [ri cw1 r cw2 r], one worker per key,
+    staggered, with a start/stop nemesis cycle (causal.clj:116-131)."""
+    o = opts or {}
+    import itertools
+
+    def fgen(_k):
+        # each step once: bare fns are infinite generators (the reference
+        # relies on Clojure fns being one-shot inside seqs; ours aren't)
+        return [gen.once(ri), gen.once(cw1), gen.once(r),
+                gen.once(cw2), gen.once(r)]
+
+    g = independent.concurrent_generator(1, itertools.count(), fgen)
+    g = gen.stagger(1, g)
+    nemesis_cycle = gen.cycle_gen(
+        [gen.sleep(10), {"type": "info", "f": "start"},
+         gen.sleep(10), {"type": "info", "f": "stop"}])
+    g = gen.nemesis(nemesis_cycle, g)
+    if o.get("time-limit"):
+        g = gen.time_limit(o["time-limit"], g)
+    return {
+        "checker": independent.checker(check(causal_register()),
+                                       batch_device=False),
+        "generator": g,
+    }

@@ -1,0 +1,104 @@
+"""Causal-reverse workload: strict-serializability anomaly where T1 < T2
+but T2 is visible without T1 (reference:
+jepsen/src/jepsen/tests/causal_reverse.clj).
+
+Concurrent blind writes of distinct values; reads return the set of
+visible values. Replay the history tracking which writes completed
+before each write's invocation; a read showing w_i but missing some
+w_j < w_i is a violation (causal_reverse.clj:21-49)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, Optional
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+from jepsen_tpu.checker.core import Checker, compose
+from jepsen_tpu.checker.suite import stats
+
+
+def graph(history) -> Dict:
+    """value -> set of values whose writes completed before this write
+    was invoked (the first-order write precedence graph,
+    causal_reverse.clj:21-49)."""
+    completed: set = set()
+    expected: Dict = {}
+    for op in history:
+        if op.get("f") != "write":
+            continue
+        if op.is_invoke:
+            expected[op.get("value")] = set(completed)
+        elif op.is_ok:
+            completed.add(op.get("value"))
+    return expected
+
+
+def errors(history, expected: Dict) -> list:
+    """Ok reads whose visible set misses an expected predecessor
+    (causal_reverse.clj:51-77)."""
+    out = []
+    for op in history:
+        if not (op.is_ok and op.get("f") == "read"):
+            continue
+        seen = set(op.get("value") or ())
+        our_expected: set = set()
+        for v in seen:
+            our_expected |= expected.get(v, set())
+        missing = our_expected - seen
+        if missing:
+            e = {k: v for k, v in op.items() if k != "value"}
+            e["missing"] = sorted(missing, key=repr)
+            e["expected-count"] = len(our_expected)
+            out.append(e)
+    return out
+
+
+class CausalReverseChecker(Checker):
+    """Subsequent writes never appear without prior acknowledged writes
+    (causal_reverse.clj:79-88)."""
+
+    def check(self, test, history, opts=None):
+        expected = graph(history)
+        errs = errors(history, expected)
+        return {"valid?": not errs, "errors": errs}
+
+    @property
+    def checker_name(self):
+        return "causal-reverse"
+
+
+def checker() -> CausalReverseChecker:
+    return CausalReverseChecker()
+
+
+def workload(opts: Optional[Dict] = None) -> Dict:
+    """{checker, generator}: per-key mixed reads and unique-value writes
+    (causal_reverse.clj:90-114)."""
+    o = opts or {}
+    n = len(o.get("nodes") or [1])
+    per_key_limit = o.get("per-key-limit", 500)
+
+    def fgen(_k):
+        values = itertools.count()
+
+        def write(_t=None, _c=None):
+            return {"f": "write", "value": next(values)}
+
+        def read(_t=None, _c=None):
+            # a fn, not a dict: dict generators are one-shot, and mix
+            # would drop reads after the first one
+            return {"f": "read"}
+
+        return gen.limit(per_key_limit,
+                         gen.stagger(1 / 100, gen.mix([read, write])))
+
+    return {
+        "checker": compose({
+            "stats": stats(),
+            "sequential": independent.checker(checker(),
+                                              batch_device=False),
+        }),
+        "generator": independent.concurrent_generator(
+            n, itertools.count(), fgen),
+    }

@@ -1,0 +1,233 @@
+"""Long-fork anomaly workload (reference:
+jepsen/src/jepsen/tests/long_fork.clj).
+
+Parallel snapshot isolation permits — and SI forbids — concurrent writes
+observed in conflicting orders:
+
+    T1: (write x 1)        T3: (read x nil) (read y 1)
+    T2: (write y 1)        T4: (read x 1)   (read y nil)
+
+Each key is written once (value 1), so every group read is a vector of
+nil/1 cells; two reads of the same group conflict when neither dominates
+the other (long_fork.clj:160-200). Domination over nil/1 cells is a
+pure bitmask comparison, so the pairwise fork search runs as numpy
+matrix ops over the whole group at once rather than python pairs."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker.core import Checker
+
+UNKNOWN = "unknown"
+
+
+def group_for(n: int, k: int) -> List[int]:
+    """The n keys of k's group, lower inclusive (long_fork.clj:97-104)."""
+    lo = k - (k % n)
+    return list(range(lo, lo + n))
+
+
+def read_txn_for(n: int, k: int) -> List[list]:
+    """A txn reading k's group in shuffled order (long_fork.clj:106-112)."""
+    ks = group_for(n, k)
+    gen.rand.shuffle(ks)
+    return [["r", kk, None] for kk in ks]
+
+
+class LongForkGenerator(gen.Generator):
+    """Single inserts followed by group reads, mixed with reads of other
+    in-flight groups (long_fork.clj:114-152). Workers alternate
+    write-fresh-key / read-own-group; idle workers sometimes read another
+    worker's active group."""
+
+    def __init__(self, n: int, next_key: int = 0,
+                 workers: Optional[Dict] = None):
+        self.n = n
+        self.next_key = next_key
+        self.workers = workers or {}
+
+    def update(self, test, ctx, event):
+        return self
+
+    def op(self, test, ctx):
+        process = ctx.some_free_process()
+        if process is None:
+            return gen.PENDING, self
+        worker = ctx.process_to_thread(process)
+        k = self.workers.get(worker)
+        if k is not None:
+            op = gen.fill_in_op(
+                {"process": process, "f": "read",
+                 "value": read_txn_for(self.n, k)}, ctx)
+            return op, LongForkGenerator(
+                self.n, self.next_key, {**self.workers, worker: None})
+        active = [v for v in self.workers.values() if v is not None]
+        if active and gen.rand.random() < 0.5:
+            k = active[gen.rand.randrange(len(active))]
+            op = gen.fill_in_op(
+                {"process": process, "f": "read",
+                 "value": read_txn_for(self.n, k)}, ctx)
+            return op, self
+        op = gen.fill_in_op(
+            {"process": process, "f": "write",
+             "value": [["w", self.next_key, 1]]}, ctx)
+        return op, LongForkGenerator(
+            self.n, self.next_key + 1, {**self.workers,
+                                        worker: self.next_key})
+
+
+def generator(n: int = 2) -> LongForkGenerator:
+    return LongForkGenerator(n)
+
+
+# ---------------------------------------------------------------- check
+
+
+class IllegalHistory(Exception):
+    def __init__(self, info):
+        super().__init__(info.get("msg", "illegal history"))
+        self.info = info
+
+
+def is_read_txn(txn) -> bool:
+    return all(m[0] == "r" for m in (txn or []))
+
+
+def is_write_txn(txn) -> bool:
+    return bool(txn) and len(txn) == 1 and txn[0][0] == "w"
+
+
+def read_op_value_map(op) -> Dict:
+    return {k: v for _f, k, v in op.get("value") or []}
+
+
+def read_compare(a: Dict, b: Dict):
+    """-1 if a dominates, 0 equal, 1 if b dominates, None incomparable
+    (long_fork.clj:160-200). Values move nil -> written exactly once."""
+    if set(a) != set(b):
+        raise IllegalHistory(
+            {"type": "illegal-history", "reads": [a, b],
+             "msg": "These reads did not query for the same keys, and "
+                    "therefore cannot be compared."})
+    res = 0
+    for k, va in a.items():
+        vb = b[k]
+        if va == vb:
+            continue
+        if vb is None:
+            if res > 0:
+                return None
+            res = -1
+        elif va is None:
+            if res < 0:
+                return None
+            res = 1
+        else:
+            raise IllegalHistory(
+                {"type": "illegal-history", "key": k, "reads": [a, b],
+                 "msg": "These two read states contain distinct values for "
+                        "the same key; this checker assumes only one write "
+                        "occurs per key."})
+    return res
+
+
+def find_forks(ops: List) -> List[list]:
+    """Mutually incomparable read pairs within one group
+    (long_fork.clj:211-218), via one vectorized domination matrix:
+    with presence bitvectors P (1 = non-nil), a dominates b iff
+    P_a >= P_b elementwise; a fork is a pair where neither dominates."""
+    if len(ops) < 2:
+        return []
+    maps = [read_op_value_map(o) for o in ops]
+    keys = sorted(maps[0])
+    for m in maps[1:]:
+        if set(m) != set(keys):
+            read_compare(maps[0], m)  # raises with the exemplar pair
+    # single-writer invariant: each key has at most one non-nil value
+    for k in keys:
+        distinct = {m[k] for m in maps if m[k] is not None}
+        if len(distinct) > 1:
+            a = next(m for m in maps if m[k] in distinct)
+            b = next(m for m in maps
+                     if m[k] is not None and m[k] != a[k])
+            read_compare(a, b)  # raises illegal-history
+    p = np.array([[0 if m[k] is None else 1 for k in keys] for m in maps],
+                 dtype=np.int8)
+    ge = (p[:, None, :] >= p[None, :, :]).all(axis=2)
+    incomparable = ~ge & ~ge.T
+    forks = []
+    ii, jj = np.nonzero(np.triu(incomparable, k=1))
+    for i, j in zip(ii.tolist(), jj.tolist()):
+        forks.append([dict(ops[i]), dict(ops[j])])
+    return forks
+
+
+def _groups(n: int, read_ops: List) -> List[List]:
+    """Partition reads by the key set they observed; each must have
+    exactly n keys (long_fork.clj:238-253)."""
+    by_keys: Dict = {}
+    for o in read_ops:
+        ks = frozenset(m[1] for m in o.get("value") or [])
+        by_keys.setdefault(ks, []).append(o)
+    out = []
+    for ks, ops in by_keys.items():
+        if len(ks) != n:
+            raise IllegalHistory(
+                {"type": "illegal-history", "op": dict(ops[0]),
+                 "msg": f"Every read in this history should have observed "
+                        f"exactly {n} keys, but this read observed "
+                        f"{len(ks)} instead: {sorted(ks)}"})
+        out.append(ops)
+    return out
+
+
+class LongForkChecker(Checker):
+    """No multi-writes per key; no mutually incomparable group reads
+    (long_fork.clj:282-299)."""
+
+    def __init__(self, n: int = 2):
+        self.n = n
+
+    def check(self, test, history, opts=None):
+        reads = [o for o in history
+                 if o.is_ok and is_read_txn(o.get("value"))]
+        stats = {
+            "reads-count": len(reads),
+            "early-read-count": sum(
+                1 for o in reads
+                if not any(m[2] is not None for m in o["value"])),
+            "late-read-count": sum(
+                1 for o in reads
+                if all(m[2] is not None for m in o["value"])),
+        }
+        # multiple writes to one key -> unknown (long_fork.clj:255-271)
+        seen = set()
+        for o in history:
+            if o.is_invoke and is_write_txn(o.get("value")):
+                k = o["value"][0][1]
+                if k in seen:
+                    return {**stats, "valid?": UNKNOWN,
+                            "error": ["multiple-writes", k]}
+                seen.add(k)
+        try:
+            forks = []
+            for grp in _groups(self.n, reads):
+                forks.extend(find_forks(grp))
+        except IllegalHistory as e:
+            return {**stats, "valid?": UNKNOWN, "error": e.info}
+        if forks:
+            return {**stats, "valid?": False, "forks": forks}
+        return {**stats, "valid?": True}
+
+    @property
+    def checker_name(self):
+        return "long-fork"
+
+
+def workload(n: int = 2) -> Dict:
+    """{checker, generator} (long_fork.clj:301-307)."""
+    return {"checker": LongForkChecker(n), "generator": generator(n)}

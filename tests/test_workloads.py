@@ -1,0 +1,319 @@
+"""Workload-suite tests: bank, long-fork, causal, causal-reverse, adya.
+History fixtures asserted against exact results, mirroring the
+reference's checker tests (test strategy SURVEY.md §4.3)."""
+
+from __future__ import annotations
+
+from jepsen_tpu.generator import fixed_rand
+from jepsen_tpu import generator as gen
+from jepsen_tpu.generator.testing import simulate
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.independent import ktuple as kv
+from jepsen_tpu.workloads import (adya, bank, causal, causal_reverse,
+                                  long_fork)
+
+
+def H(ops):
+    h = History()
+    for i, o in enumerate(ops):
+        op = Op(o)
+        op.setdefault("index", i)
+        op.setdefault("time", i)
+        h.append(op)
+    return h
+
+
+def ok_read(process, value, **kw):
+    return [{"type": "invoke", "process": process, "f": "read",
+             "value": None, **kw},
+            {"type": "ok", "process": process, "f": "read",
+             "value": value, **kw}]
+
+
+# ------------------------------------------------------------------ bank
+
+
+class TestBank:
+    def test_valid(self):
+        t = {"accounts": [0, 1], "total-amount": 10}
+        h = H(ok_read(0, {0: 4, 1: 6}) + ok_read(1, {0: 10, 1: 0}))
+        r = bank.BankChecker().check(t, h)
+        assert r["valid?"] is True
+        assert r["read-count"] == 2
+
+    def test_wrong_total(self):
+        t = {"accounts": [0, 1], "total-amount": 10}
+        h = H(ok_read(0, {0: 4, 1: 7}))
+        r = bank.BankChecker().check(t, h)
+        assert r["valid?"] is False
+        assert r["errors"]["wrong-total"]["count"] == 1
+        assert r["first-error"]["total"] == 11
+
+    def test_negative_value(self):
+        t = {"accounts": [0, 1], "total-amount": 10}
+        h = H(ok_read(0, {0: -2, 1: 12}))
+        r = bank.BankChecker().check(t, h)
+        assert r["valid?"] is False
+        assert "negative-value" in r["errors"]
+        r2 = bank.BankChecker({"negative-balances?": True}).check(t, h)
+        assert r2["valid?"] is True
+
+    def test_nil_balance_and_unexpected_key(self):
+        t = {"accounts": [0, 1], "total-amount": 10}
+        r = bank.BankChecker().check(t, H(ok_read(0, {0: 4, 1: None})))
+        assert r["valid?"] is False and "nil-balance" in r["errors"]
+        r = bank.BankChecker().check(t, H(ok_read(0, {0: 4, 9: 6})))
+        assert r["valid?"] is False and "unexpected-key" in r["errors"]
+
+    def test_generator_emits_valid_ops(self):
+        wl = bank.workload()
+        test = {**wl, "concurrency": 4}
+        with fixed_rand(11):
+            h = simulate(gen.limit(40, wl["generator"]),
+                         lambda c, inv: Op({**inv, "type": "ok"}),
+                         test=test)
+        invokes = [o for o in h if o.get("type") == "invoke"]
+        assert len(invokes) > 10
+        for o in invokes:
+            assert o["f"] in ("read", "transfer")
+            if o["f"] == "transfer":
+                v = o["value"]
+                assert v["from"] != v["to"]
+                assert 1 <= v["amount"] <= 5
+
+    def test_plotter_series(self):
+        t = {"accounts": [0, 1], "total-amount": 10, "nodes": ["n1", "n2"]}
+        h = H(ok_read(0, {0: 4, 1: 6}) + ok_read(1, {0: 10, 1: 0}))
+        r = bank.BalancePlotter().check(t, h)
+        assert r["valid?"] is True
+        assert set(r["series"]) == {"n1", "n2"}
+
+
+# ------------------------------------------------------------- long-fork
+
+
+def lf_read(process, pairs):
+    v = [["r", k, val] for k, val in pairs]
+    return [{"type": "invoke", "process": process, "f": "read",
+             "value": [["r", k, None] for k, _ in pairs]},
+            {"type": "ok", "process": process, "f": "read", "value": v}]
+
+
+def lf_write(process, k):
+    v = [["w", k, 1]]
+    return [{"type": "invoke", "process": process, "f": "write", "value": v},
+            {"type": "ok", "process": process, "f": "write", "value": v}]
+
+
+class TestLongFork:
+    def test_valid(self):
+        h = H(lf_write(0, 0) + lf_write(1, 1)
+              + lf_read(2, [(0, 1), (1, None)])
+              + lf_read(3, [(0, 1), (1, 1)]))
+        r = long_fork.LongForkChecker(2).check({}, h)
+        assert r["valid?"] is True
+
+    def test_fork(self):
+        # r3 sees x=nil y=1; r4 sees x=1 y=nil: incomparable
+        h = H(lf_write(0, 0) + lf_write(1, 1)
+              + lf_read(2, [(0, None), (1, 1)])
+              + lf_read(3, [(0, 1), (1, None)]))
+        r = long_fork.LongForkChecker(2).check({}, h)
+        assert r["valid?"] is False
+        assert len(r["forks"]) == 1
+
+    def test_multiple_writes_unknown(self):
+        h = H(lf_write(0, 0) + lf_write(1, 0))
+        r = long_fork.LongForkChecker(2).check({}, h)
+        assert r["valid?"] == "unknown"
+        assert r["error"] == ["multiple-writes", 0]
+
+    def test_distinct_values_illegal(self):
+        h = H(lf_read(0, [(0, 1), (1, None)])
+              + lf_read(1, [(0, 2), (1, None)]))
+        r = long_fork.LongForkChecker(2).check({}, h)
+        assert r["valid?"] == "unknown"
+
+    def test_group_math(self):
+        assert long_fork.group_for(2, 5) == [4, 5]
+        assert long_fork.group_for(3, 3) == [3, 4, 5]
+
+    def test_generator_write_then_group_read(self):
+        wl = long_fork.workload(2)
+        with fixed_rand(2):
+            h = simulate(gen.limit(40, wl["generator"]),
+                         lambda c, inv: Op({**inv, "type": "ok"}))
+        invokes = [o for o in h if o.get("type") == "invoke"]
+        fs = {o["f"] for o in invokes}
+        assert fs == {"read", "write"}
+        for o in invokes:
+            if o["f"] == "read":
+                assert len(o["value"]) == 2
+
+    def test_early_late_counts(self):
+        h = H(lf_read(0, [(0, None), (1, None)])
+              + lf_read(1, [(0, 1), (1, 1)]))
+        r = long_fork.LongForkChecker(2).check({}, h)
+        assert r["early-read-count"] == 1
+        assert r["late-read-count"] == 1
+
+
+# ---------------------------------------------------------------- causal
+
+
+def causal_op(f, value=None, position=None, link=None):
+    o = {"type": "ok", "process": 0, "f": f, "value": value}
+    if position is not None:
+        o["position"] = position
+    o["link"] = link
+    return o
+
+
+class TestCausal:
+    def test_valid_chain(self):
+        h = H([causal_op("read-init", 0, position=1, link="init"),
+               causal_op("write", 1, position=2, link=1),
+               causal_op("read", 1, position=3, link=2),
+               causal_op("write", 2, position=4, link=3),
+               causal_op("read", 2, position=5, link=4)])
+        r = causal.check().check({}, h)
+        assert r["valid?"] is True
+
+    def test_broken_link(self):
+        h = H([causal_op("read-init", 0, position=1, link="init"),
+               causal_op("write", 1, position=2, link=99)])
+        r = causal.check().check({}, h)
+        assert r["valid?"] is False
+        assert "Cannot link" in r["error"]
+
+    def test_stale_read(self):
+        h = H([causal_op("read-init", 0, position=1, link="init"),
+               causal_op("write", 1, position=2, link=1),
+               causal_op("read", 0, position=3, link=2)])
+        r = causal.check().check({}, h)
+        assert r["valid?"] is False
+        assert "can't read" in r["error"]
+
+    def test_write_out_of_order(self):
+        h = H([causal_op("read-init", 0, position=1, link="init"),
+               causal_op("write", 2, position=2, link=1)])
+        r = causal.check().check({}, h)
+        assert r["valid?"] is False
+
+    def test_workload_shape(self):
+        wl = causal.workload({"time-limit": 60})
+        assert "generator" in wl and "checker" in wl
+
+
+# -------------------------------------------------------- causal-reverse
+
+
+class TestCausalReverse:
+    def test_valid(self):
+        h = H([{"type": "invoke", "process": 0, "f": "write", "value": 0},
+               {"type": "ok", "process": 0, "f": "write", "value": 0},
+               {"type": "invoke", "process": 1, "f": "write", "value": 1},
+               {"type": "ok", "process": 1, "f": "write", "value": 1},
+               *ok_read(2, [0, 1])])
+        r = causal_reverse.checker().check({}, h)
+        assert r["valid?"] is True
+
+    def test_missing_predecessor(self):
+        # write 0 completes before write 1 invokes; a read sees 1 but not 0
+        h = H([{"type": "invoke", "process": 0, "f": "write", "value": 0},
+               {"type": "ok", "process": 0, "f": "write", "value": 0},
+               {"type": "invoke", "process": 1, "f": "write", "value": 1},
+               {"type": "ok", "process": 1, "f": "write", "value": 1},
+               *ok_read(2, [1])])
+        r = causal_reverse.checker().check({}, h)
+        assert r["valid?"] is False
+        assert r["errors"][0]["missing"] == [0]
+
+    def test_concurrent_writes_ok_in_any_order(self):
+        # both writes invoked before either completes: no precedence
+        h = H([{"type": "invoke", "process": 0, "f": "write", "value": 0},
+               {"type": "invoke", "process": 1, "f": "write", "value": 1},
+               {"type": "ok", "process": 0, "f": "write", "value": 0},
+               {"type": "ok", "process": 1, "f": "write", "value": 1},
+               *ok_read(2, [1])])
+        r = causal_reverse.checker().check({}, h)
+        assert r["valid?"] is True
+
+
+# ------------------------------------------------------------------ adya
+
+
+class TestAdya:
+    def test_valid_one_insert_per_key(self):
+        h = H([{"type": "invoke", "process": 0, "f": "insert",
+                "value": kv(0, [None, 1])},
+               {"type": "ok", "process": 0, "f": "insert",
+                "value": kv(0, [None, 1])},
+               {"type": "invoke", "process": 1, "f": "insert",
+                "value": kv(0, [2, None])},
+               {"type": "fail", "process": 1, "f": "insert",
+                "value": kv(0, [2, None])}])
+        r = adya.g2_checker().check({}, h)
+        assert r["valid?"] is True
+        assert r["key-count"] == 1
+        assert r["legal-count"] == 1
+
+    def test_g2_double_insert(self):
+        h = H([{"type": "invoke", "process": 0, "f": "insert",
+                "value": kv(7, [None, 1])},
+               {"type": "ok", "process": 0, "f": "insert",
+                "value": kv(7, [None, 1])},
+               {"type": "invoke", "process": 1, "f": "insert",
+                "value": kv(7, [2, None])},
+               {"type": "ok", "process": 1, "f": "insert",
+                "value": kv(7, [2, None])}])
+        r = adya.g2_checker().check({}, h)
+        assert r["valid?"] is False
+        assert r["illegal"] == {7: 2}
+
+    def test_gen_unique_ids_two_per_key(self):
+        wl = adya.workload()
+        with fixed_rand(9):
+            h = simulate(gen.limit(20, wl["generator"]),
+                         lambda c, inv: Op({**inv, "type": "ok"}))
+        ids = []
+        per_key = {}
+        for o in h:
+            if o.get("type") == "invoke" and o.get("f") == "insert":
+                v = o["value"]
+                k, pair = v[0], v[1]
+                per_key[k] = per_key.get(k, 0) + 1
+                ids.append([x for x in pair if x is not None][0])
+        assert len(ids) == len(set(ids))  # globally unique
+        assert all(c <= 2 for c in per_key.values())
+
+
+# ------------------------------------------------------------- cycle gen
+
+
+class TestCycleGen:
+    def test_cycle_restarts_exhausted_sequence(self):
+        from jepsen_tpu.generator.testing import quick
+
+        g = gen.limit(6, gen.cycle_gen([{"f": "a"}, {"f": "b"}]))
+        h = quick(g)
+        assert [o["f"] for o in h] == ["a", "b", "a", "b", "a", "b"]
+
+    def test_causal_generator_advances_past_read_init(self):
+        from jepsen_tpu.generator.testing import quick
+        from jepsen_tpu import independent as ind
+
+        wl = causal.workload({})
+        h = quick(gen.limit(10, wl["generator"]))
+        fs = [o["f"] for o in h if isinstance(o.get("process"), int)]
+        assert "write" in fs and "read" in fs
+
+    def test_causal_reverse_mix_keeps_reading(self):
+        from jepsen_tpu.generator.testing import quick
+
+        wl = causal_reverse.workload({"nodes": [1], "per-key-limit": 40})
+        with fixed_rand(4):
+            h = quick(gen.limit(40, wl["generator"]))
+        fs = [o["f"] for o in h]
+        assert fs.count("read") > 5
+        assert fs.count("write") > 5
