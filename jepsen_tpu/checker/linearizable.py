@@ -44,6 +44,16 @@ class Linearizable(Checker):
         algo = self.algorithm or "competition"
         h = history if isinstance(history, History) else History.wrap(history)
 
+        # Guard against mis-parsed histories (e.g. raw EDN keyword keys):
+        # a non-empty history in which NO op has a recognizable :type
+        # would otherwise sail through as trivially linearizable.
+        if len(h) and not any(
+                o.get("type") in ("invoke", "ok", "fail", "info")
+                for o in h):
+            raise ValueError(
+                "history has no ops with a recognizable :type — was it "
+                "parsed with History.from_edn / op_from_edn?")
+
         if algo == "competition":
             # decide statically: packable models race onto the device
             packable = model_ns.pack_spec(model, Intern()) is not None

@@ -1,0 +1,92 @@
+"""libfaketime wrappers: make a DB binary's clock run at a skewed rate
+(reference: jepsen/src/jepsen/faketime.clj).
+
+Where the clock nemesis (nemesis/time.py) skews the *whole node*,
+faketime skews a *single process* by replacing its binary with a shell
+wrapper that launches the original under `faketime -m -f "+OFFs xRATE"`
+(faketime.clj:24-47). A rate of 1.0 is real time; 2.0 runs the victim's
+clock twice as fast."""
+
+from __future__ import annotations
+
+from jepsen_tpu import control as c
+from jepsen_tpu import generator as gen
+
+REPO_URL = "https://github.com/wolfcw/libfaketime.git"
+
+
+def install() -> None:
+    """Builds libfaketime from source on the ambient node
+    (faketime.clj:8-22). Requires network egress on the node; tests use
+    `script`/`wrap` against a pre-installed faketime instead."""
+    with c.su():
+        c.exec_("mkdir", "-p", "/tmp/jepsen")
+        with c.cd("/tmp/jepsen"):
+            try:
+                c.exec_("test", "-d", "libfaketime")
+            except Exception:  # noqa: BLE001 - not cloned yet
+                c.exec_("git", "clone", REPO_URL, "libfaketime")
+            with c.cd("libfaketime"):
+                c.exec_("make")
+                c.exec_("make", "install")
+
+
+def script(cmd: str, init_offset: float, rate: float) -> str:
+    """A sh script invoking cmd under a faketime wrapper with the given
+    initial offset (seconds) and clock rate (faketime.clj:24-34)."""
+    sign = "-" if init_offset < 0 else "+"
+    mag = abs(init_offset)
+    # Preserve sub-second offsets; print integers without a trailing .0
+    off = str(int(mag)) if float(mag) == int(mag) else repr(float(mag))
+    return ("#!/bin/bash\n"
+            f'faketime -m -f "{sign}{off}s x{float(rate)}" '
+            f'{cmd} "$@"\n')
+
+
+def wrap(cmd: str, init_offset: float, rate: float) -> None:
+    """Replaces the executable at cmd with a faketime wrapper, moving
+    the original to cmd.no-faketime. Idempotent (faketime.clj:36-47)."""
+    orig = cmd + ".no-faketime"
+    wrapper = script(orig, init_offset, rate)
+
+    def exists(path):
+        try:
+            c.exec_("test", "-e", path)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+    if not exists(orig):
+        c.exec_("mv", cmd, orig)
+    import tempfile
+    import os
+    fd, tmp = tempfile.mkstemp(suffix=".sh")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(wrapper)
+        c.upload([tmp], cmd)
+    finally:
+        os.unlink(tmp)
+    c.exec_("chmod", "a+x", cmd)
+
+
+def unwrap(cmd: str) -> None:
+    """Removes the wrapper, restoring the original binary
+    (faketime.clj:49-55)."""
+    orig = cmd + ".no-faketime"
+    try:
+        c.exec_("test", "-e", orig)
+    except Exception:  # noqa: BLE001 - no wrapper installed
+        return
+    c.exec_("mv", orig, cmd)
+
+
+
+
+def rand_factor(factor: float) -> float:
+    """A random clock rate near 1.0 such that across repeated draws the
+    fastest possible clock is exactly `factor` times the slowest:
+    max = 2/(1 + 1/factor), min = max/factor (faketime.clj:57-65)."""
+    mx = 2.0 / (1.0 + 1.0 / factor)
+    mn = mx / factor
+    return mn + gen.rand.random() * (mx - mn)

@@ -1,0 +1,305 @@
+"""Combined nemesis packages (reference: jepsen/src/jepsen/nemesis/combined.clj).
+
+A *package* is a dict {nemesis, generator, final_generator, perf}
+composing a fault's nemesis with the generator that schedules it and
+the perf-graph legend describing it (combined.clj:8-15,295-341). The
+algebra: build one package per enabled fault (partition / kill / pause
+/ clock), then `compose_packages` mixes the generators, sequences the
+final generators, and :f-routes one composed nemesis."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from jepsen_tpu import control as c
+from jepsen_tpu import db as _db
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as n
+from jepsen_tpu.nemesis import time as nt
+from jepsen_tpu.util import majority, minority_third, random_nonempty_subset
+
+DEFAULT_INTERVAL = 10  # seconds between nemesis ops (combined.clj:26-28)
+
+
+# ----------------------------------------------------------- node specs
+
+
+def db_nodes(test: dict, db, node_spec) -> list:
+    """Resolve a node specification to concrete nodes
+    (combined.clj:30-53). Specs: None (random nonempty subset), "one",
+    "minority", "majority", "minority-third", "primaries", "all", or an
+    explicit list of nodes."""
+    nodes = list(test.get("nodes") or [])
+    if node_spec is None:
+        return random_nonempty_subset(nodes)
+    if node_spec == "one":
+        return [gen.rand.choice(nodes)]
+    if node_spec == "minority":
+        k = majority(len(nodes)) - 1
+        return _shuffled(nodes)[:k]
+    if node_spec == "majority":
+        return _shuffled(nodes)[:majority(len(nodes))]
+    if node_spec == "minority-third":
+        return _shuffled(nodes)[:minority_third(len(nodes))]
+    if node_spec == "primaries":
+        assert isinstance(db, _db.Primary), "db has no Primary support"
+        return random_nonempty_subset(db.primaries(test))
+    if node_spec == "all":
+        return nodes
+    return list(node_spec)
+
+
+def node_specs(db) -> list:
+    """All specs valid for this DB (combined.clj:55-60)."""
+    specs = [None, "one", "minority-third", "minority", "majority", "all"]
+    if isinstance(db, _db.Primary):
+        specs.append("primaries")
+    return specs
+
+
+_shuffled = n._shuffled
+
+
+# ----------------------------------------------------- db start/kill/pause
+
+
+class DbNemesis(n.Nemesis):
+    """start/kill/pause/resume the DB's process on a node spec
+    (combined.clj:62-90)."""
+
+    def __init__(self, db):
+        self.db = db
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        fns = {"start": lambda t, node: self.db.start(t, node),
+               "kill": lambda t, node: self.db.kill(t, node),
+               "pause": lambda t, node: self.db.pause(t, node),
+               "resume": lambda t, node: self.db.resume(t, node)}
+        if f not in fns:
+            raise ValueError(f"db nemesis doesn't handle :f {f!r}")
+        nodes = db_nodes(test, self.db, op.get("value"))
+        res = c.on_nodes(test, fns[f], nodes)
+        out = n._ok(op)
+        out["value"] = res
+        return out
+
+    def fs(self):
+        return {"start", "kill", "pause", "resume"}
+
+
+def db_generators(opts: dict) -> dict:
+    """{:generator :final-generator} for DB process faults
+    (combined.clj:92-131)."""
+    db = opts["db"]
+    faults = set(opts.get("faults") or ())
+    kill = isinstance(db, _db.Process) and "kill" in faults
+    pause = isinstance(db, _db.Pause) and "pause" in faults
+
+    kill_targets = (opts.get("kill") or {}).get("targets") or node_specs(db)
+    pause_targets = (opts.get("pause") or {}).get("targets") or node_specs(db)
+
+    start = {"type": "info", "f": "start", "value": "all"}
+    resume = {"type": "info", "f": "resume", "value": "all"}
+
+    def kill_op(test, ctx):
+        return {"type": "info", "f": "kill",
+                "value": gen.rand.choice(kill_targets)}
+
+    def pause_op(test, ctx):
+        return {"type": "info", "f": "pause",
+                "value": gen.rand.choice(pause_targets)}
+
+    modes, final = [], []
+    if pause:
+        modes.append(gen.flip_flop(pause_op, gen.repeat(resume)))
+        final.append(resume)
+    if kill:
+        modes.append(gen.flip_flop(kill_op, gen.repeat(start)))
+        final.append(start)
+    return {"generator": gen.mix(modes) if modes else None,
+            "final_generator": final}
+
+
+def db_package(opts: dict) -> Optional[dict]:
+    """Package for DB process faults, or None when neither kill nor
+    pause is enabled (combined.clj:133-152)."""
+    faults = set(opts.get("faults") or ())
+    if not faults & {"kill", "pause"}:
+        return None
+    gens = db_generators(opts)
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    return {"generator": gen.stagger(interval, gens["generator"]),
+            "final_generator": gens["final_generator"],
+            "nemesis": DbNemesis(opts["db"]),
+            "perf": [{"name": "kill", "start": {"kill"},
+                      "stop": {"start"}, "color": "#E9A4A0"},
+                     {"name": "pause", "start": {"pause"},
+                      "stop": {"resume"}, "color": "#A0B1E9"}]}
+
+
+# ----------------------------------------------------------- partitions
+
+
+def grudge(test: dict, db, part_spec):
+    """Compute a grudge from a partition spec (combined.clj:154-180).
+    None isolates a random proper nonempty subset."""
+    nodes = list(test.get("nodes") or [])
+    if part_spec is None:
+        k = gen.rand.randint(1, max(1, len(nodes) - 1))
+        shuf = _shuffled(nodes)
+        return n.complete_grudge([shuf[:k], shuf[k:]])
+    if part_spec == "one":
+        return n.complete_grudge(n.split_one(nodes))
+    if part_spec == "majority":
+        return n.complete_grudge(n.bisect(_shuffled(nodes)))
+    if part_spec == "majorities-ring":
+        return n.majorities_ring(nodes)
+    if part_spec == "minority-third":
+        k = minority_third(len(nodes))
+        shuf = _shuffled(nodes)
+        return n.complete_grudge([shuf[:k], shuf[k:]])
+    if part_spec == "primaries":
+        assert isinstance(db, _db.Primary), "db has no Primary support"
+        prim = random_nonempty_subset(db.primaries(test))
+        others = [x for x in nodes if x not in set(prim)]
+        return n.complete_grudge([others] + [[p] for p in prim])
+    return part_spec  # already a grudge map
+
+
+def partition_specs(db) -> list:
+    """(combined.clj:182-186)."""
+    specs = [None, "one", "majority", "majorities-ring"]
+    if isinstance(db, _db.Primary):
+        specs.append("primaries")
+    return specs
+
+
+class PartitionNemesis(n.Nemesis):
+    """Wraps a Partitioner with partition-spec support
+    (combined.clj:188-216). Handles :start-partition/:stop-partition."""
+
+    def __init__(self, db, partitioner: Optional[n.Partitioner] = None):
+        self.db = db
+        self.p = partitioner or n.partitioner()
+
+    def setup(self, test):
+        self.p = self.p.setup(test)
+        return self
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        inner = dict(op)
+        if f == "start-partition":
+            g = op.get("value")
+            if g is None or isinstance(g, str):
+                g = grudge(test, self.db, g)
+            inner.update(f="start", value=g)
+        elif f == "stop-partition":
+            inner.update(f="stop", value=None)
+        else:
+            raise ValueError(f"partition nemesis doesn't handle :f {f!r}")
+        from jepsen_tpu.history import Op
+        res = self.p.invoke(test, Op(inner))
+        out = n._ok(res)
+        out["f"] = f
+        return out
+
+    def teardown(self, test):
+        self.p.teardown(test)
+
+    def fs(self):
+        return {"start-partition", "stop-partition"}
+
+
+def partition_package(opts: dict) -> Optional[dict]:
+    """(combined.clj:218-238)."""
+    if "partition" not in set(opts.get("faults") or ()):
+        return None
+    db = opts["db"]
+    targets = ((opts.get("partition") or {}).get("targets")
+               or partition_specs(db))
+
+    def start(test, ctx):
+        return {"type": "info", "f": "start-partition",
+                "value": gen.rand.choice(targets)}
+
+    stop = {"type": "info", "f": "stop-partition", "value": None}
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    g = gen.stagger(interval, gen.flip_flop(start, gen.repeat(stop)))
+    return {"generator": g,
+            "final_generator": stop,
+            "nemesis": PartitionNemesis(db),
+            "perf": [{"name": "partition", "start": {"start-partition"},
+                      "stop": {"stop-partition"}, "color": "#E9DCA0"}]}
+
+
+# --------------------------------------------------------------- clocks
+
+
+def clock_package(opts: dict) -> Optional[dict]:
+    """Clock-skew package; renames the clock nemesis fs so they can't
+    collide with other packages' (combined.clj:240-272)."""
+    if "clock" not in set(opts.get("faults") or ()):
+        return None
+    db = opts["db"]
+    nem = n.compose([({"reset-clock": "reset",
+                       "check-clock-offsets": "check-offsets",
+                       "strobe-clock": "strobe",
+                       "bump-clock": "bump"}, nt.clock_nemesis())])
+    target_specs = (opts.get("clock") or {}).get("targets") or node_specs(db)
+
+    def targets(test):
+        spec = gen.rand.choice(target_specs) if target_specs else None
+        return db_nodes(test, db, spec)
+
+    g = gen.f_map({"reset": "reset-clock",
+                   "check-offsets": "check-clock-offsets",
+                   "strobe": "strobe-clock",
+                   "bump": "bump-clock"},
+                  nt.clock_gen(targets))
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    return {"generator": gen.stagger(interval, g),
+            "final_generator": {"type": "info", "f": "reset-clock"},
+            "nemesis": nem,
+            "perf": [{"name": "clock", "start": {"bump-clock"},
+                      "stop": {"reset-clock"}, "fs": {"strobe-clock"},
+                      "color": "#A0E9E3"}]}
+
+
+# ---------------------------------------------------------- composition
+
+
+def compose_packages(packages: Sequence[dict]) -> dict:
+    """Mix generators, sequence final generators, :f-route nemeses,
+    union perf legends (combined.clj:274-283)."""
+    packages = [p for p in packages if p]
+    return {"generator": gen.mix([p["generator"] for p in packages
+                                  if p.get("generator") is not None]),
+            "final_generator": [p["final_generator"] for p in packages
+                                if p.get("final_generator") is not None],
+            "nemesis": n.compose([(p["nemesis"].fs(), p["nemesis"])
+                                  for p in packages]),
+            "perf": [spec for p in packages for spec in p.get("perf", [])]}
+
+
+def nemesis_packages(opts: dict) -> list:
+    """One package per enabled fault (combined.clj:285-293)."""
+    faults = set(opts["faults"] if "faults" in opts
+                 else ["partition", "kill", "pause", "clock"])
+    opts = dict(opts, faults=faults)
+    pkgs = [partition_package(opts), clock_package(opts), db_package(opts)]
+    try:  # membership is optional and opt-in (membership.clj:254-266)
+        from jepsen_tpu.nemesis import membership as _membership
+        pkgs.append(_membership.package(opts))
+    except ImportError:  # pragma: no cover
+        pass
+    return [p for p in pkgs if p]
+
+
+def nemesis_package(opts: dict) -> dict:
+    """The one-stop combined package (combined.clj:295-341). Options:
+    :db (required), :interval, :faults, and per-fault target options
+    {:partition {:targets [...]}, :kill {...}, :pause {...},
+    :clock {...}}."""
+    return compose_packages(nemesis_packages(opts))

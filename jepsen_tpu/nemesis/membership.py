@@ -1,0 +1,258 @@
+"""Membership-change nemesis: add/remove nodes from a live cluster
+(reference: jepsen/src/jepsen/nemesis/membership.clj + membership/state.clj).
+
+Cluster membership is the hardest fault to standardize: Jepsen's view,
+each node's view, and reality all diverge. The design (membership.clj:
+1-47): a State object tracks {node_views, view, pending}; background
+pollers refresh each node's view every few seconds; a generator asks
+the state for legal next ops; invoke applies an op and remembers it as
+pending until `resolve_op` can prove it completed.
+
+State protocol (membership/state.clj:6-32): node_view / merge_views /
+fs / op / invoke / resolve / resolve_op."""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from typing import Optional
+
+from jepsen_tpu import control as c
+from jepsen_tpu import generator as gen
+from jepsen_tpu.history import Op
+from jepsen_tpu.nemesis import Nemesis, _ok
+NODE_VIEW_INTERVAL = 5  # seconds between node-view refreshes
+
+
+class State:
+    """Membership state machine protocol (membership/state.clj:6-32).
+    Implementations are immutable-ish: mutating methods return a new
+    (or the same) State. The framework adds the bookkeeping keys
+    node_views / view / pending via attributes on the instance."""
+
+    node_views: dict
+    view: object
+    pending: set
+
+    def node_view(self, test, node):
+        """This node's current view of the cluster, or None if unknown."""
+        raise NotImplementedError
+
+    def merge_views(self, test):
+        """Merge self.node_views into one authoritative view."""
+        raise NotImplementedError
+
+    def fs(self) -> set:
+        """All op :f values this state machine can generate."""
+        raise NotImplementedError
+
+    def op(self, test):
+        """Next operation dict to perform, "pending" if none available
+        now, or None if no ops can ever be performed again."""
+        raise NotImplementedError
+
+    def invoke(self, test, op: Op) -> Op:
+        """Apply a generated operation; return the completed op."""
+        raise NotImplementedError
+
+    def resolve(self, test) -> "State":
+        """Evolve toward a fixed point (e.g. fold a confirmed change
+        into the view). Must be idempotent at the fixed point."""
+        return self
+
+    def resolve_op(self, test, op_pair) -> Optional["State"]:
+        """Given [invocation, completion], return the state with that
+        op considered complete, or None if it is still pending."""
+        return None
+
+    # -- bookkeeping helpers (shared by all implementations) ----------
+
+    def with_updates(self, **kw) -> "State":
+        import copy
+        s = copy.copy(self)
+        for k, v in kw.items():
+            setattr(s, k, v)
+        return s
+
+
+def initial_bookkeeping() -> dict:
+    """The framework-owned part of the state (membership.clj:68-77)."""
+    return {"node_views": {}, "view": None, "pending": set()}
+
+
+def _resolve_ops(state: State, test, opts) -> State:
+    """Try to resolve every pending [op, op'] pair
+    (membership.clj:79-93). Pairs are stored frozen (hashable) in the
+    pending set but handed to resolve_op thawed, as dicts."""
+    for pair in list(state.pending):
+        s2 = state.resolve_op(test, [thaw(pair[0]), thaw(pair[1])])
+        if s2 is not None:
+            pending = set(s2.pending)
+            pending.discard(pair)
+            state = s2.with_updates(pending=pending)
+    return state
+
+
+def resolve(state: State, test, opts=None) -> State:
+    """resolve + resolve_ops to a fixed point (membership.clj:95-107)."""
+    opts = opts or {}
+
+    def step(s):
+        return _resolve_ops(s.resolve(test), test, opts)
+
+    # States aren't required to be value-comparable; iterate until the
+    # pending set and view stop changing.
+    prev = None
+    for _ in range(1000):
+        cur = step(state)
+        key = (repr(getattr(cur, "pending", None)),
+               repr(getattr(cur, "view", None)))
+        if key == prev:
+            return cur
+        prev = key
+        state = cur
+    raise RuntimeError("membership resolve did not converge")
+
+
+class MembershipNemesis(Nemesis):
+    """(membership.clj:159-206). Holds the state under a lock; spawns a
+    poller thread per node refreshing node views."""
+
+    def __init__(self, state: State, opts: Optional[dict] = None):
+        self.lock = threading.RLock()
+        self.state = state
+        self.opts = opts or {}
+        self.running = threading.Event()
+        self._stop_signal = threading.Event()  # set at teardown: wakes pollers
+        self.pollers: list = []
+
+    # -- view maintenance --------------------------------------------
+
+    def _update_node_view(self, test, node):
+        """Poll one node and merge its view in (membership.clj:109-140)."""
+        with self.lock:
+            state = self.state
+        nv = state.node_view(test, node)
+        if nv is None:
+            return
+        with self.lock:
+            views = dict(self.state.node_views)
+            views[node] = nv
+            s = self.state.with_updates(node_views=views)
+            s = s.with_updates(view=s.merge_views(test))
+            self.state = resolve(s, test, self.opts)
+
+    def _poller(self, test, node):
+        while self.running.is_set():
+            try:
+                self._update_node_view(test, node)
+            except Exception:  # noqa: BLE001 - keep polling (clj:150-156)
+                pass
+            # Sleep in small slices so teardown is prompt.
+            interval = self.opts.get("node_view_interval",
+                                     NODE_VIEW_INTERVAL)
+            deadline = _time.monotonic() + interval
+            while self.running.is_set():
+                left = deadline - _time.monotonic()
+                if left <= 0:
+                    break
+                self._stop_signal.wait(min(0.1, left))
+
+    # -- Nemesis protocol --------------------------------------------
+
+    def setup(self, test):
+        with self.lock:
+            updates = {k: v for k, v in initial_bookkeeping().items()
+                       if getattr(self.state, k, None) is None}
+            if updates:
+                self.state = self.state.with_updates(**updates)
+        self.running.set()
+        self._stop_signal.clear()
+        self.pollers = []
+        for node in test.get("nodes") or []:
+            t = threading.Thread(target=self._poller, args=(test, node),
+                                 daemon=True,
+                                 name=f"membership-view-{node}")
+            t.start()
+            self.pollers.append(t)
+        return self
+
+    def invoke(self, test, op):
+        with self.lock:
+            state = self.state
+        op2 = state.invoke(test, op)
+        with self.lock:
+            pending = set(self.state.pending)
+            pending.add((_freeze(op), _freeze(op2)))
+            s = self.state.with_updates(pending=pending)
+            self.state = resolve(s, test, self.opts)
+        return op2
+
+    def teardown(self, test):
+        self.running.clear()
+        self._stop_signal.set()
+        for t in self.pollers:
+            t.join(timeout=2)
+        self.pollers = []
+
+    def fs(self):
+        return set(self.state.fs())
+
+
+def _freeze(op):
+    """Ops go into the pending *set*; dicts aren't hashable."""
+    if isinstance(op, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in op.items()))
+    if isinstance(op, (list, set)):
+        return tuple(_freeze(x) for x in op)
+    return op
+
+
+def thaw(frozen):
+    """Inverse of _freeze for op pairs handed to resolve_op."""
+    if isinstance(frozen, tuple) and frozen and \
+            all(isinstance(x, tuple) and len(x) == 2 and
+                isinstance(x[0], str) for x in frozen):
+        return {k: thaw(v) for k, v in frozen}
+    if isinstance(frozen, tuple):
+        return [thaw(x) for x in frozen]
+    return frozen
+
+
+class MembershipGenerator(gen.Generator):
+    """Asks the shared state for the next legal op
+    (membership.clj:208-218)."""
+
+    def __init__(self, nemesis: MembershipNemesis):
+        self.nemesis = nemesis
+
+    def op(self, test, ctx):
+        with self.nemesis.lock:
+            state = self.nemesis.state
+        o = state.op(test)
+        if o is None:
+            return None
+        if o == "pending":
+            return gen.PENDING, self
+        return gen.fill_in_op(dict(o), ctx), self
+
+    def update(self, test, ctx, event):
+        return self
+
+
+def package(opts: dict) -> Optional[dict]:
+    """Package for combined-nemesis composition (membership.clj:220-266).
+    opts: {faults: {..., "membership"}, membership: {state: State,
+    interval, node_view_interval, ...}}."""
+    if "membership" not in set(opts.get("faults") or ()):
+        return None
+    mopts = dict(opts.get("membership") or {})
+    state = mopts.pop("state")
+    nem = MembershipNemesis(state, mopts)
+    g = gen.stagger(opts.get("interval", 10), MembershipGenerator(nem))
+    return {"generator": g,
+            "final_generator": None,
+            "nemesis": nem,
+            "perf": [{"name": "membership",
+                      "fs": set(state.fs()),
+                      "color": "#A0E9B6"}]}

@@ -1,0 +1,222 @@
+"""Clock-manipulation nemesis (reference: jepsen/src/jepsen/nemesis/time.clj).
+
+Messes with node wall clocks four ways (nemesis/time.clj:89-139):
+
+    {:f :reset,  :value [node1 ...]}                       # back to NTP
+    {:f :bump,   :value {node: delta-ms, ...}}             # one-shot skew
+    {:f :strobe, :value {node: {:delta :period :duration}}}# oscillation
+    {:f :check-offsets}                                    # measure only
+
+The heavy lifting happens in two small C programs (this repo's
+jepsen_tpu/resources/{bump,strobe}-time.c, paralleling the reference's
+jepsen/resources/*.c) which are uploaded to each node and compiled with
+the *node's* gcc at nemesis setup, exactly as the reference does
+(nemesis/time.clj:14-52) — nodes may be a different architecture or
+libc than the control host, so shipping source beats shipping binaries.
+
+Every completion op carries :clock-offsets {node: seconds}, consumed by
+the clock-skew plot (checker/clock.clj:47-75 parallel)."""
+
+from __future__ import annotations
+
+import math
+import time as _time
+from pathlib import Path
+from typing import Callable, Optional
+
+from jepsen_tpu import control as c
+from jepsen_tpu import generator as gen
+from jepsen_tpu.history import Op
+from jepsen_tpu.nemesis import Nemesis, _ok
+from jepsen_tpu.util import random_nonempty_subset
+
+RESOURCE_DIR = Path(__file__).resolve().parent.parent / "resources"
+INSTALL_DIR = "/opt/jepsen"
+
+
+# --------------------------------------------- on-node tool management
+# All of these assume an ambient control session (c.on_host) — they are
+# called from inside c.on_nodes thunks, like the reference's c/su forms.
+
+
+def compile_tool(src: str, bin_name: str) -> str:
+    """Uploads resources/<src> to the current node and compiles it to
+    /opt/jepsen/<bin_name> (nemesis/time.clj:14-30)."""
+    with c.su():
+        c.exec_("mkdir", "-p", INSTALL_DIR)
+        c.exec_("chmod", "a+rwx", INSTALL_DIR)
+        c.upload([str(RESOURCE_DIR / src)], f"{INSTALL_DIR}/{bin_name}.c")
+        with c.cd(INSTALL_DIR):
+            c.exec_("gcc", "-O2", "-o", bin_name, f"{bin_name}.c")
+    return bin_name
+
+
+def install() -> None:
+    """Uploads and compiles the clock tools on the current node
+    (nemesis/time.clj:38-52). Tries a build-essential install on
+    failure, as the reference does, then retries once."""
+    try:
+        compile_tool("strobe-time.c", "strobe-time")
+        compile_tool("bump-time.c", "bump-time")
+    except Exception:  # noqa: BLE001 - node may lack a compiler
+        with c.su():
+            try:
+                c.exec_("apt-get", "install", "-y", "build-essential")
+            except Exception:  # noqa: BLE001
+                c.exec_("yum", "install", "-y", "gcc")
+        compile_tool("strobe-time.c", "strobe-time")
+        compile_tool("bump-time.c", "bump-time")
+
+
+# ----------------------------------------------------- clock primitives
+
+
+def parse_time(s: str) -> float:
+    """Decimal unix seconds from a `date +%s.%N` string
+    (nemesis/time.clj:54-58)."""
+    return float(s.strip())
+
+
+def clock_offset(remote_time: float) -> float:
+    """Remote seconds-since-epoch minus local control-host time: the
+    node's relative skew in seconds (nemesis/time.clj:60-64)."""
+    return remote_time - _time.time()
+
+
+def current_offset() -> float:
+    """Clock offset of the current ambient node (nemesis/time.clj:66-69)."""
+    return clock_offset(parse_time(c.exec_("date", "+%s.%N")))
+
+
+def reset_time() -> None:
+    """Reset the ambient node's clock to NTP (nemesis/time.clj:71-75)."""
+    with c.su():
+        c.exec_("ntpdate", "-b", "time.google.com")
+
+
+def reset_time_test(test: dict) -> None:
+    c.on_nodes(test, lambda t, n: reset_time())
+
+
+def bump_time(delta_ms) -> float:
+    """Adjust the ambient node's clock by delta ms; returns the node's
+    resulting offset in seconds (nemesis/time.clj:77-81)."""
+    with c.su():
+        return clock_offset(parse_time(
+            c.exec_(f"{INSTALL_DIR}/bump-time", delta_ms)))
+
+
+def strobe_time(delta_ms, period_ms, duration_s) -> None:
+    """Oscillate the ambient node's clock (nemesis/time.clj:83-87)."""
+    with c.su():
+        c.exec_(f"{INSTALL_DIR}/strobe-time", delta_ms, period_ms,
+                duration_s)
+
+
+# ------------------------------------------------------------- nemesis
+
+
+class ClockNemesis(Nemesis):
+    """The clock nemesis proper (nemesis/time.clj:89-139)."""
+
+    def setup(self, test):
+        c.on_nodes(test, lambda t, n: install())
+
+        def stop_ntp(t, n):
+            for svc in ("ntp", "ntpd"):
+                try:
+                    with c.su():
+                        c.exec_("service", svc, "stop")
+                except Exception:  # noqa: BLE001 - service may not exist
+                    pass
+
+        c.on_nodes(test, stop_ntp)
+        reset_time_test(test)
+        return self
+
+    def invoke(self, test, op: Op) -> Op:
+        f = op.get("f")
+        if f == "reset":
+            res = c.on_nodes(
+                test, lambda t, n: (reset_time(), current_offset())[1],
+                op.get("value"))
+        elif f == "check-offsets":
+            res = c.on_nodes(test, lambda t, n: current_offset())
+        elif f == "strobe":
+            m = op.get("value") or {}
+
+            def do_strobe(t, n):
+                spec = m[n]
+                strobe_time(spec["delta"], spec["period"], spec["duration"])
+                return current_offset()
+
+            res = c.on_nodes(test, do_strobe, list(m))
+        elif f == "bump":
+            m = op.get("value") or {}
+            res = c.on_nodes(test, lambda t, n: bump_time(m[n]), list(m))
+        else:
+            raise ValueError(f"clock nemesis doesn't handle :f {f!r}")
+        out = _ok(op)
+        out["clock-offsets"] = res
+        return out
+
+    def teardown(self, test):
+        reset_time_test(test)
+
+    def fs(self):
+        return {"reset", "strobe", "bump", "check-offsets"}
+
+
+def clock_nemesis() -> ClockNemesis:
+    return ClockNemesis()
+
+
+# ---------------------------------------------------------- generators
+# Op generators mirroring nemesis/time.clj:141-198: exponential deltas
+# from ~4ms to ~262s (2^(2+rand*16) ms), strobe periods 1ms-1s,
+# durations 0-32s.
+
+
+def _default_select(test):
+    return random_nonempty_subset(test.get("nodes") or [])
+
+
+def reset_gen_select(select: Callable) -> Callable:
+    def reset_op(test, ctx):
+        return {"type": "info", "f": "reset", "value": list(select(test))}
+    return reset_op
+
+
+def bump_gen_select(select: Callable) -> Callable:
+    def bump_op(test, ctx):
+        value = {n: int(gen.rand.choice([-1, 1])
+                        * math.pow(2, 2 + gen.rand.random() * 16))
+                 for n in select(test)}
+        return {"type": "info", "f": "bump", "value": value}
+    return bump_op
+
+
+def strobe_gen_select(select: Callable) -> Callable:
+    def strobe_op(test, ctx):
+        value = {n: {"delta": int(math.pow(2, 2 + gen.rand.random() * 16)),
+                     "period": int(math.pow(2, gen.rand.random() * 10)),
+                     "duration": gen.rand.random() * 32}
+                 for n in select(test)}
+        return {"type": "info", "f": "strobe", "value": value}
+    return strobe_op
+
+
+reset_gen = reset_gen_select(_default_select)
+bump_gen = bump_gen_select(_default_select)
+strobe_gen = strobe_gen_select(_default_select)
+
+
+def clock_gen(select: Optional[Callable] = None):
+    """Random schedule of clock-skew ops, always opening with a
+    check-offsets to establish a baseline (nemesis/time.clj:192-198)."""
+    select = select or _default_select
+    return gen.phases(
+        {"type": "info", "f": "check-offsets"},
+        gen.mix([reset_gen_select(select),
+                 bump_gen_select(select),
+                 strobe_gen_select(select)]))

@@ -1,0 +1,51 @@
+/* bump-time: shift the system wall clock by a signed delta, given in
+ * milliseconds, then print the resulting time as decimal unix seconds.
+ *
+ * Capability parallel of the reference's jepsen/resources/bump-time.c
+ * (used by jepsen.nemesis.time, nemesis/time.clj:77-81): the nemesis
+ * uploads this source to each node, compiles it with the node's gcc,
+ * and invokes it as /opt/jepsen/bump-time <delta-ms>.
+ *
+ * Exit codes: 0 ok, 1 bad usage, 2 settimeofday failed (needs root).
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/time.h>
+
+int main(int argc, char **argv) {
+  if (argc != 2) {
+    fprintf(stderr, "usage: %s <delta-ms>\n", argv[0]);
+    return 1;
+  }
+
+  char *end = NULL;
+  long long delta_ms = strtoll(argv[1], &end, 10);
+  if (end == argv[1] || *end != '\0') {
+    fprintf(stderr, "not a number: %s\n", argv[1]);
+    return 1;
+  }
+
+  struct timeval tv;
+  if (gettimeofday(&tv, NULL) != 0) {
+    perror("gettimeofday");
+    return 2;
+  }
+
+  long long usec = (long long)tv.tv_sec * 1000000LL + tv.tv_usec
+                   + delta_ms * 1000LL;
+  tv.tv_sec  = usec / 1000000LL;
+  tv.tv_usec = usec % 1000000LL;
+  if (tv.tv_usec < 0) { /* normalize for negative deltas past a second */
+    tv.tv_usec += 1000000LL;
+    tv.tv_sec  -= 1;
+  }
+
+  if (settimeofday(&tv, NULL) != 0) {
+    perror("settimeofday");
+    return 2;
+  }
+
+  printf("%lld.%06lld\n", (long long)tv.tv_sec, (long long)tv.tv_usec);
+  return 0;
+}

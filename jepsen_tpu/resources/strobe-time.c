@@ -1,0 +1,75 @@
+/* strobe-time: oscillate the system wall clock back and forth by a
+ * delta (milliseconds), flipping every period (milliseconds), for a
+ * total duration (seconds).
+ *
+ * Capability parallel of the reference's jepsen/resources/strobe-time.c
+ * (invoked by jepsen.nemesis.time, nemesis/time.clj:83-87) as
+ * /opt/jepsen/strobe-time <delta-ms> <period-ms> <duration-s>.
+ *
+ * The strobe is measured against CLOCK_MONOTONIC so the wall-clock
+ * manipulation we ourselves perform never confuses the schedule, and
+ * the final flip always returns the clock to its original offset
+ * (an even number of flips), so a strobe is net-zero skew.
+ *
+ * Exit codes: 0 ok, 1 bad usage, 2 clock syscall failed (needs root).
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+#include <sys/time.h>
+
+static long long mono_ms(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (long long)ts.tv_sec * 1000LL + ts.tv_nsec / 1000000LL;
+}
+
+static int shift_wall_clock(long long delta_ms) {
+  struct timeval tv;
+  if (gettimeofday(&tv, NULL) != 0) return -1;
+  long long usec = (long long)tv.tv_sec * 1000000LL + tv.tv_usec
+                   + delta_ms * 1000LL;
+  tv.tv_sec  = usec / 1000000LL;
+  tv.tv_usec = usec % 1000000LL;
+  if (tv.tv_usec < 0) {
+    tv.tv_usec += 1000000LL;
+    tv.tv_sec  -= 1;
+  }
+  return settimeofday(&tv, NULL);
+}
+
+int main(int argc, char **argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s <delta-ms> <period-ms> <duration-s>\n",
+            argv[0]);
+    return 1;
+  }
+  long long delta_ms  = strtoll(argv[1], NULL, 10);
+  long long period_ms = strtoll(argv[2], NULL, 10);
+  double    duration  = strtod(argv[3], NULL);
+  if (period_ms < 1) period_ms = 1;
+
+  long long start    = mono_ms();
+  long long end      = start + (long long)(duration * 1000.0);
+  long long flips    = 0;
+  int       sign     = 1;
+
+  while (mono_ms() < end) {
+    if (shift_wall_clock(sign * delta_ms) != 0) {
+      perror("settimeofday");
+      return 2;
+    }
+    sign = -sign;
+    flips++;
+    struct timespec nap = {period_ms / 1000, (period_ms % 1000) * 1000000L};
+    nanosleep(&nap, NULL);
+  }
+
+  if (flips % 2 == 1) { /* undo the dangling half-cycle */
+    if (shift_wall_clock(sign * delta_ms) != 0) {
+      perror("settimeofday");
+      return 2;
+    }
+  }
+  return 0;
+}

@@ -30,6 +30,19 @@ def minority_third(n: int) -> int:
     return max(0, int(math.ceil(n / 3)) - 1)
 
 
+def random_nonempty_subset(coll: Sequence) -> list:
+    """A random non-empty subset of coll (util.clj analogue used by the
+    clock/combined nemeses, nemesis/time.clj:149-152). Uses the generator
+    RNG so fixed_rand makes nemesis schedules deterministic."""
+    from jepsen_tpu import generator as _gen  # lazy: util is a leaf module
+    xs = list(coll)
+    if not xs:
+        return []
+    k = _gen.rand.randint(1, len(xs))
+    _gen.rand.shuffle(xs)
+    return xs[:k]
+
+
 # ------------------------------------------------------- parallel helpers
 def real_pmap(f: Callable, coll: Sequence) -> list:
     """Thread-per-element map; raises the most *meaningful* exception if

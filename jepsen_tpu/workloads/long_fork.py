@@ -147,14 +147,20 @@ def find_forks(ops: List) -> List[list]:
     for m in maps[1:]:
         if set(m) != set(keys):
             read_compare(maps[0], m)  # raises with the exemplar pair
-    # single-writer invariant: each key has at most one non-nil value
+    # single-writer invariant: each key has at most one non-nil value.
+    # Raise directly — read_compare may hit an incomparable key first
+    # and return None instead of raising on the conflicting one.
     for k in keys:
         distinct = {m[k] for m in maps if m[k] is not None}
         if len(distinct) > 1:
             a = next(m for m in maps if m[k] in distinct)
             b = next(m for m in maps
                      if m[k] is not None and m[k] != a[k])
-            read_compare(a, b)  # raises illegal-history
+            raise IllegalHistory(
+                {"type": "illegal-history", "key": k, "reads": [a, b],
+                 "msg": "These two read states contain distinct values "
+                        "for the same key; this checker assumes only one "
+                        "write occurs per key."})
     p = np.array([[0 if m[k] is None else 1 for k in keys] for m in maps],
                  dtype=np.int8)
     ge = (p[:, None, :] >= p[None, :, :]).all(axis=2)

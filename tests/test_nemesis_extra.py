@@ -1,0 +1,410 @@
+"""Clock, faketime, combined-package, and membership nemeses
+(reference behaviors: nemesis/time.clj, faketime.clj,
+nemesis/combined.clj, nemesis/membership.clj)."""
+
+from __future__ import annotations
+
+import re
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from jepsen_tpu import control as c
+from jepsen_tpu import db as _db
+from jepsen_tpu import faketime
+from jepsen_tpu import generator as gen
+from jepsen_tpu import net
+from jepsen_tpu import nemesis as n
+from jepsen_tpu.history import Op
+from jepsen_tpu.nemesis import combined, membership
+from jepsen_tpu.nemesis import time as nt
+
+
+# --------------------------------------------------------- fake remote
+
+
+class ScriptedRemote(c.Remote):
+    """Records every command; answers clock queries with a scripted
+    per-host offset so the clock nemesis sees believable node clocks."""
+
+    def __init__(self, log, offsets):
+        self.log = log          # shared list of (host, cmd)
+        self.offsets = offsets  # shared dict host -> seconds of skew
+        self.host = None
+
+    def connect(self, conn_spec):
+        r = ScriptedRemote(self.log, self.offsets)
+        r.host = conn_spec.get("host")
+        return r
+
+    def disconnect(self):
+        pass
+
+    def execute(self, ctx, cmd):
+        import time as _t
+        self.log.append((self.host, cmd))
+        if "date +%s.%N" in cmd:
+            now = _t.time() + self.offsets.get(self.host, 0.0)
+            return c.Result(cmd, 0, f"{now:.9f}", "")
+        m = re.search(r"bump-time (-?\d+)$", cmd)
+        if m:
+            delta = int(m.group(1)) / 1000.0
+            self.offsets[self.host] = self.offsets.get(self.host, 0) + delta
+            now = _t.time() + self.offsets[self.host]
+            return c.Result(cmd, 0, f"{now:.6f}", "")
+        if "ntpdate" in cmd:
+            self.offsets[self.host] = 0.0
+            return c.Result(cmd, 0, "", "")
+        return c.Result(cmd, 0, "", "")
+
+    def upload(self, local_paths, remote_path):
+        self.log.append((self.host, f"UPLOAD {local_paths} {remote_path}"))
+
+    def download(self, remote_paths, local_path):
+        pass
+
+
+def scripted_test(nodes=("n1", "n2", "n3")):
+    log, offsets = [], {}
+    return {"nodes": list(nodes),
+            "remote": ScriptedRemote(log, offsets),
+            "net": net.mem()}, log, offsets
+
+
+# -------------------------------------------------------- C helpers
+
+
+def test_clock_helper_sources_compile(tmp_path):
+    src_dir = Path(nt.RESOURCE_DIR)
+    for name in ("bump-time", "strobe-time"):
+        binary = tmp_path / name
+        subprocess.run(["gcc", "-O2", "-o", str(binary),
+                        str(src_dir / f"{name}.c")], check=True)
+        # Wrong usage exits 1 without touching the clock.
+        r = subprocess.run([str(binary)], capture_output=True)
+        assert r.returncode == 1
+        assert b"usage" in r.stderr
+
+
+# ------------------------------------------------------ clock nemesis
+
+
+def test_clock_nemesis_setup_installs_tools():
+    test, log, _ = scripted_test()
+    nem = nt.clock_nemesis().setup(test)
+    uploads = [cmd for _, cmd in log if cmd.startswith("UPLOAD")]
+    # Both C sources uploaded to every node.
+    assert len(uploads) == 2 * len(test["nodes"])
+    gcc_runs = [cmd for _, cmd in log if "gcc" in cmd]
+    assert len(gcc_runs) == 2 * len(test["nodes"])
+    nem.teardown(test)
+
+
+def test_clock_nemesis_bump_and_offsets():
+    test, log, offsets = scripted_test()
+    nem = nt.clock_nemesis().setup(test)
+    op = Op({"type": "info", "f": "bump",
+             "value": {"n1": 5000, "n2": -3000}})
+    out = nem.invoke(test, op)
+    assert out["type"] == "info"
+    co = out["clock-offsets"]
+    assert set(co) == {"n1", "n2"}
+    assert co["n1"] == pytest.approx(5.0, abs=0.5)
+    assert co["n2"] == pytest.approx(-3.0, abs=0.5)
+
+    check = nem.invoke(test, Op({"type": "info", "f": "check-offsets"}))
+    assert set(check["clock-offsets"]) == {"n1", "n2", "n3"}
+
+    reset = nem.invoke(test, Op({"type": "info", "f": "reset",
+                                 "value": ["n1", "n2"]}))
+    assert reset["clock-offsets"]["n1"] == pytest.approx(0.0, abs=0.5)
+
+
+def test_clock_gen_schedule():
+    test, _, _ = scripted_test()
+    test["concurrency"] = 2
+    ctx = gen.context(test)
+    with gen.fixed_rand(7):
+        g = nt.clock_gen()
+        res = gen.gen_op(g, test, ctx)
+        op, g = res
+        # Always opens with check-offsets (nemesis/time.clj:192-198).
+        assert op["f"] == "check-offsets"
+        event = Op(dict(op, type="info"))
+        g = gen.gen_update(g, test, ctx, event)
+        fs = set()
+        for _ in range(30):
+            res = gen.gen_op(g, test, ctx)
+            if res is None:
+                break
+            op, g = res
+            if op is gen.PENDING:
+                break
+            fs.add(op["f"])
+            if op["f"] == "bump":
+                for delta in op["value"].values():
+                    assert 4 <= abs(delta) <= 2 ** 18 * 4
+            if op["f"] == "strobe":
+                for spec in op["value"].values():
+                    assert spec["period"] >= 1
+                    assert 0 <= spec["duration"] <= 32
+        assert fs <= {"reset", "bump", "strobe"}
+        assert len(fs) >= 2
+
+
+# ----------------------------------------------------------- faketime
+
+
+def test_faketime_script():
+    s = faketime.script("/opt/db/bin/db", -30, 2.0)
+    assert s.startswith("#!/bin/bash\n")
+    assert 'faketime -m -f "-30s x2.0" /opt/db/bin/db "$@"' in s
+    s2 = faketime.script("/bin/x", 5, 0.5)
+    assert '"+5s x0.5"' in s2
+
+
+def test_faketime_rand_factor_bounds():
+    with gen.fixed_rand(3):
+        for _ in range(100):
+            rate = faketime.rand_factor(2.5)
+            mx = 2 / (1 + 1 / 2.5)
+            assert mx / 2.5 <= rate <= mx
+            # fastest/slowest possible draw ratio is exactly the factor
+
+
+def test_faketime_wrap_unwrap(tmp_path):
+    # Run against the real local filesystem via LocalRemote.
+    binary = tmp_path / "victim"
+    binary.write_text("#!/bin/bash\necho real\n")
+    binary.chmod(0o755)
+    remote = c.LocalRemote().connect({})
+    with c.on_host(remote, "local"):
+        faketime.wrap(str(binary), 10, 1.5)
+        wrapped = binary.read_text()
+        assert "faketime" in wrapped
+        assert (tmp_path / "victim.no-faketime").exists()
+        # Idempotent: wrapping again keeps the original.
+        faketime.wrap(str(binary), 10, 1.5)
+        assert "real" in (tmp_path / "victim.no-faketime").read_text()
+        faketime.unwrap(str(binary))
+        assert binary.read_text().endswith("echo real\n")
+        assert not (tmp_path / "victim.no-faketime").exists()
+
+
+# ----------------------------------------------------- combined package
+
+
+class FakeDB(_db.DB, _db.Process, _db.Pause, _db.Primary):
+    def __init__(self):
+        self.events = []
+
+    def setup(self, test, node):
+        pass
+
+    def teardown(self, test, node):
+        pass
+
+    def start(self, test, node):
+        self.events.append(("start", node))
+        return "started"
+
+    def kill(self, test, node):
+        self.events.append(("kill", node))
+        return "killed"
+
+    def pause(self, test, node):
+        self.events.append(("pause", node))
+        return "paused"
+
+    def resume(self, test, node):
+        self.events.append(("resume", node))
+        return "resumed"
+
+    def primaries(self, test):
+        return [test["nodes"][0]]
+
+
+def test_db_nodes_specs():
+    test = {"nodes": ["a", "b", "c", "d", "e"]}
+    db = FakeDB()
+    with gen.fixed_rand(5):
+        assert combined.db_nodes(test, db, "all") == test["nodes"]
+        assert len(combined.db_nodes(test, db, "one")) == 1
+        assert len(combined.db_nodes(test, db, "minority")) == 2
+        assert len(combined.db_nodes(test, db, "majority")) == 3
+        assert len(combined.db_nodes(test, db, "minority-third")) == 1
+        assert combined.db_nodes(test, db, ["a", "b"]) == ["a", "b"]
+        sub = combined.db_nodes(test, db, None)
+        assert 1 <= len(sub) <= 5
+        prim = combined.db_nodes(test, db, "primaries")
+        assert prim == ["a"]
+    assert "primaries" in combined.node_specs(db)
+
+
+def test_grudge_specs():
+    test = {"nodes": ["a", "b", "c", "d", "e"]}
+    db = FakeDB()
+    with gen.fixed_rand(5):
+        g1 = combined.grudge(test, db, "one")
+        # Exactly one isolated node dropping the other four.
+        isolated = [k for k, v in g1.items() if len(v) == 4]
+        assert len(isolated) == 1
+        g2 = combined.grudge(test, db, "majority")
+        sizes = sorted({len(v) for v in g2.values()})
+        assert sizes == [2, 3]
+        g3 = combined.grudge(test, db, "majorities-ring")
+        assert set(g3) == set(test["nodes"])
+        g4 = combined.grudge(test, db, "primaries")
+        assert set(g4["a"]) == {"b", "c", "d", "e"}
+        # None isolates a random proper nonempty subset.
+        g5 = combined.grudge(test, db, None)
+        assert g5 and all(v for v in g5.values())
+
+
+def test_empty_faults_means_no_packages():
+    assert combined.nemesis_packages({"db": FakeDB(), "faults": []}) == []
+
+
+def test_nemesis_package_composition():
+    db = FakeDB()
+    test, log, _ = scripted_test(("a", "b", "c"))
+    test["db"] = db
+    pkg = combined.nemesis_package(
+        {"db": db, "faults": ["partition", "kill", "pause"], "interval": 1})
+    nem = pkg["nemesis"].setup(test)
+    fs = nem.fs()
+    assert {"start-partition", "stop-partition", "start", "kill",
+            "pause", "resume"} <= fs
+    assert pkg["final_generator"]
+
+    # Partition ops route through to the MemNet.
+    out = nem.invoke(test, Op({"type": "info", "f": "start-partition",
+                               "value": "majority"}))
+    assert out["f"] == "start-partition"
+    assert test["net"].partitioned()
+    out = nem.invoke(test, Op({"type": "info", "f": "stop-partition"}))
+    assert not test["net"].partitioned()
+
+    # Kill ops hit the DB on the right nodes.
+    with gen.fixed_rand(1):
+        out = nem.invoke(test, Op({"type": "info", "f": "kill",
+                                   "value": "all"}))
+    assert sorted(n_ for f, n_ in db.events if f == "kill") == ["a", "b", "c"]
+    assert set(out["value"].values()) == {"killed"}
+    nem.teardown(test)
+
+    # perf legend covers each package.
+    names = {spec["name"] for spec in pkg["perf"]}
+    assert {"partition", "kill", "pause"} <= names
+
+
+def test_clock_package_renames_fs():
+    db = FakeDB()
+    pkg = combined.clock_package({"db": db, "faults": {"clock"},
+                                  "interval": 1})
+    assert pkg["nemesis"].fs() == {"reset-clock", "check-clock-offsets",
+                                   "strobe-clock", "bump-clock"}
+    test, _, _ = scripted_test(("a", "b"))
+    nem = pkg["nemesis"].setup(test)
+    out = nem.invoke(test, Op({"type": "info", "f": "bump-clock",
+                               "value": {"a": 1000}}))
+    assert out["f"] == "bump-clock"
+    assert out["clock-offsets"]["a"] == pytest.approx(1.0, abs=0.5)
+
+
+# --------------------------------------------------------- membership
+
+
+class FakeClusterState(membership.State):
+    """A scripted membership state machine over an in-memory cluster.
+    The cluster's actual member set lives in `actual`; node views lag
+    behind until the poller refreshes them."""
+
+    def __init__(self, actual, plan):
+        self.actual = actual      # {"members": set}
+        self.plan = plan          # list of ("add-node"|"remove-node", n)
+        self.node_views = None
+        self.view = None
+        self.pending = None
+
+    def node_view(self, test, node):
+        return frozenset(self.actual["members"])
+
+    def merge_views(self, test):
+        views = list((self.node_views or {}).values())
+        if not views:
+            return None
+        return frozenset().union(*views)
+
+    def fs(self):
+        return {"add-node", "remove-node"}
+
+    def op(self, test):
+        if self.pending:
+            return "pending"  # one change at a time
+        if not self.plan:
+            return None
+        f, node = self.plan[0]
+        return {"type": "info", "f": f, "value": node}
+
+    def invoke(self, test, op):
+        f, node = op["f"], op["value"]
+        if f == "add-node":
+            self.actual["members"].add(node)
+        else:
+            self.actual["members"].discard(node)
+        self.plan.pop(0)
+        done = Op(op)
+        done["type"] = "info"
+        return done
+
+    def resolve_op(self, test, op_pair):
+        inv = membership.thaw(op_pair[0])
+        node, f = inv["value"], inv["f"]
+        view = self.view or frozenset()
+        applied = (node in view) if f == "add-node" else (node not in view)
+        return self if applied else None
+
+
+def test_membership_nemesis_lifecycle():
+    actual = {"members": {"n1", "n2", "n3"}}
+    state = FakeClusterState(actual, [("add-node", "n4"),
+                                      ("remove-node", "n1")])
+    test = {"nodes": ["n1", "n2", "n3"], "concurrency": 2}
+    pkg = membership.package(
+        {"faults": {"membership"}, "interval": 0,
+         "membership": {"state": state, "node_view_interval": 0.05}})
+    assert pkg is not None
+    nem = pkg["nemesis"].setup(test)
+    try:
+        ctx = gen.context(test)
+        g = membership.MembershipGenerator(nem)
+
+        op, g = g.op(test, ctx)
+        assert op["f"] == "add-node" and op["value"] == "n4"
+        done = nem.invoke(test, op)
+        assert done["type"] == "info"
+
+        # Pollers refresh views; the pending op resolves once the view
+        # reflects the addition.
+        import time as _t
+        deadline = _t.time() + 5
+        while _t.time() < deadline and nem.state.pending:
+            _t.sleep(0.05)
+        assert not nem.state.pending
+        assert "n4" in nem.state.view
+
+        op, g = g.op(test, ctx)
+        assert op["f"] == "remove-node" and op["value"] == "n1"
+        nem.invoke(test, op)
+        deadline = _t.time() + 5
+        while _t.time() < deadline and nem.state.pending:
+            _t.sleep(0.05)
+        assert not nem.state.pending
+        assert "n1" not in nem.state.view
+
+        # Plan exhausted: generator is done.
+        assert g.op(test, ctx) is None
+    finally:
+        nem.teardown(test)
