@@ -29,3 +29,6 @@ from jepsen_tpu.checker.suite import (  # noqa: F401
     unique_ids,
 )
 from jepsen_tpu.checker.linearizable import linearizable  # noqa: F401
+from jepsen_tpu.checker.clock import clock_plot  # noqa: F401
+from jepsen_tpu.checker.perf import perf as perf_checker  # noqa: F401
+from jepsen_tpu.checker.timeline import html as timeline_html  # noqa: F401

@@ -1,0 +1,89 @@
+"""Clock-skew plot (reference: jepsen/src/jepsen/checker/clock.clj).
+
+Consumes ops carrying :clock-offsets {node: seconds} — produced by the
+clock nemesis (nemesis/time.py) — and renders each node's skew over
+time as a step series into clock-skew.svg (clock.clj:13-75)."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from jepsen_tpu.checker import plot as pl
+from jepsen_tpu.checker.core import Checker
+from jepsen_tpu.util import nanos_to_secs
+
+SERIES_COLORS = ("#1f77b4", "#ff7f0e", "#2ca02c", "#d62728", "#9467bd",
+                 "#8c564b", "#e377c2", "#7f7f7f", "#bcbd22", "#17becf")
+
+
+def history_to_datasets(history) -> Dict:
+    """node -> [[t, offset], ...], with a final sample pinned at the
+    history's last time so steps extend to the edge (clock.clj:13-34)."""
+    final_time = 0.0
+    for o in history:
+        if o.get("time") is not None:
+            final_time = max(final_time, nanos_to_secs(o["time"]))
+    series: Dict[str, List[list]] = {}
+    for o in history:
+        offsets = o.get("clock-offsets")
+        if not offsets:
+            continue
+        t = nanos_to_secs(o.get("time") or 0)
+        for node, off in offsets.items():
+            series.setdefault(node, []).append([t, off])
+    for node, points in series.items():
+        points.append([final_time, points[-1][1]])
+    return series
+
+
+def short_node_names(nodes: List[str]) -> List[str]:
+    """Strip common trailing domain components (clock.clj:36-45)."""
+    split = [str(n).split(".") for n in nodes]
+    if len(split) < 2:
+        return [str(n) for n in nodes]
+    # Longest common suffix across all names, kept only while proper.
+    k = 0
+    while all(len(s) > k + 1 for s in split) and \
+            len({tuple(s[len(s) - k - 1:]) for s in split}) == 1:
+        k += 1
+    return [".".join(s[:len(s) - k]) for s in split]
+
+
+class ClockPlot(Checker):
+    """(clock.clj:47-75). Always valid; writes clock-skew.svg."""
+
+    def check(self, test, history, opts=None):
+        datasets = history_to_datasets(history)
+        path = None
+        if datasets:
+            nodes = sorted(datasets, key=str)
+            names = short_node_names(nodes)
+            series = [{"title": name,
+                       "with": "steps",
+                       "color": SERIES_COLORS[i % len(SERIES_COLORS)],
+                       "point_type": i,
+                       "data": datasets[node]}
+                      for i, (node, name) in enumerate(zip(nodes, names))]
+            plot = {"title": f"{(test or {}).get('name', 'test')} "
+                             f"clock skew",
+                    "ylabel": "Skew (s)",
+                    "series": series}
+            nemeses = ((opts or {}).get("nemeses")
+                       or ((test or {}).get("plot") or {}).get("nemeses"))
+            try:
+                plot = pl.with_nemeses(plot, history, nemeses)
+                svg = pl.render(plot)
+                store = (test or {}).get("store")
+                if store is not None:
+                    sub = (opts or {}).get("subdirectory")
+                    parts = ([sub, "clock-skew.svg"] if sub
+                             else ["clock-skew.svg"])
+                    store.write_file(parts, svg)
+                    path = store.path(*parts)
+            except pl.NoPoints:
+                pass
+        return {"valid?": True, "clock-skew-graph": path}
+
+
+def clock_plot() -> ClockPlot:
+    return ClockPlot()

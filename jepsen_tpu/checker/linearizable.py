@@ -71,7 +71,20 @@ class Linearizable(Checker):
         else:
             raise ValueError(f"unknown linearizability algorithm {algo!r}")
         r["analyzer"] = algo
-        return _truncate(r)
+        r = _truncate(r)
+
+        # On failure, render the counterexample SVG into the store, as
+        # the reference does via knossos.linear.report
+        # (checker.clj:203-207). Rendered from the truncated analysis:
+        # thousands of final-paths would take hours, just like writing
+        # them would (checker.clj:210-213).
+        if r.get("valid?") is False and (test or {}).get("store"):
+            try:
+                from jepsen_tpu.checker import linear_report
+                linear_report.render_analysis_file(h, r, test, opts)
+            except Exception:  # noqa: BLE001 - plots must never fail a check
+                pass
+        return r
 
 
 def _engine_available() -> bool:

@@ -1,0 +1,205 @@
+"""Performance graphs: latency points, latency quantiles, op rate
+(reference: jepsen/src/jepsen/checker/perf.clj + checker.clj:794-826).
+
+Artifacts are SVG (latency-raw.svg, latency-quantiles.svg, rate.svg)
+written into the test's store directory; the reference writes PNGs via
+gnuplot. The perf *checker* composes all three and always returns
+{"valid?": True} — graphs are diagnostics, not validity judgments
+(checker.clj:794-826)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+from jepsen_tpu.checker import plot as pl
+from jepsen_tpu.checker.core import Checker
+from jepsen_tpu.util import history_to_latencies, nanos_to_secs
+
+TYPES = ("ok", "info", "fail")  # perf.clj:173-175
+
+TYPE_COLOR = {"ok": "#81BFFC",  # perf.clj:177-181
+              "info": "#FFA400",
+              "fail": "#FF1E90"}
+
+QUANTILE_COLORS = ("red", "orange", "purple", "blue", "green", "grey")
+
+
+def latency_point(inv, lat_ns) -> list:
+    """[time-of-invoke (s), latency (ms)] (perf.clj:143-148)."""
+    return [nanos_to_secs(inv.get("time") or 0), lat_ns / 1e6]
+
+
+def fs_to_points(fs: List) -> Dict:
+    """f -> marker index, one marker shape per :f (perf.clj:150-156)."""
+    return {f: i for i, f in enumerate(fs)}
+
+
+def qs_to_colors(qs: List[float]) -> Dict:
+    """quantile -> color, highest quantile reddest (perf.clj:158-171)."""
+    return dict(zip(sorted(qs, reverse=True),
+                    itertools.cycle(QUANTILE_COLORS)))
+
+
+def invokes_by_f_type(pairs) -> Dict:
+    """f -> completion-type -> [(invoke, latency)] (perf.clj:96-117)."""
+    out: Dict = {}
+    for inv, comp, lat in pairs:
+        out.setdefault(inv.get("f"), {}) \
+           .setdefault(comp.get("type"), []).append((inv, lat))
+    return out
+
+
+def _polysort(xs):
+    return sorted(xs, key=lambda x: (str(type(x)), str(x)))
+
+
+def _write(test, opts, filename: str, svg: str) -> Optional[str]:
+    store = (test or {}).get("store")
+    if store is None:
+        return None
+    sub = (opts or {}).get("subdirectory")
+    parts = [sub, filename] if sub else [filename]
+    store.write_file(parts, svg)
+    return store.path(*parts)
+
+
+def _nemeses(test, opts):
+    return ((opts or {}).get("nemeses")
+            or ((test or {}).get("plot") or {}).get("nemeses"))
+
+
+def point_graph(test, history, opts=None, pairs=None) -> Optional[str]:
+    """Raw latency scatter: one point per completed op, colored by
+    completion type, marker by :f (perf.clj:484-511). Returns the
+    written path, or None with no data or no store to write to. Pass
+    precomputed history_to_latencies pairs to avoid re-pairing."""
+    if (test or {}).get("store") is None:
+        return None
+    pairs = pairs if pairs is not None else history_to_latencies(history)
+    datasets = invokes_by_f_type(pairs)
+    fs = _polysort(datasets)
+    f_marker = fs_to_points(fs)
+    series = []
+    for f in fs:
+        for t in TYPES:
+            data = datasets.get(f, {}).get(t)
+            if data:
+                series.append({
+                    "title": f"{f} {t}",
+                    "with": "points",
+                    "color": TYPE_COLOR[t],
+                    "point_type": f_marker[f],
+                    "data": [latency_point(inv, lat) for inv, lat in data]})
+    plot = {"title": f"{(test or {}).get('name', 'test')} latency",
+            "ylabel": "Latency (ms)",
+            "logscale": "y",
+            "series": series}
+    try:
+        plot = pl.with_nemeses(plot, history, _nemeses(test, opts))
+        svg = pl.render(plot)
+    except pl.NoPoints:
+        return None
+    return _write(test, opts, "latency-raw.svg", svg)
+
+
+def quantiles_graph(test, history, opts=None,
+                    dt: float = 30,
+                    qs=(0.5, 0.95, 0.99, 1), pairs=None) -> Optional[str]:
+    """Latency quantiles over dt-second windows, per :f
+    (perf.clj:513-552)."""
+    if (test or {}).get("store") is None:
+        return None
+    pairs = pairs if pairs is not None else history_to_latencies(history)
+    by_f: Dict = {}
+    for inv, _comp, lat in pairs:
+        by_f.setdefault(inv.get("f"), []).append(latency_point(inv, lat))
+    fs = _polysort(by_f)
+    f_marker = fs_to_points(fs)
+    q_color = qs_to_colors(list(qs))
+    series = []
+    for f in fs:
+        quant = pl.latencies_to_quantiles(dt, list(qs), by_f[f])
+        for q in qs:
+            series.append({"title": f"{f} {q}",
+                           "with": "linespoints",
+                           "color": q_color[q],
+                           "point_type": f_marker[f],
+                           "data": quant.get(q) or []})
+    plot = {"title": f"{(test or {}).get('name', 'test')} latency",
+            "ylabel": "Latency (ms)",
+            "logscale": "y",
+            "series": series}
+    try:
+        plot = pl.with_nemeses(plot, history, _nemeses(test, opts))
+        svg = pl.render(plot)
+    except pl.NoPoints:
+        return None
+    return _write(test, opts, "latency-quantiles.svg", svg)
+
+
+def rate_graph(test, history, opts=None, dt: float = 10) -> Optional[str]:
+    """Completion rate (hz) in dt-second buckets, by f and type
+    (perf.clj:554-599). Nemesis completions are excluded (only integer
+    processes count)."""
+    if (test or {}).get("store") is None:
+        return None
+    td = 1.0 / dt
+    t_max = 0.0
+    rates: Dict = {}
+    for o in history:
+        t_max = max(t_max, nanos_to_secs(o.get("time") or 0))
+        if o.get("type") == "invoke" or \
+                not isinstance(o.get("process"), int):
+            continue
+        b = pl.bucket_time(dt, nanos_to_secs(o.get("time") or 0))
+        key = (o.get("f"), o.get("type"))
+        rates[key] = rates.get(key, {})
+        rates[key][b] = rates[key].get(b, 0.0) + td
+    fs = _polysort({f for f, _t in rates})
+    f_marker = fs_to_points(fs)
+    series = []
+    for f in fs:
+        for t in TYPES:
+            m = rates.get((f, t))
+            if m:
+                series.append({
+                    "title": f"{f} {t}",
+                    "with": "linespoints",
+                    "color": TYPE_COLOR[t],
+                    "point_type": f_marker[f],
+                    "data": [[b, m.get(b, 0.0)]
+                             for b in pl.buckets(dt, t_max)]})
+    plot = {"title": f"{(test or {}).get('name', 'test')} rate",
+            "ylabel": "Throughput (hz)",
+            "series": series}
+    try:
+        plot = pl.with_nemeses(plot, history, _nemeses(test, opts))
+        svg = pl.render(plot)
+    except pl.NoPoints:
+        return None
+    return _write(test, opts, "rate.svg", svg)
+
+
+class Perf(Checker):
+    """Renders latency and rate graphs (checker.clj:794-826). Always
+    valid; the value is the artifacts."""
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+
+    def check(self, test, history, opts=None):
+        o = {**self.opts, **(opts or {})}
+        # Pair invocations with completions once; both latency graphs
+        # reuse the result.
+        pairs = (history_to_latencies(history)
+                 if (test or {}).get("store") is not None else [])
+        return {"valid?": True,
+                "latency-graph": point_graph(test, history, o, pairs=pairs),
+                "latency-quantiles-graph":
+                    quantiles_graph(test, history, o, pairs=pairs),
+                "rate-graph": rate_graph(test, history, o)}
+
+
+def perf(opts: Optional[dict] = None) -> Perf:
+    return Perf(opts)

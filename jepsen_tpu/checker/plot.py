@@ -1,0 +1,416 @@
+"""Self-contained SVG plotting engine for performance graphs
+(reference: jepsen/src/jepsen/checker/perf.clj's gnuplot layer).
+
+The reference shells out to gnuplot (perf.clj:417-480 `plot!`); this
+build renders SVG directly — no external process, no raster output,
+and the artifacts diff cleanly in version control. The plot *model* is
+kept the same shape as the reference's so perf.py reads like its
+counterpart: a plot is a dict
+
+    {"title":     str,
+     "ylabel":    str,
+     "series":    [series...],
+     "logscale":  "y" | None,
+     "xrange":    (xmin, xmax) | None,
+     "yrange":    (ymin, ymax) | None,
+     "nemeses":   [nemesis-activity...]}     # see with_nemeses
+
+and a series is
+
+    {"title": str | None,
+     "with":  "points" | "lines" | "linespoints" | "steps",
+     "color": "#rrggbb",
+     "point_type": int,          # marker shape index
+     "data":  [(x, y), ...]}
+
+Bucketing/quantile helpers mirror perf.clj:21-85; range broadening
+mirrors perf.clj:334-360; nemesis regions/lines mirror
+perf.clj:240-310."""
+
+from __future__ import annotations
+
+import html as _html
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from jepsen_tpu.util import nanos_to_secs, nemesis_intervals
+
+DEFAULT_NEMESIS_COLOR = "#cccccc"
+NEMESIS_ALPHA = 0.6
+
+WIDTH, HEIGHT = 900, 400          # plot canvas (perf.clj preamble size)
+LEGEND_WIDTH = 180
+MARGIN = {"left": 70, "right": 20, "top": 40, "bottom": 45}
+
+
+# ------------------------------------------------------------ buckets
+
+
+def bucket_scale(dt: float, b: int) -> float:
+    """Midpoint time of bucket number b (perf.clj:21-25)."""
+    return b * dt + dt / 2
+
+
+def bucket_time(dt: float, t: float) -> float:
+    """Midpoint of the bucket containing time t (perf.clj:27-31)."""
+    return bucket_scale(dt, int(t // dt))
+
+
+def buckets(dt: float, tmax: float) -> List[float]:
+    """Bucket midpoints from 0 up to tmax (perf.clj:33-39)."""
+    out, b = [], 0
+    while True:
+        t = bucket_scale(dt, b)
+        if t > tmax:
+            return out
+        out.append(t)
+        b += 1
+
+
+def bucket_points(dt: float, points: Sequence) -> Dict[float, list]:
+    """Group [t, v] points by bucket midpoint, sorted (perf.clj:41-48)."""
+    out: Dict[float, list] = {}
+    for p in points:
+        out.setdefault(bucket_time(dt, p[0]), []).append(p)
+    return dict(sorted(out.items()))
+
+
+def quantiles(qs: Sequence[float], points: Sequence) -> Optional[dict]:
+    """Map of quantile -> value at that quantile (perf.clj:50-61)."""
+    s = sorted(points)
+    if not s:
+        return None
+    n = len(s)
+    return {q: s[min(n - 1, int(math.floor(n * q)))] for q in qs}
+
+
+def latencies_to_quantiles(dt: float, qs: Sequence[float],
+                           points: Sequence) -> Dict[float, list]:
+    """Per-bucket latency quantiles: {q: [[t, v], ...]} (perf.clj:63-86)."""
+    bs = [(t, quantiles(qs, [p[1] for p in ps]))
+          for t, ps in bucket_points(dt, points).items()]
+    return {q: [[t, qv[q]] for t, qv in bs] for q in qs}
+
+
+def broaden_range(rng: Tuple[float, float]) -> Tuple[float, float]:
+    """Expand a range to land on tidy integral boundaries
+    (perf.clj:334-357)."""
+    a, b = rng
+    if a == b:
+        return (a - 1, a + 1)
+    size = abs(float(b) - float(a))
+    grid = size / 10
+    scale = 10 ** round(math.log10(grid)) if grid > 0 else 1
+    a2 = a - (a % scale)
+    m = b % scale
+    b2 = b if (m / scale) < 0.001 else (scale + b - m)
+    return (min(a, a2), max(b, b2))
+
+
+def with_range(plot: dict) -> dict:
+    """Fill in missing xrange/yrange from the series data
+    (perf.clj:368-392). Raises NoPoints when every series is empty."""
+    data = [p for s in plot.get("series", []) for p in s.get("data", [])]
+    if not data:
+        raise NoPoints(plot)
+    xs = [p[0] for p in data]
+    ys = [p[1] for p in data]
+    xrange = broaden_range((min(xs), max(xs)))
+    if plot.get("logscale") == "y":
+        yrange = (min(ys), max(ys))  # don't broaden toward 0 on log scale
+    else:
+        yrange = broaden_range((min(ys), max(ys)))
+    plot = dict(plot)
+    plot.setdefault("xrange", xrange)
+    plot.setdefault("yrange", yrange)
+    if plot["xrange"] is None:
+        plot["xrange"] = xrange
+    if plot["yrange"] is None:
+        plot["yrange"] = yrange
+    return plot
+
+
+class NoPoints(Exception):
+    """No data to plot (perf.clj's ::no-points condition)."""
+
+
+def has_data(plot: dict) -> bool:
+    return any(s.get("data") for s in plot.get("series", []))
+
+
+def without_empty_series(plot: dict) -> dict:
+    plot = dict(plot)
+    plot["series"] = [s for s in plot.get("series", []) if s.get("data")]
+    return plot
+
+
+# ----------------------------------------------------- nemesis overlay
+
+
+def nemesis_ops(nemeses: Optional[Sequence[dict]], history) -> List[dict]:
+    """Partition nemesis ops in the history among the nemesis specs by
+    their :f sets; unmatched ops get a default spec (perf.clj:145-177).
+    Spec keys: name, color, start (set of fs), stop, fs."""
+    nemeses = list(nemeses or [])
+    index = {}
+    for spec in nemeses:
+        index.update({f: spec.get("name") for f in _spec_fs(spec)})
+    by_name: Dict[Optional[str], list] = {}
+    for o in history:
+        if o.get("process") == "nemesis":
+            by_name.setdefault(index.get(o.get("f")), []).append(o)
+    out = []
+    for spec in nemeses:
+        ops = by_name.get(spec.get("name"))
+        if ops:
+            out.append({**spec, "ops": ops})
+    if by_name.get(None):
+        out.append({"name": "nemesis", "ops": by_name[None]})
+    return out
+
+
+def _spec_fs(spec: dict) -> tuple:
+    """(starts, stops, others) for a nemesis spec, flattened. The
+    'start'/'stop' defaults apply only to specs that name no fs at all —
+    an fs-only spec (e.g. membership) must not capture other packages'
+    start/stop ops."""
+    starts, stops = spec.get("start"), spec.get("stop")
+    others = list(spec.get("fs") or [])
+    if starts is None and stops is None and not others:
+        starts, stops = ["start"], ["stop"]
+    return list(starts or []) + list(stops or []) + others
+
+
+def _spec_start_stop(spec: dict) -> tuple:
+    starts, stops = spec.get("start"), spec.get("stop")
+    if starts is None and stops is None and not spec.get("fs"):
+        starts, stops = ["start"], ["stop"]
+    return tuple(starts or []), tuple(stops or [])
+
+
+def nemesis_activity(nemeses: Optional[Sequence[dict]],
+                     history) -> List[dict]:
+    """Augment each active spec with [start, stop] op intervals
+    (perf.clj:179-190)."""
+    out = []
+    for spec in nemesis_ops(nemeses, history):
+        starts, stops = _spec_start_stop(spec)
+        ivs = nemesis_intervals(spec["ops"], fs_start=starts,
+                                fs_stop=stops)
+        out.append({**spec, "intervals": ivs})
+    return out
+
+
+def with_nemeses(plot: dict, history, nemeses) -> dict:
+    plot = dict(plot)
+    plot["nemeses"] = nemesis_activity(nemeses, history)
+    return plot
+
+
+# ------------------------------------------------------------- render
+
+
+MARKERS = ("circle", "square", "triangle", "diamond", "plus", "cross")
+
+
+def _marker_svg(shape: str, x: float, y: float, r: float,
+                color: str) -> str:
+    if shape == "circle":
+        return (f'<circle cx="{x:.1f}" cy="{y:.1f}" r="{r}" '
+                f'fill="{color}"/>')
+    if shape == "square":
+        return (f'<rect x="{x - r:.1f}" y="{y - r:.1f}" width="{2 * r}" '
+                f'height="{2 * r}" fill="{color}"/>')
+    if shape == "triangle":
+        pts = f"{x:.1f},{y - r:.1f} {x - r:.1f},{y + r:.1f} " \
+              f"{x + r:.1f},{y + r:.1f}"
+        return f'<polygon points="{pts}" fill="{color}"/>'
+    if shape == "diamond":
+        pts = f"{x:.1f},{y - r:.1f} {x + r:.1f},{y:.1f} " \
+              f"{x:.1f},{y + r:.1f} {x - r:.1f},{y:.1f}"
+        return f'<polygon points="{pts}" fill="{color}"/>'
+    if shape == "plus":
+        return (f'<path d="M{x - r:.1f} {y:.1f}H{x + r:.1f}'
+                f'M{x:.1f} {y - r:.1f}V{y + r:.1f}" stroke="{color}" '
+                f'stroke-width="1.5"/>')
+    return (f'<path d="M{x - r:.1f} {y - r:.1f}L{x + r:.1f} {y + r:.1f}'
+            f'M{x + r:.1f} {y - r:.1f}L{x - r:.1f} {y + r:.1f}" '
+            f'stroke="{color}" stroke-width="1.5"/>')
+
+
+def _ticks_linear(lo: float, hi: float, n: int = 6) -> List[float]:
+    if hi <= lo:
+        return [lo]
+    raw = (hi - lo) / n
+    mag = 10 ** math.floor(math.log10(raw))
+    step = min((m * mag for m in (1, 2, 5, 10) if m * mag >= raw),
+               default=mag)
+    t = math.ceil(lo / step) * step
+    out = []
+    while t <= hi + 1e-12:
+        out.append(round(t, 10))
+        t += step
+    return out or [lo]
+
+
+def _ticks_log(lo: float, hi: float) -> List[float]:
+    lo = max(lo, 1e-12)
+    out = []
+    e = math.floor(math.log10(lo))
+    while 10 ** e <= hi * 1.0001:
+        if 10 ** e >= lo * 0.9999:
+            out.append(10 ** e)
+        e += 1
+    return out or [lo]
+
+
+def _fmt(v: float) -> str:
+    if v == 0:
+        return "0"
+    if abs(v) >= 1000 or abs(v) < 0.01:
+        return f"{v:.0e}".replace("e+0", "e").replace("e-0", "e-")
+    if float(v) == int(v):
+        return str(int(v))
+    return f"{v:g}"
+
+
+def render(plot: dict) -> str:
+    """Render the plot model to an SVG string."""
+    plot = without_empty_series(plot)
+    plot = with_range(plot)
+    x0, x1 = plot["xrange"]
+    y0, y1 = plot["yrange"]
+    log_y = plot.get("logscale") == "y"
+    if log_y:
+        y0 = max(y0, 1e-9)
+        y1 = max(y1, y0 * 10)
+
+    pl, pr = MARGIN["left"], WIDTH - MARGIN["right"]
+    pt, pb = MARGIN["top"], HEIGHT - MARGIN["bottom"]
+
+    def sx(x: float) -> float:
+        return pl + (x - x0) / (x1 - x0 or 1) * (pr - pl)
+
+    def sy(y: float) -> float:
+        if log_y:
+            ly0, ly1 = math.log10(y0), math.log10(y1)
+            ly = math.log10(max(y, 1e-12))
+            return pb - (ly - ly0) / (ly1 - ly0 or 1) * (pb - pt)
+        return pb - (y - y0) / (y1 - y0 or 1) * (pb - pt)
+
+    svg: List[str] = []
+    total_w = WIDTH + LEGEND_WIDTH
+    svg.append(
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{total_w}" '
+        f'height="{HEIGHT}" viewBox="0 0 {total_w} {HEIGHT}" '
+        f'font-family="Helvetica,Arial,sans-serif" font-size="11">')
+    svg.append(f'<rect width="{total_w}" height="{HEIGHT}" fill="white"/>')
+
+    # nemesis regions: stacked twelfth-height bands (perf.clj:240-269)
+    for i, nem in enumerate(plot.get("nemeses") or []):
+        color = nem.get("fill-color") or nem.get("color") \
+            or DEFAULT_NEMESIS_COLOR
+        alpha = nem.get("transparency", NEMESIS_ALPHA)
+        height, padding = 0.0834, 0.00615
+        bot = 1 - height * (i + 1)
+        top = bot + height
+        ry0 = pt + (1 - (top - padding)) * (pb - pt)
+        ry1 = pt + (1 - (bot + padding)) * (pb - pt)
+        for start, stop in nem.get("intervals", []):
+            t_start = nanos_to_secs(start.get("time") or 0)
+            rx0 = max(pl, min(pr, sx(t_start)))
+            rx1 = pr if stop is None else \
+                max(pl, min(pr, sx(nanos_to_secs(stop.get("time") or 0))))
+            svg.append(
+                f'<rect x="{rx0:.1f}" y="{ry0:.1f}" '
+                f'width="{max(0.5, rx1 - rx0):.1f}" '
+                f'height="{ry1 - ry0:.1f}" fill="{color}" '
+                f'fill-opacity="{alpha}"/>')
+        # vertical event lines (perf.clj:271-293)
+        line_color = nem.get("line-color") or nem.get("color") \
+            or DEFAULT_NEMESIS_COLOR
+        for o in nem.get("ops", []):
+            t = nanos_to_secs(o.get("time") or 0)
+            if x0 <= t <= x1:
+                lx = sx(t)
+                svg.append(
+                    f'<line x1="{lx:.1f}" y1="{pt}" x2="{lx:.1f}" '
+                    f'y2="{pb}" stroke="{line_color}" '
+                    f'stroke-width="1"/>')
+
+    # axes + grid
+    xticks = _ticks_linear(x0, x1)
+    yticks = _ticks_log(y0, y1) if log_y else _ticks_linear(y0, y1)
+    for t in xticks:
+        tx = sx(t)
+        svg.append(f'<line x1="{tx:.1f}" y1="{pt}" x2="{tx:.1f}" '
+                   f'y2="{pb}" stroke="#eeeeee"/>')
+        svg.append(f'<text x="{tx:.1f}" y="{pb + 16}" '
+                   f'text-anchor="middle">{_fmt(t)}</text>')
+    for t in yticks:
+        ty = sy(t)
+        svg.append(f'<line x1="{pl}" y1="{ty:.1f}" x2="{pr}" '
+                   f'y2="{ty:.1f}" stroke="#eeeeee"/>')
+        svg.append(f'<text x="{pl - 6}" y="{ty + 4:.1f}" '
+                   f'text-anchor="end">{_fmt(t)}</text>')
+    svg.append(f'<rect x="{pl}" y="{pt}" width="{pr - pl}" '
+               f'height="{pb - pt}" fill="none" stroke="#333333"/>')
+
+    # titles + labels (preamble: perf.clj:325-332,394-407)
+    if plot.get("title"):
+        svg.append(f'<text x="{(pl + pr) / 2}" y="20" text-anchor="middle" '
+                   f'font-size="14">{_html.escape(plot["title"])}</text>')
+    svg.append(f'<text x="{(pl + pr) / 2}" y="{HEIGHT - 8}" '
+               f'text-anchor="middle">Time (s)</text>')
+    if plot.get("ylabel"):
+        svg.append(f'<text x="14" y="{(pt + pb) / 2}" text-anchor="middle" '
+                   f'transform="rotate(-90 14 {(pt + pb) / 2})">'
+                   f'{_html.escape(plot["ylabel"])}</text>')
+
+    # series: fewest points drawn last = on top (perf.clj:447-462)
+    ordered = sorted(plot["series"], key=lambda s: -len(s["data"]))
+    for s in ordered:
+        color = s.get("color", "#3366cc")
+        mode = s.get("with", "points")
+        marker = MARKERS[s.get("point_type", 0) % len(MARKERS)]
+        pts = [(sx(x), sy(y)) for x, y in s["data"]
+               if x0 <= x <= x1]
+        if not pts:
+            continue
+        if mode in ("lines", "linespoints", "steps"):
+            if mode == "steps":
+                d = f"M{pts[0][0]:.1f} {pts[0][1]:.1f}"
+                for (px, _), (qx, qy) in zip(pts, pts[1:]):
+                    d += f"H{qx:.1f}V{qy:.1f}"
+            else:
+                d = "M" + "L".join(f"{x:.1f} {y:.1f}" for x, y in pts)
+            svg.append(f'<path d="{d}" fill="none" stroke="{color}" '
+                       f'stroke-width="1.3"/>')
+        if mode in ("points", "linespoints"):
+            for x, y in pts:
+                svg.append(_marker_svg(marker, x, y, 2.5, color))
+
+    # legend (outside right, like `set key outside top right`)
+    ly = pt
+    for s in plot["series"]:
+        if not s.get("title"):
+            continue
+        color = s.get("color", "#3366cc")
+        marker = MARKERS[s.get("point_type", 0) % len(MARKERS)]
+        svg.append(_marker_svg(marker, WIDTH + 12, ly + 4, 3.5, color))
+        svg.append(f'<text x="{WIDTH + 22}" y="{ly + 8}">'
+                   f'{_html.escape(str(s["title"]))}</text>')
+        ly += 16
+    for nem in plot.get("nemeses") or []:
+        color = nem.get("fill-color") or nem.get("color") \
+            or DEFAULT_NEMESIS_COLOR
+        svg.append(f'<rect x="{WIDTH + 6}" y="{ly}" width="12" height="8" '
+                   f'fill="{color}" fill-opacity="{NEMESIS_ALPHA}"/>')
+        svg.append(f'<text x="{WIDTH + 22}" y="{ly + 8}">'
+                   f'{_html.escape(str(nem.get("name")))}</text>')
+        ly += 16
+
+    svg.append("</svg>")
+    return "\n".join(svg)
+
+

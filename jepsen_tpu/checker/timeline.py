@@ -1,0 +1,147 @@
+"""HTML op timeline (reference: jepsen/src/jepsen/checker/timeline.clj).
+
+One column per process, one absolutely-positioned box per op pair, box
+height proportional to duration (1 ms of history per pixel), colored by
+completion type, with full op details in the hover title
+(timeline.clj:20-33,85-158)."""
+
+from __future__ import annotations
+
+import html as _html
+import json
+from typing import List, Optional
+
+from jepsen_tpu.checker.core import Checker
+
+TIMESCALE = 1e6     # nanoseconds per pixel (timeline.clj:20)
+COL_WIDTH = 100     # px (timeline.clj:21)
+GUTTER_WIDTH = 106  # px (timeline.clj:22)
+HEIGHT = 16         # px minimum box height (timeline.clj:23)
+
+STYLESHEET = """\
+.ops        { position: absolute; }
+.op         { position: absolute; padding: 2px; border-radius: 2px;
+              box-shadow: 0 1px 3px rgba(0,0,0,0.12),
+                          0 1px 2px rgba(0,0,0,0.24);
+              overflow: hidden; font-size: 10px;
+              font-family: Helvetica, Arial, sans-serif; }
+.op.invoke  { background: #eeeeee; }
+.op.ok      { background: #6DB6FE; }
+.op.info    { background: #FFAA26; }
+.op.fail    { background: #FEB5DA; }
+.op:target  { box-shadow: 0 14px 28px rgba(0,0,0,0.25),
+                          0 10px 10px rgba(0,0,0,0.22); }
+"""
+
+
+def pairs(history) -> List[list]:
+    """[invoke, completion] pairs (or [info] for unmatched infos),
+    in history order (timeline.clj:35-54)."""
+    out, open_by_process = [], {}
+    for op in history:
+        t, p = op.get("type"), op.get("process")
+        if t == "invoke":
+            open_by_process[p] = op
+        elif t == "info" and p not in open_by_process:
+            out.append([op])
+        elif t in ("ok", "fail", "info"):
+            inv = open_by_process.pop(p, None)
+            out.append([inv, op] if inv is not None else [op])
+    for inv in open_by_process.values():
+        out.append([inv])
+    return out
+
+
+def _processes(history) -> List:
+    """Processes in order of first appearance, nemesis last
+    (timeline.clj:145-149 sort-processes)."""
+    seen, order = set(), []
+    for op in history:
+        p = op.get("process")
+        if p not in seen:
+            seen.add(p)
+            order.append(p)
+    nums = sorted(p for p in order if isinstance(p, int))
+    others = [p for p in order if not isinstance(p, int)]
+    return nums + others
+
+
+def _title(op, start, stop) -> str:
+    lines = []
+    if stop is not None and start.get("time") is not None \
+            and stop.get("time") is not None:
+        lines.append(f"Dur: {(stop['time'] - start['time']) // 1_000_000} ms")
+    if op.get("error") is not None:
+        lines.append(f"Err: {op['error']!r}")
+    lines.append("Op:")
+    lines.append(json.dumps({k: v for k, v in op.items()}, default=repr,
+                            indent=1))
+    return "\n".join(lines)
+
+
+def _esc(s) -> str:
+    return _html.escape(str(s))
+
+
+def render_html(test, history) -> str:
+    """The timeline document (timeline.clj:110-158)."""
+    procs = _processes(history)
+    col = {p: i for i, p in enumerate(procs)}
+    t0 = next((o.get("time") for o in history
+               if o.get("time") is not None), 0)
+    body = []
+    # process headers
+    for p, i in col.items():
+        body.append(
+            f'<div style="position:absolute; left:{i * GUTTER_WIDTH}px; '
+            f'top:0px; width:{COL_WIDTH}px; font-weight:bold">'
+            f'{_esc(p)}</div>')
+    for pair in pairs(history):
+        start = pair[0]
+        stop = pair[1] if len(pair) > 1 else None
+        op = stop or start
+        p = op.get("process")
+        left = col.get(p, 0) * GUTTER_WIDTH
+        top = HEIGHT + (start.get("time", t0) - t0) / TIMESCALE
+        if stop is not None and stop.get("time") is not None:
+            h = max(HEIGHT,
+                    (stop["time"] - start.get("time", t0)) / TIMESCALE)
+        else:
+            h = HEIGHT
+        idx = op.get("index", "")
+        cls = op.get("type", "invoke")
+        val = start.get("value")
+        if stop is not None and stop.get("value") != val:
+            txt = f"{op.get('f')} {val!r} → {stop.get('value')!r}"
+        else:
+            txt = f"{op.get('f')} {val!r}"
+        body.append(
+            f'<a href="#i{idx}"><div id="i{idx}" class="op {cls}" '
+            f'style="left:{left}px; top:{top:.0f}px; '
+            f'width:{COL_WIDTH}px; height:{h:.0f}px" '
+            f'title="{_esc(_title(op, start, stop))}">'
+            f'{_esc(p)} {_esc(txt)}</div></a>')
+    name = (test or {}).get("name", "test")
+    return (f"<!DOCTYPE html><html><head><title>{_esc(name)} timeline"
+            f"</title><style>{STYLESHEET}</style></head>"
+            f'<body><h1>{_esc(name)}</h1><div class="ops">'
+            + "\n".join(body) + "</div></body></html>")
+
+
+class Timeline(Checker):
+    """Writes timeline.html into the store (timeline.clj:159-179)."""
+
+    def check(self, test, history, opts=None):
+        html_doc = render_html(test, history)
+        store = (test or {}).get("store")
+        path = None
+        if store is not None:
+            sub = (opts or {}).get("subdirectory")
+            parts = [sub, "timeline.html"] if sub else ["timeline.html"]
+            store.write_file(parts, html_doc)
+            path = store.path(*parts)
+        return {"valid?": True, "timeline": path}
+
+
+def html() -> Timeline:
+    return Timeline()

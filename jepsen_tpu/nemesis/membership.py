@@ -17,10 +17,10 @@ import threading
 import time as _time
 from typing import Optional
 
-from jepsen_tpu import control as c
 from jepsen_tpu import generator as gen
 from jepsen_tpu.history import Op
-from jepsen_tpu.nemesis import Nemesis, _ok
+from jepsen_tpu.nemesis import Nemesis
+
 NODE_VIEW_INTERVAL = 5  # seconds between node-view refreshes
 
 
@@ -32,7 +32,7 @@ class State:
 
     node_views: dict
     view: object
-    pending: set
+    pending: tuple  # of (invoke_op, completion_op) dict pairs
 
     def node_view(self, test, node):
         """This node's current view of the cluster, or None if unknown."""
@@ -77,19 +77,18 @@ class State:
 
 def initial_bookkeeping() -> dict:
     """The framework-owned part of the state (membership.clj:68-77)."""
-    return {"node_views": {}, "view": None, "pending": set()}
+    return {"node_views": {}, "view": None, "pending": ()}
 
 
 def _resolve_ops(state: State, test, opts) -> State:
     """Try to resolve every pending [op, op'] pair
-    (membership.clj:79-93). Pairs are stored frozen (hashable) in the
-    pending set but handed to resolve_op thawed, as dicts."""
+    (membership.clj:79-93). Pairs are (invocation, completion) dicts,
+    exactly as invoke recorded them."""
     for pair in list(state.pending):
-        s2 = state.resolve_op(test, [thaw(pair[0]), thaw(pair[1])])
+        s2 = state.resolve_op(test, [pair[0], pair[1]])
         if s2 is not None:
-            pending = set(s2.pending)
-            pending.discard(pair)
-            state = s2.with_updates(pending=pending)
+            state = s2.with_updates(
+                pending=tuple(p for p in s2.pending if p is not pair))
     return state
 
 
@@ -182,8 +181,7 @@ class MembershipNemesis(Nemesis):
             state = self.state
         op2 = state.invoke(test, op)
         with self.lock:
-            pending = set(self.state.pending)
-            pending.add((_freeze(op), _freeze(op2)))
+            pending = tuple(self.state.pending) + ((dict(op), dict(op2)),)
             s = self.state.with_updates(pending=pending)
             self.state = resolve(s, test, self.opts)
         return op2
@@ -199,24 +197,6 @@ class MembershipNemesis(Nemesis):
         return set(self.state.fs())
 
 
-def _freeze(op):
-    """Ops go into the pending *set*; dicts aren't hashable."""
-    if isinstance(op, dict):
-        return tuple(sorted((k, _freeze(v)) for k, v in op.items()))
-    if isinstance(op, (list, set)):
-        return tuple(_freeze(x) for x in op)
-    return op
-
-
-def thaw(frozen):
-    """Inverse of _freeze for op pairs handed to resolve_op."""
-    if isinstance(frozen, tuple) and frozen and \
-            all(isinstance(x, tuple) and len(x) == 2 and
-                isinstance(x[0], str) for x in frozen):
-        return {k: thaw(v) for k, v in frozen}
-    if isinstance(frozen, tuple):
-        return [thaw(x) for x in frozen]
-    return frozen
 
 
 class MembershipGenerator(gen.Generator):

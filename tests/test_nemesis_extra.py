@@ -360,7 +360,7 @@ class FakeClusterState(membership.State):
         return done
 
     def resolve_op(self, test, op_pair):
-        inv = membership.thaw(op_pair[0])
+        inv = op_pair[0]
         node, f = inv["value"], inv["f"]
         view = self.view or frozenset()
         applied = (node in view) if f == "add-node" else (node not in view)

@@ -1,0 +1,236 @@
+"""Perf graphs, timeline HTML, clock plot, and linear.svg rendering
+(reference: checker/perf.clj, checker/timeline.clj, checker/clock.clj,
+knossos.linear.report; unit-test style after
+test/jepsen/perf_test.clj — synthetic histories exercise plotting)."""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from jepsen_tpu import store as store_mod
+import jepsen_tpu.checker.clock as clock
+import jepsen_tpu.checker.perf as perf
+from jepsen_tpu.checker import linear_report, plot, timeline
+from jepsen_tpu.checker.linearizable import linearizable
+from jepsen_tpu.history import Op
+from jepsen_tpu.models import CASRegister
+
+
+def synthetic_history(n_ops=400, n_procs=5, seed=11):
+    """ok/fail/info mix with latencies and two nemesis windows."""
+    import random
+    r = random.Random(seed)
+    h, t = [], 0
+    for i in range(n_ops):
+        p = i % n_procs
+        f = r.choice(["read", "write", "cas"])
+        t += r.randint(1_000_000, 30_000_000)
+        inv_t = t
+        h.append(Op({"index": len(h), "time": inv_t, "process": p,
+                     "type": "invoke", "f": f, "value": i}))
+        t += r.randint(500_000, 200_000_000)
+        typ = r.choices(["ok", "fail", "info"], weights=[8, 1, 1])[0]
+        h.append(Op({"index": len(h), "time": t, "process": p,
+                     "type": typ, "f": f, "value": i}))
+    # nemesis activity: two partition windows
+    dur = t // 5
+    for k in range(2):
+        s = dur * (1 + 2 * k)
+        h.append(Op({"index": len(h), "time": s, "process": "nemesis",
+                     "type": "info", "f": "start", "value": "cut"}))
+        h.append(Op({"index": len(h), "time": s + dur, "process": "nemesis",
+                     "type": "info", "f": "stop", "value": "healed"}))
+    return sorted(h, key=lambda o: o["time"])
+
+
+def _store(tmp_path, name="plots"):
+    return store_mod.Store(name, base_dir=str(tmp_path))
+
+
+def _assert_svg(path):
+    assert path and path.endswith(".svg")
+    root = ET.parse(path).getroot()
+    assert root.tag.endswith("svg")
+    return ET.tostring(root, encoding="unicode")
+
+
+# ----------------------------------------------------------- plot core
+
+
+def test_buckets_and_quantiles():
+    assert plot.bucket_time(10, 7) == 5.0
+    assert plot.bucket_time(10, 17) == 15.0
+    assert plot.buckets(10, 35) == [5.0, 15.0, 25.0, 35.0]
+    q = plot.quantiles([0.5, 1], [1, 2, 3, 4])
+    assert q[1] == 4 and q[0.5] == 3
+    lq = plot.latencies_to_quantiles(10, [0.5], [[1, 5], [2, 7], [12, 9]])
+    assert lq[0.5] == [[5.0, 7], [15.0, 9]]
+
+
+def test_broaden_range():
+    assert plot.broaden_range((5, 5)) == (4, 6)
+    lo, hi = plot.broaden_range((0.3, 9.7))
+    assert lo <= 0.3 and hi >= 9.7
+
+
+def test_with_range_raises_no_points():
+    with pytest.raises(plot.NoPoints):
+        plot.with_range({"series": [{"data": []}]})
+
+
+def test_nemesis_activity_partitions_ops():
+    h = synthetic_history()
+    specs = [{"name": "partition", "color": "#E9DCA0",
+              "start": {"start"}, "stop": {"stop"}}]
+    act = plot.nemesis_activity(specs, h)
+    assert len(act) == 1
+    assert len(act[0]["intervals"]) == 2
+    assert all(b is not None for _a, b in act[0]["intervals"])
+
+
+# ----------------------------------------------------------- perf graphs
+
+
+def test_point_graph_renders(tmp_path):
+    h = synthetic_history()
+    test = {"name": "t", "store": _store(tmp_path)}
+    path = perf.point_graph(test, h)
+    svg = _assert_svg(path)
+    assert "Latency (ms)" in svg
+    # all three completion types appear in the legend
+    for t in ("ok", "fail", "info"):
+        assert t in svg
+
+
+def test_quantiles_graph_renders(tmp_path):
+    h = synthetic_history()
+    test = {"name": "t", "store": _store(tmp_path)}
+    path = perf.quantiles_graph(test, h)
+    svg = _assert_svg(path)
+    assert "0.95" in svg and "0.99" in svg
+
+
+def test_rate_graph_renders(tmp_path):
+    h = synthetic_history()
+    test = {"name": "t", "store": _store(tmp_path)}
+    path = perf.rate_graph(test, h)
+    svg = _assert_svg(path)
+    assert "Throughput (hz)" in svg
+
+
+def test_perf_checker_composes(tmp_path):
+    h = synthetic_history()
+    test = {"name": "t", "store": _store(tmp_path),
+            "plot": {"nemeses": [{"name": "partition", "color": "#E9DCA0",
+                                  "start": {"start"}, "stop": {"stop"}}]}}
+    res = perf.perf().check(test, h)
+    assert res["valid?"] is True
+    for k in ("latency-graph", "latency-quantiles-graph", "rate-graph"):
+        svg = _assert_svg(res[k])
+        assert "partition" in svg  # nemesis legend present
+
+
+def test_perf_empty_history_is_valid():
+    res = perf.perf().check({"name": "t"}, [])
+    assert res["valid?"] is True
+    assert res["latency-graph"] is None
+
+
+# ------------------------------------------------------------- timeline
+
+
+def test_timeline_html(tmp_path):
+    h = synthetic_history(n_ops=40)
+    test = {"name": "t", "store": _store(tmp_path)}
+    res = timeline.html().check(test, h)
+    assert res["valid?"] is True
+    doc = open(res["timeline"]).read()
+    assert "<style>" in doc
+    assert doc.count('class="op ') >= 40
+    assert 'class="op ok"' in doc
+    # crashed/unmatched infos are still rendered
+    assert 'class="op info"' in doc
+
+
+def test_timeline_pairs_crashed_ops():
+    h = [Op({"index": 0, "time": 0, "process": 0, "type": "invoke",
+             "f": "w", "value": 1}),
+         Op({"index": 1, "time": 5, "process": "nemesis", "type": "info",
+             "f": "start", "value": None}),
+         Op({"index": 2, "time": 9, "process": 0, "type": "info",
+             "f": "w", "value": 1})]
+    ps = timeline.pairs(h)
+    # nemesis info stands alone; process-0 invoke pairs with its crash
+    assert [len(p) for p in ps] == [1, 2]
+
+
+# ------------------------------------------------------------- clock
+
+
+def test_clock_plot(tmp_path):
+    h = [Op({"index": 0, "time": 1_000_000_000, "process": "nemesis",
+             "type": "info", "f": "check-offsets",
+             "clock-offsets": {"n1": 0.0, "n2": 0.1}}),
+         Op({"index": 1, "time": 5_000_000_000, "process": "nemesis",
+             "type": "info", "f": "bump",
+             "clock-offsets": {"n1": 30.0, "n2": 0.1}}),
+         Op({"index": 2, "time": 9_000_000_000, "process": "nemesis",
+             "type": "info", "f": "reset",
+             "clock-offsets": {"n1": 0.0, "n2": 0.0}})]
+    test = {"name": "t", "store": _store(tmp_path)}
+    res = clock.clock_plot().check(test, h)
+    assert res["valid?"] is True
+    svg = _assert_svg(res["clock-skew-graph"])
+    assert "Skew (s)" in svg and "n1" in svg
+
+
+def test_clock_plot_no_offsets_ok():
+    res = clock.clock_plot().check({"name": "t"}, synthetic_history(20))
+    assert res["valid?"] is True
+    assert res["clock-skew-graph"] is None
+
+
+def test_short_node_names():
+    assert clock.short_node_names(
+        ["n1.db.local", "n2.db.local"]) == ["n1", "n2"]
+    assert clock.short_node_names(["a", "b"]) == ["a", "b"]
+    assert clock.short_node_names(["only.example.com"]) \
+        == ["only.example.com"]
+
+
+# ----------------------------------------------------- linear.svg
+
+
+def _invalid_register_history():
+    return [Op({"index": 0, "time": 0, "process": 0, "type": "invoke",
+                "f": "write", "value": 1}),
+            Op({"index": 1, "time": 10, "process": 0, "type": "ok",
+                "f": "write", "value": 1}),
+            Op({"index": 2, "time": 20, "process": 1, "type": "invoke",
+                "f": "read", "value": None}),
+            Op({"index": 3, "time": 30, "process": 1, "type": "ok",
+                "f": "read", "value": 2})]
+
+
+def test_render_analysis_highlights_counterexample():
+    h = _invalid_register_history()
+    analysis = {"valid?": False,
+                "op": {"index": 2, "f": "read", "value": 2,
+                       "process": 1},
+                "final-paths": [[{"op": dict(h[0]), "model": "1"},
+                                 {"op": dict(h[2]), "model": "1"}]]}
+    svg = linear_report.render_analysis(h, analysis)
+    assert "#d00000" in svg              # counterexample outline
+    assert "No legal linearization" in svg
+    assert "process 0" in svg and "process 1" in svg
+
+
+def test_linearizable_failure_writes_linear_svg(tmp_path):
+    test = {"name": "t", "store": _store(tmp_path)}
+    chk = linearizable(CASRegister(), algorithm="wgl")
+    res = chk.check(test, _invalid_register_history())
+    assert res["valid?"] is False
+    path = test["store"].path("linear.svg")
+    _assert_svg(path)
