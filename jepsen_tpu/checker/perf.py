@@ -69,7 +69,8 @@ def _nemeses(test, opts):
             or ((test or {}).get("plot") or {}).get("nemeses"))
 
 
-def point_graph(test, history, opts=None, pairs=None) -> Optional[str]:
+def point_graph(test, history, opts=None, pairs=None,
+                activity=None) -> Optional[str]:
     """Raw latency scatter: one point per completed op, colored by
     completion type, marker by :f (perf.clj:484-511). Returns the
     written path, or None with no data or no store to write to. Pass
@@ -96,7 +97,9 @@ def point_graph(test, history, opts=None, pairs=None) -> Optional[str]:
             "logscale": "y",
             "series": series}
     try:
-        plot = pl.with_nemeses(plot, history, _nemeses(test, opts))
+        plot["nemeses"] = (activity if activity is not None else
+                           pl.nemesis_activity(_nemeses(test, opts),
+                                               history))
         svg = pl.render(plot)
     except pl.NoPoints:
         return None
@@ -105,7 +108,8 @@ def point_graph(test, history, opts=None, pairs=None) -> Optional[str]:
 
 def quantiles_graph(test, history, opts=None,
                     dt: float = 30,
-                    qs=(0.5, 0.95, 0.99, 1), pairs=None) -> Optional[str]:
+                    qs=(0.5, 0.95, 0.99, 1), pairs=None,
+                    activity=None) -> Optional[str]:
     """Latency quantiles over dt-second windows, per :f
     (perf.clj:513-552)."""
     if (test or {}).get("store") is None:
@@ -131,14 +135,17 @@ def quantiles_graph(test, history, opts=None,
             "logscale": "y",
             "series": series}
     try:
-        plot = pl.with_nemeses(plot, history, _nemeses(test, opts))
+        plot["nemeses"] = (activity if activity is not None else
+                           pl.nemesis_activity(_nemeses(test, opts),
+                                               history))
         svg = pl.render(plot)
     except pl.NoPoints:
         return None
     return _write(test, opts, "latency-quantiles.svg", svg)
 
 
-def rate_graph(test, history, opts=None, dt: float = 10) -> Optional[str]:
+def rate_graph(test, history, opts=None, dt: float = 10,
+               activity=None) -> Optional[str]:
     """Completion rate (hz) in dt-second buckets, by f and type
     (perf.clj:554-599). Nemesis completions are excluded (only integer
     processes count)."""
@@ -174,7 +181,9 @@ def rate_graph(test, history, opts=None, dt: float = 10) -> Optional[str]:
             "ylabel": "Throughput (hz)",
             "series": series}
     try:
-        plot = pl.with_nemeses(plot, history, _nemeses(test, opts))
+        plot["nemeses"] = (activity if activity is not None else
+                           pl.nemesis_activity(_nemeses(test, opts),
+                                               history))
         svg = pl.render(plot)
     except pl.NoPoints:
         return None
@@ -190,15 +199,21 @@ class Perf(Checker):
 
     def check(self, test, history, opts=None):
         o = {**self.opts, **(opts or {})}
-        # Pair invocations with completions once; both latency graphs
-        # reuse the result.
-        pairs = (history_to_latencies(history)
-                 if (test or {}).get("store") is not None else [])
+        # Pair invocations with completions and partition nemesis
+        # activity once; all three graphs reuse the results.
+        if (test or {}).get("store") is None:
+            return {"valid?": True, "latency-graph": None,
+                    "latency-quantiles-graph": None, "rate-graph": None}
+        pairs = history_to_latencies(history)
+        activity = pl.nemesis_activity(_nemeses(test, o), history)
         return {"valid?": True,
-                "latency-graph": point_graph(test, history, o, pairs=pairs),
+                "latency-graph": point_graph(test, history, o, pairs=pairs,
+                                             activity=activity),
                 "latency-quantiles-graph":
-                    quantiles_graph(test, history, o, pairs=pairs),
-                "rate-graph": rate_graph(test, history, o)}
+                    quantiles_graph(test, history, o, pairs=pairs,
+                                    activity=activity),
+                "rate-graph": rate_graph(test, history, o,
+                                         activity=activity)}
 
 
 def perf(opts: Optional[dict] = None) -> Perf:

@@ -102,10 +102,11 @@ def render_html(test, history) -> str:
         op = stop or start
         p = op.get("process")
         left = col.get(p, 0) * GUTTER_WIDTH
-        top = HEIGHT + (start.get("time", t0) - t0) / TIMESCALE
+        start_t = start.get("time")
+        start_t = t0 if start_t is None else start_t
+        top = HEIGHT + (start_t - t0) / TIMESCALE
         if stop is not None and stop.get("time") is not None:
-            h = max(HEIGHT,
-                    (stop["time"] - start.get("time", t0)) / TIMESCALE)
+            h = max(HEIGHT, (stop["time"] - start_t) / TIMESCALE)
         else:
             h = HEIGHT
         idx = op.get("index", "")

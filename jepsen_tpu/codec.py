@@ -1,0 +1,25 @@
+"""Object <-> bytes serialization (reference: jepsen/src/jepsen/codec.clj).
+
+Used for nemesis payloads and anywhere a value must cross a byte
+boundary. EDN text encoding, like the reference; None round-trips as
+zero bytes (codec.clj:9-28)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jepsen_tpu import edn
+
+
+def encode(o) -> bytes:
+    """Serialize an object to EDN bytes; None -> b'' (codec.clj:9-15)."""
+    if o is None:
+        return b""
+    return edn.dumps(o).encode("utf-8")
+
+
+def decode(data: Optional[bytes]):
+    """Deserialize EDN bytes; b'' or None -> None (codec.clj:17-28)."""
+    if not data:
+        return None
+    return edn.loads(data.decode("utf-8"))

@@ -1,0 +1,51 @@
+"""Node-side network helpers (reference: jepsen/src/jepsen/control/net.clj).
+
+All functions assume an ambient control session (c.on_host)."""
+
+from __future__ import annotations
+
+import functools
+import re
+
+from jepsen_tpu import control as c
+
+
+def reachable(node: str) -> bool:
+    """Can the current node ping the given node? (control/net.clj:8-12)."""
+    try:
+        c.exec_("ping", "-w", 1, node)
+        return True
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def local_ip() -> str:
+    """The current node's IP address (control/net.clj:14-17)."""
+    return c.exec_("hostname", "-I").split()[0]
+
+
+def ip_uncached(host: str) -> str:
+    """Resolve a hostname to an IP via getent (control/net.clj:19-35)."""
+    res = c.exec_("getent", "ahosts", host)
+    first_line = res.splitlines()[0] if res else ""
+    addr = first_line.split()[0] if first_line.split() else ""
+    if not addr:
+        raise RuntimeError(f"blank getent ip for {host!r}: {res!r}")
+    return addr
+
+
+@functools.lru_cache(maxsize=None)
+def ip(host: str) -> str:
+    """Memoized hostname -> IP (control/net.clj:37-39)."""
+    return ip_uncached(host)
+
+
+def control_ip() -> str:
+    """The control node's IP as seen from the current DB node, via the
+    $SSH_CLIENT env var of the session (control/net.clj:41-52)."""
+    with c._Binding(sudo=None):  # escape sudo: env doesn't cross subshells
+        out = c.exec_("bash", "-c", "echo $SSH_CLIENT")
+    m = re.match(r"^(.+?)\s", out + " ")
+    if not m or not m.group(1):
+        raise RuntimeError(f"can't find control ip in SSH_CLIENT {out!r}")
+    return m.group(1)

@@ -1,0 +1,174 @@
+"""Auto-reconnecting client wrappers (reference: jepsen/src/jepsen/reconnect.clj).
+
+A Wrapper owns a connection plus open/close functions. `with_conn`
+hands the current connection to a body under a read lock — many threads
+may use the connection concurrently — while open/close/reopen take the
+write lock. When a body raises, the wrapper reopens the connection
+(only if it is still the same one that failed — another thread may have
+already replaced it, reconnect.clj:104-116) and re-raises the original
+error."""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+from typing import Callable, Optional
+
+log = logging.getLogger(__name__)
+
+
+class RWLock:
+    """Writer-preferring read/write lock (the ReentrantReadWriteLock of
+    reconnect.clj:30, minus reentrancy, which the wrapper doesn't use)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+
+    def acquire_read(self):
+        with self._cond:
+            while self._writer or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+
+    def release_read(self):
+        with self._cond:
+            self._readers -= 1
+            if self._readers == 0:
+                self._cond.notify_all()
+
+    def acquire_write(self):
+        with self._cond:
+            self._writers_waiting += 1
+            try:
+                while self._writer or self._readers:
+                    self._cond.wait()
+            finally:
+                self._writers_waiting -= 1
+            self._writer = True
+
+    def release_write(self):
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()
+
+    @contextlib.contextmanager
+    def read(self):
+        self.acquire_read()
+        try:
+            yield
+        finally:
+            self.release_read()
+
+    @contextlib.contextmanager
+    def write(self):
+        self.acquire_write()
+        try:
+            yield
+        finally:
+            self.release_write()
+
+
+class Wrapper:
+    """(reconnect.clj:16-31). open: () -> conn; close: (conn) -> None."""
+
+    def __init__(self, open: Callable, close: Callable,  # noqa: A002
+                 name: Optional[str] = None, log_: bool = False):
+        assert callable(open) and callable(close)
+        self._open = open
+        self._close = close
+        self.name = name
+        self.log = log_
+        self.lock = RWLock()
+        self._conn = None
+
+    def conn(self):
+        """Active connection, if any (reconnect.clj:49-52)."""
+        return self._conn
+
+    def open(self) -> "Wrapper":
+        """Opens a connection; no-op if already open (reconnect.clj:54-66)."""
+        with self.lock.write():
+            if self._conn is None:
+                c = self._open()
+                if c is None:
+                    raise RuntimeError(
+                        f"Reconnect wrapper {self.name!r}'s open function "
+                        f"returned None instead of a connection!")
+                self._conn = c
+        return self
+
+    def close(self) -> "Wrapper":
+        """(reconnect.clj:68-75)."""
+        with self.lock.write():
+            if self._conn is not None:
+                self._close(self._conn)
+                self._conn = None
+        return self
+
+    def reopen(self) -> "Wrapper":
+        """(reconnect.clj:77-90)."""
+        with self.lock.write():
+            if self._conn is not None:
+                self._close(self._conn)
+                self._conn = None
+            c = self._open()
+            if c is None:
+                raise RuntimeError(
+                    f"Reconnect wrapper {self.name!r}'s open function "
+                    f"returned None instead of a connection!")
+            self._conn = c
+        return self
+
+    @contextlib.contextmanager
+    def with_conn(self):
+        """Yields the current connection under the read lock; on any
+        exception, reopens (if this conn is still current) and
+        re-raises the *original* error (reconnect.clj:92-129)."""
+        self.lock.acquire_read()
+        c = self._conn
+        try:
+            yield c
+        except Exception as e:
+            self.lock.release_read()
+            try:
+                with self.lock.write():
+                    if c is self._conn:
+                        if self.log:
+                            log.warning(
+                                "Encountered error with conn %r; "
+                                "reopening: %r", self.name, e)
+                        try:
+                            self.reopen_locked()
+                        except Exception as e2:  # noqa: BLE001
+                            if self.log:
+                                log.warning("Error reopening %r: %r",
+                                            self.name, e2)
+            finally:
+                self.lock.acquire_read()
+            raise
+        finally:
+            self.lock.release_read()
+
+    def reopen_locked(self):
+        """reopen body for callers already holding the write lock."""
+        if self._conn is not None:
+            try:
+                self._close(self._conn)
+            except Exception:  # noqa: BLE001 - old conn may be dead
+                pass
+            self._conn = None
+        c = self._open()
+        if c is None:
+            raise RuntimeError(
+                f"Reconnect wrapper {self.name!r}'s open function "
+                f"returned None instead of a connection!")
+        self._conn = c
+
+
+def wrapper(open: Callable, close: Callable,  # noqa: A002
+            name: Optional[str] = None, log_: bool = False) -> Wrapper:
+    return Wrapper(open, close, name=name, log_=log_)

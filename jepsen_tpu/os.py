@@ -82,3 +82,73 @@ class Debian(OS):
 
 def debian(extra_packages: Sequence[str] = ()) -> Debian:
     return Debian(extra_packages)
+
+
+class Ubuntu(Debian):
+    """Alias of Debian: the reference's ubuntu os only adds sudo-group
+    bookkeeping for non-root users, which this control plane (always
+    root or explicit su) does not need (os/ubuntu.clj:1-46)."""
+
+
+def ubuntu(extra_packages: Sequence[str] = ()) -> Ubuntu:
+    return Ubuntu(extra_packages)
+
+
+CENTOS_BASE_PACKAGES = [
+    "wget", "curl", "unzip", "iptables", "logrotate", "tar", "gzip",
+    "ntpdate", "psmisc", "man-db",
+]
+
+
+class Centos(OS):
+    """CentOS-family setup: hostfile loopback fix + yum packages
+    (os/centos.clj:12-158)."""
+
+    def __init__(self, extra_packages: Sequence[str] = ()):
+        self.extra_packages = list(extra_packages)
+
+    def setup(self, test, node):
+        with c.su():
+            self._hostfile_loopback()
+            c.exec_("yum", "install", "-y",
+                    *(CENTOS_BASE_PACKAGES + self.extra_packages))
+
+    def teardown(self, test, node):
+        pass
+
+    def _hostfile_loopback(self):
+        """Ensure /etc/hosts' 127.0.0.1 line mentions the local hostname
+        as a whole token (os/centos.clj:12-26 setup-hostfile!). The file
+        is shipped back via upload, not a shell printf: existing lines
+        may contain %/backslash sequences a format string would eat."""
+        name = c.exec_("hostname")
+        hosts = c.exec_("cat", "/etc/hosts")
+        out = []
+        for line in hosts.splitlines():
+            if line.startswith("127.0.0.1") and name not in line.split():
+                line = f"{line} {name}"
+            out.append(line)
+        import os as _os
+        import tempfile
+        fd, tmp = tempfile.mkstemp(suffix=".hosts")
+        try:
+            with _os.fdopen(fd, "w") as f:
+                f.write("\n".join(out) + "\n")
+            c.upload([tmp], "/etc/hosts")
+        finally:
+            _os.unlink(tmp)
+
+    def installed(self, pkgs: Sequence[str]) -> set:
+        """Subset of pkgs currently yum-installed (os/centos.clj:46-57)."""
+        want = {str(p) for p in pkgs}
+        have = set()
+        for line in c.exec_("yum", "list", "installed").splitlines():
+            namever = line.split()[0] if line.split() else ""
+            base = namever.rsplit(".", 1)[0]
+            if base in want:
+                have.add(base)
+        return have
+
+
+def centos(extra_packages: Sequence[str] = ()) -> Centos:
+    return Centos(extra_packages)

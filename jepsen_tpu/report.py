@@ -1,0 +1,71 @@
+"""Report output helper (reference: jepsen/src/jepsen/report.clj).
+
+`to(filename)` binds stdout to a file for a block:
+
+    with report.to("store/foo/report.txt"):
+        print("history:", n, "ops")
+
+Like the reference's thread-local `*out*` rebinding (report.clj:7-16),
+the redirect is per-thread: a proxy stdout routes each thread's writes
+to that thread's active report file (if any) and everything else to the
+real stdout — concurrent worker threads never leak into a report."""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import os
+import sys
+import threading
+
+_locals = threading.local()
+
+
+class _ThreadStdoutProxy(io.TextIOBase):
+    """Routes writes to the calling thread's report buffer, else to the
+    original stdout."""
+
+    def __init__(self, real):
+        self.real = real
+
+    def _target(self):
+        return getattr(_locals, "target", None) or self.real
+
+    def write(self, s):
+        return self._target().write(s)
+
+    def flush(self):
+        self._target().flush()
+
+    def writable(self):
+        return True
+
+
+_install_lock = threading.Lock()
+
+
+def _ensure_proxy():
+    with _install_lock:
+        if not isinstance(sys.stdout, _ThreadStdoutProxy):
+            sys.stdout = _ThreadStdoutProxy(sys.stdout)
+        return sys.stdout
+
+
+@contextlib.contextmanager
+def to(filename: str):
+    """Redirect this thread's stdout to filename for the block,
+    creating parent directories (report.clj:7-16)."""
+    parent = os.path.dirname(filename)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    proxy = _ensure_proxy()
+    prev = getattr(_locals, "target", None)
+    buf = io.StringIO()
+    _locals.target = buf
+    try:
+        yield
+    finally:
+        _locals.target = prev
+        with open(filename, "w") as f:
+            f.write(buf.getvalue())
+        (prev or proxy.real).write(f"Report written to {filename}\n")

@@ -1,0 +1,220 @@
+"""Reconnect wrapper, control.net helpers, OS variants, report, codec
+(reference: reconnect.clj, control/net.clj, os/centos.clj, report.clj,
+codec.clj)."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from jepsen_tpu import codec, report
+from jepsen_tpu import control as c
+from jepsen_tpu.control import net as cnet
+from jepsen_tpu.control import reconnect
+
+
+# ----------------------------------------------------------- reconnect
+
+
+class Conn:
+    seq = 0
+
+    def __init__(self):
+        Conn.seq += 1
+        self.id = Conn.seq
+        self.closed = False
+
+
+def test_wrapper_open_close_reopen():
+    opened, closed = [], []
+
+    def op():
+        conn = Conn()
+        opened.append(conn)
+        return conn
+
+    def cl(conn):
+        conn.closed = True
+        closed.append(conn)
+
+    w = reconnect.wrapper(op, cl, name="db")
+    assert w.conn() is None
+    w.open()
+    c1 = w.conn()
+    assert c1 is not None
+    w.open()  # no-op when already open (reconnect.clj:54-66)
+    assert w.conn() is c1
+    w.reopen()
+    c2 = w.conn()
+    assert c2 is not c1 and c1.closed
+    w.close()
+    assert w.conn() is None and c2.closed
+
+
+def test_wrapper_with_conn_reopens_on_error():
+    def op():
+        return Conn()
+
+    def cl(conn):
+        conn.closed = True
+
+    w = reconnect.wrapper(op, cl).open()
+    c1 = w.conn()
+    with w.with_conn() as conn:
+        assert conn is c1
+    assert w.conn() is c1  # success: same conn kept
+
+    with pytest.raises(ValueError):
+        with w.with_conn() as conn:
+            raise ValueError("boom")
+    # original error propagated AND the conn was replaced
+    assert w.conn() is not c1
+    assert c1.closed
+
+
+def test_wrapper_open_returning_none_raises():
+    w = reconnect.wrapper(lambda: None, lambda conn: None)
+    with pytest.raises(RuntimeError, match="returned None"):
+        w.open()
+
+
+def test_wrapper_concurrent_readers():
+    w = reconnect.wrapper(Conn, lambda conn: None).open()
+    inside = []
+    barrier = threading.Barrier(4, timeout=5)
+
+    def body():
+        with w.with_conn() as conn:
+            barrier.wait()  # all 4 readers hold the conn at once
+            inside.append(conn)
+
+    ts = [threading.Thread(target=body) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=5)
+    assert len(inside) == 4
+    assert len({id(conn) for conn in inside}) == 1
+
+
+def test_rwlock_writer_blocks_readers():
+    lk = reconnect.RWLock()
+    order = []
+    lk.acquire_write()
+
+    def reader():
+        with lk.read():
+            order.append("read")
+
+    t = threading.Thread(target=reader)
+    t.start()
+    t.join(timeout=0.2)
+    assert order == []  # reader blocked by writer
+    order.append("write-done")
+    lk.release_write()
+    t.join(timeout=5)
+    assert order == ["write-done", "read"]
+
+
+# ------------------------------------------------------------- net
+
+
+class NetRemote(c.Remote):
+    def __init__(self, responses):
+        self.responses = responses
+        self.cmds = []
+
+    def connect(self, conn_spec):
+        return self
+
+    def execute(self, ctx, cmd):
+        self.cmds.append(cmd)
+        for pat, out in self.responses.items():
+            if pat in cmd:
+                if isinstance(out, Exception):
+                    return c.Result(cmd, 1, "", str(out))
+                return c.Result(cmd, 0, out, "")
+        return c.Result(cmd, 0, "", "")
+
+    def upload(self, local_paths, remote_path):
+        content = open(local_paths[0]).read() if local_paths else ""
+        self.cmds.append(f"UPLOAD {remote_path}: {content!r}")
+
+    def download(self, remote_paths, local_path):
+        pass
+
+
+def test_net_helpers():
+    r = NetRemote({
+        "hostname -I": "10.0.0.5 172.17.0.1",
+        "getent ahosts n2": "10.0.0.6   STREAM n2\n10.0.0.6   DGRAM",
+        "echo $SSH_CLIENT": "10.0.0.99 53266 22",
+        "ping": RuntimeError("unreachable"),
+    })
+    with c.on_host(r, "n1"):
+        assert cnet.local_ip() == "10.0.0.5"
+        assert cnet.ip_uncached("n2") == "10.0.0.6"
+        assert cnet.control_ip() == "10.0.0.99"
+        assert cnet.reachable("n9") is False
+
+
+def test_net_blank_getent_raises():
+    r = NetRemote({"getent ahosts nx": "   "})
+    with c.on_host(r, "n1"):
+        with pytest.raises(RuntimeError, match="blank getent"):
+            cnet.ip_uncached("nx")
+
+
+# ---------------------------------------------------------- os variants
+
+
+def test_centos_setup_uses_yum():
+    from jepsen_tpu import os as os_mod
+    r = NetRemote({"hostname": "n1",
+                   "cat /etc/hosts": "127.0.0.1 localhost"})
+    with c.on_host(r, "n1"):
+        os_mod.centos(["extra-pkg"]).setup({"nodes": ["n1"]}, "n1")
+    yum = [cmd for cmd in r.cmds if "yum install" in cmd]
+    assert yum and "extra-pkg" in yum[0]
+    # loopback line gained the hostname, shipped via upload
+    uploads = [cmd for cmd in r.cmds if cmd.startswith("UPLOAD /etc/hosts")]
+    assert uploads and "127.0.0.1 localhost n1" in uploads[0]
+
+
+def test_centos_hostfile_token_match():
+    """n1 must still be appended when a superstring token (n10) is
+    present; % sequences must survive the round-trip."""
+    from jepsen_tpu import os as os_mod
+    r = NetRemote({"hostname": "n1",
+                   "cat /etc/hosts":
+                       "127.0.0.1 localhost n10\nfe80::1%eth0 ipv6host"})
+    with c.on_host(r, "n1"):
+        os_mod.centos()._hostfile_loopback()
+    up = [cmd for cmd in r.cmds if cmd.startswith("UPLOAD /etc/hosts")][0]
+    assert "localhost n10 n1" in up
+    assert "fe80::1%eth0" in up
+
+
+def test_ubuntu_is_debian():
+    from jepsen_tpu import os as os_mod
+    assert isinstance(os_mod.ubuntu(), os_mod.Debian)
+
+
+# --------------------------------------------------------- report/codec
+
+
+def test_report_to(tmp_path):
+    path = str(tmp_path / "sub" / "report.txt")
+    with report.to(path):
+        print("all is well")
+    assert open(path).read() == "all is well\n"
+
+
+def test_codec_roundtrip():
+    for v in (None, 42, "hi", ["a", 1, None], {"k": [1, 2]},
+              {"nested": {"deep": True}}):
+        assert codec.decode(codec.encode(v)) == v
+    assert codec.encode(None) == b""
+    assert codec.decode(b"") is None
+    assert codec.decode(None) is None
