@@ -110,17 +110,10 @@ class Wrapper:
         return self
 
     def reopen(self) -> "Wrapper":
-        """(reconnect.clj:77-90)."""
+        """Closes (tolerating a dead connection) and opens fresh
+        (reconnect.clj:77-90)."""
         with self.lock.write():
-            if self._conn is not None:
-                self._close(self._conn)
-                self._conn = None
-            c = self._open()
-            if c is None:
-                raise RuntimeError(
-                    f"Reconnect wrapper {self.name!r}'s open function "
-                    f"returned None instead of a connection!")
-            self._conn = c
+            self.reopen_locked()
         return self
 
     @contextlib.contextmanager

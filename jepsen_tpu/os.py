@@ -134,7 +134,12 @@ class Centos(OS):
         try:
             with _os.fdopen(fd, "w") as f:
                 f.write("\n".join(out) + "\n")
-            c.upload([tmp], "/etc/hosts")
+            # Upload to /tmp first: uploads run as the login user (scp
+            # has no sudo), while the final cp honors the su binding.
+            staged = "/tmp/jepsen-hosts"
+            c.upload([tmp], staged)
+            c.exec_("cp", staged, "/etc/hosts")
+            c.exec_("rm", "-f", staged)
         finally:
             _os.unlink(tmp)
 

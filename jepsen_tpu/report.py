@@ -40,6 +40,12 @@ class _ThreadStdoutProxy(io.TextIOBase):
     def writable(self):
         return True
 
+    def __getattr__(self, name):
+        # Delegate everything else (buffer, fileno, encoding, isatty…)
+        # to the real stdout so unrelated code keeps working after the
+        # proxy is installed.
+        return getattr(self.real, name)
+
 
 _install_lock = threading.Lock()
 

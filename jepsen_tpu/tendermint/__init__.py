@@ -1,0 +1,4 @@
+"""Tendermint test suite: the worked example bundled with the framework
+(reference: tendermint/ — cli.clj, core.clj, client.clj, gowire.clj,
+db.clj, validator.clj) plus the native merkleeyes app it exercises
+(native/merkleeyes/, C++)."""

@@ -1,0 +1,129 @@
+"""go-wire style binary tx encoding
+(reference: tendermint/src/jepsen/tendermint/gowire.clj:5-109).
+
+Byte strings are uvarint-length-prefixed; integers are 8-byte
+big-endian; a tx is nonce[12] ∥ type-byte ∥ args (merkleeyes
+README "Formatting", app.go:488-520)."""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Union
+
+NONCE_LENGTH = 12
+
+# Tx type bytes (app.go:22-30; tendermint/client.clj:113-122)
+TX_SET = 0x01
+TX_RM = 0x02
+TX_GET = 0x03
+TX_CAS = 0x04
+TX_VALSET_CHANGE = 0x05
+TX_VALSET_READ = 0x06
+TX_VALSET_CAS = 0x07
+
+
+def uvarint(n: int) -> bytes:
+    """Unsigned LEB128, as Go's binary.PutUvarint (gowire.clj:20-41)."""
+    assert n >= 0
+    out = bytearray()
+    while n >= 0x80:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+    return bytes(out)
+
+
+def read_uvarint(data: bytes, pos: int = 0) -> tuple:
+    """(value, new_pos); raises on truncation."""
+    v, shift = 0, 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated uvarint")
+        b = data[pos]
+        pos += 1
+        v |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return v, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("uvarint overflow")
+
+
+def varint(n: int) -> bytes:
+    """Signed zigzag varint (binary.PutVarint)."""
+    return uvarint((n << 1) ^ (n >> 63) if n < 0 else n << 1)
+
+
+def read_varint(data: bytes, pos: int = 0) -> tuple:
+    uv, pos = read_uvarint(data, pos)
+    v = uv >> 1
+    return (~v if uv & 1 else v), pos
+
+
+def encode_bytes(b: Union[bytes, str]) -> bytes:
+    """uvarint(len) ∥ raw (gowire.clj:43-61)."""
+    if isinstance(b, str):
+        b = b.encode("utf-8")
+    return uvarint(len(b)) + b
+
+
+def read_bytes(data: bytes, pos: int = 0) -> tuple:
+    n, pos = read_uvarint(data, pos)
+    if len(data) - pos < n:
+        raise ValueError("truncated bytes field")
+    return data[pos:pos + n], pos + n
+
+
+def u64be(n: int) -> bytes:
+    """8-byte big-endian (app.go:528-534 decodeInt's inverse)."""
+    return struct.pack(">Q", n)
+
+
+def nonce() -> bytes:
+    """A fresh 12-byte random nonce (client.clj's nonce generation)."""
+    return os.urandom(NONCE_LENGTH)
+
+
+def tx(type_byte: int, *args: bytes, nonce_: bytes = None) -> bytes:
+    """nonce ∥ type ∥ args (gowire.clj:103-109)."""
+    n = nonce_ if nonce_ is not None else nonce()
+    assert len(n) == NONCE_LENGTH
+    return n + bytes([type_byte]) + b"".join(args)
+
+
+# -- the tx constructors the clients use (client.clj:130-206) ---------
+
+
+def set_tx(key, value, nonce_=None) -> bytes:
+    return tx(TX_SET, encode_bytes(key), encode_bytes(value), nonce_=nonce_)
+
+
+def rm_tx(key, nonce_=None) -> bytes:
+    return tx(TX_RM, encode_bytes(key), nonce_=nonce_)
+
+
+def get_tx(key, nonce_=None) -> bytes:
+    return tx(TX_GET, encode_bytes(key), nonce_=nonce_)
+
+
+def cas_tx(key, compare, set_value, nonce_=None) -> bytes:
+    return tx(TX_CAS, encode_bytes(key), encode_bytes(compare),
+              encode_bytes(set_value), nonce_=nonce_)
+
+
+def valset_change_tx(pubkey: bytes, power: int, nonce_=None) -> bytes:
+    assert len(pubkey) == 32
+    return tx(TX_VALSET_CHANGE, encode_bytes(pubkey), u64be(power),
+              nonce_=nonce_)
+
+
+def valset_read_tx(nonce_=None) -> bytes:
+    return tx(TX_VALSET_READ, nonce_=nonce_)
+
+
+def valset_cas_tx(version: int, pubkey: bytes, power: int,
+                  nonce_=None) -> bytes:
+    assert len(pubkey) == 32
+    return tx(TX_VALSET_CAS, u64be(version), encode_bytes(pubkey),
+              u64be(power), nonce_=nonce_)

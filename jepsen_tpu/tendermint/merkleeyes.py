@@ -1,0 +1,267 @@
+"""Client for the native merkleeyes server (native/merkleeyes/).
+
+Speaks the framed session protocol documented in
+native/merkleeyes/README.md — the capability parallel of the
+tendermint↔merkleeyes ABCI socket link (merkleeyes/cmd/merkleeyes/
+main.go:26-57, tendermint/db.clj:84-87). Also knows how to build and
+spawn the server binary for local integration runs."""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from jepsen_tpu.tendermint import gowire as w
+
+NATIVE_DIR = Path(__file__).resolve().parents[2] / "native" / "merkleeyes"
+BINARY = NATIVE_DIR / "build" / "merkleeyes"
+
+# Message types (server.cc)
+MSG_INFO = 0x10
+MSG_CHECK_TX = 0x11
+MSG_DELIVER_TX = 0x12
+MSG_BEGIN_BLOCK = 0x13
+MSG_END_BLOCK = 0x14
+MSG_COMMIT = 0x15
+MSG_QUERY = 0x16
+MSG_ECHO = 0x17
+MSG_FLUSH = 0x18
+
+# Error codes (app.go:33-40)
+OK = 0
+CODE_UNKNOWN_REQUEST = 2
+CODE_ENCODING_ERROR = 3
+CODE_BAD_NONCE = 4
+CODE_UNKNOWN_TX_TYPE = 5
+CODE_INTERNAL = 6
+CODE_BASE_UNKNOWN_ADDRESS = 7
+CODE_UNAUTHORIZED = 8
+
+
+@dataclass
+class TxResult:
+    code: int
+    data: bytes = b""
+    log: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.code == OK
+
+
+@dataclass
+class QueryResult:
+    code: int
+    height: int = 0
+    index: int = -1
+    key: bytes = b""
+    value: bytes = b""
+    log: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.code == OK
+
+
+class MerkleeyesClient:
+    """One framed-protocol session. Address: ('unix', path) or
+    ('tcp', (host, port))."""
+
+    def __init__(self, address, timeout: float = 10.0):
+        self.address = address
+        self.timeout = timeout
+        self.sock: Optional[socket.socket] = None
+
+    # -- connection ---------------------------------------------------
+
+    def connect(self) -> "MerkleeyesClient":
+        kind, addr = self.address
+        if kind == "unix":
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        else:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.settimeout(self.timeout)
+        s.connect(addr)
+        self.sock = s
+        return self
+
+    def close(self):
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            finally:
+                self.sock = None
+
+    def __enter__(self):
+        return self.connect()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- framing ------------------------------------------------------
+
+    def _roundtrip(self, msg_type: int, body: bytes = b"") -> bytes:
+        assert self.sock is not None, "not connected"
+        payload = bytes([msg_type]) + body
+        self.sock.sendall(w.uvarint(len(payload)) + payload)
+        return self._read_frame()
+
+    def _read_exact(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = self.sock.recv(n - len(out))
+            if not chunk:
+                raise ConnectionError("merkleeyes closed the connection")
+            out += chunk
+        return out
+
+    def _read_frame(self) -> bytes:
+        length, shift = 0, 0
+        while True:
+            b = self._read_exact(1)[0]
+            length |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+        return self._read_exact(length)
+
+    # -- ABCI surface -------------------------------------------------
+
+    def info(self) -> Tuple[int, bytes]:
+        """(height, last committed app hash)."""
+        resp = self._roundtrip(MSG_INFO)
+        code, pos = w.read_uvarint(resp, 1)
+        assert code == OK, code
+        height, pos = w.read_varint(resp, pos)
+        apphash, _ = w.read_bytes(resp, pos)
+        return height, apphash
+
+    def _tx_result(self, resp: bytes) -> TxResult:
+        code, pos = w.read_uvarint(resp, 1)
+        data, pos = w.read_bytes(resp, pos)
+        log, _ = w.read_bytes(resp, pos)
+        return TxResult(code, data, log.decode("utf-8", "replace"))
+
+    def check_tx(self, tx: bytes) -> TxResult:
+        return self._tx_result(self._roundtrip(MSG_CHECK_TX, tx))
+
+    def deliver_tx(self, tx: bytes) -> TxResult:
+        return self._tx_result(self._roundtrip(MSG_DELIVER_TX, tx))
+
+    def begin_block(self):
+        self._roundtrip(MSG_BEGIN_BLOCK)
+
+    def end_block(self) -> List[Tuple[bytes, int]]:
+        resp = self._roundtrip(MSG_END_BLOCK)
+        code, pos = w.read_uvarint(resp, 1)
+        assert code == OK, code
+        n, pos = w.read_uvarint(resp, pos)
+        updates = []
+        for _ in range(n):
+            pk, pos = w.read_bytes(resp, pos)
+            power, pos = w.read_varint(resp, pos)
+            updates.append((pk, power))
+        return updates
+
+    def commit(self) -> bytes:
+        resp = self._roundtrip(MSG_COMMIT)
+        code, pos = w.read_uvarint(resp, 1)
+        assert code == OK, code
+        apphash, _ = w.read_bytes(resp, pos)
+        return apphash
+
+    def query(self, path: str, data: bytes = b"") -> QueryResult:
+        body = w.encode_bytes(path) + data
+        resp = self._roundtrip(MSG_QUERY, body)
+        code, pos = w.read_uvarint(resp, 1)
+        height, pos = w.read_varint(resp, pos)
+        index, pos = w.read_varint(resp, pos)
+        key, pos = w.read_bytes(resp, pos)
+        value, pos = w.read_bytes(resp, pos)
+        log, _ = w.read_bytes(resp, pos)
+        return QueryResult(code, height, index, key, value,
+                           log.decode("utf-8", "replace"))
+
+    def echo(self, data: bytes) -> bytes:
+        resp = self._roundtrip(MSG_ECHO, data)
+        return resp[2:]
+
+    # -- convenience: tx + block + commit in one shot -----------------
+
+    def tx_commit(self, tx: bytes) -> TxResult:
+        """DeliverTx inside its own block, then commit — the shape of
+        tendermint's /broadcast_tx_commit (tendermint/client.clj:79-93)."""
+        self.begin_block()
+        r = self.deliver_tx(tx)
+        self.end_block()
+        self.commit()
+        return r
+
+
+# -------------------------------------------------------- local server
+
+
+def build(force: bool = False) -> Path:
+    """Builds the native binary via make; returns its path."""
+    if force or not BINARY.exists():
+        subprocess.run(["make", "-s"], cwd=NATIVE_DIR, check=True)
+    return BINARY
+
+
+@dataclass
+class LocalServer:
+    """A locally spawned merkleeyes process on a unix socket."""
+
+    sock_path: str
+    wal_path: Optional[str] = None
+    proc: Optional[subprocess.Popen] = None
+    extra_args: List[str] = field(default_factory=list)
+
+    def start(self) -> "LocalServer":
+        binary = build()
+        args = [str(binary), "--listen", f"unix:{self.sock_path}"]
+        if self.wal_path:
+            args += ["--wal", self.wal_path]
+        args += self.extra_args
+        self.proc = subprocess.Popen(
+            args, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if os.path.exists(self.sock_path):
+                try:
+                    with MerkleeyesClient(("unix", self.sock_path)) as cl:
+                        cl.echo(b"ping")
+                    return self
+                except OSError:
+                    pass
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"merkleeyes exited with {self.proc.returncode}")
+            time.sleep(0.02)
+        raise TimeoutError("merkleeyes did not come up")
+
+    def stop(self):
+        if self.proc is not None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+            self.proc = None
+
+    def client(self) -> MerkleeyesClient:
+        return MerkleeyesClient(("unix", self.sock_path)).connect()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -1,0 +1,391 @@
+// The merkleeyes application: an ABCI-style Merkle-KV state machine.
+//
+// Behavior parity with the reference Go app (merkleeyes/app.go):
+//   tx = nonce[12] ∥ type ∥ args            (app.go:22-30,226-238)
+//   types: Set 0x01, Rm 0x02, Get 0x03, CAS 0x04,
+//          ValSetChange 0x05, ValSetRead 0x06, ValSetCAS 0x07
+//   error codes (app.go:33-40): 0 ok, 2 unknown-request, 3 encoding,
+//          4 bad-nonce, 5 unknown-tx-type, 6 internal,
+//          7 base-unknown-address, 8 unauthorized
+//   nonce dedupe in-tree under "/nonce/" (app.go:219-250)
+//   user keys under "/key/" (app.go:223-226)
+//   committed vs working tree; queries answer from committed only
+//          (app.go:158-217, state.go:14-24)
+//   valset changes collected per block, version bumped at EndBlock when
+//          changes exist (app.go:134-146,451-485)
+//
+// Durability: an append-only WAL of committed tx blocks (frame =
+// uvarint(len) ∥ txs), replayed at startup; a trailing partial frame is
+// ignored — that is what the truncate nemesis produces. The reference
+// delegates this to goleveldb; a WAL keeps the native component
+// self-contained and gives file truncation well-defined semantics.
+#pragma once
+
+#include <cstdio>
+#include <map>
+#include <string>
+
+#include "tree.h"
+#include "wire.h"
+
+namespace merkleeyes {
+
+// Error codes (app.go:33-40).
+enum Code : uint32_t {
+  OK = 0,
+  UnknownRequest = 2,
+  EncodingError = 3,
+  BadNonce = 4,
+  ErrUnknownRequest = 5,
+  InternalError = 6,
+  ErrBaseUnknownAddress = 7,
+  ErrUnauthorized = 8,
+};
+
+constexpr size_t kNonceLength = 12;     // app.go:31
+constexpr size_t kPubKeySize = 32;      // ed25519
+constexpr size_t kMinTxLen = kNonceLength + 1;
+
+struct TxResult {
+  uint32_t code = OK;
+  bytes data;
+  std::string log;
+};
+
+struct QueryResult {
+  uint32_t code = OK;
+  int64_t height = 0;
+  int64_t index = -1;
+  bytes key;
+  bytes value;
+  std::string log;
+};
+
+inline bytes cat(const char* prefix, const bytes& b) {
+  bytes out(prefix, prefix + std::strlen(prefix));
+  out.insert(out.end(), b.begin(), b.end());
+  return out;
+}
+
+class App {
+ public:
+  // wal_path empty = in-memory only.
+  explicit App(std::string wal_path = "") : wal_path_(std::move(wal_path)) {
+    if (!wal_path_.empty()) replay_wal();
+  }
+
+  // ---- ABCI surface -------------------------------------------------
+
+  // Info (app.go:95-103): (height, committed app hash).
+  std::pair<int64_t, bytes> info() const {
+    auto h = committed_.hash();
+    return {height_, bytes(h.begin(), h.end())};
+  }
+
+  TxResult check_tx(const bytes& tx) const {  // app.go:116-126
+    if (tx.size() < kMinTxLen)
+      return {EncodingError, {}, "Tx length must be at least 13"};
+    return {OK, {}, ""};
+  }
+
+  TxResult deliver_tx(const bytes& tx) {  // app.go:129-131
+    TxResult r = do_tx(tx);
+    if (r.code == OK || r.code == ErrBaseUnknownAddress ||
+        r.code == ErrUnauthorized || r.code == BadNonce) {
+      // Replayable outcomes mutate the nonce set (and maybe the tree):
+      // record them so WAL replay reproduces the exact same state.
+      block_.insert(block_.end(), tx.begin(), tx.end());
+      block_frames_.push_back(tx.size());
+    }
+    return r;
+  }
+
+  void begin_block() {  // app.go:134-139
+    changes_.clear();
+  }
+
+  // Returns the validator updates of this block (app.go:141-147).
+  std::map<bytes, int64_t> end_block() {
+    if (!changes_.empty()) valset_version_++;
+    return changes_;
+  }
+
+  bytes commit() {  // app.go:149-156, state.go:66-90
+    committed_ = working_;
+    height_++;
+    append_wal();
+    block_.clear();
+    block_frames_.clear();
+    auto h = committed_.hash();
+    return bytes(h.begin(), h.end());
+  }
+
+  QueryResult query(const std::string& path, const bytes& data,
+                    int64_t req_height = 0) const {  // app.go:158-217
+    QueryResult res;
+    if (req_height != 0) {
+      res.code = InternalError;
+      res.log = "merkleeyes only supports queries on latest commit";
+      return res;
+    }
+    res.height = height_;
+    if (path == "/store" || path == "/key") {
+      res.key = data;
+      auto got = committed_.get(cat("/key/", data));
+      if (!got) {
+        res.code = ErrBaseUnknownAddress;
+        res.log = "not found";
+        return res;
+      }
+      res.index = got->first;
+      res.value = got->second;
+    } else if (path == "/index") {
+      auto [idx, n] = get_varint(data.data(), data.size());
+      if (n != int(data.size())) {
+        res.code = EncodingError;
+        res.log = "Varint did not consume all of in";
+        return res;
+      }
+      auto got = committed_.get_by_index(idx);
+      if (!got) {
+        res.code = ErrBaseUnknownAddress;
+        res.log = "not found";
+        return res;
+      }
+      res.key = got->first;
+      res.index = idx;
+      res.value = got->second;
+    } else if (path == "/size") {
+      bytes v;
+      put_varint(v, committed_.size());
+      res.value = v;
+    } else {
+      res.code = UnknownRequest;
+      res.log = "Unexpected Query path: " + path;
+    }
+    return res;
+  }
+
+  int64_t height() const { return height_; }
+  uint64_t valset_version() const { return valset_version_; }
+  const std::map<bytes, int64_t>& validators() const { return validators_; }
+
+  // JSON of the validator set (ValSetRead, app.go:383-395).
+  std::string valset_json() const {
+    std::string out = "{\"version\":" + std::to_string(valset_version_) +
+                      ",\"validators\":[";
+    bool first = true;
+    for (const auto& [pk, power] : validators_) {
+      if (!first) out += ",";
+      first = false;
+      out += "{\"pub_key\":\"" + to_hex(pk.data(), pk.size()) +
+             "\",\"power\":" + std::to_string(power) + "}";
+    }
+    out += "]}";
+    return out;
+  }
+
+ private:
+  // ---- tx execution -------------------------------------------------
+
+  // unmarshalBytes (app.go:488-520): uvarint length-prefixed field.
+  static std::pair<bytes, TxResult> read_field(const bytes& buf, size_t& pos,
+                                               const char* what,
+                                               bool must_exhaust) {
+    auto [len, n] = get_uvarint(buf.data() + pos, buf.size() - pos);
+    if (n <= 0)
+      return {{}, {EncodingError, {}, std::string("Buf too small ") + what}};
+    if (len == 0)
+      return {{}, {EncodingError, {},
+                   std::string("Zero or negative length ") + what}};
+    if (buf.size() - pos < size_t(n) + len)
+      return {{}, {EncodingError, {},
+                   std::string("Not enough bytes ") + what}};
+    bytes field(buf.begin() + pos + n, buf.begin() + pos + n + len);
+    pos += size_t(n) + len;
+    if (must_exhaust && pos != buf.size())
+      return {{}, {EncodingError, {}, "Got bytes left over"}};
+    return {field, {OK, {}, ""}};
+  }
+
+  TxResult do_tx(const bytes& tx_full) {  // app.go:227-448
+    if (tx_full.size() < kMinTxLen)
+      return {EncodingError, {}, "Tx length must be at least 13"};
+    bytes nonce(tx_full.begin(), tx_full.begin() + kNonceLength);
+
+    // Nonce check + mark (app.go:239-250). Applied to the working tree
+    // so a replayed nonce is rejected even before commit.
+    bytes nkey = cat("/nonce/", nonce);
+    if (working_.get(nkey)) {
+      return {BadNonce,
+              {},
+              "Nonce " + to_hex(nonce.data(), nonce.size()) +
+                  " already exists"};
+    }
+    working_ = working_.set(nkey, {0x01});
+
+    uint8_t type = tx_full[kNonceLength];
+    bytes tx(tx_full.begin() + kMinTxLen, tx_full.end());
+    size_t pos = 0;
+
+    switch (type) {
+      case 0x01: {  // Set (app.go:257-271)
+        auto [key, err1] = read_field(tx, pos, "key", false);
+        if (err1.code != OK) return err1;
+        auto [value, err2] = read_field(tx, pos, "value", true);
+        if (err2.code != OK) return err2;
+        working_ = working_.set(cat("/key/", key), value);
+        return {OK, {}, ""};
+      }
+      case 0x02: {  // Rm (app.go:273-289)
+        auto [key, err] = read_field(tx, pos, "key", true);
+        if (err.code != OK) return err;
+        auto [t2, removed] = working_.remove(cat("/key/", key));
+        if (!removed)
+          return {ErrBaseUnknownAddress, {},
+                  "Failed to remove " + to_hex(key.data(), key.size())};
+        working_ = t2;
+        return {OK, {}, ""};
+      }
+      case 0x03: {  // Get (app.go:291-306)
+        auto [key, err] = read_field(tx, pos, "key", true);
+        if (err.code != OK) return err;
+        auto got = working_.get(cat("/key/", key));
+        if (!got)
+          return {ErrBaseUnknownAddress, {},
+                  "Cannot find key: " + to_hex(key.data(), key.size())};
+        return {OK, got->second, ""};
+      }
+      case 0x04: {  // CompareAndSet (app.go:308-352)
+        auto [key, err1] = read_field(tx, pos, "key", false);
+        if (err1.code != OK) return err1;
+        auto [cmp, err2] = read_field(tx, pos, "compareKey", false);
+        if (err2.code != OK) return err2;
+        auto [setv, err3] = read_field(tx, pos, "setValue", true);
+        if (err3.code != OK) return err3;
+        auto got = working_.get(cat("/key/", key));
+        if (!got)
+          return {ErrBaseUnknownAddress, {},
+                  "Cannot find key: " + to_hex(key.data(), key.size())};
+        if (got->second != cmp)
+          return {ErrUnauthorized, {},
+                  "Value was " + to_hex(got->second.data(),
+                                        got->second.size()) +
+                      ", not " + to_hex(cmp.data(), cmp.size())};
+        working_ = working_.set(cat("/key/", key), setv);
+        return {OK, {}, ""};
+      }
+      case 0x05: {  // ValSetChange (app.go:354-382)
+        auto [pubkey, err] = read_field(tx, pos, "pubKey", false);
+        if (err.code != OK) return err;
+        if (pubkey.size() != kPubKeySize)
+          return {EncodingError, {}, "PubKey must be 32 bytes"};
+        auto power = get_u64be(tx.data() + pos, tx.size() - pos);
+        if (!power)
+          return {EncodingError, {}, "Can't decode power: not enough bytes"};
+        return update_validator(pubkey, int64_t(*power));
+      }
+      case 0x06:  // ValSetRead (app.go:383-395)
+        return {OK, [&] {
+                  std::string j = valset_json();
+                  return bytes(j.begin(), j.end());
+                }(), ""};
+      case 0x07: {  // ValSetCAS (app.go:397-441)
+        auto version = get_u64be(tx.data(), tx.size());
+        if (!version)
+          return {EncodingError, {}, "Can't decode version: not enough bytes"};
+        if (valset_version_ != *version)
+          return {ErrUnauthorized, {},
+                  "Version was " + std::to_string(valset_version_) +
+                      ", not " + std::to_string(*version)};
+        pos = 8;
+        auto [pubkey, err] = read_field(tx, pos, "pubKey", false);
+        if (err.code != OK) return err;
+        if (pubkey.size() != kPubKeySize)
+          return {EncodingError, {}, "PubKey must be 32 bytes"};
+        auto power = get_u64be(tx.data() + pos, tx.size() - pos);
+        if (!power)
+          return {EncodingError, {}, "Can't decode power: not enough bytes"};
+        return update_validator(pubkey, int64_t(*power));
+      }
+      default:
+        return {ErrUnknownRequest, {}, "Unexpected tx type byte"};
+    }
+  }
+
+  TxResult update_validator(const bytes& pubkey, int64_t power) {
+    // app.go:451-485: power 0 removes (error if absent); else upsert.
+    if (power == 0) {
+      auto it = validators_.find(pubkey);
+      if (it == validators_.end())
+        return {ErrUnauthorized, {}, "Cannot remove non-existent validator"};
+      validators_.erase(it);
+    } else {
+      validators_[pubkey] = power;
+    }
+    changes_[pubkey] = power;  // last change per pubkey wins in the block
+    return {OK, {}, ""};
+  }
+
+  // ---- WAL ----------------------------------------------------------
+
+  void append_wal() {
+    if (wal_path_.empty() || block_.empty()) return;
+    FILE* f = std::fopen(wal_path_.c_str(), "ab");
+    if (!f) return;
+    bytes frame;
+    bytes payload;
+    for (size_t i = 0, off = 0; i < block_frames_.size(); i++) {
+      put_uvarint(payload, block_frames_[i]);
+      payload.insert(payload.end(), block_.begin() + off,
+                     block_.begin() + off + block_frames_[i]);
+      off += block_frames_[i];
+    }
+    put_uvarint(frame, payload.size());
+    frame.insert(frame.end(), payload.begin(), payload.end());
+    std::fwrite(frame.data(), 1, frame.size(), f);
+    std::fflush(f);
+    std::fclose(f);
+  }
+
+  void replay_wal() {
+    FILE* f = std::fopen(wal_path_.c_str(), "rb");
+    if (!f) return;
+    bytes data;
+    uint8_t buf[65536];
+    size_t n;
+    while ((n = std::fread(buf, 1, sizeof(buf), f)) > 0)
+      data.insert(data.end(), buf, buf + n);
+    std::fclose(f);
+
+    size_t pos = 0;
+    while (pos < data.size()) {
+      auto [flen, c] = get_uvarint(data.data() + pos, data.size() - pos);
+      if (c <= 0 || data.size() - pos - c < flen) break;  // partial: stop
+      size_t p = pos + c, end = pos + c + flen;
+      while (p < end) {
+        auto [tlen, tc] = get_uvarint(data.data() + p, end - p);
+        if (tc <= 0 || end - p - tc < tlen) break;
+        bytes tx(data.begin() + p + tc, data.begin() + p + tc + tlen);
+        do_tx(tx);  // replay against the working tree
+        p += tc + tlen;
+      }
+      committed_ = working_;
+      height_++;
+      pos = end;
+    }
+    block_.clear();
+    block_frames_.clear();
+  }
+
+  Tree working_, committed_;  // state.go:14-24
+  int64_t height_ = 0;
+  uint64_t valset_version_ = 0;
+  std::map<bytes, int64_t> validators_;
+  std::map<bytes, int64_t> changes_;  // this block's updates
+  bytes block_;                       // txs accepted since last commit
+  std::vector<size_t> block_frames_;
+  std::string wal_path_;
+};
+
+}  // namespace merkleeyes

@@ -1,0 +1,278 @@
+// merkleeyes server: serves the App over a unix or TCP socket.
+//
+// Capability parallel of the reference's ABCI socket server
+// (merkleeyes/cmd/merkleeyes/main.go:26-57, which listens on a unix
+// socket for tendermint). The session protocol is this build's own
+// minimal ABCI equivalent (documented in ../README.md):
+//
+//   request  = uvarint(len) ∥ msg-type ∥ body
+//   response = uvarint(len) ∥ msg-type ∥ fields
+//
+// msg types: 0x10 Info, 0x11 CheckTx, 0x12 DeliverTx, 0x13 BeginBlock,
+//            0x14 EndBlock, 0x15 Commit, 0x16 Query, 0x17 Echo,
+//            0x18 Flush
+//
+// One worker thread per connection; the App is serialized behind a
+// mutex (tendermint drives ABCI from one connection, but the test
+// harness may open several).
+//
+// Usage: merkleeyes --listen unix:/tmp/me.sock [--wal /path/wal]
+//        merkleeyes --listen tcp:46658 [--wal /path/wal]
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <csignal>
+#include <cstring>
+#include <iostream>
+#include <mutex>
+#include <thread>
+
+#include "app.h"
+
+namespace merkleeyes {
+
+enum Msg : uint8_t {
+  MsgInfo = 0x10,
+  MsgCheckTx = 0x11,
+  MsgDeliverTx = 0x12,
+  MsgBeginBlock = 0x13,
+  MsgEndBlock = 0x14,
+  MsgCommit = 0x15,
+  MsgQuery = 0x16,
+  MsgEcho = 0x17,
+  MsgFlush = 0x18,
+};
+
+struct Server {
+  App app;
+  std::mutex mu;
+
+  explicit Server(const std::string& wal) : app(wal) {}
+
+  bytes handle(const bytes& req) {
+    std::lock_guard<std::mutex> lock(mu);
+    bytes resp;
+    if (req.empty()) {
+      resp.push_back(0x00);
+      put_uvarint(resp, EncodingError);
+      return resp;
+    }
+    uint8_t type = req[0];
+    const uint8_t* body = req.data() + 1;
+    size_t n = req.size() - 1;
+    resp.push_back(type);
+    switch (type) {
+      case MsgInfo: {
+        auto [height, hash] = app.info();
+        put_uvarint(resp, OK);
+        put_varint(resp, height);
+        put_bytes(resp, hash);
+        break;
+      }
+      case MsgCheckTx: {
+        TxResult r = app.check_tx(bytes(body, body + n));
+        put_uvarint(resp, r.code);
+        put_bytes(resp, r.data);
+        put_str(resp, r.log);
+        break;
+      }
+      case MsgDeliverTx: {
+        TxResult r = app.deliver_tx(bytes(body, body + n));
+        put_uvarint(resp, r.code);
+        put_bytes(resp, r.data);
+        put_str(resp, r.log);
+        break;
+      }
+      case MsgBeginBlock:
+        app.begin_block();
+        put_uvarint(resp, OK);
+        break;
+      case MsgEndBlock: {
+        auto updates = app.end_block();
+        put_uvarint(resp, OK);
+        put_uvarint(resp, updates.size());
+        for (const auto& [pk, power] : updates) {
+          put_bytes(resp, pk);
+          put_varint(resp, power);
+        }
+        break;
+      }
+      case MsgCommit: {
+        bytes hash = app.commit();
+        put_uvarint(resp, OK);
+        put_bytes(resp, hash);
+        break;
+      }
+      case MsgQuery: {
+        // body = uvarint(len path) ∥ path ∥ data
+        auto [plen, c] = get_uvarint(body, n);
+        if (c <= 0 || n - c < plen) {
+          put_uvarint(resp, EncodingError);
+          put_varint(resp, 0);
+          put_varint(resp, -1);
+          put_bytes(resp, {});
+          put_bytes(resp, {});
+          put_str(resp, "bad query frame");
+          break;
+        }
+        std::string path(body + c, body + c + plen);
+        bytes data(body + c + plen, body + n);
+        QueryResult q = app.query(path, data);
+        put_uvarint(resp, q.code);
+        put_varint(resp, q.height);
+        put_varint(resp, q.index);
+        put_bytes(resp, q.key);
+        put_bytes(resp, q.value);
+        put_str(resp, q.log);
+        break;
+      }
+      case MsgEcho:
+        put_uvarint(resp, OK);
+        resp.insert(resp.end(), body, body + n);
+        break;
+      case MsgFlush:
+        put_uvarint(resp, OK);
+        break;
+      default:
+        resp[0] = 0x00;
+        put_uvarint(resp, UnknownRequest);
+        put_str(resp, "unknown message type");
+    }
+    return resp;
+  }
+};
+
+static bool read_exact(int fd, uint8_t* buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::read(fd, buf + got, n - got);
+    if (r <= 0) return false;
+    got += size_t(r);
+  }
+  return true;
+}
+
+static bool write_all(int fd, const uint8_t* buf, size_t n) {
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = ::write(fd, buf + sent, n - sent);
+    if (r <= 0) return false;
+    sent += size_t(r);
+  }
+  return true;
+}
+
+// Reads one uvarint-framed message; false on EOF/error.
+static bool read_frame(int fd, bytes& out) {
+  uint64_t len = 0;
+  int shift = 0;
+  while (true) {
+    uint8_t b;
+    if (!read_exact(fd, &b, 1)) return false;
+    len |= uint64_t(b & 0x7f) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+    if (shift > 63) return false;
+  }
+  if (len > (64u << 20)) return false;  // 64 MiB sanity cap
+  out.resize(len);
+  return len == 0 || read_exact(fd, out.data(), len);
+}
+
+static void serve_conn(Server* srv, int fd) {
+  bytes req;
+  while (read_frame(fd, req)) {
+    bytes resp = srv->handle(req);
+    bytes framed;
+    put_uvarint(framed, resp.size());
+    framed.insert(framed.end(), resp.begin(), resp.end());
+    if (!write_all(fd, framed.data(), framed.size())) break;
+  }
+  ::close(fd);
+}
+
+static int listen_unix(const std::string& path) {
+  ::unlink(path.c_str());
+  int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  std::strncpy(addr.sun_path, path.c_str(), sizeof(addr.sun_path) - 1);
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, 16) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+static int listen_tcp(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(uint16_t(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, 16) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+}  // namespace merkleeyes
+
+int main(int argc, char** argv) {
+  using namespace merkleeyes;
+  std::string listen_spec = "unix:/tmp/merkleeyes.sock";
+  std::string wal;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    if (a == "--listen" && i + 1 < argc) listen_spec = argv[++i];
+    else if (a == "--wal" && i + 1 < argc) wal = argv[++i];
+    else if (a == "--help") {
+      std::cout << "usage: merkleeyes --listen unix:PATH|tcp:PORT "
+                   "[--wal FILE]\n";
+      return 0;
+    } else {
+      std::cerr << "unknown flag: " << a << " (see --help)\n";
+      return 1;
+    }
+  }
+  ::signal(SIGPIPE, SIG_IGN);
+
+  int lfd = -1;
+  if (listen_spec.rfind("unix:", 0) == 0) {
+    lfd = listen_unix(listen_spec.substr(5));
+  } else if (listen_spec.rfind("tcp:", 0) == 0) {
+    lfd = listen_tcp(std::stoi(listen_spec.substr(4)));
+  } else {
+    std::cerr << "bad --listen spec: " << listen_spec << "\n";
+    return 1;
+  }
+  if (lfd < 0) {
+    std::cerr << "cannot listen on " << listen_spec << ": "
+              << std::strerror(errno) << "\n";
+    return 1;
+  }
+
+  Server srv(wal);
+  std::cout << "merkleeyes listening on " << listen_spec << std::endl;
+  while (true) {
+    int cfd = ::accept(lfd, nullptr, nullptr);
+    if (cfd < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    std::thread(serve_conn, &srv, cfd).detach();
+  }
+  ::close(lfd);
+  return 0;
+}

@@ -1,0 +1,206 @@
+// Persistent (path-copying) AVL+ Merkle tree.
+//
+// Capability parallel of cosmos/iavl as used by the reference
+// (merkleeyes/state.go:18-35): an ordered KV map whose every version is
+// an immutable snapshot sharing structure with its predecessors, with a
+// root hash covering keys and values. iavl's design — values at leaf
+// nodes, inner nodes carrying the split key — is kept, because it makes
+// leaf order (and so GetByIndex / key rank) the sort order of keys and
+// keeps values out of inner-node hashes.
+//
+// Node hashing (domain-separated, à la iavl):
+//   leaf:  H(0x00 ∥ uvarint(len k) ∥ k ∥ uvarint(len v) ∥ v)
+//   inner: H(0x01 ∥ height ∥ uvarint(size) ∥ lhash ∥ rhash)
+// The working tree (State.Working in state.go) is just "the latest
+// root"; Commit publishes it as the committed root — structural
+// sharing makes that free.
+#pragma once
+
+#include <memory>
+#include <optional>
+
+#include "sha256.h"
+#include "wire.h"
+
+namespace merkleeyes {
+
+struct Node;
+using NodeRef = std::shared_ptr<const Node>;
+
+struct Node {
+  bytes key;            // leaf: its key; inner: smallest key of right subtree
+  bytes value;          // leaf only
+  int height = 0;       // leaf = 0
+  int64_t size = 1;     // number of leaves under this node
+  NodeRef left, right;  // inner only
+  mutable std::optional<std::array<uint8_t, 32>> hash_cache;
+
+  bool leaf() const { return height == 0; }
+
+  const std::array<uint8_t, 32>& hash() const {
+    if (!hash_cache) {
+      Sha256 s;
+      bytes buf;
+      if (leaf()) {
+        buf.push_back(0x00);
+        put_bytes(buf, key);
+        put_bytes(buf, value);
+        s.update(buf);
+      } else {
+        buf.push_back(0x01);
+        put_uvarint(buf, uint64_t(height));
+        put_uvarint(buf, uint64_t(size));
+        s.update(buf);
+        s.update(left->hash().data(), 32);
+        s.update(right->hash().data(), 32);
+      }
+      hash_cache = s.digest();
+    }
+    return *hash_cache;
+  }
+};
+
+inline NodeRef make_leaf(bytes key, bytes value) {
+  auto n = std::make_shared<Node>();
+  n->key = std::move(key);
+  n->value = std::move(value);
+  return n;
+}
+
+inline NodeRef make_inner(NodeRef l, NodeRef r) {
+  auto n = std::make_shared<Node>();
+  n->height = 1 + std::max(l->height, r->height);
+  n->size = l->size + r->size;
+  // split key: smallest key in the right subtree
+  const Node* m = r.get();
+  while (!m->leaf()) m = m->left.get();
+  n->key = m->key;
+  n->left = std::move(l);
+  n->right = std::move(r);
+  return n;
+}
+
+inline int balance_factor(const NodeRef& n) {
+  return n->left->height - n->right->height;
+}
+
+inline NodeRef rotate_right(const NodeRef& n) {
+  return make_inner(n->left->left, make_inner(n->left->right, n->right));
+}
+
+inline NodeRef rotate_left(const NodeRef& n) {
+  return make_inner(make_inner(n->left, n->right->left), n->right->right);
+}
+
+inline NodeRef rebalance(NodeRef n) {
+  int bf = balance_factor(n);
+  if (bf > 1) {
+    if (balance_factor(n->left) < 0)
+      n = make_inner(rotate_left(n->left), n->right);
+    return rotate_right(n);
+  }
+  if (bf < -1) {
+    if (balance_factor(n->right) > 0)
+      n = make_inner(n->left, rotate_right(n->right));
+    return rotate_left(n);
+  }
+  return n;
+}
+
+// An immutable tree snapshot. All "mutators" return a new Tree.
+class Tree {
+ public:
+  Tree() = default;
+  explicit Tree(NodeRef root) : root_(std::move(root)) {}
+
+  int64_t size() const { return root_ ? root_->size : 0; }
+
+  std::array<uint8_t, 32> hash() const {
+    if (!root_) return Sha256::hash({});  // empty-tree hash
+    return root_->hash();
+  }
+
+  // (index, value) — index is the key's in-order rank; nullopt if absent.
+  std::optional<std::pair<int64_t, bytes>> get(const bytes& key) const {
+    const Node* n = root_.get();
+    int64_t rank = 0;
+    while (n) {
+      if (n->leaf()) {
+        if (n->key == key) return {{rank, n->value}};
+        return std::nullopt;
+      }
+      if (key < n->key) {
+        n = n->left.get();
+      } else {
+        rank += n->left->size;
+        n = n->right.get();
+      }
+    }
+    return std::nullopt;
+  }
+
+  // (key, value) at in-order index; nullopt out of range.
+  std::optional<std::pair<bytes, bytes>> get_by_index(int64_t idx) const {
+    if (!root_ || idx < 0 || idx >= root_->size) return std::nullopt;
+    const Node* n = root_.get();
+    while (!n->leaf()) {
+      if (idx < n->left->size) {
+        n = n->left.get();
+      } else {
+        idx -= n->left->size;
+        n = n->right.get();
+      }
+    }
+    return {{n->key, n->value}};
+  }
+
+  Tree set(const bytes& key, const bytes& value) const {
+    return Tree(set_(root_, key, value));
+  }
+
+  // (tree', removed?)
+  std::pair<Tree, bool> remove(const bytes& key) const {
+    if (!root_) return {*this, false};
+    auto [r, removed] = remove_(root_, key);
+    if (!removed) return {*this, false};
+    return {Tree(r), true};
+  }
+
+ private:
+  static NodeRef set_(const NodeRef& n, const bytes& key,
+                      const bytes& value) {
+    if (!n) return make_leaf(key, value);
+    if (n->leaf()) {
+      if (n->key == key) return make_leaf(key, value);
+      if (key < n->key)
+        return make_inner(make_leaf(key, value), n);
+      return make_inner(n, make_leaf(key, value));
+    }
+    if (key < n->key)
+      return rebalance(make_inner(set_(n->left, key, value), n->right));
+    return rebalance(make_inner(n->left, set_(n->right, key, value)));
+  }
+
+  // (subtree-or-null, removed?)
+  static std::pair<NodeRef, bool> remove_(const NodeRef& n,
+                                          const bytes& key) {
+    if (n->leaf()) {
+      if (n->key == key) return {nullptr, true};
+      return {n, false};
+    }
+    if (key < n->key) {
+      auto [l, removed] = remove_(n->left, key);
+      if (!removed) return {n, false};
+      if (!l) return {n->right, true};
+      return {rebalance(make_inner(l, n->right)), true};
+    }
+    auto [r, removed] = remove_(n->right, key);
+    if (!removed) return {n, false};
+    if (!r) return {n->left, true};
+    return {rebalance(make_inner(n->left, r)), true};
+  }
+
+  NodeRef root_;
+};
+
+}  // namespace merkleeyes

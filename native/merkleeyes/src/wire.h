@@ -1,0 +1,84 @@
+// Wire primitives: unsigned varints (LEB128, as Go's binary.Uvarint),
+// zigzag varints (Go's binary.Varint), big-endian u64, and
+// length-prefixed frames.
+//
+// The *tx* format matches the reference exactly
+// (merkleeyes/app.go:488-520 unmarshalBytes/decodeInt + the gowire
+// encoding in tendermint/src/jepsen/tendermint/gowire.clj:5-109):
+//   tx     = nonce[12] ∥ type-byte ∥ args
+//   bytes  = uvarint(len) ∥ raw
+//   power  = 8-byte big-endian
+// The *session* framing (frame = uvarint(len) ∥ payload) is this
+// build's own — the reference speaks protobuf ABCI to tendermint; this
+// server speaks a minimal equivalent documented in ../README.md.
+#pragma once
+
+#include <cstdint>
+#include <optional>
+#include <string>
+#include <vector>
+
+namespace merkleeyes {
+
+using bytes = std::vector<uint8_t>;
+
+inline void put_uvarint(bytes& out, uint64_t v) {
+  while (v >= 0x80) {
+    out.push_back(uint8_t(v) | 0x80);
+    v >>= 7;
+  }
+  out.push_back(uint8_t(v));
+}
+
+// Returns (value, bytes-consumed); consumed == 0 on truncation,
+// negative on overflow — the binary.Uvarint contract.
+inline std::pair<uint64_t, int> get_uvarint(const uint8_t* p, size_t n) {
+  uint64_t v = 0;
+  int shift = 0;
+  for (size_t i = 0; i < n; i++) {
+    uint8_t b = p[i];
+    if (shift >= 64) return {0, -int(i + 1)};
+    if (b < 0x80) {
+      if (shift == 63 && b > 1) return {0, -int(i + 1)};
+      return {v | (uint64_t(b) << shift), int(i + 1)};
+    }
+    v |= uint64_t(b & 0x7f) << shift;
+    shift += 7;
+  }
+  return {0, 0};
+}
+
+// Signed varint, zigzag encoded (binary.PutVarint / binary.Varint).
+inline void put_varint(bytes& out, int64_t v) {
+  put_uvarint(out, (uint64_t(v) << 1) ^ uint64_t(v >> 63));
+}
+
+inline std::pair<int64_t, int> get_varint(const uint8_t* p, size_t n) {
+  auto [uv, c] = get_uvarint(p, n);
+  int64_t v = int64_t(uv >> 1);
+  if (uv & 1) v = ~v;
+  return {v, c};
+}
+
+inline void put_u64be(bytes& out, uint64_t v) {
+  for (int i = 7; i >= 0; i--) out.push_back((v >> (8 * i)) & 0xff);
+}
+
+inline std::optional<uint64_t> get_u64be(const uint8_t* p, size_t n) {
+  if (n < 8) return std::nullopt;
+  uint64_t v = 0;
+  for (int i = 0; i < 8; i++) v = (v << 8) | p[i];
+  return v;
+}
+
+inline void put_bytes(bytes& out, const bytes& b) {
+  put_uvarint(out, b.size());
+  out.insert(out.end(), b.begin(), b.end());
+}
+
+inline void put_str(bytes& out, const std::string& s) {
+  put_uvarint(out, s.size());
+  out.insert(out.end(), s.begin(), s.end());
+}
+
+}  // namespace merkleeyes

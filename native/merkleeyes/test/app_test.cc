@@ -1,0 +1,247 @@
+// In-process lifecycle test, mirroring the reference's
+// merkleeyes/app_test.go:20-90: Info → CheckTx → BeginBlock →
+// DeliverTx for every tx type → EndBlock → Commit, with hand-rolled tx
+// encoders (app_test.go:92-171), plus tree/WAL/nonce coverage.
+#include <unistd.h>
+
+#include <cassert>
+#include <cstdio>
+#include <iostream>
+
+#include "../src/app.h"
+
+using namespace merkleeyes;
+
+static int checks = 0;
+#define CHECK(cond)                                              \
+  do {                                                           \
+    if (!(cond)) {                                               \
+      std::cerr << "FAIL at " << __LINE__ << ": " #cond "\n";    \
+      return 1;                                                  \
+    }                                                            \
+    checks++;                                                    \
+  } while (0)
+
+static bytes nonce(uint8_t seed) {
+  bytes n(kNonceLength, 0);
+  for (size_t i = 0; i < n.size(); i++) n[i] = uint8_t(seed + i);
+  return n;
+}
+
+static bytes field(const std::string& s) {
+  bytes out;
+  put_uvarint(out, s.size());
+  out.insert(out.end(), s.begin(), s.end());
+  return out;
+}
+
+static bytes tx(uint8_t seed, uint8_t type, const bytes& args) {
+  bytes t = nonce(seed);
+  t.push_back(type);
+  t.insert(t.end(), args.begin(), args.end());
+  return t;
+}
+
+static bytes set_tx(uint8_t seed, const std::string& k,
+                    const std::string& v) {
+  bytes args = field(k);
+  bytes vf = field(v);
+  args.insert(args.end(), vf.begin(), vf.end());
+  return tx(seed, 0x01, args);
+}
+
+static bytes cas_tx(uint8_t seed, const std::string& k,
+                    const std::string& cmp, const std::string& v) {
+  bytes args = field(k);
+  bytes c = field(cmp), vf = field(v);
+  args.insert(args.end(), c.begin(), c.end());
+  args.insert(args.end(), vf.begin(), vf.end());
+  return tx(seed, 0x04, args);
+}
+
+int tree_tests() {
+  Tree t;
+  CHECK(t.size() == 0);
+  bytes k1{'a'}, k2{'b'}, k3{'c'}, v1{'1'}, v2{'2'};
+  Tree t1 = t.set(k1, v1);
+  Tree t2 = t1.set(k2, v2);
+  CHECK(t.size() == 0);  // persistence: old snapshots untouched
+  CHECK(t1.size() == 1 && t2.size() == 2);
+  CHECK(t2.get(k1)->second == v1);
+  CHECK(t2.get(k1)->first == 0);  // rank of 'a'
+  CHECK(t2.get(k2)->first == 1);
+  CHECK(!t2.get(k3));
+  CHECK(t2.get_by_index(1)->first == k2);
+  auto [t3, removed] = t2.remove(k1);
+  CHECK(removed && t3.size() == 1 && t2.size() == 2);
+  auto [t4, removed2] = t3.remove(k1);
+  CHECK(!removed2);
+  CHECK(t1.hash() != t2.hash());
+  CHECK(t2.hash() == t.set(k2, v2).set(k1, v1).hash());  // order-free
+
+  // balance under sequential inserts: height stays O(log n)
+  Tree big;
+  for (int i = 0; i < 1024; i++) {
+    std::string key = "key" + std::to_string(1000000 + i);
+    big = big.set(bytes(key.begin(), key.end()), v1);
+  }
+  CHECK(big.size() == 1024);
+  for (int i = 0; i < 1024; i += 111) {
+    std::string key = "key" + std::to_string(1000000 + i);
+    CHECK(big.get(bytes(key.begin(), key.end())));
+  }
+  return 0;
+}
+
+int app_lifecycle() {
+  App app;
+  auto [h0, hash0] = app.info();
+  CHECK(h0 == 0 && hash0.size() == 32);
+
+  CHECK(app.check_tx(bytes{1, 2}).code == EncodingError);
+  CHECK(app.check_tx(set_tx(1, "k", "v")).code == OK);
+
+  app.begin_block();
+  CHECK(app.deliver_tx(set_tx(1, "name", "satoshi")).code == OK);
+  // duplicate nonce rejected (app.go:239-250)
+  CHECK(app.deliver_tx(set_tx(1, "name", "mallory")).code == BadNonce);
+  // Get on working tree sees the uncommitted write (app.go:291-306)
+  TxResult g = app.deliver_tx(tx(2, 0x03, field("name")));
+  CHECK(g.code == OK && std::string(g.data.begin(), g.data.end()) ==
+                            "satoshi");
+  // CAS success and failure (app.go:308-352)
+  CHECK(app.deliver_tx(cas_tx(3, "name", "satoshi", "nakamoto")).code == OK);
+  TxResult bad = app.deliver_tx(cas_tx(4, "name", "satoshi", "x"));
+  CHECK(bad.code == ErrUnauthorized);
+  // Rm (app.go:273-289)
+  CHECK(app.deliver_tx(tx(5, 0x02, field("nope"))).code ==
+        ErrBaseUnknownAddress);
+  CHECK(app.deliver_tx(set_tx(6, "tmp", "x")).code == OK);
+  CHECK(app.deliver_tx(tx(7, 0x02, field("tmp"))).code == OK);
+  // unknown type byte
+  CHECK(app.deliver_tx(tx(8, 0x99, {})).code == ErrUnknownRequest);
+
+  // query before commit: committed tree is still empty (app.go:158-165)
+  QueryResult q0 = app.query("/key", bytes{'n', 'a', 'm', 'e'});
+  CHECK(q0.code == ErrBaseUnknownAddress);
+
+  app.end_block();
+  bytes apphash = app.commit();
+  CHECK(apphash.size() == 32 && apphash != hash0);
+  CHECK(app.height() == 1);
+
+  QueryResult q1 = app.query("/key", bytes{'n', 'a', 'm', 'e'});
+  CHECK(q1.code == OK);
+  CHECK(std::string(q1.value.begin(), q1.value.end()) == "nakamoto");
+  CHECK(q1.height == 1);
+
+  // /size counts nonces too (everything lives in one tree, like the
+  // reference's /nonce/ + /key/ prefixes)
+  QueryResult qs = app.query("/size", {});
+  CHECK(qs.code == OK);
+  auto [size, c] = get_varint(qs.value.data(), qs.value.size());
+  CHECK(c > 0 && size >= 2);
+
+  QueryResult qi = app.query("/index", [] {
+    bytes b;
+    put_varint(b, 0);
+    return b;
+  }());
+  CHECK(qi.code == OK && !qi.key.empty());
+
+  QueryResult qbad = app.query("/bogus", {});
+  CHECK(qbad.code == UnknownRequest);
+  return 0;
+}
+
+int valset_tests() {
+  App app;
+  bytes pk(32, 0xaa);
+  bytes args = field(std::string(32, char(0xaa)));
+  put_u64be(args, 10);
+
+  app.begin_block();
+  CHECK(app.deliver_tx(tx(1, 0x05, args)).code == OK);
+  auto updates = app.end_block();
+  CHECK(updates.size() == 1 && updates.at(pk) == 10);
+  CHECK(app.valset_version() == 1);
+
+  // ValSetRead returns JSON with the validator
+  app.begin_block();
+  TxResult read = app.deliver_tx(tx(2, 0x06, {}));
+  std::string json(read.data.begin(), read.data.end());
+  CHECK(read.code == OK);
+  CHECK(json.find("\"version\":1") != std::string::npos);
+  CHECK(json.find("\"power\":10") != std::string::npos);
+
+  // ValSetCAS with wrong version rejected (app.go:397-441)
+  bytes cas_args;
+  put_u64be(cas_args, 99);
+  bytes pkf = field(std::string(32, char(0xbb)));
+  cas_args.insert(cas_args.end(), pkf.begin(), pkf.end());
+  put_u64be(cas_args, 5);
+  CHECK(app.deliver_tx(tx(3, 0x07, cas_args)).code == ErrUnauthorized);
+  // right version accepted
+  bytes cas_ok;
+  put_u64be(cas_ok, 1);
+  cas_ok.insert(cas_ok.end(), pkf.begin(), pkf.end());
+  put_u64be(cas_ok, 5);
+  CHECK(app.deliver_tx(tx(4, 0x07, cas_ok)).code == OK);
+  CHECK(app.end_block().size() == 1);
+  CHECK(app.valset_version() == 2);
+
+  // removing a non-existent validator fails (app.go:453-460)
+  bytes rm;
+  bytes pkf2 = field(std::string(32, char(0xcc)));
+  rm.insert(rm.end(), pkf2.begin(), pkf2.end());
+  put_u64be(rm, 0);
+  app.begin_block();
+  CHECK(app.deliver_tx(tx(5, 0x05, rm)).code == ErrUnauthorized);
+  return 0;
+}
+
+int wal_tests() {
+  std::string wal = "/tmp/merkleeyes_test_wal.bin";
+  std::remove(wal.c_str());
+  {
+    App app(wal);
+    app.begin_block();
+    app.deliver_tx(set_tx(1, "k1", "v1"));
+    app.commit();
+    app.begin_block();
+    app.deliver_tx(set_tx(2, "k2", "v2"));
+    app.commit();
+  }
+  {
+    App app(wal);  // replay
+    CHECK(app.height() == 2);
+    CHECK(app.query("/key", bytes{'k', '1'}).code == OK);
+    CHECK(app.query("/key", bytes{'k', '2'}).code == OK);
+    // replayed nonces stay burned
+    CHECK(app.deliver_tx(set_tx(1, "k1", "evil")).code == BadNonce);
+  }
+  // truncation: chop the file mid-frame; replay keeps complete prefix
+  FILE* f = std::fopen(wal.c_str(), "rb");
+  std::fseek(f, 0, SEEK_END);
+  long len = std::ftell(f);
+  std::fclose(f);
+  (void)!truncate(wal.c_str(), len - 3);
+  {
+    App app(wal);
+    CHECK(app.height() == 1);  // second block lost, first intact
+    CHECK(app.query("/key", bytes{'k', '1'}).code == OK);
+    CHECK(app.query("/key", bytes{'k', '2'}).code ==
+          ErrBaseUnknownAddress);
+  }
+  std::remove(wal.c_str());
+  return 0;
+}
+
+int main() {
+  if (tree_tests()) return 1;
+  if (app_lifecycle()) return 1;
+  if (valset_tests()) return 1;
+  if (wal_tests()) return 1;
+  std::cout << "OK: " << checks << " checks passed\n";
+  return 0;
+}

@@ -178,7 +178,7 @@ def test_centos_setup_uses_yum():
     yum = [cmd for cmd in r.cmds if "yum install" in cmd]
     assert yum and "extra-pkg" in yum[0]
     # loopback line gained the hostname, shipped via upload
-    uploads = [cmd for cmd in r.cmds if cmd.startswith("UPLOAD /etc/hosts")]
+    uploads = [cmd for cmd in r.cmds if cmd.startswith("UPLOAD /tmp/jepsen-hosts")]
     assert uploads and "127.0.0.1 localhost n1" in uploads[0]
 
 
@@ -191,7 +191,7 @@ def test_centos_hostfile_token_match():
                        "127.0.0.1 localhost n10\nfe80::1%eth0 ipv6host"})
     with c.on_host(r, "n1"):
         os_mod.centos()._hostfile_loopback()
-    up = [cmd for cmd in r.cmds if cmd.startswith("UPLOAD /etc/hosts")][0]
+    up = [cmd for cmd in r.cmds if cmd.startswith("UPLOAD /tmp/jepsen-hosts")][0]
     assert "localhost n10 n1" in up
     assert "fe80::1%eth0" in up
 
