@@ -82,6 +82,7 @@ def base_parser(prog: str = "jepsen") -> argparse.ArgumentParser:
     s = sub.add_parser("serve", help="serve stored results over HTTP")
     s.add_argument("--port", type=int, default=8080)
     s.add_argument("--host", default="0.0.0.0")
+    p._jepsen_subparsers = {"test": t, "analyze": a, "serve": s}
     return p
 
 
@@ -102,6 +103,9 @@ def options_from_args(args) -> Dict:
         "test-count": args.test_count,
         "workload": args.workload,
         "nemesis": args.nemesis,
+        # suite-specific flags as plain data (serializable, no Namespace)
+        "args": dict(vars(args)),
+        "explicit-nodes": bool(args.node or args.nodes_file),
     }
 
 
@@ -158,14 +162,19 @@ def run_serve_cmd(args) -> int:
 
 
 def run_cli(test_fn: Optional[Callable[[Dict], Dict]] = None,
-            argv: Optional[list] = None, prog: str = "jepsen") -> int:
+            argv: Optional[list] = None, prog: str = "jepsen",
+            extend_parser: Optional[Callable] = None) -> int:
     """Main dispatcher (cli.clj:246-322). test_fn builds a test map from
-    parsed options; defaults to the noop test."""
+    parsed options; defaults to the noop test. extend_parser(parser)
+    may add suite-specific flags (parser._jepsen_subparsers maps
+    subcommand names to their subparsers)."""
     if test_fn is None:
         test_fn = lambda opts: jcore.make_test(  # noqa: E731
             {"nodes": opts["nodes"], "ssh": opts["ssh"],
              "concurrency": opts["concurrency"]})
     parser = base_parser(prog)
+    if extend_parser is not None:
+        extend_parser(parser)
     try:
         args = parser.parse_args(argv)
     except SystemExit as e:

@@ -1,0 +1,78 @@
+"""Tendermint suite CLI (reference: tendermint/src/jepsen/tendermint/cli.clj).
+
+    python -m jepsen_tpu.tendermint.cli test \
+        --workload cas-register --nemesis half-partitions \
+        --time-limit 60 [--local]
+
+`--local` runs against one shared native merkleeyes instance (no
+cluster needed); without it, nodes are driven over SSH and tendermint
+RPC (requires --tendermint-url for the consensus binary, as the
+reference's tarball flags do, cli.clj:8-19)."""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, Optional
+
+from jepsen_tpu import cli as jcli
+from jepsen_tpu.tendermint import core as tcore
+from jepsen_tpu.tendermint import db as td
+
+
+def extend_parser(p):
+    # --workload / --nemesis already exist on the base parser; add only
+    # the suite-specific flags (cli.clj:8-19).
+    for sp_name in ("test", "analyze"):
+        sp = p._jepsen_subparsers[sp_name]
+        sp.add_argument("--local", action="store_true",
+                        help="single local native merkleeyes, no cluster")
+        sp.add_argument("--dup-validators", action="store_true")
+        sp.add_argument("--super-byzantine-validators", action="store_true")
+        sp.add_argument("--tendermint-url")
+        sp.add_argument("--merkleeyes-url")
+    return p
+
+
+def test_fn(options: Dict) -> Dict:
+    args = options.get("args") or {}
+    opts = dict(options)
+    opts["workload"] = options.get("workload") or "cas-register"
+    opts["nemesis_name"] = options.get("nemesis") or "none"
+    if opts["workload"] not in tcore.WORKLOADS:
+        print(f"unknown workload {opts['workload']!r}; "
+              f"choose from {tcore.WORKLOADS}", file=sys.stderr)
+        raise SystemExit(jcli.EXIT_BAD_ARGS)
+    if opts["nemesis_name"] not in tcore.NEMESES:
+        print(f"unknown nemesis {opts['nemesis_name']!r}; "
+              f"choose from {tcore.NEMESES}", file=sys.stderr)
+        raise SystemExit(jcli.EXIT_BAD_ARGS)
+    if options.get("time-limit") is not None:
+        opts["time_limit"] = options["time-limit"]
+    opts["dup_validators"] = bool(args.get("dup_validators"))
+    opts["super_byzantine_validators"] = \
+        bool(args.get("super_byzantine_validators"))
+    if args.get("local"):
+        opts["db"] = td.LocalMerkleeyesDB()
+        opts["transport_for"] = td.local_transport_for
+        opts.setdefault("ssh", {})["dummy"] = True
+        if not options.get("explicit-nodes"):
+            # one logical node unless the user asked for more — local
+            # mode shares a single server, extra nodes add nothing
+            opts["nodes"] = ["n1"]
+            if opts.get("concurrency"):
+                opts["concurrency"] = max(
+                    2, opts["concurrency"] // max(1, len(options["nodes"])))
+    else:
+        opts["db"] = td.db({"tendermint_url": args.get("tendermint_url"),
+                            "merkleeyes_url": args.get("merkleeyes_url")})
+        opts["transport_for"] = td.http_transport_for
+    return tcore.test_map(opts)
+
+
+def main(argv: Optional[list] = None) -> int:
+    return jcli.run_cli(test_fn, argv=argv, prog="jepsen-tendermint",
+                        extend_parser=extend_parser)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

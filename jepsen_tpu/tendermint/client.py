@@ -1,0 +1,193 @@
+"""Client for the merkleeyes data plane
+(reference: tendermint/src/jepsen/tendermint/client.clj).
+
+Two transports behind one API:
+
+- `SocketTransport` — speaks directly to the native merkleeyes server
+  (native/merkleeyes/), one block per tx, mirroring tendermint's
+  /broadcast_tx_commit semantics. The local / integration-test path.
+- `HttpTransport` — tendermint RPC on :26657 (/broadcast_tx_commit,
+  /abci_query), for driving a real cluster (client.clj:59-102).
+
+Values are EDN-encoded bytes (jepsen_tpu.codec) — the capability
+parallel of the reference's fressian value encoding (client.clj:137-152).
+Tx error codes map to typed exceptions: 7 -> BaseUnknownAddress
+(read of a missing key returns None instead), 8 -> Unauthorized
+(client.clj:58-66 validate-tx-code)."""
+
+from __future__ import annotations
+
+import json as _json
+from typing import Any, Optional
+
+from jepsen_tpu import codec
+from jepsen_tpu.tendermint import gowire as w
+from jepsen_tpu.tendermint import merkleeyes as me
+
+PORT = 26657  # tendermint RPC (client.clj:68)
+
+
+class TxError(RuntimeError):
+    def __init__(self, code, log=""):
+        super().__init__(f"tx failed with code {code}: {log}")
+        self.code = code
+        self.log = log
+
+
+class Unauthorized(TxError):
+    """Code 8: CAS mismatch / valset version mismatch."""
+
+
+class BaseUnknownAddress(TxError):
+    """Code 7: key not found."""
+
+
+def validate_tx_code(code: int, log: str = ""):
+    """(client.clj:58-66)."""
+    if code == 0:
+        return
+    if code == me.CODE_BASE_UNKNOWN_ADDRESS:
+        raise BaseUnknownAddress(code, log)
+    if code == me.CODE_UNAUTHORIZED:
+        raise Unauthorized(code, log)
+    raise TxError(code, log)
+
+
+class SocketTransport:
+    """Direct connection to a native merkleeyes server."""
+
+    def __init__(self, address):
+        self.address = address  # ("unix", path) | ("tcp", (host, port))
+
+    def broadcast_tx(self, tx: bytes) -> me.TxResult:
+        with me.MerkleeyesClient(self.address) as cl:
+            r = cl.tx_commit(tx)
+        validate_tx_code(r.code, r.log)
+        return r
+
+    def abci_query(self, path: str, data: bytes) -> me.QueryResult:
+        with me.MerkleeyesClient(self.address) as cl:
+            return cl.query(path, data)
+
+
+class HttpTransport:
+    """Tendermint RPC over HTTP (client.clj:79-102). Used against real
+    clusters; requires network reachability to node:26657."""
+
+    def __init__(self, node: str, timeout: float = 10.0):
+        self.node = node
+        self.timeout = timeout
+
+    def _get(self, path: str, params: dict) -> dict:
+        import urllib.parse
+        import urllib.request
+        url = (f"http://{self.node}:{PORT}{path}?"
+               + urllib.parse.urlencode(params))
+        with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+            return _json.loads(resp.read().decode("utf-8"))
+
+    def broadcast_tx(self, tx: bytes) -> me.TxResult:
+        body = self._get("/broadcast_tx_commit",
+                         {"tx": "0x" + tx.hex()})
+        result = (body.get("result") or {})
+        for stage in ("check_tx", "deliver_tx"):
+            st = result.get(stage) or {}
+            validate_tx_code(int(st.get("code") or 0), st.get("log") or "")
+        import base64
+        data = base64.b64decode(result.get("deliver_tx", {})
+                                .get("data") or "")
+        return me.TxResult(0, data, "")
+
+    def abci_query(self, path: str, data: bytes) -> me.QueryResult:
+        body = self._get("/abci_query",
+                         {"path": _json.dumps(path),
+                          "data": "0x" + data.hex(), "prove": "false"})
+        resp = ((body.get("result") or {}).get("response") or {})
+        import base64
+        value = base64.b64decode(resp.get("value") or "")
+        return me.QueryResult(int(resp.get("code") or 0),
+                              int(resp.get("height") or 0),
+                              int(resp.get("index") or -1),
+                              base64.b64decode(resp.get("key") or ""),
+                              value, resp.get("log") or "")
+
+
+# --------------------------------------------------- merkleeyes KV API
+
+
+def _k(k) -> bytes:
+    return codec.encode(k)
+
+
+def write(transport, k, v) -> None:
+    """Set k = v (client.clj:137-140)."""
+    transport.broadcast_tx(w.set_tx(_k(k), codec.encode(v)))
+
+
+def read(transport, k) -> Any:
+    """Transactional read; None when absent (client.clj:142-149 — the
+    reference's read throws :base-unknown-address, which its clients
+    map to nil-valued :fail; returning None here keeps reads total)."""
+    try:
+        r = transport.broadcast_tx(w.get_tx(_k(k)))
+    except BaseUnknownAddress:
+        return None
+    return codec.decode(r.data)
+
+
+def cas(transport, k, v, v2) -> None:
+    """Compare-and-set k: v -> v2 (client.clj:151-154). Raises
+    Unauthorized on mismatch, BaseUnknownAddress when k is unset."""
+    transport.broadcast_tx(
+        w.cas_tx(_k(k), codec.encode(v), codec.encode(v2)))
+
+
+def local_read(transport, k) -> Any:
+    """Non-transactional read from one node's committed state
+    (client.clj:184-196)."""
+    q = transport.abci_query("/store", _k(k))
+    if q.code == me.CODE_BASE_UNKNOWN_ADDRESS or not q.value:
+        return None
+    return codec.decode(q.value)
+
+
+# ------------------------------------------------------- validator set
+
+
+def validator_set(transport) -> dict:
+    """Transactional read of the validator set (client.clj:156-163):
+    {"version": int, "validators": [{"pub_key": hex, "power": int}]}."""
+    r = transport.broadcast_tx(w.valset_read_tx())
+    return _json.loads(r.data.decode("utf-8"))
+
+
+def validator_set_change(transport, pub_key_hex: str, power: int) -> None:
+    """(client.clj:165-171)."""
+    transport.broadcast_tx(
+        w.valset_change_tx(bytes.fromhex(pub_key_hex), power))
+
+
+def validator_set_cas(transport, version: int, pub_key_hex: str,
+                      power: int) -> None:
+    """(client.clj:173-179)."""
+    transport.broadcast_tx(
+        w.valset_cas_tx(version, bytes.fromhex(pub_key_hex), power))
+
+
+def with_any_node(test, f, *args, transport_for=None):
+    """Try f(transport, *args) against each node until one answers
+    (client.clj:198-210)."""
+    from jepsen_tpu import generator as gen
+    nodes = list(test.get("nodes") or [])
+    gen.rand.shuffle(nodes)
+    transport_for = transport_for or test.get("transport_for")
+    assert transport_for is not None, "test has no transport_for"
+    last = None
+    for node in nodes:
+        try:
+            return f(transport_for(test, node), *args)
+        except (ConnectionError, OSError, TimeoutError) as e:
+            last = e
+    if last is not None:
+        raise last
+    return None

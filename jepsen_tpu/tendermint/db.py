@@ -1,0 +1,324 @@
+"""Tendermint + merkleeyes deployment
+(reference: tendermint/src/jepsen/tendermint/db.clj).
+
+Two modes:
+
+- **Cluster mode** (`TendermintDB`): installs the tendermint and
+  merkleeyes binaries on each node via the control plane, writes
+  genesis / validator-key / node-key JSON, and runs both daemons with
+  pidfiles (db.clj:21-219). The merkleeyes binary deployed is this
+  repo's native C++ one — `make` locally, ship the binary (nodes are
+  assumed ABI-compatible; pass merkleeyes_url to install a prebuilt
+  archive instead, as the reference does for both components).
+- **Local mode** (`LocalMerkleeyesDB`): one shared native merkleeyes
+  process on a unix socket stands in for the whole replicated cluster —
+  consensus collapses to a single linearizable app, which is exactly
+  what a correctness test of the *harness* wants (the atom-db pattern,
+  tests.clj:27-67, but through the real native server)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import tempfile
+import threading
+from typing import Optional
+
+from jepsen_tpu import control as c
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.nemesis import time as nt
+from jepsen_tpu.tendermint import merkleeyes as me
+from jepsen_tpu.tendermint import validator as tv
+
+log = logging.getLogger(__name__)
+
+BASE_DIR = "/opt/tendermint"  # tendermint/util.clj:4
+
+
+CONFIG_TOML = """\
+# config.toml tuned for fast 5-node commits
+# (tendermint/resources/config.toml:1-19)
+[consensus]
+timeout_commit = "0ms"
+skip_timeout_commit = true
+peer_gossip_sleep_duration = "10ms"
+
+[p2p]
+flush_throttle_timeout = "10ms"
+
+[rpc]
+laddr = "tcp://0.0.0.0:26657"
+"""
+
+
+def base_dir(test) -> str:
+    return test.get("base_dir", BASE_DIR)
+
+
+def socket_file(test) -> str:
+    return base_dir(test) + "/merkleeyes.sock"
+
+
+def socket_addr(test) -> str:
+    return "unix://" + socket_file(test)
+
+
+def merkleeyes_log(test) -> str:
+    return base_dir(test) + "/merkleeyes.log"
+
+
+def tendermint_log(test) -> str:
+    return base_dir(test) + "/tendermint.log"
+
+
+def merkleeyes_pid(test) -> str:
+    return base_dir(test) + "/merkleeyes.pid"
+
+
+def tendermint_pid(test) -> str:
+    return base_dir(test) + "/tendermint.pid"
+
+
+# -------------------------------------------------- per-node file writes
+
+
+def _write_json(path: str, data) -> None:
+    import os as _os
+    fd, tmp = tempfile.mkstemp(suffix=".json")
+    try:
+        with _os.fdopen(fd, "w") as f:
+            json.dump(data, f)
+        c.upload([tmp], path)
+    finally:
+        _os.unlink(tmp)
+
+
+def write_validator(test, node, validator: dict) -> None:
+    """priv_validator_key.json + empty state (db.clj:28-38)."""
+    with c.su():
+        _write_json(base_dir(test) + "/config/priv_validator_key.json",
+                    validator)
+        _write_json(base_dir(test) + "/data/priv_validator_state.json", {})
+
+
+def write_node_key(test, node, node_key: dict) -> None:
+    """(db.clj:40-47)."""
+    with c.su():
+        _write_json(base_dir(test) + "/config/node_key.json", node_key)
+
+
+def write_genesis(test, genesis: dict) -> None:
+    """(db.clj:49-56)."""
+    with c.su():
+        _write_json(base_dir(test) + "/config/genesis.json", genesis)
+
+
+def write_config(test) -> None:
+    """(db.clj:58-64)."""
+    import os as _os
+    with c.su():
+        fd, tmp = tempfile.mkstemp(suffix=".toml")
+        try:
+            with _os.fdopen(fd, "w") as f:
+                f.write(CONFIG_TOML)
+            c.upload([tmp], base_dir(test) + "/config/config.toml")
+        finally:
+            _os.unlink(tmp)
+
+
+def node_id(test, node) -> Optional[str]:
+    """(db.clj:66-73)."""
+    cfg = (test.get("validator_config") or [None])[0] or {}
+    return ((cfg.get("node_keys") or {}).get(node) or {}).get("id")
+
+
+def persistent_peers(test, node) -> str:
+    """--p2p.persistent_peers value (db.clj:75-82)."""
+    return ",".join(f"{node_id(test, n)}@{n}:26656"
+                    for n in test.get("nodes") or [] if n != node)
+
+
+# ------------------------------------------------------ daemon control
+
+
+def start_tendermint(test, node) -> str:
+    """(db.clj:94-108)."""
+    with c.su(), c.cd(base_dir(test)):
+        cu.start_daemon(
+            {"logfile": tendermint_log(test),
+             "pidfile": tendermint_pid(test), "chdir": base_dir(test)},
+            "./tendermint", "--home", base_dir(test), "node",
+            "--proxy_app", socket_addr(test),
+            "--p2p.persistent_peers", persistent_peers(test, node))
+    return "started"
+
+
+def start_merkleeyes(test, node) -> str:
+    """(db.clj:110-122). Runs this repo's native server."""
+    with c.su(), c.cd(base_dir(test)):
+        cu.start_daemon(
+            {"logfile": merkleeyes_log(test),
+             "pidfile": merkleeyes_pid(test), "chdir": base_dir(test)},
+            "./merkleeyes/merkleeyes", "--listen",
+            f"unix:{socket_file(test)}",
+            "--wal", base_dir(test) + "/jepsen/jepsen.db/000001.log")
+    return "started"
+
+
+def stop_tendermint(test, node) -> str:
+    with c.su():
+        cu.stop_daemon(tendermint_pid(test))
+    return "stopped"
+
+
+def stop_merkleeyes(test, node) -> str:
+    with c.su():
+        cu.stop_daemon(merkleeyes_pid(test))
+        c.exec_("rm", "-rf", socket_file(test))
+    return "stopped"
+
+
+def start(test, node):
+    """(db.clj:133-136)."""
+    start_merkleeyes(test, node)
+    start_tendermint(test, node)
+    return "started"
+
+
+def stop(test, node):
+    """(db.clj:138-141)."""
+    stop_tendermint(test, node)
+    stop_merkleeyes(test, node)
+    return "stopped"
+
+
+def reset_validator(test, node) -> None:
+    """Wipe identity + data, preserving binaries and genesis
+    (db.clj:155-161)."""
+    with c.su():
+        bd = base_dir(test)
+        c.exec_("bash", "-c", c.lit(c.escape(
+            f"rm -rf {bd}/data {bd}/jepsen "
+            f"{bd}/config/priv_validator_key.json "
+            f"{bd}/config/node_key.json")))
+
+
+class TendermintDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    """Full cluster deployment (db.clj:163-219). Options:
+    tendermint_url / merkleeyes_url — archives to install (merkleeyes
+    defaults to shipping the locally built native binary)."""
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self._lock = threading.Lock()  # on_nodes runs setup in parallel
+
+    def setup(self, test, node):
+        bd = base_dir(test)
+        with c.su():
+            if self.opts.get("tendermint_url"):
+                cu.install_archive(self.opts["tendermint_url"],
+                                   bd + "/tendermint-dist")
+                c.exec_("cp", bd + "/tendermint-dist/tendermint", bd + "/")
+            if self.opts.get("merkleeyes_url"):
+                cu.install_archive(self.opts["merkleeyes_url"],
+                                   bd + "/merkleeyes")
+            else:
+                with self._lock:  # make must not run concurrently
+                    binary = me.build()
+                c.exec_("mkdir", "-p", bd + "/merkleeyes")
+                c.upload([str(binary)], bd + "/merkleeyes/merkleeyes")
+                c.exec_("chmod", "+x", bd + "/merkleeyes/merkleeyes")
+            c.exec_("mkdir", "-p", bd + "/config", bd + "/data",
+                    bd + "/jepsen/jepsen.db")
+            write_config(test)
+
+        # One node computes the initial validator config; the rest wait
+        # on the lock and reuse it — the synchronize-barrier equivalent
+        # (db.clj:180-192). on_nodes runs setups in parallel threads.
+        with self._lock:
+            box = test.setdefault("validator_config", [None])
+            if box[0] is None:
+                box[0] = tv.initial_config(test)
+
+        vc = box[0]
+        write_genesis(test, tv.genesis(vc))
+        v = vc["validators"].get(vc["nodes"].get(node))
+        if v is not None:
+            write_validator(test, node, v)
+        write_node_key(test, node, vc["node_keys"].get(node) or {})
+
+        start_merkleeyes(test, node)
+        start_tendermint(test, node)
+        nt.install()
+
+    def teardown(self, test, node):
+        try:
+            stop(test, node)
+        finally:
+            with c.su():
+                c.exec_("rm", "-rf", base_dir(test))
+
+    # Process protocol: used by the crash nemesis / combined packages.
+    def start(self, test, node):
+        return start(test, node)
+
+    def kill(self, test, node):
+        return stop(test, node)
+
+    def log_files(self, test, node):
+        bd = base_dir(test)
+        return [tendermint_log(test), merkleeyes_log(test),
+                bd + "/config/priv_validator_key.json",
+                bd + "/config/node_key.json",
+                bd + "/config/genesis.json"]
+
+
+def db(opts: Optional[dict] = None) -> TendermintDB:
+    return TendermintDB(opts)
+
+
+# ------------------------------------------------------------ local mode
+
+
+class LocalMerkleeyesDB(jdb.DB):
+    """One shared native merkleeyes process standing in for the cluster.
+    setup/teardown manage the process; `transport_for` points every
+    node at it."""
+
+    def __init__(self, workdir: Optional[str] = None):
+        self.workdir = workdir
+        self.server: Optional[me.LocalServer] = None
+        self._lock = threading.Lock()
+
+    def setup(self, test, node):
+        with self._lock:
+            self._setup_locked(test)
+
+    def _setup_locked(self, test):
+        if self.server is None:
+            d = self.workdir or tempfile.mkdtemp(prefix="merkleeyes-")
+            self.server = me.LocalServer(
+                sock_path=d + "/merkleeyes.sock",
+                wal_path=d + "/merkleeyes.wal").start()
+            test["merkleeyes_sock"] = self.server.sock_path
+
+    def teardown(self, test, node):
+        if self.server is not None:
+            self.server.stop()
+            self.server = None
+
+
+def local_transport_for(test, node):
+    """transport factory for local mode: every node reaches the one
+    shared server."""
+    from jepsen_tpu.tendermint import client as tc
+    sock = test.get("merkleeyes_sock")
+    assert sock, "local merkleeyes is not running (no :merkleeyes_sock)"
+    return tc.SocketTransport(("unix", sock))
+
+
+def http_transport_for(test, node):
+    """transport factory for cluster mode: tendermint RPC on the node."""
+    from jepsen_tpu.tendermint import client as tc
+    return tc.HttpTransport(node)

@@ -1,0 +1,480 @@
+"""Validator cluster-configuration state machine
+(reference: tendermint/src/jepsen/tendermint/validator.clj).
+
+Tracks which validators exist, how many votes each controls, and which
+node runs which validator — including deliberately *byzantine* setups
+where one validator key runs on several nodes. Provides:
+
+- vote allocation incl. the byzantine weighting math
+  (validator.clj:267-337)
+- safety invariants (quorum, fault bound, ghost/zombie limits,
+  omnipotent-byzantine bound — validator.clj:558-673 assert-valid)
+- legal random transitions (create/destroy/add/remove/alter-votes,
+  validator.clj:684-843)
+- reconciliation with a transactional read of the cluster's validator
+  set (validator.clj:868-930 current-config)
+
+A config is a plain dict:
+
+    {"validators":  {pub_key: {"pub_key", "priv_key", "votes"}},
+     "nodes":       {node: pub_key},
+     "node_keys":   {node: node_key},
+     "node_set":    set of nodes,
+     "version":     int,
+     "prospective_validators": {pub_key: validator},
+     "super_byzantine_validators": bool,
+     "max_byzantine_vote_fraction": Fraction}
+"""
+
+from __future__ import annotations
+
+import os as _os
+from fractions import Fraction
+from typing import Callable, Dict, List, Optional
+
+from jepsen_tpu import generator as gen
+
+GHOST_LIMIT = 2    # validators with no node (validator.clj:600-604)
+ZOMBIE_LIMIT = 2   # nodes running a non-member validator (clj:617-621)
+QUORUM = Fraction(2, 3)       # validator.clj:633-637
+FAULT_LIMIT = Fraction(1, 3)  # validator.clj:646-650
+
+
+class IllegalTransition(AssertionError):
+    """A transition would violate the cluster invariants."""
+
+
+def gen_validator(votes: int = 2) -> dict:
+    """A fresh validator with a random 32-byte key (the reference shells
+    out to `tendermint gen_validator`, validator.clj:356-365; key
+    *structure* is what matters to the state machine)."""
+    key = _os.urandom(32).hex().upper()
+    return {"pub_key": key,
+            "priv_key": _os.urandom(64).hex().upper(),
+            "votes": votes}
+
+
+def gen_node_key() -> dict:
+    """(validator.clj:367-375)."""
+    return {"id": _os.urandom(20).hex(),
+            "priv_key": _os.urandom(64).hex().upper()}
+
+
+def config(opts: Optional[dict] = None) -> dict:
+    """(validator.clj:383-421)."""
+    out = {"validators": {},
+           "nodes": {},
+           "node_keys": {},
+           "node_set": set(),
+           "version": -1,
+           "max_byzantine_vote_fraction": Fraction(1, 3),
+           "super_byzantine_validators": False}
+    out.update(opts or {})
+    out["prospective_validators"] = {}
+    return out
+
+
+# ------------------------------------------------------ derived views
+
+
+def nodes_running_validators(cfg: dict) -> Dict[str, List[str]]:
+    """pub_key -> [nodes running it] (validator.clj:246-255)."""
+    out: Dict[str, List[str]] = {}
+    for node, key in cfg["nodes"].items():
+        out.setdefault(key, []).append(node)
+    return out
+
+
+def byzantine_validators(cfg: dict) -> List[dict]:
+    """Validators running on more than one node (validator.clj:257-265)."""
+    return [cfg["validators"][k]
+            for k, nodes in nodes_running_validators(cfg).items()
+            if len(nodes) > 1 and k in cfg["validators"]]
+
+
+def byzantine_validator_keys(cfg: dict) -> List[str]:
+    return [v["pub_key"] for v in byzantine_validators(cfg)]
+
+
+def running_validators(cfg: dict) -> List[dict]:
+    """Validators running on at least one node (validator.clj:541-547)."""
+    keys = set(cfg["nodes"].values())
+    return [cfg["validators"][k] for k in keys if k in cfg["validators"]]
+
+
+def ghost_validators(cfg: dict) -> List[dict]:
+    """Members not running anywhere (validator.clj:549-555)."""
+    running = {v["pub_key"] for v in running_validators(cfg)}
+    return [v for k, v in cfg["validators"].items() if k not in running]
+
+
+def total_votes(cfg: dict) -> int:
+    """(validator.clj:496-503)."""
+    return sum(v["votes"] for v in cfg["validators"].values())
+
+
+def vote_fractions(cfg: dict) -> Dict[str, Fraction]:
+    """(validator.clj:532-539)."""
+    total = total_votes(cfg)
+    return {k: Fraction(v["votes"], total)
+            for k, v in cfg["validators"].items()}
+
+
+def dup_groups(cfg: dict) -> dict:
+    """{groups, singles, dups} of node groups by validator
+    (validator.clj:569-583)."""
+    groups = list(nodes_running_validators(cfg).values())
+    return {"groups": groups,
+            "singles": [g for g in groups if len(g) == 1],
+            "dups": [g for g in groups if len(g) > 1]}
+
+
+def compact_config(cfg: dict) -> dict:
+    """Human-readable summary (validator.clj:511-530)."""
+    return {"version": cfg["version"],
+            "total_votes": total_votes(cfg),
+            "validators": {k[:5]: {"votes": v["votes"]}
+                           for k, v in sorted(cfg["validators"].items())},
+            "nodes": {n: k[:5] for n, k in cfg["nodes"].items()},
+            "prospective": sorted(k[:5]
+                                  for k in cfg["prospective_validators"])}
+
+
+# -------------------------------------------------- initial allocation
+
+
+def initial_validator_votes(cfg: dict) -> Dict[str, int]:
+    """Votes per validator; byzantine (dup) validators get just shy of
+    1/3 — or 2/3 with super_byzantine_validators (validator.clj:267-337,
+    derivation in the reference's comment):
+
+      normal node weight 2; n validators total.
+      regular dup:  dup weight n-2    of total 3n-4   (< 1/3)
+      super dup:    dup weight 4(n-1)-1 of 6(n-1)-1   (→ 2/3)
+    """
+    bs = byzantine_validators(cfg)
+    if not bs:
+        return {k: 2 for k in cfg["validators"]}
+    assert len(bs) == 1, \
+        "Only know how to deal with 1 or 0 byzantine validators"
+    b = bs[0]["pub_key"]
+    n = len(cfg["validators"])
+    votes = {k: 2 for k in cfg["validators"] if k != b}
+    if cfg.get("super_byzantine_validators"):
+        votes[b] = 4 * (n - 1) - 1
+    else:
+        votes[b] = n - 2
+    return votes
+
+
+def with_initial_validator_votes(cfg: dict) -> dict:
+    """(validator.clj:339-353)."""
+    votes = initial_validator_votes(cfg)
+    validators = {k: dict(v, votes=votes[k])
+                  for k, v in cfg["validators"].items()}
+    return dict(cfg, validators=validators)
+
+
+def initial_config(test: dict,
+                   gen_validator_fn: Callable = gen_validator,
+                   gen_node_key_fn: Callable = gen_node_key) -> dict:
+    """Initial config for a test's nodes: one validator per node, unless
+    dup_validators collapses the first node onto the second node's
+    validator (validator.clj:423-473)."""
+    nodes_list = list(test.get("nodes") or [])
+    per_node = {n: gen_validator_fn() for n in nodes_list}
+    nodes = {n: v["pub_key"] for n, v in per_node.items()}
+    validators = {v["pub_key"]: v for v in per_node.values()}
+
+    if test.get("dup_validators") and len(nodes_list) >= 2:
+        n1, n2 = nodes_list[0], nodes_list[1]
+        del validators[nodes[n1]]
+        nodes[n1] = nodes[n2]
+
+    cfg = config({
+        "validators": validators,
+        "nodes": nodes,
+        "node_keys": {n: gen_node_key_fn() for n in nodes_list},
+        "node_set": set(nodes_list),
+        "super_byzantine_validators":
+            bool(test.get("super_byzantine_validators")),
+        "max_byzantine_vote_fraction":
+            test.get("max_byzantine_vote_fraction", Fraction(1, 3))})
+    return with_initial_validator_votes(cfg)
+
+
+def genesis(cfg: dict) -> dict:
+    """genesis.json structure (validator.clj:475-488)."""
+    vals = []
+    for v in cfg["validators"].values():
+        names = [n for n, k in cfg["nodes"].items() if k == v["pub_key"]]
+        assert names, f"validator {v['pub_key'][:8]} runs nowhere"
+        vals.append({"power": str(v["votes"]),
+                     "name": names[0],
+                     "pub_key": v["pub_key"]})
+    return {"app_hash": "",
+            "chain_id": "jepsen",
+            "genesis_time": "2020-12-09T12:11:22.481331Z",
+            "validators": vals}
+
+
+# ---------------------------------------------------------- invariants
+
+
+def at_least_one_running_validator(cfg) -> bool:
+    return bool(running_validators(cfg))  # validator.clj:585-590
+
+
+def omnipotent_byzantines(cfg) -> bool:
+    """Any byzantine validator at/above the byzantine vote bound?
+    (validator.clj:592-604)."""
+    vfs = vote_fractions(cfg)
+    threshold = cfg["max_byzantine_vote_fraction"]
+    return any(threshold <= vfs[k] for k in byzantine_validator_keys(cfg))
+
+
+def too_many_ghosts(cfg) -> bool:
+    """(validator.clj:606-615)."""
+    members = set(cfg["validators"])
+    running = set(cfg["nodes"].values())
+    return GHOST_LIMIT < len(members - running)
+
+
+def too_many_zombies(cfg) -> bool:
+    """(validator.clj:623-631)."""
+    members = set(cfg["validators"])
+    return ZOMBIE_LIMIT < sum(1 for k in cfg["nodes"].values()
+                              if k not in members)
+
+
+def quorum(cfg) -> bool:
+    """Running votes strictly exceed 2/3 of total (validator.clj:639-644)."""
+    total = total_votes(cfg)
+    if total == 0:
+        return False
+    running = sum(v["votes"] for v in running_validators(cfg))
+    return QUORUM < Fraction(running, total)
+
+
+def faulty(cfg) -> bool:
+    """Byzantine + ghost votes at/above 1/3 (validator.clj:652-661)."""
+    total = total_votes(cfg)
+    if total == 0:
+        return True
+    bad_keys = ({v["pub_key"] for v in byzantine_validators(cfg)}
+                | {v["pub_key"] for v in ghost_validators(cfg)})
+    bad = sum(cfg["validators"][k]["votes"] for k in bad_keys)
+    return FAULT_LIMIT <= Fraction(bad, total)
+
+
+def assert_valid(cfg: dict) -> dict:
+    """(validator.clj:663-678)."""
+    def check(ok, why):
+        if not ok:
+            raise IllegalTransition(why + ": " + repr(compact_config(cfg)))
+    check(at_least_one_running_validator(cfg), "no running validators")
+    check(not omnipotent_byzantines(cfg), "omnipotent byzantine validator")
+    check(not too_many_ghosts(cfg), "too many ghosts")
+    check(not too_many_zombies(cfg), "too many zombies")
+    check(quorum(cfg), "no quorum")
+    check(not faulty(cfg), "too many faulty votes")
+    check(all(n in cfg["node_set"] for n in cfg["nodes"]),
+          "node outside node set")
+    check(all(v["votes"] > 0 for v in cfg["validators"].values()),
+          "non-positive votes")
+    return cfg
+
+
+# --------------------------------------------------------- transitions
+# {"type": "create"|"destroy"|"add"|"remove"|"alter-votes", ...}
+
+
+def pre_step(cfg: dict, t: dict) -> dict:
+    """The in-between state entered when a transition is *requested*
+    but not yet known to have happened (validator.clj:689-704)."""
+    ty = t["type"]
+    if ty == "add":
+        v = t["validator"]
+        assert v["pub_key"] not in cfg["validators"]
+        prospective = dict(cfg["prospective_validators"])
+        prospective[v["pub_key"]] = v
+        cfg = dict(cfg, prospective_validators=prospective)
+    return assert_valid(cfg)
+
+
+def post_step(cfg: dict, t: dict) -> dict:
+    """Complete a transition (validator.clj:706-747)."""
+    ty = t["type"]
+    if ty == "create":
+        n, v = t["node"], t["validator"]
+        assert n not in cfg["nodes"]
+        cfg = dict(cfg,
+                   nodes={**cfg["nodes"], n: v["pub_key"]},
+                   node_keys={**cfg["node_keys"], n: t.get("node_key")})
+    elif ty == "destroy":
+        n = t["node"]
+        nodes = dict(cfg["nodes"])
+        node_keys = dict(cfg["node_keys"])
+        nodes.pop(n, None)
+        node_keys.pop(n, None)
+        cfg = dict(cfg, nodes=nodes, node_keys=node_keys)
+    elif ty == "add":
+        v = t["validator"]
+        assert v["pub_key"] not in cfg["validators"]
+        prospective = dict(cfg["prospective_validators"])
+        prospective.pop(v["pub_key"], None)
+        cfg = dict(cfg,
+                   prospective_validators=prospective,
+                   validators={**cfg["validators"], v["pub_key"]: v})
+    elif ty == "remove":
+        validators = dict(cfg["validators"])
+        validators.pop(t["pub_key"], None)
+        cfg = dict(cfg, validators=validators)
+    elif ty == "alter-votes":
+        k, votes = t["pub_key"], t["votes"]
+        v = cfg["validators"][k]
+        cfg = dict(cfg, validators={**cfg["validators"],
+                                    k: dict(v, votes=votes)})
+    else:
+        raise ValueError(f"unknown transition type {ty!r}")
+    return assert_valid(cfg)
+
+
+def step(cfg: dict, t: dict) -> dict:
+    """pre_step then post_step; raises IllegalTransition when the
+    result would violate invariants (validator.clj:749-757)."""
+    return post_step(pre_step(cfg, t), t)
+
+
+def rand_transition(test: dict, cfg: dict,
+                    gen_validator_fn: Callable = gen_validator,
+                    gen_node_key_fn: Callable = gen_node_key) -> Optional[dict]:
+    """One random (possibly illegal) transition (validator.clj:765-823).
+    Weights match the reference's condp thresholds: create 1/5,
+    destroy 1/5, add 1/5, remove 1/5, alter-votes 1/5."""
+    roll = gen.rand.random()
+    if roll >= 4 / 5:
+        free = sorted(cfg["node_set"] - set(cfg["nodes"]))
+        if not cfg["validators"] or not free:
+            return None
+        v = gen.rand.choice(sorted(cfg["validators"]))
+        return {"type": "create", "node": gen.rand.choice(free),
+                "validator": cfg["validators"][v],
+                "node_key": gen_node_key_fn()}
+    if roll >= 3 / 5:
+        taken = sorted(cfg["nodes"])
+        if not taken:
+            return None
+        return {"type": "destroy", "node": gen.rand.choice(taken)}
+    if roll >= 2 / 5:
+        return {"type": "add", "version": cfg["version"],
+                "validator": gen_validator_fn()}
+    if roll >= 1 / 5:
+        if not cfg["validators"]:
+            return None
+        k = gen.rand.choice(sorted(cfg["validators"]))
+        return {"type": "remove", "version": cfg["version"], "pub_key": k}
+    if not cfg["validators"]:
+        return None
+    k = gen.rand.choice(sorted(cfg["validators"]))
+    votes = cfg["validators"][k]["votes"]
+    return {"type": "alter-votes", "version": cfg["version"], "pub_key": k,
+            "votes": max(1, votes + gen.rand.randint(-5, 5))}
+
+
+def rand_legal_transition(test: dict, cfg: dict, max_tries: int = 100,
+                          **kw) -> dict:
+    """Retry rand_transition until one steps legally
+    (validator.clj:825-843)."""
+    for _ in range(max_tries):
+        t = rand_transition(test, cfg, **kw)
+        if t is None:
+            continue
+        try:
+            step(cfg, t)
+            return t
+        except (IllegalTransition, AssertionError):
+            continue
+    raise RuntimeError(
+        f"Unable to generate state transition from "
+        f"{compact_config(cfg)!r} in less than {max_tries} tries")
+
+
+# --------------------------------------- reconciliation with the cluster
+
+
+def validator_set_to_vote_map(cfg: dict, validator_set: dict) -> Dict:
+    """Cluster read {version, validators:[{pub_key, power}]} -> full
+    pub_key -> votes map (validator.clj:861-885). Unknown keys raise."""
+    out = {}
+    for v in validator_set.get("validators") or []:
+        k = v["pub_key"]
+        if k not in cfg["validators"] and \
+                k not in cfg["prospective_validators"]:
+            raise RuntimeError(
+                f"Don't recognize cluster validator {v!r}; "
+                f"where did it come from?")
+        out[k] = v["power"]
+    return out
+
+
+def clear_removed_nodes(cfg: dict, votes: Dict) -> dict:
+    """Drop members the cluster no longer knows (validator.clj:887-896)."""
+    return dict(cfg, validators={k: v for k, v in cfg["validators"].items()
+                                 if k in votes})
+
+
+def update_known_nodes(cfg: dict, votes: Dict) -> dict:
+    """Fold cluster votes in; promote prospective validators that now
+    appear (validator.clj:898-928)."""
+    validators = dict(cfg["validators"])
+    prospective = dict(cfg["prospective_validators"])
+    for k, power in votes.items():
+        if k in validators:
+            validators[k] = dict(validators[k], votes=power)
+        else:
+            v = prospective.pop(k, None)
+            assert v is not None, \
+                f"Don't recognize validator {k}; where did it come from?"
+            validators[k] = dict(v, votes=power)
+    return dict(cfg, validators=validators,
+                prospective_validators=prospective)
+
+
+def current_config(cfg: dict, cluster_validator_set: dict) -> dict:
+    """Merge our view with a transactional cluster read
+    (validator.clj:930-946)."""
+    votes = validator_set_to_vote_map(cfg, cluster_validator_set)
+    out = update_known_nodes(clear_removed_nodes(cfg, votes), votes)
+    return dict(out, version=cluster_validator_set.get("version"))
+
+
+class TransitionGenerator(gen.Generator):
+    """Emits {:f :transition, :value legal-transition} ops against the
+    test's live validator config (validator.clj:948-989). The config
+    lives in test["validator_config"], a one-element list acting as the
+    reference's atom; refresh_fn (optional) re-reads it from the
+    cluster before each op."""
+
+    def __init__(self, refresh_fn: Optional[Callable] = None):
+        self.refresh_fn = refresh_fn
+
+    def op(self, test, ctx):
+        box = test.get("validator_config")
+        if not box or box[0] is None:
+            return None
+        cfg = self.refresh_fn(test) if self.refresh_fn else box[0]
+        try:
+            t = rand_legal_transition(test, cfg)
+        except RuntimeError:
+            return None
+        o = gen.fill_in_op({"type": "info", "f": "transition", "value": t},
+                           ctx)
+        return o, self
+
+    def update(self, test, ctx, event):
+        return self
+
+
+def generator(refresh_fn: Optional[Callable] = None) -> TransitionGenerator:
+    return TransitionGenerator(refresh_fn)

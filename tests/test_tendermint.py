@@ -1,0 +1,366 @@
+"""Tendermint suite: gowire golden vectors, validator state-machine
+math, dup-validator grudges, client error mapping, and a full local
+end-to-end cas-register run against the native merkleeyes server with
+a linearizability check (reference: tendermint/src/jepsen/tendermint/*
++ the docker quickstart run, /root/reference/README.md:26-52)."""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+import pytest
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.tendermint import client as tc
+from jepsen_tpu.tendermint import core as tcore
+from jepsen_tpu.tendermint import db as td
+from jepsen_tpu.tendermint import gowire as w
+from jepsen_tpu.tendermint import merkleeyes as me
+from jepsen_tpu.tendermint import validator as tv
+
+
+# ------------------------------------------------------------- gowire
+
+
+def test_uvarint_golden():
+    # Go binary.PutUvarint reference values
+    assert w.uvarint(0) == b"\x00"
+    assert w.uvarint(1) == b"\x01"
+    assert w.uvarint(127) == b"\x7f"
+    assert w.uvarint(128) == b"\x80\x01"
+    assert w.uvarint(300) == b"\xac\x02"
+    for n in (0, 1, 127, 128, 300, 2 ** 40):
+        v, pos = w.read_uvarint(w.uvarint(n))
+        assert v == n and pos == len(w.uvarint(n))
+
+
+def test_varint_zigzag():
+    # Go binary.PutVarint: zigzag(-1)=1, zigzag(1)=2
+    assert w.varint(0) == b"\x00"
+    assert w.varint(-1) == b"\x01"
+    assert w.varint(1) == b"\x02"
+    for n in (-300, -1, 0, 1, 300, -(2 ** 40)):
+        v, _ = w.read_varint(w.varint(n))
+        assert v == n
+
+
+def test_tx_layout():
+    n = bytes(range(12))
+    t = w.set_tx(b"abc", b"x", nonce_=n)
+    # nonce ∥ 0x01 ∥ len(3) "abc" ∥ len(1) "x"  (merkleeyes README)
+    assert t == n + b"\x01\x03abc\x01x"
+    t = w.cas_tx(b"k", b"1", b"2", nonce_=n)
+    assert t == n + b"\x04\x01k\x011\x012"
+    t = w.valset_cas_tx(5, bytes(32), 9, nonce_=n)
+    assert t[12] == 0x07
+    assert t[13:21] == (5).to_bytes(8, "big")
+
+
+# ----------------------------------------------------------- validator
+
+
+def _test_map(nodes=("n1", "n2", "n3", "n4", "n5"), **kw):
+    return {"nodes": list(nodes), **kw}
+
+
+def test_initial_config_plain():
+    cfg = tv.initial_config(_test_map())
+    assert len(cfg["validators"]) == 5
+    assert all(v["votes"] == 2 for v in cfg["validators"].values())
+    assert tv.total_votes(cfg) == 10
+    tv.assert_valid(cfg)
+    assert not tv.byzantine_validators(cfg)
+
+
+def test_initial_config_dup_validators():
+    cfg = tv.initial_config(_test_map(dup_validators=True))
+    # n1 runs n2's validator; 4 validators remain
+    assert len(cfg["validators"]) == 4
+    assert cfg["nodes"]["n1"] == cfg["nodes"]["n2"]
+    bs = tv.byzantine_validators(cfg)
+    assert len(bs) == 1
+    # regular dup weighting: dup gets n-2 = 2 votes of total 3n-4 = 8
+    # (validator.clj:267-337 derivation with n = 4 validators)
+    n = len(cfg["validators"])
+    assert bs[0]["votes"] == n - 2
+    assert tv.total_votes(cfg) == 3 * n - 4
+    frac = tv.vote_fractions(cfg)[bs[0]["pub_key"]]
+    assert frac < Fraction(1, 3)
+    tv.assert_valid(cfg)
+
+
+def test_initial_config_super_byzantine():
+    cfg = tv.initial_config(_test_map(dup_validators=True,
+                                      super_byzantine_validators=True,
+                                      max_byzantine_vote_fraction=
+                                      Fraction(2, 3)))
+    bs = tv.byzantine_validators(cfg)
+    n = len(cfg["validators"])
+    assert bs[0]["votes"] == 4 * (n - 1) - 1
+    frac = tv.vote_fractions(cfg)[bs[0]["pub_key"]]
+    assert Fraction(1, 3) < frac < Fraction(2, 3)
+
+
+def test_invariants():
+    cfg = tv.initial_config(_test_map())
+    # removing validators until quorum breaks must fail
+    ks = sorted(cfg["validators"])
+    c1 = tv.step(cfg, {"type": "remove", "pub_key": ks[0]})
+    with pytest.raises(tv.IllegalTransition):
+        c2 = c1
+        for k in ks[1:]:
+            c2 = tv.step(c2, {"type": "remove", "pub_key": k})
+    # destroying a node leaves a ghost; more than 2 ghosts is illegal
+    c = cfg
+    gone = 0
+    with pytest.raises(tv.IllegalTransition):
+        for n in sorted(cfg["nodes"]):
+            c = tv.step(c, {"type": "destroy", "node": n})
+            gone += 1
+    assert gone >= 1
+
+
+def test_step_add_promotes_prospective():
+    cfg = tv.initial_config(_test_map())
+    v = tv.gen_validator()
+    pre = tv.pre_step(cfg, {"type": "add", "validator": v})
+    assert v["pub_key"] in pre["prospective_validators"]
+    post = tv.post_step(pre, {"type": "add", "validator": v})
+    assert v["pub_key"] in post["validators"]
+    assert v["pub_key"] not in post["prospective_validators"]
+
+
+def test_rand_legal_transition_always_legal():
+    cfg = tv.initial_config(_test_map())
+    with gen.fixed_rand(11):
+        for _ in range(60):
+            t = tv.rand_legal_transition(_test_map(), cfg)
+            cfg = tv.step(cfg, t)  # must not raise
+    tv.assert_valid(cfg)
+
+
+def test_reconciliation():
+    cfg = tv.initial_config(_test_map(("n1", "n2", "n3")))
+    ks = sorted(cfg["validators"])
+    cluster = {"version": 7,
+               "validators": [{"pub_key": k, "power": 5} for k in ks[:2]]}
+    merged = tv.current_config(cfg, cluster)
+    assert merged["version"] == 7
+    assert set(merged["validators"]) == set(ks[:2])
+    assert all(v["votes"] == 5 for v in merged["validators"].values())
+    # unknown cluster validator is an error
+    with pytest.raises(RuntimeError, match="recognize"):
+        tv.current_config(cfg, {"version": 8, "validators":
+                                [{"pub_key": "FF" * 32, "power": 1}]})
+
+
+def test_genesis_structure():
+    cfg = tv.initial_config(_test_map(("n1", "n2")))
+    g = tv.genesis(cfg)
+    assert g["chain_id"] == "jepsen"
+    assert len(g["validators"]) == 2
+    assert all(v["power"] == "2" for v in g["validators"])
+
+
+# -------------------------------------------------------- dup grudges
+
+
+def test_peekaboo_grudge():
+    cfg = tv.initial_config(_test_map(dup_validators=True))
+    test = {"nodes": ["n1", "n2", "n3", "n4", "n5"],
+            "validator_config": [cfg]}
+    with gen.fixed_rand(3):
+        grudge = tcore.peekaboo_dup_validators_grudge(test)(test["nodes"])
+    # one of the dup pair (n1, n2) is exiled from everyone else
+    exiled = [n for n in ("n1", "n2") if len(grudge.get(n, [])) == 4]
+    assert len(exiled) == 1
+    kept = "n1" if exiled == ["n2"] else "n2"
+    assert len(grudge.get(kept, [])) == 1  # only drops the exile
+
+
+def test_split_grudge():
+    cfg = tv.initial_config(_test_map(dup_validators=True))
+    test = {"nodes": ["n1", "n2", "n3", "n4", "n5"],
+            "validator_config": [cfg]}
+    with gen.fixed_rand(3):
+        grudge = tcore.split_dup_validators_grudge(test)(test["nodes"])
+    # two components (dup group size 2), each dropping the other side
+    assert set(grudge) == set(test["nodes"])
+    comp_of = {}
+    for node, drops in grudge.items():
+        comp_of[node] = frozenset(set(test["nodes"]) - set(drops))
+    comps = set(comp_of.values())
+    assert len(comps) == 2
+    # dup nodes n1, n2 land in different components
+    assert comp_of["n1"] != comp_of["n2"]
+
+
+# ------------------------------------------------- client error mapping
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tm")
+    with me.LocalServer(sock_path=str(d / "me.sock")) as srv:
+        yield srv
+
+
+def test_client_kv_roundtrip(server):
+    t = tc.SocketTransport(("unix", server.sock_path))
+    tc.write(t, "reg", 7)
+    assert tc.read(t, "reg") == 7
+    tc.cas(t, "reg", 7, 8)
+    assert tc.read(t, "reg") == 8
+    with pytest.raises(tc.Unauthorized):
+        tc.cas(t, "reg", 99, 0)
+    with pytest.raises(tc.BaseUnknownAddress):
+        tc.cas(t, "missing", 1, 2)
+    assert tc.read(t, "missing-key") is None
+    assert tc.local_read(t, "reg") == 8
+    # structured values round-trip (vectors, as the set workload uses)
+    tc.write(t, "vec", [1, 2, 3])
+    assert tc.read(t, "vec") == [1, 2, 3]
+
+
+def test_client_valset_roundtrip(server):
+    t = tc.SocketTransport(("unix", server.sock_path))
+    vs = tc.validator_set(t)
+    pk = "AB" * 32
+    tc.validator_set_cas(t, vs["version"], pk, 11)
+    vs2 = tc.validator_set(t)
+    assert vs2["version"] == vs["version"] + 1
+    assert {"pub_key": pk, "power": 11} in vs2["validators"]
+
+
+def test_cas_register_client_against_server(server):
+    test = {"transport_for":
+            lambda t_, n_: tc.SocketTransport(("unix", server.sock_path))}
+    cl = tcore.CasRegisterClient().open(test, "n1")
+    from jepsen_tpu.history import Op
+    ok = cl.invoke(test, Op({"type": "invoke", "f": "write",
+                             "value": (1, 5), "process": 0}))
+    assert ok["type"] == "ok"
+    rd = cl.invoke(test, Op({"type": "invoke", "f": "read",
+                             "value": (1, None), "process": 0}))
+    assert rd["type"] == "ok" and tuple(rd["value"]) == (1, 5)
+    bad = cl.invoke(test, Op({"type": "invoke", "f": "cas",
+                              "value": (1, [9, 2]), "process": 0}))
+    assert bad["type"] == "fail"
+    assert bad["error"] == "precondition-failed"
+
+
+def test_changing_validators_nemesis_against_server(tmp_path):
+    """The changing-validators path: refresh reconciles version with
+    the live cluster, valset transitions apply via CAS, failures roll
+    the local config back (core.clj:225-278)."""
+    from jepsen_tpu.history import Op
+    with me.LocalServer(sock_path=str(tmp_path / "s.sock")) as srv:
+        nodes = ["n1", "n2", "n3"]
+        cfg = tv.initial_config({"nodes": nodes})
+        test = {"nodes": nodes, "validator_config": [cfg],
+                "ssh": {"dummy": True},
+                "transport_for":
+                lambda t_, n_: tc.SocketTransport(("unix", srv.sock_path))}
+        # Seed the cluster with the initial validators so refresh
+        # recognizes them.
+        t0 = tc.SocketTransport(("unix", srv.sock_path))
+        for k, v in cfg["validators"].items():
+            tc.validator_set_change(t0, k, v["votes"])
+        cfg2 = tcore.refresh_config(test)
+        assert cfg2["version"] >= 1  # reconciled with the live valset
+
+        nem = tcore.ChangingValidatorsNemesis().setup(test)
+        with gen.fixed_rand(5):
+            t = tv.rand_legal_transition(test, cfg2)
+        out = nem.invoke(test, Op({"type": "info", "f": "transition",
+                                   "value": t}))
+        assert out["value"] == "done"
+
+        # A valset transition with a hopelessly stale version raises and
+        # rolls the local config back (no stranded prospectives).
+        before = test["validator_config"][0]
+        bad = {"type": "add", "version": 999_999,
+               "validator": tv.gen_validator()}
+        with pytest.raises(tc.Unauthorized):
+            nem.invoke(test, Op({"type": "info", "f": "transition",
+                                 "value": bad}))
+        assert test["validator_config"][0] is before
+
+
+def test_crash_nemesis_binds_sessions():
+    """crash_nemesis must run daemon control inside node sessions; with
+    the dummy remote every op completes rather than raising 'no session
+    bound' (the regression this guards)."""
+    from jepsen_tpu.history import Op
+    test = {"nodes": ["n1", "n2"], "ssh": {"dummy": True}}
+    nem = tcore.crash_nemesis().setup(test)
+    out = nem.invoke(test, Op({"type": "info", "f": "start"}))
+    assert set(out["value"]) == {"n1", "n2"}
+    assert set(out["value"].values()) == {"stopped"}
+    out = nem.invoke(test, Op({"type": "info", "f": "stop"}))
+    assert set(out["value"].values()) == {"started"}
+
+
+def test_concurrency_override():
+    base = {"nodes": ["n1"], "ssh": {"dummy": True},
+            "transport_for": td.local_transport_for}
+    t = tcore.test_map({**base, "concurrency": 6})
+    assert t["concurrency"] == 6  # multiple of 2*n honored
+    with pytest.raises(ValueError, match="multiple"):
+        tcore.test_map({**base, "concurrency": 3})
+
+
+# --------------------------------------------------------- end-to-end
+
+
+def test_local_cas_register_end_to_end(tmp_path):
+    """The quickstart run (README.md:26-52): cas-register workload
+    against the native merkleeyes, full lifecycle, linearizable."""
+    from jepsen_tpu import core as jcore
+    with gen.fixed_rand(42):
+        t = tcore.test_map({
+            "nodes": ["n1"],
+            "ssh": {"dummy": True},
+            "db": td.LocalMerkleeyesDB(workdir=str(tmp_path)),
+            "transport_for": td.local_transport_for,
+            "time_limit": 6,
+            "quiesce": 0,
+            "ops_per_key": 30,
+            "concurrency": 4,
+        })
+        completed = jcore.run(t)
+    res = completed["results"]
+    assert res["valid?"] is True, res
+    linear = res["linear"]
+    assert linear["valid?"] is True
+    # multiple keys were actually exercised
+    history = completed["history"]
+    kv_ops = [o for o in history if isinstance(o.get("value"), tuple)]
+    assert len(kv_ops) > 40
+
+
+def test_local_set_workload_end_to_end(tmp_path):
+    from jepsen_tpu import core as jcore
+    with gen.fixed_rand(7):
+        t = tcore.test_map({
+            "nodes": ["n1"],
+            "ssh": {"dummy": True},
+            "db": td.LocalMerkleeyesDB(workdir=str(tmp_path)),
+            "transport_for": td.local_transport_for,
+            "workload": "set",
+            "time_limit": 5,
+            "quiesce": 0,
+            "concurrency": 4,
+        })
+        completed = jcore.run(t)
+    res = completed["results"]
+    assert res["valid?"] is True, res
+
+
+def test_cli_local_run(tmp_path, monkeypatch):
+    from jepsen_tpu.tendermint import cli as tcli
+    monkeypatch.chdir(tmp_path)
+    code = tcli.main(["test", "--local", "--node", "n1",
+                      "--workload", "cas-register", "--nemesis", "none",
+                      "--time-limit", "3", "--concurrency", "4"])
+    assert code == 0
