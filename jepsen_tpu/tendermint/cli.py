@@ -57,11 +57,13 @@ def test_fn(options: Dict) -> Dict:
         opts.setdefault("ssh", {})["dummy"] = True
         if not options.get("explicit-nodes"):
             # one logical node unless the user asked for more — local
-            # mode shares a single server, extra nodes add nothing
+            # mode shares a single server, extra nodes add nothing.
             opts["nodes"] = ["n1"]
-            if opts.get("concurrency"):
-                opts["concurrency"] = max(
-                    2, opts["concurrency"] // max(1, len(options["nodes"])))
+            raw = str(args.get("concurrency") or "")
+            if raw.endswith("n"):
+                # per-node spec: recompute for the collapsed node count
+                opts["concurrency"] = jcli.parse_concurrency(raw, 1)
+            # absolute values pass through untouched
     else:
         opts["db"] = td.db({"tendermint_url": args.get("tendermint_url"),
                             "merkleeyes_url": args.get("merkleeyes_url")})

@@ -89,6 +89,13 @@ class HttpTransport:
     def broadcast_tx(self, tx: bytes) -> me.TxResult:
         body = self._get("/broadcast_tx_commit",
                          {"tx": "0x" + tx.hex()})
+        if body.get("error") or "result" not in body:
+            # RPC-level failure (mempool full, timeout, catching up):
+            # the tx outcome is indeterminate — surface it, never :ok.
+            err = body.get("error") or {}
+            raise TxError(me.CODE_INTERNAL,
+                          str(err.get("message") or err or
+                              "no result in RPC response"))
         result = (body.get("result") or {})
         for stage in ("check_tx", "deliver_tx"):
             st = result.get(stage) or {}

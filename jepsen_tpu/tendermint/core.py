@@ -11,6 +11,7 @@ merkleeyes instances, cluster runs at tendermint RPC."""
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import math
 from typing import Callable, Dict, Optional
@@ -58,6 +59,28 @@ def _transport(test, node):
     return tf(test, node)
 
 
+@contextlib.contextmanager
+def _map_errors(o: Op, crash: str):
+    """Shared tx-error taxonomy (core.clj:57-75,116-138): code 8 ->
+    :fail precondition-failed, code 7 -> :fail not-found, connection
+    refused -> :fail, other network faults -> `crash` (:info for
+    writes, :fail for reads) with an indeterminate error."""
+    try:
+        yield
+    except tc.Unauthorized:
+        o["type"] = "fail"
+        o["error"] = "precondition-failed"
+    except tc.BaseUnknownAddress:
+        o["type"] = "fail"
+        o["error"] = "not-found"
+    except ConnectionRefusedError:
+        o["type"] = "fail"
+        o["error"] = "connection-refused"
+    except (ConnectionError, TimeoutError, OSError) as e:
+        o["type"] = crash
+        o["error"] = f"indeterminate: {e}"
+
+
 class CasRegisterClient(jclient.Client):
     """read/write/cas on independent [k v] tuples (core.clj:33-80).
     Error mapping: code 8 -> :fail precondition-failed; code 7 -> :fail
@@ -75,7 +98,7 @@ class CasRegisterClient(jclient.Client):
         k, v = op.get("value")
         crash = "fail" if op.get("f") == "read" else "info"
         t = _transport(test, self.node)
-        try:
+        with _map_errors(o, crash):
             f = op.get("f")
             if f == "read":
                 o["type"] = "ok"
@@ -89,18 +112,6 @@ class CasRegisterClient(jclient.Client):
                 o["type"] = "ok"
             else:
                 raise ValueError(f"unknown f {f!r}")
-        except tc.Unauthorized:
-            o["type"] = "fail"
-            o["error"] = "precondition-failed"
-        except tc.BaseUnknownAddress:
-            o["type"] = "fail"
-            o["error"] = "not-found"
-        except ConnectionRefusedError:
-            o["type"] = "fail"
-            o["error"] = "connection-refused"
-        except (ConnectionError, TimeoutError, OSError) as e:
-            o["type"] = crash
-            o["error"] = f"indeterminate: {e}"
         return o
 
     def is_reusable(self, test):
@@ -123,7 +134,7 @@ class SetClient(jclient.Client):
         k, v = op.get("value")
         crash = "fail" if op.get("f") == "read" else "info"
         t = _transport(test, self.node)
-        try:
+        with _map_errors(o, crash):
             f = op.get("f")
             if f == "init":
                 tries = 0
@@ -147,18 +158,6 @@ class SetClient(jclient.Client):
                 o["value"] = independent.KV(k, set(got or []))
             else:
                 raise ValueError(f"unknown f {f!r}")
-        except tc.Unauthorized:
-            o["type"] = "fail"
-            o["error"] = "precondition-failed"
-        except tc.BaseUnknownAddress:
-            o["type"] = "fail"
-            o["error"] = "not-found"
-        except ConnectionRefusedError:
-            o["type"] = "fail"
-            o["error"] = "connection-refused"
-        except (ConnectionError, TimeoutError, OSError) as e:
-            o["type"] = crash
-            o["error"] = f"indeterminate: {e}"
         return o
 
     def is_reusable(self, test):
@@ -491,9 +490,15 @@ def test_map(opts: Optional[Dict] = None) -> Dict:
         if user_c % group == 0:
             group = user_c
         else:
-            raise ValueError(
-                f"concurrency {user_c} must be a multiple of the "
-                f"workload's group size {wl['concurrency']} (2 x nodes)")
+            # Round up to the nearest whole key-group (2 x nodes): the
+            # independent generator needs full groups, and honoring the
+            # user's magnitude loudly beats crashing on the CLI default.
+            rounded = max(group, math.ceil(user_c / group) * group)
+            log.warning(
+                "concurrency %d is not a multiple of the workload's "
+                "key-group size %d (2 x nodes); using %d",
+                user_c, group, rounded)
+            group = rounded
     t.update({"client": wl["client"],
               "concurrency": group,
               "generator": gen.phases(*phases),

@@ -306,8 +306,11 @@ def test_concurrency_override():
             "transport_for": td.local_transport_for}
     t = tcore.test_map({**base, "concurrency": 6})
     assert t["concurrency"] == 6  # multiple of 2*n honored
-    with pytest.raises(ValueError, match="multiple"):
-        tcore.test_map({**base, "concurrency": 3})
+    # non-multiples round up to the nearest whole key-group
+    t = tcore.test_map({**base, "concurrency": 3})
+    assert t["concurrency"] == 4
+    t = tcore.test_map({**base, "concurrency": 1})
+    assert t["concurrency"] == 2
 
 
 # --------------------------------------------------------- end-to-end
