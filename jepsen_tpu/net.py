@@ -125,6 +125,48 @@ def iptables() -> IPTables:
     return IPTables()
 
 
+class IPFilter(Net):
+    """SmartOS ipfilter drops (net.clj:113-145). Note: the reference's
+    slow/flaky arms shell out to Linux tc/netem even in this impl
+    (net.clj:123-144) and cannot work on actual illumos; partitions
+    (drop/heal via ipf) are the useful surface, so slow/flaky/fast
+    raise a clear error instead of failing with 'tc: not found'."""
+
+    def drop(self, test, src, dest):
+        c.on_nodes(test, lambda t, n: c.exec_(
+            "sh", "-c",
+            f"echo block in from {_ip(src)} to any | ipf -f -"), [dest])
+
+    def drop_all(self, test, grudge):
+        grudge = grudge or {}
+
+        def apply(t, node):
+            rules = "\n".join(f"block in from {_ip(s)} to any"
+                               for s in grudge.get(node, []))
+            if rules:
+                c.exec_("sh", "-c", f"printf '{rules}\n' | ipf -f -")
+        c.on_nodes(test, apply, list(grudge))
+
+    def heal(self, test):
+        c.on_nodes(test, lambda t, n: c.exec_("ipf", "-Fa"))
+
+    def slow(self, test, opts=None):
+        raise NotImplementedError(
+            "ipfilter net has no traffic shaping: tc/netem is Linux-only")
+
+    def flaky(self, test):
+        raise NotImplementedError(
+            "ipfilter net has no traffic shaping: tc/netem is Linux-only")
+
+    def fast(self, test):
+        # Nothing to undo: slow/flaky are unavailable on this platform.
+        pass
+
+
+def ipfilter() -> IPFilter:
+    return IPFilter()
+
+
 def _ip(node: str) -> str:
     return node  # hostnames resolve on the nodes (control/net.clj:8-20)
 

@@ -157,3 +157,31 @@ class Centos(OS):
 
 def centos(extra_packages: Sequence[str] = ()) -> Centos:
     return Centos(extra_packages)
+
+
+SMARTOS_BASE_PACKAGES = ["curl", "wget", "gtar", "gzip", "coreutils"]
+
+
+class SmartOS(OS):
+    """SmartOS setup: pkgin packages + loopback hostfile fix
+    (os/smartos.clj:12-132). Pairs with net.ipfilter()."""
+
+    def __init__(self, extra_packages: Sequence[str] = ()):
+        self.extra_packages = list(extra_packages)
+
+    def setup(self, test, node):
+        with c.su():
+            name = c.exec_("hostname")
+            hosts = c.exec_("cat", "/etc/hosts")
+            if name not in hosts.split():
+                c.exec_("sh", "-c",
+                        f"echo '127.0.0.1 {name}' >> /etc/hosts")
+            c.exec_("pkgin", "-y", "install",
+                    *(SMARTOS_BASE_PACKAGES + self.extra_packages))
+
+    def teardown(self, test, node):
+        pass
+
+
+def smartos(extra_packages: Sequence[str] = ()) -> SmartOS:
+    return SmartOS(extra_packages)

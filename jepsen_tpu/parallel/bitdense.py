@@ -140,8 +140,7 @@ def _bitdense_impl(xs, state0, step_name: str, S: int, C: int,
             legal[:, :, None] & ((nxt - lo)[:, :, None] == t_idx[None, None, :]),
             FULL, U32(0))                                      # [C, S, S]
 
-        def body(c):
-            B, _ = c
+        def expand(B):
             B2 = B
             for j in range(C):
                 ext = without_bit(j, B)                        # [S, W]
@@ -151,6 +150,16 @@ def _bitdense_impl(xs, state0, step_name: str, S: int, C: int,
                 for s in range(1, S):
                     G = G | terms[s]
                 B2 = B2 | or_into_bit(j, G)
+            return B2
+
+        def body(c):
+            B, _ = c
+            # Two expansions per while iteration: the loop is latency-
+            # bound by the `changed` reduction + condition sync, not by
+            # the bitwise algebra, so halving the iteration count wins
+            # ~1.5x even when the second expansion is sometimes a no-op
+            # (measured on v5e: 8.9k -> 12.9k ops/s on the bench batch).
+            B2 = expand(expand(B))
             return B2, jnp.any(B2 != B)
         return body
 

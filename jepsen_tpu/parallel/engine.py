@@ -79,11 +79,22 @@ def _dedupe_compact(st, ml, mh, live, N):
     return new_st, new_ml, new_mh, new_live, count, count > N
 
 
-def _check_impl(xs, state0, step_name: str, N: int):
-    """Scan over return events. xs: dict of [R, ...] arrays. Returns
-    (valid, fail_event, overflow, max_frontier, steps_evaluated)."""
+def _initial_carry(state0, N: int):
+    """The scan carry at event 0: one live config (the initial model
+    state, nothing linearized)."""
+    st0 = jnp.zeros(N, jnp.int32).at[0].set(state0)
+    ml0 = jnp.zeros(N, jnp.uint32)
+    mh0 = jnp.zeros(N, jnp.uint32)
+    live0 = jnp.arange(N) < 1
+    return (st0, ml0, mh0, live0, jnp.array(True), jnp.int32(-1),
+            jnp.int32(0), jnp.int32(1), jnp.int32(0))
+
+
+def _scan_step_factory(step_name: str, N: int, C: int):
+    """The per-return-event scan step, parameterized by model step,
+    frontier capacity, and slot-window width. Shared by the one-shot
+    and the resumable (checkpointed) entry points."""
     step = STEPS[step_name]
-    C = xs["slot_f"].shape[1]
     bit_lo, bit_hi = _slot_bits(C)
 
     # model step vmapped over configs x slots
@@ -159,17 +170,30 @@ def _check_impl(xs, state0, step_name: str, N: int):
         return (st_o, ml_o, mh_o, live_o, new_ok, new_fail,
                 r_idx + 1, maxf, steps_n), ovf
 
-    st0 = jnp.zeros(N, jnp.int32).at[0].set(state0)
-    ml0 = jnp.zeros(N, jnp.uint32)
-    mh0 = jnp.zeros(N, jnp.uint32)
-    live0 = jnp.arange(N) < 1
-    carry0 = (st0, ml0, mh0, live0, jnp.array(True), jnp.int32(-1),
-              jnp.int32(0), jnp.int32(1), jnp.int32(0))
-    carry, ovfs = lax.scan(scan_step, carry0, xs)
+    return scan_step
+
+
+def _check_impl(xs, state0, step_name: str, N: int):
+    """Scan over all return events from scratch. xs: dict of [R, ...]
+    arrays. Returns (valid, fail_event, overflow, max_frontier,
+    steps_evaluated)."""
+    C = xs["slot_f"].shape[1]
+    carry0 = _initial_carry(state0, N)
+    carry, ovfs = lax.scan(_scan_step_factory(step_name, N, C), carry0, xs)
     _, _, _, live, ok, fail_r, _, maxf, steps_n = carry
     overflow = jnp.any(ovfs)
     valid = ok & (jnp.sum(live) > 0) & ~overflow
     return valid, fail_r, overflow, maxf, steps_n
+
+
+@functools.partial(jax.jit, static_argnames=("step_name", "N"))
+def _check_device_resumable(xs, carry0, step_name: str, N: int):
+    """One chunk of events from an explicit carry; returns the final
+    carry plus the overflow flag so the host can checkpoint between
+    chunks."""
+    C = xs["slot_f"].shape[1]
+    carry, ovfs = lax.scan(_scan_step_factory(step_name, N, C), carry0, xs)
+    return carry, jnp.any(ovfs)
 
 
 _check_device = jax.jit(_check_impl, static_argnames=("step_name", "N"))
@@ -194,6 +218,171 @@ def _xs_from_encoded(e: EncodedHistory) -> dict:
         "slot_occ": jnp.asarray(e.slot_occ),
         "ev_slot": jnp.asarray(e.ev_slot),
     }
+
+
+class FrontierCheckpoint:
+    """A resumable snapshot of the search frontier — the checker-side
+    checkpoint/resume capability (SURVEY.md §5.4: the reference's
+    resume is re-analysis of a stored history; long device searches
+    additionally checkpoint mid-search so a crash or preemption loses
+    at most one chunk of events).
+
+    Saved as .npz; history identity is guarded by a digest of the
+    encoded event arrays — resuming against a different history is an
+    error, not silent corruption."""
+
+    def __init__(self, event_index: int, capacity: int, step_name: str,
+                 history_digest: str, st, ml, mh, live, ok, fail_r,
+                 maxf, steps_n):
+        self.event_index = int(event_index)
+        self.capacity = int(capacity)
+        self.step_name = step_name
+        self.history_digest = history_digest
+        self.st = np.asarray(st)
+        self.ml = np.asarray(ml)
+        self.mh = np.asarray(mh)
+        self.live = np.asarray(live)
+        self.ok = bool(ok)
+        self.fail_r = int(fail_r)
+        self.maxf = int(maxf)
+        self.steps_n = int(steps_n)
+
+    def carry(self):
+        """The device scan carry this checkpoint resumes from."""
+        return (jnp.asarray(self.st), jnp.asarray(self.ml),
+                jnp.asarray(self.mh), jnp.asarray(self.live),
+                jnp.asarray(self.ok), jnp.int32(self.fail_r),
+                jnp.int32(self.event_index), jnp.int32(self.maxf),
+                jnp.int32(self.steps_n))
+
+    def grown(self, new_capacity: int) -> "FrontierCheckpoint":
+        """Re-embed the frontier into a larger capacity (overflow
+        doubling across a resume)."""
+        pad = new_capacity - self.capacity
+        assert pad >= 0
+        return FrontierCheckpoint(
+            self.event_index, new_capacity, self.step_name,
+            self.history_digest,
+            np.concatenate([self.st, np.zeros(pad, np.int32)]),
+            np.concatenate([self.ml, np.zeros(pad, np.uint32)]),
+            np.concatenate([self.mh, np.zeros(pad, np.uint32)]),
+            np.concatenate([self.live, np.zeros(pad, bool)]),
+            self.ok, self.fail_r, self.maxf, self.steps_n)
+
+    def save(self, path: str) -> str:
+        # np.savez appends .npz to suffix-less paths; normalize so
+        # load(save(p)) always works.
+        if not path.endswith(".npz"):
+            path = path + ".npz"
+        np.savez_compressed(
+            path, st=self.st, ml=self.ml, mh=self.mh, live=self.live,
+            meta=np.array([self.event_index, self.capacity,
+                           int(self.ok), self.fail_r, self.maxf,
+                           self.steps_n], np.int64),
+            step_name=np.array(self.step_name),
+            history_digest=np.array(self.history_digest))
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "FrontierCheckpoint":
+        if not path.endswith(".npz"):
+            path = path + ".npz"
+        z = np.load(path, allow_pickle=False)
+        ev, cap, ok, fail_r, maxf, steps_n = z["meta"].tolist()
+        return cls(ev, cap, str(z["step_name"]), str(z["history_digest"]),
+                   z["st"], z["ml"], z["mh"], z["live"], bool(ok),
+                   fail_r, maxf, steps_n)
+
+
+def history_digest(e: EncodedHistory) -> str:
+    """Stable identity of an encoded history, for checkpoint safety."""
+    import hashlib
+    h = hashlib.sha256()
+    for a in (e.slot_f, e.slot_a0, e.slot_a1, e.slot_wild, e.slot_occ,
+              e.ev_slot):
+        h.update(np.ascontiguousarray(a).tobytes())
+    h.update(str(e.state0).encode())
+    return h.hexdigest()[:16]
+
+
+def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
+                            max_capacity: int = 1 << 20,
+                            checkpoint_every: int = 256,
+                            checkpoint_cb=None,
+                            resume: Optional[FrontierCheckpoint] = None,
+                            ) -> dict:
+    """check_encoded with mid-search checkpointing: events are processed
+    in chunks of `checkpoint_every`; after each chunk the frontier is
+    pulled to host and handed to checkpoint_cb(FrontierCheckpoint) (e.g.
+    cp.save(path)). Pass `resume` to continue a prior search. Overflow
+    inside a chunk re-runs that chunk at doubled capacity — the
+    checkpoint taken before the chunk stays valid."""
+    if e.n_returns == 0:
+        return {"valid?": True, "max-frontier": 0, "capacity": 0}
+    digest = history_digest(e)
+    if resume is not None:
+        if resume.history_digest != digest:
+            raise ValueError(
+                f"checkpoint is for a different history "
+                f"(digest {resume.history_digest} != {digest})")
+        if resume.step_name != e.step_name:
+            raise ValueError("checkpoint is for a different model")
+        cp = resume
+        N = cp.capacity
+    else:
+        N = max(64, capacity)
+        cp = FrontierCheckpoint(
+            0, N, e.step_name, digest,
+            np.zeros(N, np.int32), np.zeros(N, np.uint32),
+            np.zeros(N, np.uint32), np.arange(N) < 1,
+            True, -1, 1, 0)
+        cp.st[0] = e.state0
+    xs_np = {
+        "slot_f": e.slot_f, "slot_a0": e.slot_a0, "slot_a1": e.slot_a1,
+        "slot_wild": e.slot_wild, "slot_occ": e.slot_occ,
+        "ev_slot": e.ev_slot,
+    }
+    R = e.n_returns
+    while cp.event_index < R and cp.ok:
+        lo = cp.event_index
+        hi = min(R, lo + checkpoint_every)
+        chunk = {k: jnp.asarray(v[lo:hi]) for k, v in xs_np.items()}
+        carry, overflow = _check_device_resumable(
+            chunk, cp.carry(), e.step_name, cp.capacity)
+        if bool(overflow):
+            if cp.capacity * 2 > max_capacity:
+                return {"valid?": "unknown",
+                        "error": f"frontier overflow at capacity "
+                                 f"{cp.capacity}",
+                        "capacity": cp.capacity,
+                        "checkpoint": cp}
+            cp = cp.grown(cp.capacity * 2)
+            continue  # re-run the same chunk at doubled capacity
+        st, ml, mh, live, ok, fail_r, r_idx, maxf, steps_n = \
+            [np.asarray(x) for x in carry]
+        cp = FrontierCheckpoint(int(r_idx), cp.capacity, e.step_name,
+                                digest, st, ml, mh, live, bool(ok),
+                                int(fail_r), int(maxf), int(steps_n))
+        if checkpoint_cb is not None:
+            checkpoint_cb(cp)
+    out = {"valid?": cp.ok and bool(cp.live.any()),
+           "max-frontier": cp.maxf,
+           "capacity": cp.capacity,
+           # approximate when capacity grew mid-search: iterations from
+           # earlier chunks ran at smaller capacities
+           "explored": cp.steps_n * cp.capacity * len(e.slot_f[0])}
+    if not out["valid?"]:
+        out.update(_fail_op(e, cp.fail_r))
+    return out
+
+
+def _fail_op(e: EncodedHistory, r: int) -> dict:
+    """The counterexample op fields for a failing return event."""
+    c = e.calls[int(e.ret_call[r])]
+    return {"op": {"process": c.process, "f": c.f,
+                   "value": c.result if c.f == "read" else c.value,
+                   "index": c.invoke_index},
+            "fail-event": r}
 
 
 def check_encoded(e: EncodedHistory, capacity: int = 1024,
@@ -221,13 +410,7 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
         "explored": int(steps_n) * N * len(e.slot_f[0]),
     }
     if not out["valid?"]:
-        r = int(fail_r)
-        cid = int(e.ret_call[r])
-        c = e.calls[cid]
-        out["op"] = {"process": c.process, "f": c.f,
-                     "value": c.result if c.f == "read" else c.value,
-                     "index": c.invoke_index}
-        out["fail-event"] = r
+        out.update(_fail_op(e, int(fail_r)))
     return out
 
 

@@ -1,0 +1,100 @@
+"""Frontier checkpoint/resume for long device searches (the
+checkpoint/resume capability beyond the reference's re-analysis path,
+SURVEY.md §5.4 / §7)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.histories import rand_register_history
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.parallel import encode as enc_mod, engine
+
+
+def _encoded(seed=3, n_ops=160, crash_p=0.01, valid=True):
+    h = rand_register_history(n_ops=n_ops, n_processes=6, n_values=4,
+                              crash_p=crash_p, fail_p=0.05, busy=0.7,
+                              seed=seed)
+    if not valid:
+        # corrupt one ok read to a value never written
+        for o in reversed(h):
+            if o.get("type") == "ok" and o.get("f") == "read" \
+                    and o.get("value") is not None:
+                o["value"] = 993
+                break
+    return enc_mod.encode(CASRegister(), h)
+
+
+def test_resumable_matches_oneshot_valid():
+    e = _encoded(seed=5)
+    ref = engine.check_encoded(e, capacity=256)
+    res = engine.check_encoded_resumable(e, capacity=256,
+                                         checkpoint_every=16)
+    assert res["valid?"] == ref["valid?"] is True
+    assert res["max-frontier"] == ref["max-frontier"]
+
+
+def test_resumable_matches_oneshot_invalid():
+    e = _encoded(seed=6, valid=False)
+    ref = engine.check_encoded(e, capacity=256)
+    res = engine.check_encoded_resumable(e, capacity=256,
+                                         checkpoint_every=16)
+    assert ref["valid?"] is False and res["valid?"] is False
+    assert res["op"] == ref["op"]
+    assert res["fail-event"] == ref["fail-event"]
+
+
+def test_checkpoint_save_load_resume(tmp_path):
+    e = _encoded(seed=7)
+    ref = engine.check_encoded(e, capacity=256)
+
+    # run the first chunks only, capturing checkpoints
+    cps = []
+
+    class Stop(Exception):
+        pass
+
+    def cb(cp):
+        cps.append(cp)
+        if len(cps) >= 3:
+            raise Stop  # simulate preemption mid-search
+
+    with pytest.raises(Stop):
+        engine.check_encoded_resumable(e, capacity=256,
+                                       checkpoint_every=8,
+                                       checkpoint_cb=cb)
+    assert cps and cps[-1].event_index < e.n_returns
+
+    # persist, reload, resume to completion
+    path = str(tmp_path / "frontier.npz")
+    cps[-1].save(path)
+    loaded = engine.FrontierCheckpoint.load(path)
+    assert loaded.event_index == cps[-1].event_index
+    assert (loaded.live == cps[-1].live).all()
+
+    res = engine.check_encoded_resumable(e, checkpoint_every=64,
+                                         resume=loaded)
+    assert res["valid?"] == ref["valid?"]
+    assert res["max-frontier"] >= 1
+
+
+def test_checkpoint_rejects_wrong_history(tmp_path):
+    e1, e2 = _encoded(seed=8), _encoded(seed=9)
+    cps = []
+    engine.check_encoded_resumable(e1, checkpoint_every=8,
+                                   checkpoint_cb=cps.append)
+    assert cps
+    with pytest.raises(ValueError, match="different history"):
+        engine.check_encoded_resumable(e2, resume=cps[0])
+
+
+def test_overflow_regrows_within_resume():
+    # tiny capacity forces overflow doubling; the result must still
+    # match the roomy one-shot check
+    e = _encoded(seed=10, n_ops=120)
+    ref = engine.check_encoded(e, capacity=1024)
+    res = engine.check_encoded_resumable(e, capacity=64,
+                                         checkpoint_every=16)
+    assert res["valid?"] == ref["valid?"]
+    assert res["capacity"] >= 64
