@@ -160,12 +160,35 @@ def encode(model, history, pad_slots: Optional[int] = None) -> EncodedHistory:
     )
 
 
+def place_batch(xs: dict, state0, mesh):
+    """Explicitly device_put a padded batch onto `mesh`: key axis sharded
+    over the first mesh axis when divisible, replicated otherwise. Always
+    an *explicit* placement — a batch headed for a mesh must never be
+    created on the default backend, which can be a broken TPU runtime
+    while the caller is deliberately on a CPU mesh (the MULTICHIP_r01
+    crash mode)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ax = mesh.axis_names[0]
+    K = len(state0)
+    if K % mesh.shape[ax] == 0:
+        xs = {k: jax.device_put(v, NamedSharding(
+            mesh, P(*((ax,) + (None,) * (v.ndim - 1)))))
+            for k, v in xs.items()}
+        state0 = jax.device_put(state0, NamedSharding(mesh, P(ax)))
+    else:
+        rep = NamedSharding(mesh, P())
+        xs = jax.device_put(xs, rep)
+        state0 = jax.device_put(state0, rep)
+    return xs, state0
+
+
 def pad_batch(encs: list, mesh=None):
     """Pad per-key encoded histories to one (K, R, C) batch and build the
-    scanned arrays; with a mesh (and K divisible by its first axis) the
-    key axis is device_put-sharded across it. Shared by the sparse,
-    dense, and bitdense batch checkers. Returns (xs, state0, S, C, R)."""
-    import jax
+    scanned arrays; with a mesh the batch is explicitly placed on it via
+    `place_batch`. Shared by the sparse, dense, and bitdense batch
+    checkers. Returns (xs, state0, S, C, R)."""
     import jax.numpy as jnp
 
     S = max(e.n_states for e in encs)
@@ -178,7 +201,7 @@ def pad_batch(encs: list, mesh=None):
         for k, e in enumerate(encs):
             a = getattr(e, attr)
             out[k, : a.shape[0], : a.shape[1]] = a
-        return jnp.asarray(out)
+        return out
 
     xs = {
         "slot_f": pad("slot_f", -1, np.int32),
@@ -190,15 +213,12 @@ def pad_batch(encs: list, mesh=None):
     ev = np.full((K, R), -1, np.int32)
     for k, e in enumerate(encs):
         ev[k, : e.n_returns] = e.ev_slot
-    xs["ev_slot"] = jnp.asarray(ev)
-    state0 = jnp.asarray(np.array([e.state0 for e in encs], np.int32))
+    xs["ev_slot"] = ev
+    state0 = np.array([e.state0 for e in encs], np.int32)
 
     if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        ax = mesh.axis_names[0]
-        if K % mesh.shape[ax] == 0:
-            xs = {k: jax.device_put(v, NamedSharding(
-                mesh, P(*((ax,) + (None,) * (v.ndim - 1)))))
-                for k, v in xs.items()}
-            state0 = jax.device_put(state0, NamedSharding(mesh, P(ax)))
+        xs, state0 = place_batch(xs, state0, mesh)
+    else:
+        xs = {k: jnp.asarray(v) for k, v in xs.items()}
+        state0 = jnp.asarray(state0)
     return xs, state0, S, C, R

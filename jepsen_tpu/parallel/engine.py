@@ -209,15 +209,23 @@ def _check_device_batch(xs, state0, step_name: str, N: int):
 # ------------------------------------------------------------- host API
 
 
-def _xs_from_encoded(e: EncodedHistory) -> dict:
-    return {
-        "slot_f": jnp.asarray(e.slot_f),
-        "slot_a0": jnp.asarray(e.slot_a0),
-        "slot_a1": jnp.asarray(e.slot_a1),
-        "slot_wild": jnp.asarray(e.slot_wild),
-        "slot_occ": jnp.asarray(e.slot_occ),
-        "ev_slot": jnp.asarray(e.ev_slot),
+def _xs_from_encoded(e: EncodedHistory, device=None) -> dict:
+    """Event arrays as device arrays. With `device` (a Device or
+    Sharding) every array is *explicitly* placed there — never on the
+    default backend, which may be a broken TPU runtime while the caller
+    is deliberately running on a CPU mesh (the MULTICHIP_r01 failure
+    mode: jnp.asarray landing on the poisoned default backend)."""
+    xs = {
+        "slot_f": e.slot_f,
+        "slot_a0": e.slot_a0,
+        "slot_a1": e.slot_a1,
+        "slot_wild": e.slot_wild,
+        "slot_occ": e.slot_occ,
+        "ev_slot": e.ev_slot,
     }
+    if device is not None:
+        return jax.device_put(xs, device)
+    return {k: jnp.asarray(v) for k, v in xs.items()}
 
 
 class FrontierCheckpoint:
@@ -472,36 +480,17 @@ def _prefix_calls(cs, fail_idx):
 
 
 def encode_batch(model, histories, pad_slots: Optional[int] = None,
-                 encs: Optional[list] = None):
+                 encs: Optional[list] = None, mesh=None):
     """Encode many per-key histories to one padded batch (the reference's
     per-key data parallelism, jepsen.independent — SURVEY.md §2.20 P5:
-    'one key's history per TPU program instance')."""
+    'one key's history per TPU program instance'). With `mesh`, the
+    arrays are explicitly device_put onto the mesh (key axis sharded
+    when divisible, replicated otherwise) so the default backend is
+    never touched."""
     if encs is None:
         encs = [enc_mod.encode(model, h, pad_slots=pad_slots)
                 for h in histories]
-    C = max(e.slot_f.shape[1] for e in encs)
-    R = max(e.n_returns for e in encs)
-    K = len(encs)
-
-    def pad(attr, fill, dtype):
-        out = np.full((K, R, C), fill, dtype)
-        for k, e in enumerate(encs):
-            arr = getattr(e, attr)
-            out[k, : arr.shape[0], : arr.shape[1]] = arr
-        return jnp.asarray(out)
-
-    xs = {
-        "slot_f": pad("slot_f", -1, np.int32),
-        "slot_a0": pad("slot_a0", -1, np.int32),
-        "slot_a1": pad("slot_a1", -1, np.int32),
-        "slot_wild": pad("slot_wild", False, bool),
-        "slot_occ": pad("slot_occ", False, bool),
-    }
-    ev = np.full((K, R), -1, np.int32)
-    for k, e in enumerate(encs):
-        ev[k, : e.n_returns] = e.ev_slot
-    xs["ev_slot"] = jnp.asarray(ev)
-    state0 = jnp.asarray(np.array([e.state0 for e in encs], np.int32))
+    xs, state0, _, _, _ = enc_mod.pad_batch(encs, mesh=mesh)
     return encs, xs, state0
 
 
@@ -523,19 +512,9 @@ def check_batch(model, histories, capacity: int = 512,
     C_max = max(e.n_slots for e in pre)
     if bitdense.fits_bitdense(S_max, C_max):
         return bitdense.check_batch_bitdense(pre, mesh=mesh)
-    encs, xs, state0 = encode_batch(model, histories, encs=pre)
+    encs, xs, state0 = encode_batch(model, histories, encs=pre, mesh=mesh)
     step_name = encs[0].step_name
-    K = len(encs)
     N = max(64, capacity)
-    if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        ax = mesh.axis_names[0]
-        n_dev = mesh.shape[ax]
-        if K % n_dev == 0:
-            xs = {k: jax.device_put(v, NamedSharding(
-                mesh, P(*((ax,) + (None,) * (v.ndim - 1)))))
-                for k, v in xs.items()}
-            state0 = jax.device_put(state0, NamedSharding(mesh, P(ax)))
     while True:
         valid, fail_r, overflow, maxf, steps_n = _check_device_batch(
             xs, state0, step_name, N)

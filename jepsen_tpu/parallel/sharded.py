@@ -193,12 +193,17 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
     # flatten whatever mesh we're given onto a 1-D mesh named AXIS
     mesh = Mesh(np.asarray(mesh.devices).reshape(-1), (AXIS,))
     n_dev = mesh.shape[AXIS]
-    xs = _xs_from_encoded(e)
+    # replicate inputs onto the mesh explicitly: nothing may be created
+    # on the default backend (it can be a broken TPU runtime while we
+    # deliberately run on a CPU mesh — the MULTICHIP_r01 crash mode)
+    rep = NamedSharding(mesh, P())
+    xs = _xs_from_encoded(e, device=rep)
+    state0 = jax.device_put(np.int32(e.state0), rep)
     N = max(64 * n_dev, capacity)
     while True:
         Nd = (N + n_dev - 1) // n_dev
         valid, fail_r, overflow, maxf = _check_sharded(
-            xs, jnp.int32(e.state0), e.step_name, Nd, n_dev, mesh)
+            xs, state0, e.step_name, Nd, n_dev, mesh)
         if not bool(overflow):
             break
         if N * 2 > max_capacity:
