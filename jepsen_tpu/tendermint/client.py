@@ -54,19 +54,27 @@ def validate_tx_code(code: int, log: str = ""):
 
 
 class SocketTransport:
-    """Direct connection to a native merkleeyes server."""
+    """Direct connection to a native merkleeyes server. Speaks the real
+    tendermint v0.34 ABCI socket protocol by default (proto="abci"),
+    so local integration runs exercise the same bytes a tendermint
+    node's --proxy_app link carries; proto="custom" selects the
+    server's legacy compact protocol."""
 
-    def __init__(self, address):
+    def __init__(self, address, proto: str = "abci"):
         self.address = address  # ("unix", path) | ("tcp", (host, port))
+        self.proto = proto
+
+    def _client(self):
+        return me.client_for(self.address, self.proto)
 
     def broadcast_tx(self, tx: bytes) -> me.TxResult:
-        with me.MerkleeyesClient(self.address) as cl:
+        with self._client() as cl:
             r = cl.tx_commit(tx)
         validate_tx_code(r.code, r.log)
         return r
 
     def abci_query(self, path: str, data: bytes) -> me.QueryResult:
-        with me.MerkleeyesClient(self.address) as cl:
+        with self._client() as cl:
             return cl.query(path, data)
 
 

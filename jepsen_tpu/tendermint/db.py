@@ -162,6 +162,9 @@ def start_merkleeyes(test, node) -> str:
              "pidfile": merkleeyes_pid(test), "chdir": base_dir(test)},
             "./merkleeyes/merkleeyes", "--listen",
             f"unix:{socket_file(test)}",
+            # the real tendermint binary drives --proxy_app over the
+            # v0.34 ABCI socket protocol (native/merkleeyes/src/abci.h)
+            "--proto", "abci",
             "--wal", base_dir(test) + "/jepsen/jepsen.db/000001.log")
     return "started"
 

@@ -68,6 +68,18 @@ class QueryResult:
         return self.code == OK
 
 
+def client_for(address, proto: str = "abci", timeout: float = 10.0):
+    """The client class for a session protocol, unconnected: "abci"
+    (tendermint v0.34 ABCI socket protocol) or "custom" (this build's
+    compact protocol). The single proto->client dispatch point."""
+    if proto == "abci":
+        from jepsen_tpu.tendermint.abci import AbciClient
+        return AbciClient(address, timeout=timeout)
+    if proto == "custom":
+        return MerkleeyesClient(address, timeout=timeout)
+    raise ValueError(f"unknown merkleeyes protocol {proto!r}")
+
+
 class MerkleeyesClient:
     """One framed-protocol session. Address: ('unix', path) or
     ('tcp', (host, port))."""
@@ -216,16 +228,22 @@ def build(force: bool = False) -> Path:
 
 @dataclass
 class LocalServer:
-    """A locally spawned merkleeyes process on a unix socket."""
+    """A locally spawned merkleeyes process on a unix socket.
+
+    proto selects the session protocol: "abci" (default — the real
+    tendermint v0.34 ABCI socket protocol, jepsen_tpu.tendermint.abci)
+    or "custom" (this build's original compact protocol)."""
 
     sock_path: str
     wal_path: Optional[str] = None
     proc: Optional[subprocess.Popen] = None
     extra_args: List[str] = field(default_factory=list)
+    proto: str = "abci"
 
     def start(self) -> "LocalServer":
         binary = build()
-        args = [str(binary), "--listen", f"unix:{self.sock_path}"]
+        args = [str(binary), "--listen", f"unix:{self.sock_path}",
+                "--proto", self.proto]
         if self.wal_path:
             args += ["--wal", self.wal_path]
         args += self.extra_args
@@ -235,7 +253,7 @@ class LocalServer:
         while time.monotonic() < deadline:
             if os.path.exists(self.sock_path):
                 try:
-                    with MerkleeyesClient(("unix", self.sock_path)) as cl:
+                    with self.client() as cl:
                         cl.echo(b"ping")
                     return self
                 except OSError:
@@ -256,8 +274,9 @@ class LocalServer:
                 self.proc.wait()
             self.proc = None
 
-    def client(self) -> MerkleeyesClient:
-        return MerkleeyesClient(("unix", self.sock_path)).connect()
+    def client(self):
+        """A connected client speaking this server's protocol."""
+        return client_for(("unix", self.sock_path), self.proto).connect()
 
     def __enter__(self):
         return self.start()

@@ -1,0 +1,258 @@
+// The tendermint v0.34 ABCI socket protocol, server side.
+//
+// This is the wire protocol a real tendermint binary speaks to its
+// --proxy_app (reference: merkleeyes/cmd/merkleeyes/main.go:26-57
+// serves the app via tendermint's abci/server over a unix socket; the
+// reference pins tendermint v0.34.1-dev1 in merkleeyes/go.mod). Framing
+// is uvarint-length-delimited protobuf: each message is
+//
+//     uvarint(len(body)) ∥ body
+//
+// where body is a `tendermint.abci.Request` / `Response` — a oneof
+// over the per-method messages. Field numbers below follow tendermint
+// v0.34 proto/tendermint/abci/types.proto.
+//
+// The handler maps each request onto the App (app.h), whose tx/query
+// semantics mirror the reference Go app (merkleeyes/app.go:95-217).
+// Responses carry exactly the fields the reference app sets; oneof
+// arms the app doesn't implement (set_option, snapshots) return the
+// BaseApplication empty response, and an unparseable request returns
+// ResponseException — the same contract tendermint's own server gives.
+#pragma once
+
+#include "app.h"
+#include "pb.h"
+
+namespace merkleeyes {
+namespace abci {
+
+// Request oneof field numbers (types.proto, tendermint v0.34).
+enum Req : uint32_t {
+  kReqEcho = 1,
+  kReqFlush = 2,
+  kReqInfo = 3,
+  kReqSetOption = 4,
+  kReqInitChain = 5,
+  kReqQuery = 6,
+  kReqBeginBlock = 7,
+  kReqCheckTx = 8,
+  kReqDeliverTx = 9,
+  kReqEndBlock = 10,
+  kReqCommit = 11,
+  kReqListSnapshots = 12,
+  kReqOfferSnapshot = 13,
+  kReqLoadSnapshotChunk = 14,
+  kReqApplySnapshotChunk = 15,
+};
+
+// Response oneof field numbers (exception first, then each method
+// shifted by one relative to Request).
+enum Resp : uint32_t {
+  kRespException = 1,
+  kRespEcho = 2,
+  kRespFlush = 3,
+  kRespInfo = 4,
+  kRespSetOption = 5,
+  kRespInitChain = 6,
+  kRespQuery = 7,
+  kRespBeginBlock = 8,
+  kRespCheckTx = 9,
+  kRespDeliverTx = 10,
+  kRespEndBlock = 11,
+  kRespCommit = 12,
+  kRespListSnapshots = 13,
+  kRespOfferSnapshot = 14,
+  kRespLoadSnapshotChunk = 15,
+  kRespApplySnapshotChunk = 16,
+};
+
+// version.ABCIVersion for tendermint v0.34.
+constexpr const char* kABCIVersion = "0.17.0";
+
+inline bytes wrap(uint32_t arm, const bytes& body) {
+  bytes out;
+  pb::msg_field(out, arm, body);
+  return out;
+}
+
+inline bytes exception(const std::string& err) {
+  bytes body;
+  pb::string_field(body, 1, err);
+  return wrap(kRespException, body);
+}
+
+// ResponseCheckTx / ResponseDeliverTx share the field layout
+// {code:1, data:2, log:3, ...}.
+inline bytes tx_response(uint32_t arm, const TxResult& r) {
+  bytes body;
+  pb::varint_field(body, 1, r.code);
+  pb::bytes_field(body, 2, r.data);
+  pb::string_field(body, 3, r.log);
+  return wrap(arm, body);
+}
+
+// ValidatorUpdate{pub_key:1 = PublicKey{ed25519:1}, power:2}.
+inline bytes validator_update(const bytes& pubkey, int64_t power) {
+  bytes pk;
+  pb::bytes_field(pk, 1, pubkey);  // PublicKey.ed25519
+  bytes vu;
+  pb::msg_field(vu, 1, pk);
+  pb::int64_field(vu, 2, power);
+  return vu;
+}
+
+// Parses one RequestInitChain's validators (field 4, repeated
+// ValidatorUpdate) into (ed25519-pubkey -> power).
+inline std::map<bytes, int64_t> parse_init_validators(pb::Reader req) {
+  std::map<bytes, int64_t> out;
+  uint32_t f, w;
+  while (req.next(f, w)) {
+    if (f != 4 || w != pb::kLen) {
+      req.skip(w);
+      continue;
+    }
+    pb::Reader vu = req.len_payload();
+    bytes pubkey;
+    int64_t power = 0;
+    uint32_t vf, vw;
+    while (vu.next(vf, vw)) {
+      if (vf == 1 && vw == pb::kLen) {
+        pb::Reader pk = vu.len_payload();
+        uint32_t pf, pw;
+        while (pk.next(pf, pw)) {
+          if (pf == 1 && pw == pb::kLen) pubkey = pk.len_bytes();
+          else pk.skip(pw);
+        }
+      } else if (vf == 2 && vw == pb::kVarint) {
+        power = int64_t(vu.varint());
+      } else {
+        vu.skip(vw);
+      }
+    }
+    if (!pubkey.empty()) out[pubkey] = power;
+  }
+  return out;
+}
+
+// Handles one Request frame body; returns the Response frame body.
+inline bytes handle(App& app, const bytes& req_body) {
+  pb::Reader outer(req_body);
+  uint32_t arm, wire;
+  if (!outer.next(arm, wire) || wire != pb::kLen)
+    return exception("malformed Request: no oneof arm");
+  pb::Reader req = outer.len_payload();
+  if (!outer.ok) return exception("malformed Request: bad length");
+
+  switch (arm) {
+    case kReqEcho: {
+      std::string msg;
+      uint32_t f, w;
+      while (req.next(f, w)) {
+        if (f == 1 && w == pb::kLen) msg = req.len_string();
+        else req.skip(w);
+      }
+      bytes body;
+      pb::string_field(body, 1, msg);
+      return wrap(kRespEcho, body);
+    }
+
+    case kReqFlush:
+      return wrap(kRespFlush, {});
+
+    case kReqInfo: {
+      auto [height, hash] = app.info();
+      bytes body;
+      pb::string_field(body, 2, kABCIVersion);  // version
+      pb::varint_field(body, 3, 1);             // app_version (app.go:97-102)
+      pb::int64_field(body, 4, height);         // last_block_height
+      pb::bytes_field(body, 5, hash);           // last_block_app_hash
+      return wrap(kRespInfo, body);
+    }
+
+    case kReqSetOption:
+      return wrap(kRespSetOption, {});
+
+    case kReqInitChain: {
+      bytes hash = app.init_chain(parse_init_validators(req));
+      bytes body;
+      pb::bytes_field(body, 3, hash);  // app_hash (app.go:105-113)
+      return wrap(kRespInitChain, body);
+    }
+
+    case kReqQuery: {
+      bytes data;
+      std::string path;
+      int64_t height = 0;
+      uint32_t f, w;
+      while (req.next(f, w)) {
+        if (f == 1 && w == pb::kLen) data = req.len_bytes();
+        else if (f == 2 && w == pb::kLen) path = req.len_string();
+        else if (f == 3 && w == pb::kVarint) height = int64_t(req.varint());
+        else req.skip(w);  // prove:4 — app.query rejects proofs itself
+      }
+      QueryResult q = app.query(path, data, height);
+      bytes body;
+      pb::varint_field(body, 1, q.code);
+      pb::string_field(body, 3, q.log);
+      // proto3 int64: unset and 0 coincide; negative "no index" stays
+      // off the wire like the reference's never-set Index field
+      if (q.index > 0) pb::int64_field(body, 5, q.index);
+      pb::bytes_field(body, 6, q.key);
+      pb::bytes_field(body, 7, q.value);
+      pb::int64_field(body, 9, q.height);
+      return wrap(kRespQuery, body);
+    }
+
+    case kReqBeginBlock:
+      app.begin_block();
+      return wrap(kRespBeginBlock, {});
+
+    case kReqCheckTx: {
+      bytes tx;
+      uint32_t f, w;
+      while (req.next(f, w)) {
+        if (f == 1 && w == pb::kLen) tx = req.len_bytes();
+        else req.skip(w);
+      }
+      return tx_response(kRespCheckTx, app.check_tx(tx));
+    }
+
+    case kReqDeliverTx: {
+      bytes tx;
+      uint32_t f, w;
+      while (req.next(f, w)) {
+        if (f == 1 && w == pb::kLen) tx = req.len_bytes();
+        else req.skip(w);
+      }
+      return tx_response(kRespDeliverTx, app.deliver_tx(tx));
+    }
+
+    case kReqEndBlock: {
+      bytes body;
+      for (const auto& [pk, power] : app.end_block())
+        pb::msg_field(body, 1, validator_update(pk, power));
+      return wrap(kRespEndBlock, body);
+    }
+
+    case kReqCommit: {
+      bytes body;
+      pb::bytes_field(body, 2, app.commit());  // data
+      return wrap(kRespCommit, body);
+    }
+
+    case kReqListSnapshots:
+      return wrap(kRespListSnapshots, {});
+    case kReqOfferSnapshot:
+      return wrap(kRespOfferSnapshot, {});
+    case kReqLoadSnapshotChunk:
+      return wrap(kRespLoadSnapshotChunk, {});
+    case kReqApplySnapshotChunk:
+      return wrap(kRespApplySnapshotChunk, {});
+
+    default:
+      return exception("unknown Request arm " + std::to_string(arm));
+  }
+}
+
+}  // namespace abci
+}  // namespace merkleeyes

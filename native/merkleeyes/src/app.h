@@ -21,7 +21,10 @@
 // self-contained and gives file truncation well-defined semantics.
 #pragma once
 
+#include <unistd.h>
+
 #include <cstdio>
+#include <cstdlib>
 #include <map>
 #include <string>
 
@@ -90,10 +93,13 @@ class App {
 
   TxResult deliver_tx(const bytes& tx) {  // app.go:129-131
     TxResult r = do_tx(tx);
-    if (r.code == OK || r.code == ErrBaseUnknownAddress ||
-        r.code == ErrUnauthorized || r.code == BadNonce) {
-      // Replayable outcomes mutate the nonce set (and maybe the tree):
-      // record them so WAL replay reproduces the exact same state.
+    // Record every tx whose nonce was newly marked — do_tx marks the
+    // nonce in the working tree before parsing args, so even txs that
+    // then fail to parse (EncodingError / unknown type) mutate state
+    // and must replay. Only the pre-nonce length check (too-short tx)
+    // and BadNonce (nonce already present, no set) leave the tree
+    // untouched.
+    if (tx.size() >= kMinTxLen && r.code != BadNonce) {
       block_.insert(block_.end(), tx.begin(), tx.end());
       block_frames_.push_back(tx.size());
     }
@@ -102,6 +108,18 @@ class App {
 
   void begin_block() {  // app.go:134-139
     changes_.clear();
+  }
+
+  // InitChain (app.go:105-113): installs the genesis validator set,
+  // returns the current app hash. pubkeys are raw ed25519. Persisted
+  // as its own WAL frame — the reference keeps its valset in the
+  // backing db (state.go aux state); without this a crash-restart
+  // would silently drop every genesis validator.
+  bytes init_chain(const std::map<bytes, int64_t>& validators) {
+    for (const auto& [pk, power] : validators) validators_[pk] = power;
+    append_init_chain_wal(validators);
+    auto h = committed_.hash();
+    return bytes(h.begin(), h.end());
   }
 
   // Returns the validator updates of this block (app.go:141-147).
@@ -328,24 +346,54 @@ class App {
   }
 
   // ---- WAL ----------------------------------------------------------
+  //
+  // frame   = uvarint(len) ∥ payload
+  // payload = tag ∥ rest, where
+  //   tag 0x00 (block): rest = n × (uvarint(txlen) ∥ tx) — one frame
+  //     per Commit, empty for empty blocks so replayed height matches;
+  //   tag 0x01 (init-chain): rest = n × (uvarint(pklen) ∥ pk ∥
+  //     varint(power)) — the genesis validator set from InitChain.
+  //
+  // Replay reproduces the exact pre-crash state: block frames re-run
+  // every recorded tx and then apply EndBlock's valset-version bump
+  // (otherwise a replayed ValSetCAS that succeeded pre-crash would be
+  // rejected against a stale version).
 
-  void append_wal() {
-    if (wal_path_.empty() || block_.empty()) return;
+  static constexpr uint8_t kWalBlock = 0x00;
+  static constexpr uint8_t kWalInitChain = 0x01;
+
+  void write_wal_frame(const bytes& payload) {
     FILE* f = std::fopen(wal_path_.c_str(), "ab");
     if (!f) return;
     bytes frame;
-    bytes payload;
+    put_uvarint(frame, payload.size());
+    frame.insert(frame.end(), payload.begin(), payload.end());
+    std::fwrite(frame.data(), 1, frame.size(), f);
+    std::fflush(f);
+    std::fclose(f);
+  }
+
+  void append_wal() {
+    if (wal_path_.empty()) return;
+    bytes payload{kWalBlock};
     for (size_t i = 0, off = 0; i < block_frames_.size(); i++) {
       put_uvarint(payload, block_frames_[i]);
       payload.insert(payload.end(), block_.begin() + off,
                      block_.begin() + off + block_frames_[i]);
       off += block_frames_[i];
     }
-    put_uvarint(frame, payload.size());
-    frame.insert(frame.end(), payload.begin(), payload.end());
-    std::fwrite(frame.data(), 1, frame.size(), f);
-    std::fflush(f);
-    std::fclose(f);
+    write_wal_frame(payload);
+  }
+
+  void append_init_chain_wal(const std::map<bytes, int64_t>& validators) {
+    if (wal_path_.empty()) return;
+    bytes payload{kWalInitChain};
+    for (const auto& [pk, power] : validators) {
+      put_uvarint(payload, pk.size());
+      payload.insert(payload.end(), pk.begin(), pk.end());
+      put_varint(payload, power);
+    }
+    write_wal_frame(payload);
   }
 
   void replay_wal() {
@@ -363,17 +411,49 @@ class App {
       auto [flen, c] = get_uvarint(data.data() + pos, data.size() - pos);
       if (c <= 0 || data.size() - pos - c < flen) break;  // partial: stop
       size_t p = pos + c, end = pos + c + flen;
-      while (p < end) {
-        auto [tlen, tc] = get_uvarint(data.data() + p, end - p);
-        if (tc <= 0 || end - p - tc < tlen) break;
-        bytes tx(data.begin() + p + tc, data.begin() + p + tc + tlen);
-        do_tx(tx);  // replay against the working tree
-        p += tc + tlen;
+      if (p == end) break;  // tagless empty frame: corrupt
+      uint8_t frame_tag = data[p++];
+      if (frame_tag == kWalInitChain) {
+        while (p < end) {
+          auto [klen, kc] = get_uvarint(data.data() + p, end - p);
+          if (kc <= 0 || end - p - kc < klen) break;
+          bytes pk(data.begin() + p + kc, data.begin() + p + kc + klen);
+          p += kc + klen;
+          auto [power, pc] = get_varint(data.data() + p, end - p);
+          if (pc <= 0) break;
+          p += pc;
+          validators_[pk] = power;
+        }
+      } else if (frame_tag == kWalBlock) {
+        changes_.clear();  // BeginBlock
+        while (p < end) {
+          auto [tlen, tc] = get_uvarint(data.data() + p, end - p);
+          if (tc <= 0 || end - p - tc < tlen) break;
+          bytes tx(data.begin() + p + tc, data.begin() + p + tc + tlen);
+          do_tx(tx);  // replay against the working tree
+          p += tc + tlen;
+        }
+        if (!changes_.empty()) valset_version_++;  // EndBlock
+        committed_ = working_;
+        height_++;
+      } else {
+        break;  // unknown frame type: stop at corruption
       }
-      committed_ = working_;
-      height_++;
       pos = end;
     }
+    if (pos < data.size()) {
+      // Drop the trailing partial/corrupt frame NOW: append_wal opens
+      // in "ab", so without this the next commit's frame would land
+      // after the garbage and a second restart would mis-parse the
+      // boundary (partial frame borrowing the next frame's bytes).
+      if (::truncate(wal_path_.c_str(), off_t(pos)) != 0) {
+        // Can't make the log safe to append to — refuse to run on it.
+        std::fprintf(stderr, "merkleeyes: cannot truncate corrupt WAL %s\n",
+                     wal_path_.c_str());
+        std::abort();
+      }
+    }
+    changes_.clear();
     block_.clear();
     block_frames_.clear();
   }

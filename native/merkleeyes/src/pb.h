@@ -1,0 +1,146 @@
+// Minimal protobuf wire-format primitives — just enough to speak the
+// tendermint v0.34 ABCI socket protocol (abci.h). Hand-rolled instead
+// of linking protoc output: the surface is ~15 message types with
+// scalar/bytes/submessage fields only, and the framework must build
+// with no vendored deps.
+//
+// Wire types: 0 varint, 1 fixed64, 2 length-delimited, 5 fixed32.
+// int64/uint64/uint32/bool/enum ride wire type 0 (two's-complement
+// varint, NOT zigzag — zigzag is only sint64, which ABCI doesn't use).
+#pragma once
+
+#include "wire.h"
+
+namespace merkleeyes {
+namespace pb {
+
+enum Wire : uint32_t {
+  kVarint = 0,
+  kFixed64 = 1,
+  kLen = 2,
+  kFixed32 = 5,
+};
+
+// ---- writing --------------------------------------------------------
+
+inline void tag(bytes& out, uint32_t field, uint32_t wire) {
+  put_uvarint(out, (uint64_t(field) << 3) | wire);
+}
+
+// Varint-typed field. proto3 omits zero-valued scalars; callers that
+// must preserve an explicit 0 skip the helper and emit the tag
+// themselves (ABCI never needs that).
+inline void varint_field(bytes& out, uint32_t field, uint64_t v) {
+  if (v == 0) return;
+  tag(out, field, kVarint);
+  put_uvarint(out, v);
+}
+
+inline void int64_field(bytes& out, uint32_t field, int64_t v) {
+  varint_field(out, field, uint64_t(v));  // two's complement
+}
+
+inline void bytes_field(bytes& out, uint32_t field, const bytes& b) {
+  if (b.empty()) return;
+  tag(out, field, kLen);
+  put_uvarint(out, b.size());
+  out.insert(out.end(), b.begin(), b.end());
+}
+
+inline void string_field(bytes& out, uint32_t field, const std::string& s) {
+  if (s.empty()) return;
+  tag(out, field, kLen);
+  put_uvarint(out, s.size());
+  out.insert(out.end(), s.begin(), s.end());
+}
+
+// Submessages are emitted even when empty: a present-but-empty member
+// is how a oneof arm (e.g. ResponseFlush) is distinguished from an
+// absent one.
+inline void msg_field(bytes& out, uint32_t field, const bytes& sub) {
+  tag(out, field, kLen);
+  put_uvarint(out, sub.size());
+  out.insert(out.end(), sub.begin(), sub.end());
+}
+
+// ---- reading --------------------------------------------------------
+
+struct Reader {
+  const uint8_t* p;
+  size_t n;
+  size_t pos = 0;
+  bool ok = true;
+
+  Reader(const uint8_t* p_, size_t n_) : p(p_), n(n_) {}
+  Reader(const bytes& b) : p(b.data()), n(b.size()) {}
+
+  bool done() const { return !ok || pos >= n; }
+
+  uint64_t varint() {
+    auto [v, c] = get_uvarint(p + pos, n - pos);
+    if (c <= 0) {
+      ok = false;
+      return 0;
+    }
+    pos += size_t(c);
+    return v;
+  }
+
+  // Reads the next tag; false at end of buffer or on error.
+  bool next(uint32_t& field, uint32_t& wire) {
+    if (done()) return false;
+    uint64_t t = varint();
+    if (!ok) return false;
+    field = uint32_t(t >> 3);
+    wire = uint32_t(t & 7);
+    return field != 0;
+  }
+
+  // Length-delimited payload as a sub-reader.
+  Reader len_payload() {
+    uint64_t len = varint();
+    if (!ok || n - pos < len) {
+      ok = false;
+      return Reader(p, 0);
+    }
+    Reader sub(p + pos, size_t(len));
+    pos += size_t(len);
+    return sub;
+  }
+
+  bytes len_bytes() {
+    Reader sub = len_payload();
+    if (!ok) return {};
+    return bytes(sub.p, sub.p + sub.n);
+  }
+
+  std::string len_string() {
+    Reader sub = len_payload();
+    if (!ok) return {};
+    return std::string(sub.p, sub.p + sub.n);
+  }
+
+  void skip(uint32_t wire) {
+    switch (wire) {
+      case kVarint:
+        varint();
+        break;
+      case kFixed64:
+        if (n - pos < 8) ok = false;
+        else pos += 8;
+        break;
+      case kLen:
+        len_payload();
+        break;
+      case kFixed32:
+        if (n - pos < 4) ok = false;
+        else pos += 4;
+        break;
+      default:
+        ok = false;
+    }
+  }
+};
+
+}  // namespace pb
+}  // namespace merkleeyes

@@ -1,23 +1,28 @@
 // merkleeyes server: serves the App over a unix or TCP socket.
 //
-// Capability parallel of the reference's ABCI socket server
-// (merkleeyes/cmd/merkleeyes/main.go:26-57, which listens on a unix
-// socket for tendermint). The session protocol is this build's own
-// minimal ABCI equivalent (documented in ../README.md):
+// Two session protocols behind the same uvarint-length framing,
+// selected by --proto:
 //
-//   request  = uvarint(len) ∥ msg-type ∥ body
-//   response = uvarint(len) ∥ msg-type ∥ fields
+//   --proto abci (DEFAULT) — the tendermint v0.34 ABCI socket
+//     protocol (varint-delimited protobuf Request/Response, abci.h).
+//     This is what a real tendermint binary speaks to its --proxy_app
+//     (reference: merkleeyes/cmd/merkleeyes/main.go:26-57) and what
+//     jepsen_tpu.tendermint.db deploys against.
 //
-// msg types: 0x10 Info, 0x11 CheckTx, 0x12 DeliverTx, 0x13 BeginBlock,
-//            0x14 EndBlock, 0x15 Commit, 0x16 Query, 0x17 Echo,
-//            0x18 Flush
+//   --proto custom — this build's own compact protocol (kept for the
+//     original test harness; documented in ../README.md):
+//       request  = uvarint(len) ∥ msg-type ∥ body
+//       response = uvarint(len) ∥ msg-type ∥ fields
+//     msg types: 0x10 Info, 0x11 CheckTx, 0x12 DeliverTx,
+//                0x13 BeginBlock, 0x14 EndBlock, 0x15 Commit,
+//                0x16 Query, 0x17 Echo, 0x18 Flush
 //
 // One worker thread per connection; the App is serialized behind a
 // mutex (tendermint drives ABCI from one connection, but the test
 // harness may open several).
 //
 // Usage: merkleeyes --listen unix:/tmp/me.sock [--wal /path/wal]
-//        merkleeyes --listen tcp:46658 [--wal /path/wal]
+//        merkleeyes --listen tcp:46658 [--proto abci|custom]
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -31,6 +36,7 @@
 #include <mutex>
 #include <thread>
 
+#include "abci.h"
 #include "app.h"
 
 namespace merkleeyes {
@@ -50,11 +56,18 @@ enum Msg : uint8_t {
 struct Server {
   App app;
   std::mutex mu;
+  bool abci_mode;
 
-  explicit Server(const std::string& wal) : app(wal) {}
+  explicit Server(const std::string& wal, bool abci = true)
+      : app(wal), abci_mode(abci) {}
 
   bytes handle(const bytes& req) {
     std::lock_guard<std::mutex> lock(mu);
+    if (abci_mode) return abci::handle(app, req);
+    return handle_custom(req);
+  }
+
+  bytes handle_custom(const bytes& req) {
     bytes resp;
     if (req.empty()) {
       resp.push_back(0x00);
@@ -233,13 +246,15 @@ int main(int argc, char** argv) {
   using namespace merkleeyes;
   std::string listen_spec = "unix:/tmp/merkleeyes.sock";
   std::string wal;
+  std::string proto = "abci";
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
     if (a == "--listen" && i + 1 < argc) listen_spec = argv[++i];
     else if (a == "--wal" && i + 1 < argc) wal = argv[++i];
+    else if (a == "--proto" && i + 1 < argc) proto = argv[++i];
     else if (a == "--help") {
       std::cout << "usage: merkleeyes --listen unix:PATH|tcp:PORT "
-                   "[--wal FILE]\n";
+                   "[--wal FILE] [--proto abci|custom]\n";
       return 0;
     } else {
       std::cerr << "unknown flag: " << a << " (see --help)\n";
@@ -263,8 +278,14 @@ int main(int argc, char** argv) {
     return 1;
   }
 
-  Server srv(wal);
-  std::cout << "merkleeyes listening on " << listen_spec << std::endl;
+  if (proto != "abci" && proto != "custom") {
+    std::cerr << "bad --proto (want abci|custom): " << proto << "\n";
+    return 1;
+  }
+
+  Server srv(wal, proto == "abci");
+  std::cout << "merkleeyes listening on " << listen_spec << " (" << proto
+            << ")" << std::endl;
   while (true) {
     int cfd = ::accept(lfd, nullptr, nullptr);
     if (cfd < 0) {

@@ -156,6 +156,50 @@ def test_wal_survives_restart(tmp_path):
             assert cl.query("/key", b"persist").value == b"yes"
 
 
+def test_wal_replays_malformed_tx_nonce(tmp_path):
+    """A tx that marks its nonce but then fails to parse (unknown type
+    byte, code 5) mutates the working tree; the WAL must record it so
+    replay reproduces the exact pre-crash state — including rejecting a
+    later reuse of that nonce."""
+    sock = str(tmp_path / "s.sock")
+    wal = str(tmp_path / "w.wal")
+    nonce = bytes(range(12))
+    bad_tx = nonce + bytes([0x99])  # unknown tx type
+    with me.LocalServer(sock_path=sock, wal_path=wal) as srv:
+        with srv.client() as cl:
+            cl.begin_block()
+            assert cl.deliver_tx(bad_tx).code == me.CODE_UNKNOWN_TX_TYPE
+            cl.end_block()
+            cl.commit()
+            h1, hash1 = cl.info()
+    with me.LocalServer(sock_path=sock, wal_path=wal) as srv:
+        with srv.client() as cl:
+            h2, hash2 = cl.info()
+            assert (h2, hash2) == (h1, hash1)
+            # the malformed tx's nonce survived the replay
+            r = cl.tx_commit(w.set_tx("x", "y", nonce_=nonce))
+            assert r.code == me.CODE_BAD_NONCE
+
+
+def test_wal_preserves_height_across_empty_blocks(tmp_path):
+    """Empty blocks bump the committed height; the WAL writes a frame
+    per commit so the replayed height matches the pre-crash value."""
+    sock = str(tmp_path / "s.sock")
+    wal = str(tmp_path / "w.wal")
+    with me.LocalServer(sock_path=sock, wal_path=wal) as srv:
+        with srv.client() as cl:
+            assert cl.tx_commit(w.set_tx("k", "v")).ok
+            for _ in range(3):  # three empty blocks
+                cl.begin_block()
+                cl.end_block()
+                cl.commit()
+            h1, hash1 = cl.info()
+            assert h1 == 4
+    with me.LocalServer(sock_path=sock, wal_path=wal) as srv:
+        with srv.client() as cl:
+            assert cl.info() == (h1, hash1)
+
+
 def test_wal_truncation_rolls_back_blocks(tmp_path):
     sock = str(tmp_path / "s.sock")
     wal = str(tmp_path / "w.wal")
@@ -171,6 +215,32 @@ def test_wal_truncation_rolls_back_blocks(tmp_path):
             assert cl.query("/key", b"a").value == b"1"
             assert cl.query("/key", b"b").code == \
                 me.CODE_BASE_UNKNOWN_ADDRESS
+
+
+def test_wal_truncate_then_commit_then_crash(tmp_path):
+    """The double-crash sequence the truncate nemesis drives: chop the
+    WAL mid-frame, restart, commit new blocks, restart again. The first
+    restart must drop the partial frame from the file — otherwise the
+    post-recovery frames land after garbage and the second replay
+    mis-parses the boundary."""
+    sock = str(tmp_path / "s.sock")
+    wal = str(tmp_path / "w.wal")
+    with me.LocalServer(sock_path=sock, wal_path=wal) as srv:
+        with srv.client() as cl:
+            assert cl.tx_commit(w.set_tx("a", "1")).ok
+            assert cl.tx_commit(w.set_tx("b", "2")).ok
+    data = open(wal, "rb").read()
+    open(wal, "wb").write(data[:-3])  # chop mid-frame
+    with me.LocalServer(sock_path=sock, wal_path=wal) as srv:
+        with srv.client() as cl:
+            assert cl.query("/key", b"b").code == me.CODE_BASE_UNKNOWN_ADDRESS
+            assert cl.tx_commit(w.set_tx("c", "3")).ok  # post-recovery commit
+            h1, hash1 = cl.info()
+    with me.LocalServer(sock_path=sock, wal_path=wal) as srv:
+        with srv.client() as cl:
+            assert cl.info() == (h1, hash1)
+            assert cl.query("/key", b"a").value == b"1"
+            assert cl.query("/key", b"c").value == b"3"
 
 
 def test_concurrent_clients(server):
