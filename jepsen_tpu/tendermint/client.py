@@ -191,7 +191,13 @@ def validator_set_cas(transport, version: int, pub_key_hex: str,
 
 def with_any_node(test, f, *args, transport_for=None):
     """Try f(transport, *args) against each node until one answers
-    (client.clj:198-210)."""
+    (client.clj:198-210).
+
+    A TxError raised after an earlier node failed with a network error
+    carries ``prior_indeterminate=True``: the earlier attempt may have
+    committed (e.g. a timeout after the tx landed), so the app-level
+    rejection is NOT proof the operation never happened — callers that
+    roll back on definite failures must check this flag."""
     from jepsen_tpu import generator as gen
     nodes = list(test.get("nodes") or [])
     gen.rand.shuffle(nodes)
@@ -203,6 +209,9 @@ def with_any_node(test, f, *args, transport_for=None):
             return f(transport_for(test, node), *args)
         except (ConnectionError, OSError, TimeoutError) as e:
             last = e
+        except TxError as e:
+            e.prior_indeterminate = last is not None
+            raise
     if last is not None:
         raise last
     return None

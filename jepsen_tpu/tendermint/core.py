@@ -301,12 +301,28 @@ class ChangingValidatorsNemesis(jnemesis.Nemesis):
         before = box[0]
         try:
             return self._invoke(test, op)
-        except Exception:
-            # Leave local state as it was: a failed request must not
-            # strand prospective validators (core.clj applies pre-step
-            # then the request; on a crash the op comes back :info and
-            # the next refresh reconciles — here we roll back eagerly).
+        except (tc.Unauthorized, tc.BaseUnknownAddress) as e:
+            if getattr(e, "prior_indeterminate", False):
+                # The rejection came after an earlier node's network
+                # failure — the change may have landed there (and the
+                # retry's CAS then lost against the new version). Not
+                # definite: fall through to the indeterminate handling.
+                raise
+            # Definite failure: the valset CAS was rejected by the app
+            # on the first attempt, so nothing changed on the cluster —
+            # roll the local config back to the pre-transition state.
             box[0] = before
+            raise
+        except Exception:
+            # Indeterminate (network error, timeout, node crash): the
+            # change MAY have been applied on the cluster. Keep the
+            # pre-step config — it retains the prospective validator so
+            # the next refresh_config can reconcile either outcome
+            # (core.clj leaves pre-step state in place for exactly this
+            # reason). Rolling back here would make a landed validator
+            # unrecognizable: validator_set_to_vote_map would raise on
+            # every later refresh and the transition generator would be
+            # stuck on a permanently stale config.
             raise
 
     def fs(self):

@@ -25,6 +25,7 @@
 
 #include <cstdio>
 #include <cstdlib>
+#include <cstring>
 #include <map>
 #include <string>
 
@@ -347,7 +348,8 @@ class App {
 
   // ---- WAL ----------------------------------------------------------
   //
-  // frame   = uvarint(len) ∥ payload
+  // file    = "MEW1" ∥ frames
+  // frame   = uvarint(len) ∥ payload ∥ crc32le(payload)
   // payload = tag ∥ rest, where
   //   tag 0x00 (block): rest = n × (uvarint(txlen) ∥ tx) — one frame
   //     per Commit, empty for empty blocks so replayed height matches;
@@ -361,16 +363,33 @@ class App {
 
   static constexpr uint8_t kWalBlock = 0x00;
   static constexpr uint8_t kWalInitChain = 0x01;
+  // File magic: lets replay tell "not a MEW1 WAL" (refuse to run)
+  // apart from "empty/new file" (start fresh) — without it a foreign
+  // or corrupt file would be silently wiped.
+  static constexpr const char* kWalMagic = "MEW1";
 
+  // frame on disk = uvarint(len(payload)) ∥ payload ∥ crc32le(payload)
   void write_wal_frame(const bytes& payload) {
     FILE* f = std::fopen(wal_path_.c_str(), "ab");
     if (!f) return;
+    std::fseek(f, 0, SEEK_END);
+    if (std::ftell(f) == 0) std::fwrite(kWalMagic, 1, 4, f);
     bytes frame;
     put_uvarint(frame, payload.size());
     frame.insert(frame.end(), payload.begin(), payload.end());
+    uint32_t c = crc32(payload.data(), payload.size());
+    for (int i = 0; i < 4; i++) frame.push_back((c >> (8 * i)) & 0xFF);
     std::fwrite(frame.data(), 1, frame.size(), f);
     std::fflush(f);
     std::fclose(f);
+  }
+
+  [[noreturn]] void wal_corrupt(const char* what) {
+    std::fprintf(stderr,
+                 "merkleeyes: WAL %s is corrupt (%s) — refusing to run; "
+                 "move the file aside to start fresh\n",
+                 wal_path_.c_str(), what);
+    std::abort();
   }
 
   void append_wal() {
@@ -396,6 +415,15 @@ class App {
     write_wal_frame(payload);
   }
 
+  // Replays the WAL. Two failure shapes are told apart:
+  //   * a *partial final frame* — a length underrun at the tail, the
+  //     exact shape `truncate -c -s -N` (the truncate nemesis) and
+  //     crashes mid-append produce — is silently dropped: the file is
+  //     truncated back to the last complete frame so later appends
+  //     never land after garbage;
+  //   * anything else (wrong magic, unknown frame tag, malformed frame
+  //     interior) is corruption — refuse to run rather than silently
+  //     discard committed history.
   void replay_wal() {
     FILE* f = std::fopen(wal_path_.c_str(), "rb");
     if (!f) return;
@@ -406,21 +434,46 @@ class App {
       data.insert(data.end(), buf, buf + n);
     std::fclose(f);
 
-    size_t pos = 0;
+    if (data.empty()) return;
+    if (data.size() < 4 ||
+        std::memcmp(data.data(), kWalMagic, 4) != 0) {
+      // A <4-byte prefix of the magic = crash during the very first
+      // write; safe to start over. Anything else is not our WAL.
+      if (data.size() < 4 &&
+          std::memcmp(data.data(), kWalMagic, data.size()) == 0) {
+        if (::truncate(wal_path_.c_str(), 0) != 0)
+          wal_corrupt("cannot truncate partial magic");
+        return;
+      }
+      wal_corrupt("bad magic");
+    }
+
+    size_t pos = 4;
     while (pos < data.size()) {
       auto [flen, c] = get_uvarint(data.data() + pos, data.size() - pos);
-      if (c <= 0 || data.size() - pos - c < flen) break;  // partial: stop
+      // partial tail: length underrun (frame + its crc don't fit)
+      if (c <= 0 || data.size() - pos - c < flen + 4) break;
       size_t p = pos + c, end = pos + c + flen;
-      if (p == end) break;  // tagless empty frame: corrupt
+      uint32_t want = 0;
+      for (int i = 0; i < 4; i++)
+        want |= uint32_t(data[end + i]) << (8 * i);
+      if (crc32(data.data() + p, flen) != want) {
+        // Bad checksum on the FINAL frame = torn write: drop it like a
+        // partial frame. On an interior frame = real corruption.
+        if (end + 4 == data.size()) break;
+        wal_corrupt("frame checksum mismatch");
+      }
+      if (p == end) wal_corrupt("tagless empty frame");
       uint8_t frame_tag = data[p++];
       if (frame_tag == kWalInitChain) {
         while (p < end) {
           auto [klen, kc] = get_uvarint(data.data() + p, end - p);
-          if (kc <= 0 || end - p - kc < klen) break;
+          if (kc <= 0 || end - p - kc < klen)
+            wal_corrupt("malformed init-chain frame");
           bytes pk(data.begin() + p + kc, data.begin() + p + kc + klen);
           p += kc + klen;
           auto [power, pc] = get_varint(data.data() + p, end - p);
-          if (pc <= 0) break;
+          if (pc <= 0) wal_corrupt("malformed init-chain power");
           p += pc;
           validators_[pk] = power;
         }
@@ -428,7 +481,8 @@ class App {
         changes_.clear();  // BeginBlock
         while (p < end) {
           auto [tlen, tc] = get_uvarint(data.data() + p, end - p);
-          if (tc <= 0 || end - p - tc < tlen) break;
+          if (tc <= 0 || end - p - tc < tlen)
+            wal_corrupt("malformed block frame");
           bytes tx(data.begin() + p + tc, data.begin() + p + tc + tlen);
           do_tx(tx);  // replay against the working tree
           p += tc + tlen;
@@ -437,21 +491,17 @@ class App {
         committed_ = working_;
         height_++;
       } else {
-        break;  // unknown frame type: stop at corruption
+        wal_corrupt("unknown frame tag");
       }
-      pos = end;
+      pos = end + 4;  // skip the crc
     }
     if (pos < data.size()) {
-      // Drop the trailing partial/corrupt frame NOW: append_wal opens
-      // in "ab", so without this the next commit's frame would land
-      // after the garbage and a second restart would mis-parse the
+      // Drop the partial final frame NOW: append_wal opens in "ab", so
+      // without this the next commit's frame would land after the
+      // partial bytes and a second restart would mis-parse the
       // boundary (partial frame borrowing the next frame's bytes).
-      if (::truncate(wal_path_.c_str(), off_t(pos)) != 0) {
-        // Can't make the log safe to append to — refuse to run on it.
-        std::fprintf(stderr, "merkleeyes: cannot truncate corrupt WAL %s\n",
-                     wal_path_.c_str());
-        std::abort();
-      }
+      if (::truncate(wal_path_.c_str(), off_t(pos)) != 0)
+        wal_corrupt("cannot truncate partial final frame");
     }
     changes_.clear();
     block_.clear();

@@ -71,6 +71,25 @@ inline std::optional<uint64_t> get_u64be(const uint8_t* p, size_t n) {
   return v;
 }
 
+// CRC-32 (IEEE, the zlib/Go hash/crc32 polynomial) — WAL frame
+// integrity. Table built on first use.
+inline uint32_t crc32(const uint8_t* p, size_t n) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) c = table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
 inline void put_bytes(bytes& out, const bytes& b) {
   put_uvarint(out, b.size());
   out.insert(out.end(), b.begin(), b.end());

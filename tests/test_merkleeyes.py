@@ -217,6 +217,31 @@ def test_wal_truncation_rolls_back_blocks(tmp_path):
                 me.CODE_BASE_UNKNOWN_ADDRESS
 
 
+def test_wal_mid_file_corruption_refuses_to_run(tmp_path):
+    """A bit flip inside a committed frame is corruption, not the
+    nemesis's tail truncation — the server must refuse to run rather
+    than silently discard committed history."""
+    sock = str(tmp_path / "s.sock")
+    wal = str(tmp_path / "w.wal")
+    with me.LocalServer(sock_path=sock, wal_path=wal) as srv:
+        with srv.client() as cl:
+            assert cl.tx_commit(w.set_tx("a", "1")).ok
+            assert cl.tx_commit(w.set_tx("b", "2")).ok
+    data = bytearray(open(wal, "rb").read())
+    data[6] ^= 0xFF  # flip a byte inside the first frame
+    open(wal, "wb").write(bytes(data))
+    with pytest.raises(RuntimeError, match="exited"):
+        me.LocalServer(sock_path=sock, wal_path=wal).start()
+
+
+def test_wal_foreign_file_refuses_to_run(tmp_path):
+    sock = str(tmp_path / "s.sock")
+    wal = str(tmp_path / "w.wal")
+    open(wal, "wb").write(b"this is not a merkleeyes wal")
+    with pytest.raises(RuntimeError, match="exited"):
+        me.LocalServer(sock_path=sock, wal_path=wal).start()
+
+
 def test_wal_truncate_then_commit_then_crash(tmp_path):
     """The double-crash sequence the truncate nemesis drives: chop the
     WAL mid-frame, restart, commit new blocks, restart again. The first

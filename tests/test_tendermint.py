@@ -63,6 +63,103 @@ def _test_map(nodes=("n1", "n2", "n3", "n4", "n5"), **kw):
     return {"nodes": list(nodes), **kw}
 
 
+def _add_transition(cfg):
+    v = tv.gen_validator()
+    return {"type": "add", "version": cfg["version"], "validator": v}
+
+
+def test_changing_validators_rollback_on_definite_failure(monkeypatch):
+    """An Unauthorized valset CAS definitely did not apply: the local
+    config must roll back (no stranded prospective validator)."""
+    from jepsen_tpu.tendermint import core as tcore
+    cfg = tv.initial_config(_test_map())
+    test = {"nodes": _test_map()["nodes"], "validator_config": [cfg]}
+    t = _add_transition(cfg)
+
+    def boom_cas(*a, **k):
+        raise tcore.tc.Unauthorized(8, "version mismatch")
+    monkeypatch.setattr(tcore.tc, "with_any_node",
+                        lambda test_, fn, *a: boom_cas())
+    nem = tcore.ChangingValidatorsNemesis()
+    with pytest.raises(tcore.tc.Unauthorized):
+        nem.invoke(test, {"type": "info", "f": "transition", "value": t})
+    assert test["validator_config"][0] is cfg  # rolled back
+    assert t["validator"]["pub_key"] not in \
+        cfg["prospective_validators"]
+
+
+def test_with_any_node_flags_prior_indeterminate():
+    """A TxError raised after another node's network failure carries
+    prior_indeterminate=True — the failed attempt may have committed,
+    so the app-level rejection is not proof nothing happened."""
+    calls = []
+
+    def transport_for(test, node):
+        return node
+
+    def cas(node, *args):
+        calls.append(node)
+        if len(calls) == 1:
+            raise OSError("timeout after send")
+        raise tc.Unauthorized(8, "version mismatch")
+
+    test = {"nodes": ["n1", "n2"], "transport_for": transport_for}
+    with pytest.raises(tc.Unauthorized) as ei:
+        tc.with_any_node(test, cas)
+    assert ei.value.prior_indeterminate is True
+
+    # first-attempt rejection: definitively nothing happened
+    calls.clear()
+
+    def cas2(node, *args):
+        raise tc.Unauthorized(8, "version mismatch")
+
+    with pytest.raises(tc.Unauthorized) as ei:
+        tc.with_any_node(test, cas2)
+    assert ei.value.prior_indeterminate is False
+
+
+def test_changing_validators_keeps_prospective_on_tainted_unauthorized(
+        monkeypatch):
+    """Unauthorized AFTER a swallowed indeterminate attempt must not
+    roll back — the change may have landed via the earlier node."""
+    from jepsen_tpu.tendermint import core as tcore
+    cfg = tv.initial_config(_test_map())
+    test = {"nodes": _test_map()["nodes"], "validator_config": [cfg]}
+    t = _add_transition(cfg)
+
+    def tainted(*a, **k):
+        e = tcore.tc.Unauthorized(8, "version mismatch")
+        e.prior_indeterminate = True
+        raise e
+    monkeypatch.setattr(tcore.tc, "with_any_node", tainted)
+    nem = tcore.ChangingValidatorsNemesis()
+    with pytest.raises(tcore.tc.Unauthorized):
+        nem.invoke(test, {"type": "info", "f": "transition", "value": t})
+    after = test["validator_config"][0]
+    assert t["validator"]["pub_key"] in after["prospective_validators"]
+
+
+def test_changing_validators_keeps_prospective_on_indeterminate(monkeypatch):
+    """A network error is indeterminate — the change may have landed on
+    the cluster. The pre-step config (prospective validator retained)
+    must survive so refresh_config can reconcile either outcome; an
+    eager rollback would make a landed validator unrecognizable."""
+    from jepsen_tpu.tendermint import core as tcore
+    cfg = tv.initial_config(_test_map())
+    test = {"nodes": _test_map()["nodes"], "validator_config": [cfg]}
+    t = _add_transition(cfg)
+
+    monkeypatch.setattr(
+        tcore.tc, "with_any_node",
+        lambda *a, **k: (_ for _ in ()).throw(OSError("conn reset")))
+    nem = tcore.ChangingValidatorsNemesis()
+    with pytest.raises(OSError):
+        nem.invoke(test, {"type": "info", "f": "transition", "value": t})
+    after = test["validator_config"][0]
+    assert t["validator"]["pub_key"] in after["prospective_validators"]
+
+
 def test_initial_config_plain():
     cfg = tv.initial_config(_test_map())
     assert len(cfg["validators"]) == 5
