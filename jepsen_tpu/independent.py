@@ -17,12 +17,15 @@ of bounded-pmap'd host processes.
 
 from __future__ import annotations
 
+import logging
 from typing import Callable, Iterable, Optional, Sequence
 
 from jepsen_tpu import generator as gen
 from jepsen_tpu.checker.core import Checker, check_safe, merge_valid
 from jepsen_tpu.history import History, Op
 from jepsen_tpu.util import bounded_pmap
+
+log = logging.getLogger(__name__)
 
 DIR = "independent"  # results subdirectory (independent.clj:17-19)
 
@@ -300,7 +303,7 @@ class IndependentChecker(Checker):
         subs = split_history(history)
         ks = list(subs)
 
-        results = self._batched_device_results(test, subs)
+        results, fallback = self._batched_device_results(test, subs)
         if results is None:
             pairs = bounded_pmap(
                 lambda k: (k, check_safe(
@@ -316,33 +319,48 @@ class IndependentChecker(Checker):
         # only proven-invalid keys; "unknown" (e.g. a crashed per-key
         # checker) is not a failure (independent.clj:305-311)
         failures = [k for k, r in results.items() if r.get("valid?") is False]
-        return {
+        out = {
             "valid?": merge_valid(r.get("valid?") for r in results.values()),
             "results": results,
             "failures": failures,
         }
+        if fallback is not None:
+            out["device-fallback"] = fallback
+        return out
 
     # -- device batch fast path
-    def _batched_device_results(self, test, subs) -> Optional[dict]:
+    def _batched_device_results(self, test, subs):
+        """(results, fallback-reason): results is None when the host
+        per-key path should run. A None fallback-reason means the
+        device path was simply not applicable (non-device checker,
+        unpackable model); a string means the device path was attempted
+        and FAILED — that is a loud event (warning + result tag), since
+        silently degrading to the host checker would hide a TPU
+        regression behind a 100-300x slowdown."""
         from jepsen_tpu.checker.linearizable import Linearizable
         c = self.checker
         if not (self.batch_device and isinstance(c, Linearizable)
                 and c.algorithm in ("jax", "competition") and subs):
-            return None
+            return None, None
         model = c.model or (test or {}).get("model")
         if model is None:
-            return None
+            return None, None
+        from jepsen_tpu import models as model_ns
+        from jepsen_tpu.history import Intern
+        from jepsen_tpu.parallel import engine
+        if model_ns.pack_spec(model, Intern()) is None:
+            return None, None
         try:
-            from jepsen_tpu import models as model_ns
-            from jepsen_tpu.history import Intern
-            from jepsen_tpu.parallel import engine
-            if model_ns.pack_spec(model, Intern()) is None:
-                return None
             ks = list(subs)
             rs = engine.check_batch(model, [subs[k] for k in ks])
-            return {k: {**r, "analyzer": "jax"} for k, r in zip(ks, rs)}
-        except Exception:  # noqa: BLE001 - fall back to host per-key path
-            return None
+            return {k: {**r, "analyzer": "jax"} for k, r in zip(ks, rs)}, None
+        except Exception as err:  # noqa: BLE001 - host path still checks
+            reason = f"{type(err).__name__}: {err}"
+            log.warning(
+                "device batch check FAILED (%s) — falling back to the "
+                "host per-key checker; results will be correct but the "
+                "TPU path is broken", reason)
+            return None, reason
 
     # -- results/history persistence per key (independent.clj:292-300)
     def _persist(self, test, opts, subs, results):

@@ -257,6 +257,10 @@ class PackedSpec:
     # interned value codes with nil = -1, mutex uses {0, 1}
     state_lo: int = -1
     n_states: Callable = None  # (intern) -> int
+    # (packed state, intern) -> Model instance at that state — lets the
+    # host seed a re-search from a device frontier checkpoint
+    # (counterexample extraction for long histories)
+    unpack_state: Callable = None
 
 
 def pack_spec(model: Model, intern) -> Optional[PackedSpec]:
@@ -290,6 +294,7 @@ def pack_spec(model: Model, intern) -> Optional[PackedSpec]:
                 return (F_CAS, intern.code(old), intern.code(new), False)
             raise ValueError(f"register family: unknown f {f!r}")
 
+        cls = type(model)
         return PackedSpec(
             state0=state0,
             step_name="register",
@@ -297,6 +302,7 @@ def pack_spec(model: Model, intern) -> Optional[PackedSpec]:
             f_codes={"read": F_READ, "write": F_WRITE, "cas": F_CAS},
             state_lo=-1,
             n_states=lambda intern: len(intern) + 1,
+            unpack_state=lambda code, intern: cls(intern.value(code)),
         )
 
     if isinstance(model, Mutex):
@@ -314,6 +320,7 @@ def pack_spec(model: Model, intern) -> Optional[PackedSpec]:
             f_codes={"acquire": F_ACQUIRE, "release": F_RELEASE},
             state_lo=0,
             n_states=lambda intern: 2,
+            unpack_state=lambda code, intern: Mutex(bool(code)),
         )
 
     return None

@@ -437,6 +437,10 @@ def analysis(model, history, capacity: int = 1024) -> dict:
         e = enc_mod.encode(model, h)
     except EncodeError as err:
         from jepsen_tpu.checker import wgl
+        import logging
+        logging.getLogger(__name__).warning(
+            "history not device-checkable (%s) — using the host WGL "
+            "engine; expect it to be orders of magnitude slower", err)
         r = wgl.analysis(model, h)
         r["fallback"] = str(err)
         return r
@@ -445,25 +449,152 @@ def analysis(model, history, capacity: int = 1024) -> dict:
         r = bitdense.check_encoded_bitdense(e)
     else:
         r = check_encoded(e, capacity=capacity)
-    if r["valid?"] is False and e.n_calls <= 500:
-        from jepsen_tpu.checker import wgl
-        fail_idx = e.calls[int(e.ret_call[r["fail-event"]])].complete_index
-        host = wgl.check_calls(model, _prefix_calls(e.calls, fail_idx),
-                               fail_idx + 1)
-        if host.get("valid?") is False:
-            r["final-paths"] = host.get("final-paths", [])
-            r["configs"] = host.get("configs", [])
+    if r["valid?"] is False:
+        r.update(extract_final_paths(model, e, int(r["fail-event"])))
     return r
 
 
-def _prefix_calls(cs, fail_idx):
-    """Calls restricted to the failing prefix: everything invoked up to
-    fail_idx, with completions after it treated as still-open (crashed)."""
+# --------------------------------------- counterexample extraction
+
+# Host re-search window for long histories: events before the failure
+# covered by the seeded re-search (the reference emits full paths but
+# truncates to 10 — checker.clj:203-213; for histories the host could
+# never search whole, a window ending at the failure is the useful part)
+PATHS_WINDOW_EVENTS = 64
+PATHS_MAX_SEEDS = 8
+
+
+def extract_final_paths(model, e: EncodedHistory, fail_r: int,
+                        window: int = PATHS_WINDOW_EVENTS,
+                        max_seeds: int = PATHS_MAX_SEEDS) -> dict:
+    """knossos-style :final-paths / :configs for a failing return event.
+
+    Short histories (<= 500 calls) re-search the whole failing prefix on
+    the host. Longer ones re-run the device scan up to a checkpoint
+    `window` return-events before the failure, decode the frontier into
+    (model state, linearized-open-calls) seeds, and host-search only the
+    window from each seed — exact counterexamples at any history length,
+    with the device doing the long prefix."""
+    from jepsen_tpu.checker import wgl
+    fail_idx = e.calls[int(e.ret_call[fail_r])].complete_index
+    if e.n_calls <= 500:
+        host = wgl.check_calls(model, _prefix_calls(e.calls, fail_idx),
+                               fail_idx + 1)
+        if host.get("valid?") is False:
+            return {"final-paths": host.get("final-paths", []),
+                    "configs": host.get("configs", [])}
+        return {}
+
+    from jepsen_tpu import models as model_ns
+    spec = model_ns.pack_spec(model, e.intern)
+    if spec is None or spec.unpack_state is None:
+        return {}
+    start_ev = max(0, fail_r - window)
+    if start_ev == 0:
+        seeds = [(e.state0, frozenset())]
+        occupants: dict = {}
+    else:
+        rows = _frontier_at(e, start_ev)
+        if rows is None:
+            return {}
+        occupants = _slot_occupants_before(e, start_ev)
+        seeds = []
+        for stc, ml, mh in rows[:max_seeds]:
+            mask = ml | (mh << 32)
+            seeds.append((stc, frozenset(
+                cid for s, cid in occupants.items() if (mask >> s) & 1)))
+
+    boundary = (e.calls[int(e.ret_call[start_ev])].complete_index
+                if start_ev > 0 else -1)
+    paths: list = []
+    configs: list = []
+    for stc, linearized in seeds:
+        seed_model = spec.unpack_state(stc, e.intern)
+        cs = _window_calls(e.calls, boundary, fail_idx, linearized)
+        host = wgl.check_calls(seed_model, cs, fail_idx + 1)
+        if host.get("valid?") is False:
+            paths.extend(host.get("final-paths", []))
+            configs.extend(host.get("configs", []))
+        if len(paths) >= 10:
+            break
+    out = {"final-paths": paths[:10], "configs": configs[:10]}
+    if start_ev > 0:
+        # paths cover the failure window only; the device verified the
+        # prefix and supplied the seed states
+        out["final-paths-window"] = [start_ev, fail_r]
+    return out
+
+
+def _frontier_at(e: EncodedHistory, start_ev: int):
+    """Re-run the device scan over return events [0, start_ev) and pull
+    the live frontier rows to host as (state, mask_lo, mask_hi)."""
+    xs_np = {
+        "slot_f": e.slot_f[:start_ev], "slot_a0": e.slot_a0[:start_ev],
+        "slot_a1": e.slot_a1[:start_ev], "slot_wild": e.slot_wild[:start_ev],
+        "slot_occ": e.slot_occ[:start_ev], "ev_slot": e.ev_slot[:start_ev],
+    }
+    chunk = {k: jnp.asarray(v) for k, v in xs_np.items()}
+    N = 1024
+    while True:
+        carry0 = _initial_carry(jnp.int32(e.state0), N)
+        carry, overflow = _check_device_resumable(
+            chunk, carry0, e.step_name, N)
+        if not bool(overflow):
+            break
+        if N * 2 > (1 << 20):
+            return None
+        N *= 2
+    st, ml, mh, live = [np.asarray(x) for x in carry[:4]]
+    idx = np.nonzero(live)[0]
+    return [(int(st[i]), int(ml[i]), int(mh[i])) for i in idx]
+
+
+def _slot_occupants_before(e: EncodedHistory, r_target: int) -> dict:
+    """slot -> call id of the snapshot taken just before return event
+    r_target — the same walk encode() performs (same heap discipline,
+    so slot numbers match the device masks)."""
+    import heapq
+    events = []
+    for c in e.calls:
+        events.append((c.invoke_index, 0, c.index))
+        if not c.crashed:
+            events.append((c.complete_index, 1, c.index))
+    events.sort()
+    free: list = []
+    n_slots = 0
+    slot_of: dict = {}
+    occ: dict = {}
+    r = 0
+    for _, kind, cid in events:
+        if kind == 0:
+            s = heapq.heappop(free) if free else n_slots
+            if s == n_slots:
+                n_slots += 1
+            slot_of[cid] = s
+            occ[s] = cid
+        else:
+            if r == r_target:
+                return dict(occ)
+            s = slot_of[cid]
+            del occ[s]
+            heapq.heappush(free, s)
+            r += 1
+    return dict(occ)
+
+
+def _window_calls(cs, boundary: int, fail_idx: int, linearized):
+    """Calls active in the window (boundary, fail_idx]: drops calls
+    fully completed before the boundary and calls the seed already
+    linearized; clamps completions past fail_idx to still-open."""
     from jepsen_tpu.history import Call
     out = []
     for c in cs:
         if c.invoke_index > fail_idx:
             continue
+        if (not c.crashed) and c.complete_index < boundary:
+            continue  # returned before the window: effect is in the seed
+        if c.index in linearized:
+            continue  # already applied in the seed state
         if c.complete_index > fail_idx:
             c2 = Call(c.index, c.process, c.f, c.value, None,
                       c.invoke_index, fail_idx + 1, True)
@@ -474,6 +605,12 @@ def _prefix_calls(cs, fail_idx):
     for j, c in enumerate(out):
         c.index = j
     return out
+
+
+def _prefix_calls(cs, fail_idx):
+    """Calls restricted to the failing prefix: everything invoked up to
+    fail_idx, with completions after it treated as still-open (crashed)."""
+    return _window_calls(cs, -1, fail_idx, frozenset())
 
 
 # ----------------------------------------------------- batched (per-key)

@@ -123,6 +123,56 @@ def test_engine_empty():
     assert engine.analysis(Register(), _h())["valid?"] is True
 
 
+def _long_invalid_history(n_ops):
+    """A long valid cas-register history with an impossible read
+    appended at the end — the failure is in the last few events."""
+    from jepsen_tpu.histories import rand_register_history
+    h = rand_register_history(n_ops=n_ops, n_processes=4, crash_p=0.0,
+                              fail_p=0.0, n_values=4, seed=7)
+    ops = [dict(o) for o in h]
+    t = ops[-1]["time"] + 1
+    i = ops[-1]["index"] + 1
+    ops += [{"index": i, "time": t, "process": 97, "type": "invoke",
+             "f": "read", "value": None},
+            {"index": i + 1, "time": t + 1, "process": 97, "type": "ok",
+             "f": "read", "value": "never-written"}]
+    return _h(*ops)
+
+
+@pytest.mark.slow
+def test_counterexample_extraction_long_history():
+    """Past the 500-call whole-prefix limit the engine seeds a host
+    window re-search from a device frontier checkpoint — a failing
+    10k-op history still yields final-paths (the reference always
+    produces them, checker.clj:203-213)."""
+    from jepsen_tpu.models import CASRegister
+    h = _long_invalid_history(10_000)
+    r = engine.analysis(CASRegister(), h)
+    assert r["valid?"] is False
+    assert r["op"]["value"] == "never-written"
+    assert r["final-paths"]
+    # the windowed (device-seeded) path ran, not the whole-prefix one
+    assert r["final-paths-window"][1] == r["fail-event"]
+    for path in r["final-paths"]:
+        assert path, "empty path"
+
+
+def test_window_calls_drops_past_and_linearized():
+    from jepsen_tpu.history import Call
+    cs = [
+        Call(0, 0, "write", 1, None, 0, 1, False),    # before window
+        Call(1, 1, "write", 2, None, 2, 10, False),   # spans boundary
+        Call(2, 2, "read", None, 2, 5, 9, False),     # in window
+        Call(3, 3, "write", 3, None, 6, 20, False),   # completes past fail
+    ]
+    out = engine._window_calls(cs, boundary=4, fail_idx=12,
+                               linearized=frozenset([1]))
+    ids = [(c.process, c.crashed) for c in out]
+    # call 0 dropped (past), call 1 dropped (linearized), call 3 clamped
+    assert ids == [(2, False), (3, True)]
+    assert out[0].index == 0 and out[1].index == 1  # renumbered
+
+
 # ----------------------------------------------------------- differential
 
 

@@ -96,6 +96,36 @@ def test_independent_checker_device_batch():
     assert r["results"]["x"]["analyzer"] == "jax"
 
 
+def test_device_batch_failure_is_loud(monkeypatch, caplog):
+    """A broken device path must not silently degrade to the host
+    checker: the result carries a device-fallback tag and a warning is
+    logged (the host still produces correct per-key results)."""
+    import logging
+
+    from jepsen_tpu.parallel import engine
+
+    def boom(*a, **k):
+        raise RuntimeError("simulated TPU runtime breakage")
+
+    monkeypatch.setattr(engine, "check_batch", boom)
+    c = independent.checker(linearizable(CASRegister(), algorithm="jax"))
+    with caplog.at_level(logging.WARNING, logger="jepsen_tpu.independent"):
+        r = c.check({}, _keyed_register_history())
+    assert r["valid?"] is False          # host path still checked keys
+    assert r["failures"] == ["y"]
+    assert "simulated TPU runtime breakage" in r["device-fallback"]
+    assert any("FAILED" in rec.message for rec in caplog.records)
+
+
+def test_device_batch_not_applicable_is_quiet():
+    """A host-only checker never gets the fallback tag — 'not
+    applicable' is not a failure."""
+    c = independent.checker(linearizable(CASRegister(), algorithm="wgl"))
+    r = c.check({}, _keyed_register_history())
+    assert r["valid?"] is False
+    assert "device-fallback" not in r
+
+
 def test_independent_checker_plain_fn():
     seen = []
 
