@@ -348,7 +348,11 @@ class IndependentChecker(Checker):
         from jepsen_tpu import models as model_ns
         from jepsen_tpu.history import Intern
         from jepsen_tpu.parallel import engine
-        if model_ns.pack_spec(model, Intern()) is None:
+        try:
+            packable = model_ns.pack_spec(model, Intern()) is not None
+        except Exception:  # noqa: BLE001 - spec probe blowing up is just
+            packable = False  # "not packable": quiet host path, not a crash
+        if not packable:
             return None, None
         try:
             ks = list(subs)

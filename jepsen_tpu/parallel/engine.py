@@ -483,12 +483,30 @@ def extract_final_paths(model, e: EncodedHistory, fail_r: int,
         if host.get("valid?") is False:
             return {"final-paths": host.get("final-paths", []),
                     "configs": host.get("configs", [])}
-        return {}
+        import logging
+        logging.getLogger(__name__).warning(
+            "final-paths: host re-search of the failing prefix came back "
+            "valid while the device said invalid — engine disagreement")
+        return {"final-paths": [], "configs": [],
+                "final-paths-note": "host re-search of failing prefix "
+                                    "disagreed (valid)"}
+
+    import logging
+    log = logging.getLogger(__name__)
+
+    def _empty(note: str) -> dict:
+        # an invalid history with no paths is a loud event, same policy
+        # as the device-fallback tagging in independent.py — silence
+        # here would look like "no counterexample available" by design
+        log.warning("final-paths extraction returned nothing for an "
+                    "invalid history: %s", note)
+        return {"final-paths": [], "configs": [], "final-paths-note": note}
 
     from jepsen_tpu import models as model_ns
     spec = model_ns.pack_spec(model, e.intern)
     if spec is None or spec.unpack_state is None:
-        return {}
+        return _empty("model has no unpack_state; cannot seed a window "
+                      "re-search")
     start_ev = max(0, fail_r - window)
     if start_ev == 0:
         seeds = [(e.state0, frozenset())]
@@ -496,7 +514,7 @@ def extract_final_paths(model, e: EncodedHistory, fail_r: int,
     else:
         rows = _frontier_at(e, start_ev)
         if rows is None:
-            return {}
+            return _empty("seed-frontier re-scan overflowed max capacity")
         occupants = _slot_occupants_before(e, start_ev)
         seeds = []
         for stc, ml, mh in rows[:max_seeds]:
@@ -517,6 +535,10 @@ def extract_final_paths(model, e: EncodedHistory, fail_r: int,
             configs.extend(host.get("configs", []))
         if len(paths) >= 10:
             break
+    if not paths:
+        return _empty("all %d window re-searches from device seeds came "
+                      "back valid (window [%d, %d])"
+                      % (len(seeds), start_ev, fail_r))
     out = {"final-paths": paths[:10], "configs": configs[:10]}
     if start_ev > 0:
         # paths cover the failure window only; the device verified the
@@ -649,33 +671,46 @@ def check_batch(model, histories, capacity: int = 512,
     C_max = max(e.n_slots for e in pre)
     if bitdense.fits_bitdense(S_max, C_max):
         return bitdense.check_batch_bitdense(pre, mesh=mesh)
-    encs, xs, state0 = encode_batch(model, histories, encs=pre, mesh=mesh)
-    step_name = encs[0].step_name
+    step_name = pre[0].step_name
+    K = len(pre)
+    out: list = [None] * K
+    # Per-key capacity retry: keys are bucketed by the capacity tier
+    # they need — only keys that overflowed re-run (at doubled
+    # capacity), so one hot key never drags the whole batch through
+    # re-padding and re-search at 2-512x capacity.
+    pending = list(range(K))
     N = max(64, capacity)
-    while True:
+    while pending:
+        encs_t = [pre[i] for i in pending]
+        _, xs, state0 = encode_batch(model, [], encs=encs_t, mesh=mesh)
         valid, fail_r, overflow, maxf, steps_n = _check_device_batch(
             xs, state0, step_name, N)
-        if not bool(jnp.any(overflow)) or N * 2 > max_capacity:
+        valid = np.asarray(valid)
+        fail_r = np.asarray(fail_r)
+        overflow = np.asarray(overflow)
+        maxf = np.asarray(maxf)
+        retry = []
+        for j, i in enumerate(pending):
+            if bool(overflow[j]):
+                retry.append(i)
+                continue
+            e = pre[i]
+            r = {"valid?": bool(valid[j]), "max-frontier": int(maxf[j]),
+                 "capacity": N}
+            if not r["valid?"]:
+                ri = int(fail_r[j])
+                c = e.calls[int(e.ret_call[ri])]
+                r["op"] = {"process": c.process, "f": c.f,
+                           "value": c.result if c.f == "read" else c.value,
+                           "index": c.invoke_index}
+            out[i] = r
+        if not retry:
             break
+        if N * 2 > max_capacity:
+            for i in retry:
+                out[i] = {"valid?": "unknown",
+                          "error": f"frontier overflow at capacity {N}"}
+            break
+        pending = retry
         N *= 2
-    valid = np.asarray(valid)
-    fail_r = np.asarray(fail_r)
-    overflow = np.asarray(overflow)
-    maxf = np.asarray(maxf)
-    out = []
-    for k, e in enumerate(encs):
-        if bool(overflow[k]):
-            out.append({"valid?": "unknown",
-                        "error": f"frontier overflow at capacity {N}"})
-            continue
-        r = {"valid?": bool(valid[k]), "max-frontier": int(maxf[k]),
-             "capacity": N}
-        if not r["valid?"]:
-            ri = int(fail_r[k])
-            cid = int(e.ret_call[ri])
-            c = e.calls[cid]
-            r["op"] = {"process": c.process, "f": c.f,
-                       "value": c.result if c.f == "read" else c.value,
-                       "index": c.invoke_index}
-        out.append(r)
     return out

@@ -58,7 +58,7 @@ class TxResult:
 class QueryResult:
     code: int
     height: int = 0
-    index: int = -1
+    index: int = 0  # 0 = "no index" (proto3 conflates unset with 0)
     key: bytes = b""
     value: bytes = b""
     log: str = ""
@@ -197,7 +197,10 @@ class MerkleeyesClient:
         key, pos = w.read_bytes(resp, pos)
         value, pos = w.read_bytes(resp, pos)
         log, _ = w.read_bytes(resp, pos)
-        return QueryResult(code, height, index, key, value,
+        # the ABCI arm cannot transmit the -1 "no index" sentinel
+        # (proto3 conflates unset with 0); clamp here so QueryResult is
+        # identical across both protocols
+        return QueryResult(code, height, max(index, 0), key, value,
                            log.decode("utf-8", "replace"))
 
     def echo(self, data: bytes) -> bytes:

@@ -194,9 +194,12 @@ inline bytes handle(App& app, const bytes& req_body) {
       bytes body;
       pb::varint_field(body, 1, q.code);
       pb::string_field(body, 3, q.log);
-      // proto3 int64: unset and 0 coincide; negative "no index" stays
-      // off the wire like the reference's never-set Index field
-      if (q.index > 0) pb::int64_field(body, 5, q.index);
+      // proto3 int64: unset and 0 coincide on the wire, so a >= 0
+      // index always decodes faithfully; the -1 "no index" sentinel
+      // stays off the wire (decodes as 0) — matching the custom
+      // protocol's client, which clamps -1 to 0 on decode so both
+      // protocols agree on QueryResult.index
+      if (q.index >= 0) pb::int64_field(body, 5, q.index);
       pb::bytes_field(body, 6, q.key);
       pb::bytes_field(body, 7, q.value);
       pb::int64_field(body, 9, q.height);

@@ -365,7 +365,14 @@ class App {
   static constexpr uint8_t kWalInitChain = 0x01;
   // File magic: lets replay tell "not a MEW1 WAL" (refuse to run)
   // apart from "empty/new file" (start fresh) — without it a foreign
-  // or corrupt file would be silently wiped.
+  // or corrupt file would be silently wiped. MEW1 is a BREAKING format
+  // change from the headerless pre-release WAL: a legacy file hits
+  // "bad magic" and the node refuses to start — move the file aside
+  // (or delete it, losing replayed history) to upgrade in place.
+  // Deliberate: the pre-release format carried no checksums, so
+  // "convert on first boot" would launder torn writes into committed
+  // history; refusing is the conservative arm of the same policy the
+  // replay applies to interior corruption.
   static constexpr const char* kWalMagic = "MEW1";
 
   // frame on disk = uvarint(len(payload)) ∥ payload ∥ crc32le(payload)

@@ -220,6 +220,38 @@ def test_check_batch_sharded_mesh():
     assert all(r["valid?"] is True for r in rs)
 
 
+def _concurrent_writes_history(m, base_process=0):
+    """m concurrent writes of m distinct values (all invoked, then all
+    acked): the frontier peaks at ~m * 2^(m-1) configs during the first
+    closure — an adversarial single key whose cost is tunable by m."""
+    ops = []
+    for p in range(m):
+        ops.append(invoke_op(base_process + p, "write", 1000 + p))
+    for p in range(m):
+        ops.append(ok_op(base_process + p, "write", 1000 + p))
+    return _h(*ops)
+
+
+def test_check_batch_per_key_capacity_retry():
+    """One hot key among cheap ones: only the hot key re-runs at doubled
+    capacity; the cheap keys' results record the base tier, proving they
+    were not re-padded and re-searched at the hot key's capacity."""
+    cheap = [rand_register_history(n_ops=20, n_processes=3, crash_p=0.0,
+                                   seed=300 + s) for s in range(16)]
+    hot = _concurrent_writes_history(7)       # needs ~450 configs -> 512
+    doomed = _concurrent_writes_history(26)   # blows past any tier; its
+    # 26-slot window also forces the whole batch off the bitdense path
+    rs = engine.check_batch(CASRegister(), cheap + [hot, doomed],
+                            capacity=128, max_capacity=2048)
+    for r in rs[:16]:
+        assert r["valid?"] is True
+        assert r["capacity"] == 128, r   # never re-run at a higher tier
+    assert rs[16]["valid?"] is True
+    assert rs[16]["capacity"] == 512, rs[16]  # bucketed retry found 512
+    assert rs[17]["valid?"] == "unknown"
+    assert "overflow" in rs[17]["error"]
+
+
 def test_dispatcher_jax_route():
     from jepsen_tpu.checker import linearizable
     h = _h(
