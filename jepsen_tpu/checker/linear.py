@@ -86,7 +86,8 @@ def check_calls(model, cs: List[Call], n_history: int,
             return {
                 "valid?": False,
                 "op": {"process": c.process, "f": c.f,
-                       "value": c.result if c.f == "read" else c.value,
+                       "value": c.result if c.f in ("read", "dequeue")
+                       else c.value,
                        "index": c.invoke_index},
                 "explored": explored,
                 "max-frontier": max_frontier,

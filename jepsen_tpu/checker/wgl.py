@@ -89,13 +89,14 @@ def _candidates(ev: _EventList, start_after: Optional[int] = None):
 
 class _StepOp:
     """Adapter giving Call records the .f/.value interface models expect,
-    with reads carrying their completion value (knossos complete)."""
+    with observing ops (reads, dequeues) carrying their completion value
+    (knossos complete merges ok values into the invocation)."""
 
     __slots__ = ("f", "value")
 
     def __init__(self, c: Call):
         self.f = c.f
-        if c.f == "read":
+        if c.f in ("read", "dequeue"):
             self.value = c.result if not c.crashed else None
         else:
             self.value = c.value
@@ -195,10 +196,10 @@ def _invalid_result(model, best_path, best_stuck, explored, state, linearized,
                      "model": str(st)})
     stuck_op = None
     if best_stuck is not None:
-        # report the observed value for reads (the completion is what the
-        # search couldn't explain), invocation args otherwise
+        # report the observed value for reads/dequeues (the completion
+        # is what the search couldn't explain), invocation args otherwise
         v = (best_stuck.result
-             if best_stuck.f == "read" and not best_stuck.crashed
+             if best_stuck.f in ("read", "dequeue") and not best_stuck.crashed
              else best_stuck.value)
         stuck_op = {"process": best_stuck.process, "f": best_stuck.f,
                     "value": v, "index": best_stuck.invoke_index}

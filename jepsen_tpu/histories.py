@@ -17,6 +17,60 @@ from typing import Optional
 from jepsen_tpu.history import History, Op
 
 
+def _simulate(
+    n_ops: int,
+    n_processes: int,
+    busy: float,
+    crash_p: float,
+    seed: int,
+    choose_op,      # (rng) -> (f, invoke_value)
+    complete_op,    # (rng, f, v) -> (type, completion_value); applies effect
+    crash_op,       # (rng, f, v) -> None; maybe-applies effect (both legal)
+) -> History:
+    """The shared concurrent-simulation driver behind every generator:
+    a true model state evolves; each op's effect is applied at its
+    completion instant (a legal linearization point inside its
+    [invoke, complete] window), so histories are valid by construction.
+    Crashed (:info) ops are applied-or-not by `crash_op` and their
+    process id is retired for a fresh one; `busy` biases toward opening
+    new calls before completing pending ones (higher -> more
+    concurrency -> wider search windows)."""
+    rng = random.Random(seed)
+    h = History()
+    pending: dict = {}      # process -> (f, invoke value)
+    free = list(range(n_processes))
+    next_process = n_processes
+    started = 0
+    t = 0
+
+    def emit(typ, process, f, val, **kw):
+        nonlocal t
+        t += rng.randint(1, 1000)
+        h.append(Op(type=typ, process=process, f=f, value=val, time=t, **kw))
+
+    while started < n_ops or pending:
+        can_start = started < n_ops and free
+        if can_start and (not pending or rng.random() < busy):
+            p = free.pop(rng.randrange(len(free)))
+            f, v = choose_op(rng)
+            emit("invoke", p, f, v)
+            pending[p] = (f, v)
+            started += 1
+        else:
+            p = rng.choice(list(pending))
+            f, v = pending.pop(p)
+            if rng.random() < crash_p:
+                crash_op(rng, f, v)
+                emit("info", p, f, v, error="indeterminate")
+                free.append(next_process)
+                next_process += 1
+            else:
+                typ, out = complete_op(rng, f, v)
+                emit(typ, p, f, out)
+                free.append(p)
+    return h.index()
+
+
 def rand_register_history(
     n_ops: int = 100,
     n_processes: int = 5,
@@ -27,71 +81,37 @@ def rand_register_history(
     busy: float = 0.5,
     seed: int = 45100,
 ) -> History:
-    """A random, linearizable-by-construction cas-register history.
-
-    Simulation: a true register value evolves; each op's effect is applied
-    at its completion instant (a legal linearization point inside its
-    [invoke, complete] window). Crashed ops (:info) either applied at
-    crash time or never — both legal. Failed ops never applied.
-    Concurrency comes from interleaving invocations and completions of
-    different processes. Default seed 45100 is the reference's test seed
+    """A random, linearizable-by-construction cas-register history
+    (see `_simulate` for the driver semantics). Failed ops never apply.
+    Default seed 45100 is the reference's test seed
     (jepsen/src/jepsen/generator/test.clj:30-47).
     """
-    rng = random.Random(seed)
-    h = History()
-    value = None            # true register state
-    pending: dict = {}      # process -> op dict
-    free = list(range(n_processes))
-    next_process = n_processes  # crashed processes are replaced with fresh ids
-    started = 0
-    t = 0
+    state = {"value": None}
 
-    def emit(typ, process, f, val, **kw):
-        nonlocal t
-        t += rng.randint(1, 1000)
-        o = Op(type=typ, process=process, f=f, value=val, time=t, **kw)
-        h.append(o)
-        return o
+    def choose(rng):
+        r = rng.random()
+        if cas and r < 0.3:
+            return "cas", [rng.randrange(n_values), rng.randrange(n_values)]
+        if r < 0.6:
+            return "write", rng.randrange(n_values)
+        return "read", None
 
-    while started < n_ops or pending:
-        # `busy` biases toward opening new calls before completing pending
-        # ones: higher busy -> more concurrency -> wider search windows
-        can_start = started < n_ops and free
-        if can_start and (not pending or rng.random() < busy):
-            p = free.pop(rng.randrange(len(free)))
-            r = rng.random()
-            if cas and r < 0.3:
-                f, v = "cas", [rng.randrange(n_values), rng.randrange(n_values)]
-            elif r < 0.6:
-                f, v = "write", rng.randrange(n_values)
-            else:
-                f, v = "read", None
-            emit("invoke", p, f, v)
-            pending[p] = {"f": f, "value": v}
-            started += 1
-        else:
-            p = rng.choice(list(pending))
-            op_info = pending.pop(p)
-            f, v = op_info["f"], op_info["value"]
-            roll = rng.random()
-            if roll < crash_p:
-                # crashed: maybe applied, maybe not; process id retired
-                if rng.random() < 0.5:
-                    value = _apply(value, f, v)[0]
-                emit("info", p, f, v, error="indeterminate")
-                free.append(next_process)
-                next_process += 1
-            elif roll < crash_p + fail_p and f != "read":
-                emit("fail", p, f, v)
-                free.append(p)
-            else:
-                value, result, ok = _apply_and_result(value, f, v)
-                if ok:
-                    emit("ok", p, f, result)
-                else:
-                    emit("fail", p, f, v)
-                free.append(p)
-    return h.index()
+    def complete(rng, f, v):
+        if f != "read" and rng.random() < fail_p:
+            return "fail", v
+        value = state["value"]
+        if f == "read":
+            return "ok", value
+        new_value, ok = _apply(value, f, v)
+        state["value"] = new_value
+        return ("ok", v) if ok else ("fail", v)
+
+    def crash(rng, f, v):
+        if rng.random() < 0.5:
+            state["value"] = _apply(state["value"], f, v)[0]
+
+    return _simulate(n_ops, n_processes, busy, crash_p, seed,
+                     choose, complete, crash)
 
 
 def _apply(value, f, v):
@@ -105,11 +125,86 @@ def _apply(value, f, v):
     return value, True
 
 
-def _apply_and_result(value, f, v):
-    if f == "read":
-        return value, value, True
-    new_value, ok = _apply(value, f, v)
-    return (new_value, v, True) if ok else (value, v, False)
+def rand_gset_history(
+    n_ops: int = 100,
+    n_processes: int = 5,
+    n_elements: int = 8,
+    read_p: float = 0.4,
+    crash_p: float = 0.05,
+    busy: float = 0.5,
+    seed: int = 45100,
+) -> History:
+    """A random, linearizable-by-construction grow-only-set history:
+    adds of distinct elements and full-set reads (see `_simulate`)."""
+    true_set: set = set()
+    counter = iter(range(n_elements))
+
+    def choose(rng):
+        if rng.random() >= read_p:
+            v = next(counter, None)
+            if v is not None:
+                return "add", v
+        return "read", None
+
+    def complete(rng, f, v):
+        if f == "add":
+            true_set.add(v)
+            return "ok", v
+        return "ok", sorted(true_set)
+
+    def crash(rng, f, v):
+        if f == "add" and rng.random() < 0.5:
+            true_set.add(v)
+
+    return _simulate(n_ops, n_processes, busy, crash_p, seed,
+                     choose, complete, crash)
+
+
+def rand_queue_history(
+    n_ops: int = 100,
+    n_processes: int = 5,
+    n_values: int = 3,
+    deq_p: float = 0.45,
+    crash_p: float = 0.05,
+    busy: float = 0.5,
+    seed: int = 45100,
+) -> History:
+    """A random, linearizable-by-construction unordered-queue history:
+    enqueues of a small value domain and dequeues returning any pending
+    element (see `_simulate`). Dequeues finding the queue empty
+    complete as :fail (dropped by the checkers, like a client-side
+    retryable empty-queue error)."""
+    from collections import Counter
+    q: Counter = Counter()
+
+    def pop_random(rng):
+        x = rng.choice(list(q.elements()))
+        q[x] -= 1
+        return x
+
+    def choose(rng):
+        if rng.random() < deq_p:
+            return "dequeue", None
+        return "enqueue", rng.randrange(n_values)
+
+    def complete(rng, f, v):
+        if f == "enqueue":
+            q[v] += 1
+            return "ok", v
+        if sum(q.values()) == 0:
+            return "fail", None
+        return "ok", pop_random(rng)
+
+    def crash(rng, f, v):
+        # crashed: enqueues maybe applied; dequeues maybe popped
+        if f == "enqueue" and rng.random() < 0.5:
+            q[v] += 1
+        elif (f == "dequeue" and sum(q.values()) > 0
+              and rng.random() < 0.5):
+            pop_random(rng)
+
+    return _simulate(n_ops, n_processes, busy, crash_p, seed,
+                     choose, complete, crash)
 
 
 def corrupt_history(h: History, seed: int = 0,

@@ -348,6 +348,7 @@ class IndependentChecker(Checker):
         from jepsen_tpu import models as model_ns
         from jepsen_tpu.history import Intern
         from jepsen_tpu.parallel import engine
+        from jepsen_tpu.parallel.encode import EncodeError
         try:
             packable = model_ns.pack_spec(model, Intern()) is not None
         except Exception:  # noqa: BLE001 - spec probe blowing up is just
@@ -358,6 +359,14 @@ class IndependentChecker(Checker):
             ks = list(subs)
             rs = engine.check_batch(model, [subs[k] for k in ks])
             return {k: {**r, "analyzer": "jax"} for k, r in zip(ks, rs)}, None
+        except EncodeError as err:
+            # legitimately not device-encodable (a gset key past the
+            # 31-element budget, a > 64-slot crash pile-up): the host
+            # path is correct but 100-300x slower, so still say so
+            reason = f"not device-encodable: {err}"
+            log.warning("device batch check skipped (%s) — using the "
+                        "host per-key checker", reason)
+            return None, reason
         except Exception as err:  # noqa: BLE001 - host path still checks
             reason = f"{type(err).__name__}: {err}"
             log.warning(

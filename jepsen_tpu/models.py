@@ -231,6 +231,7 @@ def gset():
 
 # f-codes shared by host encoder and device step functions
 F_READ, F_WRITE, F_CAS, F_ACQUIRE, F_RELEASE = 0, 1, 2, 3, 4
+F_ADD, F_ENQ, F_DEQ = 5, 6, 7
 
 
 @dataclass
@@ -261,14 +262,25 @@ class PackedSpec:
     # host seed a re-search from a device frontier checkpoint
     # (counterexample extraction for long histories)
     unpack_state: Callable = None
+    # optional pre-pass over the full (pruned) call list, run by
+    # encode() before any encode_call: models whose packing needs
+    # global knowledge of the history (GSet element lanes, queue count
+    # widths) build their tables here and may raise EncodeError to
+    # send the history to the host engine
+    prepare: Callable = None
 
 
 def pack_spec(model: Model, intern) -> Optional[PackedSpec]:
     """Return the PackedSpec for device-packable models, else None.
 
     Packable today: Register / CASRegister (state = interned value id,
-    nil = -1) and Mutex (state = 0/1). Queue/set families have unbounded
-    state and stay on the host checker (SURVEY.md §7.3 #4).
+    nil = -1), Mutex (state = 0/1), GSet (state = element bitmask, up
+    to 31 distinct elements), and UnorderedQueue (state = packed count
+    lanes, up to 31 total bits). GSet/queue packing is history-bounded,
+    not unbounded: their `prepare` pass sizes the state from the actual
+    call list and raises EncodeError past the 31-bit budget, falling
+    back to the host checker (SURVEY.md §7.3 #4). FIFOQueue stays
+    host-only (order-sensitive unbounded state).
     """
     if isinstance(model, (Register, CASRegister)):
         state0 = intern.code(model.value)
@@ -323,4 +335,154 @@ def pack_spec(model: Model, intern) -> Optional[PackedSpec]:
             unpack_state=lambda code, intern: Mutex(bool(code)),
         )
 
+    if isinstance(model, GSet):
+        return _gset_spec(model)
+
+    if isinstance(model, UnorderedQueue):
+        return _uqueue_spec(model)
+
     return None
+
+
+def _encode_error(msg: str):
+    from jepsen_tpu.parallel.encode import EncodeError
+    return EncodeError(msg)
+
+
+def _gset_spec(model: "GSet") -> PackedSpec:
+    """GSet packing: state IS the element bitmask. Lanes (element ->
+    bit) are assigned by `prepare` from the history — adds first, then
+    read sets — so the device step sees only small ints."""
+    lanes: dict = {}
+
+    def prepare(cs, intern):
+        elems = list(model.items)
+        for c in cs:
+            if c.f == "add" and c.value is not None:
+                elems.append(c.value)
+        for c in cs:
+            if c.f == "read" and not c.crashed and c.result is not None:
+                elems.extend(c.result)
+        lanes.clear()
+        try:
+            for v in elems:
+                if v not in lanes:
+                    lanes[v] = len(lanes)
+        except TypeError as err:  # unhashable element
+            raise _encode_error(f"gset element not hashable: {err}")
+        if len(lanes) > 31:
+            raise _encode_error(
+                f"gset has {len(lanes)} distinct elements; the packed "
+                f"bitmask state holds 31 — use the host engine")
+        spec.state0 = _gset_mask(model.items)
+
+    def _gset_mask(items):
+        m = 0
+        for v in items:
+            m |= 1 << lanes[v]
+        return m
+
+    def encode_call(f, value, result, crashed):
+        if f == "add":
+            if value is None:
+                return (F_READ, -1, -1, True)  # unknown add: wildcard
+            return (F_ADD, lanes[value], -1, False)
+        if f == "read":
+            v = result if not crashed else None
+            if v is None:
+                return (F_READ, -1, -1, True)
+            return (F_READ, _gset_mask(v), -1, False)
+        raise ValueError(f"gset: unknown f {f!r}")
+
+    def unpack_state(code, intern):
+        return GSet(frozenset(v for v, b in lanes.items()
+                              if (code >> b) & 1))
+
+    spec = PackedSpec(
+        state0=0,  # finalized by prepare (needs the lane table)
+        step_name="gset",
+        encode_call=encode_call,
+        f_codes={"add": F_ADD, "read": F_READ},
+        state_lo=0,
+        n_states=lambda intern: 1 << len(lanes),
+        unpack_state=unpack_state,
+        prepare=prepare,
+    )
+    return spec
+
+
+def _uqueue_spec(model: "UnorderedQueue") -> PackedSpec:
+    """UnorderedQueue packing: one count lane per distinct value, width
+    sized by `prepare` from the history's total enqueues (plus initial
+    pending) — counts can never overflow their lane by construction.
+    lanes maps value -> (bit offset, unshifted mask)."""
+    lanes: dict = {}
+    total_bits = [0]
+
+    def prepare(cs, intern):
+        from collections import Counter
+        cap: Counter = Counter()
+        try:
+            for v, k in model.pending:
+                cap[v] += k
+            for c in cs:
+                if c.f == "enqueue" and c.value is not None:
+                    cap[c.value] += 1
+            for c in cs:
+                if c.f == "dequeue":
+                    v = c.value if c.crashed else c.result
+                    if v is not None and v not in cap:
+                        cap[v] = 0  # dequeue-only value: 1-bit zero lane
+        except TypeError as err:
+            raise _encode_error(f"queue element not hashable: {err}")
+        lanes.clear()
+        off = 0
+        for v, k in cap.items():
+            w = max(1, int(k).bit_length())
+            lanes[v] = (off, (1 << w) - 1)
+            off += w
+        if off > 31:
+            raise _encode_error(
+                f"queue count lanes need {off} bits; the packed state "
+                f"holds 31 — use the host engine")
+        total_bits[0] = off
+        s0 = 0
+        for v, k in model.pending:
+            s0 += k << lanes[v][0]
+        spec.state0 = s0
+
+    def encode_call(f, value, result, crashed):
+        if f == "enqueue":
+            if value is None:
+                return (F_READ, -1, -1, True)
+            o, m = lanes[value]
+            return (F_ENQ, o, m, False)
+        if f == "dequeue":
+            # completion-valued: the dequeued element is learned at ok;
+            # unknown results (crashed, or nil ok) are unconstrained
+            v = value if crashed else result
+            if v is None:
+                return (F_READ, -1, -1, True)
+            o, m = lanes[v]
+            return (F_DEQ, o, m, False)
+        raise ValueError(f"unordered-queue: unknown f {f!r}")
+
+    def unpack_state(code, intern):
+        items = []
+        for v, (o, m) in lanes.items():
+            k = (code >> o) & m
+            if k:
+                items.append((v, k))
+        return UnorderedQueue(frozenset(items))
+
+    spec = PackedSpec(
+        state0=0,  # finalized by prepare
+        step_name="uqueue",
+        encode_call=encode_call,
+        f_codes={"enqueue": F_ENQ, "dequeue": F_DEQ},
+        state_lo=0,
+        n_states=lambda intern: 1 << total_bits[0],
+        unpack_state=unpack_state,
+        prepare=prepare,
+    )
+    return spec

@@ -229,12 +229,8 @@ def check_encoded_bitdense(e: EncodedHistory) -> dict:
     out = {"valid?": bool(valid), "engine": "bitdense",
            "states": S, "slots": C}
     if not out["valid?"]:
-        r = int(fail_r)
-        c = e.calls[int(e.ret_call[r])]
-        out["op"] = {"process": c.process, "f": c.f,
-                     "value": c.result if c.f == "read" else c.value,
-                     "index": c.invoke_index}
-        out["fail-event"] = r
+        from jepsen_tpu.parallel.encode import fail_op_fields
+        out.update(fail_op_fields(e, int(fail_r)))
     return out
 
 
@@ -257,10 +253,7 @@ def check_batch_bitdense(encs, mesh=None) -> list:
     for k, e in enumerate(encs):
         r = {"valid?": bool(valid[k]), "engine": "bitdense"}
         if not r["valid?"]:
-            ri = int(fail_r[k])
-            c = e.calls[int(e.ret_call[ri])]
-            r["op"] = {"process": c.process, "f": c.f,
-                       "value": c.result if c.f == "read" else c.value,
-                       "index": c.invoke_index}
+            from jepsen_tpu.parallel.encode import fail_op_fields
+            r.update(fail_op_fields(e, int(fail_r[k])))
         out.append(r)
     return out

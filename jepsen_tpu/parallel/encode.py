@@ -57,6 +57,9 @@ class EncodedHistory:
     intern: Intern          # value table (host-side reporting)
     state_lo: int = -1      # dense state domain: [state_lo, state_lo + S)
     n_states: int = 0
+    spec: object = None     # the *prepared* PackedSpec — models whose
+    # packing is history-dependent (gset lanes, queue widths) need this
+    # exact instance for unpack_state during counterexample extraction
 
     @property
     def n_returns(self) -> int:
@@ -65,6 +68,21 @@ class EncodedHistory:
 
 class EncodeError(Exception):
     """History can't go to the device; callers fall back to host engines."""
+
+
+# fs whose constraint is learned at completion, not invocation — the
+# counterexample op should report what the system *returned*
+OBSERVED_FS = ("read", "dequeue")
+
+
+def fail_op_fields(e: "EncodedHistory", r: int) -> dict:
+    """The knossos-style counterexample op fields for failing return
+    event r — shared by every engine's reporting path."""
+    c = e.calls[int(e.ret_call[int(r)])]
+    v = c.result if (c.f in OBSERVED_FS and not c.crashed) else c.value
+    return {"op": {"process": c.process, "f": c.f, "value": v,
+                   "index": c.invoke_index},
+            "fail-event": int(r)}
 
 
 def encode(model, history, pad_slots: Optional[int] = None) -> EncodedHistory:
@@ -80,6 +98,25 @@ def encode(model, history, pad_slots: Optional[int] = None) -> EncodedHistory:
 
     h = history if isinstance(history, History) else History.wrap(history)
     cs = prune_wildcard_calls(history_calls(h))
+    if spec.prepare is not None:
+        spec.prepare(cs, intern)  # may raise EncodeError (host fallback)
+
+    # Prune crashed calls that pack to wildcards (identity step, always
+    # ok, never returns): they may linearize at any point or never, so
+    # dropping them is sound — and each one would otherwise double the
+    # frontier's mask space forever. prune_wildcard_calls catches
+    # crashed *reads* before the model is known; this generalizes to
+    # whatever the model family declares unconstrained (e.g. crashed
+    # dequeues with unknown results).
+    packed = [spec.encode_call(c.f, c.value, c.result, c.crashed)
+              for c in cs]
+    if any(c.crashed and pk[3] for c, pk in zip(cs, packed)):
+        kept = [(c, pk) for c, pk in zip(cs, packed)
+                if not (c.crashed and pk[3])]
+        cs = [c for c, _ in kept]
+        packed = [pk for _, pk in kept]
+        for j, c in enumerate(cs):
+            c.index = j
 
     # events in history order; kind 0=invoke first on ties (an invoke at
     # the same index as a return cannot precede it in a real history —
@@ -91,17 +128,11 @@ def encode(model, history, pad_slots: Optional[int] = None) -> EncodedHistory:
             events.append((c.complete_index, 1, c.index))
     events.sort()
 
-    # encode per-call packed ops
-    enc_f = np.empty(len(cs), np.int32)
-    enc_a0 = np.empty(len(cs), np.int32)
-    enc_a1 = np.empty(len(cs), np.int32)
-    enc_wild = np.empty(len(cs), bool)
-    for c in cs:
-        f, a0, a1, wild = spec.encode_call(c.f, c.value, c.result, c.crashed)
-        enc_f[c.index] = f
-        enc_a0[c.index] = a0
-        enc_a1[c.index] = a1
-        enc_wild[c.index] = wild
+    # per-call packed ops as arrays
+    enc_f = np.fromiter((pk[0] for pk in packed), np.int32, len(packed))
+    enc_a0 = np.fromiter((pk[1] for pk in packed), np.int32, len(packed))
+    enc_a1 = np.fromiter((pk[2] for pk in packed), np.int32, len(packed))
+    enc_wild = np.fromiter((pk[3] for pk in packed), bool, len(packed))
 
     # slot assignment + per-return snapshots
     free: list = []  # min-heap of free slots
@@ -157,6 +188,7 @@ def encode(model, history, pad_slots: Optional[int] = None) -> EncodedHistory:
         n_calls=len(cs), n_slots=n_slots, calls=cs, intern=intern,
         state_lo=spec.state_lo,
         n_states=spec.n_states(intern) if spec.n_states else len(intern) + 1,
+        spec=spec,
     )
 
 

@@ -384,13 +384,7 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
     return out
 
 
-def _fail_op(e: EncodedHistory, r: int) -> dict:
-    """The counterexample op fields for a failing return event."""
-    c = e.calls[int(e.ret_call[r])]
-    return {"op": {"process": c.process, "f": c.f,
-                   "value": c.result if c.f == "read" else c.value,
-                   "index": c.invoke_index},
-            "fail-event": r}
+_fail_op = enc_mod.fail_op_fields
 
 
 def check_encoded(e: EncodedHistory, capacity: int = 1024,
@@ -503,7 +497,10 @@ def extract_final_paths(model, e: EncodedHistory, fail_r: int,
         return {"final-paths": [], "configs": [], "final-paths-note": note}
 
     from jepsen_tpu import models as model_ns
-    spec = model_ns.pack_spec(model, e.intern)
+    # the encoded history carries its *prepared* spec — for models with
+    # history-dependent packing (gset lanes, queue widths) a fresh
+    # pack_spec could not unpack device states
+    spec = e.spec or model_ns.pack_spec(model, e.intern)
     if spec is None or spec.unpack_state is None:
         return _empty("model has no unpack_state; cannot seed a window "
                       "re-search")
@@ -698,11 +695,7 @@ def check_batch(model, histories, capacity: int = 512,
             r = {"valid?": bool(valid[j]), "max-frontier": int(maxf[j]),
                  "capacity": N}
             if not r["valid?"]:
-                ri = int(fail_r[j])
-                c = e.calls[int(e.ret_call[ri])]
-                r["op"] = {"process": c.process, "f": c.f,
-                           "value": c.result if c.f == "read" else c.value,
-                           "index": c.invoke_index}
+                r.update(enc_mod.fail_op_fields(e, int(fail_r[j])))
             out[i] = r
         if not retry:
             break

@@ -214,10 +214,6 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
     out = {"valid?": bool(valid), "max-frontier": int(maxf),
            "capacity": N, "devices": n_dev}
     if not out["valid?"]:
-        r = int(fail_r)
-        c = e.calls[int(e.ret_call[r])]
-        out["op"] = {"process": c.process, "f": c.f,
-                     "value": c.result if c.f == "read" else c.value,
-                     "index": c.invoke_index}
-        out["fail-event"] = r
+        from jepsen_tpu.parallel.encode import fail_op_fields
+        out.update(fail_op_fields(e, int(fail_r)))
     return out

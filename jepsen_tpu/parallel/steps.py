@@ -18,7 +18,9 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from jepsen_tpu.models import F_ACQUIRE, F_CAS, F_READ, F_RELEASE, F_WRITE
+from jepsen_tpu.models import (
+    F_ACQUIRE, F_ADD, F_CAS, F_DEQ, F_ENQ, F_READ, F_RELEASE, F_WRITE,
+)
 
 
 def register_step(state, f, a0, a1, wild):
@@ -58,7 +60,60 @@ def mutex_step(state, f, a0, a1, wild):
     return jnp.where(ok, new_state, state), ok
 
 
+def gset_step(state, f, a0, a1, wild):
+    """Grow-only set (models.GSet; knossos.model/set): state is the
+    element bitmask itself — bit b set iff element with lane b has been
+    added. Lanes are assigned by the encoder's prepare pass; histories
+    with more than 31 distinct elements fall back to the host engine.
+
+    add  a0=element lane:        always ok; state |= 1 << a0
+    read a0=observed-set mask:   ok iff wild or state == a0; unchanged
+    """
+    is_add = f == F_ADD
+    is_read = f == F_READ
+    bit = jnp.int32(1) << jnp.maximum(a0, 0)  # a0=-1 only on masked rows
+    ok = jnp.where(
+        wild, True,
+        jnp.where(is_add, True, jnp.where(is_read, state == a0, False)),
+    )
+    new_state = jnp.where(wild | is_read, state,
+                          jnp.where(is_add, state | bit, state))
+    return jnp.where(ok, new_state, state), ok
+
+
+def uqueue_step(state, f, a0, a1, wild):
+    """Unordered queue (models.UnorderedQueue; knossos.model/
+    unordered-queue): state packs one count lane per distinct value —
+    a0 is the lane's bit offset, a1 its unshifted mask. Lane widths are
+    sized by the encoder from the history's total enqueues per value, so
+    counts cannot overflow their lane; > 31 total bits falls back to the
+    host engine.
+
+    enqueue a0=offset:        always ok; count += 1
+    dequeue a0=offset a1=mask: ok iff count > 0; count -= 1
+    (dequeues with unknown results arrive as wildcards: identity, ok —
+    the same unconstrained treatment the host model gives value=None)
+    """
+    is_enq = f == F_ENQ
+    is_deq = f == F_DEQ
+    off = jnp.maximum(a0, 0)
+    one = jnp.int32(1) << off
+    cnt = (state >> off) & a1
+    ok = jnp.where(
+        wild, True,
+        jnp.where(is_enq, True, jnp.where(is_deq, cnt > 0, False)),
+    )
+    new_state = jnp.where(
+        wild, state,
+        jnp.where(is_enq, state + one,
+                  jnp.where(is_deq, state - one, state)),
+    )
+    return jnp.where(ok, new_state, state), ok
+
+
 STEPS = {
     "register": register_step,
     "mutex": mutex_step,
+    "gset": gset_step,
+    "uqueue": uqueue_step,
 }

@@ -447,14 +447,23 @@ def workload(test) -> dict:
                 2 * n, iter(range(max_key[0] + 1)),
                 lambda k: gen.once({"type": "invoke", "f": "read",
                                     "value": None}))
+        # linearizable mode: each per-key sub-history is additionally a
+        # knossos-style GSet linearizability check — and GSet packs onto
+        # the device (bitmask state), so the whole keyed batch rides the
+        # TPU engine (analyzer :jax), not a host timeline scan
+        checkers = {"set": independent.checker(jchecker.set_checker())}
+        if test.get("linearizable"):
+            from jepsen_tpu.models import GSet
+            checkers["linear"] = independent.checker(
+                jchecker.linearizable(GSet(), algorithm=test.get(
+                    "algorithm", "competition")))
         return {
             "client": SetClient(),
             "concurrency": 2 * n,
             "generator": independent.concurrent_generator(
                 2 * n, _naturals(), per_key),
             "final_generator": final,  # thunk: built after main phase
-            "checker": {"set": independent.checker(
-                jchecker.set_checker())}}
+            "checker": checkers}
 
     raise ValueError(f"unknown workload {kind!r}")
 

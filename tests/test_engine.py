@@ -52,8 +52,9 @@ def test_encode_crashed_call_holds_slot():
 
 
 def test_encode_unpackable_model():
+    from jepsen_tpu.models import FIFOQueue
     with pytest.raises(enc_mod.EncodeError):
-        enc_mod.encode(UnorderedQueue(), _h())
+        enc_mod.encode(FIFOQueue(), _h())
 
 
 # ------------------------------------------------------------- fixtures
@@ -191,6 +192,148 @@ def test_differential_vs_host():
         e2 = linear.analysis(CASRegister(), bad)["valid?"]
         e3 = engine.analysis(CASRegister(), bad)["valid?"]
         assert e1 == e2 == e3, f"seed {seed}: wgl={e1} linear={e2} jax={e3}"
+
+
+def test_differential_gset_vs_host():
+    """Device gset (bitmask state) vs host WGL on random + corrupted
+    histories, covering both the bitdense (<= 7 elements) and sparse
+    (> 7 elements) dispatch tiers."""
+    from jepsen_tpu.histories import rand_gset_history
+    from jepsen_tpu.models import GSet
+    for seed in range(12):
+        n_el = 5 if seed % 2 == 0 else 12  # bitdense / sparse tiers
+        h = rand_gset_history(n_ops=40, n_processes=4, n_elements=n_el,
+                              crash_p=0.06, seed=seed + 7000)
+        expect = wgl.analysis(GSet(), h)["valid?"]
+        got = engine.analysis(GSet(), h)
+        assert got["valid?"] is expect, f"seed {seed}: {got}"
+        assert "fallback" not in got, got
+
+        # corrupt one ok read to include a never-added element
+        ops = [dict(o) for o in h]
+        for o in ops:
+            if o.get("type") == "ok" and o.get("f") == "read":
+                o["value"] = list(o["value"]) + [999]
+                break
+        bad = _h(*ops)
+        e1 = wgl.analysis(GSet(), bad)["valid?"]
+        e2 = engine.analysis(GSet(), bad)["valid?"]
+        assert e1 == e2, f"seed {seed}: wgl={e1} jax={e2}"
+
+
+def test_differential_uqueue_vs_host():
+    """Device unordered-queue (packed count lanes) vs host WGL, random +
+    corrupted, bitdense (4 bits) and sparse (9+ bits) tiers."""
+    from jepsen_tpu.histories import rand_queue_history
+    from jepsen_tpu.models import UnorderedQueue
+    for seed in range(12):
+        n_vals = 2 if seed % 2 == 0 else 4
+        h = rand_queue_history(n_ops=40, n_processes=4, n_values=n_vals,
+                               crash_p=0.06, seed=seed + 8000)
+        expect = wgl.analysis(UnorderedQueue(), h)["valid?"]
+        got = engine.analysis(UnorderedQueue(), h)
+        assert got["valid?"] is expect, f"seed {seed}: {got}"
+        assert "fallback" not in got, got
+
+        # corrupt one ok dequeue to a never-enqueued value
+        ops = [dict(o) for o in h]
+        for o in ops:
+            if o.get("type") == "ok" and o.get("f") == "dequeue":
+                o["value"] = 777
+                break
+        else:
+            continue
+        bad = _h(*ops)
+        e1 = wgl.analysis(UnorderedQueue(), bad)["valid?"]
+        e2 = engine.analysis(UnorderedQueue(), bad)["valid?"]
+        assert e1 == e2, f"seed {seed}: wgl={e1} jax={e2}"
+        assert e1 is False  # dequeue of a never-enqueued value
+
+
+def test_crashed_wildcard_dequeues_pruned():
+    """25 crashed dequeues (unknown results) pack to wildcards and are
+    pruned at encode — without this each would double the mask space
+    forever and overflow every capacity tier."""
+    from jepsen_tpu.models import UnorderedQueue
+    ops = []
+    for p in range(25):
+        ops.append(invoke_op(p, "dequeue", None))
+        ops.append(info_op(p, "dequeue", None))
+    ops += [invoke_op(30, "enqueue", "a"), ok_op(30, "enqueue", "a"),
+            invoke_op(30, "dequeue", None), ok_op(30, "dequeue", "a")]
+    e = enc_mod.encode(UnorderedQueue(), _h(*ops))
+    assert e.n_calls == 2      # the crashed wildcards are gone
+    assert e.n_slots <= 2
+    r = engine.analysis(UnorderedQueue(), _h(*ops))
+    assert r["valid?"] is True and "fallback" not in r
+
+
+def test_uqueue_counterexample_reports_observed_value():
+    from jepsen_tpu.models import UnorderedQueue
+    r = engine.analysis(UnorderedQueue(), _h(
+        invoke_op(0, "enqueue", "a"), ok_op(0, "enqueue", "a"),
+        invoke_op(0, "dequeue", None), ok_op(0, "dequeue", "a"),
+        invoke_op(0, "dequeue", None), ok_op(0, "dequeue", "a")))
+    assert r["valid?"] is False
+    # the op is completion-valued: the impossible second dequeue of "a",
+    # not the invocation's value=None
+    assert r["op"]["f"] == "dequeue" and r["op"]["value"] == "a"
+
+
+def test_gset_device_fixtures():
+    from jepsen_tpu.models import GSet
+    # exact-read semantics: read must observe the full set
+    r = engine.analysis(GSet(), _h(
+        invoke_op(0, "add", "x"), ok_op(0, "add", "x"),
+        invoke_op(0, "read", None), ok_op(0, "read", ["x"])))
+    assert r["valid?"] is True
+    r = engine.analysis(GSet(), _h(
+        invoke_op(0, "add", "x"), ok_op(0, "add", "x"),
+        invoke_op(0, "read", None), ok_op(0, "read", [])))
+    assert r["valid?"] is False
+    # concurrent add may or may not be visible
+    r = engine.analysis(GSet(), _h(
+        invoke_op(0, "add", "x"), ok_op(0, "add", "x"),
+        invoke_op(1, "add", "y"), invoke_op(2, "read", None),
+        ok_op(2, "read", ["x", "y"]), ok_op(1, "add", "y")))
+    assert r["valid?"] is True
+    # > 31 distinct elements: loud host fallback, same verdict
+    big = []
+    for i in range(33):
+        big += [invoke_op(0, "add", i), ok_op(0, "add", i)]
+    r = engine.analysis(GSet(), _h(*big))
+    assert r["valid?"] is True and "fallback" in r
+
+
+def test_uqueue_device_fixtures():
+    from jepsen_tpu.models import UnorderedQueue
+    # unordered: dequeue order need not match enqueue order
+    r = engine.analysis(UnorderedQueue(), _h(
+        invoke_op(0, "enqueue", "a"), ok_op(0, "enqueue", "a"),
+        invoke_op(0, "enqueue", "b"), ok_op(0, "enqueue", "b"),
+        invoke_op(0, "dequeue", None), ok_op(0, "dequeue", "b"),
+        invoke_op(0, "dequeue", None), ok_op(0, "dequeue", "a")))
+    assert r["valid?"] is True
+    # dequeue of a value enqueued only once, twice: invalid
+    r = engine.analysis(UnorderedQueue(), _h(
+        invoke_op(0, "enqueue", "a"), ok_op(0, "enqueue", "a"),
+        invoke_op(0, "dequeue", None), ok_op(0, "dequeue", "a"),
+        invoke_op(0, "dequeue", None), ok_op(0, "dequeue", "a")))
+    assert r["valid?"] is False
+    # concurrent enqueue may satisfy a concurrent dequeue
+    r = engine.analysis(UnorderedQueue(), _h(
+        invoke_op(0, "enqueue", "a"), invoke_op(1, "dequeue", None),
+        ok_op(1, "dequeue", "a"), ok_op(0, "enqueue", "a")))
+    assert r["valid?"] is True
+    # crashed enqueue may supply a later dequeue
+    r = engine.analysis(UnorderedQueue(), _h(
+        invoke_op(0, "enqueue", "a"), info_op(0, "enqueue", "a"),
+        invoke_op(1, "dequeue", None), ok_op(1, "dequeue", "a")))
+    assert r["valid?"] is True
+    # initial pending elements count (UnorderedQueue.of)
+    r = engine.analysis(UnorderedQueue.of("x"), _h(
+        invoke_op(0, "dequeue", None), ok_op(0, "dequeue", "x")))
+    assert r["valid?"] is True
 
 
 # ------------------------------------------------------------- batching

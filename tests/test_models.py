@@ -83,5 +83,11 @@ def test_pack_spec_register():
 
 
 def test_pack_spec_unpackable():
-    assert models.pack_spec(UnorderedQueue(), Intern()) is None
-    assert models.pack_spec(GSet(), Intern()) is None
+    from jepsen_tpu.models import FIFOQueue
+    assert models.pack_spec(FIFOQueue(), Intern()) is None
+
+
+def test_pack_spec_gset_and_uqueue_pack():
+    # round 3: gset and unordered-queue gained device tiers
+    assert models.pack_spec(GSet(), Intern()).step_name == "gset"
+    assert models.pack_spec(UnorderedQueue(), Intern()).step_name == "uqueue"

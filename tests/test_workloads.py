@@ -288,6 +288,54 @@ class TestAdya:
         assert all(c <= 2 for c in per_key.values())
 
 
+# ------------------------------------------------- set linearizable mode
+
+
+class TestSetLinearizableDevice:
+    def test_set_workload_linearizable_mode_rides_device(self):
+        """The tendermint set workload's linearizable mode checks each
+        per-key GSet sub-history through the device engine (analyzer
+        :jax) — VERDICT round-2 ask #2: the set workload must not
+        silently take the host WGL path now that GSet packs."""
+        from jepsen_tpu.history import History, invoke_op, ok_op
+        from jepsen_tpu.independent import KV
+        from jepsen_tpu.tendermint import core as tm
+
+        wl = tm.workload({"nodes": ["n1"], "workload": "set",
+                          "linearizable": True})
+        assert "linear" in wl["checker"]
+        ops = []
+        for k in (0, 1):
+            for i in range(4):
+                ops.append(invoke_op(k, "add", KV(k, i)))
+                ops.append(ok_op(k, "add", KV(k, i)))
+            ops.append(invoke_op(k, "read", KV(k, None)))
+            ops.append(ok_op(k, "read", KV(k, list(range(4)))))
+        h = History.wrap(ops).index()
+        r = wl["checker"]["linear"].check({}, h)
+        assert r["valid?"] is True, r
+        for k, sub in r["results"].items():
+            assert sub.get("analyzer") == "jax", (k, sub)
+
+    def test_set_workload_linearizable_catches_lost_element(self):
+        from jepsen_tpu.history import History, invoke_op, ok_op
+        from jepsen_tpu.independent import KV
+        from jepsen_tpu.tendermint import core as tm
+
+        wl = tm.workload({"nodes": ["n1"], "workload": "set",
+                          "linearizable": True})
+        ops = [
+            invoke_op(0, "add", KV(9, 1)), ok_op(0, "add", KV(9, 1)),
+            invoke_op(0, "add", KV(9, 2)), ok_op(0, "add", KV(9, 2)),
+            # read drops element 1 after both adds acked: not linearizable
+            invoke_op(0, "read", KV(9, None)), ok_op(0, "read", KV(9, [2])),
+        ]
+        h = History.wrap(ops).index()
+        r = wl["checker"]["linear"].check({}, h)
+        assert r["valid?"] is False
+        assert r["results"][9]["analyzer"] == "jax"
+
+
 # ------------------------------------------------------------- cycle gen
 
 
