@@ -158,6 +158,54 @@ def test_counterexample_extraction_long_history():
         assert path, "empty path"
 
 
+@pytest.mark.slow
+def test_counterexample_window_cause_precedes_window():
+    """Adversarial placement for the windowed re-search: value 7 is
+    written at the very start of the history, overwritten two calls
+    later, and never written again; 700 valid ops follow; the final read
+    returns 7. The *cause* of the failure (the overwrite) sits ~700
+    return-events before the re-search window, so sound paths depend
+    entirely on the device-seeded frontier carrying the correct states
+    across the long prefix (engine.extract_final_paths; reference
+    semantics checker.clj:203-213)."""
+    from jepsen_tpu.models import CASRegister
+
+    body = rand_register_history(n_ops=700, n_processes=4, crash_p=0.0,
+                                 fail_p=0.0, n_values=5, seed=11)
+    ops = [{"process": 90, "type": "invoke", "f": "write", "value": 7},
+           {"process": 90, "type": "ok", "f": "write", "value": 7},
+           {"process": 90, "type": "invoke", "f": "write", "value": 0},
+           {"process": 90, "type": "ok", "f": "write", "value": 0}]
+    ops += [dict(o) for o in body]
+    ops += [{"process": 91, "type": "invoke", "f": "read", "value": None},
+            {"process": 91, "type": "ok", "f": "read", "value": 7}]
+    for i, o in enumerate(ops):
+        o["index"], o["time"] = i, i
+    h = _h(*ops)
+
+    r = engine.analysis(CASRegister(), h)
+    assert r["valid?"] is False
+    assert r["op"]["f"] == "read" and r["op"]["value"] == 7
+    assert r["final-paths"], r.get("final-paths-note")
+    # the windowed path ran, and the window starts long after the cause
+    start_ev, end_ev = r["final-paths-window"]
+    # the overwrite of 7 is at return-event ~1; the window starts
+    # hundreds of return events later (cas ops that legally failed are
+    # dropped by encode, so returns < calls)
+    assert start_ev > 400 and end_ev == r["fail-event"]
+
+    # soundness: every path op is a genuine call from the history (no
+    # fabricated linearizations), and no path linearizes a write of 7 —
+    # i.e. the seeds really carried "register != 7" across the prefix
+    invokes = {o["index"]: o for o in h if o["type"] == "invoke"}
+    for path in r["final-paths"]:
+        for step in path:
+            op = step["op"]
+            src = invokes[op["index"]]
+            assert src["f"] == op["f"]
+            assert not (op["f"] == "write" and op["value"] == 7)
+
+
 def test_window_calls_drops_past_and_linearized():
     from jepsen_tpu.history import Call
     cs = [
