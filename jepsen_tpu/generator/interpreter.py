@@ -235,12 +235,10 @@ def run(test) -> History:
             poll_timeout_us = 0
     except BaseException:
         # Abnormal exit: ask every worker to exit via its queue
-        # (interpreter.clj:294-310).
+        # (interpreter.clj:294-310). SimpleQueue is unbounded, so the
+        # exit op always enqueues.
         for w in workers:
-            try:
-                w.in_q.put_nowait({"type": "exit"})
-            except queue.Full:
-                pass
+            w.in_q.put({"type": "exit"})
         raise
 
 

@@ -8,17 +8,24 @@ search frontier, not the sequence"):
   * each of the D devices on the mesh owns N/D configuration rows;
   * the closure expands locally (vmap over local configs × slots);
   * dedupe is global: every config is **owned** by the device
-    `hash(config) % D`. Candidates are all-gathered over the mesh axis
-    (ICI), each device keeps the rows it owns, then sort-dedupes
-    locally. A config can therefore exist on exactly one device — the
-    union of per-device frontiers is the exact global config set. This
-    is the "device-sharded hash set deduped over the ICI mesh" of
-    BASELINE.json, realised with XLA collectives instead of NCCL;
+    `hash(config) % D`. A config can therefore exist on exactly one
+    device — the union of per-device frontiers is the exact global
+    config set. This is the "device-sharded hash set deduped over the
+    ICI mesh" of BASELINE.json, realised with XLA collectives instead
+    of NCCL;
+  * candidates travel by **owner-routed segmented all-to-all**: each
+    device sorts its legal candidates by owner, packs them into D
+    equal buckets of width B ≈ 2×(local candidates)/D (hash-uniform,
+    overflow psum-checked), and one `lax.all_to_all` delivers every
+    bucket to its owner. Per-device traffic is O(2·global/D) per round,
+    vs O(global) for the naive full all-gather — a D/2× reduction that
+    grows with mesh size (SURVEY.md §7.1 step 4's work exchange;
+    `exchange="gather"` keeps the broadcast path for A/B measurement);
   * liveness / convergence / overflow decisions ride `psum`s.
 
 The whole event scan runs inside one `shard_map` region: slot tables are
 replicated, frontier arrays stay device-local, and the only cross-device
-traffic is the closure's all-gather + psums.
+traffic is the closure's exchange + psums.
 """
 
 from __future__ import annotations
@@ -70,12 +77,48 @@ def _owned_dedupe_compact(st, ml, mh, live, Nd, n_dev, my_idx):
     return new_st, new_ml, new_mh, new_live, count, count > Nd
 
 
-def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int):
+def _route_to_owners(st, ml, mh, legal, n_dev: int, B: int):
+    """Owner-routed exchange (runs INSIDE shard_map): deliver each legal
+    row to the device `hash(row) % n_dev` via one segmented all-to-all.
+
+    Rows are sorted by owner (dead rows sink past bucket n_dev-1), each
+    owner's bucket is padded/truncated to the static width B, and
+    `lax.all_to_all(tiled)` swaps bucket d to device d. Returns the
+    received rows [n_dev*B] plus a local overflow flag (some bucket
+    exceeded B — the caller escalates to a capacity retry)."""
+    L = st.shape[0]
+    owner = (_hash_config(st, ml, mh) % jnp.uint32(n_dev)).astype(jnp.int32)
+    key = jnp.where(legal, owner, n_dev)
+    order = jnp.argsort(key)
+    st_s, ml_s, mh_s = st[order], ml[order], mh[order]
+    key_s = key[order]
+    starts = jnp.searchsorted(key_s, jnp.arange(n_dev))
+    rank = jnp.arange(L) - starts[jnp.clip(key_s, 0, n_dev - 1)]
+    in_bucket = (key_s < n_dev) & (rank < B)
+    ovf = jnp.any((key_s < n_dev) & (rank >= B))
+    pos = jnp.where(in_bucket, key_s * B + rank, n_dev * B)  # OOB -> drop
+    buf_st = jnp.zeros(n_dev * B, jnp.int32).at[pos].set(st_s, mode="drop")
+    buf_ml = jnp.zeros(n_dev * B, jnp.uint32).at[pos].set(ml_s, mode="drop")
+    buf_mh = jnp.zeros(n_dev * B, jnp.uint32).at[pos].set(mh_s, mode="drop")
+    buf_lv = jnp.zeros(n_dev * B, jnp.uint8).at[pos].set(
+        in_bucket.astype(jnp.uint8), mode="drop")
+    a2a = lambda a: lax.all_to_all(a, AXIS, split_axis=0, concat_axis=0,
+                                   tiled=True)
+    return (a2a(buf_st), a2a(buf_ml), a2a(buf_mh),
+            a2a(buf_lv).astype(bool), ovf)
+
+
+def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int,
+                  exchange: str = "route"):
     """Runs INSIDE shard_map: per-device view, mesh axis AXIS."""
     step = STEPS[step_name]
     C = xs["slot_f"].shape[1]
     bit_lo, bit_hi = _slot_bits(C)
     my_idx = lax.axis_index(AXIS).astype(jnp.uint32)
+    # owner-bucket widths: 2x the uniform share (hash-uniform slack),
+    # floored so tiny frontiers never trip the overflow path
+    B_cand = max(64, -(-2 * Nd * C // n_dev))
+    B_front = max(64, -(-2 * Nd // n_dev))
 
     step_cc = jax.vmap(
         jax.vmap(step, in_axes=(None, 0, 0, 0, 0)),
@@ -95,22 +138,30 @@ def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int):
                        | (mh[:, None] & bit_hi[None, :])) != 0
             legal = (live[:, None] & ev["slot_occ"][None, :]
                      & ~already & cand_ok)
-            # candidates ride the ICI ring: all-gather, keep owned rows
-            g_st = lax.all_gather(cand_st.reshape(-1), AXIS, tiled=True)
-            g_ml = lax.all_gather((ml[:, None] | bit_lo[None, :]).reshape(-1),
-                                  AXIS, tiled=True)
-            g_mh = lax.all_gather((mh[:, None] | bit_hi[None, :]).reshape(-1),
-                                  AXIS, tiled=True)
-            g_live = lax.all_gather(legal.reshape(-1), AXIS, tiled=True)
-            all_st = jnp.concatenate([st, g_st])
-            all_ml = jnp.concatenate([ml, g_ml])
-            all_mh = jnp.concatenate([mh, g_mh])
-            all_live = jnp.concatenate([live, g_live])
+            c_st = cand_st.reshape(-1)
+            c_ml = (ml[:, None] | bit_lo[None, :]).reshape(-1)
+            c_mh = (mh[:, None] | bit_hi[None, :]).reshape(-1)
+            c_live = legal.reshape(-1)
+            route_ovf = jnp.array(False)
+            if exchange == "route":
+                # owner-routed: each candidate travels once, to its owner
+                c_st, c_ml, c_mh, c_live, route_ovf = _route_to_owners(
+                    c_st, c_ml, c_mh, c_live, n_dev, B_cand)
+            else:
+                # broadcast: every candidate to every device (A/B path)
+                c_st = lax.all_gather(c_st, AXIS, tiled=True)
+                c_ml = lax.all_gather(c_ml, AXIS, tiled=True)
+                c_mh = lax.all_gather(c_mh, AXIS, tiled=True)
+                c_live = lax.all_gather(c_live, AXIS, tiled=True)
+            all_st = jnp.concatenate([st, c_st])
+            all_ml = jnp.concatenate([ml, c_ml])
+            all_mh = jnp.concatenate([mh, c_mh])
+            all_live = jnp.concatenate([live, c_live])
             old_n = lax.psum(jnp.sum(live), AXIS)
             st2, ml2, mh2, live2, cnt, ovf = _owned_dedupe_compact(
                 all_st, all_ml, all_mh, all_live, Nd, n_dev, my_idx)
             new_n = lax.psum(cnt, AXIS)
-            g_ovf = lax.psum(ovf.astype(jnp.int32), AXIS) > 0
+            g_ovf = lax.psum((ovf | route_ovf).astype(jnp.int32), AXIS) > 0
             return st2, ml2, mh2, live2, new_n > old_n, g_ovf
         return body
 
@@ -137,13 +188,19 @@ def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int):
         failed_here = run & (n_live == 0)
         # clearing the slot bit changed every survivor's hash — re-route
         # each config to its new owner device before the next closure
-        g_st = lax.all_gather(st2, AXIS, tiled=True)
-        g_ml = lax.all_gather(ml3, AXIS, tiled=True)
-        g_mh = lax.all_gather(mh3, AXIS, tiled=True)
-        g_live = lax.all_gather(live3, AXIS, tiled=True)
+        if exchange == "route":
+            r_st, r_ml, r_mh, r_live, rt_ovf = _route_to_owners(
+                st2, ml3, mh3, live3, n_dev, B_front)
+        else:
+            rt_ovf = jnp.array(False)
+            r_st = lax.all_gather(st2, AXIS, tiled=True)
+            r_ml = lax.all_gather(ml3, AXIS, tiled=True)
+            r_mh = lax.all_gather(mh3, AXIS, tiled=True)
+            r_live = lax.all_gather(live3, AXIS, tiled=True)
         st2, ml3, mh3, live3, _, r_ovf = _owned_dedupe_compact(
-            g_st, g_ml, g_mh, g_live, Nd, n_dev, my_idx)
-        ovf = ovf | (run & (lax.psum(r_ovf.astype(jnp.int32), AXIS) > 0))
+            r_st, r_ml, r_mh, r_live, Nd, n_dev, my_idx)
+        ovf = ovf | (run & (lax.psum((r_ovf | rt_ovf).astype(jnp.int32),
+                                     AXIS) > 0))
         new_ok = jnp.where(run, ~failed_here & ~ovf, ok)
         new_fail = jnp.where(failed_here & (fail_r < 0), r_idx, fail_r)
         st_o = jnp.where(run, st2, st)
@@ -170,11 +227,12 @@ def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int):
     return valid, fail_r, overflow, maxf
 
 
-@functools.partial(jax.jit, static_argnames=("step_name", "Nd", "n_dev", "mesh"))
+@functools.partial(jax.jit, static_argnames=("step_name", "Nd", "n_dev",
+                                             "mesh", "exchange"))
 def _check_sharded(xs, state0, step_name: str, Nd: int, n_dev: int,
-                   mesh: Mesh):
+                   mesh: Mesh, exchange: str = "route"):
     fn = jax.shard_map(
-        lambda x, s0: _sharded_impl(x, s0, step_name, Nd, n_dev),
+        lambda x, s0: _sharded_impl(x, s0, step_name, Nd, n_dev, exchange),
         mesh=mesh,
         in_specs=(P(), P()),       # tables + state replicated
         out_specs=(P(), P(), P(), P()),
@@ -185,9 +243,15 @@ def _check_sharded(xs, state0, step_name: str, Nd: int, n_dev: int,
 
 def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
                           capacity: int = 8192,
-                          max_capacity: int = 1 << 22) -> dict:
+                          max_capacity: int = 1 << 22,
+                          exchange: str = "route") -> dict:
     """Check one encoded history with the frontier sharded over `mesh`'s
-    first axis. `capacity` is the GLOBAL frontier capacity."""
+    first axis. `capacity` is the GLOBAL frontier capacity; it doubles
+    on overflow (frontier past capacity, or an owner bucket past its
+    2x-uniform slack) by re-jitting at the next tier, like
+    `engine.check_encoded`. `exchange` picks the candidate exchange:
+    "route" (owner-routed segmented all-to-all, the default) or
+    "gather" (full all-gather broadcast, kept for A/B measurement)."""
     if e.n_returns == 0:
         return {"valid?": True, "max-frontier": 0, "capacity": 0}
     # flatten whatever mesh we're given onto a 1-D mesh named AXIS
@@ -203,7 +267,7 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
     while True:
         Nd = (N + n_dev - 1) // n_dev
         valid, fail_r, overflow, maxf = _check_sharded(
-            xs, state0, e.step_name, Nd, n_dev, mesh)
+            xs, state0, e.step_name, Nd, n_dev, mesh, exchange)
         if not bool(overflow):
             break
         if N * 2 > max_capacity:
@@ -217,3 +281,33 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
         from jepsen_tpu.parallel.encode import fail_op_fields
         out.update(fail_op_fields(e, int(fail_r)))
     return out
+
+
+def analysis(model, history, mesh: Mesh, capacity: int = 8192,
+             max_capacity: int = 1 << 22, exchange: str = "route") -> dict:
+    """knossos-style (model, history) -> result with the frontier
+    sharded over `mesh`; on failure, counterexample paths come from the
+    same windowed host re-search as `engine.analysis` (the seed frontier
+    is re-derived on one device — the sharded union equals the
+    single-device frontier by construction)."""
+    from jepsen_tpu.history import History
+    from jepsen_tpu.parallel import encode as enc, engine
+    h = history if isinstance(history, History) else History.wrap(history)
+    try:
+        e = enc.encode(model, h)
+    except enc.EncodeError as err:
+        # same host fallback as engine.analysis — the two entry points
+        # must be interchangeable for non-packable inputs
+        from jepsen_tpu.checker import wgl
+        import logging
+        logging.getLogger(__name__).warning(
+            "history not device-checkable (%s) — using the host WGL "
+            "engine; expect it to be orders of magnitude slower", err)
+        r = wgl.analysis(model, h)
+        r["fallback"] = str(err)
+        return r
+    r = check_encoded_sharded(e, mesh, capacity=capacity,
+                              max_capacity=max_capacity, exchange=exchange)
+    if r["valid?"] is False:
+        r.update(engine.extract_final_paths(model, e, int(r["fail-event"])))
+    return r

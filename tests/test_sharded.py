@@ -45,3 +45,82 @@ def test_sharded_counterexample():
     r = sharded.check_encoded_sharded(e, mesh, capacity=256)
     assert r["valid?"] is False
     assert r["op"]["f"] == "read" and r["op"]["value"] == 2
+
+
+def _wide_frontier_history(n_crashed=10, read_value=3):
+    """n_crashed concurrent crashed writes of distinct values, then one
+    ok read: at the read's return the closure explores every subset of
+    the crashed writes — the global frontier peaks around
+    n_crashed * 2^(n_crashed-1) configs, far past one device's share of
+    a small initial capacity."""
+    from jepsen_tpu.history import History, invoke_op, ok_op, info_op
+    ops = []
+    for v in range(1, n_crashed + 1):
+        ops.append(invoke_op(v, "write", v))
+    for v in range(1, n_crashed + 1):
+        ops.append(info_op(v, "write", v))
+    ops.append(invoke_op(0, "read", None))
+    ops.append(ok_op(0, "read", read_value))
+    return History.wrap(ops).index()
+
+
+def test_sharded_frontier_past_one_device_grows_capacity():
+    """Pushes the global frontier well past one device's share of the
+    starting capacity: the engine must double through several tiers
+    (the same overflow policy as engine.check_encoded) and still agree
+    with the host oracle. Exercises the owner-routed exchange and the
+    rehash/compaction path under a deep closure (10 crashed slots ->
+    ~5k configs rehashed every round)."""
+    mesh = _mesh()
+    h = _wide_frontier_history(n_crashed=10, read_value=3)
+    e = enc_mod.encode(CASRegister(), h)
+    r = sharded.check_encoded_sharded(e, mesh, capacity=512)
+    expect = wgl.analysis(CASRegister(), h)["valid?"]
+    assert r["valid?"] is expect is True
+    assert r["capacity"] > 512, "expected capacity growth"
+    # the peak global frontier would not fit on any single device's
+    # share — sharding, not padding, is what made this run
+    assert r["max-frontier"] > r["capacity"] // r["devices"], r
+
+    # invalid variant: a read of a never-written value must fail at the
+    # same wide-closure event
+    hb = _wide_frontier_history(n_crashed=10, read_value=99)
+    eb = enc_mod.encode(CASRegister(), hb)
+    rb = sharded.check_encoded_sharded(eb, mesh, capacity=512)
+    assert rb["valid?"] is False
+    assert rb["op"]["f"] == "read" and rb["op"]["value"] == 99
+
+
+def test_sharded_route_and_gather_agree():
+    """The owner-routed all-to-all exchange and the broadcast all-gather
+    exchange are two implementations of the same global dedupe — they
+    must produce identical results and frontier statistics."""
+    mesh = _mesh()
+    h = _wide_frontier_history(n_crashed=8, read_value=2)
+    e = enc_mod.encode(CASRegister(), h)
+    r_route = sharded.check_encoded_sharded(e, mesh, capacity=512,
+                                            exchange="route")
+    r_gather = sharded.check_encoded_sharded(e, mesh, capacity=512,
+                                             exchange="gather")
+    assert r_route == r_gather, (r_route, r_gather)
+
+
+def test_sharded_1k_invalid_end_to_end():
+    """A >=1k-op invalid history checked end-to-end on the 8-device
+    mesh, counterexample included (the VERDICT r2 ask: multi-chip
+    correctness must not rest on 16-48-op smoke histories)."""
+    h = rand_register_history(n_ops=1000, n_processes=6, crash_p=0.005,
+                              fail_p=0.03, n_values=5, seed=2026)
+    ops = [dict(o) for o in h]
+    n = len(ops)
+    ops += [{"index": n, "time": ops[-1]["time"] + 1, "process": 95,
+             "type": "invoke", "f": "read", "value": None},
+            {"index": n + 1, "time": ops[-1]["time"] + 2, "process": 95,
+             "type": "ok", "f": "read", "value": "never-written"}]
+    from jepsen_tpu.history import History
+    hb = History.wrap(ops).index()
+    r = sharded.analysis(CASRegister(), hb, _mesh(), capacity=1024)
+    assert r["valid?"] is False
+    assert r["op"]["value"] == "never-written"
+    assert r["devices"] == 8
+    assert r["final-paths"], r.get("final-paths-note")
