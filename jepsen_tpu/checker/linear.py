@@ -44,7 +44,13 @@ def _events(cs: List[Call]) -> List[Tuple[int, int, int]]:
 
 
 def check_calls(model, cs: List[Call], n_history: int,
-                max_configs: int = 2_000_000) -> dict:
+                max_configs: int = 2_000_000,
+                deadline: Optional[float] = None) -> dict:
+    """With `deadline` (a time.monotonic() instant), the search returns
+    {"valid?": "unknown", "timeout": True, "events-done": k, ...} when
+    the budget runs out — cooperative, checked once per return event,
+    so benchmark timeouts measure real search progress."""
+    import time as _time
     if not cs:
         return {"valid?": True, "configs": [], "final-paths": []}
     step_ops = [_StepOp(c) for c in cs]
@@ -52,11 +58,17 @@ def check_calls(model, cs: List[Call], n_history: int,
     configs = {(model, frozenset())}
     explored = 0
     max_frontier = 1
+    events_done = 0
 
     for pos, kind, cid in _events(cs):
+        if deadline is not None and _time.monotonic() > deadline:
+            return {"valid?": "unknown", "timeout": True,
+                    "events-done": events_done, "explored": explored,
+                    "max-frontier": max_frontier}
         if kind == 0:
             open_calls.add(cid)
             continue
+        events_done += 1
         # return event: closure, then require cid linearized
         frontier = set(configs)
         while frontier:
@@ -99,9 +111,11 @@ def check_calls(model, cs: List[Call], n_history: int,
             "max-frontier": max_frontier, "configs": [], "final-paths": []}
 
 
-def analysis(model, history, max_configs: int = 2_000_000) -> dict:
+def analysis(model, history, max_configs: int = 2_000_000,
+             deadline: Optional[float] = None) -> dict:
     """knossos.linear/analysis equivalent."""
     from jepsen_tpu.history import History, prune_wildcard_calls
     h = history if isinstance(history, History) else History.wrap(history)
     cs = prune_wildcard_calls(history_calls(h))
-    return check_calls(model, cs, len(h), max_configs=max_configs)
+    return check_calls(model, cs, len(h), max_configs=max_configs,
+                       deadline=deadline)

@@ -82,7 +82,17 @@ def base_parser(prog: str = "jepsen") -> argparse.ArgumentParser:
     s = sub.add_parser("serve", help="serve stored results over HTTP")
     s.add_argument("--port", type=int, default=8080)
     s.add_argument("--host", default="0.0.0.0")
-    p._jepsen_subparsers = {"test": t, "analyze": a, "serve": s}
+    ta = sub.add_parser(
+        "test-all", help="run a whole suite of tests in one go")
+    common(ta)
+    ta.add_argument("--workloads",
+                    help="comma-separated workload sweep (default: the "
+                         "single --workload)")
+    ta.add_argument("--nemeses",
+                    help="comma-separated nemesis sweep (default: the "
+                         "single --nemesis)")
+    p._jepsen_subparsers = {"test": t, "analyze": a, "serve": s,
+                            "test-all": ta}
     return p
 
 
@@ -155,6 +165,70 @@ def run_analyze_cmd(test_fn: Callable[[Dict], Dict], args) -> int:
     return validity_exit_code(results)
 
 
+def _sweep_tests(args, opts):
+    """The default tests-fn for test-all: the cross product of
+    --workloads x --nemeses, each repeated --test-count times."""
+    workloads = [w.strip() for w in (args.workloads or "").split(",")
+                 if w.strip()] or [opts.get("workload")]
+    nemeses = [n.strip() for n in (args.nemeses or "").split(",")
+               if n.strip()] or [opts.get("nemesis")]
+    for w in workloads:
+        for n in nemeses:
+            for _ in range(max(1, opts.get("test-count") or 1)):
+                o = dict(opts)
+                o["workload"] = w
+                o["nemesis"] = n
+                yield f"{w or 'default'}:{n or 'none'}", o
+
+
+def run_test_all_cmd(test_fn: Callable[[Dict], Dict], args,
+                     tests_fn: Optional[Callable] = None) -> int:
+    """Run a suite of tests, collate outcomes, print a summary, and exit
+    255 if any crashed / 2 if any unknown / 1 if any invalid / 0 if all
+    passed (cli.clj:421-503 test-all-cmd + test-all-exit!).
+
+    tests_fn(opts) may yield (name, options) pairs to override the
+    default --workloads x --nemeses sweep."""
+    opts = options_from_args(args)
+    pairs = (tests_fn(opts) if tests_fn is not None
+             else _sweep_tests(args, opts))
+    outcomes: Dict = {}  # True | False | "unknown" | "crashed" -> [runs]
+    for name, o in pairs:
+        try:
+            completed = jcore.run(test_fn(o))
+            v = completed["results"].get("valid?")
+            key = v if v in (True, False) else "unknown"
+            run_ref = str(getattr(completed.get("store"), "dir", name))
+            outcomes.setdefault(key, []).append(run_ref)
+            print(json.dumps({"test": name, "valid?": v,
+                              "store": run_ref}, default=str))
+        except KeyboardInterrupt:
+            raise
+        except Exception:  # noqa: BLE001 — one crash must not end the sweep
+            traceback.print_exc()
+            outcomes.setdefault("crashed", []).append(name)
+    for title, key in (("Successful tests", True),
+                       ("Indeterminate tests", "unknown"),
+                       ("Crashed tests", "crashed"),
+                       ("Failed tests", False)):
+        if outcomes.get(key):
+            print(f"\n# {title}\n")
+            for r in outcomes[key]:
+                print(r)
+    print()
+    print(len(outcomes.get(True, [])), "successes")
+    print(len(outcomes.get("unknown", [])), "unknown")
+    print(len(outcomes.get("crashed", [])), "crashed")
+    print(len(outcomes.get(False, [])), "failures")
+    if outcomes.get("crashed"):
+        return EXIT_CRASH
+    if outcomes.get("unknown"):
+        return EXIT_UNKNOWN
+    if outcomes.get(False):
+        return EXIT_INVALID
+    return EXIT_VALID
+
+
 def run_serve_cmd(args) -> int:
     from jepsen_tpu import web
     web.serve(host=args.host, port=args.port)
@@ -163,11 +237,14 @@ def run_serve_cmd(args) -> int:
 
 def run_cli(test_fn: Optional[Callable[[Dict], Dict]] = None,
             argv: Optional[list] = None, prog: str = "jepsen",
-            extend_parser: Optional[Callable] = None) -> int:
+            extend_parser: Optional[Callable] = None,
+            tests_fn: Optional[Callable] = None) -> int:
     """Main dispatcher (cli.clj:246-322). test_fn builds a test map from
     parsed options; defaults to the noop test. extend_parser(parser)
     may add suite-specific flags (parser._jepsen_subparsers maps
-    subcommand names to their subparsers)."""
+    subcommand names to their subparsers). tests_fn(opts), if given,
+    yields (name, options) pairs for the test-all sweep
+    (cli.clj:478-503's :tests-fn)."""
     if test_fn is None:
         test_fn = lambda opts: jcore.make_test(  # noqa: E731
             {"nodes": opts["nodes"], "ssh": opts["ssh"],
@@ -185,6 +262,8 @@ def run_cli(test_fn: Optional[Callable[[Dict], Dict]] = None,
     try:
         if args.command == "test":
             return run_test_cmd(test_fn, args)
+        if args.command == "test-all":
+            return run_test_all_cmd(test_fn, args, tests_fn=tests_fn)
         if args.command == "analyze":
             return run_analyze_cmd(test_fn, args)
         if args.command == "serve":

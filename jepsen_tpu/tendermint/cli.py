@@ -22,7 +22,7 @@ from jepsen_tpu.tendermint import db as td
 def extend_parser(p):
     # --workload / --nemesis already exist on the base parser; add only
     # the suite-specific flags (cli.clj:8-19).
-    for sp_name in ("test", "analyze"):
+    for sp_name in ("test", "analyze", "test-all"):
         sp = p._jepsen_subparsers[sp_name]
         sp.add_argument("--local", action="store_true",
                         help="single local native merkleeyes, no cluster")

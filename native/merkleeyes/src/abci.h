@@ -183,14 +183,16 @@ inline bytes handle(App& app, const bytes& req_body) {
       bytes data;
       std::string path;
       int64_t height = 0;
+      bool prove = false;
       uint32_t f, w;
       while (req.next(f, w)) {
         if (f == 1 && w == pb::kLen) data = req.len_bytes();
         else if (f == 2 && w == pb::kLen) path = req.len_string();
         else if (f == 3 && w == pb::kVarint) height = int64_t(req.varint());
-        else req.skip(w);  // prove:4 — app.query rejects proofs itself
+        else if (f == 4 && w == pb::kVarint) prove = req.varint() != 0;
+        else req.skip(w);
       }
-      QueryResult q = app.query(path, data, height);
+      QueryResult q = app.query(path, data, height, prove);
       bytes body;
       pb::varint_field(body, 1, q.code);
       pb::string_field(body, 3, q.log);

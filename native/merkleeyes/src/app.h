@@ -140,11 +140,17 @@ class App {
   }
 
   QueryResult query(const std::string& path, const bytes& data,
-                    int64_t req_height = 0) const {  // app.go:158-217
+                    int64_t req_height = 0,
+                    bool prove = false) const {  // app.go:158-217
     QueryResult res;
     if (req_height != 0) {
       res.code = InternalError;
       res.log = "merkleeyes only supports queries on latest commit";
+      return res;
+    }
+    if (prove) {  // app.go:174-176
+      res.code = InternalError;
+      res.log = "Query with proof is not supported";
       return res;
     }
     res.height = height_;

@@ -129,6 +129,44 @@ def test_cli_invalid_exit_code():
     assert code == jcli.EXIT_INVALID
 
 
+def test_cli_test_all_sweep(capsys):
+    """test-all runs the whole sweep, collates outcomes, prints the
+    summary sections, and exits with the worst outcome (cli.clj:478-503
+    test-all-cmd, test-all-exit!: crashed > unknown > invalid > valid)."""
+    def switching_test_fn(opts):
+        t = _register_test_fn(opts)
+        t["name"] = f"sweep-{opts.get('workload')}-{opts.get('nemesis')}"
+        if opts.get("workload") == "bad":
+            t["checker"] = FnChecker(lambda *a: {"valid?": False})
+        return t
+
+    code = jcli.run_cli(switching_test_fn,
+                        ["test-all", "--no-ssh",
+                         "--workloads", "good,bad",
+                         "--nemeses", "none"])
+    out = capsys.readouterr().out
+    assert code == jcli.EXIT_INVALID
+    assert "1 successes" in out and "1 failures" in out
+    assert "# Failed tests" in out
+
+    code = jcli.run_cli(switching_test_fn,
+                        ["test-all", "--no-ssh", "--workloads", "good"])
+    assert code == jcli.EXIT_VALID
+
+    # a crashing test map must not end the sweep, and wins the exit code
+    def crashing_test_fn(opts):
+        if opts.get("workload") == "boom":
+            raise RuntimeError("kaboom")
+        return switching_test_fn(opts)
+
+    code = jcli.run_cli(crashing_test_fn,
+                        ["test-all", "--no-ssh",
+                         "--workloads", "good,boom,bad"])
+    assert code == jcli.EXIT_CRASH
+    out = capsys.readouterr().out
+    assert "1 crashed" in out and "1 successes" in out
+
+
 def test_cli_unknown_exit_code():
     def unk_test_fn(opts):
         t = _register_test_fn(opts)

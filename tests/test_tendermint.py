@@ -464,3 +464,18 @@ def test_cli_local_run(tmp_path, monkeypatch):
                       "--workload", "cas-register", "--nemesis", "none",
                       "--time-limit", "3", "--concurrency", "4"])
     assert code == 0
+
+
+def test_cli_test_all_local(tmp_path, monkeypatch, capsys):
+    """test-all sweeps two local configs (cas-register and set) through
+    LocalMerkleeyesDB and collates both as successes (the reference's
+    multi-test runner, cli.clj:478-503)."""
+    from jepsen_tpu.tendermint import cli as tcli
+    monkeypatch.chdir(tmp_path)
+    code = tcli.main(["test-all", "--local", "--node", "n1",
+                      "--workloads", "cas-register,set",
+                      "--nemeses", "none",
+                      "--time-limit", "3", "--concurrency", "4"])
+    out = capsys.readouterr().out
+    assert code == 0, out
+    assert "2 successes" in out and "0 failures" in out
