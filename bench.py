@@ -1,73 +1,284 @@
-"""Benchmark: linearizability checking throughput, device engine vs host.
+"""Benchmark: linearizability-check throughput, device engines vs host.
 
-The north-star metric (BASELINE.md): ops/sec of linearizability checking
-on a 10k-op Tendermint-shaped cas-register history. The reference's
-cas-register workload rotates keys every 120 ops with 2n=10 worker
-threads (tendermint/src/jepsen/tendermint/core.clj:351-361), so a 10k-op
-history is ~84 independent per-key subhistories — exactly what
-jepsen.independent feeds the checker per key. The CPU baseline is this
-repo's host JIT-linearization engine (the same algorithm knossos.linear
-runs), timed on a sample of keys; the device number is the batched dense
-TPU engine checking all keys in one program (including host->device
-encode time).
+The north-star metric (BASELINE.md): knossos ops/sec checked and max
+history length verified @ 60s budget, target >= 100x a 32-core host on
+adversarial histories. Emits one JSON line per sub-metric, HEADLINE
+LAST (the driver parses `{"metric", "value", "unit", "vs_baseline"}`):
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+1. multi-key north-star shape — 84 keys x 120 ops (the reference's
+   cas-register workload: 120-op keys via jepsen.independent,
+   tendermint/src/jepsen/tendermint/core.clj:351-361), device
+   end-to-end with the encode/device split reported, vs a measured
+   host-engine baseline scaled to a MODELED 32-core box (ideal linear
+   scaling — generous to the host; per-key checks parallelize
+   perfectly, so 32x is the host's true ceiling).
+2. adversarial single-key histories at 1k/5k/10k/50k ops
+   (histories.adversarial_register_history: k crashed writes held open
+   forever -> the host search carries 2^k configs through every event,
+   the regime where knossos dies; SURVEY.md §2.10). Host runs under a
+   cooperative deadline and reports real progress (events done), from
+   which its full-run time is estimated. NOTE: a single key cannot be
+   parallelized by knossos (linear/wgl are single-threaded per key),
+   so no 32x scaling is applied to this baseline — stated in the
+   methodology field.
+3. frontier-sharded engine on the same 10k history over all local
+   devices (1-device mesh on a single chip; the 8-device path is
+   exercised by tests/test_sharded.py and the driver dryrun).
+4. max history length verified within a 60s device budget
+   (steady-state device time; compiles excluded and reported).
+
+The host baseline is this repo's own `checker.linear` (the same
+JIT-linearization algorithm knossos.linear runs, checker.clj:194-200).
+Caveat, stated rather than fudged: a JVM knossos would run this Python
+baseline's algorithm some constant factor faster; the adversarial
+speedups measured here are orders of magnitude above that factor.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
-import time
+from time import monotonic, perf_counter
 
-N_KEYS = 84
-OPS_PER_KEY = 120          # reference per-key cap
-N_PROCESSES = 14           # concurrent workers per key
-BUSY = 0.8                 # high overlap: realistic contention windows
-HOST_SAMPLE_KEYS = 4
+# -------- north-star multi-key shape (reference workload dimensions)
+SMOKE = os.environ.get("BENCH_SMOKE") == "1"   # tiny shapes for CI/CPU
+N_KEYS = 8 if SMOKE else 84
+OPS_PER_KEY = 40 if SMOKE else 120
+N_PROCESSES = 14
+BUSY = 0.8
+HOST_SAMPLE_KEYS = 2 if SMOKE else 4
 SEED = 2024
+
+# -------- adversarial single-key shape
+ADV_K = 8 if SMOKE else 12       # crashed writes held open: 2^k configs
+ADV_SIZES = [200, 400] if SMOKE else [1000, 5000, 10000, 50000]
+HOST_DEADLINES = ({200: 10.0, 400: 5.0} if SMOKE
+                  else {1000: 45.0, 5000: 20.0, 10000: 25.0, 50000: 15.0})
+BUDGET_SECS = float(os.environ.get("BENCH_BUDGET_SECS", "900"))
+
+
+def emit(obj):
+    print(json.dumps(obj), flush=True)
+
+
+def note(msg):
+    print(f"# {msg}", file=sys.stderr, flush=True)
 
 
 def main():
-    from jepsen_tpu.histories import rand_register_history
+    from jepsen_tpu.histories import (
+        adversarial_register_history, rand_register_history)
     from jepsen_tpu.models import CASRegister
-    from jepsen_tpu.parallel import engine
     from jepsen_tpu.checker import linear
+    from jepsen_tpu.parallel import bitdense, encode as enc_mod, engine
+    from jepsen_tpu.util import bounded_pmap
 
     model = CASRegister()
+    t_start = monotonic()
+
+    def left():
+        return BUDGET_SECS - (monotonic() - t_start)
+
+    # ---------------- 1. multi-key north-star shape --------------------
     keys = [rand_register_history(
         n_ops=OPS_PER_KEY, n_processes=N_PROCESSES, n_values=5,
         crash_p=0.005, fail_p=0.05, busy=BUSY, seed=SEED + k)
         for k in range(N_KEYS)]
     total_ops = N_KEYS * OPS_PER_KEY
 
-    # --- host baseline: same algorithm, per-key, sample + extrapolate
-    t0 = time.perf_counter()
-    for h in keys[:HOST_SAMPLE_KEYS]:
-        rh = linear.analysis(model, h)
-        assert rh["valid?"] is True, rh
-    host_secs = time.perf_counter() - t0
-    host_ops_per_sec = HOST_SAMPLE_KEYS * OPS_PER_KEY / host_secs
-
-    # --- device engine: all keys in one batched program
-    engine.check_batch(model, keys)  # warm-up: jit compile
-    t0 = time.perf_counter()
-    rs = engine.check_batch(model, keys)
-    dev_secs = time.perf_counter() - t0
+    t0 = perf_counter()
+    pre = [enc_mod.encode(model, h) for h in keys]
+    encode_secs = perf_counter() - t0
+    S_max = max(bitdense.n_states(e) for e in pre)
+    C_max = max(e.n_slots for e in pre)
+    assert bitdense.fits_bitdense(S_max, C_max), (S_max, C_max)
+    bitdense.check_batch_bitdense(pre)          # warm up (jit compile)
+    t0 = perf_counter()
+    rs = bitdense.check_batch_bitdense(pre)
+    device_secs = perf_counter() - t0
     assert all(r["valid?"] is True for r in rs), rs[:3]
-    dev_ops_per_sec = total_ops / dev_secs
+    e2e_secs = encode_secs + device_secs
+    dev_rate = total_ops / e2e_secs
 
-    print(json.dumps({
-        "metric": "linearizability check throughput "
-                  "(10k-op multi-key cas-register history)",
-        "value": round(dev_ops_per_sec, 1),
-        "unit": "ops/sec",
-        "vs_baseline": round(dev_ops_per_sec / host_ops_per_sec, 2),
-    }))
-    print(f"# device: {dev_secs:.3f}s for {total_ops} ops across {N_KEYS} "
-          f"keys (incl. encode); host: {host_secs:.3f}s for "
-          f"{HOST_SAMPLE_KEYS * OPS_PER_KEY} ops "
-          f"({host_ops_per_sec:.0f} ops/s)", file=sys.stderr)
+    # Sequential single-core measurement, then an EXPLICIT x32 ideal-
+    # scaling model. (A thread pool would be GIL-bound here — pure-
+    # Python search threads serialize — so measuring "parallel" wall
+    # time would just re-measure one core and, on a many-core box,
+    # silently present a single-core rate as the 32-core baseline.)
+    t0 = perf_counter()
+    for h in keys[:HOST_SAMPLE_KEYS]:
+        rh = linear.analysis(model, h, deadline=monotonic() + 60)
+        assert rh["valid?"] is True, rh
+    host_secs = perf_counter() - t0
+    host_rate = HOST_SAMPLE_KEYS * OPS_PER_KEY / host_secs
+    host32_rate = host_rate * 32
+
+    emit({"metric": f"multi-key {N_KEYS}x{OPS_PER_KEY}-op cas-register "
+                    f"(north-star shape), device end-to-end",
+          "value": round(dev_rate, 1), "unit": "ops/sec",
+          "vs_baseline": round(dev_rate / host32_rate, 2),
+          "device_only_secs": round(device_secs, 3),
+          "encode_secs": round(encode_secs, 3),
+          "device_only_ops_per_sec": round(total_ops / device_secs, 1),
+          "host_seq_ops_per_sec": round(host_rate, 1),
+          "host_cpus": os.cpu_count() or 1,
+          "baseline": "host engine: single-core measured sequentially, "
+                      "x32 ideal scaling modeled (per-key checks "
+                      "parallelize perfectly, so 32x is the host's true "
+                      "ceiling)"})
+
+    # ---------------- 2. adversarial single-key ------------------------
+    adv_results = {}
+    adv_enc = {}     # L -> encoded history, reused by sections 3 and 4
+
+    def adv_encoded(L):
+        if L not in adv_enc:
+            h = adversarial_register_history(n_ops=L, k_crashed=ADV_K,
+                                             seed=7)
+            adv_enc[L] = (h, enc_mod.encode(model, h))
+        return adv_enc[L]
+
+    for L in ADV_SIZES:
+        if left() < 90:
+            emit({"metric": f"adversarial single-key {L}-op", "value": None,
+                  "unit": "ops/sec", "skipped": "bench budget exhausted"})
+            continue
+        h, e = adv_encoded(L)
+        assert bitdense.fits_bitdense(bitdense.n_states(e), e.n_slots)
+        t0 = perf_counter()
+        r = bitdense.check_encoded_bitdense(e)      # cold (compile per R)
+        warm_secs = perf_counter() - t0
+        t0 = perf_counter()
+        r = bitdense.check_encoded_bitdense(e)      # steady state
+        dev_secs = perf_counter() - t0
+        assert r["valid?"] is True, r
+        R = e.n_returns
+
+        host_info = {"deadline_secs": HOST_DEADLINES[L]}
+        if left() > HOST_DEADLINES[L] + 30:
+            t0 = perf_counter()
+            rh = linear.analysis(model, h,
+                                 deadline=monotonic() + HOST_DEADLINES[L])
+            host_wall = perf_counter() - t0
+            if rh.get("timeout"):
+                done = max(1, rh.get("events-done", 1))
+                host_est = host_wall * R / done
+                host_info.update({"timeout": True, "events_done": done,
+                                  "of_events": R,
+                                  "est_total_secs": round(host_est, 1)})
+            else:
+                assert rh["valid?"] is True, rh
+                host_est = host_wall
+                host_info.update({"timeout": False,
+                                  "total_secs": round(host_wall, 1)})
+        else:
+            # out of budget: scale the previous size's measured rate
+            idx = ADV_SIZES.index(L)
+            prev = adv_results.get(ADV_SIZES[idx - 1]) if idx > 0 else None
+            host_est = (prev["host_est"] * (L / prev["L"])
+                        if prev and prev["host_est"] is not None else None)
+            host_info.update({"skipped": "bench budget",
+                              "est_total_secs": round(host_est, 1)
+                              if host_est else None})
+
+        speedup = round(host_est / dev_secs, 1) if host_est else None
+        adv_results[L] = {"L": L, "dev_secs": dev_secs,
+                          "host_est": host_est, "speedup": speedup}
+        emit({"metric": f"adversarial single-key {L}-op cas-register "
+                        f"(2^{ADV_K} open configs), device",
+              "value": round(L / dev_secs, 1), "unit": "ops/sec",
+              "vs_baseline": speedup,
+              "device_secs": round(dev_secs, 2),
+              "device_compile_secs": round(warm_secs - dev_secs, 2),
+              "host": host_info,
+              "baseline": "host engine, single-threaded — a single key "
+                          "cannot be parallelized by knossos linear/wgl, "
+                          "so no 32x scaling applies"})
+
+    # ---------------- 3. sharded engine on the local mesh --------------
+    if 10000 in adv_results and left() > 120:
+        import jax
+        from jax.sharding import Mesh
+        import numpy as np
+        from jepsen_tpu.parallel import sharded
+        _, e = adv_encoded(10000)
+        mesh = Mesh(np.array(jax.devices()), ("frontier",))
+        cap = 1 << 17
+        t0 = perf_counter()
+        r = sharded.check_encoded_sharded(e, mesh, capacity=cap,
+                                          max_capacity=1 << 20)
+        warm = perf_counter() - t0
+        t0 = perf_counter()
+        r = sharded.check_encoded_sharded(e, mesh,
+                                          capacity=r.get("capacity", cap),
+                                          max_capacity=1 << 20)
+        dev_secs = perf_counter() - t0
+        emit({"metric": "adversarial 10k-op via frontier-sharded engine",
+              "value": round(10000 / dev_secs, 1), "unit": "ops/sec",
+              "vs_baseline": round(adv_results[10000]["host_est"] / dev_secs,
+                                   1) if adv_results[10000]["host_est"]
+              else None,
+              "devices": r.get("devices"), "valid": r.get("valid?"),
+              "device_secs": round(dev_secs, 2),
+              "note": "owner-routed all-to-all exchange; multi-device "
+                      "behavior exercised on the 8-way CPU mesh in CI"})
+
+    # ---------------- 4. max length verified @ 60s ---------------------
+    max_len = 0
+    budget_per_run = 5 if SMOKE else 60
+    L = 400 if SMOKE else 10000
+    prev_dt = None
+    while left() > 2.5 * budget_per_run:
+        if prev_dt is not None and prev_dt * 2 > 1.5 * budget_per_run:
+            break   # doubling would clearly blow the budget; stop early
+        _, e = adv_encoded(L)
+        bitdense.check_encoded_bitdense(e)          # compile, uncounted
+        t0 = perf_counter()
+        r = bitdense.check_encoded_bitdense(e)
+        dt = perf_counter() - t0
+        assert r["valid?"] is True, r
+        note(f"max-length probe L={L}: {dt:.1f}s steady")
+        if dt <= budget_per_run:
+            max_len = L
+            L *= 2
+            prev_dt = dt
+        else:
+            break
+    if max_len:
+        emit({"metric": f"max adversarial (2^{ADV_K}-config) history "
+                        f"length verified @ {budget_per_run}s device "
+                        f"budget",
+              "value": max_len, "unit": "ops",
+              "vs_baseline": None,
+              "note": "steady-state device time; per-shape compile "
+                      "excluded (one-time, cached)"})
+
+    # ---------------- HEADLINE (last line: the driver's record) --------
+    # prefer 10k (the BASELINE.md config); else the largest that ran
+    ten_k = adv_results.get(10000)
+    if ten_k is None and adv_results:
+        ten_k = adv_results[max(adv_results)]
+    if ten_k is not None:
+        emit({"metric": f"adversarial {ten_k['L']}-op single-key "
+                        f"cas-register linearizability check "
+                        f"(2^{ADV_K} open configs)",
+              "value": round(ten_k["L"] / ten_k["dev_secs"], 1),
+              "unit": "ops/sec",
+              "vs_baseline": ten_k["speedup"],
+              "methodology": "vs this repo's host engine (same algorithm "
+                             "as knossos.linear) measured under a "
+                             "deadline on the same history; single-key "
+                             "search does not parallelize, so the "
+                             "single-core host rate IS the 32-core rate"})
+    else:
+        # budget ran out before any adversarial size finished: fall back
+        # to the multi-key line so the driver still records a headline
+        emit({"metric": f"multi-key {N_KEYS}x{OPS_PER_KEY}-op "
+                        f"cas-register, device end-to-end",
+              "value": round(dev_rate, 1),
+              "unit": "ops/sec",
+              "vs_baseline": round(dev_rate / host32_rate, 2)})
 
 
 if __name__ == "__main__":

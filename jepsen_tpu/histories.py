@@ -207,6 +207,47 @@ def rand_queue_history(
                      choose, complete, crash)
 
 
+def adversarial_register_history(
+    n_ops: int = 1000,
+    k_crashed: int = 12,
+    n_values: int = 5,
+    seed: int = 45100,
+) -> History:
+    """The knossos-killer shape: k concurrent crashed writes of distinct
+    values opened at the start and never completed, followed by a
+    sequential write/read tail. Every crashed write stays open forever
+    (knossos completes :info ops at history end — SURVEY.md §2.10), so
+    the search must carry ~2^k linearized-subset configurations through
+    EVERY later event: the host's per-config frontier walk grinds at
+    ~2^k work per return, while the bit-packed device engine's cost is
+    independent of the live frontier (the whole mask space is a static
+    [S, 2^C/32] tensor). Valid by construction: reads return the last
+    completed write (crashed writes "not yet" linearized — always
+    legal). Host cost scales 2^k; device cost does not."""
+    rng = random.Random(seed)
+    h = History()
+    t = 0
+
+    def emit(typ, process, f, val, **kw):
+        nonlocal t
+        t += 1
+        h.append(Op(type=typ, process=process, f=f, value=val, time=t, **kw))
+
+    for i in range(k_crashed):
+        emit("invoke", 500 + i, "write", 1000 + i)
+    state = None
+    for j in range(max(0, n_ops - k_crashed)):
+        if j % 2 == 0:
+            v = rng.randrange(n_values)
+            emit("invoke", 0, "write", v)
+            emit("ok", 0, "write", v)
+            state = v
+        else:
+            emit("invoke", 0, "read", None)
+            emit("ok", 0, "read", state)
+    return h.index()
+
+
 def corrupt_history(h: History, seed: int = 0,
                     n_corruptions: int = 1) -> History:
     """Flip ok-read values to likely-inconsistent ones — adversarial

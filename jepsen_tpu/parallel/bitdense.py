@@ -105,30 +105,25 @@ def _bitdense_impl(xs, state0, step_name: str, S: int, C: int,
         in_axes=(None, 0, 0, 0, 0),
     )
 
-    # trace-time constants
-    clear_tab = []
-    for j, p in enumerate(plan):
-        if p["intra"]:
-            clear_tab.append((True, U32(p["clear"]), int(p["shift"]), None,
-                              None, None))
-        else:
-            clear_tab.append((False, jnp.asarray(p["clearw"]), None,
-                              jnp.asarray(p["fwd_idx"]),
-                              jnp.asarray(p["setw"]), None))
+    # trace-time constants, STACKED over slots so the closure is a
+    # handful of big tensor ops instead of C*(S+3) kernel launches —
+    # the while_loop is dispatch-latency-bound on small [S, W] tiles
+    J0 = min(5, C)                    # intra-word slots (bit j < 32)
+    J1 = C - J0                       # word-level slots
+    clr5 = jnp.asarray(np.array([plan[j]["clear"] for j in range(J0)],
+                                np.uint32))                    # [J0]
+    shift5 = jnp.asarray(np.array([plan[j]["shift"] for j in range(J0)],
+                                  np.uint32))                  # [J0]
+    if J1:
+        clw = jnp.asarray(np.stack([plan[j]["clearw"]
+                                    for j in range(J0, C)]))   # [J1, W]
+        fwd = jnp.asarray(np.stack([plan[j]["fwd_idx"]
+                                    for j in range(J0, C)]))   # [J1, W]
+        setw = jnp.asarray(np.stack([plan[j]["setw"]
+                                     for j in range(J0, C)]))  # [J1, W]
 
-    def or_into_bit(j, G):
-        """G [S, W] has contributions at masks without bit j; move them to
-        mask | bit_j."""
-        intra, clear, shift, fwd_idx, setw, _ = clear_tab[j]
-        if intra:
-            return (G & clear) << shift
-        return jnp.take(G, fwd_idx, axis=1) & setw[None, :]
-
-    def without_bit(j, B):
-        intra, clear, shift, fwd_idx, setw, _ = clear_tab[j]
-        if intra:
-            return B & clear
-        return B & clear[None, :]
+    def _or_over(x, axis):
+        return lax.reduce(x, U32(0), lax.bitwise_or, (axis,))
 
     def make_closure_body(ev):
         nxt, okj = step_js(state_codes, ev["slot_f"], ev["slot_a0"],
@@ -141,16 +136,21 @@ def _bitdense_impl(xs, state0, step_name: str, S: int, C: int,
             FULL, U32(0))                                      # [C, S, S]
 
         def expand(B):
-            B2 = B
-            for j in range(C):
-                ext = without_bit(j, B)                        # [S, W]
-                # G[t, w] = OR_s ext[s, w] & sel[j, s, t]
-                terms = ext[:, None, :] & sel[j][:, :, None]   # [S, S, W]
-                G = terms[0]
-                for s in range(1, S):
-                    G = G | terms[s]
-                B2 = B2 | or_into_bit(j, G)
-            return B2
+            # intra-word slots: ext[j,s,w] = B & clr5[j]; G[j,t,w] =
+            # OR_s ext & sel; contribution = (G & clr5) << (1 << j)
+            ext5 = B[None, :, :] & clr5[:, None, None]         # [J0, S, W]
+            g5 = _or_over(ext5[:, :, None, :] & sel[:J0, :, :, None], 1)
+            c5 = _or_over((g5 & clr5[:, None, None])
+                          << shift5[:, None, None], 0)         # [S, W]
+            out = B | c5
+            if J1:
+                # word-level slots: same algebra with word masks/gathers
+                extw = B[None, :, :] & clw[:, None, :]         # [J1, S, W]
+                gw = _or_over(extw[:, :, None, :] & sel[J0:, :, :, None], 1)
+                moved = jnp.take_along_axis(
+                    gw, jnp.broadcast_to(fwd[:, None, :], gw.shape), axis=2)
+                out = out | _or_over(moved & setw[:, None, :], 0)
+            return out
 
         def body(c):
             B, _ = c
@@ -243,8 +243,7 @@ def check_batch_bitdense(encs, mesh=None) -> list:
         return []
     from jepsen_tpu.parallel.encode import pad_batch
     step_name = encs[0].step_name
-    xs, state0, S, C, R = pad_batch(encs, mesh=mesh)
-    C = max(5, C)
+    xs, state0, S, C, R = pad_batch(encs, mesh=mesh, min_slots=5)
     valid, fail_r = _check_bitdense_batch(xs, state0, step_name, S, C,
                                           encs[0].state_lo)
     valid = np.asarray(valid)

@@ -216,15 +216,18 @@ def place_batch(xs: dict, state0, mesh):
     return xs, state0
 
 
-def pad_batch(encs: list, mesh=None):
+def pad_batch(encs: list, mesh=None, min_slots: int = 1):
     """Pad per-key encoded histories to one (K, R, C) batch and build the
     scanned arrays; with a mesh the batch is explicitly placed on it via
     `place_batch`. Shared by the sparse, dense, and bitdense batch
-    checkers. Returns (xs, state0, S, C, R)."""
+    checkers. `min_slots` floors C so engines with a structural minimum
+    (bitdense needs one full 32-mask word, C >= 5) get slot tables that
+    actually match the C they were compiled for. Returns
+    (xs, state0, S, C, R)."""
     import jax.numpy as jnp
 
     S = max(e.n_states for e in encs)
-    C = max(e.slot_f.shape[1] for e in encs)
+    C = max(min_slots, max(e.slot_f.shape[1] for e in encs))
     R = max(e.n_returns for e in encs)
     K = len(encs)
 

@@ -424,16 +424,18 @@ def _concurrent_writes_history(m, base_process=0):
 
 
 def test_check_batch_per_key_capacity_retry():
-    """One hot key among cheap ones: only the hot key re-runs at doubled
-    capacity; the cheap keys' results record the base tier, proving they
-    were not re-padded and re-searched at the hot key's capacity."""
+    """One hot key among cheap ones in the sparse batch path: only the
+    hot key re-runs at doubled capacity; the cheap keys' results record
+    the base tier, proving they were not re-padded and re-searched at
+    the hot key's capacity."""
     cheap = [rand_register_history(n_ops=20, n_processes=3, crash_p=0.0,
                                    seed=300 + s) for s in range(16)]
     hot = _concurrent_writes_history(7)       # needs ~450 configs -> 512
-    doomed = _concurrent_writes_history(26)   # blows past any tier; its
-    # 26-slot window also forces the whole batch off the bitdense path
-    rs = engine.check_batch(CASRegister(), cheap + [hot, doomed],
-                            capacity=128, max_capacity=2048)
+    doomed = _concurrent_writes_history(26)   # blows past any tier
+    pre = [enc_mod.encode(CASRegister(), h)
+           for h in cheap + [hot, doomed]]
+    rs = engine._check_batch_sparse(CASRegister(), pre, capacity=128,
+                                    max_capacity=2048)
     for r in rs[:16]:
         assert r["valid?"] is True
         assert r["capacity"] == 128, r   # never re-run at a higher tier
@@ -441,6 +443,41 @@ def test_check_batch_per_key_capacity_retry():
     assert rs[16]["capacity"] == 512, rs[16]  # bucketed retry found 512
     assert rs[17]["valid?"] == "unknown"
     assert "overflow" in rs[17]["error"]
+
+
+def test_adversarial_register_history_oracle():
+    """The bench's adversarial shape (histories.adversarial_register_
+    history) must be valid-by-construction under both engines, ride the
+    bit-packed device path, and genuinely hold its k crashed writes
+    open (slot window = k + sequential slot)."""
+    from jepsen_tpu.histories import adversarial_register_history
+    from jepsen_tpu.parallel import bitdense
+    h = adversarial_register_history(n_ops=80, k_crashed=6, seed=3)
+    e = enc_mod.encode(CASRegister(), h)
+    assert e.n_slots == 7
+    assert bitdense.fits_bitdense(bitdense.n_states(e), e.n_slots)
+    assert wgl.analysis(CASRegister(), h)["valid?"] is True
+    r = engine.analysis(CASRegister(), h)
+    assert r["valid?"] is True and r.get("engine") == "bitdense"
+
+
+def test_check_batch_c_tier_bucketing():
+    """A wide key must not drag narrow keys into its padded mask-space:
+    check_batch buckets by slot-window tier, so the narrow keys still
+    ride the bit-packed engine while the 26-slot key lands in its own
+    (sparse) bucket. Results per key are unchanged."""
+    narrow = [rand_register_history(n_ops=30, n_processes=3, crash_p=0.02,
+                                    seed=400 + s) for s in range(6)]
+    bad = corrupt_history(narrow[2], seed=9, n_corruptions=2)
+    doomed = _concurrent_writes_history(26)
+    batch = narrow[:2] + [bad] + narrow[3:] + [doomed]
+    rs = engine.check_batch(CASRegister(), batch, capacity=128,
+                            max_capacity=2048)
+    oracle = [wgl.analysis(CASRegister(), h)["valid?"] for h in batch[:-1]]
+    assert [r["valid?"] for r in rs[:-1]] == oracle
+    for r in rs[:-1]:
+        assert r.get("engine") == "bitdense", r  # narrow bucket stayed fast
+    assert rs[-1]["valid?"] == "unknown"         # wide bucket overflowed
 
 
 def test_dispatcher_jax_route():
