@@ -71,8 +71,7 @@ def main():
         adversarial_register_history, rand_register_history)
     from jepsen_tpu.models import CASRegister
     from jepsen_tpu.checker import linear
-    from jepsen_tpu.parallel import bitdense, encode as enc_mod, engine
-    from jepsen_tpu.util import bounded_pmap
+    from jepsen_tpu.parallel import bitdense, encode as enc_mod
 
     model = CASRegister()
     t_start = monotonic()

@@ -207,6 +207,46 @@ def rand_queue_history(
                      choose, complete, crash)
 
 
+def rand_fifo_history(
+    n_ops: int = 100,
+    n_processes: int = 5,
+    n_values: int = 3,
+    deq_p: float = 0.45,
+    crash_p: float = 0.05,
+    busy: float = 0.5,
+    seed: int = 45100,
+) -> History:
+    """A random, linearizable-by-construction strict-FIFO history (see
+    `_simulate`): dequeues return the true head; empty-queue dequeues
+    complete as :fail (dropped by the checkers). Dequeue-biased once
+    the queue runs deep, so the packed device tier's depth bound stays
+    inside its 31-bit budget."""
+    from collections import deque
+    q: deque = deque()
+
+    def choose(rng):
+        if len(q) >= 3 or rng.random() < deq_p:
+            return "dequeue", None
+        return "enqueue", rng.randrange(n_values)
+
+    def complete(rng, f, v):
+        if f == "enqueue":
+            q.append(v)
+            return "ok", v
+        if not q:
+            return "fail", None
+        return "ok", q.popleft()
+
+    def crash(rng, f, v):
+        if f == "enqueue" and rng.random() < 0.5:
+            q.append(v)
+        elif f == "dequeue" and q and rng.random() < 0.5:
+            q.popleft()
+
+    return _simulate(n_ops, n_processes, busy, crash_p, seed,
+                     choose, complete, crash)
+
+
 def adversarial_register_history(
     n_ops: int = 1000,
     k_crashed: int = 12,

@@ -341,6 +341,9 @@ def pack_spec(model: Model, intern) -> Optional[PackedSpec]:
     if isinstance(model, UnorderedQueue):
         return _uqueue_spec(model)
 
+    if isinstance(model, FIFOQueue):
+        return _fifo_spec(model)
+
     return None
 
 
@@ -358,7 +361,9 @@ def _gset_spec(model: "GSet") -> PackedSpec:
     def prepare(cs, intern):
         elems = list(model.items)
         for c in cs:
-            if c.f == "add" and c.value is not None:
+            # None is an ordinary addable element (the host model adds
+            # it literally, and reads observe it) — lane like any other
+            if c.f == "add":
                 elems.append(c.value)
         for c in cs:
             if c.f == "read" and not c.crashed and c.result is not None:
@@ -384,8 +389,6 @@ def _gset_spec(model: "GSet") -> PackedSpec:
 
     def encode_call(f, value, result, crashed):
         if f == "add":
-            if value is None:
-                return (F_READ, -1, -1, True)  # unknown add: wildcard
             return (F_ADD, lanes[value], -1, False)
         if f == "read":
             v = result if not crashed else None
@@ -405,6 +408,93 @@ def _gset_spec(model: "GSet") -> PackedSpec:
         f_codes={"add": F_ADD, "read": F_READ},
         state_lo=0,
         n_states=lambda intern: 1 << len(lanes),
+        unpack_state=unpack_state,
+        prepare=prepare,
+    )
+    return spec
+
+
+def _fifo_spec(model: "FIFOQueue") -> PackedSpec:
+    """FIFOQueue packing: the queue IS the state — v-bit value-code
+    lanes (code 0 = empty, codes 1..K assigned by `prepare`), head at
+    the low bits, depth implicit in the bit length. `prepare` proves a
+    depth bound B = initial depth + max over event positions of
+    (enqueues invoked so far - ok-dequeues completed so far): any
+    config reachable at any return event holds <= B elements (a
+    completed dequeue must have linearized; an open enqueue may have),
+    so B*v <= 31 guarantees enqueue shifts stay inside the int32.
+    Past that budget the history goes to the host engine."""
+    lanes: dict = {}        # value -> code 1..K
+    width = [0]             # v bits per lane
+    bound = [0]
+
+    def prepare(cs, intern):
+        try:
+            for v in model.items:
+                if v not in lanes:
+                    lanes[v] = len(lanes) + 1
+            for c in cs:
+                # None is an ordinary enqueueable value (the host model
+                # appends it literally), so it gets a lane like any other
+                if c.f == "enqueue":
+                    if c.value not in lanes:
+                        lanes[c.value] = len(lanes) + 1
+                elif c.f == "dequeue" and not c.crashed \
+                        and c.result is not None:
+                    if c.result not in lanes:
+                        lanes[c.result] = len(lanes) + 1
+        except TypeError as err:
+            raise _encode_error(f"fifo element not hashable: {err}")
+        width[0] = max(1, len(lanes).bit_length())
+        events = []
+        for c in cs:
+            if c.f == "enqueue":
+                events.append((c.invoke_index, 1))
+            elif c.f == "dequeue" and not c.crashed:
+                events.append((c.complete_index, -1))
+        events.sort()
+        depth = peak = len(model.items)
+        for _, d in events:
+            depth += d
+            peak = max(peak, depth)
+        bound[0] = max(1, peak)
+        if bound[0] * width[0] > 31:
+            raise _encode_error(
+                f"fifo needs {bound[0]} lanes x {width[0]} bits; the "
+                f"packed state holds 31 — use the host engine")
+        s0 = 0
+        for i, v in enumerate(model.items):
+            s0 |= lanes[v] << (i * width[0])
+        spec.state0 = s0
+
+    def encode_call(f, value, result, crashed):
+        if f == "enqueue":
+            return (F_ENQ, lanes[value], width[0], False)
+        if f == "dequeue":
+            # an unknown-result dequeue pops ANY head (the host model's
+            # value=None semantics) — match-any, not a wildcard identity
+            v = value if crashed else result
+            if v is None:
+                return (F_DEQ, -1, width[0], False)
+            return (F_DEQ, lanes[v], width[0], False)
+        raise ValueError(f"fifo-queue: unknown f {f!r}")
+
+    def unpack_state(code, intern):
+        by_code = {c: v for v, c in lanes.items()}
+        items = []
+        v = width[0]
+        while code:
+            items.append(by_code[code & ((1 << v) - 1)])
+            code >>= v
+        return FIFOQueue(tuple(items))
+
+    spec = PackedSpec(
+        state0=0,  # finalized by prepare
+        step_name="fifo",
+        encode_call=encode_call,
+        f_codes={"enqueue": F_ENQ, "dequeue": F_DEQ},
+        state_lo=0,
+        n_states=lambda intern: 1 << (bound[0] * width[0]),
         unpack_state=unpack_state,
         prepare=prepare,
     )

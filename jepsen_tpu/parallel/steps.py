@@ -17,6 +17,7 @@ control flow inside jit").
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax import lax
 
 from jepsen_tpu.models import (
     F_ACQUIRE, F_ADD, F_CAS, F_DEQ, F_ENQ, F_READ, F_RELEASE, F_WRITE,
@@ -111,9 +112,47 @@ def uqueue_step(state, f, a0, a1, wild):
     return jnp.where(ok, new_state, state), ok
 
 
+def fifo_step(state, f, a0, a1, wild):
+    """Strict FIFO queue (models.FIFOQueue; knossos.model/fifo-queue):
+    state is a sequence of v-bit value-code lanes, head at the LOW
+    bits, code 0 = empty lane — so the occupied depth is implicit in
+    the state's bit length (no separate counter field). The encoder's
+    prepare pass assigns codes 1..K, picks the lane width v, and
+    proves a depth bound B with B*v <= 31 from the history (falling
+    back to the host engine otherwise), so enqueues can never shift
+    past bit 30.
+
+    enqueue a0=code a1=v:  always ok; state |= code << (v * depth)
+    dequeue a0=code|-1 a1=v: ok iff head != 0 and (code < 0 or
+                             head == code); state >>= v
+    (a dequeue with unknown result pops ANY head — the host model's
+    value=None semantics — so it is a -1 match-any, NOT a wildcard
+    identity.)
+    """
+    is_enq = f == F_ENQ
+    is_deq = f == F_DEQ
+    v = jnp.maximum(a1, 1)
+    head = state & ((jnp.int32(1) << v) - 1)
+    bitlen = 32 - lax.clz(state)          # state >= 0 by construction
+    depth = (bitlen + v - 1) // v
+    enq_state = state | (jnp.maximum(a0, 0) << (v * depth))
+    deq_ok = (head != 0) & ((a0 < 0) | (head == a0))
+    ok = jnp.where(
+        wild, True,
+        jnp.where(is_enq, True, jnp.where(is_deq, deq_ok, False)),
+    )
+    new_state = jnp.where(
+        wild, state,
+        jnp.where(is_enq, enq_state,
+                  jnp.where(is_deq, state >> v, state)),
+    )
+    return jnp.where(ok, new_state, state), ok
+
+
 STEPS = {
     "register": register_step,
     "mutex": mutex_step,
     "gset": gset_step,
     "uqueue": uqueue_step,
+    "fifo": fifo_step,
 }

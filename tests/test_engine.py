@@ -52,9 +52,14 @@ def test_encode_crashed_call_holds_slot():
 
 
 def test_encode_unpackable_model():
-    from jepsen_tpu.models import FIFOQueue
+    from jepsen_tpu.models import Model
+
+    class Weird(Model):  # no pack_spec arm: host-only
+        def step(self, op):
+            return self
+
     with pytest.raises(enc_mod.EncodeError):
-        enc_mod.encode(FIFOQueue(), _h())
+        enc_mod.encode(Weird(), _h())
 
 
 # ------------------------------------------------------------- fixtures
@@ -296,6 +301,107 @@ def test_differential_uqueue_vs_host():
         e2 = engine.analysis(UnorderedQueue(), bad)["valid?"]
         assert e1 == e2, f"seed {seed}: wgl={e1} jax={e2}"
         assert e1 is False  # dequeue of a never-enqueued value
+
+
+def test_differential_fifo_vs_host():
+    """Device strict-FIFO queue (value-code lanes, head at low bits) vs
+    host WGL, random + corrupted histories."""
+    from jepsen_tpu.histories import rand_fifo_history
+    from jepsen_tpu.models import FIFOQueue
+    for seed in range(12):
+        n_vals = 2 if seed % 2 == 0 else 4
+        h = rand_fifo_history(n_ops=36, n_processes=4, n_values=n_vals,
+                              crash_p=0.06, seed=seed + 9100)
+        expect = wgl.analysis(FIFOQueue(), h)["valid?"]
+        got = engine.analysis(FIFOQueue(), h)
+        assert got["valid?"] is expect, f"seed {seed}: {got}"
+        assert "fallback" not in got, got
+
+        # corrupt one ok dequeue to a never-enqueued value
+        ops = [dict(o) for o in h]
+        for o in ops:
+            if o.get("type") == "ok" and o.get("f") == "dequeue":
+                o["value"] = 777
+                break
+        else:
+            continue
+        bad = _h(*ops)
+        e1 = wgl.analysis(FIFOQueue(), bad)["valid?"]
+        e2 = engine.analysis(FIFOQueue(), bad)["valid?"]
+        assert e1 == e2 is False, f"seed {seed}: wgl={e1} jax={e2}"
+
+
+def test_fifo_order_sensitivity():
+    """The FIFO device tier must reject out-of-order dequeues the
+    unordered queue would accept — sequential enqueue a,b then
+    dequeue b is FIFO-invalid; concurrent enqueues go either way."""
+    from jepsen_tpu.models import FIFOQueue, UnorderedQueue
+    seq = _h(invoke_op(0, "enqueue", "a"), ok_op(0, "enqueue", "a"),
+             invoke_op(0, "enqueue", "b"), ok_op(0, "enqueue", "b"),
+             invoke_op(0, "dequeue", None), ok_op(0, "dequeue", "b"))
+    assert engine.analysis(UnorderedQueue(), seq)["valid?"] is True
+    r = engine.analysis(FIFOQueue(), seq)
+    assert r["valid?"] is False and "fallback" not in r
+    assert r["op"]["f"] == "dequeue" and r["op"]["value"] == "b"
+
+    conc = _h(invoke_op(0, "enqueue", "a"), invoke_op(1, "enqueue", "b"),
+              ok_op(0, "enqueue", "a"), ok_op(1, "enqueue", "b"),
+              invoke_op(2, "dequeue", None), ok_op(2, "dequeue", "b"))
+    assert engine.analysis(FIFOQueue(), conc)["valid?"] is True
+
+    # crashed dequeue pops ANY head (host value=None semantics): a
+    # crashed dequeue can explain the missing "a"
+    crashed = _h(invoke_op(0, "enqueue", "a"), ok_op(0, "enqueue", "a"),
+                 invoke_op(0, "enqueue", "b"), ok_op(0, "enqueue", "b"),
+                 invoke_op(1, "dequeue", None), info_op(1, "dequeue", None),
+                 invoke_op(2, "dequeue", None), ok_op(2, "dequeue", "b"))
+    assert engine.analysis(FIFOQueue(), crashed)["valid?"] is True
+    assert wgl.analysis(FIFOQueue(), crashed)["valid?"] is True
+
+    # initial items (FIFOQueue.of equivalent): head is the first item
+    pre = FIFOQueue(("x", "y"))
+    assert engine.analysis(pre, _h(
+        invoke_op(0, "dequeue", None), ok_op(0, "dequeue", "x")))["valid?"] \
+        is True
+    assert engine.analysis(pre, _h(
+        invoke_op(0, "dequeue", None), ok_op(0, "dequeue", "y")))["valid?"] \
+        is False
+
+
+def test_none_is_an_ordinary_element():
+    """The host models append/add literal None; the device tiers must
+    agree (a None-valued ok enqueue/add encoded as a wildcard identity
+    would report a false linearizability violation)."""
+    from jepsen_tpu.models import FIFOQueue, GSet
+    h = _h(invoke_op(0, "enqueue", None), ok_op(0, "enqueue", None),
+           invoke_op(0, "dequeue", None), ok_op(0, "dequeue", None))
+    assert wgl.analysis(FIFOQueue(), h)["valid?"] is True
+    r = engine.analysis(FIFOQueue(), h)
+    assert r["valid?"] is True and "fallback" not in r, r
+
+    g = _h(invoke_op(0, "add", None), ok_op(0, "add", None),
+           invoke_op(1, "read", None), ok_op(1, "read", [None]))
+    assert wgl.analysis(GSet(), g)["valid?"] is True
+    rg = engine.analysis(GSet(), g)
+    assert rg["valid?"] is True and "fallback" not in rg, rg
+    # and the read must CONSTRAIN: an empty read after the add completes
+    g2 = _h(invoke_op(0, "add", None), ok_op(0, "add", None),
+            invoke_op(1, "read", None), ok_op(1, "read", []))
+    assert wgl.analysis(GSet(), g2)["valid?"] is False
+    assert engine.analysis(GSet(), g2)["valid?"] is False
+
+
+def test_fifo_depth_budget_falls_back_to_host():
+    """> 31 bits of lane space (here 16 pending x 2-bit codes) must go
+    to the host engine, loudly tagged."""
+    from jepsen_tpu.models import FIFOQueue
+    ops = []
+    for i in range(16):
+        ops.append(invoke_op(0, "enqueue", i % 3))
+        ops.append(ok_op(0, "enqueue", i % 3))
+    r = engine.analysis(FIFOQueue(), _h(*ops))
+    assert r["valid?"] is True
+    assert "fallback" in r and "fifo" in r["fallback"]
 
 
 def test_crashed_wildcard_dequeues_pruned():

@@ -83,11 +83,16 @@ def test_pack_spec_register():
 
 
 def test_pack_spec_unpackable():
+    class Custom(models.Model):  # user-defined model: host-only
+        def step(self, op):
+            return self
+
+    assert models.pack_spec(Custom(), Intern()) is None
+
+
+def test_pack_spec_gset_uqueue_fifo_pack():
+    # round 3: gset, unordered-queue and fifo-queue gained device tiers
     from jepsen_tpu.models import FIFOQueue
-    assert models.pack_spec(FIFOQueue(), Intern()) is None
-
-
-def test_pack_spec_gset_and_uqueue_pack():
-    # round 3: gset and unordered-queue gained device tiers
     assert models.pack_spec(GSet(), Intern()).step_name == "gset"
     assert models.pack_spec(UnorderedQueue(), Intern()).step_name == "uqueue"
+    assert models.pack_spec(FIFOQueue(), Intern()).step_name == "fifo"
