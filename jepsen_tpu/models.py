@@ -273,14 +273,15 @@ class PackedSpec:
 def pack_spec(model: Model, intern) -> Optional[PackedSpec]:
     """Return the PackedSpec for device-packable models, else None.
 
-    Packable today: Register / CASRegister (state = interned value id,
-    nil = -1), Mutex (state = 0/1), GSet (state = element bitmask, up
-    to 31 distinct elements), and UnorderedQueue (state = packed count
-    lanes, up to 31 total bits). GSet/queue packing is history-bounded,
-    not unbounded: their `prepare` pass sizes the state from the actual
-    call list and raises EncodeError past the 31-bit budget, falling
-    back to the host checker (SURVEY.md §7.3 #4). FIFOQueue stays
-    host-only (order-sensitive unbounded state).
+    Packable today — all six knossos model families: Register /
+    CASRegister (state = interned value id, nil = -1), Mutex (state =
+    0/1), GSet (state = element bitmask, up to 31 distinct elements),
+    UnorderedQueue (state = packed count lanes, up to 31 total bits),
+    and FIFOQueue (state = v-bit value-code lanes, head at the low
+    bits, depth bound x width <= 31). GSet/queue packing is
+    history-bounded, not unbounded: their `prepare` pass sizes the
+    state from the actual call list and raises EncodeError past the
+    31-bit budget, falling back to the host checker (SURVEY.md §7.3 #4).
     """
     if isinstance(model, (Register, CASRegister)):
         state0 = intern.code(model.value)
@@ -471,9 +472,11 @@ def _fifo_spec(model: "FIFOQueue") -> PackedSpec:
         if f == "enqueue":
             return (F_ENQ, lanes[value], width[0], False)
         if f == "dequeue":
-            # an unknown-result dequeue pops ANY head (the host model's
-            # value=None semantics) — match-any, not a wildcard identity
-            v = value if crashed else result
+            # dequeues are completion-valued; a crashed dequeue's result
+            # is unknown regardless of its invoke value (the host oracle
+            # sets value=None for crashed dequeues, wgl._StepOp) and
+            # pops ANY head — match-any, not a wildcard identity
+            v = None if crashed else result
             if v is None:
                 return (F_DEQ, -1, width[0], False)
             return (F_DEQ, lanes[v], width[0], False)
@@ -549,8 +552,12 @@ def _uqueue_spec(model: "UnorderedQueue") -> PackedSpec:
             return (F_ENQ, o, m, False)
         if f == "dequeue":
             # completion-valued: the dequeued element is learned at ok;
-            # unknown results (crashed, or nil ok) are unconstrained
-            v = value if crashed else result
+            # unknown results are unconstrained. A crashed dequeue's
+            # result is unknown REGARDLESS of its invoke value (the
+            # host oracle sets value=None for crashed dequeues,
+            # wgl._StepOp) — constraining on the invoke value would
+            # report false violations the host accepts
+            v = None if crashed else result
             if v is None:
                 return (F_READ, -1, -1, True)
             o, m = lanes[v]

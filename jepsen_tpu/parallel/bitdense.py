@@ -27,6 +27,7 @@ for windows too wide to materialise (C > ~24).
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 
@@ -93,8 +94,15 @@ def _plan(C: int):
     return W, plan
 
 
+def _pallas_interpret() -> bool:
+    """Pallas interpreter mode when the backend isn't a real TPU —
+    CI runs the kernel's logic on the 8-device CPU mesh."""
+    import jax as _jax
+    return _jax.default_backend() != "tpu"
+
+
 def _bitdense_impl(xs, state0, step_name: str, S: int, C: int,
-                   lo: int = -1):
+                   lo: int = -1, use_pallas: bool = False):
     step = STEPS[step_name]
     W, plan = _plan(C)
     state_codes = jnp.arange(S, dtype=jnp.int32) + lo
@@ -125,16 +133,17 @@ def _bitdense_impl(xs, state0, step_name: str, S: int, C: int,
     def _or_over(x, axis):
         return lax.reduce(x, U32(0), lax.bitwise_or, (axis,))
 
-    def make_closure_body(ev):
+    def compute_sel(ev):
         nxt, okj = step_js(state_codes, ev["slot_f"], ev["slot_a0"],
                            ev["slot_a1"], ev["slot_wild"])
         legal = okj & ev["slot_occ"][:, None]                  # [C, S]
         # sel[j, s, t] = FULL if legal[j,s] and nxt[j,s]==t
         t_idx = jnp.arange(S)
-        sel = jnp.where(
+        return jnp.where(
             legal[:, :, None] & ((nxt - lo)[:, :, None] == t_idx[None, None, :]),
             FULL, U32(0))                                      # [C, S, S]
 
+    def make_closure_body(sel):
         def expand(B):
             # intra-word slots: ext[j,s,w] = B & clr5[j]; G[j,t,w] =
             # OR_s ext & sel; contribution = (G & clr5) << (1 << j)
@@ -184,7 +193,19 @@ def _bitdense_impl(xs, state0, step_name: str, S: int, C: int,
     def scan_step(carry, ev):
         B, ok, fail_r, r_idx = carry
         run = ok & (ev["ev_slot"] >= 0)
-        B2, _ = lax.while_loop(closure_cond, make_closure_body(ev), (B, run))
+        sel = compute_sel(ev)
+        if use_pallas:
+            # the entire fixpoint runs inside one VMEM-resident pallas
+            # kernel (parallel.pallas_kernels); skipped on pad events
+            from jepsen_tpu.parallel import pallas_kernels as pk
+            B2 = lax.cond(
+                run,
+                lambda b: pk.closure_call(sel, b, C,
+                                          interpret=_pallas_interpret()),
+                lambda b: b, B)
+        else:
+            B2, _ = lax.while_loop(closure_cond, make_closure_body(sel),
+                                   (B, run))
         s = jnp.clip(ev["ev_slot"], 0, C - 1)
         B3 = lax.switch(s, filter_branches, B2)
         alive = jnp.any(B3 != 0)
@@ -202,7 +223,8 @@ def _bitdense_impl(xs, state0, step_name: str, S: int, C: int,
 
 
 _check_bitdense = jax.jit(_bitdense_impl,
-                          static_argnames=("step_name", "S", "C", "lo"))
+                          static_argnames=("step_name", "S", "C", "lo",
+                                           "use_pallas"))
 
 
 @functools.partial(jax.jit,
@@ -218,16 +240,28 @@ def n_states(e: EncodedHistory) -> int:
     return e.n_states
 
 
-def check_encoded_bitdense(e: EncodedHistory) -> dict:
+def check_encoded_bitdense(e: EncodedHistory,
+                           use_pallas: bool = None) -> dict:
+    """Single-key bit-packed check. `use_pallas` routes the closure
+    through the VMEM-resident pallas kernel (parallel.pallas_kernels);
+    default: the JEPSEN_TPU_PALLAS=1 env flag, and only for shapes the
+    kernel supports. The batch path stays on XLA."""
     if e.n_returns == 0:
         return {"valid?": True, "engine": "bitdense"}
     from jepsen_tpu.parallel.dense import _xs_dense
     S = n_states(e)
     C = max(5, e.n_slots)  # at least one full word
+    if use_pallas is None:
+        use_pallas = os.environ.get("JEPSEN_TPU_PALLAS") == "1"
+    if use_pallas:
+        from jepsen_tpu.parallel import pallas_kernels as pk
+        use_pallas = pk.supported(S, C)
     valid, fail_r = _check_bitdense(_xs_dense(e, C), jnp.int32(e.state0),
-                                    e.step_name, S, C, e.state_lo)
+                                    e.step_name, S, C, e.state_lo,
+                                    use_pallas)
     out = {"valid?": bool(valid), "engine": "bitdense",
-           "states": S, "slots": C}
+           "states": S, "slots": C,
+           "closure": "pallas" if use_pallas else "xla"}
     if not out["valid?"]:
         from jepsen_tpu.parallel.encode import fail_op_fields
         out.update(fail_op_fields(e, int(fail_r)))

@@ -1,0 +1,121 @@
+"""Pallas TPU kernels for the bit-packed linearizability engine.
+
+The bitdense closure (parallel.bitdense) is a fixpoint of bitwise
+algebra over the reachable-set tensor B: uint32[S, W]. Under XLA each
+fixpoint iteration is a chain of small VPU kernels with an HBM
+round-trip per op and a device-visible `changed` reduction per
+while-iteration; for the bench's single-key shapes (S ~ 18, W = 256+)
+the loop is dispatch-latency-bound, not compute-bound. This kernel runs
+the ENTIRE fixpoint inside one `pallas_call`: B lives in VMEM for all
+iterations (B + sel + word tables fit comfortably: S*W words ~ tens of
+KB against ~16 MB VMEM), and the word-level "move contributions to
+mask | bit_j" gather is the XOR-stride shuffle w ^ 2^(j-5), realised as
+a reshape/flip — a pure VMEM permutation, no HBM gathers.
+
+SURVEY.md §7.1 step 4: "Pallas kernels where XLA fuses poorly (hash
+probe, bitset ops)". This is the bitset-ops kernel.
+
+Enabled via JEPSEN_TPU_PALLAS=1 (read at trace time by
+parallel.bitdense) or the explicit `closure_fixpoint` call; shapes are
+gated to W >= 128 (one full lane tile) and S <= 64 (the s-axis
+reduction is trace-unrolled). CI differential-tests the kernel in
+interpreter mode on CPU; on hardware it is opt-in until measured —
+flags do not get to claim speedups.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+U32 = jnp.uint32
+
+
+def supported(S: int, C: int) -> bool:
+    """Shapes this kernel handles: at least one full lane tile of mask
+    words, and a trace-unrollable state axis."""
+    W = max(1, (1 << C) // 32)
+    return W >= 128 and S <= 64 and C >= 5
+
+
+def _xor_shuffle(G, jb: int):
+    """y[..., w] = x[..., w ^ jb] for power-of-two jb: swap adjacent
+    jb-wide halves — a reshape/flip, no gather."""
+    S, W = G.shape
+    G4 = G.reshape(S, W // (2 * jb), 2, jb)
+    return jnp.flip(G4, axis=2).reshape(S, W)
+
+
+def _closure_kernel(plan, S: int, C: int, W: int,
+                    sel_ref, clw_ref, setw_ref, b_ref, out_ref):
+    """One return event's closure fixpoint, entirely in VMEM.
+
+    sel  [C, S, S] u32   transition selects (FULL where legal s->t)
+    clw  [J1, W]  u32    word masks: FULL where mask-bit j is clear
+    setw [J1, W]  u32    word masks: FULL where mask-bit j is set
+    b    [S, W]   u32    reachable set, bit b of word w = mask w*32+b
+    """
+    J0 = min(5, C)
+
+    def expand(B):
+        out = B
+        for j in range(J0):
+            clear = U32(plan[j]["clear"])
+            shift = int(plan[j]["shift"])
+            ext = B & clear                          # [S, W]
+            G = jnp.zeros((S, W), U32)
+            for s in range(S):
+                G = G | (sel_ref[j, s][:, None] & ext[s][None, :])
+            out = out | ((G & clear) << shift)
+        for idx in range(C - J0):
+            j = J0 + idx
+            jb = 1 << (j - 5)
+            ext = B & clw_ref[idx][None, :]
+            G = jnp.zeros((S, W), U32)
+            for s in range(S):
+                G = G | (sel_ref[j, s][:, None] & ext[s][None, :])
+            out = out | (_xor_shuffle(G, jb) & setw_ref[idx][None, :])
+        return out
+
+    def body(carry):
+        B, _ = carry
+        B2 = expand(B)
+        return B2, jnp.any(B2 != B)
+
+    B0 = b_ref[:]
+    B_final, _ = lax.while_loop(lambda c: c[1], body, (B0, jnp.bool_(True)))
+    out_ref[:] = B_final
+
+
+def closure_call(sel, B, C: int, interpret: bool = False):
+    """Traceable (un-jitted) pallas invocation — usable inside an outer
+    scan/cond. sel [C, S, S] u32, B [S, W] u32 -> B' [S, W]."""
+    from jepsen_tpu.parallel.bitdense import _plan
+    S, W = B.shape
+    W_plan, plan = _plan(C)
+    assert W_plan == W, (W_plan, W)
+    assert supported(S, C), (S, C)
+    J1 = C - min(5, C)
+    clw = np.stack([plan[j]["clearw"] for j in range(5, C)]) \
+        if J1 else np.zeros((1, W), np.uint32)
+    setw = np.stack([plan[j]["setw"] for j in range(5, C)]) \
+        if J1 else np.zeros((1, W), np.uint32)
+    kernel = functools.partial(_closure_kernel, plan, S, C, W)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((S, W), jnp.uint32),
+        interpret=interpret,
+    )(sel, jnp.asarray(clw), jnp.asarray(setw), B)
+
+
+@functools.partial(jax.jit, static_argnames=("C", "interpret"))
+def closure_fixpoint(sel, B, C: int, interpret: bool = False):
+    """Run the closure fixpoint for one event: sel [C, S, S] u32,
+    B [S, W] u32 -> B' [S, W]. Requires supported(S, C)."""
+    return closure_call(sel, B, C, interpret=interpret)

@@ -368,6 +368,28 @@ def test_fifo_order_sensitivity():
         is False
 
 
+def test_crashed_dequeue_invoke_value_is_ignored():
+    """A crashed dequeue's result is unknown regardless of its invoke
+    value (wgl._StepOp sets value=None): the device tiers must pop
+    any head / stay unconstrained, not constrain on the invoke value
+    (that was a KeyError for unlaned values and a false violation for
+    laned ones)."""
+    from jepsen_tpu.models import FIFOQueue, UnorderedQueue
+    # unlaned invoke value 5: must not KeyError
+    h = _h(invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+           invoke_op(1, "dequeue", 5), info_op(1, "dequeue", 5))
+    for model in (FIFOQueue(), UnorderedQueue()):
+        r = engine.analysis(model, h)
+        assert r["valid?"] is True and "fallback" not in r, (model, r)
+    # laned invoke value: crashed deq(5) must be able to pop head 1
+    h2 = _h(invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+            invoke_op(0, "enqueue", 5), ok_op(0, "enqueue", 5),
+            invoke_op(1, "dequeue", 5), info_op(1, "dequeue", 5),
+            invoke_op(2, "dequeue", None), ok_op(2, "dequeue", 5))
+    assert wgl.analysis(FIFOQueue(), h2)["valid?"] is True
+    assert engine.analysis(FIFOQueue(), h2)["valid?"] is True
+
+
 def test_none_is_an_ordinary_element():
     """The host models append/add literal None; the device tiers must
     agree (a None-valued ok enqueue/add encoded as a wildcard identity

@@ -1,0 +1,108 @@
+"""Pallas closure kernel (parallel.pallas_kernels) — interpreter-mode
+differential tests on the CPU backend. Three oracles:
+
+1. a pure-Python SEMANTIC fixpoint over (state, mask) pairs, written
+   from the closure's definition, not its bit-twiddling realisation;
+2. the XLA bitdense closure (same algebra, different execution);
+3. the host WGL engine, via full check_encoded_bitdense runs with the
+   pallas path forced on.
+"""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.parallel import bitdense, pallas_kernels as pk
+
+FULL = np.uint32(0xFFFFFFFF)
+
+
+def _semantic_fixpoint(sel, B, C):
+    """Reference closure: from every reachable (state s, mask m), for
+    every slot j not in m with a legal transition s->t, (t, m | 1<<j)
+    is reachable. Iterate to fixpoint. sel [C,S,S], B [S,W] words."""
+    S, W = B.shape
+    reach = set()
+    for s in range(S):
+        for w in range(W):
+            word = int(B[s, w])
+            for b in range(32):
+                if (word >> b) & 1:
+                    reach.add((s, w * 32 + b))
+    changed = True
+    while changed:
+        changed = False
+        for (s, m) in list(reach):
+            for j in range(C):
+                if (m >> j) & 1:
+                    continue
+                for t in range(S):
+                    if sel[j, s, t] and (t, m | (1 << j)) not in reach:
+                        reach.add((t, m | (1 << j)))
+                        changed = True
+    out = np.zeros((S, W), np.uint32)
+    for (s, m) in reach:
+        out[s, m // 32] |= np.uint32(1) << np.uint32(m % 32)
+    return out
+
+
+def _rand_case(seed, S=5, C=12, n_seeds=3, p_legal=0.08):
+    rng = np.random.default_rng(seed)
+    W = (1 << C) // 32
+    sel = np.where(rng.random((C, S, S)) < p_legal, FULL,
+                   np.uint32(0)).astype(np.uint32)
+    B = np.zeros((S, W), np.uint32)
+    for _ in range(n_seeds):
+        s, m = rng.integers(S), rng.integers(1 << C)
+        B[s, m // 32] |= np.uint32(1) << np.uint32(m % 32)
+    return sel, B, C
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_pallas_closure_vs_semantic_oracle(seed):
+    sel, B, C = _rand_case(seed)
+    got = np.asarray(pk.closure_fixpoint(sel, B, C, interpret=True))
+    want = _semantic_fixpoint(sel, B, C)
+    assert (got == want).all(), f"seed {seed}: {int((got != want).sum())} "\
+                                f"words differ"
+
+
+def test_pallas_supported_gate():
+    assert pk.supported(6, 12)       # W=128
+    assert not pk.supported(6, 11)   # W=64: below one lane tile
+    assert not pk.supported(100, 12) # S too large to unroll
+
+
+def test_bitdense_pallas_path_differential():
+    """Full engine runs with the pallas closure forced on vs the XLA
+    closure and the host oracle — valid and invalid histories. C must
+    be >= 12 for kernel support, so the histories carry 11 crashed
+    writes to widen the slot window."""
+    from jepsen_tpu.checker import wgl
+    from jepsen_tpu.histories import adversarial_register_history
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.parallel import encode as enc_mod
+    from jepsen_tpu.history import History
+
+    h = adversarial_register_history(n_ops=60, k_crashed=11, seed=5)
+    e = enc_mod.encode(CASRegister(), h)
+    assert pk.supported(bitdense.n_states(e), e.n_slots), \
+        (bitdense.n_states(e), e.n_slots)
+    r_xla = bitdense.check_encoded_bitdense(e, use_pallas=False)
+    r_pl = bitdense.check_encoded_bitdense(e, use_pallas=True)
+    assert r_pl["closure"] == "pallas" and r_xla["closure"] == "xla"
+    assert r_xla["valid?"] is r_pl["valid?"] is True
+
+    # invalid: impossible read appended
+    ops = [dict(o) for o in h]
+    n = len(ops)
+    ops += [{"index": n, "time": n, "process": 90, "type": "invoke",
+             "f": "read", "value": None},
+            {"index": n + 1, "time": n + 1, "process": 90, "type": "ok",
+             "f": "read", "value": 999}]
+    hb = History.wrap(ops).index()
+    eb = enc_mod.encode(CASRegister(), hb)
+    rb_xla = bitdense.check_encoded_bitdense(eb, use_pallas=False)
+    rb_pl = bitdense.check_encoded_bitdense(eb, use_pallas=True)
+    assert rb_xla["valid?"] is rb_pl["valid?"] is False
+    assert rb_xla["fail-event"] == rb_pl["fail-event"]
+    assert wgl.analysis(CASRegister(), hb)["valid?"] is False
