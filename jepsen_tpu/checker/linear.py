@@ -89,7 +89,8 @@ def check_calls(model, cs: List[Call], n_history: int,
             if len(configs) > max_configs:
                 return {"valid?": "unknown",
                         "error": f"config budget exceeded ({max_configs})",
-                        "explored": explored}
+                        "events-done": events_done, "explored": explored,
+                        "max-frontier": max(max_frontier, len(configs))}
         max_frontier = max(max_frontier, len(configs))
         configs = {(s, lin - {cid}) for s, lin in configs if cid in lin}
         open_calls.discard(cid)

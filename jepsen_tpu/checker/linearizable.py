@@ -5,6 +5,11 @@ Mirrors the reference's algorithm dispatch (jepsen/src/jepsen/checker.clj:182-21
 
     "wgl"          host depth-first WGL search (jepsen_tpu.checker.wgl)
     "linear"       host JIT-linearization frontier (jepsen_tpu.checker.linear)
+    "packed"       host frontier over int configs — the device encoding
+                   run on CPU (jepsen_tpu.checker.linear_packed); the
+                   fastest host engine for packable models, and the
+                   bench's baseline. Falls back to wgl when the model
+                   can't pack.
     "jax"          the TPU engine (jepsen_tpu.parallel.engine) — batched,
                    device-sharded frontier expansion; the north star
     "competition"  jax when the model packs to fixed-width ints, else wgl
@@ -65,6 +70,15 @@ class Linearizable(Checker):
         elif algo == "linear":
             from jepsen_tpu.checker import linear
             r = linear.analysis(model, h)
+        elif algo == "packed":
+            from jepsen_tpu.checker import linear_packed, wgl
+            from jepsen_tpu.parallel.encode import EncodeError
+            try:
+                r = linear_packed.analysis(model, h)
+            except EncodeError as err:
+                r = wgl.analysis(model, h)
+                r["fallback"] = str(err)
+                algo = "wgl"
         elif algo == "jax":
             from jepsen_tpu.parallel import engine
             r = engine.analysis(model, h)

@@ -619,3 +619,20 @@ def test_dispatcher_jax_route():
     # competition now resolves to jax (engine importable, devices present)
     r = linearizable(Register(), algorithm="competition").check({}, h)
     assert r["analyzer"] == "jax"
+    # packed: the int-config host engine behind the same boundary
+    r = linearizable(Register(), algorithm="packed").check({}, h)
+    assert r["valid?"] is True and r["analyzer"] == "packed"
+    bad = _h(invoke_op(0, "write", 1), ok_op(0, "write", 1),
+             invoke_op(0, "read", None), ok_op(0, "read", 2))
+    r = linearizable(Register(), algorithm="packed").check({}, bad)
+    assert r["valid?"] is False and r["op"]["value"] == 2
+
+    # packed on an unpackable model falls back to wgl, tagged
+    from jepsen_tpu.models import Model
+
+    class Weird(Model):
+        def step(self, op):
+            return self
+
+    r = linearizable(Weird(), algorithm="packed").check({}, _h())
+    assert r["valid?"] is True and r["analyzer"] == "wgl"

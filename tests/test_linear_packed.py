@@ -1,0 +1,87 @@
+"""checker.linear_packed — the int-config host engine (the bench's
+CPU baseline). Differential against the object-config host engines on
+every packed model family, plus deadline and fallback behavior."""
+
+import pytest
+
+from jepsen_tpu.checker import linear, linear_packed, wgl
+from jepsen_tpu.histories import (
+    adversarial_register_history, corrupt_history, rand_fifo_history,
+    rand_gset_history, rand_queue_history, rand_register_history)
+from jepsen_tpu.models import CASRegister, FIFOQueue, GSet, UnorderedQueue
+
+
+CASES = [
+    ("register", CASRegister(),
+     lambda s: rand_register_history(n_ops=60, n_processes=5, crash_p=0.05,
+                                     fail_p=0.05, seed=s)),
+    ("fifo", FIFOQueue(),
+     lambda s: rand_fifo_history(n_ops=40, n_processes=4, crash_p=0.05,
+                                 seed=s)),
+    ("uqueue", UnorderedQueue(),
+     lambda s: rand_queue_history(n_ops=40, n_processes=4, crash_p=0.05,
+                                  seed=s)),
+    ("gset", GSet(),
+     lambda s: rand_gset_history(n_ops=40, n_processes=4, crash_p=0.05,
+                                 seed=s)),
+]
+
+
+@pytest.mark.parametrize("name,model,gen", CASES,
+                         ids=[c[0] for c in CASES])
+def test_packed_vs_object_engines(name, model, gen):
+    for s in range(6):
+        h = gen(s + 50)
+        want = wgl.analysis(model, h)["valid?"]
+        assert linear_packed.analysis(model, h)["valid?"] is want, (name, s)
+    # register only: corrupt_history flips read values to ints
+    if name == "register":
+        for s in range(6):
+            bad = corrupt_history(gen(s + 50), seed=s)
+            want = wgl.analysis(model, bad)["valid?"]
+            got = linear_packed.analysis(model, bad)
+            assert got["valid?"] is want, (s, got)
+            if want is False:
+                assert got["op"]["f"] == "read"
+
+
+def test_packed_matches_object_on_multi_key_shape():
+    """The bench's north-star key shape: both host engines agree and
+    the packed one is the faster (sanity, not a benchmark)."""
+    h = rand_register_history(n_ops=120, n_processes=14, n_values=5,
+                              crash_p=0.005, fail_p=0.05, busy=0.8,
+                              seed=2024)
+    assert linear.analysis(CASRegister(), h)["valid?"] is True
+    assert linear_packed.analysis(CASRegister(), h)["valid?"] is True
+
+
+def test_packed_deadline_reports_progress():
+    from time import monotonic
+    h = adversarial_register_history(n_ops=300, k_crashed=10, seed=7)
+    r = linear_packed.analysis(CASRegister(), h,
+                               deadline=monotonic() - 1)  # already past
+    assert r["valid?"] == "unknown" and r["timeout"] is True
+    assert r["events-done"] == 0
+
+
+def test_packed_config_budget_reports_progress():
+    """Budget exhaustion must carry the same progress keys as a
+    deadline timeout — bench extrapolates the host rate from either."""
+    h = adversarial_register_history(n_ops=100, k_crashed=10, seed=7)
+    r = linear_packed.analysis(CASRegister(), h, max_configs=100)
+    assert r["valid?"] == "unknown"
+    assert "budget exceeded" in r["error"]
+    assert "events-done" in r and "max-frontier" in r
+
+
+def test_packed_raises_for_unpackable():
+    from jepsen_tpu.models import Model
+    from jepsen_tpu.parallel.encode import EncodeError
+
+    class Weird(Model):
+        def step(self, op):
+            return self
+
+    from jepsen_tpu.history import History
+    with pytest.raises(EncodeError):
+        linear_packed.analysis(Weird(), History.wrap([]))
