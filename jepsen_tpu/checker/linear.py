@@ -87,9 +87,13 @@ def check_calls(model, cs: List[Call], n_history: int,
             configs |= new
             frontier = new
             if len(configs) > max_configs:
+                # events_done was bumped when THIS event started; only
+                # completed events count (matches the timeout path and
+                # linear_packed)
                 return {"valid?": "unknown",
                         "error": f"config budget exceeded ({max_configs})",
-                        "events-done": events_done, "explored": explored,
+                        "events-done": events_done - 1,
+                        "explored": explored,
                         "max-frontier": max(max_frontier, len(configs))}
         max_frontier = max(max_frontier, len(configs))
         configs = {(s, lin - {cid}) for s, lin in configs if cid in lin}

@@ -95,21 +95,35 @@ def check_encoded(e, max_configs: int = 2_000_000,
     explored = 0
     max_frontier = 1
     R = e.n_returns
-    slot_f, slot_a0 = e.slot_f, e.slot_a0
-    slot_a1, slot_wild, slot_occ = e.slot_a1, e.slot_wild, e.slot_occ
-    ev_slot = e.ev_slot
+    # plain Python lists: per-element numpy scalar indexing in the hot
+    # loop would slow the baseline and flatter the device comparison
+    slot_f, slot_a0 = e.slot_f.tolist(), e.slot_a0.tolist()
+    slot_a1, slot_wild = e.slot_a1.tolist(), e.slot_wild.tolist()
+    slot_occ, ev_slot = e.slot_occ.tolist(), e.ev_slot.tolist()
+    C = len(slot_f[0]) if R else 0
 
     for r in range(R):
         if deadline is not None and _time.monotonic() > deadline:
             return {"valid?": "unknown", "timeout": True, "events-done": r,
                     "explored": explored, "max-frontier": max_frontier}
-        occ = [(j, int(slot_f[r, j]), int(slot_a0[r, j]),
-                int(slot_a1[r, j]), bool(slot_wild[r, j]))
-               for j in range(e.slot_f.shape[1]) if slot_occ[r, j]]
+        occ = [(j, slot_f[r][j], slot_a0[r][j], slot_a1[r][j],
+                slot_wild[r][j])
+               for j in range(C) if slot_occ[r][j]]
         frontier = configs
+        next_check = explored + 131072
         while frontier:
             new = set()
             for s, m in frontier:
+                if explored >= next_check:
+                    # stride deadline check: even ONE expansion round
+                    # over a 2^k frontier must not overshoot unboundedly
+                    next_check = explored + 131072
+                    if deadline is not None \
+                            and _time.monotonic() > deadline:
+                        return {"valid?": "unknown", "timeout": True,
+                                "events-done": r, "explored": explored,
+                                "max-frontier": max(max_frontier,
+                                                    len(configs))}
                 for j, f, a0, a1, wild in occ:
                     bit = 1 << j
                     if m & bit:
@@ -126,6 +140,12 @@ def check_encoded(e, max_configs: int = 2_000_000,
             if len(configs) > max_configs:
                 return {"valid?": "unknown",
                         "error": f"config budget exceeded ({max_configs})",
+                        "events-done": r, "explored": explored,
+                        "max-frontier": max(max_frontier, len(configs))}
+            if deadline is not None and _time.monotonic() > deadline:
+                # mid-window deadline: a single wide window's expansion
+                # must not overshoot the budget unboundedly
+                return {"valid?": "unknown", "timeout": True,
                         "events-done": r, "explored": explored,
                         "max-frontier": max(max_frontier, len(configs))}
         max_frontier = max(max_frontier, len(configs))

@@ -444,6 +444,38 @@ def test_crashed_wildcard_dequeues_pruned():
     assert r["valid?"] is True and "fallback" not in r
 
 
+def test_gset_read_constrains_completed_adds():
+    """Sequential add a; add b; read [a] is invalid (the read missed a
+    completed add); an add CONCURRENT with the read goes either way."""
+    from jepsen_tpu.models import GSet
+    seq = _h(invoke_op(0, "add", "a"), ok_op(0, "add", "a"),
+             invoke_op(0, "add", "b"), ok_op(0, "add", "b"),
+             invoke_op(1, "read", None), ok_op(1, "read", ["a"]))
+    assert wgl.analysis(GSet(), seq)["valid?"] is False
+    assert engine.analysis(GSet(), seq)["valid?"] is False
+
+    conc = _h(invoke_op(0, "add", "a"), ok_op(0, "add", "a"),
+              invoke_op(1, "read", None),
+              invoke_op(0, "add", "b"), ok_op(0, "add", "b"),
+              ok_op(1, "read", ["a"]))
+    assert wgl.analysis(GSet(), conc)["valid?"] is True
+    assert engine.analysis(GSet(), conc)["valid?"] is True
+
+
+def test_uqueue_multiset_counting():
+    """Two enqueues of the same value supply exactly two dequeues."""
+    from jepsen_tpu.models import UnorderedQueue
+    ops = [invoke_op(0, "enqueue", "a"), ok_op(0, "enqueue", "a"),
+           invoke_op(0, "enqueue", "a"), ok_op(0, "enqueue", "a"),
+           invoke_op(1, "dequeue", None), ok_op(1, "dequeue", "a"),
+           invoke_op(1, "dequeue", None), ok_op(1, "dequeue", "a")]
+    assert engine.analysis(UnorderedQueue(), _h(*ops))["valid?"] is True
+    ops += [invoke_op(1, "dequeue", None), ok_op(1, "dequeue", "a")]
+    r = engine.analysis(UnorderedQueue(), _h(*ops))
+    assert r["valid?"] is False
+    assert wgl.analysis(UnorderedQueue(), _h(*ops))["valid?"] is False
+
+
 def test_uqueue_counterexample_reports_observed_value():
     from jepsen_tpu.models import UnorderedQueue
     r = engine.analysis(UnorderedQueue(), _h(
