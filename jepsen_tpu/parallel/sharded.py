@@ -26,6 +26,16 @@ search frontier, not the sequence"):
 The whole event scan runs inside one `shard_map` region: slot tables are
 replicated, frontier arrays stay device-local, and the only cross-device
 traffic is the closure's exchange + psums.
+
+**Multi-slice (DCN):** give `check_encoded_sharded` a mesh whose device
+array is 2-D — axis 0 = slices (DCN between them), axis 1 = chips
+within a slice (ICI) — and the owner routing goes HIERARCHICAL: stage 1
+delivers candidates to the owner's chip column over ICI, stage 2
+crosses slices with rows pre-aggregated into ONE bucket per destination
+slice. Every row still crosses DCN exactly once, but as n_slice large
+messages per device per round instead of n_slice*n_chip small ones —
+DCN latency punishes message count, not bytes. CI exercises this on
+2x4 and 4x2 CPU meshes; psums ride both axes.
 """
 
 from __future__ import annotations
@@ -77,48 +87,56 @@ def _owned_dedupe_compact(st, ml, mh, live, Nd, n_dev, my_idx):
     return new_st, new_ml, new_mh, new_live, count, count > Nd
 
 
-def _route_to_owners(st, ml, mh, legal, n_dev: int, B: int):
-    """Owner-routed exchange (runs INSIDE shard_map): deliver each legal
-    row to the device `hash(row) % n_dev` via one segmented all-to-all.
+def _route_stage(st, ml, mh, live, dest, n_dest: int, B: int, axis: str):
+    """One segmented all-to-all stage (runs INSIDE shard_map): deliver
+    each live row to position `dest` along the mesh axis `axis`.
 
-    Rows are sorted by owner (dead rows sink past bucket n_dev-1), each
-    owner's bucket is padded/truncated to the static width B, and
-    `lax.all_to_all(tiled)` swaps bucket d to device d. Returns the
-    received rows [n_dev*B] plus a local overflow flag (some bucket
-    exceeded B — the caller escalates to a capacity retry)."""
+    Rows are sorted by destination (dead rows sink past bucket
+    n_dest-1), each destination's bucket is padded/truncated to the
+    static width B, and `lax.all_to_all(tiled)` swaps bucket d to
+    device d. Returns the received rows [n_dest*B] plus a local
+    overflow flag (some bucket exceeded B — the caller escalates to a
+    capacity retry)."""
     L = st.shape[0]
-    owner = (_hash_config(st, ml, mh) % jnp.uint32(n_dev)).astype(jnp.int32)
-    key = jnp.where(legal, owner, n_dev)
+    key = jnp.where(live, dest.astype(jnp.int32), n_dest)
     order = jnp.argsort(key)
     st_s, ml_s, mh_s = st[order], ml[order], mh[order]
     key_s = key[order]
-    starts = jnp.searchsorted(key_s, jnp.arange(n_dev))
-    rank = jnp.arange(L) - starts[jnp.clip(key_s, 0, n_dev - 1)]
-    in_bucket = (key_s < n_dev) & (rank < B)
-    ovf = jnp.any((key_s < n_dev) & (rank >= B))
-    pos = jnp.where(in_bucket, key_s * B + rank, n_dev * B)  # OOB -> drop
-    buf_st = jnp.zeros(n_dev * B, jnp.int32).at[pos].set(st_s, mode="drop")
-    buf_ml = jnp.zeros(n_dev * B, jnp.uint32).at[pos].set(ml_s, mode="drop")
-    buf_mh = jnp.zeros(n_dev * B, jnp.uint32).at[pos].set(mh_s, mode="drop")
-    buf_lv = jnp.zeros(n_dev * B, jnp.uint8).at[pos].set(
+    starts = jnp.searchsorted(key_s, jnp.arange(n_dest))
+    rank = jnp.arange(L) - starts[jnp.clip(key_s, 0, n_dest - 1)]
+    in_bucket = (key_s < n_dest) & (rank < B)
+    ovf = jnp.any((key_s < n_dest) & (rank >= B))
+    pos = jnp.where(in_bucket, key_s * B + rank, n_dest * B)  # OOB -> drop
+    buf_st = jnp.zeros(n_dest * B, jnp.int32).at[pos].set(st_s, mode="drop")
+    buf_ml = jnp.zeros(n_dest * B, jnp.uint32).at[pos].set(ml_s, mode="drop")
+    buf_mh = jnp.zeros(n_dest * B, jnp.uint32).at[pos].set(mh_s, mode="drop")
+    buf_lv = jnp.zeros(n_dest * B, jnp.uint8).at[pos].set(
         in_bucket.astype(jnp.uint8), mode="drop")
-    a2a = lambda a: lax.all_to_all(a, AXIS, split_axis=0, concat_axis=0,
+    a2a = lambda a: lax.all_to_all(a, axis, split_axis=0, concat_axis=0,
                                    tiled=True)
     return (a2a(buf_st), a2a(buf_ml), a2a(buf_mh),
             a2a(buf_lv).astype(bool), ovf)
 
 
-def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int,
-                  exchange: str = "route"):
-    """Runs INSIDE shard_map: per-device view, mesh axis AXIS."""
+def _route_to_owners(st, ml, mh, legal, n_dev: int, B: int):
+    """Flat owner routing over the 1-D mesh: one stage, dest =
+    hash(row) % n_dev."""
+    owner = _hash_config(st, ml, mh) % jnp.uint32(n_dev)
+    return _route_stage(st, ml, mh, legal, owner, n_dev, B, AXIS)
+
+
+def _sharded_core(xs, state0, step_name: str, Nd: int, n_dev: int,
+                  my_idx, axes, route_cand, route_front):
+    """The topology-independent event scan (runs INSIDE shard_map).
+
+    `axes` names the mesh axes reductions ride; `route_cand(st, ml, mh,
+    live)` / `route_front(...)` deliver candidate / surviving rows to
+    their hash-owner devices (returning an overflow flag) — the ONLY
+    things that differ between the flat 1-D mesh, the all-gather A/B
+    path, and the hierarchical multi-slice topology."""
     step = STEPS[step_name]
     C = xs["slot_f"].shape[1]
     bit_lo, bit_hi = _slot_bits(C)
-    my_idx = lax.axis_index(AXIS).astype(jnp.uint32)
-    # owner-bucket widths: 2x the uniform share (hash-uniform slack),
-    # floored so tiny frontiers never trip the overflow path
-    B_cand = max(64, -(-2 * Nd * C // n_dev))
-    B_front = max(64, -(-2 * Nd // n_dev))
 
     step_cc = jax.vmap(
         jax.vmap(step, in_axes=(None, 0, 0, 0, 0)),
@@ -133,35 +151,26 @@ def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int,
         def body(c):
             st, ml, mh, live, _, _ = c
             cand_st, cand_ok = step_cc(
-                st, ev["slot_f"], ev["slot_a0"], ev["slot_a1"], ev["slot_wild"])
+                st, ev["slot_f"], ev["slot_a0"], ev["slot_a1"],
+                ev["slot_wild"])
             already = ((ml[:, None] & bit_lo[None, :])
                        | (mh[:, None] & bit_hi[None, :])) != 0
             legal = (live[:, None] & ev["slot_occ"][None, :]
                      & ~already & cand_ok)
-            c_st = cand_st.reshape(-1)
-            c_ml = (ml[:, None] | bit_lo[None, :]).reshape(-1)
-            c_mh = (mh[:, None] | bit_hi[None, :]).reshape(-1)
-            c_live = legal.reshape(-1)
-            route_ovf = jnp.array(False)
-            if exchange == "route":
-                # owner-routed: each candidate travels once, to its owner
-                c_st, c_ml, c_mh, c_live, route_ovf = _route_to_owners(
-                    c_st, c_ml, c_mh, c_live, n_dev, B_cand)
-            else:
-                # broadcast: every candidate to every device (A/B path)
-                c_st = lax.all_gather(c_st, AXIS, tiled=True)
-                c_ml = lax.all_gather(c_ml, AXIS, tiled=True)
-                c_mh = lax.all_gather(c_mh, AXIS, tiled=True)
-                c_live = lax.all_gather(c_live, AXIS, tiled=True)
+            c_st, c_ml, c_mh, c_live, route_ovf = route_cand(
+                cand_st.reshape(-1),
+                (ml[:, None] | bit_lo[None, :]).reshape(-1),
+                (mh[:, None] | bit_hi[None, :]).reshape(-1),
+                legal.reshape(-1))
             all_st = jnp.concatenate([st, c_st])
             all_ml = jnp.concatenate([ml, c_ml])
             all_mh = jnp.concatenate([mh, c_mh])
             all_live = jnp.concatenate([live, c_live])
-            old_n = lax.psum(jnp.sum(live), AXIS)
+            old_n = lax.psum(jnp.sum(live), axes)
             st2, ml2, mh2, live2, cnt, ovf = _owned_dedupe_compact(
                 all_st, all_ml, all_mh, all_live, Nd, n_dev, my_idx)
-            new_n = lax.psum(cnt, AXIS)
-            g_ovf = lax.psum((ovf | route_ovf).astype(jnp.int32), AXIS) > 0
+            new_n = lax.psum(cnt, axes)
+            g_ovf = lax.psum((ovf | route_ovf).astype(jnp.int32), axes) > 0
             return st2, ml2, mh2, live2, new_n > old_n, g_ovf
         return body
 
@@ -184,23 +193,16 @@ def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int,
         live3 = live2 & has
         ml3 = jnp.where(live3, ml2 & ~blo, ml2)
         mh3 = jnp.where(live3, mh2 & ~bhi, mh2)
-        n_live = lax.psum(jnp.sum(live3), AXIS)
+        n_live = lax.psum(jnp.sum(live3), axes)
         failed_here = run & (n_live == 0)
         # clearing the slot bit changed every survivor's hash — re-route
         # each config to its new owner device before the next closure
-        if exchange == "route":
-            r_st, r_ml, r_mh, r_live, rt_ovf = _route_to_owners(
-                st2, ml3, mh3, live3, n_dev, B_front)
-        else:
-            rt_ovf = jnp.array(False)
-            r_st = lax.all_gather(st2, AXIS, tiled=True)
-            r_ml = lax.all_gather(ml3, AXIS, tiled=True)
-            r_mh = lax.all_gather(mh3, AXIS, tiled=True)
-            r_live = lax.all_gather(live3, AXIS, tiled=True)
+        r_st, r_ml, r_mh, r_live, rt_ovf = route_front(st2, ml3, mh3,
+                                                       live3)
         st2, ml3, mh3, live3, _, r_ovf = _owned_dedupe_compact(
             r_st, r_ml, r_mh, r_live, Nd, n_dev, my_idx)
         ovf = ovf | (run & (lax.psum((r_ovf | rt_ovf).astype(jnp.int32),
-                                     AXIS) > 0))
+                                     axes) > 0))
         new_ok = jnp.where(run, ~failed_here & ~ovf, ok)
         new_fail = jnp.where(failed_here & (fail_r < 0), r_idx, fail_r)
         st_o = jnp.where(run, st2, st)
@@ -208,7 +210,8 @@ def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int,
         mh_o = jnp.where(run, mh3, mh)
         live_o = jnp.where(run, live3, live)
         maxf = jnp.maximum(maxf, jnp.where(run,
-                                           lax.psum(jnp.sum(live2), AXIS), 0))
+                                           lax.psum(jnp.sum(live2), axes),
+                                           0))
         return (st_o, ml_o, mh_o, live_o, new_ok, new_fail,
                 r_idx + 1, maxf), ovf
 
@@ -223,8 +226,88 @@ def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int,
     carry, ovfs = lax.scan(scan_step, carry0, xs)
     _, _, _, live, ok, fail_r, _, maxf = carry
     overflow = jnp.any(ovfs)
-    valid = ok & (lax.psum(jnp.sum(live), AXIS) > 0) & ~overflow
+    valid = ok & (lax.psum(jnp.sum(live), axes) > 0) & ~overflow
     return valid, fail_r, overflow, maxf
+
+
+def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int,
+                  exchange: str = "route"):
+    """1-D topology adapter: flat owner routing over AXIS, or the
+    all-gather broadcast (A/B measurement path)."""
+    C = xs["slot_f"].shape[1]
+    my_idx = lax.axis_index(AXIS).astype(jnp.uint32)
+    # owner-bucket widths: 2x the uniform share (hash-uniform slack),
+    # floored so tiny frontiers never trip the overflow path
+    B_cand = max(64, -(-2 * Nd * C // n_dev))
+    B_front = max(64, -(-2 * Nd // n_dev))
+    if exchange == "route":
+        route_cand = lambda st, ml, mh, lv: _route_to_owners(
+            st, ml, mh, lv, n_dev, B_cand)
+        route_front = lambda st, ml, mh, lv: _route_to_owners(
+            st, ml, mh, lv, n_dev, B_front)
+    else:
+        def _bcast(st, ml, mh, lv):
+            g = lambda a: lax.all_gather(a, AXIS, tiled=True)
+            return g(st), g(ml), g(mh), g(lv), jnp.array(False)
+        route_cand = route_front = _bcast
+    return _sharded_core(xs, state0, step_name, Nd, n_dev, my_idx,
+                         (AXIS,), route_cand, route_front)
+
+
+AX_SLICE, AX_CHIP = "slice", "chip"
+
+
+def _sharded2d_impl(xs, state0, step_name: str, Nd: int,
+                    n_slice: int, n_chip: int):
+    """2-D topology adapter (slice x chip): the multi-slice story.
+    Owner routing is HIERARCHICAL — stage 1 delivers candidates to the
+    owner's chip COLUMN over the intra-slice axis (ICI); stage 2
+    crosses slices (DCN) with rows already aggregated into one bucket
+    per destination slice. Each row still crosses the slice boundary
+    exactly once, but DCN sees n_slice large buckets per device instead
+    of n_slice*n_chip small ones — message-count, not byte-count, is
+    what DCN latency punishes."""
+    C = xs["slot_f"].shape[1]
+    D = n_slice * n_chip
+    my_idx = (lax.axis_index(AX_SLICE) * n_chip
+              + lax.axis_index(AX_CHIP)).astype(jnp.uint32)
+    # bucket widths: 2x the uniform share at each stage; stage-2 input
+    # is the stage-1 receive buffer (n_chip * B1 rows)
+    B1c = max(64, -(-2 * Nd * C // n_chip))
+    B2c = max(64, -(-2 * n_chip * B1c // n_slice))
+    B1f = max(64, -(-2 * Nd // n_chip))
+    B2f = max(64, -(-2 * n_chip * B1f // n_slice))
+
+    def route2(st, ml, mh, live, B1, B2):
+        owner = _hash_config(st, ml, mh) % jnp.uint32(D)
+        st, ml, mh, live, o1 = _route_stage(
+            st, ml, mh, live, owner % jnp.uint32(n_chip), n_chip, B1,
+            AX_CHIP)
+        owner = _hash_config(st, ml, mh) % jnp.uint32(D)
+        st, ml, mh, live, o2 = _route_stage(
+            st, ml, mh, live, owner // jnp.uint32(n_chip), n_slice, B2,
+            AX_SLICE)
+        return st, ml, mh, live, o1 | o2
+
+    return _sharded_core(
+        xs, state0, step_name, Nd, D, my_idx, (AX_SLICE, AX_CHIP),
+        lambda st, ml, mh, lv: route2(st, ml, mh, lv, B1c, B2c),
+        lambda st, ml, mh, lv: route2(st, ml, mh, lv, B1f, B2f))
+
+
+@functools.partial(jax.jit, static_argnames=("step_name", "Nd", "n_slice",
+                                             "n_chip", "mesh"))
+def _check_sharded2d(xs, state0, step_name: str, Nd: int, n_slice: int,
+                     n_chip: int, mesh: Mesh):
+    fn = jax.shard_map(
+        lambda x, s0: _sharded2d_impl(x, s0, step_name, Nd, n_slice,
+                                      n_chip),
+        mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
+    )
+    return fn(xs, state0)
 
 
 @functools.partial(jax.jit, static_argnames=("step_name", "Nd", "n_dev",
@@ -245,18 +328,36 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
                           capacity: int = 8192,
                           max_capacity: int = 1 << 22,
                           exchange: str = "route") -> dict:
-    """Check one encoded history with the frontier sharded over `mesh`'s
-    first axis. `capacity` is the GLOBAL frontier capacity; it doubles
-    on overflow (frontier past capacity, or an owner bucket past its
-    2x-uniform slack) by re-jitting at the next tier, like
-    `engine.check_encoded`. `exchange` picks the candidate exchange:
-    "route" (owner-routed segmented all-to-all, the default) or
-    "gather" (full all-gather broadcast, kept for A/B measurement)."""
+    """Check one encoded history with the frontier sharded over `mesh`.
+
+    Topology: a mesh whose device array is 2-D (both dims > 1) with
+    exchange="route" selects the HIERARCHICAL multi-slice path — axis 0
+    is treated as the slice (DCN) axis, axis 1 as intra-slice chips
+    (ICI), candidates route in two stages (see the module docstring),
+    and the result carries a "mesh" key. Any other mesh is flattened
+    onto a 1-D axis; exchange="gather" (the all-gather A/B measurement
+    path) always flattens.
+
+    `capacity` is the GLOBAL frontier capacity; it doubles on overflow
+    (frontier past capacity, or an owner bucket past its 2x-uniform
+    slack) by re-jitting at the next tier, like
+    `engine.check_encoded`."""
     if e.n_returns == 0:
         return {"valid?": True, "max-frontier": 0, "capacity": 0}
-    # flatten whatever mesh we're given onto a 1-D mesh named AXIS
-    mesh = Mesh(np.asarray(mesh.devices).reshape(-1), (AXIS,))
-    n_dev = mesh.shape[AXIS]
+    # A 2-D device array + "route" = the multi-slice topology: axis 0
+    # is the slice (DCN) axis, axis 1 the intra-slice chip (ICI) axis,
+    # and the exchange goes hierarchical. Anything else flattens onto
+    # a 1-D mesh named AXIS.
+    devs = np.asarray(mesh.devices)
+    hier = exchange == "route" and devs.ndim == 2 and devs.shape[0] > 1 \
+        and devs.shape[1] > 1
+    if hier:
+        n_slice, n_chip = devs.shape
+        mesh = Mesh(devs, (AX_SLICE, AX_CHIP))
+        n_dev = n_slice * n_chip
+    else:
+        mesh = Mesh(devs.reshape(-1), (AXIS,))
+        n_dev = mesh.shape[AXIS]
     # replicate inputs onto the mesh explicitly: nothing may be created
     # on the default backend (it can be a broken TPU runtime while we
     # deliberately run on a CPU mesh — the MULTICHIP_r01 crash mode)
@@ -266,8 +367,12 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
     N = max(64 * n_dev, capacity)
     while True:
         Nd = (N + n_dev - 1) // n_dev
-        valid, fail_r, overflow, maxf = _check_sharded(
-            xs, state0, e.step_name, Nd, n_dev, mesh, exchange)
+        if hier:
+            valid, fail_r, overflow, maxf = _check_sharded2d(
+                xs, state0, e.step_name, Nd, n_slice, n_chip, mesh)
+        else:
+            valid, fail_r, overflow, maxf = _check_sharded(
+                xs, state0, e.step_name, Nd, n_dev, mesh, exchange)
         if not bool(overflow):
             break
         if N * 2 > max_capacity:
@@ -277,6 +382,8 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
         N *= 2
     out = {"valid?": bool(valid), "max-frontier": int(maxf),
            "capacity": N, "devices": n_dev}
+    if hier:
+        out["mesh"] = f"{n_slice}x{n_chip} (hierarchical exchange)"
     if not out["valid?"]:
         from jepsen_tpu.parallel.encode import fail_op_fields
         out.update(fail_op_fields(e, int(fail_r)))
