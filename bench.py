@@ -236,6 +236,7 @@ def main():
                   else None,
                   "devices": r.get("devices"), "valid": r.get("valid?"),
                   "device_secs": round(dev_secs, 2),
+                  "device_compile_secs": round(warm - dev_secs, 2),
                   "note": "owner-routed all-to-all exchange; multi-device "
                           "behavior exercised on the 8-way CPU mesh in CI"})
     except Exception as err:  # noqa: BLE001 — a sharded-path failure

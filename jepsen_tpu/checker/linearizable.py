@@ -85,6 +85,30 @@ class Linearizable(Checker):
         else:
             raise ValueError(f"unknown linearizability algorithm {algo!r}")
         r["analyzer"] = algo
+        if (r.get("valid?") is False and not r.get("final-paths")
+                and algo in ("linear", "packed") and len(h) <= 1000):
+            # the frontier engines localize the failure but keep no
+            # breadcrumbs; knossos's linear analysis always produces
+            # final-paths (they feed linear.svg, checker.clj:203-207) —
+            # attach them via a state-bounded WGL re-search
+            from jepsen_tpu.checker import wgl as _wgl
+            rw = _wgl.analysis(model, h, max_states=1_000_000)
+            if rw.get("valid?") is False:
+                # take wgl's whole failure report so op / final-paths /
+                # configs describe the SAME stuck point (the frontier
+                # engine may localize a different window)
+                r["final-paths"] = rw.get("final-paths", [])
+                r["configs"] = rw.get("configs", [])
+                if rw.get("op"):
+                    r["op"] = rw["op"]
+            elif rw.get("valid?") is True:
+                # the oracle contradicts the engine: surface it loudly —
+                # a silent wrong verdict would hide an engine bug
+                import logging
+                logging.getLogger(__name__).warning(
+                    "%s said invalid but the WGL oracle says valid — "
+                    "engine disagreement", algo)
+                r["oracle-disagreement"] = True
         r = _truncate(r)
 
         # On failure, render the counterexample SVG into the store, as

@@ -74,6 +74,23 @@ def test_packed_config_budget_reports_progress():
     assert "events-done" in r and "max-frontier" in r
 
 
+def test_packed_dispatcher_attaches_final_paths():
+    """Via the Checker boundary, an invalid 'packed' (or 'linear')
+    verdict carries final-paths like knossos's analyses do
+    (checker.clj:203-207 renders linear.svg from them)."""
+    from jepsen_tpu import checker
+    from jepsen_tpu.history import History, invoke_op, ok_op
+    h = History.wrap([
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(0, "read", None), ok_op(0, "read", 2),
+    ]).index()
+    for algo in ("packed", "linear"):
+        r = checker.linearizable(CASRegister(), algorithm=algo)\
+            .check({}, h, {})
+        assert r["valid?"] is False and r["analyzer"] == algo
+        assert r["final-paths"], (algo, r)
+
+
 def test_packed_raises_for_unpackable():
     from jepsen_tpu.models import Model
     from jepsen_tpu.parallel.encode import EncodeError
