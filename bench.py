@@ -35,13 +35,27 @@ baseline would flatter the speedup). Caveat, stated rather than
 fudged: a JVM knossos would run a Python baseline some constant factor
 faster; the adversarial speedups measured here are orders of magnitude
 above that factor.
+
+HANG ISOLATION. Every section runs in its OWN subprocess under a hard
+wall-clock timeout (the parent process never imports jax). A wedged
+device runtime — e.g. a TPU tunnel outage mid-call, observed in the
+wild: the PJRT client blocks forever inside make_c_api_client / a
+device sync with no Python-level signal delivery — therefore costs
+exactly one section, not the bench: the parent kills the child, emits
+a machine-readable `{"skipped": "timeout/hang"}` line, and moves on.
+The headline is computed by the parent from whichever sections
+completed, so the driver always records a result. Children re-emit
+their JSON lines on stdout; the parent forwards them verbatim and
+parses them to thread host-baseline estimates between sections.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
+import threading
 from time import monotonic, perf_counter
 
 # -------- north-star multi-key shape (reference workload dimensions)
@@ -63,6 +77,26 @@ HOST_DEADLINES = ({200: 10.0, 400: 5.0} if SMOKE
                   else {1000: 45.0, 5000: 20.0, 10000: 25.0, 50000: 15.0})
 BUDGET_SECS = float(os.environ.get("BENCH_BUDGET_SECS", "900"))
 
+# Per-section wall-clock timeouts (seconds). Generous against measured
+# runtimes (compile + cold + steady + host deadline), tight against the
+# global budget; tuned so a single hang leaves room for what follows.
+TIMEOUT_SCALE = float(os.environ.get("BENCH_TIMEOUT_SCALE", "1"))
+SEC_TIMEOUTS = {
+    "multikey": 60 if SMOKE else 300,
+    "adv": ({200: 60, 400: 60} if SMOKE
+            else {1000: 180, 5000: 240, 10000: 300, 50000: 480}),
+    "sharded": 90 if SMOKE else 300,
+    "maxlen": 120 if SMOKE else 360,
+}
+
+
+def sec_timeout(key: str, L: int | None = None) -> float:
+    """Scaled per-section timeout. TIMEOUT_SCALE applies HERE — before
+    the callers clamp by the remaining global budget — so a scale > 1
+    can never push a section past BUDGET_SECS."""
+    base = SEC_TIMEOUTS["adv"][L] if key == "adv" else SEC_TIMEOUTS[key]
+    return base * TIMEOUT_SCALE
+
 
 def emit(obj):
     print(json.dumps(obj), flush=True)
@@ -72,20 +106,37 @@ def note(msg):
     print(f"# {msg}", file=sys.stderr, flush=True)
 
 
-def main():
-    from jepsen_tpu.histories import (
-        adversarial_register_history, rand_register_history)
+def _enable_compile_cache():
+    """Persistent compilation cache: lets a child reuse a sibling's
+    compile for the same shape (e.g. maxlen re-probing the 10k shape).
+    Best-effort — some backends (remote-compile tunnels) ignore it."""
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/jepsen_bench_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _adv_encoded(L):
+    from jepsen_tpu.histories import adversarial_register_history
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.parallel import encode as enc_mod
+    model = CASRegister()
+    h = adversarial_register_history(n_ops=L, k_crashed=ADV_K, seed=7)
+    return model, h, enc_mod.encode(model, h)
+
+
+# ======================= child sections ============================
+
+def sec_multikey():
+    from jepsen_tpu.histories import rand_register_history
     from jepsen_tpu.models import CASRegister
     from jepsen_tpu.checker import linear_packed
     from jepsen_tpu.parallel import bitdense, encode as enc_mod
 
     model = CASRegister()
-    t_start = monotonic()
-
-    def left():
-        return BUDGET_SECS - (monotonic() - t_start)
-
-    # ---------------- 1. multi-key north-star shape --------------------
     keys = [rand_register_history(
         n_ops=OPS_PER_KEY, n_processes=N_PROCESSES, n_values=5,
         crash_p=0.005, fail_p=0.05, busy=BUSY, seed=SEED + k)
@@ -138,113 +189,119 @@ def main():
                       "(per-key checks parallelize perfectly, so 32x is "
                       "the host's true ceiling)"})
 
-    # ---------------- 2. adversarial single-key ------------------------
-    adv_results = {}
-    adv_enc = {}     # L -> encoded history, reused by sections 3 and 4
 
-    def adv_encoded(L):
-        if L not in adv_enc:
-            h = adversarial_register_history(n_ops=L, k_crashed=ADV_K,
-                                             seed=7)
-            adv_enc[L] = (h, enc_mod.encode(model, h))
-        return adv_enc[L]
+def sec_adv(L: int, host_deadline: float, skip_host: bool,
+            host_est_hint: float | None):
+    from jepsen_tpu.checker import linear_packed
+    from jepsen_tpu.parallel import bitdense
 
-    for L in ADV_SIZES:
-        if left() < 90:
-            emit({"metric": f"adversarial single-key {L}-op", "value": None,
-                  "unit": "ops/sec", "skipped": "bench budget exhausted"})
-            continue
-        h, e = adv_encoded(L)
-        assert bitdense.fits_bitdense(bitdense.n_states(e), e.n_slots)
+    _, _, e = _adv_encoded(L)
+    assert bitdense.fits_bitdense(bitdense.n_states(e), e.n_slots)
+    t0 = perf_counter()
+    r = bitdense.check_encoded_bitdense(e)      # cold (compile per R)
+    warm_secs = perf_counter() - t0
+    t0 = perf_counter()
+    r = bitdense.check_encoded_bitdense(e)      # steady state
+    dev_secs = perf_counter() - t0
+    assert r["valid?"] is True, r
+    R = e.n_returns
+
+    host_info = {"deadline_secs": host_deadline}
+    host_est = None
+    if skip_host:
+        # parent ran out of budget for a host run: it passes the
+        # previous size's measured rate scaled by L as the estimate
+        host_est = host_est_hint
+        host_info.update({"skipped": "bench budget",
+                          "est_total_secs": round(host_est, 1)
+                          if host_est else None})
+    else:
         t0 = perf_counter()
-        r = bitdense.check_encoded_bitdense(e)      # cold (compile per R)
-        warm_secs = perf_counter() - t0
-        t0 = perf_counter()
-        r = bitdense.check_encoded_bitdense(e)      # steady state
-        dev_secs = perf_counter() - t0
-        assert r["valid?"] is True, r
-        R = e.n_returns
-
-        host_info = {"deadline_secs": HOST_DEADLINES[L]}
-        if left() > HOST_DEADLINES[L] + 30:
-            t0 = perf_counter()
-            rh = linear_packed.check_encoded(
-                e, deadline=monotonic() + HOST_DEADLINES[L])
-            host_wall = perf_counter() - t0
-            if rh["valid?"] == "unknown":
-                # deadline OR config-budget exhaustion: either way the
-                # host's measured progress rate is the estimate
-                done = max(1, rh.get("events-done", 1))
-                host_est = host_wall * R / done
-                host_info.update({"timeout": bool(rh.get("timeout")),
-                                  "stopped": rh.get("error", "deadline"),
-                                  "events_done": done, "of_events": R,
-                                  "est_total_secs": round(host_est, 1)})
-            else:
-                assert rh["valid?"] is True, rh
-                host_est = host_wall
-                host_info.update({"timeout": False,
-                                  "total_secs": round(host_wall, 1)})
+        rh = linear_packed.check_encoded(
+            e, deadline=monotonic() + host_deadline)
+        host_wall = perf_counter() - t0
+        if rh["valid?"] == "unknown":
+            # deadline OR config-budget exhaustion: either way the
+            # host's measured progress rate is the estimate
+            done = max(1, rh.get("events-done", 1))
+            host_est = host_wall * R / done
+            host_info.update({"timeout": bool(rh.get("timeout")),
+                              "stopped": rh.get("error", "deadline"),
+                              "events_done": done, "of_events": R,
+                              "est_total_secs": round(host_est, 1)})
         else:
-            # out of budget: scale the previous size's measured rate
-            idx = ADV_SIZES.index(L)
-            prev = adv_results.get(ADV_SIZES[idx - 1]) if idx > 0 else None
-            host_est = (prev["host_est"] * (L / prev["L"])
-                        if prev and prev["host_est"] is not None else None)
-            host_info.update({"skipped": "bench budget",
-                              "est_total_secs": round(host_est, 1)
-                              if host_est else None})
+            assert rh["valid?"] is True, rh
+            host_est = host_wall
+            host_info.update({"timeout": False,
+                              "total_secs": round(host_wall, 1)})
 
-        speedup = round(host_est / dev_secs, 1) if host_est else None
-        adv_results[L] = {"L": L, "dev_secs": dev_secs,
-                          "host_est": host_est, "speedup": speedup}
-        emit({"metric": f"adversarial single-key {L}-op cas-register "
-                        f"(2^{ADV_K} open configs), device",
-              "value": round(L / dev_secs, 1), "unit": "ops/sec",
-              "vs_baseline": speedup,
-              "device_secs": round(dev_secs, 2),
-              "device_compile_secs": round(warm_secs - dev_secs, 2),
-              "host": host_info,
-              "baseline": "packed int-config host engine, single-"
-                          "threaded — a single key cannot be "
-                          "parallelized by knossos linear/wgl, so no "
-                          "32x scaling applies"})
+    speedup = round(host_est / dev_secs, 1) if host_est else None
+    emit({"metric": f"adversarial single-key {L}-op cas-register "
+                    f"(2^{ADV_K} open configs), device",
+          "value": round(L / dev_secs, 1), "unit": "ops/sec",
+          "vs_baseline": speedup,
+          "L": L,
+          "device_secs": round(dev_secs, 3),
+          "device_compile_secs": round(warm_secs - dev_secs, 2),
+          "host_est_secs": round(host_est, 1) if host_est else None,
+          "host": host_info,
+          "baseline": "packed int-config host engine, single-"
+                      "threaded — a single key cannot be "
+                      "parallelized by knossos linear/wgl, so no "
+                      "32x scaling applies"})
 
-    # ---------------- 3. sharded engine on the local mesh --------------
-    try:
-        if 10000 in adv_results and left() > 120:
-            import jax
-            from jax.sharding import Mesh
-            import numpy as np
-            from jepsen_tpu.parallel import sharded
-            _, e = adv_encoded(10000)
-            mesh = Mesh(np.array(jax.devices()), ("frontier",))
-            cap = 1 << 17
-            t0 = perf_counter()
-            r = sharded.check_encoded_sharded(e, mesh, capacity=cap,
-                                              max_capacity=1 << 20)
-            warm = perf_counter() - t0
-            t0 = perf_counter()
-            r = sharded.check_encoded_sharded(e, mesh,
-                                              capacity=r.get("capacity", cap),
-                                              max_capacity=1 << 20)
-            dev_secs = perf_counter() - t0
-            emit({"metric": "adversarial 10k-op via frontier-sharded engine",
-                  "value": round(10000 / dev_secs, 1), "unit": "ops/sec",
-                  "vs_baseline": round(adv_results[10000]["host_est"] / dev_secs,
-                                       1) if adv_results[10000]["host_est"]
-                  else None,
-                  "devices": r.get("devices"), "valid": r.get("valid?"),
-                  "device_secs": round(dev_secs, 2),
-                  "device_compile_secs": round(warm - dev_secs, 2),
-                  "note": "owner-routed all-to-all exchange; multi-device "
-                          "behavior exercised on the 8-way CPU mesh in CI"})
-    except Exception as err:  # noqa: BLE001 — a sharded-path failure
-        # must not cost the bench its remaining sections or headline
-        emit({"metric": "adversarial 10k-op via frontier-sharded engine",
-              "value": None, "unit": "ops/sec", "error": repr(err)})
 
-    # ---------------- 4. max length verified @ 60s ---------------------
+def sec_sharded(L: int, host_est: float | None):
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    from jepsen_tpu.parallel import sharded
+
+    _, _, e = _adv_encoded(L)
+    mesh = Mesh(np.array(jax.devices()), ("frontier",))
+    cap0 = (1 << 12) if SMOKE else (1 << 17)
+    t0 = perf_counter()
+    r = sharded.check_encoded_sharded(e, mesh, capacity=cap0,
+                                      max_capacity=1 << 20)
+    warm = perf_counter() - t0
+    cap = r.get("capacity", cap0)
+    if cap != cap0:
+        # capacity grew during the warm run: compile the final tier
+        # before measuring, so the steady number holds no compile
+        sharded.check_encoded_sharded(e, mesh, capacity=cap,
+                                      max_capacity=1 << 20)
+    t0 = perf_counter()
+    r = sharded.check_encoded_sharded(e, mesh, capacity=cap,
+                                      max_capacity=1 << 20)
+    dev_secs = perf_counter() - t0
+    line = {"metric": f"adversarial {L}-op via frontier-sharded engine",
+            "value": round(L / dev_secs, 1), "unit": "ops/sec",
+            "vs_baseline": round(host_est / dev_secs, 1)
+            if host_est else None,
+            "devices": r.get("devices"), "valid": r.get("valid?"),
+            "device_secs": round(dev_secs, 2),
+            "warm_secs": round(warm, 2),
+            "note": "owner-routed all-to-all exchange; multi-device "
+                    "behavior exercised on the 8-way CPU mesh in CI"}
+    if cap == cap0:
+        # warm and steady runs share one shape, so the difference IS
+        # the compile; after tier growth it would also contain whole
+        # searches at smaller capacities — omitted rather than fudged
+        line["device_compile_secs"] = round(max(warm - dev_secs, 0.0), 2)
+    else:
+        line["capacity_grew_to"] = cap
+    emit(line)
+
+
+def sec_maxlen(budget_secs: float):
+    """Max length verified @ 60s device budget, within budget_secs."""
+    from jepsen_tpu.parallel import bitdense
+
+    t_start = monotonic()
+
+    def left():
+        return budget_secs - (monotonic() - t_start)
+
     max_len = 0
     budget_per_run = 5 if SMOKE else 60
     L = 400 if SMOKE else 10000
@@ -252,7 +309,7 @@ def main():
     while left() > 2.5 * budget_per_run:
         if prev_dt is not None and prev_dt * 2 > 1.5 * budget_per_run:
             break   # doubling would clearly blow the budget; stop early
-        _, e = adv_encoded(L)
+        _, _, e = _adv_encoded(L)
         bitdense.check_encoded_bitdense(e)          # compile, uncounted
         t0 = perf_counter()
         r = bitdense.check_encoded_bitdense(e)
@@ -274,18 +331,122 @@ def main():
               "note": "steady-state device time; per-shape compile "
                       "excluded (one-time, cached)"})
 
-    # ---------------- HEADLINE (last line: the driver's record) --------
+
+# ======================= parent orchestrator =======================
+
+def run_section(argv: list, timeout: float) -> list:
+    """Spawn `python bench.py --section ...`; forward the child's
+    stdout lines as they arrive, parse the JSON ones, kill on timeout.
+    The ACTUAL timeout rides along as the final `--timeout` argv so
+    the child can schedule its pre-kill stack dump just before it.
+    Returns the parsed JSON objects (empty on crash/hang)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--section"] + \
+        [str(a) for a in argv] + ["--timeout", f"{timeout:.0f}"]
+    parsed = []
+    try:
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=sys.stderr, text=True)
+    except OSError as err:
+        emit({"metric": f"section {argv[0]}", "value": None,
+              "unit": "ops/sec", "error": repr(err)})
+        return parsed
+
+    def pump():
+        for line in proc.stdout:
+            line = line.rstrip("\n")
+            if not line:
+                continue
+            print(line, flush=True)            # forward verbatim
+            if line.lstrip().startswith("{"):
+                try:
+                    parsed.append(json.loads(line))
+                except ValueError:
+                    pass
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    try:
+        rc = proc.wait(timeout=timeout)
+        t.join(timeout=10)
+        if rc != 0:
+            emit({"metric": f"section {argv[0]}", "value": None,
+                  "unit": "ops/sec",
+                  "error": f"child exited rc={rc}"})
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        t.join(timeout=10)
+        emit({"metric": f"section {argv[0]}", "value": None,
+              "unit": "ops/sec",
+              "skipped": f"timeout/hang after {timeout:.0f}s "
+                         f"(section isolated in a subprocess; "
+                         f"bench continues)"})
+    return parsed
+
+
+def main():
+    t_start = monotonic()
+
+    def left():
+        return BUDGET_SECS - (monotonic() - t_start)
+
+    # ---------------- 1. multi-key north-star shape ----------------
+    multikey = run_section(["multikey"],
+                           min(sec_timeout("multikey"), BUDGET_SECS))
+    mk_line = next((p for p in multikey if p.get("value")), None)
+
+    # ---------------- 2. adversarial single-key --------------------
+    adv_results = {}       # L -> parsed line (with L, device_secs, host)
+    for L in ADV_SIZES:
+        sec_to = sec_timeout("adv", L)
+        if left() < min(90, sec_to):
+            emit({"metric": f"adversarial single-key {L}-op",
+                  "value": None,
+                  "unit": "ops/sec", "skipped": "bench budget exhausted"})
+            continue
+        deadline = HOST_DEADLINES[L]
+        skip_host = left() < deadline + 90
+        hint = ""
+        if skip_host:
+            # scale the largest completed size's host estimate
+            prev = max((p for p in adv_results.values()
+                        if p.get("host_est_secs")),
+                       key=lambda p: p["L"], default=None)
+            if prev:
+                hint = prev["host_est_secs"] * (L / prev["L"])
+        args = ["adv", L, deadline, int(skip_host), hint]
+        for p in run_section(args, min(sec_to, max(left(), 60))):
+            if p.get("L") == L and p.get("device_secs"):
+                adv_results[L] = p
+
+    # ---------------- 3. sharded engine on the local mesh ----------
+    pick = 10000 if not SMOKE else (400 if 400 in adv_results else None)
+    if pick in adv_results and left() > 120:
+        run_section(["sharded", pick,
+                     adv_results[pick].get("host_est_secs") or ""],
+                    min(sec_timeout("sharded"), left()))
+
+    # ---------------- 4. max length verified @ 60s -----------------
+    if left() > (30 if SMOKE else 150):
+        # the child's own probe budget sits INSIDE the kill timeout,
+        # with margin, so a healthy child always emits its metric line
+        # before the parent would kill it
+        to = min(sec_timeout("maxlen"), left())
+        run_section(["maxlen", max(to - 30, 20)], to)
+
+    # ---------------- HEADLINE (last line: the driver's record) ----
     # prefer 10k (the BASELINE.md config); else the largest that ran
     ten_k = adv_results.get(10000)
     if ten_k is None and adv_results:
         ten_k = adv_results[max(adv_results)]
     if ten_k is not None:
-        emit({"metric": f"adversarial {ten_k['L']}-op single-key "
+        L = ten_k["L"]
+        emit({"metric": f"adversarial {L}-op single-key "
                         f"cas-register linearizability check "
                         f"(2^{ADV_K} open configs)",
-              "value": round(ten_k["L"] / ten_k["dev_secs"], 1),
+              "value": round(L / ten_k["device_secs"], 1),
               "unit": "ops/sec",
-              "vs_baseline": ten_k["speedup"],
+              "vs_baseline": ten_k.get("vs_baseline"),
               "methodology": "vs this repo's packed int-config host "
                              "engine (same algorithm and encoding as "
                              "the device; our fastest CPU "
@@ -293,22 +454,61 @@ def main():
                              "on the same history; single-key search "
                              "does not parallelize, so the single-core "
                              "host rate IS the 32-core rate"})
-    else:
-        # budget ran out before any adversarial size finished: fall back
-        # to the multi-key line so the driver still records a headline
+    elif mk_line is not None:
+        # no adversarial size finished (budget/hang): fall back to the
+        # multi-key line so the driver still records a headline
         emit({"metric": f"multi-key {N_KEYS}x{OPS_PER_KEY}-op "
                         f"cas-register, device end-to-end",
-              "value": round(dev_rate, 1),
+              "value": mk_line["value"],
               "unit": "ops/sec",
-              "vs_baseline": round(dev_rate / host32_rate, 2)})
+              "vs_baseline": mk_line.get("vs_baseline")})
+    else:
+        emit({"metric": "linearizability check throughput",
+              "value": None, "unit": "ops/sec", "vs_baseline": None,
+              "error": "no section completed (device runtime down?) — "
+                       "see the per-section lines above"})
+
+
+def child_main(argv: list) -> None:
+    # a child that hangs in device code cannot deliver Python signals;
+    # dump a stack to stderr shortly before the parent's ACTUAL kill
+    # time (threaded through as --timeout) so the hang site is
+    # diagnosable from the bench log
+    import faulthandler
+    to = 300.0
+    if "--timeout" in argv:
+        i = argv.index("--timeout")
+        to = float(argv[i + 1])
+        argv = argv[:i] + argv[i + 2:]
+    sec = argv[0]
+    faulthandler.dump_traceback_later(max(20, to - 10), exit=False)
+    _enable_compile_cache()
+    if sec == "multikey":
+        sec_multikey()
+    elif sec == "adv":
+        L, deadline, skip_host = int(argv[1]), float(argv[2]), \
+            bool(int(argv[3]))
+        hint = float(argv[4]) if len(argv) > 4 and argv[4] else None
+        sec_adv(L, deadline, skip_host, hint)
+    elif sec == "sharded":
+        L = int(argv[1])
+        host_est = float(argv[2]) if len(argv) > 2 and argv[2] else None
+        sec_sharded(L, host_est)
+    elif sec == "maxlen":
+        sec_maxlen(float(argv[1]))
+    else:
+        raise SystemExit(f"unknown section {sec!r}")
 
 
 if __name__ == "__main__":
     try:
-        main()
+        if len(sys.argv) > 1 and sys.argv[1] == "--section":
+            child_main(sys.argv[2:])
+        else:
+            main()
     except Exception as err:  # noqa: BLE001
-        # the driver parses JSON lines: a crash must still leave a
-        # visible, machine-readable trace rather than bare stderr
+        # JSON-line consumers must see a machine-readable trace of any
+        # crash rather than bare stderr
         import traceback
         traceback.print_exc()
         emit({"metric": "bench crashed", "value": None, "unit": "ops/sec",
