@@ -293,6 +293,9 @@ def sec_sharded(L: int, host_est: float | None):
     emit(line)
 
 
+MAXLEN_RUN_BUDGET = 5 if SMOKE else 60   # the metric's "@ 60s" budget
+
+
 def sec_maxlen(budget_secs: float):
     """Max length verified @ 60s device budget, within budget_secs."""
     from jepsen_tpu.parallel import bitdense
@@ -303,7 +306,7 @@ def sec_maxlen(budget_secs: float):
         return budget_secs - (monotonic() - t_start)
 
     max_len = 0
-    budget_per_run = 5 if SMOKE else 60
+    budget_per_run = MAXLEN_RUN_BUDGET
     L = 400 if SMOKE else 10000
     prev_dt = None
     while left() > 2.5 * budget_per_run:
@@ -416,7 +419,7 @@ def main():
                 hint = prev["host_est_secs"] * (L / prev["L"])
         args = ["adv", L, deadline, int(skip_host), hint]
         for p in run_section(args, min(sec_to, max(left(), 60))):
-            if p.get("L") == L and p.get("device_secs"):
+            if p.get("L") == L and p.get("value") is not None:
                 adv_results[L] = p
 
     # ---------------- 3. sharded engine on the local mesh ----------
@@ -427,12 +430,13 @@ def main():
                     min(sec_timeout("sharded"), left()))
 
     # ---------------- 4. max length verified @ 60s -----------------
-    if left() > (30 if SMOKE else 150):
-        # the child's own probe budget sits INSIDE the kill timeout,
-        # with margin, so a healthy child always emits its metric line
-        # before the parent would kill it
-        to = min(sec_timeout("maxlen"), left())
-        run_section(["maxlen", max(to - 30, 20)], to)
+    # the child's own probe budget sits INSIDE the kill timeout, with
+    # margin; only spawn when that budget clears the probe loop's own
+    # floor (2.5x the per-run budget), so a child is never started
+    # that could not run a single probe
+    to = min(sec_timeout("maxlen"), left())
+    if to - 30 > 2.5 * MAXLEN_RUN_BUDGET:
+        run_section(["maxlen", to - 30], to)
 
     # ---------------- HEADLINE (last line: the driver's record) ----
     # prefer 10k (the BASELINE.md config); else the largest that ran
@@ -444,7 +448,7 @@ def main():
         emit({"metric": f"adversarial {L}-op single-key "
                         f"cas-register linearizability check "
                         f"(2^{ADV_K} open configs)",
-              "value": round(L / ten_k["device_secs"], 1),
+              "value": ten_k["value"],
               "unit": "ops/sec",
               "vs_baseline": ten_k.get("vs_baseline"),
               "methodology": "vs this repo's packed int-config host "
