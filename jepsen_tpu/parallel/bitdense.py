@@ -94,15 +94,25 @@ def _plan(C: int):
     return W, plan
 
 
-def _pallas_interpret() -> bool:
-    """Pallas interpreter mode when the backend isn't a real TPU —
-    CI runs the kernel's logic on the 8-device CPU mesh."""
-    import jax as _jax
-    return _jax.default_backend() != "tpu"
+def _resolve_use_pallas(use_pallas, S: int, C: int, platform: str):
+    """Shared gate for the single and batch paths: default from the
+    JEPSEN_TPU_PALLAS=1 env flag, downgraded to False for shapes the
+    kernel doesn't support. Returns (use_pallas, interpret) — interpret
+    mode whenever the DATA's platform isn't a real TPU (keyed off where
+    the arrays actually live, not the process default backend: a batch
+    pinned to a CPU mesh must never trace a TPU kernel just because a
+    TPU runtime happens to be the default)."""
+    if use_pallas is None:
+        use_pallas = os.environ.get("JEPSEN_TPU_PALLAS") == "1"
+    if use_pallas:
+        from jepsen_tpu.parallel import pallas_kernels as pk
+        use_pallas = pk.supported(S, C)
+    return use_pallas, platform != "tpu"
 
 
 def _bitdense_impl(xs, state0, step_name: str, S: int, C: int,
-                   lo: int = -1, use_pallas: bool = False):
+                   lo: int = -1, use_pallas: bool = False,
+                   pallas_interpret: bool = True):
     step = STEPS[step_name]
     W, plan = _plan(C)
     state_codes = jnp.arange(S, dtype=jnp.int32) + lo
@@ -201,7 +211,7 @@ def _bitdense_impl(xs, state0, step_name: str, S: int, C: int,
             B2 = lax.cond(
                 run,
                 lambda b: pk.closure_call(sel, b, C,
-                                          interpret=_pallas_interpret()),
+                                          interpret=pallas_interpret),
                 lambda b: b, B)
         else:
             B2, _ = lax.while_loop(closure_cond, make_closure_body(sel),
@@ -224,15 +234,23 @@ def _bitdense_impl(xs, state0, step_name: str, S: int, C: int,
 
 _check_bitdense = jax.jit(_bitdense_impl,
                           static_argnames=("step_name", "S", "C", "lo",
-                                           "use_pallas"))
+                                           "use_pallas",
+                                           "pallas_interpret"))
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("step_name", "S", "C", "lo"))
+                   static_argnames=("step_name", "S", "C", "lo",
+                                    "use_pallas", "pallas_interpret"))
 def _check_bitdense_batch(xs, state0, step_name: str, S: int, C: int,
-                          lo: int = -1):
+                          lo: int = -1, use_pallas: bool = False,
+                          pallas_interpret: bool = True):
+    # under vmap the per-event lax.cond around the pallas closure
+    # becomes run-both-and-select, so pad events cost one extra kernel
+    # run per key — harmless: their result is discarded by the select
     return jax.vmap(
-        lambda x, s0: _bitdense_impl(x, s0, step_name, S, C, lo)
+        lambda x, s0: _bitdense_impl(x, s0, step_name, S, C, lo,
+                                     use_pallas=use_pallas,
+                                     pallas_interpret=pallas_interpret)
     )(xs, state0)
 
 
@@ -245,20 +263,17 @@ def check_encoded_bitdense(e: EncodedHistory,
     """Single-key bit-packed check. `use_pallas` routes the closure
     through the VMEM-resident pallas kernel (parallel.pallas_kernels);
     default: the JEPSEN_TPU_PALLAS=1 env flag, and only for shapes the
-    kernel supports. The batch path stays on XLA."""
+    kernel supports (the same flag also governs the batch path)."""
     if e.n_returns == 0:
         return {"valid?": True, "engine": "bitdense"}
     from jepsen_tpu.parallel.dense import _xs_dense
     S = n_states(e)
     C = max(5, e.n_slots)  # at least one full word
-    if use_pallas is None:
-        use_pallas = os.environ.get("JEPSEN_TPU_PALLAS") == "1"
-    if use_pallas:
-        from jepsen_tpu.parallel import pallas_kernels as pk
-        use_pallas = pk.supported(S, C)
+    use_pallas, interpret = _resolve_use_pallas(
+        use_pallas, S, C, jax.default_backend())
     valid, fail_r = _check_bitdense(_xs_dense(e, C), jnp.int32(e.state0),
                                     e.step_name, S, C, e.state_lo,
-                                    use_pallas)
+                                    use_pallas, interpret)
     out = {"valid?": bool(valid), "engine": "bitdense",
            "states": S, "slots": C,
            "closure": "pallas" if use_pallas else "xla"}
@@ -268,23 +283,34 @@ def check_encoded_bitdense(e: EncodedHistory,
     return out
 
 
-def check_batch_bitdense(encs, mesh=None) -> list:
+def check_batch_bitdense(encs, mesh=None, use_pallas: bool = None) -> list:
     """Batched per-key check. Callers must ensure the COMBINED padded
     dims fit (fits_bitdense(max S, max C)) — individually-fitting keys
     can combine into an over-budget program; engine.check_batch does
-    this check and falls back to per-key dispatch otherwise."""
+    this check and falls back to per-key dispatch otherwise.
+    `use_pallas` routes each key's closure through the VMEM-resident
+    kernel (vmapped over keys); default: the JEPSEN_TPU_PALLAS=1 env
+    flag, gated to shapes the kernel supports at the PADDED dims."""
     if not encs:
         return []
     from jepsen_tpu.parallel.encode import pad_batch
     step_name = encs[0].step_name
     xs, state0, S, C, R = pad_batch(encs, mesh=mesh, min_slots=5)
+    # gate on where the batch actually lives: pad_batch pins it to the
+    # mesh when one is given, regardless of the process default backend
+    platform = (mesh.devices.flat[0].platform if mesh is not None
+                else jax.default_backend())
+    use_pallas, interpret = _resolve_use_pallas(use_pallas, S, C, platform)
     valid, fail_r = _check_bitdense_batch(xs, state0, step_name, S, C,
-                                          encs[0].state_lo)
+                                          encs[0].state_lo, use_pallas,
+                                          interpret)
     valid = np.asarray(valid)
     fail_r = np.asarray(fail_r)
+    closure = "pallas" if use_pallas else "xla"
     out = []
     for k, e in enumerate(encs):
-        r = {"valid?": bool(valid[k]), "engine": "bitdense"}
+        r = {"valid?": bool(valid[k]), "engine": "bitdense",
+             "closure": closure}
         if not r["valid?"]:
             from jepsen_tpu.parallel.encode import fail_op_fields
             r.update(fail_op_fields(e, int(fail_r[k])))

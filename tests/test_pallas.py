@@ -106,3 +106,43 @@ def test_bitdense_pallas_path_differential():
     assert rb_xla["valid?"] is rb_pl["valid?"] is False
     assert rb_xla["fail-event"] == rb_pl["fail-event"]
     assert wgl.analysis(CASRegister(), hb)["valid?"] is False
+
+
+def test_batch_pallas_path_differential():
+    """check_batch_bitdense with the vmapped pallas closure vs the XLA
+    closure on a mixed valid/invalid key batch (padded C >= 12 for
+    kernel support)."""
+    from jepsen_tpu.histories import adversarial_register_history
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.parallel import encode as enc_mod
+    from jepsen_tpu.history import History
+
+    encs = []
+    for seed in range(3):
+        h = adversarial_register_history(n_ops=40, k_crashed=11,
+                                         seed=seed)
+        encs.append(enc_mod.encode(CASRegister(), h))
+    # one invalid key: impossible read appended
+    h = adversarial_register_history(n_ops=40, k_crashed=11, seed=9)
+    ops = [dict(o) for o in h]
+    n = len(ops)
+    ops += [{"index": n, "time": n, "process": 90, "type": "invoke",
+             "f": "read", "value": None},
+            {"index": n + 1, "time": n + 1, "process": 90, "type": "ok",
+             "f": "read", "value": 999}]
+    encs.append(enc_mod.encode(CASRegister(), History.wrap(ops).index()))
+
+    # the differential is vacuous unless the PADDED batch dims clear
+    # the kernel's support gate (check_batch downgrades silently)
+    S_pad = max(bitdense.n_states(e) for e in encs)
+    C_pad = max(5, max(e.n_slots for e in encs))
+    assert pk.supported(S_pad, C_pad), (S_pad, C_pad)
+
+    rs_xla = bitdense.check_batch_bitdense(encs, use_pallas=False)
+    rs_pl = bitdense.check_batch_bitdense(encs, use_pallas=True)
+    assert all(r["closure"] == "xla" for r in rs_xla)
+    assert all(r["closure"] == "pallas" for r in rs_pl)
+    assert [r["valid?"] for r in rs_xla] == [True, True, True, False]
+    for rx, rp in zip(rs_xla, rs_pl):
+        assert rx["valid?"] is rp["valid?"]
+        assert rx.get("fail-event") == rp.get("fail-event")
