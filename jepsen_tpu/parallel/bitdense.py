@@ -300,6 +300,11 @@ def check_batch_bitdense(encs, mesh=None, use_pallas: bool = None) -> list:
     # mesh when one is given, regardless of the process default backend
     platform = (mesh.devices.flat[0].platform if mesh is not None
                 else jax.default_backend())
+    if mesh is not None and platform == "tpu":
+        # a non-interpret pallas_call over a key-sharded batch has no
+        # exercised SPMD partitioning path — keep mesh-sharded TPU
+        # batches on XLA until that lowering is measured on hardware
+        use_pallas = False
     use_pallas, interpret = _resolve_use_pallas(use_pallas, S, C, platform)
     valid, fail_r = _check_bitdense_batch(xs, state0, step_name, S, C,
                                           encs[0].state_lo, use_pallas,

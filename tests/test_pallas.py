@@ -45,6 +45,19 @@ def _semantic_fixpoint(sel, B, C):
     return out
 
 
+def _with_impossible_read(h):
+    """Append a read of a never-written value — the canonical invalid
+    suffix shared by the engine differential tests."""
+    from jepsen_tpu.history import History
+    ops = [dict(o) for o in h]
+    n = len(ops)
+    ops += [{"index": n, "time": n, "process": 90, "type": "invoke",
+             "f": "read", "value": None},
+            {"index": n + 1, "time": n + 1, "process": 90, "type": "ok",
+             "f": "read", "value": 999}]
+    return History.wrap(ops).index()
+
+
 def _rand_case(seed, S=5, C=12, n_seeds=3, p_legal=0.08):
     rng = np.random.default_rng(seed)
     W = (1 << C) // 32
@@ -81,7 +94,6 @@ def test_bitdense_pallas_path_differential():
     from jepsen_tpu.histories import adversarial_register_history
     from jepsen_tpu.models import CASRegister
     from jepsen_tpu.parallel import encode as enc_mod
-    from jepsen_tpu.history import History
 
     h = adversarial_register_history(n_ops=60, k_crashed=11, seed=5)
     e = enc_mod.encode(CASRegister(), h)
@@ -93,13 +105,7 @@ def test_bitdense_pallas_path_differential():
     assert r_xla["valid?"] is r_pl["valid?"] is True
 
     # invalid: impossible read appended
-    ops = [dict(o) for o in h]
-    n = len(ops)
-    ops += [{"index": n, "time": n, "process": 90, "type": "invoke",
-             "f": "read", "value": None},
-            {"index": n + 1, "time": n + 1, "process": 90, "type": "ok",
-             "f": "read", "value": 999}]
-    hb = History.wrap(ops).index()
+    hb = _with_impossible_read(h)
     eb = enc_mod.encode(CASRegister(), hb)
     rb_xla = bitdense.check_encoded_bitdense(eb, use_pallas=False)
     rb_pl = bitdense.check_encoded_bitdense(eb, use_pallas=True)
@@ -115,7 +121,6 @@ def test_batch_pallas_path_differential():
     from jepsen_tpu.histories import adversarial_register_history
     from jepsen_tpu.models import CASRegister
     from jepsen_tpu.parallel import encode as enc_mod
-    from jepsen_tpu.history import History
 
     encs = []
     for seed in range(3):
@@ -124,13 +129,7 @@ def test_batch_pallas_path_differential():
         encs.append(enc_mod.encode(CASRegister(), h))
     # one invalid key: impossible read appended
     h = adversarial_register_history(n_ops=40, k_crashed=11, seed=9)
-    ops = [dict(o) for o in h]
-    n = len(ops)
-    ops += [{"index": n, "time": n, "process": 90, "type": "invoke",
-             "f": "read", "value": None},
-            {"index": n + 1, "time": n + 1, "process": 90, "type": "ok",
-             "f": "read", "value": 999}]
-    encs.append(enc_mod.encode(CASRegister(), History.wrap(ops).index()))
+    encs.append(enc_mod.encode(CASRegister(), _with_impossible_read(h)))
 
     # the differential is vacuous unless the PADDED batch dims clear
     # the kernel's support gate (check_batch downgrades silently)

@@ -1,0 +1,133 @@
+"""A/B perf harness: XLA closure vs the VMEM-resident pallas kernel.
+
+Decides whether JEPSEN_TPU_PALLAS should default ON for the TPU
+backend (parallel/bitdense.py gates the kernel behind the env flag
+until a hardware measurement exists — "flags do not get to claim
+speedups", pallas_kernels.py docstring). Run on the real chip:
+
+    python tools/perf_ab.py              # full shapes
+    BENCH_SMOKE=1 python tools/perf_ab.py  # tiny shapes (CI sanity)
+
+Measures, per shape, steady-state wall time (cold run first to absorb
+compiles; results fetched to host, so timings include the device sync):
+
+  single-key adversarial 1k / 10k   (the bench's headline shape)
+  multi-key 84x120 batch            (the reference workload shape)
+
+Prints one JSON line per measurement and a final verdict line with the
+pallas:xla ratio per shape. The engine paths are driven through their
+public entry points (check_encoded_bitdense / check_batch_bitdense)
+with use_pallas explicitly set, so what is measured is exactly what the
+flag would switch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from time import perf_counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SMOKE = os.environ.get("BENCH_SMOKE") == "1"
+REPEATS = 3
+
+
+def emit(obj):
+    print(json.dumps(obj), flush=True)
+
+
+def _steady(fn):
+    fn()                                    # cold: compile + warm cache
+    best = float("inf")
+    for _ in range(REPEATS):
+        t0 = perf_counter()
+        fn()
+        best = min(best, perf_counter() - t0)
+    return best
+
+
+def main():
+    import jax
+
+    # honor JAX_PLATFORMS via jax.config too: on this image the axon
+    # plugin initializes (and hangs on, when the tunnel is down) the
+    # TPU client even under the env var alone — same pinning pattern
+    # as tests/conftest.py and the dryrun hardening
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    from jepsen_tpu.histories import (
+        adversarial_register_history, rand_register_history)
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.parallel import bitdense, encode as enc_mod
+    from jepsen_tpu.parallel import pallas_kernels as pk
+
+    backend = jax.default_backend()
+    model = CASRegister()
+    ratios = {}
+
+    # ---- single-key adversarial ----
+    for L in ([200, 400] if SMOKE else [1000, 10000]):
+        # k=11 keeps the smoke shapes inside kernel support (C >= 12)
+        h = adversarial_register_history(
+            n_ops=L, k_crashed=(11 if SMOKE else 12), seed=7)
+        e = enc_mod.encode(model, h)
+        S, C = bitdense.n_states(e), max(5, e.n_slots)
+        if not pk.supported(S, C):
+            emit({"shape": f"single-{L}", "skipped": f"unsupported "
+                  f"S={S} C={C}"})
+            continue
+        t_xla = _steady(lambda: bitdense.check_encoded_bitdense(
+            e, use_pallas=False))
+        t_pl = _steady(lambda: bitdense.check_encoded_bitdense(
+            e, use_pallas=True))
+        ratios[f"single-{L}"] = t_xla / t_pl
+        emit({"shape": f"single-key {L}-op adversarial", "S": S, "C": C,
+              "xla_secs": round(t_xla, 3), "pallas_secs": round(t_pl, 3),
+              "pallas_speedup": round(t_xla / t_pl, 2)})
+
+    # ---- multi-key batch ----
+    n_keys, ops_per_key = (8, 40) if SMOKE else (84, 120)
+    keys = [rand_register_history(
+        n_ops=ops_per_key, n_processes=14, n_values=5, crash_p=0.005,
+        fail_p=0.05, busy=0.8, seed=2024 + k) for k in range(n_keys)]
+    encs = [enc_mod.encode(model, h) for h in keys]
+    S = max(bitdense.n_states(e) for e in encs)
+    C = max(5, max(e.n_slots for e in encs))
+    if pk.supported(S, C):
+        t_xla = _steady(lambda: bitdense.check_batch_bitdense(
+            encs, use_pallas=False))
+        t_pl = _steady(lambda: bitdense.check_batch_bitdense(
+            encs, use_pallas=True))
+        ratios["batch"] = t_xla / t_pl
+        emit({"shape": f"batch {n_keys}x{ops_per_key}", "S": S, "C": C,
+              "xla_secs": round(t_xla, 3), "pallas_secs": round(t_pl, 3),
+              "pallas_speedup": round(t_xla / t_pl, 2)})
+    else:
+        emit({"shape": "batch", "skipped": f"unsupported S={S} C={C}"})
+
+    if backend != "tpu":
+        # interpret-mode timings measure the interpreter, not the
+        # kernel — never let them flip the default
+        verdict = "no-verdict (non-tpu backend: interpret-mode timings)"
+    elif ratios and min(ratios.values()) >= 1.1:
+        verdict = "default-on"
+    else:
+        verdict = "keep-opt-in"
+    emit({"backend": backend, "verdict": verdict,
+          "ratios": {k: round(v, 2) for k, v in ratios.items()},
+          "rule": "default-on iff pallas wins >=1.1x on EVERY measured "
+                  "shape on the tpu backend"})
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as err:  # noqa: BLE001
+        import traceback
+        traceback.print_exc()
+        emit({"error": repr(err)})
+        sys.exit(1)
