@@ -22,6 +22,7 @@ Result shape mirrors knossos: {"valid?", "op" (first stuck op),
 
 from __future__ import annotations
 
+import time as _time
 from typing import Any, List, Optional
 
 from jepsen_tpu import models as model_ns
@@ -103,8 +104,13 @@ class _StepOp:
 
 
 def check_calls(model, cs: List[Call], n_history: int,
-                max_states: int = 50_000_000) -> dict:
-    """Run WGL over prepared calls. Returns a knossos-shaped result."""
+                max_states: int = 50_000_000,
+                deadline: Optional[float] = None) -> dict:
+    """Run WGL over prepared calls. Returns a knossos-shaped result.
+    With `deadline` (a time.monotonic() instant) the search returns
+    `{"valid?": "unknown", "timeout": True}` when it runs past it —
+    the same cooperative contract as checker.linear — checked every
+    4096 explored states so the overshoot is bounded."""
     m = len(cs)
     if m == 0:
         return {"valid?": True, "configs": [], "final-paths": []}
@@ -153,6 +159,10 @@ def check_calls(model, cs: List[Call], n_history: int,
                 return {"valid?": "unknown",
                         "error": f"state budget exceeded ({max_states})",
                         "explored": explored}
+            if deadline is not None and (explored & 0xFFF) == 0 \
+                    and _time.monotonic() > deadline:
+                return {"valid?": "unknown", "error": "deadline",
+                        "timeout": True, "explored": explored}
             key = (s2, linearized | (1 << cid))
             if not model_ns.is_inconsistent(s2) and key not in visited:
                 visited.add(key)
@@ -212,13 +222,16 @@ def _invalid_result(model, best_path, best_stuck, explored, state, linearized,
     }
 
 
-def analysis(model, history, max_states: int = 50_000_000) -> dict:
+def analysis(model, history, max_states: int = 50_000_000,
+             deadline: Optional[float] = None) -> dict:
     """knossos.wgl/analysis equivalent: (model, history) -> result.
 
     History may be a `History` or plain list of op dicts; invocations are
-    paired/completed internally.
+    paired/completed internally. `deadline` is a time.monotonic()
+    instant for the cooperative timeout (see check_calls).
     """
     from jepsen_tpu.history import History, prune_wildcard_calls
     h = history if isinstance(history, History) else History.wrap(history)
     cs = prune_wildcard_calls(history_calls(h))
-    return check_calls(model, cs, len(h), max_states=max_states)
+    return check_calls(model, cs, len(h), max_states=max_states,
+                       deadline=deadline)

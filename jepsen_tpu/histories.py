@@ -288,15 +288,41 @@ def adversarial_register_history(
     return h.index()
 
 
+def with_impossible_read(h: History, value=999,
+                         process: int = 90) -> History:
+    """Append a read observing `value` — pick one no write/enqueue ever
+    produced and the result is invalid with the failure at the very
+    end. The canonical invalid suffix for engine differential tests."""
+    ops = [dict(o) for o in h]
+    n = len(ops)
+    t = (ops[-1]["time"] + 1) if ops else 0
+    ops += [{"index": n, "time": t, "process": process,
+             "type": "invoke", "f": "read", "value": None},
+            {"index": n + 1, "time": t + 1, "process": process,
+             "type": "ok", "f": "read", "value": value}]
+    return History.wrap(ops).index()
+
+
 def corrupt_history(h: History, seed: int = 0,
                     n_corruptions: int = 1) -> History:
-    """Flip ok-read values to likely-inconsistent ones — adversarial
-    invalid(ish) histories; pair with a checker oracle, don't assume."""
+    """Flip ok completion values to likely-inconsistent ones —
+    adversarial invalid(ish) histories; pair with a checker oracle,
+    don't assume. Reads claim unobservable values (scalar bump, or a
+    never-added element for collection-valued reads); dequeues claim a
+    never-enqueued value, so queue families get invalid coverage too."""
     rng = random.Random(seed)
     out = History.wrap(Op(dict(o)) for o in h)
-    reads = [i for i, o in enumerate(out)
-             if o.get("type") == "ok" and o.get("f") == "read"
-             and o.get("value") is not None]
-    for i in rng.sample(reads, min(n_corruptions, len(reads))):
-        out[i]["value"] = (out[i]["value"] or 0) + 1000
+    targets = [i for i, o in enumerate(out)
+               if o.get("type") == "ok" and o.get("f") in ("read", "dequeue")
+               and o.get("value") is not None]
+    for i in rng.sample(targets, min(n_corruptions, len(targets))):
+        v = out[i]["value"]
+        if isinstance(v, list):
+            # set-style read (gset observes a collection): claim an
+            # element that was never added
+            out[i]["value"] = v + [1000 + i]
+        elif isinstance(v, (set, frozenset)):
+            out[i]["value"] = set(v) | {1000 + i}
+        else:
+            out[i]["value"] = (v or 0) + 1000
     return out.index()

@@ -300,10 +300,12 @@ def check_batch_bitdense(encs, mesh=None, use_pallas: bool = None) -> list:
     # mesh when one is given, regardless of the process default backend
     platform = (mesh.devices.flat[0].platform if mesh is not None
                 else jax.default_backend())
-    if mesh is not None and platform == "tpu":
+    if use_pallas is None and mesh is not None and platform == "tpu":
         # a non-interpret pallas_call over a key-sharded batch has no
-        # exercised SPMD partitioning path — keep mesh-sharded TPU
-        # batches on XLA until that lowering is measured on hardware
+        # exercised SPMD partitioning path — the DEFAULT (env-flag)
+        # route keeps mesh-sharded TPU batches on XLA until that
+        # lowering is measured on hardware; an explicit use_pallas=True
+        # is honored (that is how the measurement will be taken)
         use_pallas = False
     use_pallas, interpret = _resolve_use_pallas(use_pallas, S, C, platform)
     valid, fail_r = _check_bitdense_batch(xs, state0, step_name, S, C,

@@ -416,14 +416,19 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
     return out
 
 
-def analysis(model, history, capacity: int = 1024) -> dict:
+def analysis(model, history, capacity: int = 1024,
+             max_capacity: int = 1 << 20) -> dict:
     """knossos-style (model, history) -> result on the device engine.
 
     Falls back to the host WGL engine when the model can't pack or the
     open-call window exceeds the device limit. On failure, counter-example
     paths are reconstructed host-side on the failing prefix (SURVEY.md
     §7.3 hard part #3: breadcrumbs stay implicit; a host re-search of the
-    short failing prefix supplies :final-paths).
+    short failing prefix supplies :final-paths). `max_capacity` caps the
+    frontier's double-on-overflow growth; past it the result is
+    `{"valid?": "unknown"}` — histories that never prune (e.g. invalid
+    queue histories, where every enqueue-order hypothesis stays live)
+    otherwise escalate through every tier before deciding.
     """
     from jepsen_tpu.history import History
     h = history if isinstance(history, History) else History.wrap(history)
@@ -442,7 +447,7 @@ def analysis(model, history, capacity: int = 1024) -> dict:
     if bitdense.fits_bitdense(bitdense.n_states(e), e.n_slots):
         r = bitdense.check_encoded_bitdense(e)
     else:
-        r = check_encoded(e, capacity=capacity)
+        r = check_encoded(e, capacity=capacity, max_capacity=max_capacity)
     if r["valid?"] is False:
         r.update(extract_final_paths(model, e, int(r["fail-event"])))
     return r

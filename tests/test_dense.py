@@ -81,3 +81,33 @@ def test_fits_predicates():
     assert not bitdense.fits_bitdense(8, 30)
     assert dense.fits_dense(8, 13)
     assert not dense.fits_dense(8, 25)
+    # quadratic-in-S guard: S*2^C alone admits this shape, but the
+    # [C, S, S] transition select would be 21 GB (fuzz-tier find:
+    # corrupted fifo histories intern tens of thousands of states)
+    assert not dense.fits_dense(32768, 5)
+
+
+def test_dense_rejects_state_rich_fifo_and_sparse_decides_fast():
+    """The fuzz regression end-to-end: a corrupted fifo history whose
+    interned state space explodes must be REJECTED by the dense gate
+    and decided (or bounded-unknown'd) by the sparse path in seconds,
+    not crawl through a multi-gigabyte dense program."""
+    from time import monotonic
+
+    from jepsen_tpu.checker import wgl
+    from jepsen_tpu.histories import corrupt_history, rand_fifo_history
+    from jepsen_tpu.models import FIFOQueue
+    from jepsen_tpu.parallel import encode as enc_mod, engine
+
+    h = corrupt_history(
+        rand_fifo_history(n_ops=24, n_processes=4, n_values=3,
+                          crash_p=0.05, seed=0), seed=0, n_corruptions=2)
+    m = FIFOQueue()
+    e = enc_mod.encode(m, h)
+    assert dense.n_states(e) > 1000          # the state explosion
+    assert not dense.fits_dense(dense.n_states(e), e.n_slots)
+    t0 = monotonic()
+    r = engine.analysis(m, h, max_capacity=1 << 15)
+    assert monotonic() - t0 < 60, "sparse path took too long"
+    if r["valid?"] != "unknown":
+        assert r["valid?"] is wgl.analysis(m, h)["valid?"]

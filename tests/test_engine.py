@@ -132,17 +132,11 @@ def test_engine_empty():
 def _long_invalid_history(n_ops):
     """A long valid cas-register history with an impossible read
     appended at the end — the failure is in the last few events."""
-    from jepsen_tpu.histories import rand_register_history
+    from jepsen_tpu.histories import (rand_register_history,
+                                      with_impossible_read)
     h = rand_register_history(n_ops=n_ops, n_processes=4, crash_p=0.0,
                               fail_p=0.0, n_values=4, seed=7)
-    ops = [dict(o) for o in h]
-    t = ops[-1]["time"] + 1
-    i = ops[-1]["index"] + 1
-    ops += [{"index": i, "time": t, "process": 97, "type": "invoke",
-             "f": "read", "value": None},
-            {"index": i + 1, "time": t + 1, "process": 97, "type": "ok",
-             "f": "read", "value": "never-written"}]
-    return _h(*ops)
+    return with_impossible_read(h, value="never-written", process=97)
 
 
 @pytest.mark.slow

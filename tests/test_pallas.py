@@ -45,17 +45,7 @@ def _semantic_fixpoint(sel, B, C):
     return out
 
 
-def _with_impossible_read(h):
-    """Append a read of a never-written value — the canonical invalid
-    suffix shared by the engine differential tests."""
-    from jepsen_tpu.history import History
-    ops = [dict(o) for o in h]
-    n = len(ops)
-    ops += [{"index": n, "time": n, "process": 90, "type": "invoke",
-             "f": "read", "value": None},
-            {"index": n + 1, "time": n + 1, "process": 90, "type": "ok",
-             "f": "read", "value": 999}]
-    return History.wrap(ops).index()
+from jepsen_tpu.histories import with_impossible_read as _with_impossible_read
 
 
 def _rand_case(seed, S=5, C=12, n_seeds=3, p_legal=0.08):
