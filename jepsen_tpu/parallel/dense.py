@@ -42,11 +42,25 @@ from jax import lax
 from jepsen_tpu.parallel.encode import EncodedHistory
 from jepsen_tpu.parallel.steps import STEPS
 
-DENSE_BUDGET = 1 << 22  # max S * 2^C cells per key
+DENSE_BUDGET = 1 << 22    # max S * 2^C cells per key
+P_BUDGET = 1 << 22        # max C * S^2 cells in the transition select
+CLOSURE_BUDGET = 1 << 28  # max C * S^2 * 2^C work per closure round
 
 
 def fits_dense(n_states: int, n_slots: int, budget: int = DENSE_BUDGET) -> bool:
-    return n_slots <= 20 and n_states * (1 << n_slots) <= budget
+    """Admission gate. Bounds BOTH the reachable tensor B (S * 2^C)
+    and the quadratic-in-S costs the impl materializes per event: the
+    one-hot transition select P [C, S, S] and the closure einsum
+    O(C * S^2 * 2^C). Value-rich models (FIFO interns every packed
+    queue content as a state) can reach S in the tens of thousands at
+    tiny C — S * 2^C alone admits those, and P alone would then be
+    gigabytes (found by the differential fuzz tier: a corrupted
+    24-op fifo history hit S=32768, C=5 -> a 21 GB P)."""
+    M = 1 << n_slots
+    return (n_slots <= 20
+            and n_states * M <= budget
+            and n_slots * n_states * n_states <= P_BUDGET
+            and n_slots * n_states * n_states * M <= CLOSURE_BUDGET)
 
 
 def _check_dense_impl(xs, state0, step_name: str, S: int, C: int,

@@ -9,10 +9,10 @@ jepsen/project.clj:36-41); likewise this tier is deselected by default
 
     python -m pytest tests/test_fuzz_differential.py -m fuzz -q
 
-Seed count via JEPSEN_FUZZ_SEEDS (default 3 per model-variant; the
-standing sweep driver tools/../tmp runs 30+). Any verdict disagreement
-or engine crash fails the test with the (model, seed, variant) triple —
-enough to reproduce deterministically.
+Seed count via JEPSEN_FUZZ_SEEDS (default 3 per model-variant; for a
+deep sweep run e.g. `JEPSEN_FUZZ_SEEDS=30 ... -m fuzz`). Any verdict
+disagreement or engine crash fails the test with the (model, seed,
+variant) triple — enough to reproduce deterministically.
 """
 
 import os
