@@ -337,12 +337,16 @@ def sec_maxlen(budget_secs: float):
 
 # ======================= parent orchestrator =======================
 
-def run_section(argv: list, timeout: float) -> list:
+def run_section(argv: list, timeout: float):
     """Spawn `python bench.py --section ...`; forward the child's
     stdout lines as they arrive, parse the JSON ones, kill on timeout.
     The ACTUAL timeout rides along as the final `--timeout` argv so
     the child can schedule its pre-kill stack dump just before it.
-    Returns the parsed JSON objects (empty on crash/hang)."""
+    Returns (parsed JSON objects, status) — status in
+    {"ok", "crash", "hung"}. parsed holds whatever JSON lines arrived
+    BEFORE a kill — a child can emit its result line and then hang in
+    a later phase (e.g. the host baseline), and callers rely on
+    harvesting those partial results."""
     cmd = [sys.executable, os.path.abspath(__file__), "--section"] + \
         [str(a) for a in argv] + ["--timeout", f"{timeout:.0f}"]
     parsed = []
@@ -352,7 +356,7 @@ def run_section(argv: list, timeout: float) -> list:
     except OSError as err:
         emit({"metric": f"section {argv[0]}", "value": None,
               "unit": "ops/sec", "error": repr(err)})
-        return parsed
+        return parsed, "crash"
 
     def pump():
         for line in proc.stdout:
@@ -375,6 +379,8 @@ def run_section(argv: list, timeout: float) -> list:
             emit({"metric": f"section {argv[0]}", "value": None,
                   "unit": "ops/sec",
                   "error": f"child exited rc={rc}"})
+            return parsed, "crash"
+        return parsed, "ok"
     except subprocess.TimeoutExpired:
         proc.kill()
         proc.wait()
@@ -384,7 +390,7 @@ def run_section(argv: list, timeout: float) -> list:
               "skipped": f"timeout/hang after {timeout:.0f}s "
                          f"(section isolated in a subprocess; "
                          f"bench continues)"})
-    return parsed
+        return parsed, "hung"
 
 
 def main():
@@ -393,20 +399,19 @@ def main():
     def left():
         return BUDGET_SECS - (monotonic() - t_start)
 
+    hung = []              # (kind, L) sections killed on timeout
+
     # ---------------- 1. multi-key north-star shape ----------------
-    multikey = run_section(["multikey"],
-                           min(sec_timeout("multikey"), BUDGET_SECS))
+    multikey, st = run_section(["multikey"],
+                               min(sec_timeout("multikey"), BUDGET_SECS))
     mk_line = next((p for p in multikey if p.get("value")), None)
+    if st == "hung":
+        hung.append(("multikey", None))
 
     # ---------------- 2. adversarial single-key --------------------
     adv_results = {}       # L -> parsed line (with L, device_secs, host)
-    for L in ADV_SIZES:
-        sec_to = sec_timeout("adv", L)
-        if left() < min(90, sec_to):
-            emit({"metric": f"adversarial single-key {L}-op",
-                  "value": None,
-                  "unit": "ops/sec", "skipped": "bench budget exhausted"})
-            continue
+
+    def run_adv(L):
         deadline = HOST_DEADLINES[L]
         skip_host = left() < deadline + 90
         hint = ""
@@ -418,9 +423,40 @@ def main():
             if prev:
                 hint = prev["host_est_secs"] * (L / prev["L"])
         args = ["adv", L, deadline, int(skip_host), hint]
-        for p in run_section(args, min(sec_to, max(left(), 60))):
+        parsed, st = run_section(
+            args, min(sec_timeout("adv", L), max(left(), 60)))
+        for p in parsed:
             if p.get("L") == L and p.get("value") is not None:
                 adv_results[L] = p
+        return st
+
+    for L in ADV_SIZES:
+        if left() < min(90, sec_timeout("adv", L)):
+            emit({"metric": f"adversarial single-key {L}-op",
+                  "value": None,
+                  "unit": "ops/sec", "skipped": "bench budget exhausted"})
+            continue
+        if run_adv(L) == "hung":
+            hung.append(("adv", L))
+
+    # ---------------- retry hung sections once ---------------------
+    # a hang can be a transient device-runtime flake rather than a
+    # hard outage; retry BEFORE sections 3-4 so a recovered result can
+    # still feed the sharded section and the headline, and so maxlen
+    # (which deliberately consumes the remaining budget) hasn't eaten
+    # the retry's slot. Largest adversarial size first, then the
+    # multi-key shape; a second hang just re-emits the skip line.
+    for kind, L in sorted(hung, key=lambda k: -(k[1] or 0)):
+        if kind == "adv":
+            if L in adv_results or left() < 120:
+                continue
+            note(f"retrying hung adv L={L} (transient flake?)")
+            run_adv(L)
+        elif kind == "multikey" and mk_line is None and left() > 120:
+            note("retrying hung multikey section (transient flake?)")
+            parsed, _ = run_section(
+                ["multikey"], min(sec_timeout("multikey"), left()))
+            mk_line = next((p for p in parsed if p.get("value")), None)
 
     # ---------------- 3. sharded engine on the local mesh ----------
     pick = 10000 if not SMOKE else (400 if 400 in adv_results else None)
