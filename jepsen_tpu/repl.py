@@ -1,0 +1,41 @@
+"""Helpers for exploring stored test runs interactively.
+
+The reference ships `jepsen.repl` (jepsen/src/jepsen/repl.clj:1-14)
+with a single `last-test` convenience for "mucking around with tests";
+this is its analogue over our store layout, returning the loaded run
+map (test map + history + results) rather than a lazy deref.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from jepsen_tpu import store as store_mod
+
+
+def last_test(test_name: Optional[str] = None,
+              base_dir: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    """The most recently run test as a loaded run map
+    (jepsen.repl/last-test, repl.clj:6-13). With `test_name`, the
+    newest run of that test; otherwise the newest run of any test.
+    Returns None when nothing has been stored yet."""
+    if base_dir is None:
+        # resolve at call time: store.BASE_DIR is runtime-configurable
+        base_dir = store_mod.BASE_DIR
+    if test_name is None:
+        run_dir = store_mod.latest(base_dir)
+        return store_mod.load_run(run_dir) if run_dir else None
+    name = store_mod._sanitize(test_name)   # runs live under the
+    test_dir = os.path.join(base_dir, name)  # sanitized name
+    run_dir = None
+    link = os.path.join(test_dir, "latest")  # store-maintained symlink
+    if os.path.islink(link):
+        target = os.path.join(test_dir, os.readlink(link))
+        if os.path.isdir(target):
+            run_dir = target
+    if run_dir is None:
+        runs = store_mod.tests(base_dir).get(name, [])
+        if runs:
+            run_dir = os.path.join(test_dir, runs[-1])
+    return store_mod.load_run(run_dir) if run_dir else None

@@ -76,6 +76,20 @@ def test_store_latest_and_load():
     # live objects are stripped from the stored test
     assert "client" not in loaded["test"]
 
+    # jepsen.repl/last-test analogue rides the same store
+    from jepsen_tpu import repl
+    for by_name in (None, "register-test"):
+        run = repl.last_test(by_name)
+        assert run is not None
+        assert os.path.realpath(run["dir"]) == os.path.realpath(latest)
+        assert run["results"]["valid?"] is True
+    assert repl.last_test("no-such-test") is None
+
+    # names are sanitized on write; lookup must apply the same rule
+    jcore.run(register_test(name="etcd/cas"))
+    run = repl.last_test("etcd/cas")
+    assert run is not None and run["test"]["name"] == "etcd/cas"
+
 
 def test_checker_crash_yields_unknown():
     def boom(test, history, opts):
