@@ -8,7 +8,6 @@ map (test map + history + results) rather than a lazy deref.
 
 from __future__ import annotations
 
-import os
 from typing import Any, Dict, Optional
 
 from jepsen_tpu import store as store_mod
@@ -23,19 +22,5 @@ def last_test(test_name: Optional[str] = None,
     if base_dir is None:
         # resolve at call time: store.BASE_DIR is runtime-configurable
         base_dir = store_mod.BASE_DIR
-    if test_name is None:
-        run_dir = store_mod.latest(base_dir)
-        return store_mod.load_run(run_dir) if run_dir else None
-    name = store_mod._sanitize(test_name)   # runs live under the
-    test_dir = os.path.join(base_dir, name)  # sanitized name
-    run_dir = None
-    link = os.path.join(test_dir, "latest")  # store-maintained symlink
-    if os.path.islink(link):
-        target = os.path.join(test_dir, os.readlink(link))
-        if os.path.isdir(target):
-            run_dir = target
-    if run_dir is None:
-        runs = store_mod.tests(base_dir).get(name, [])
-        if runs:
-            run_dir = os.path.join(test_dir, runs[-1])
+    run_dir = store_mod.latest(base_dir, test_name=test_name)
     return store_mod.load_run(run_dir) if run_dir else None

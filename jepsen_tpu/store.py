@@ -145,8 +145,24 @@ def tests(base_dir: str = BASE_DIR) -> Dict[str, List[str]]:
     return out
 
 
-def latest(base_dir: str = BASE_DIR) -> Optional[str]:
-    """Directory of the most recent run (store.clj:296-305)."""
+def latest(base_dir: str = BASE_DIR,
+           test_name: Optional[str] = None) -> Optional[str]:
+    """Directory of the most recent run (store.clj:296-305). With
+    `test_name` (sanitized like the writer), that test's newest run —
+    preferring the per-test `latest` symlink `_update_symlinks`
+    maintains, falling back to a directory scan."""
+    if test_name is not None:
+        test_dir = os.path.join(base_dir, _sanitize(test_name))
+        link = os.path.join(test_dir, "latest")
+        if os.path.islink(link):
+            target = os.path.join(test_dir, os.readlink(link))
+            if os.path.isdir(target):
+                return target
+        if not os.path.isdir(test_dir):
+            return None
+        runs = sorted(r for r in os.listdir(test_dir)
+                      if not os.path.islink(os.path.join(test_dir, r)))
+        return os.path.join(test_dir, runs[-1]) if runs else None
     link = os.path.join(base_dir, "current")
     if os.path.islink(link):
         target = os.path.join(base_dir, os.readlink(link))
