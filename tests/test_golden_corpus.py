@@ -15,13 +15,17 @@ sparse/bitdense dispatch — to reproduce the recorded verdict.
 import json
 import pathlib
 
+import numpy as np
 import pytest
+
+import jax
+from jax.sharding import Mesh
 
 from jepsen_tpu.checker import linear, linear_packed, wgl
 from jepsen_tpu.history import History
 from jepsen_tpu.models import (
     CASRegister, FIFOQueue, GSet, Mutex, UnorderedQueue)
-from jepsen_tpu.parallel import engine
+from jepsen_tpu.parallel import engine, sharded
 
 GOLDEN = pathlib.Path(__file__).parent / "data" / "golden"
 MANIFEST = json.loads((GOLDEN / "manifest.json").read_text())
@@ -45,4 +49,23 @@ def test_golden_corpus_all_engines(entry):
     assert "fallback" not in r, r
     if want is False:
         # invalid verdicts must carry a counterexample op
+        assert r.get("op"), r
+
+
+@pytest.mark.fuzz
+@pytest.mark.parametrize("entry", MANIFEST,
+                         ids=[e["file"] for e in MANIFEST])
+def test_golden_corpus_sharded_engine(entry):
+    """Every corpus verdict must also reproduce with the frontier
+    sharded across the 8-device mesh (opt-in tier: one sharded compile
+    per shape is too slow for the default suite)."""
+    h = History.from_edn((GOLDEN / entry["file"]).read_text()).index()
+    model = MODELS[entry["model"]]()
+    mesh = Mesh(np.array(jax.devices()[:8]), ("frontier",))
+    r = sharded.analysis(model, h, mesh, capacity=64 * 8)
+    assert r["valid?"] is entry["valid"], r
+    # a host fallback would re-run the oracle that MADE the manifest —
+    # meaningless; this tier must exercise the sharded engine itself
+    assert "fallback" not in r, r
+    if entry["valid"] is False:
         assert r.get("op"), r
