@@ -8,8 +8,9 @@ generated in-repo (tests/data/golden/, verdicts recorded in
 manifest.json at generation time from the host WGL oracle) in the
 reference's on-disk EDN format — the same format `lein run analyze`
 re-checks. The test round-trips each file through History.from_edn and
-requires EVERY engine — host wgl / linear / packed and the device
-sparse/bitdense dispatch — to reproduce the recorded verdict.
+requires EVERY engine — host wgl / linear / packed, the device
+sparse/bitdense dispatch, and (in the opt-in fuzz tier) the
+mesh-sharded frontier engine — to reproduce the recorded verdict.
 """
 
 import json
