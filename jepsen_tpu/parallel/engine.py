@@ -728,9 +728,47 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
             break
         if N * 2 > max_capacity:
             for i in retry:
-                out[i] = {"valid?": "unknown",
-                          "error": f"frontier overflow at capacity {N}"}
+                out[i] = _escalate_overflow(pre[i], N, mesh)
             break
         pending = retry
         N *= 2
     return out
+
+
+def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh) -> dict:
+    """A key too wide for the batch program escalates instead of dying
+    as "unknown": first the single-key sparse engine at 4x the batch
+    ceiling, then — with a mesh — the frontier-sharded engine, whose
+    aggregate capacity scales with the device count (the dp -> sp
+    escalation SURVEY.md §5.7 frames as the long-history story:
+    per-key batching until a key outgrows a chip, frontier sharding
+    beyond). Ceilings scale from the caller's batch bound — batch_cap
+    x4 on one device, a further xD across the mesh — so a tight bound
+    set for latency/memory reasons stays meaningful. Reports which
+    tier decided via "escalated". The first batch run already proved
+    batch_cap overflows, so every tier starts at 2x."""
+    r = check_encoded(e, capacity=batch_cap * 2,
+                      max_capacity=min(batch_cap * 4, 1 << 21))
+    if r["valid?"] != "unknown":
+        r["escalated"] = "single"
+        return r
+    if mesh is not None:
+        try:
+            from jepsen_tpu.parallel import sharded
+            n_dev = np.asarray(mesh.devices).size
+            # pass the caller's mesh through untouched: the sharded
+            # engine picks the hierarchical exchange on 2-D (multi-
+            # slice) meshes and flattens anything else itself
+            rs = sharded.check_encoded_sharded(
+                e, mesh, capacity=batch_cap * 2,
+                max_capacity=min(batch_cap * 4 * n_dev, 1 << 24))
+            if rs["valid?"] != "unknown":
+                rs["escalated"] = "sharded"
+                return rs
+            r = rs
+        except Exception as err:  # noqa: BLE001 — escalation must not
+            r = dict(r)           # turn a decidable batch into a crash
+            r["escalation-error"] = repr(err)
+    r.setdefault("error", f"frontier overflow past batch capacity "
+                          f"{batch_cap} and every escalation tier")
+    return r

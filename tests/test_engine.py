@@ -662,3 +662,44 @@ def test_dispatcher_jax_route():
 
     r = linearizable(Weird(), algorithm="packed").check({}, _h())
     assert r["valid?"] is True and r["analyzer"] == "wgl"
+
+
+def test_batch_overflow_escalates_to_wider_tiers():
+    """A key too wide for the batch program must escalate — first the
+    single-key sparse engine at a higher ceiling, then the mesh-sharded
+    engine — instead of returning "unknown" (the dp -> sp long-history
+    escalation, SURVEY.md §5.7). State-rich FIFO keys route through the
+    sparse path (S far past bitdense's cap); measured frontiers: the
+    mid key peaks ~512 configs (single tier's 4x ceiling decides it),
+    the giant ~1.3k (only the sharded tier's aggregate reaches it)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from jepsen_tpu.histories import rand_fifo_history
+    from jepsen_tpu.models import FIFOQueue
+
+    cheap = rand_fifo_history(n_ops=40, n_processes=6, n_values=3,
+                              crash_p=0.15, seed=5)    # peak ~86
+    mid = rand_fifo_history(n_ops=40, n_processes=6, n_values=3,
+                            crash_p=0.15, seed=1)      # peak ~512
+    giant = rand_fifo_history(n_ops=40, n_processes=6, n_values=3,
+                              crash_p=0.25, seed=2)    # peak ~1.3k
+
+    rs = engine.check_batch(FIFOQueue(), [cheap, mid],
+                            capacity=64, max_capacity=128)
+    assert rs[0]["valid?"] is True and "escalated" not in rs[0]
+    assert rs[1]["valid?"] is True, rs[1]
+    assert rs[1].get("escalated") == "single", rs[1]
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("keys",))
+    rs = engine.check_batch(FIFOQueue(), [cheap, giant],
+                            capacity=64, max_capacity=128, mesh=mesh)
+    assert rs[0]["valid?"] is True
+    assert rs[1]["valid?"] is True, rs[1]
+    assert rs[1].get("escalated") == "sharded", rs[1]
+
+    # without a mesh the giant is honestly unknown, with the error tagged
+    rs = engine.check_batch(FIFOQueue(), [giant],
+                            capacity=64, max_capacity=128)
+    assert rs[0]["valid?"] == "unknown"
+    assert "error" in rs[0]
