@@ -357,7 +357,12 @@ class IndependentChecker(Checker):
             return None, None
         try:
             ks = list(subs)
-            rs = engine.check_batch(model, [subs[k] for k in ks])
+            # a mesh on the test map shards the key axis across devices
+            # and lets overflow keys escalate to the frontier-sharded
+            # engine (engine._escalate_overflow)
+            mesh = (test or {}).get("mesh")
+            rs = engine.check_batch(model, [subs[k] for k in ks],
+                                    mesh=mesh)
             return {k: {**r, "analyzer": "jax"} for k, r in zip(ks, rs)}, None
         except EncodeError as err:
             # legitimately not device-encodable (a gset key past the

@@ -747,8 +747,9 @@ def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh) -> dict:
     set for latency/memory reasons stays meaningful. Reports which
     tier decided via "escalated". The first batch run already proved
     batch_cap overflows, so every tier starts at 2x."""
-    r = check_encoded(e, capacity=batch_cap * 2,
-                      max_capacity=min(batch_cap * 4, 1 << 21))
+    ceil_single = min(batch_cap * 4, 1 << 21)
+    r = check_encoded(e, capacity=min(batch_cap * 2, ceil_single),
+                      max_capacity=ceil_single)
     if r["valid?"] != "unknown":
         r["escalated"] = "single"
         return r
@@ -758,17 +759,29 @@ def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh) -> dict:
             n_dev = np.asarray(mesh.devices).size
             # pass the caller's mesh through untouched: the sharded
             # engine picks the hierarchical exchange on 2-D (multi-
-            # slice) meshes and flattens anything else itself
+            # slice) meshes and flattens anything else itself. Start
+            # past the single tier's proven-overflowing 4x ceiling —
+            # frontier occupancy is a property of the history, so
+            # re-running smaller global capacities is pure waste.
+            ceil_sharded = min(batch_cap * 4 * n_dev, 1 << 24)
             rs = sharded.check_encoded_sharded(
-                e, mesh, capacity=batch_cap * 2,
-                max_capacity=min(batch_cap * 4 * n_dev, 1 << 24))
+                e, mesh, capacity=min(batch_cap * 8, ceil_sharded),
+                max_capacity=ceil_sharded)
             if rs["valid?"] != "unknown":
                 rs["escalated"] = "sharded"
                 return rs
             r = rs
         except Exception as err:  # noqa: BLE001 — escalation must not
-            r = dict(r)           # turn a decidable batch into a crash
+            # turn a decidable batch into a crash; but a broken sharded
+            # engine must be LOUD (the same rule as independent.py's
+            # device-fallback), not a buried result key
+            import logging
+            logging.getLogger(__name__).warning(
+                "sharded escalation tier crashed (%r) — key left "
+                "unknown; this may hide a sharded-engine regression",
+                err)
+            r = dict(r)
             r["escalation-error"] = repr(err)
-    r.setdefault("error", f"frontier overflow past batch capacity "
-                          f"{batch_cap} and every escalation tier")
+    r["error"] = (f"frontier overflow: batch capacity {batch_cap}, "
+                  f"escalation tiers exhausted ({r.get('error')})")
     return r

@@ -33,6 +33,9 @@ NONSERIALIZABLE_KEYS = (
     # live objects stripped before writing (store.clj:160-168)
     "db", "os", "net", "client", "checker", "nemesis", "generator", "model",
     "remote", "sessions", "store", "control",
+    # a jax.sharding.Mesh of live device handles (independent's device
+    # batch path reads test["mesh"])
+    "mesh",
     # big run artifacts with their own files (history.edn / results.edn)
     "history", "results",
 )

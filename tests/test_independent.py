@@ -96,6 +96,25 @@ def test_independent_checker_device_batch():
     assert r["results"]["x"]["analyzer"] == "jax"
 
 
+def test_independent_checker_device_batch_with_mesh():
+    """test["mesh"] shards the per-key batch over the device mesh (the
+    dp axis) and arms the sharded-escalation path for overflow keys."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("keys",))
+    c = independent.checker(linearizable(CASRegister(), algorithm="jax"))
+    r = c.check({"mesh": mesh}, _keyed_register_history())
+    assert r["valid?"] is False
+    assert r["failures"] == ["y"]
+    assert r["results"]["x"]["analyzer"] == "jax"
+
+    # a Mesh on the test map must be stripped before serialization
+    from jepsen_tpu.store import serializable_test
+    assert "mesh" not in serializable_test({"mesh": mesh, "name": "t"})
+
+
 def test_device_batch_failure_is_loud(monkeypatch, caplog):
     """A broken device path must not silently degrade to the host
     checker: the result carries a device-fallback tag and a warning is
