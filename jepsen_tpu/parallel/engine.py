@@ -753,7 +753,13 @@ def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh) -> dict:
     if r["valid?"] != "unknown":
         r["escalated"] = "single"
         return r
-    if mesh is not None:
+    if mesh is not None \
+            and min(batch_cap * 4 * np.asarray(mesh.devices).size,
+                    1 << 24) > ceil_single:
+        # the tier only runs when its aggregate ceiling can actually
+        # exceed what the single tier just proved overflows — on a
+        # 1-device mesh the two ceilings coincide and a re-run would
+        # be pure waste
         try:
             from jepsen_tpu.parallel import sharded
             n_dev = np.asarray(mesh.devices).size
