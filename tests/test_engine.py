@@ -703,3 +703,34 @@ def test_batch_overflow_escalates_to_wider_tiers():
                             capacity=64, max_capacity=128)
     assert rs[0]["valid?"] == "unknown"
     assert "error" in rs[0]
+
+
+def test_escalation_crash_is_loud(monkeypatch, caplog):
+    """A broken sharded escalation tier must warn loudly and tag the
+    result — never silently degrade a key to "unknown" (the same rule
+    independent.py enforces for its device fallback)."""
+    import logging
+
+    import jax
+    from jax.sharding import Mesh
+
+    from jepsen_tpu.histories import rand_fifo_history
+    from jepsen_tpu.models import FIFOQueue
+    from jepsen_tpu.parallel import sharded
+
+    def boom(*a, **k):
+        raise RuntimeError("sharded tier exploded")
+
+    monkeypatch.setattr(sharded, "check_encoded_sharded", boom)
+    giant = rand_fifo_history(n_ops=40, n_processes=6, n_values=3,
+                              crash_p=0.25, seed=2)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("keys",))
+    with caplog.at_level(logging.WARNING,
+                         logger="jepsen_tpu.parallel.engine"):
+        rs = engine.check_batch(FIFOQueue(), [giant],
+                                capacity=64, max_capacity=128, mesh=mesh)
+    assert rs[0]["valid?"] == "unknown"
+    assert "sharded tier exploded" in rs[0].get("escalation-error", "")
+    assert "escalation tiers exhausted" in rs[0]["error"]
+    assert any("sharded escalation tier crashed" in r.message
+               for r in caplog.records)
