@@ -94,6 +94,16 @@ def _plan(C: int):
     return W, plan
 
 
+def is_tpu_platform(platform: str) -> bool:
+    """TPU-equivalence for platform-name checks. The axon PJRT plugin
+    registers its backend under the name "axon" — canonicalized to
+    "tpu" only for MLIR lowering — so jax.default_backend() and
+    Device.platform report "axon" on real hardware; a literal
+    == "tpu" check would run the pallas kernel in interpret mode ON
+    the chip."""
+    return platform in ("tpu", "axon")
+
+
 def _resolve_use_pallas(use_pallas, S: int, C: int, platform: str):
     """Shared gate for the single and batch paths: default from the
     JEPSEN_TPU_PALLAS=1 env flag, downgraded to False for shapes the
@@ -107,7 +117,7 @@ def _resolve_use_pallas(use_pallas, S: int, C: int, platform: str):
     if use_pallas:
         from jepsen_tpu.parallel import pallas_kernels as pk
         use_pallas = pk.supported(S, C)
-    return use_pallas, platform != "tpu"
+    return use_pallas, not is_tpu_platform(platform)
 
 
 def _bitdense_impl(xs, state0, step_name: str, S: int, C: int,
@@ -300,7 +310,8 @@ def check_batch_bitdense(encs, mesh=None, use_pallas: bool = None) -> list:
     # mesh when one is given, regardless of the process default backend
     platform = (mesh.devices.flat[0].platform if mesh is not None
                 else jax.default_backend())
-    if use_pallas is None and mesh is not None and platform == "tpu":
+    if use_pallas is None and mesh is not None \
+            and is_tpu_platform(platform):
         # a non-interpret pallas_call over a key-sharded batch has no
         # exercised SPMD partitioning path — the DEFAULT (env-flag)
         # route keeps mesh-sharded TPU batches on XLA until that

@@ -135,3 +135,18 @@ def test_batch_pallas_path_differential():
     for rx, rp in zip(rs_xla, rs_pl):
         assert rx["valid?"] is rp["valid?"]
         assert rx.get("fail-event") == rp.get("fail-event")
+
+
+def test_axon_platform_counts_as_tpu():
+    """The axon PJRT plugin registers its backend under the name
+    "axon"; platform gates must treat it as the real chip — a literal
+    == "tpu" check would run pallas in interpret mode ON the TPU."""
+    assert bitdense.is_tpu_platform("tpu")
+    assert bitdense.is_tpu_platform("axon")
+    assert not bitdense.is_tpu_platform("cpu")
+    assert not bitdense.is_tpu_platform("cuda")
+    # the gate's interpret decision follows it
+    _, interp = bitdense._resolve_use_pallas(True, 17, 12, "axon")
+    assert interp is False
+    _, interp = bitdense._resolve_use_pallas(True, 17, 12, "cpu")
+    assert interp is True

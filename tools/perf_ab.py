@@ -98,8 +98,9 @@ def main():
 
     # off-TPU runs are interpret-mode sanity checks whose timings the
     # verdict ignores — full shapes would grind for hours producing
-    # discarded numbers, so force the tiny shapes
-    smoke = SMOKE or backend != "tpu"
+    # discarded numbers, so force the tiny shapes. "axon" IS the real
+    # chip (the PJRT plugin's backend name).
+    smoke = SMOKE or not bitdense.is_tpu_platform(backend)
     if smoke and not SMOKE:
         emit({"note": f"non-tpu backend {backend!r}: forcing smoke "
                       f"shapes (interpret-mode timings, no verdict)"})
@@ -146,7 +147,7 @@ def main():
     else:
         emit({"shape": "batch", "skipped": f"unsupported S={S} C={C}"})
 
-    if backend != "tpu":
+    if not bitdense.is_tpu_platform(backend):
         # interpret-mode timings measure the interpreter, not the
         # kernel — never let them flip the default
         verdict = "no-verdict (non-tpu backend: interpret-mode timings)"
