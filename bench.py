@@ -130,7 +130,7 @@ def _adv_encoded(L):
 
 # ======================= child sections ============================
 
-def sec_multikey():
+def sec_multikey(label: str = None):
     from jepsen_tpu.histories import rand_register_history
     from jepsen_tpu.models import CASRegister
     from jepsen_tpu.checker import linear_packed
@@ -174,10 +174,15 @@ def sec_multikey():
     host_rate = HOST_SAMPLE_KEYS * OPS_PER_KEY / host_secs
     host32_rate = host_rate * 32
 
+    # a relabeled run (the CPU fallback) must not leave a line in the
+    # record claiming a device number
+    what = label or "device end-to-end"
+    line_extra = {} if label is None else {"backend": label}
     emit({"metric": f"multi-key {N_KEYS}x{OPS_PER_KEY}-op cas-register "
-                    f"(north-star shape), device end-to-end",
+                    f"(north-star shape), {what}",
           "value": round(dev_rate, 1), "unit": "ops/sec",
           "vs_baseline": round(dev_rate / host32_rate, 2),
+          **line_extra,
           "device_only_secs": round(device_secs, 3),
           "encode_secs": round(encode_secs, 3),
           "device_only_ops_per_sec": round(total_ops / device_secs, 1),
@@ -337,7 +342,7 @@ def sec_maxlen(budget_secs: float):
 
 # ======================= parent orchestrator =======================
 
-def run_section(argv: list, timeout: float):
+def run_section(argv: list, timeout: float, env_extra: dict = None):
     """Spawn `python bench.py --section ...`; forward the child's
     stdout lines as they arrive, parse the JSON ones, kill on timeout.
     The ACTUAL timeout rides along as the final `--timeout` argv so
@@ -349,10 +354,14 @@ def run_section(argv: list, timeout: float):
     harvesting those partial results."""
     cmd = [sys.executable, os.path.abspath(__file__), "--section"] + \
         [str(a) for a in argv] + ["--timeout", f"{timeout:.0f}"]
+    env = None
+    if env_extra:
+        env = dict(os.environ)
+        env.update(env_extra)
     parsed = []
     try:
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                                stderr=sys.stderr, text=True)
+                                stderr=sys.stderr, text=True, env=env)
     except OSError as err:
         emit({"metric": f"section {argv[0]}", "value": None,
               "unit": "ops/sec", "error": repr(err)})
@@ -503,6 +512,31 @@ def main():
               "unit": "ops/sec",
               "vs_baseline": mk_line.get("vs_baseline")})
     else:
+        # EVERY device section hung or crashed — almost certainly a
+        # dead TPU runtime (observed in the wild: the tunnel wedges
+        # PJRT client creation). Record a clearly-labeled CPU-fallback
+        # number rather than a null: it documents that the checker
+        # machinery works and makes the outage legible in the record.
+        # Deliberately allowed to overrun the internal budget — this is
+        # the only number the run will produce, and the driver's outer
+        # timeout is the real bound.
+        note("all device sections failed — CPU-fallback multikey "
+             "run (labeled; not a TPU number)")
+        parsed, _ = run_section(
+            ["multikey", "cpu-fallback"],
+            max(sec_timeout("multikey"), left()),
+            env_extra={"JAX_PLATFORMS": "cpu"})
+        fb = next((p for p in parsed if p.get("value")), None)
+        if fb is not None:
+            emit({"metric": f"multi-key {N_KEYS}x{OPS_PER_KEY}-op "
+                            f"cas-register — CPU FALLBACK (TPU "
+                            f"runtime unreachable; NOT a device "
+                            f"number)",
+                  "value": fb["value"],
+                  "unit": "ops/sec",
+                  "vs_baseline": fb.get("vs_baseline"),
+                  "backend": "cpu-fallback"})
+            return
         emit({"metric": "linearizability check throughput",
               "value": None, "unit": "ops/sec", "vs_baseline": None,
               "error": "no section completed (device runtime down?) — "
@@ -522,9 +556,18 @@ def child_main(argv: list) -> None:
         argv = argv[:i] + argv[i + 2:]
     sec = argv[0]
     faulthandler.dump_traceback_later(max(20, to - 10), exit=False)
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        # env alone is not enough on this image — the TPU plugin's
+        # backend hook ignores it; pin via config like conftest does
+        try:
+            import jax
+            jax.config.update("jax_platforms", plat)
+        except Exception:  # noqa: BLE001
+            pass
     _enable_compile_cache()
     if sec == "multikey":
-        sec_multikey()
+        sec_multikey(argv[1] if len(argv) > 1 else None)
     elif sec == "adv":
         L, deadline, skip_host = int(argv[1]), float(argv[2]), \
             bool(int(argv[3]))

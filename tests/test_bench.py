@@ -40,15 +40,35 @@ def _json_lines(out):
 
 
 @pytest.mark.slow
-def test_bench_survives_total_hang():
-    r = _run({"BENCH_TIMEOUT_SCALE": "0.02"})
+def test_bench_total_hang_lands_on_labeled_cpu_fallback():
+    """Every device section killed -> the bench runs one CPU-fallback
+    multikey and the headline (and the child's own forwarded line) are
+    BOTH labeled — no unlabeled line may claim a device number."""
+    r = _run({"BENCH_TIMEOUT_SCALE": "0.02"}, timeout=400)
     assert r.returncode == 0, r.stderr[-2000:]
     lines = _json_lines(r.stdout)
-    assert lines, r.stdout
     skips = [l for l in lines if "skipped" in l]
     assert skips, "no per-section skip lines emitted"
     head = lines[-1]
-    # the driver parses the LAST line; it must carry the contract keys
+    for k in ("metric", "value", "unit", "vs_baseline"):
+        assert k in head, head
+    assert head.get("backend") == "cpu-fallback", head
+    assert "CPU FALLBACK" in head["metric"]
+    for l in lines:
+        if l.get("value") is not None and "metric" in l:
+            assert "device end-to-end" not in l["metric"], l
+
+
+@pytest.mark.slow
+def test_bench_hang_plus_exhausted_budget_emits_error_headline():
+    """When even the fallback can't run (budget already negative, so
+    its timeout collapses and it is killed too), the final line is the
+    machine-readable error headline."""
+    r = _run({"BENCH_TIMEOUT_SCALE": "0.02", "BENCH_BUDGET_SECS": "4"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = _json_lines(r.stdout)
+    head = lines[-1]
+    assert head["value"] is None and "error" in head, head
     for k in ("metric", "value", "unit", "vs_baseline"):
         assert k in head, head
 
