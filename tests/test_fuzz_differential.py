@@ -100,9 +100,18 @@ CASES = [
 @pytest.mark.parametrize("name,Model,gen", CASES,
                          ids=[c[0] for c in CASES])
 def test_fuzz_engines_agree_with_wgl(name, Model, gen):
+    import jax
+
     failures = []
     runs = 0
     for seed in range(N_SEEDS):
+        if seed and seed % 25 == 0:
+            # every distinct (R, S, C) shape is a separate compiled
+            # executable; hundreds of seeds accumulate thousands of
+            # them and the XLA CPU backend has been observed to
+            # SEGFAULT under that pressure (200-seed sweep crash in
+            # backend_compile_and_load; all shapes pass in isolation)
+            jax.clear_caches()
         # mutex ops carry no values, so corrupt_history has nothing to
         # flip — its invalid coverage comes from the clean variant,
         # where random acquire/release interleavings are often already
