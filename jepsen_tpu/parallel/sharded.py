@@ -125,9 +125,11 @@ def _route_to_owners(st, ml, mh, legal, n_dev: int, B: int):
     return _route_stage(st, ml, mh, legal, owner, n_dev, B, AXIS)
 
 
-def _sharded_core(xs, state0, step_name: str, Nd: int, n_dev: int,
+def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
                   my_idx, axes, route_cand, route_front):
-    """The topology-independent event scan (runs INSIDE shard_map).
+    """The topology-independent event scan (runs INSIDE shard_map),
+    from an explicit initial carry — shared by the fresh-start core and
+    the resumable chunk runner.
 
     `axes` names the mesh axes reductions ride; `route_cand(st, ml, mh,
     live)` / `route_front(...)` deliver candidate / surviving rows to
@@ -215,6 +217,15 @@ def _sharded_core(xs, state0, step_name: str, Nd: int, n_dev: int,
         return (st_o, ml_o, mh_o, live_o, new_ok, new_fail,
                 r_idx + 1, maxf), ovf
 
+    carry, ovfs = lax.scan(scan_step, carry0, xs)
+    return carry, jnp.any(ovfs)
+
+
+def _sharded_core(xs, state0, step_name: str, Nd: int, n_dev: int,
+                  my_idx, axes, route_cand, route_front):
+    """Fresh-start wrapper over _sharded_scan: seed the initial config
+    on its hash-owner device, scan the whole history, reduce to the
+    (valid, fail, overflow, maxf) scalars."""
     # initial config lives on its hash-owner device
     st0v = jnp.full(Nd, state0, jnp.int32)
     owner0 = _hash_config(jnp.int32(state0), jnp.uint32(0),
@@ -223,11 +234,24 @@ def _sharded_core(xs, state0, step_name: str, Nd: int, n_dev: int,
     carry0 = (st0v, jnp.zeros(Nd, jnp.uint32), jnp.zeros(Nd, jnp.uint32),
               live0, jnp.array(True), jnp.int32(-1), jnp.int32(0),
               jnp.int32(1))
-    carry, ovfs = lax.scan(scan_step, carry0, xs)
+    carry, overflow = _sharded_scan(xs, carry0, step_name, Nd, n_dev,
+                                    my_idx, axes, route_cand, route_front)
     _, _, _, live, ok, fail_r, _, maxf = carry
-    overflow = jnp.any(ovfs)
     valid = ok & (lax.psum(jnp.sum(live), axes) > 0) & ~overflow
     return valid, fail_r, overflow, maxf
+
+
+def _flat_routes(Nd: int, C: int, n_dev: int):
+    """(route_cand, route_front) for the flat 1-D topology.
+    Owner-bucket widths: 2x the uniform share (hash-uniform slack),
+    floored so tiny frontiers never trip the overflow path."""
+    B_cand = max(64, -(-2 * Nd * C // n_dev))
+    B_front = max(64, -(-2 * Nd // n_dev))
+    route_cand = lambda st, ml, mh, lv: _route_to_owners(
+        st, ml, mh, lv, n_dev, B_cand)
+    route_front = lambda st, ml, mh, lv: _route_to_owners(
+        st, ml, mh, lv, n_dev, B_front)
+    return route_cand, route_front
 
 
 def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int,
@@ -236,15 +260,8 @@ def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int,
     all-gather broadcast (A/B measurement path)."""
     C = xs["slot_f"].shape[1]
     my_idx = lax.axis_index(AXIS).astype(jnp.uint32)
-    # owner-bucket widths: 2x the uniform share (hash-uniform slack),
-    # floored so tiny frontiers never trip the overflow path
-    B_cand = max(64, -(-2 * Nd * C // n_dev))
-    B_front = max(64, -(-2 * Nd // n_dev))
     if exchange == "route":
-        route_cand = lambda st, ml, mh, lv: _route_to_owners(
-            st, ml, mh, lv, n_dev, B_cand)
-        route_front = lambda st, ml, mh, lv: _route_to_owners(
-            st, ml, mh, lv, n_dev, B_front)
+        route_cand, route_front = _flat_routes(Nd, C, n_dev)
     else:
         def _bcast(st, ml, mh, lv):
             g = lambda a: lax.all_gather(a, AXIS, tiled=True)
@@ -322,6 +339,163 @@ def _check_sharded(xs, state0, step_name: str, Nd: int, n_dev: int,
         check_vma=False,
     )
     return fn(xs, state0)
+
+
+def _sharded_resume_impl(xs, st, ml, mh, live, ok, fail_r, r_idx, maxf,
+                         step_name: str, Nd: int, n_dev: int):
+    """Resume-from-carry adapter (runs INSIDE shard_map), 1-D topology.
+
+    Restored rows arrive laid out however the host scattered them — a
+    checkpoint may be resumed on a DIFFERENT mesh size — so the first
+    act is one owner-routing round delivering every row to its current
+    hash-owner; from then on the invariant the scan relies on (each
+    live row lives on its owner device) holds. Returns the final carry
+    (frontier sharded, scalars replicated) plus the overflow flag."""
+    C = xs["slot_f"].shape[1]
+    my_idx = lax.axis_index(AXIS).astype(jnp.uint32)
+    route_cand, route_front = _flat_routes(Nd, C, n_dev)
+
+    # the restore route's destinations are maximally SKEWED, not
+    # hash-uniform — on the same mesh every one of a device's rows goes
+    # back to that one device — so it gets worst-case buckets (B = Nd)
+    # rather than route_front's 2x-uniform slack; it runs once per
+    # chunk, so the O(n_dev * Nd) receive buffer is fine
+    r_st, r_ml, r_mh, r_live, rt_ovf = _route_to_owners(
+        st, ml, mh, live, n_dev, Nd)
+    st2, ml2, mh2, live2, _, d_ovf = _owned_dedupe_compact(
+        r_st, r_ml, r_mh, r_live, Nd, n_dev, my_idx)
+    pre_ovf = lax.psum((rt_ovf | d_ovf).astype(jnp.int32), (AXIS,)) > 0
+
+    carry0 = (st2, ml2, mh2, live2, ok, fail_r, r_idx, maxf)
+    carry, scan_ovf = _sharded_scan(xs, carry0, step_name, Nd, n_dev,
+                                    my_idx, (AXIS,), route_cand,
+                                    route_front)
+    return carry, scan_ovf | pre_ovf
+
+
+@functools.partial(jax.jit, static_argnames=("step_name", "Nd", "n_dev",
+                                             "mesh"))
+def _check_sharded_resume(xs, st, ml, mh, live, ok, fail_r, r_idx, maxf,
+                          step_name: str, Nd: int, n_dev: int,
+                          mesh: Mesh):
+    fn = jax.shard_map(
+        lambda x, *c: _sharded_resume_impl(x, *c, step_name, Nd, n_dev),
+        mesh=mesh,
+        in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS),
+                  P(), P(), P(), P()),
+        out_specs=((P(AXIS), P(AXIS), P(AXIS), P(AXIS),
+                    P(), P(), P(), P()), P()),
+        check_vma=False,
+    )
+    return fn(xs, st, ml, mh, live, ok, fail_r, r_idx, maxf)
+
+
+def check_encoded_sharded_resumable(e: EncodedHistory, mesh: Mesh,
+                                    capacity: int = 8192,
+                                    max_capacity: int = 1 << 22,
+                                    checkpoint_every: int = 256,
+                                    checkpoint_cb=None,
+                                    resume=None) -> dict:
+    """check_encoded_sharded with mid-search checkpointing — the
+    sharded arm of the checker's checkpoint/resume capability
+    (SURVEY.md §5.4; engine.check_encoded_resumable is the single-
+    device arm). Events run in chunks of `checkpoint_every`; after
+    each chunk the GLOBAL frontier is gathered to host and handed to
+    checkpoint_cb(engine.FrontierCheckpoint). The checkpoint is
+    topology-independent: `capacity` is the GLOBAL frontier size, rows
+    are stored unsharded, and resuming re-routes every row to its
+    hash-owner on the CURRENT mesh — a search checkpointed on D
+    devices resumes on any other device count (elastic recovery).
+    Overflow inside a chunk (including the restore re-route) re-runs
+    that chunk at doubled capacity; the prior checkpoint stays valid.
+
+    Topology caveat: this path always runs the FLAT 1-D exchange — a
+    2-D multi-slice mesh is flattened (with a warning), unlike
+    check_encoded_sharded, which would pick the hierarchical DCN-aware
+    exchange for it. `explored` is likewise not tracked across arms:
+    sharded checkpoints carry the resume's steps_n through unchanged."""
+    from jepsen_tpu.parallel.engine import (FrontierCheckpoint,
+                                            history_digest)
+
+    if e.n_returns == 0:
+        return {"valid?": True, "max-frontier": 0, "capacity": 0}
+    devs = np.asarray(mesh.devices)
+    if devs.ndim == 2 and devs.shape[0] > 1 and devs.shape[1] > 1:
+        import logging
+        logging.getLogger(__name__).warning(
+            "resumable sharded check flattens the 2-D mesh to the flat "
+            "1-D exchange — the hierarchical multi-slice routing of "
+            "check_encoded_sharded is not used on this path")
+    devs = devs.reshape(-1)
+    mesh = Mesh(devs, (AXIS,))
+    n_dev = devs.size
+    digest = history_digest(e)
+    if resume is not None:
+        if resume.history_digest != digest:
+            raise ValueError(
+                f"checkpoint is for a different history "
+                f"(digest {resume.history_digest} != {digest})")
+        if resume.step_name != e.step_name:
+            raise ValueError("checkpoint is for a different model")
+        cp = resume
+    else:
+        N0 = max(64 * n_dev, capacity)
+        cp = FrontierCheckpoint(
+            0, N0, e.step_name, digest,
+            np.full(N0, e.state0, np.int32), np.zeros(N0, np.uint32),
+            np.zeros(N0, np.uint32), np.arange(N0) < 1,
+            True, -1, 1, 0)
+
+    rep = NamedSharding(mesh, P())
+    shard = NamedSharding(mesh, P(AXIS))
+    xs_np = {
+        "slot_f": e.slot_f, "slot_a0": e.slot_a0, "slot_a1": e.slot_a1,
+        "slot_wild": e.slot_wild, "slot_occ": e.slot_occ,
+        "ev_slot": e.ev_slot,
+    }
+    R = e.n_returns
+    while cp.event_index < R and cp.ok:
+        # global capacity must divide the mesh; grow to the next
+        # multiple when the checkpoint came from a different topology
+        N = -(-cp.capacity // n_dev) * n_dev
+        if N != cp.capacity:
+            cp = cp.grown(N)
+        Nd = N // n_dev
+        lo, hi = cp.event_index, min(R, cp.event_index + checkpoint_every)
+        chunk = {k: jax.device_put(np.asarray(v[lo:hi]), rep)
+                 for k, v in xs_np.items()}
+        carry, overflow = _check_sharded_resume(
+            chunk,
+            jax.device_put(cp.st, shard), jax.device_put(cp.ml, shard),
+            jax.device_put(cp.mh, shard),
+            jax.device_put(cp.live, shard),
+            jax.device_put(np.bool_(cp.ok), rep),
+            jax.device_put(np.int32(cp.fail_r), rep),
+            jax.device_put(np.int32(cp.event_index), rep),
+            jax.device_put(np.int32(cp.maxf), rep),
+            e.step_name, Nd, n_dev, mesh)
+        if bool(overflow):
+            if N * 2 > max_capacity:
+                return {"valid?": "unknown",
+                        "error": f"frontier overflow at capacity {N}",
+                        "capacity": N, "devices": n_dev,
+                        "checkpoint": cp}
+            cp = cp.grown(N * 2)    # N extra dead rows
+            continue                # re-run the same chunk
+        st, ml, mh, live, ok, fail_r, r_idx, maxf = \
+            [np.asarray(x) for x in carry]
+        cp = FrontierCheckpoint(int(r_idx), N, e.step_name, digest,
+                                st, ml, mh, live, bool(ok),
+                                int(fail_r), int(maxf), cp.steps_n)
+        if checkpoint_cb is not None:
+            checkpoint_cb(cp)
+    out = {"valid?": cp.ok and bool(cp.live.any()),
+           "max-frontier": cp.maxf, "capacity": cp.capacity,
+           "devices": n_dev}
+    if not out["valid?"]:
+        from jepsen_tpu.parallel.encode import fail_op_fields
+        out.update(fail_op_fields(e, cp.fail_r))
+    return out
 
 
 def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,

@@ -98,3 +98,100 @@ def test_overflow_regrows_within_resume():
                                          checkpoint_every=16)
     assert res["valid?"] == ref["valid?"]
     assert res["capacity"] >= 64
+
+
+# ---------------- sharded (mesh) checkpoint/resume -------------------
+
+
+def _mesh(n=8):
+    import jax
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:n]), ("frontier",))
+
+
+def test_sharded_resumable_matches_oneshot():
+    from jepsen_tpu.parallel import sharded
+
+    mesh = _mesh()
+    for valid, seed in ((True, 5), (False, 6)):
+        e = _encoded(seed=seed, valid=valid)
+        ref = sharded.check_encoded_sharded(e, mesh, capacity=64 * 8)
+        res = sharded.check_encoded_sharded_resumable(
+            e, mesh, capacity=64 * 8, checkpoint_every=16)
+        assert res["valid?"] == ref["valid?"] is valid
+        if not valid:
+            assert res["op"] == ref["op"]
+            assert res["fail-event"] == ref["fail-event"]
+
+
+def test_sharded_checkpoint_resumes_on_smaller_mesh(tmp_path):
+    """The elastic-recovery property: a search checkpointed on 8
+    devices resumes — via save/load — on a 4-device mesh (restored
+    rows re-route to their hash-owners on the CURRENT topology)."""
+    from jepsen_tpu.parallel import engine as eng, sharded
+
+    e = _encoded(seed=7)
+    ref = sharded.check_encoded_sharded(e, _mesh(8), capacity=64 * 8)
+
+    cps = []
+
+    class Stop(Exception):
+        pass
+
+    def cb(cp):
+        cps.append(cp)
+        if len(cps) >= 2:
+            raise Stop  # simulate preemption mid-search
+
+    with pytest.raises(Stop):
+        sharded.check_encoded_sharded_resumable(
+            e, _mesh(8), capacity=64 * 8, checkpoint_every=8,
+            checkpoint_cb=cb)
+    assert cps and cps[-1].event_index < e.n_returns
+
+    path = str(tmp_path / "sharded-frontier.npz")
+    cps[-1].save(path)
+    loaded = eng.FrontierCheckpoint.load(path)
+
+    res = sharded.check_encoded_sharded_resumable(
+        e, _mesh(4), checkpoint_every=64, resume=loaded)
+    assert res["valid?"] == ref["valid?"] is True
+    assert res["devices"] == 4
+
+
+def test_sharded_checkpoint_rejects_wrong_history():
+    from jepsen_tpu.parallel import sharded
+
+    e1, e2 = _encoded(seed=8), _encoded(seed=9)
+    cps = []
+    sharded.check_encoded_sharded_resumable(
+        e1, _mesh(), capacity=64 * 8, checkpoint_every=8,
+        checkpoint_cb=cps.append)
+    assert cps
+    with pytest.raises(ValueError, match="different history"):
+        sharded.check_encoded_sharded_resumable(e2, _mesh(),
+                                                resume=cps[0])
+
+
+def test_sharded_restore_route_handles_skewed_rows():
+    """Restore-route destinations are maximally skewed (each device's
+    rows return to that device), so its buckets must be worst-case
+    sized: with frontier ~2^10 at global capacity 2048 on 8 devices,
+    per-device restore load (~137 rows) exceeds the uniform-slack
+    bucket width (64) — under the old sizing every chunk spuriously
+    overflowed and the capacity inflated; it must stay at 2048."""
+    from jepsen_tpu.histories import adversarial_register_history
+    from jepsen_tpu.parallel import sharded
+
+    h = adversarial_register_history(n_ops=120, k_crashed=10, seed=4)
+    e = enc_mod.encode(CASRegister(), h)
+    mesh = _mesh(8)
+    ref = sharded.check_encoded_sharded(e, mesh, capacity=16384)
+    assert ref["valid?"] is True and ref["capacity"] == 16384, ref
+    # peak frontier ~12k -> ~1.5k rows per device at restore, far past
+    # the old uniform-slack bucket width (2*2048/8 = 512)
+    res = sharded.check_encoded_sharded_resumable(
+        e, mesh, capacity=16384, checkpoint_every=8)
+    assert res["valid?"] is True, res
+    assert res["capacity"] == 16384, \
+        f"spurious restore-route overflow inflated capacity: {res}"
