@@ -41,7 +41,11 @@ from jepsen_tpu.parallel.steps import STEPS
 MAX_C = 24  # 2^24 masks = 512k words per state row
 
 U32 = jnp.uint32
-FULL = jnp.uint32(0xFFFFFFFF)
+# np (not jnp): a module-level jnp scalar initializes the default
+# backend at import — with a wedged device runtime that turns a bare
+# `import bitdense` into a hang before any device call. Engine modules
+# must be import-safe; numpy constants fold into traces identically.
+FULL = np.uint32(0xFFFFFFFF)
 
 
 MAX_S = 128  # the closure trace unrolls over slots and states; its sel
