@@ -517,21 +517,26 @@ def main():
         # PJRT client creation). Record a clearly-labeled CPU-fallback
         # number rather than a null: it documents that the checker
         # machinery works and makes the outage legible in the record.
-        # Deliberately allowed to overrun the internal budget — this is
-        # the only number the run will produce, and the driver's outer
-        # timeout is the real bound.
+        # The fallback child is forced onto SMOKE shapes regardless of
+        # the parent's: the full 84-key batch cannot finish on a host
+        # CPU inside any reasonable window (BENCH_r03 recorded null for
+        # exactly that reason), and the fallback's one job is to land a
+        # labeled number. Its timeout is budget-independent (at least
+        # sec_timeout("multikey"), at most 300s): this is the only
+        # number the run will produce, and the driver's outer timeout
+        # is the real bound. TIMEOUT_SCALE scales the floor as usual,
+        # which is also how the error-headline path stays testable.
         note("all device sections failed — CPU-fallback multikey "
-             "run (labeled; not a TPU number)")
+             "run on SMOKE shapes (labeled; not a TPU number)")
         parsed, _ = run_section(
             ["multikey", "cpu-fallback"],
-            max(sec_timeout("multikey"), left()),
-            env_extra={"JAX_PLATFORMS": "cpu"})
+            max(sec_timeout("multikey"), min(left(), 300)),
+            env_extra={"JAX_PLATFORMS": "cpu", "BENCH_SMOKE": "1"})
         fb = next((p for p in parsed if p.get("value")), None)
         if fb is not None:
-            emit({"metric": f"multi-key {N_KEYS}x{OPS_PER_KEY}-op "
-                            f"cas-register — CPU FALLBACK (TPU "
-                            f"runtime unreachable; NOT a device "
-                            f"number)",
+            emit({"metric": f"{fb['metric']} — CPU FALLBACK on SMOKE "
+                            f"shapes (TPU runtime unreachable; NOT a "
+                            f"device number)",
                   "value": fb["value"],
                   "unit": "ops/sec",
                   "vs_baseline": fb.get("vs_baseline"),

@@ -43,8 +43,14 @@ def _json_lines(out):
 def test_bench_total_hang_lands_on_labeled_cpu_fallback():
     """Every device section killed -> the bench runs one CPU-fallback
     multikey and the headline (and the child's own forwarded line) are
-    BOTH labeled — no unlabeled line may claim a device number."""
-    r = _run({"BENCH_TIMEOUT_SCALE": "0.02"}, timeout=400)
+    BOTH labeled — no unlabeled line may claim a device number.
+
+    The parent runs the PRODUCTION (non-smoke) configuration: the
+    fallback child must be forced onto SMOKE shapes regardless, because
+    the full 84-key batch cannot finish on a host CPU inside any window
+    (BENCH_r03's fallback recorded null for exactly this reason)."""
+    r = _run({"BENCH_TIMEOUT_SCALE": "0.02", "BENCH_SMOKE": ""},
+             timeout=500)
     assert r.returncode == 0, r.stderr[-2000:]
     lines = _json_lines(r.stdout)
     skips = [l for l in lines if "skipped" in l]
@@ -54,6 +60,8 @@ def test_bench_total_hang_lands_on_labeled_cpu_fallback():
         assert k in head, head
     assert head.get("backend") == "cpu-fallback", head
     assert "CPU FALLBACK" in head["metric"]
+    assert "8x40" in head["metric"], head          # smoke shapes forced
+    assert "84x120" not in head["metric"], head    # not the full batch
     for l in lines:
         if l.get("value") is not None and "metric" in l:
             assert "device end-to-end" not in l["metric"], l
