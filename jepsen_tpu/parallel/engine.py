@@ -209,23 +209,28 @@ def _check_device_batch(xs, state0, step_name: str, N: int):
 # ------------------------------------------------------------- host API
 
 
-def _xs_from_encoded(e: EncodedHistory, device=None) -> dict:
-    """Event arrays as device arrays. With `device` (a Device or
+def _place(tree, device=None):
+    """Host arrays -> device arrays. With `device` (a Device or
     Sharding) every array is *explicitly* placed there — never on the
     default backend, which may be a broken TPU runtime while the caller
     is deliberately running on a CPU mesh (the MULTICHIP_r01 failure
-    mode: jnp.asarray landing on the poisoned default backend)."""
-    xs = {
+    mode: jnp.asarray landing on the poisoned default backend). Every
+    engine entry point that accepts `device` routes through here."""
+    if device is not None:
+        return jax.device_put(tree, device)
+    return jax.tree.map(jnp.asarray, tree)
+
+
+def _xs_from_encoded(e: EncodedHistory, device=None) -> dict:
+    """Event arrays as device arrays, placed via _place."""
+    return _place({
         "slot_f": e.slot_f,
         "slot_a0": e.slot_a0,
         "slot_a1": e.slot_a1,
         "slot_wild": e.slot_wild,
         "slot_occ": e.slot_occ,
         "ev_slot": e.ev_slot,
-    }
-    if device is not None:
-        return jax.device_put(xs, device)
-    return {k: jnp.asarray(v) for k, v in xs.items()}
+    }, device)
 
 
 class FrontierCheckpoint:
@@ -255,13 +260,14 @@ class FrontierCheckpoint:
         self.maxf = int(maxf)
         self.steps_n = int(steps_n)
 
-    def carry(self):
-        """The device scan carry this checkpoint resumes from."""
-        return (jnp.asarray(self.st), jnp.asarray(self.ml),
-                jnp.asarray(self.mh), jnp.asarray(self.live),
-                jnp.asarray(self.ok), jnp.int32(self.fail_r),
-                jnp.int32(self.event_index), jnp.int32(self.maxf),
-                jnp.int32(self.steps_n))
+    def carry(self, device=None):
+        """The device scan carry this checkpoint resumes from. With
+        `device` every array is explicitly placed there (same
+        invariant as _xs_from_encoded: never the default backend)."""
+        return _place((self.st, self.ml, self.mh, self.live,
+                       np.bool_(self.ok), np.int32(self.fail_r),
+                       np.int32(self.event_index), np.int32(self.maxf),
+                       np.int32(self.steps_n)), device)
 
     def grown(self, new_capacity: int) -> "FrontierCheckpoint":
         """Re-embed the frontier into a larger capacity (overflow
@@ -318,13 +324,15 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
                             checkpoint_every: int = 256,
                             checkpoint_cb=None,
                             resume: Optional[FrontierCheckpoint] = None,
-                            ) -> dict:
+                            device=None) -> dict:
     """check_encoded with mid-search checkpointing: events are processed
     in chunks of `checkpoint_every`; after each chunk the frontier is
     pulled to host and handed to checkpoint_cb(FrontierCheckpoint) (e.g.
     cp.save(path)). Pass `resume` to continue a prior search. Overflow
     inside a chunk re-runs that chunk at doubled capacity — the
-    checkpoint taken before the chunk stays valid."""
+    checkpoint taken before the chunk stays valid. With `device`, every
+    chunk and resumed carry is explicitly placed there — same invariant
+    as check_encoded(device=...): never the default backend."""
     if e.n_returns == 0:
         return {"valid?": True, "max-frontier": 0, "capacity": 0}
     digest = history_digest(e)
@@ -354,9 +362,9 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
     while cp.event_index < R and cp.ok:
         lo = cp.event_index
         hi = min(R, lo + checkpoint_every)
-        chunk = {k: jnp.asarray(v[lo:hi]) for k, v in xs_np.items()}
+        chunk = _place({k: v[lo:hi] for k, v in xs_np.items()}, device)
         carry, overflow = _check_device_resumable(
-            chunk, cp.carry(), e.step_name, cp.capacity)
+            chunk, cp.carry(device), e.step_name, cp.capacity)
         if bool(overflow):
             if cp.capacity * 2 > max_capacity:
                 return {"valid?": "unknown",
@@ -388,16 +396,20 @@ _fail_op = enc_mod.fail_op_fields
 
 
 def check_encoded(e: EncodedHistory, capacity: int = 1024,
-                  max_capacity: int = 1 << 20) -> dict:
+                  max_capacity: int = 1 << 20, device=None) -> dict:
     """Check one encoded history, doubling frontier capacity on overflow
-    (re-jit per capacity tier; tiers are cached by jax.jit)."""
+    (re-jit per capacity tier; tiers are cached by jax.jit). With
+    `device` every input is explicitly placed there and the search runs
+    on it — never on the default backend, which may be a broken TPU
+    runtime while the caller deliberately runs on a CPU mesh."""
     if e.n_returns == 0:
         return {"valid?": True, "max-frontier": 0, "capacity": 0}
-    xs = _xs_from_encoded(e)
+    xs = _xs_from_encoded(e, device)
+    state0 = _place(np.int32(e.state0), device)
     N = max(64, capacity)
     while True:
         valid, fail_r, overflow, maxf, steps_n = _check_device(
-            xs, jnp.int32(e.state0), e.step_name, N)
+            xs, state0, e.step_name, N)
         if not bool(overflow):
             break
         if N * 2 > max_capacity:
@@ -748,8 +760,14 @@ def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh) -> dict:
     tier decided via "escalated". The first batch run already proved
     batch_cap overflows, so every tier starts at 2x."""
     ceil_single = min(batch_cap * 4, 1 << 21)
+    # pin the single tier to the caller's mesh: check_encoded on the
+    # default backend would break the invariant the batch and sharded
+    # paths maintain (nothing on the default backend — it can be a
+    # wedged TPU runtime while we deliberately run on a CPU mesh), and
+    # a batch-overflow key would hang in escalation
+    dev = None if mesh is None else np.asarray(mesh.devices).flat[0]
     r = check_encoded(e, capacity=min(batch_cap * 2, ceil_single),
-                      max_capacity=ceil_single)
+                      max_capacity=ceil_single, device=dev)
     if r["valid?"] != "unknown":
         r["escalated"] = "single"
         return r

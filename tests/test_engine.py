@@ -734,3 +734,64 @@ def test_escalation_crash_is_loud(monkeypatch, caplog):
     assert "escalation tiers exhausted" in rs[0]["error"]
     assert any("sharded escalation tier crashed" in r.message
                for r in caplog.records)
+
+def test_escalation_single_tier_pinned_to_callers_mesh(monkeypatch):
+    """The single-key escalation tier must run on the caller's mesh,
+    never on the default backend — the batch and sharded paths keep
+    that invariant (the default backend can be a wedged TPU runtime
+    while we deliberately run on a CPU mesh), and a batch-overflow key
+    previously broke it right in the middle of the hardened path."""
+    import jax
+    from jax.sharding import Mesh
+
+    from jepsen_tpu.histories import rand_fifo_history
+    from jepsen_tpu.models import FIFOQueue
+
+    seen = {}
+    real = engine.check_encoded
+
+    def spy(e, capacity=1024, max_capacity=1 << 20, device=None):
+        seen["device"] = device
+        return real(e, capacity=capacity, max_capacity=max_capacity,
+                    device=device)
+
+    monkeypatch.setattr(engine, "check_encoded", spy)
+    mid = rand_fifo_history(n_ops=40, n_processes=6, n_values=3,
+                            crash_p=0.15, seed=1)     # peak ~512
+    mesh = Mesh(np.array(jax.devices()[4:8]), ("keys",))
+    rs = engine.check_batch(FIFOQueue(), [mid],
+                            capacity=64, max_capacity=128, mesh=mesh)
+    assert rs[0]["valid?"] is True
+    assert rs[0].get("escalated") == "single", rs[0]
+    assert seen["device"] == np.asarray(mesh.devices).flat[0]
+
+
+def test_check_encoded_explicit_device_placement():
+    """check_encoded(device=...) places every input on that device and
+    reaches the same verdict as the default-backend path."""
+    import jax
+
+    from jepsen_tpu.histories import rand_fifo_history
+    from jepsen_tpu.models import FIFOQueue
+
+    h = rand_fifo_history(n_ops=30, n_processes=4, n_values=3,
+                          crash_p=0.05, seed=3)
+    e = enc_mod.encode(FIFOQueue(), h)
+    dev = jax.devices()[5]
+    xs = engine._xs_from_encoded(e, dev)
+    for name, a in xs.items():
+        assert a.devices() == {dev}, (name, a.devices())
+    r_pinned = engine.check_encoded(e, device=dev)
+    r_default = engine.check_encoded(e)
+    assert r_pinned["valid?"] == r_default["valid?"]
+    # the resumable arm keeps the same invariant: chunks and carries
+    # placed on the given device, same verdict
+    r_res = engine.check_encoded_resumable(e, checkpoint_every=8,
+                                           device=dev)
+    assert r_res["valid?"] == r_default["valid?"]
+    cp = engine.FrontierCheckpoint(
+        0, 64, e.step_name, engine.history_digest(e),
+        np.zeros(64, np.int32), np.zeros(64, np.uint32),
+        np.zeros(64, np.uint32), np.arange(64) < 1, True, -1, 1, 0)
+    for a in cp.carry(dev):
+        assert a.devices() == {dev}, a.devices()
