@@ -45,11 +45,14 @@ def _events(cs: List[Call]) -> List[Tuple[int, int, int]]:
 
 def check_calls(model, cs: List[Call], n_history: int,
                 max_configs: int = 2_000_000,
-                deadline: Optional[float] = None) -> dict:
+                deadline: Optional[float] = None,
+                cancel=None) -> dict:
     """With `deadline` (a time.monotonic() instant), the search returns
     {"valid?": "unknown", "timeout": True, "events-done": k, ...} when
     the budget runs out — cooperative, checked once per return event,
-    so benchmark timeouts measure real search progress."""
+    so benchmark timeouts measure real search progress. `cancel` (a
+    threading.Event) is polled at the same points: a competition race
+    sets it when another arm already produced a decisive verdict."""
     import time as _time
     if not cs:
         return {"valid?": True, "configs": [], "final-paths": []}
@@ -63,6 +66,10 @@ def check_calls(model, cs: List[Call], n_history: int,
     for pos, kind, cid in _events(cs):
         if deadline is not None and _time.monotonic() > deadline:
             return {"valid?": "unknown", "timeout": True,
+                    "events-done": events_done, "explored": explored,
+                    "max-frontier": max_frontier}
+        if cancel is not None and cancel.is_set():
+            return {"valid?": "unknown", "error": "cancelled",
                     "events-done": events_done, "explored": explored,
                     "max-frontier": max_frontier}
         if kind == 0:
@@ -117,10 +124,10 @@ def check_calls(model, cs: List[Call], n_history: int,
 
 
 def analysis(model, history, max_configs: int = 2_000_000,
-             deadline: Optional[float] = None) -> dict:
+             deadline: Optional[float] = None, cancel=None) -> dict:
     """knossos.linear/analysis equivalent."""
     from jepsen_tpu.history import History, prune_wildcard_calls
     h = history if isinstance(history, History) else History.wrap(history)
     cs = prune_wildcard_calls(history_calls(h))
     return check_calls(model, cs, len(h), max_configs=max_configs,
-                       deadline=deadline)
+                       deadline=deadline, cancel=cancel)

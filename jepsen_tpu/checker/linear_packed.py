@@ -85,9 +85,20 @@ def _py_steps(step_name: str):
 
 
 def check_encoded(e, max_configs: int = 2_000_000,
-                  deadline: Optional[float] = None) -> dict:
+                  deadline: Optional[float] = None,
+                  cancel=None) -> dict:
     """Run the frontier search over an EncodedHistory on the host with
-    int configs. Same result shape as linear.check_calls (sans paths)."""
+    int configs. Same result shape as linear.check_calls (sans paths).
+    `cancel` (a threading.Event) is polled wherever the deadline is: a
+    competition race sets it when another arm already won."""
+    def _stop():
+        """Indecisive-return fields when the search must stop, else None
+        ("timeout" for a blown deadline, "cancelled" for a lost race)."""
+        if deadline is not None and _time.monotonic() > deadline:
+            return {"valid?": "unknown", "timeout": True}
+        if cancel is not None and cancel.is_set():
+            return {"valid?": "unknown", "error": "cancelled"}
+        return None
     from jepsen_tpu.parallel.encode import fail_op_fields
 
     step = _py_steps(e.step_name)
@@ -103,8 +114,9 @@ def check_encoded(e, max_configs: int = 2_000_000,
     C = len(slot_f[0]) if R else 0
 
     for r in range(R):
-        if deadline is not None and _time.monotonic() > deadline:
-            return {"valid?": "unknown", "timeout": True, "events-done": r,
+        stop = _stop()
+        if stop:
+            return {**stop, "events-done": r,
                     "explored": explored, "max-frontier": max_frontier}
         occ = [(j, slot_f[r][j], slot_a0[r][j], slot_a1[r][j],
                 slot_wild[r][j])
@@ -118,9 +130,9 @@ def check_encoded(e, max_configs: int = 2_000_000,
                     # stride deadline check: even ONE expansion round
                     # over a 2^k frontier must not overshoot unboundedly
                     next_check = explored + 131072
-                    if deadline is not None \
-                            and _time.monotonic() > deadline:
-                        return {"valid?": "unknown", "timeout": True,
+                    stop = _stop()
+                    if stop:
+                        return {**stop,
                                 "events-done": r, "explored": explored,
                                 "max-frontier": max(max_frontier,
                                                     len(configs))}
@@ -142,10 +154,11 @@ def check_encoded(e, max_configs: int = 2_000_000,
                         "error": f"config budget exceeded ({max_configs})",
                         "events-done": r, "explored": explored,
                         "max-frontier": max(max_frontier, len(configs))}
-            if deadline is not None and _time.monotonic() > deadline:
-                # mid-window deadline: a single wide window's expansion
-                # must not overshoot the budget unboundedly
-                return {"valid?": "unknown", "timeout": True,
+            stop = _stop()
+            if stop:
+                # mid-window stop check: a single wide window's
+                # expansion must not overshoot unboundedly
+                return {**stop,
                         "events-done": r, "explored": explored,
                         "max-frontier": max(max_frontier, len(configs))}
         max_frontier = max(max_frontier, len(configs))
@@ -163,7 +176,7 @@ def check_encoded(e, max_configs: int = 2_000_000,
 
 
 def analysis(model, history, max_configs: int = 2_000_000,
-             deadline: Optional[float] = None) -> dict:
+             deadline: Optional[float] = None, cancel=None) -> dict:
     """knossos-style (model, history) -> result, packed host engine.
     Raises EncodeError (via parallel.encode) for non-packable inputs —
     callers fall back to checker.linear / checker.wgl."""
@@ -171,4 +184,5 @@ def analysis(model, history, max_configs: int = 2_000_000,
     from jepsen_tpu.parallel import encode as enc_mod
     h = history if isinstance(history, History) else History.wrap(history)
     e = enc_mod.encode(model, h)
-    return check_encoded(e, max_configs=max_configs, deadline=deadline)
+    return check_encoded(e, max_configs=max_configs, deadline=deadline,
+                         cancel=cancel)

@@ -12,9 +12,12 @@ Mirrors the reference's algorithm dispatch (jepsen/src/jepsen/checker.clj:182-21
                    can't pack.
     "jax"          the TPU engine (jepsen_tpu.parallel.engine) — batched,
                    device-sharded frontier expansion; the north star
-    "competition"  jax when the model packs to fixed-width ints, else wgl
-                   (the reference's competition races linear vs wgl,
-                   checker.clj:199; here the race is decided statically)
+    "competition"  a REAL first-decisive-wins race (checker.competition),
+                   mirroring the reference's parallel linear-vs-wgl race
+                   (checker.clj:199, knossos.competition): packable
+                   models race jax + packed + wgl, others race
+                   linear + wgl. The host arms hedge a wedged device
+                   runtime; the losers are cooperatively cancelled.
 
 Results mirror knossos: {"valid?", "op", "final-paths", "configs",
 "analyzer"}. Like the reference, final-paths/configs are truncated to 10
@@ -60,11 +63,38 @@ class Linearizable(Checker):
                 "parsed with History.from_edn / op_from_edn?")
 
         if algo == "competition":
-            # decide statically: packable models race onto the device
+            from jepsen_tpu.checker import competition
             packable = model_ns.pack_spec(model, Intern()) is not None
-            algo = "jax" if packable and _engine_available() else "wgl"
+            if packable:
+                # host arms always race; the device arm only joins when
+                # the bounded probe says the runtime is usable (a wedged
+                # runtime would leak one stuck thread per check). A
+                # device arm orphaned by an EARLIER race and silent ever
+                # since is the mid-process wedge signature — skip the
+                # device arm while the suspicion lasts. It self-clears
+                # if the arm ever reports (a slow-but-healthy device
+                # rejoins later races; a wedged one never does).
+                suspect = competition.device_engine_suspect()
+                global _wedge_warned
+                if suspect and not _wedge_warned:
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "a device competition arm from an earlier check "
+                        "has been silent for >%.0fs — racing host arms "
+                        "only until it reports",
+                        competition.DEVICE_WEDGE_SUSPECT_SECS)
+                _wedge_warned = suspect
+                arms = (("jax", "packed", "wgl")
+                        if _engine_available() and not suspect
+                        else ("packed", "wgl"))
+            else:
+                arms = ("linear", "wgl")   # the reference's exact race
+            r = competition.analysis(
+                model, h, arms=arms,
+                timeout=(test or {}).get("competition-timeout"))
+            algo = r.get("analyzer", "competition")
 
-        if algo == "wgl":
+        elif algo == "wgl":
             from jepsen_tpu.checker import wgl
             r = wgl.analysis(model, h)
         elif algo == "linear":
@@ -125,13 +155,53 @@ class Linearizable(Checker):
         return r
 
 
-def _engine_available() -> bool:
-    try:
-        import jax
-        from jepsen_tpu.parallel import engine  # noqa: F401
-        return len(jax.devices()) > 0
-    except Exception:  # noqa: BLE001
-        return False
+_engine_probe_result: Optional[bool] = None
+_engine_probe: dict = {}   # in-flight probe: {"thread": t, "out": {...}}
+_wedge_warned = False   # one warning per suspicion episode, not per check
+
+
+def _engine_available(timeout: float = 15.0) -> bool:
+    """Whether the device engine can run — probed with a BOUNDED wait.
+
+    jax.devices() blocks forever inside PJRT client creation when the
+    device runtime is wedged (observed: TPU tunnel outages), and it
+    ignores Python signals — probing it inline would hang the check
+    before the competition race could hedge anything. The probe runs in
+    a daemon thread with a timeout instead; while it has not answered,
+    the engine is treated as unavailable (so races run host arms only).
+    Only an actual ANSWER is cached: a merely-slow first init (cold jax
+    import on a loaded host) that finishes after the timeout flips
+    later checks back to the device engine. One probe thread total —
+    later calls re-join the same thread briefly rather than piling a
+    new wedged thread onto every check."""
+    global _engine_probe_result
+    if _engine_probe_result is not None:
+        return _engine_probe_result
+    if not _engine_probe:
+        out: dict = {}
+
+        def probe():
+            try:
+                import jax
+                from jepsen_tpu.parallel import engine  # noqa: F401
+                out["ok"] = len(jax.devices()) > 0
+            except Exception:  # noqa: BLE001
+                out["ok"] = False
+
+        import threading
+        t = threading.Thread(target=probe, daemon=True,
+                             name="engine-availability-probe")
+        t.start()
+        _engine_probe.update(thread=t, out=out)
+        _engine_probe["thread"].join(timeout)
+    else:
+        # an earlier call already paid the full wait; just peek
+        _engine_probe["thread"].join(0.1)
+    out = _engine_probe["out"]
+    if "ok" in out:
+        _engine_probe_result = bool(out["ok"])
+        return _engine_probe_result
+    return False
 
 
 def linearizable(model=None, algorithm: str = "competition") -> Linearizable:

@@ -105,12 +105,16 @@ class _StepOp:
 
 def check_calls(model, cs: List[Call], n_history: int,
                 max_states: int = 50_000_000,
-                deadline: Optional[float] = None) -> dict:
+                deadline: Optional[float] = None,
+                cancel=None) -> dict:
     """Run WGL over prepared calls. Returns a knossos-shaped result.
     With `deadline` (a time.monotonic() instant) the search returns
     `{"valid?": "unknown", "timeout": True}` when it runs past it —
     the same cooperative contract as checker.linear — checked every
-    4096 explored states so the overshoot is bounded."""
+    4096 explored states so the overshoot is bounded. `cancel` (a
+    threading.Event) is polled at the same stride: a competition race
+    sets it when another arm has already produced a decisive verdict
+    (knossos competition/analysis future-cancel parity)."""
     m = len(cs)
     if m == 0:
         return {"valid?": True, "configs": [], "final-paths": []}
@@ -159,10 +163,13 @@ def check_calls(model, cs: List[Call], n_history: int,
                 return {"valid?": "unknown",
                         "error": f"state budget exceeded ({max_states})",
                         "explored": explored}
-            if deadline is not None and (explored & 0xFFF) == 0 \
-                    and _time.monotonic() > deadline:
-                return {"valid?": "unknown", "error": "deadline",
-                        "timeout": True, "explored": explored}
+            if (explored & 0xFFF) == 0:
+                if deadline is not None and _time.monotonic() > deadline:
+                    return {"valid?": "unknown", "error": "deadline",
+                            "timeout": True, "explored": explored}
+                if cancel is not None and cancel.is_set():
+                    return {"valid?": "unknown", "error": "cancelled",
+                            "explored": explored}
             key = (s2, linearized | (1 << cid))
             if not model_ns.is_inconsistent(s2) and key not in visited:
                 visited.add(key)
@@ -223,15 +230,16 @@ def _invalid_result(model, best_path, best_stuck, explored, state, linearized,
 
 
 def analysis(model, history, max_states: int = 50_000_000,
-             deadline: Optional[float] = None) -> dict:
+             deadline: Optional[float] = None, cancel=None) -> dict:
     """knossos.wgl/analysis equivalent: (model, history) -> result.
 
     History may be a `History` or plain list of op dicts; invocations are
     paired/completed internally. `deadline` is a time.monotonic()
-    instant for the cooperative timeout (see check_calls).
+    instant for the cooperative timeout; `cancel` a threading.Event
+    polled at the same stride (see check_calls).
     """
     from jepsen_tpu.history import History, prune_wildcard_calls
     h = history if isinstance(history, History) else History.wrap(history)
     cs = prune_wildcard_calls(history_calls(h))
     return check_calls(model, cs, len(h), max_states=max_states,
-                       deadline=deadline)
+                       deadline=deadline, cancel=cancel)

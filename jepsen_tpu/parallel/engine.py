@@ -461,7 +461,7 @@ def analysis(model, history, capacity: int = 1024,
     else:
         r = check_encoded(e, capacity=capacity, max_capacity=max_capacity)
     if r["valid?"] is False:
-        r.update(extract_final_paths(model, e, int(r["fail-event"])))
+        apply_final_paths(r, model, e)
     return r
 
 
@@ -473,6 +473,73 @@ def analysis(model, history, capacity: int = 1024,
 # never search whole, a window ending at the failure is the useful part)
 PATHS_WINDOW_EVENTS = 64
 PATHS_MAX_SEEDS = 8
+
+# Bounds for the full-host recheck run when the host path re-search
+# CONTRADICTS a device-invalid (below): big enough to decide any key a
+# per-key batch realistically carries, small enough that a pathological
+# key cannot stall the checker.
+DISAGREEMENT_RECHECK_MAX_STATES = 5_000_000
+DISAGREEMENT_RECHECK_SECS = 30.0
+
+
+def _disagreement_recheck(model, e: EncodedHistory, note: str) -> dict:
+    """The host re-search contradicted a device-invalid. Before shipping
+    "invalid, no paths", re-check the WHOLE key host-side under a
+    bounded budget: a device false-invalid must not become the verdict
+    when the host can decide the key. Decisive host verdicts win (WGL
+    searches exhaustively; the device engine's approximations — padded
+    slots, packed states — are the suspect side of a disagreement). An
+    over-budget recheck keeps the device verdict, tagged."""
+    import logging
+    import time as _time
+
+    from jepsen_tpu.checker import wgl
+    log = logging.getLogger(__name__)
+    n_history = max(c.complete_index for c in e.calls) + 1
+    host = wgl.check_calls(
+        model, list(e.calls), n_history,
+        max_states=DISAGREEMENT_RECHECK_MAX_STATES,
+        deadline=_time.monotonic() + DISAGREEMENT_RECHECK_SECS)
+    if host.get("valid?") is False:
+        # the key IS invalid — the disagreement was about the failure
+        # site; take the host's whole failure report so op/paths/configs
+        # describe one consistent stuck point
+        out = {"final-paths": host.get("final-paths", []),
+               "configs": host.get("configs", []),
+               "engine-disagreement": note + "; full-host recheck "
+                                             "confirms invalid"}
+        if host.get("op"):
+            out["op"] = host["op"]
+        return out
+    if host.get("valid?") is True:
+        log.error("device engine false-invalid: %s, and the bounded "
+                  "full-host recheck says VALID — overriding the device "
+                  "verdict (this may hide a device-engine bug; please "
+                  "report the history)", note)
+        return {"valid?": True, "final-paths": [], "configs": [],
+                "engine-disagreement": note + "; full-host recheck says "
+                                              "valid — device verdict "
+                                              "overridden"}
+    log.warning("final-paths: %s; the bounded full-host recheck was "
+                "indecisive (%s) — keeping the device verdict",
+                note, host.get("error", "?"))
+    return {"final-paths": [], "configs": [],
+            "final-paths-note": note + "; bounded full-host recheck "
+                                       "indecisive — device verdict "
+                                       "kept"}
+
+
+def apply_final_paths(r: dict, model, e: EncodedHistory) -> dict:
+    """Merge extract_final_paths into a device-invalid result `r`, in
+    place. When the disagreement recheck OVERRIDES the verdict to
+    valid, the device's stale counterexample fields are dropped — a
+    valid result must not carry a phantom failing op."""
+    fp = extract_final_paths(model, e, int(r["fail-event"]))
+    if fp.get("valid?") is True:
+        for k in ("op", "fail-event"):
+            r.pop(k, None)
+    r.update(fp)
+    return r
 
 
 def extract_final_paths(model, e: EncodedHistory, fail_r: int,
@@ -494,13 +561,13 @@ def extract_final_paths(model, e: EncodedHistory, fail_r: int,
         if host.get("valid?") is False:
             return {"final-paths": host.get("final-paths", []),
                     "configs": host.get("configs", [])}
-        import logging
-        logging.getLogger(__name__).warning(
-            "final-paths: host re-search of the failing prefix came back "
-            "valid while the device said invalid — engine disagreement")
-        return {"final-paths": [], "configs": [],
-                "final-paths-note": "host re-search of failing prefix "
-                                    "disagreed (valid)"}
+        # the host can linearize the prefix the device failed on:
+        # escalate to a bounded full-host recheck of the key rather
+        # than shipping "invalid, no paths" on a possible device
+        # false-invalid
+        return _disagreement_recheck(
+            model, e, "host re-search of the failing prefix came back "
+                      "valid while the device said invalid")
 
     import logging
     log = logging.getLogger(__name__)
@@ -540,18 +607,33 @@ def extract_final_paths(model, e: EncodedHistory, fail_r: int,
                 if start_ev > 0 else -1)
     paths: list = []
     configs: list = []
-    for stc, linearized in seeds:
+    # Every sampled seed runs BEFORE any paths are trusted: a failing
+    # seed may just be a dead-end config (reachable but unextendable —
+    # normal in a valid history), while a seed that linearizes through
+    # the failure proves a valid linearization of the whole prefix
+    # EXISTS — a direct contradiction of the device's
+    # empty-frontier-at-fail_r. Only an all-seeds-fail outcome
+    # corroborates the device verdict.
+    for seed_i, (stc, linearized) in enumerate(seeds):
         seed_model = spec.unpack_state(stc, e.intern)
         cs = _window_calls(e.calls, boundary, fail_idx, linearized)
         host = wgl.check_calls(seed_model, cs, fail_idx + 1)
+        if host.get("valid?") is True:
+            return _disagreement_recheck(
+                model, e, "window re-search from device seed %d "
+                          "linearized through the failure "
+                          "(window [%d, %d])"
+                          % (seed_i, start_ev, fail_r))
         if host.get("valid?") is False:
             paths.extend(host.get("final-paths", []))
             configs.extend(host.get("configs", []))
-        if len(paths) >= 10:
-            break
     if not paths:
-        return _empty("all %d window re-searches from device seeds came "
-                      "back valid (window [%d, %d])"
+        # no seed failed and none decisively linearized either (all
+        # indecisive): the window/seed machinery itself may be the
+        # wrong side, so the recheck covers the whole key
+        return _disagreement_recheck(
+            model, e, "none of the %d window re-searches from device "
+                      "seeds produced a verdict (window [%d, %d])"
                       % (len(seeds), start_ev, fail_r))
     out = {"final-paths": paths[:10], "configs": configs[:10]}
     if start_ev > 0:

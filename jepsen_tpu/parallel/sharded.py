@@ -590,5 +590,5 @@ def analysis(model, history, mesh: Mesh, capacity: int = 8192,
     r = check_encoded_sharded(e, mesh, capacity=capacity,
                               max_capacity=max_capacity, exchange=exchange)
     if r["valid?"] is False:
-        r.update(engine.extract_final_paths(model, e, int(r["fail-event"])))
+        engine.apply_final_paths(r, model, e)
     return r

@@ -642,9 +642,12 @@ def test_dispatcher_jax_route():
     )
     r = linearizable(Register(), algorithm="jax").check({}, h)
     assert r["valid?"] is True and r["analyzer"] == "jax"
-    # competition now resolves to jax (engine importable, devices present)
+    # competition now RACES jax/packed/wgl — first decisive wins
     r = linearizable(Register(), algorithm="competition").check({}, h)
-    assert r["analyzer"] == "jax"
+    assert r["valid?"] is True
+    assert r["analyzer"] in ("jax", "packed", "wgl")
+    assert r["competition"]["winner"] == r["analyzer"]
+    assert r["competition"]["arms"] == ["jax", "packed", "wgl"]
     # packed: the int-config host engine behind the same boundary
     r = linearizable(Register(), algorithm="packed").check({}, h)
     assert r["valid?"] is True and r["analyzer"] == "packed"
@@ -795,3 +798,68 @@ def test_check_encoded_explicit_device_placement():
         np.zeros(64, np.uint32), np.arange(64) < 1, True, -1, 1, 0)
     for a in cp.carry(dev):
         assert a.devices() == {dev}, a.devices()
+
+def test_device_false_invalid_escalates_to_host_recheck(monkeypatch):
+    """A fabricated device-invalid on a genuinely valid key must END in
+    the correct verdict: the host prefix re-search contradicts the
+    device, the bounded full-host recheck decides valid, and the device
+    verdict is overridden (tagged engine-disagreement) instead of
+    shipping "invalid, no paths"."""
+    from jepsen_tpu.histories import rand_register_history
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.parallel import bitdense
+
+    model = CASRegister()
+    h = rand_register_history(n_ops=60, n_processes=4, crash_p=0.01,
+                              fail_p=0.05, seed=21)
+    e = enc_mod.encode(model, h)
+    fake = {"valid?": False, "engine": "bitdense",
+            "fail-event": e.n_returns - 1}
+    fake.update(enc_mod.fail_op_fields(e, e.n_returns - 1))
+    monkeypatch.setattr(bitdense, "check_encoded_bitdense",
+                        lambda *a, **k: dict(fake))
+    r = engine.analysis(model, h)
+    assert r["valid?"] is True, r
+    assert "engine-disagreement" in r, r
+    assert "overridden" in r["engine-disagreement"]
+    # the device's stale counterexample fields must not survive on a
+    # valid verdict
+    assert "op" not in r and "fail-event" not in r, r
+
+
+def test_device_false_invalid_long_history_window_branch():
+    """Same escalation through the >500-call window/seed machinery: a
+    fabricated fail event on a valid key means SOME frontier seed
+    linearizes its window through the "failure" — that contradiction
+    must escalate to the recheck, not ship near-miss paths from the
+    dead-end seeds. max_seeds covers the whole frontier here so the
+    surviving lineage is guaranteed to be sampled (at the default 8 the
+    outcome would depend on frontier row order)."""
+    from jepsen_tpu.histories import rand_register_history
+    from jepsen_tpu.models import CASRegister
+
+    model = CASRegister()
+    h = rand_register_history(n_ops=700, n_processes=4, crash_p=0.005,
+                              fail_p=0.03, seed=9)
+    e = enc_mod.encode(model, h)
+    assert e.n_calls > 500
+    r = engine.extract_final_paths(model, e, e.n_returns - 1,
+                                   max_seeds=1024)
+    assert r.get("valid?") is True, r
+    assert "engine-disagreement" in r
+
+
+def test_indecisive_recheck_keeps_device_verdict(monkeypatch):
+    """When the bounded recheck cannot decide (budget exhausted), the
+    device verdict stands, tagged — never silently flipped."""
+    from jepsen_tpu.histories import rand_register_history
+    from jepsen_tpu.models import CASRegister
+
+    model = CASRegister()
+    h = rand_register_history(n_ops=60, n_processes=4, crash_p=0.01,
+                              fail_p=0.05, seed=21)
+    e = enc_mod.encode(model, h)
+    monkeypatch.setattr(engine, "DISAGREEMENT_RECHECK_MAX_STATES", 1)
+    r = engine.extract_final_paths(model, e, e.n_returns - 1)
+    assert "valid?" not in r           # verdict untouched
+    assert "recheck indecisive" in r.get("final-paths-note", ""), r
