@@ -152,7 +152,7 @@ def run_analyze_cmd(test_fn: Callable[[Dict], Dict], args) -> int:
     stored = jstore.load_run(run_dir)
     history = stored.get("history")
     if history is None:
-        print(f"no history.edn under {run_dir}", file=sys.stderr)
+        print(f"no history.npz/history.edn under {run_dir}", file=sys.stderr)
         return EXIT_BAD_ARGS
     opts = options_from_args(args)
     test = test_fn(opts)

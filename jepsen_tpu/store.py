@@ -83,8 +83,13 @@ class Store:
 
     # ------------------------------------------------------------ saves
     def save_1(self, test: Dict, history: History):
-        """History + test map — before analysis (store.clj:372-383)."""
+        """History + test map — before analysis (store.clj:372-383).
+        history.npz is the columnar binary sidecar (Fressian parity:
+        the reference stores binary history for fast reload,
+        store.clj:31-116); history.edn stays the canonical
+        interchange format."""
         history.save(self.path("history.edn"))
+        history.save_npz(self.path("history.npz"))
         self.write_file(["history.txt"],
                         "\n".join(_op_line(o) for o in history) + "\n")
         self.write_file(["test.json"],
@@ -187,8 +192,29 @@ def load_run(run_dir: str) -> Dict[str, Any]:
     if os.path.exists(tpath):
         with open(tpath) as fh:
             out["test"] = json.load(fh)
+    # prefer the columnar sidecar: reload is numpy-speed, no EDN parse
+    # (a 50k-op re-analyze otherwise pays seconds of parsing) — with a
+    # loud fallback to the canonical EDN if the sidecar is unreadable.
+    # A sidecar OLDER than the EDN is stale (the canonical file was
+    # rewritten after the run — e.g. a hand-corrected replay) and is
+    # skipped so the edit is not silently shadowed.
+    npath = os.path.join(run_dir, "history.npz")
     hpath = os.path.join(run_dir, "history.edn")
-    if os.path.exists(hpath):
+    if (os.path.exists(npath) and os.path.exists(hpath)
+            and os.path.getmtime(npath) < os.path.getmtime(hpath)):
+        logging.getLogger(__name__).warning(
+            "history.npz is older than history.edn — using the EDN "
+            "(rewrite the sidecar with History.save_npz to re-enable "
+            "fast reload)")
+        npath = None
+    if npath and os.path.exists(npath):
+        try:
+            out["history"] = History.load_npz(npath)
+        except Exception as err:  # noqa: BLE001
+            logging.getLogger(__name__).warning(
+                "history.npz unreadable (%r) — falling back to "
+                "history.edn", err)
+    if "history" not in out and os.path.exists(hpath):
         out["history"] = History.load(hpath)
     rpath = os.path.join(run_dir, "results.json")
     if os.path.exists(rpath):
