@@ -316,11 +316,13 @@ def check_batch_bitdense(encs, mesh=None, use_pallas: bool = None) -> list:
                 else jax.default_backend())
     if use_pallas is None and mesh is not None \
             and is_tpu_platform(platform):
-        # a non-interpret pallas_call over a key-sharded batch has no
-        # exercised SPMD partitioning path — the DEFAULT (env-flag)
-        # route keeps mesh-sharded TPU batches on XLA until that
-        # lowering is measured on hardware; an explicit use_pallas=True
-        # is honored (that is how the measurement will be taken)
+        # the key-sharded pallas lowering is differential-tested on the
+        # CPU mesh (tests/test_pallas.py: shard_map interpret + the
+        # sharded-batch differential) but has never been MEASURED
+        # non-interpret on hardware — the DEFAULT (env-flag) route
+        # keeps mesh-sharded TPU batches on XLA until then; an explicit
+        # use_pallas=True is honored (how the measurement will be
+        # taken)
         use_pallas = False
     use_pallas, interpret = _resolve_use_pallas(use_pallas, S, C, platform)
     valid, fail_r = _check_bitdense_batch(xs, state0, step_name, S, C,
