@@ -270,18 +270,3 @@ class _KeyedAtomClient(AtomClient):
                     o["type"] = "fail"
         return o
 
-
-def test_docker_compose_files_parse():
-    """The docker harness can't run in CI, but its compose files (base +
-    dev and ubuntu overlays) must at least stay structurally valid —
-    every service in an overlay must exist in the base topology."""
-    import yaml
-
-    d = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "docker")
-    base = yaml.safe_load(open(os.path.join(d, "docker-compose.yml")))
-    assert set(base["services"]) == {"control", "n1", "n2", "n3", "n4",
-                                     "n5"}
-    for overlay in ("docker-compose.dev.yml", "docker-compose.ubuntu.yml"):
-        o = yaml.safe_load(open(os.path.join(d, overlay)))
-        assert set(o["services"]) <= set(base["services"]), overlay
