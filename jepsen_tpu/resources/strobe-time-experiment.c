@@ -1,0 +1,99 @@
+/* strobe-time-experiment: phase-locked strobe of the system wall clock.
+ *
+ * Capability parallel of the reference's
+ * jepsen/resources/strobe-time-experiment.c:1-205 (its experimental
+ * variant of strobe-time, not wired into the nemesis): oscillate the
+ * wall clock by +/- delta (ms), flipping every period (ms), for
+ * duration (s) — but with ticks PHASE-LOCKED to the monotonic clock:
+ * flip k fires at exactly anchor + k*period, by sleeping the remaining
+ * distance to the next tick each cycle. A plain sleep(period) loop
+ * (strobe-time.c) drifts by the per-iteration syscall cost; over a
+ * long strobe the flip frequency sags below 1/period. Phase-locking
+ * keeps the long-run flip rate exact, which matters when the strobe
+ * period is tuned against a system's clock-sanity window.
+ *
+ * Like strobe-time.c, the schedule runs on CLOCK_MONOTONIC (immune to
+ * our own wall-clock writes) and the flip count is evened out before
+ * exit, so a completed strobe is net-zero skew.
+ *
+ * Exit codes: 0 ok, 1 bad usage, 2 clock syscall failed (needs root).
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+#include <sys/time.h>
+
+static long long NS_PER_MS = 1000000LL;
+
+static long long mono_ns(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+static int shift_wall_clock(long long delta_ms) {
+  struct timeval tv;
+  if (gettimeofday(&tv, NULL) != 0) return -1;
+  long long usec = (long long)tv.tv_sec * 1000000LL + tv.tv_usec
+                   + delta_ms * 1000LL;
+  tv.tv_sec  = usec / 1000000LL;
+  tv.tv_usec = usec % 1000000LL;
+  if (tv.tv_usec < 0) {
+    tv.tv_usec += 1000000LL;
+    tv.tv_sec  -= 1;
+  }
+  return settimeofday(&tv, NULL);
+}
+
+/* Sleep until the given monotonic instant (ns); resumes after EINTR. */
+static void sleep_until_mono(long long target_ns) {
+  for (;;) {
+    long long now = mono_ns();
+    if (target_ns <= now) return;
+    long long left = target_ns - now;
+    struct timespec nap = {left / 1000000000LL, left % 1000000000LL};
+    if (nanosleep(&nap, NULL) == 0) return;
+  }
+}
+
+int main(int argc, char **argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s <delta-ms> <period-ms> <duration-s>\n",
+            argv[0]);
+    return 1;
+  }
+  long long delta_ms  = strtoll(argv[1], NULL, 10);
+  long long period_ms = strtoll(argv[2], NULL, 10);
+  double    duration  = strtod(argv[3], NULL);
+  if (period_ms < 1) period_ms = 1;
+
+  long long period_ns = period_ms * NS_PER_MS;
+  long long anchor    = mono_ns();
+  long long end       = anchor + (long long)(duration * 1e9);
+  long long flips     = 0;
+  int       sign      = 1;
+
+  /* tick k fires at anchor + k*period: the sleep target is computed
+   * from the anchor, never from "now + period", so per-iteration cost
+   * cannot accumulate into drift */
+  for (long long k = 1; ; k++) {
+    long long tick = anchor + k * period_ns;
+    if (end < tick) break;
+    sleep_until_mono(tick);
+    if (shift_wall_clock(sign * delta_ms) != 0) {
+      perror("settimeofday");
+      return 2;
+    }
+    sign = -sign;
+    flips++;
+  }
+
+  if (flips % 2 == 1) { /* undo the dangling half-cycle */
+    if (shift_wall_clock(sign * delta_ms) != 0) {
+      perror("settimeofday");
+      return 2;
+    }
+  }
+  fprintf(stderr, "strobe-time-experiment: %lld flips\n", flips);
+  return 0;
+}
