@@ -108,6 +108,20 @@ def is_tpu_platform(platform: str) -> bool:
     return platform in ("tpu", "axon")
 
 
+def _resolve_closure_mode(closure_mode):
+    """XLA closure loop shape: "while" (converge-and-stop; extra
+    device-visible `changed` reduction per iteration) or "fori" (fixed
+    ceil(C/2) double-expansions; no convergence sync — the per-event
+    cost on tiny tensors is suspected to be dispatch/sync latency, and
+    only a hardware A/B (tools/perf_ab.py) gets to flip the default).
+    Env override: JEPSEN_TPU_CLOSURE=fori."""
+    if closure_mode is None:
+        closure_mode = os.environ.get("JEPSEN_TPU_CLOSURE", "while")
+    if closure_mode not in ("while", "fori"):
+        raise ValueError(f"unknown closure mode {closure_mode!r}")
+    return closure_mode
+
+
 def _resolve_use_pallas(use_pallas, S: int, C: int, platform: str):
     """Shared gate for the single and batch paths: default from the
     JEPSEN_TPU_PALLAS=1 env flag, downgraded to False for shapes the
@@ -126,7 +140,8 @@ def _resolve_use_pallas(use_pallas, S: int, C: int, platform: str):
 
 def _bitdense_impl(xs, state0, step_name: str, S: int, C: int,
                    lo: int = -1, use_pallas: bool = False,
-                   pallas_interpret: bool = True):
+                   pallas_interpret: bool = True,
+                   closure_mode: str = "while"):
     step = STEPS[step_name]
     W, plan = _plan(C)
     state_codes = jnp.arange(S, dtype=jnp.int32) + lo
@@ -167,7 +182,7 @@ def _bitdense_impl(xs, state0, step_name: str, S: int, C: int,
             legal[:, :, None] & ((nxt - lo)[:, :, None] == t_idx[None, None, :]),
             FULL, U32(0))                                      # [C, S, S]
 
-    def make_closure_body(sel):
+    def make_expand(sel):
         def expand(B):
             # intra-word slots: ext[j,s,w] = B & clr5[j]; G[j,t,w] =
             # OR_s ext & sel; contribution = (G & clr5) << (1 << j)
@@ -184,6 +199,10 @@ def _bitdense_impl(xs, state0, step_name: str, S: int, C: int,
                     gw, jnp.broadcast_to(fwd[:, None, :], gw.shape), axis=2)
                 out = out | _or_over(moved & setw[:, None, :], 0)
             return out
+        return expand
+
+    def make_closure_body(sel):
+        expand = make_expand(sel)
 
         def body(c):
             B, _ = c
@@ -227,6 +246,17 @@ def _bitdense_impl(xs, state0, step_name: str, S: int, C: int,
                 lambda b: pk.closure_call(sel, b, C,
                                           interpret=pallas_interpret),
                 lambda b: b, B)
+        elif closure_mode == "fori":
+            # fixed trip count, no convergence check: the fixpoint is
+            # reached in <= C single expansions (each round adds every
+            # one-step extension; chains are at most C slots long), so
+            # ceil(C/2) double-expansion bodies always suffice. Trades
+            # wasted post-convergence expansions for the removal of the
+            # per-iteration `changed` reduction + cond sync. Pad events
+            # need no guard: their sel is all-zero, expand is identity.
+            expand = make_expand(sel)
+            B2 = lax.fori_loop(0, (C + 1) // 2,
+                               lambda _, b: expand(expand(b)), B)
         else:
             B2, _ = lax.while_loop(closure_cond, make_closure_body(sel),
                                    (B, run))
@@ -249,22 +279,26 @@ def _bitdense_impl(xs, state0, step_name: str, S: int, C: int,
 _check_bitdense = jax.jit(_bitdense_impl,
                           static_argnames=("step_name", "S", "C", "lo",
                                            "use_pallas",
-                                           "pallas_interpret"))
+                                           "pallas_interpret",
+                                           "closure_mode"))
 
 
 @functools.partial(jax.jit,
                    static_argnames=("step_name", "S", "C", "lo",
-                                    "use_pallas", "pallas_interpret"))
+                                    "use_pallas", "pallas_interpret",
+                                    "closure_mode"))
 def _check_bitdense_batch(xs, state0, step_name: str, S: int, C: int,
                           lo: int = -1, use_pallas: bool = False,
-                          pallas_interpret: bool = True):
+                          pallas_interpret: bool = True,
+                          closure_mode: str = "while"):
     # under vmap the per-event lax.cond around the pallas closure
     # becomes run-both-and-select, so pad events cost one extra kernel
     # run per key — harmless: their result is discarded by the select
     return jax.vmap(
         lambda x, s0: _bitdense_impl(x, s0, step_name, S, C, lo,
                                      use_pallas=use_pallas,
-                                     pallas_interpret=pallas_interpret)
+                                     pallas_interpret=pallas_interpret,
+                                     closure_mode=closure_mode)
     )(xs, state0)
 
 
@@ -273,11 +307,14 @@ def n_states(e: EncodedHistory) -> int:
 
 
 def check_encoded_bitdense(e: EncodedHistory,
-                           use_pallas: bool = None) -> dict:
+                           use_pallas: bool = None,
+                           closure_mode: str = None) -> dict:
     """Single-key bit-packed check. `use_pallas` routes the closure
     through the VMEM-resident pallas kernel (parallel.pallas_kernels);
     default: the JEPSEN_TPU_PALLAS=1 env flag, and only for shapes the
-    kernel supports (the same flag also governs the batch path)."""
+    kernel supports (the same flag also governs the batch path).
+    `closure_mode` picks the XLA loop shape ("while"/"fori", see
+    _resolve_closure_mode); ignored when pallas runs."""
     if e.n_returns == 0:
         return {"valid?": True, "engine": "bitdense"}
     from jepsen_tpu.parallel.dense import _xs_dense
@@ -285,26 +322,30 @@ def check_encoded_bitdense(e: EncodedHistory,
     C = max(5, e.n_slots)  # at least one full word
     use_pallas, interpret = _resolve_use_pallas(
         use_pallas, S, C, jax.default_backend())
+    closure_mode = _resolve_closure_mode(closure_mode)
     valid, fail_r = _check_bitdense(_xs_dense(e, C), jnp.int32(e.state0),
                                     e.step_name, S, C, e.state_lo,
-                                    use_pallas, interpret)
+                                    use_pallas, interpret, closure_mode)
     out = {"valid?": bool(valid), "engine": "bitdense",
            "states": S, "slots": C,
-           "closure": "pallas" if use_pallas else "xla"}
+           "closure": "pallas" if use_pallas
+           else f"xla-{closure_mode}"}
     if not out["valid?"]:
         from jepsen_tpu.parallel.encode import fail_op_fields
         out.update(fail_op_fields(e, int(fail_r)))
     return out
 
 
-def check_batch_bitdense(encs, mesh=None, use_pallas: bool = None) -> list:
+def check_batch_bitdense(encs, mesh=None, use_pallas: bool = None,
+                         closure_mode: str = None) -> list:
     """Batched per-key check. Callers must ensure the COMBINED padded
     dims fit (fits_bitdense(max S, max C)) — individually-fitting keys
     can combine into an over-budget program; engine.check_batch does
     this check and falls back to per-key dispatch otherwise.
     `use_pallas` routes each key's closure through the VMEM-resident
     kernel (vmapped over keys); default: the JEPSEN_TPU_PALLAS=1 env
-    flag, gated to shapes the kernel supports at the PADDED dims."""
+    flag, gated to shapes the kernel supports at the PADDED dims.
+    `closure_mode` picks the XLA loop shape ("while"/"fori")."""
     if not encs:
         return []
     from jepsen_tpu.parallel.encode import pad_batch
@@ -325,12 +366,13 @@ def check_batch_bitdense(encs, mesh=None, use_pallas: bool = None) -> list:
         # taken)
         use_pallas = False
     use_pallas, interpret = _resolve_use_pallas(use_pallas, S, C, platform)
+    closure_mode = _resolve_closure_mode(closure_mode)
     valid, fail_r = _check_bitdense_batch(xs, state0, step_name, S, C,
                                           encs[0].state_lo, use_pallas,
-                                          interpret)
+                                          interpret, closure_mode)
     valid = np.asarray(valid)
     fail_r = np.asarray(fail_r)
-    closure = "pallas" if use_pallas else "xla"
+    closure = "pallas" if use_pallas else f"xla-{closure_mode}"
     out = []
     for k, e in enumerate(encs):
         r = {"valid?": bool(valid[k]), "engine": "bitdense",

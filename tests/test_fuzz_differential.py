@@ -22,7 +22,7 @@ from time import monotonic
 import numpy as np
 import pytest
 
-from jepsen_tpu.checker import linear, linear_packed, wgl
+from jepsen_tpu.checker import competition, linear, linear_packed, wgl
 from jepsen_tpu.histories import (
     corrupt_history, rand_fifo_history, rand_gset_history,
     rand_queue_history, rand_register_history)
@@ -131,7 +131,12 @@ def test_fuzz_engines_agree_with_wgl(name, Model, gen):
             if oracle == "unknown":
                 continue
             engines = {"linear": lambda: linear.analysis(
-                model, h, deadline=monotonic() + 10)}
+                model, h, deadline=monotonic() + 10),
+                # the full first-decisive-wins race (jax+packed+wgl or
+                # linear+wgl): whatever arm wins must agree with the
+                # oracle — this is the DEFAULT analyzer users get
+                "competition": lambda: competition.analysis(
+                    model, h, timeout=30)}
             try:
                 e = enc_mod.encode(model, h)
             except enc_mod.EncodeError:
@@ -169,3 +174,54 @@ def test_fuzz_engines_agree_with_wgl(name, Model, gen):
                                      f"oracle={oracle} got={got}", ""))
     assert not failures, failures
     assert runs > 0
+
+
+@pytest.mark.fuzz
+def test_fuzz_fake_device_invalid_ends_in_correct_verdict():
+    """Randomized disagreement-escalation sweep (VERDICT r3 next#7): a
+    fabricated device-invalid at a random fail event of a genuinely
+    VALID history must end in the correct verdict via the host
+    escalation ladder — never ship counterexample paths for a valid
+    key. max_seeds covers the whole frontier so the surviving lineage
+    is always sampled (the bounded default is sampling-dependent)."""
+    from jepsen_tpu.models import CASRegister
+
+    failures = []
+    for seed in range(max(3, N_SEEDS)):
+        rng = np.random.default_rng(1000 + seed)
+        # alternate between the short-history whole-prefix branch
+        # (<= 500 calls) and the windowed device-seeded branch (> 500
+        # calls) — the latter is where a fabricated invalid could ship
+        # fake paths from dead-end seeds, and where max_seeds matters.
+        # The long size is FIXED so the frontier re-scan's compiled
+        # shapes repeat across seeds (each distinct chunk length is a
+        # fresh XLA CPU compile; random lengths made this tier crawl)
+        long_branch = seed % 2 == 1
+        n_ops = 1100 if long_branch else int(rng.integers(60, 140))
+        # the long branch keeps crash_p low: every crashed call stays
+        # an open slot forever, and ~30 open slots make the frontier
+        # re-scan TPU-sized (capacity tiers to 2^20) — fine on a chip,
+        # unaffordable in a CPU fuzz iteration
+        h = rand_register_history(n_ops=n_ops, n_processes=4,
+                                  n_values=3,
+                                  crash_p=0.005 if long_branch else 0.03,
+                                  fail_p=0.05, seed=2000 + seed)
+        model = CASRegister()
+        oracle = wgl.analysis(model, h, max_states=1_000_000,
+                              deadline=monotonic() + 8)["valid?"]
+        if oracle is not True:
+            continue
+        e = enc_mod.encode(model, h)
+        n_samples = 1 if long_branch else min(3, e.n_returns)
+        for fail_r in rng.choice(e.n_returns, size=n_samples,
+                                 replace=False):
+            r = engine.extract_final_paths(model, e, int(fail_r),
+                                           max_seeds=4096)
+            if r.get("valid?") is True:
+                continue                      # overridden: correct
+            if "final-paths-note" in r and not r.get("final-paths"):
+                continue                      # indecisive, no fake paths
+            failures.append((seed, int(fail_r), n_ops,
+                             {k: r[k] for k in r
+                              if k != "final-paths"}))
+    assert not failures, failures

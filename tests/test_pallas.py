@@ -91,7 +91,7 @@ def test_bitdense_pallas_path_differential():
         (bitdense.n_states(e), e.n_slots)
     r_xla = bitdense.check_encoded_bitdense(e, use_pallas=False)
     r_pl = bitdense.check_encoded_bitdense(e, use_pallas=True)
-    assert r_pl["closure"] == "pallas" and r_xla["closure"] == "xla"
+    assert r_pl["closure"] == "pallas" and r_xla["closure"] == "xla-while"
     assert r_xla["valid?"] is r_pl["valid?"] is True
 
     # invalid: impossible read appended
@@ -129,7 +129,7 @@ def test_batch_pallas_path_differential():
 
     rs_xla = bitdense.check_batch_bitdense(encs, use_pallas=False)
     rs_pl = bitdense.check_batch_bitdense(encs, use_pallas=True)
-    assert all(r["closure"] == "xla" for r in rs_xla)
+    assert all(r["closure"] == "xla-while" for r in rs_xla)
     assert all(r["closure"] == "pallas" for r in rs_pl)
     assert [r["valid?"] for r in rs_xla] == [True, True, True, False]
     for rx, rp in zip(rs_xla, rs_pl):
@@ -239,4 +239,38 @@ def test_batch_pallas_on_mesh_differential():
                          {"JEPSEN_TPU_PALLAS": "1"}),             mock.patch.object(bitdense, "is_tpu_platform",
                               side_effect=lambda p: True):
         rs_default = bitdense.check_batch_bitdense(encs, mesh=mesh)
-    assert all(r["closure"] == "xla" for r in rs_default)
+    assert all(r["closure"].startswith("xla") for r in rs_default)
+
+
+def test_fori_closure_mode_differential():
+    """The fixed-trip fori closure must be verdict- and fail-event-
+    equal to the converge-and-stop while closure (its trip bound
+    ceil(C/2) double-expansions is a worst-case convergence proof — a
+    wrong bound shows up here as a missed expansion on deep chains)."""
+    from jepsen_tpu.histories import (adversarial_register_history,
+                                      rand_fifo_history)
+    from jepsen_tpu.models import CASRegister, FIFOQueue
+    from jepsen_tpu.parallel import encode as enc_mod
+
+    cases = []
+    for seed in range(3):
+        h = adversarial_register_history(n_ops=60, k_crashed=11,
+                                         seed=seed)
+        cases.append((CASRegister(), h))
+    cases.append((CASRegister(), _with_impossible_read(
+        adversarial_register_history(n_ops=60, k_crashed=11, seed=9))))
+    # deep-chain shape: crashy FIFO keys linearize long suffixes at
+    # once, the regime where an undersized trip bound would diverge
+    for seed in (1, 5):
+        cases.append((FIFOQueue(),
+                      rand_fifo_history(n_ops=24, n_processes=4,
+                                        n_values=3, crash_p=0.15,
+                                        seed=seed)))
+    for model, h in cases:
+        e = enc_mod.encode(model, h)
+        rw = bitdense.check_encoded_bitdense(e, closure_mode="while")
+        rf = bitdense.check_encoded_bitdense(e, closure_mode="fori")
+        assert rw["closure"] == "xla-while"
+        assert rf["closure"] == "xla-fori"
+        assert rw["valid?"] is rf["valid?"], (rw, rf)
+        assert rw.get("fail-event") == rf.get("fail-event")

@@ -1,9 +1,12 @@
-"""A/B perf harness: XLA closure vs the VMEM-resident pallas kernel.
+"""A/B/C perf harness: XLA while-closure vs XLA fori-closure vs the
+VMEM-resident pallas kernel.
 
-Decides whether JEPSEN_TPU_PALLAS should default ON for the TPU
-backend (parallel/bitdense.py gates the kernel behind the env flag
-until a hardware measurement exists — "flags do not get to claim
-speedups", pallas_kernels.py docstring). Run on the real chip:
+Decides (a) whether JEPSEN_TPU_PALLAS should default ON for the TPU
+backend, and (b) whether the XLA closure's default loop shape should
+flip to the fixed-trip fori variant (JEPSEN_TPU_CLOSURE=fori) — both
+gated behind env flags until a hardware measurement exists ("flags do
+not get to claim speedups", pallas_kernels.py docstring). Run on the
+real chip:
 
     python tools/perf_ab.py              # full shapes
     BENCH_SMOKE=1 python tools/perf_ab.py  # tiny shapes (CI sanity)
@@ -106,6 +109,7 @@ def main():
                       f"shapes (interpret-mode timings, no verdict)"})
     model = CASRegister()
     ratios = {}
+    fori_ratios = {}
 
     # ---- single-key adversarial ----
     for L in ([200, 400] if smoke else [1000, 10000]):
@@ -114,18 +118,27 @@ def main():
             n_ops=L, k_crashed=(11 if smoke else 12), seed=7)
         e = enc_mod.encode(model, h)
         S, C = bitdense.n_states(e), max(5, e.n_slots)
-        if not pk.supported(S, C):
-            emit({"shape": f"single-{L}", "skipped": f"unsupported "
-                  f"S={S} C={C}"})
-            continue
+        # while and fori are pure XLA: measured on EVERY shape — the
+        # fori decision must never be settled by a pallas support skip
         t_xla = _steady(lambda: bitdense.check_encoded_bitdense(
-            e, use_pallas=False))
-        t_pl = _steady(lambda: bitdense.check_encoded_bitdense(
-            e, use_pallas=True))
-        ratios[f"single-{L}"] = t_xla / t_pl
-        emit({"shape": f"single-key {L}-op adversarial", "S": S, "C": C,
-              "xla_secs": round(t_xla, 3), "pallas_secs": round(t_pl, 3),
-              "pallas_speedup": round(t_xla / t_pl, 2)})
+            e, use_pallas=False, closure_mode="while"))
+        t_fori = _steady(lambda: bitdense.check_encoded_bitdense(
+            e, use_pallas=False, closure_mode="fori"))
+        fori_ratios[f"single-{L}"] = t_xla / t_fori
+        line = {"shape": f"single-key {L}-op adversarial", "S": S,
+                "C": C,
+                "xla_secs": round(t_xla, 3),
+                "fori_secs": round(t_fori, 3),
+                "fori_speedup": round(t_xla / t_fori, 2)}
+        if pk.supported(S, C):
+            t_pl = _steady(lambda: bitdense.check_encoded_bitdense(
+                e, use_pallas=True))
+            ratios[f"single-{L}"] = t_xla / t_pl
+            line.update(pallas_secs=round(t_pl, 3),
+                        pallas_speedup=round(t_xla / t_pl, 2))
+        else:
+            line["pallas_skipped"] = f"unsupported S={S} C={C}"
+        emit(line)
 
     # ---- multi-key batch ----
     n_keys, ops_per_key = (8, 40) if smoke else (84, 120)
@@ -135,30 +148,48 @@ def main():
     encs = [enc_mod.encode(model, h) for h in keys]
     S = max(bitdense.n_states(e) for e in encs)
     C = max(5, max(e.n_slots for e in encs))
+    t_xla = _steady(lambda: bitdense.check_batch_bitdense(
+        encs, use_pallas=False, closure_mode="while"))
+    t_fori = _steady(lambda: bitdense.check_batch_bitdense(
+        encs, use_pallas=False, closure_mode="fori"))
+    fori_ratios["batch"] = t_xla / t_fori
+    line = {"shape": f"batch {n_keys}x{ops_per_key}", "S": S, "C": C,
+            "xla_secs": round(t_xla, 3),
+            "fori_secs": round(t_fori, 3),
+            "fori_speedup": round(t_xla / t_fori, 2)}
     if pk.supported(S, C):
-        t_xla = _steady(lambda: bitdense.check_batch_bitdense(
-            encs, use_pallas=False))
         t_pl = _steady(lambda: bitdense.check_batch_bitdense(
             encs, use_pallas=True))
         ratios["batch"] = t_xla / t_pl
-        emit({"shape": f"batch {n_keys}x{ops_per_key}", "S": S, "C": C,
-              "xla_secs": round(t_xla, 3), "pallas_secs": round(t_pl, 3),
-              "pallas_speedup": round(t_xla / t_pl, 2)})
+        line.update(pallas_secs=round(t_pl, 3),
+                    pallas_speedup=round(t_xla / t_pl, 2))
     else:
-        emit({"shape": "batch", "skipped": f"unsupported S={S} C={C}"})
+        line["pallas_skipped"] = f"unsupported S={S} C={C}"
+    emit(line)
 
     if not bitdense.is_tpu_platform(backend):
         # interpret-mode timings measure the interpreter, not the
         # kernel — never let them flip the default
         verdict = "no-verdict (non-tpu backend: interpret-mode timings)"
-    elif ratios and min(ratios.values()) >= 1.1:
-        verdict = "default-on"
+        fori_verdict = verdict
     else:
-        verdict = "keep-opt-in"
+        verdict = ("default-on"
+                   if ratios and min(ratios.values()) >= 1.1
+                   else "keep-opt-in")
+        fori_verdict = ("default-fori"
+                        if fori_ratios
+                        and min(fori_ratios.values()) >= 1.1
+                        else "keep-while")
     emit({"backend": backend, "verdict": verdict,
+          "fori_verdict": fori_verdict,
           "ratios": {k: round(v, 2) for k, v in ratios.items()},
-          "rule": "default-on iff pallas wins >=1.1x on EVERY measured "
-                  "shape on the tpu backend"})
+          "fori_ratios": {k: round(v, 2) for k, v in fori_ratios.items()},
+          "rule": "pallas default-on iff it wins >=1.1x on EVERY "
+                  "measured shape on the tpu backend; fori likewise "
+                  "vs the while closure (flip "
+                  "bitdense._resolve_closure_mode). If both win, "
+                  "pallas takes precedence (it replaces the XLA loop "
+                  "entirely)"})
 
 
 if __name__ == "__main__":
