@@ -322,7 +322,10 @@ def check_encoded_bitdense(e: EncodedHistory,
     C = max(5, e.n_slots)  # at least one full word
     use_pallas, interpret = _resolve_use_pallas(
         use_pallas, S, C, jax.default_backend())
-    closure_mode = _resolve_closure_mode(closure_mode)
+    # with pallas the XLA-loop branches are dead: pin the static arg so
+    # toggling JEPSEN_TPU_CLOSURE cannot split the compile cache
+    closure_mode = ("while" if use_pallas
+                    else _resolve_closure_mode(closure_mode))
     valid, fail_r = _check_bitdense(_xs_dense(e, C), jnp.int32(e.state0),
                                     e.step_name, S, C, e.state_lo,
                                     use_pallas, interpret, closure_mode)
@@ -366,7 +369,9 @@ def check_batch_bitdense(encs, mesh=None, use_pallas: bool = None,
         # taken)
         use_pallas = False
     use_pallas, interpret = _resolve_use_pallas(use_pallas, S, C, platform)
-    closure_mode = _resolve_closure_mode(closure_mode)
+    # same cache-splitting guard as the single-key path
+    closure_mode = ("while" if use_pallas
+                    else _resolve_closure_mode(closure_mode))
     valid, fail_r = _check_bitdense_batch(xs, state0, step_name, S, C,
                                           encs[0].state_lo, use_pallas,
                                           interpret, closure_mode)

@@ -333,12 +333,15 @@ def test_orphaned_device_arm_registered_on_giveup(monkeypatch):
         return {"valid?": "unknown"}
 
     monkeypatch.setattr(engine, "analysis", wedged)
-    before = len(competition._orphaned)
+    with competition._device_arms_lock:
+        before = set(competition._orphaned)
     r = competition.analysis(CASRegister(), _valid_history())
     assert r["valid?"] is True          # a host arm decided
     with competition._device_arms_lock:
-        after = len(competition._orphaned)
-    assert after == before + 1
+        # set-difference, not a count: orphans left by OTHER tests'
+        # races may be popped concurrently as their arms unwedge
+        new = set(competition._orphaned) - before
+    assert len(new) == 1, new
     wedge.set()                         # let the arm report and clean up
 
 

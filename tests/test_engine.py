@@ -863,3 +863,63 @@ def test_indecisive_recheck_keeps_device_verdict(monkeypatch):
     r = engine.extract_final_paths(model, e, e.n_returns - 1)
     assert "valid?" not in r           # verdict untouched
     assert "recheck indecisive" in r.get("final-paths-note", ""), r
+
+def test_encode_snapshot_interval_fill_matches_naive_oracle():
+    """encode()'s interval-fill snapshot construction vs a naive
+    per-return reconstruction (the straightforward O(R*C) formulation):
+    every column of every row must match, including slot reuse after
+    returns and crashed calls holding slots to the end."""
+    from jepsen_tpu.histories import (adversarial_register_history,
+                                      rand_fifo_history,
+                                      rand_register_history)
+    from jepsen_tpu.models import CASRegister, FIFOQueue
+
+    cases = [(CASRegister(), rand_register_history(
+                 n_ops=150, n_processes=8, n_values=4, crash_p=0.05,
+                 fail_p=0.08, seed=s)) for s in range(4)]
+    cases += [(CASRegister(), adversarial_register_history(
+                  n_ops=80, k_crashed=9, seed=1))]
+    cases += [(FIFOQueue(), rand_fifo_history(
+                  n_ops=40, n_processes=5, n_values=3, crash_p=0.1,
+                  seed=2))]
+    for model, h in cases:
+        e = enc_mod.encode(model, h)
+        spec = e.spec
+        packed = [spec.encode_call(c.f, c.value, c.result, c.crashed)
+                  for c in e.calls]
+        # naive reconstruction: replay events, snapshot before returns
+        import heapq as hq
+        events = []
+        for c in e.calls:
+            events.append((c.invoke_index, 0, c.index))
+            if not c.crashed:
+                events.append((c.complete_index, 1, c.index))
+        events.sort()
+        free, n_slots, slot_of, occupant = [], 0, {}, {}
+        r = 0
+        for _, kind, cid in events:
+            if kind == 0:
+                s = hq.heappop(free) if free else n_slots
+                if s == n_slots:
+                    n_slots += 1
+                slot_of[cid] = s
+                occupant[s] = cid
+            else:
+                for s in range(e.slot_f.shape[1]):
+                    if s in occupant:
+                        pk = packed[occupant[s]]
+                        assert e.slot_occ[r, s], (r, s)
+                        assert e.slot_f[r, s] == pk[0]
+                        assert e.slot_a0[r, s] == pk[1]
+                        assert e.slot_a1[r, s] == pk[2]
+                        assert e.slot_wild[r, s] == pk[3]
+                    else:
+                        assert not e.slot_occ[r, s], (r, s)
+                        assert e.slot_f[r, s] == -1
+                assert e.ev_slot[r] == slot_of[cid]
+                assert e.ret_call[r] == cid
+                r += 1
+                s = slot_of[cid]
+                del occupant[s]
+                hq.heappush(free, s)
+        assert r == e.n_returns
