@@ -108,18 +108,21 @@ def is_tpu_platform(platform: str) -> bool:
     return platform in ("tpu", "axon")
 
 
-def _resolve_closure_mode(closure_mode):
+def _resolve_closure_mode(closure_mode, use_pallas: bool = False):
     """XLA closure loop shape: "while" (converge-and-stop; extra
     device-visible `changed` reduction per iteration) or "fori" (fixed
     ceil(C/2) double-expansions; no convergence sync — the per-event
     cost on tiny tensors is suspected to be dispatch/sync latency, and
     only a hardware A/B (tools/perf_ab.py) gets to flip the default).
-    Env override: JEPSEN_TPU_CLOSURE=fori."""
+    Env override: JEPSEN_TPU_CLOSURE=fori. With pallas the XLA-loop
+    branches are dead: the mode is pinned to "while" AFTER validation,
+    so a bogus value fails on every platform and env toggles cannot
+    split the compile cache."""
     if closure_mode is None:
         closure_mode = os.environ.get("JEPSEN_TPU_CLOSURE", "while")
     if closure_mode not in ("while", "fori"):
         raise ValueError(f"unknown closure mode {closure_mode!r}")
-    return closure_mode
+    return "while" if use_pallas else closure_mode
 
 
 def _resolve_use_pallas(use_pallas, S: int, C: int, platform: str):
@@ -322,10 +325,7 @@ def check_encoded_bitdense(e: EncodedHistory,
     C = max(5, e.n_slots)  # at least one full word
     use_pallas, interpret = _resolve_use_pallas(
         use_pallas, S, C, jax.default_backend())
-    # with pallas the XLA-loop branches are dead: pin the static arg so
-    # toggling JEPSEN_TPU_CLOSURE cannot split the compile cache
-    closure_mode = ("while" if use_pallas
-                    else _resolve_closure_mode(closure_mode))
+    closure_mode = _resolve_closure_mode(closure_mode, use_pallas)
     valid, fail_r = _check_bitdense(_xs_dense(e, C), jnp.int32(e.state0),
                                     e.step_name, S, C, e.state_lo,
                                     use_pallas, interpret, closure_mode)
@@ -369,9 +369,7 @@ def check_batch_bitdense(encs, mesh=None, use_pallas: bool = None,
         # taken)
         use_pallas = False
     use_pallas, interpret = _resolve_use_pallas(use_pallas, S, C, platform)
-    # same cache-splitting guard as the single-key path
-    closure_mode = ("while" if use_pallas
-                    else _resolve_closure_mode(closure_mode))
+    closure_mode = _resolve_closure_mode(closure_mode, use_pallas)
     valid, fail_r = _check_bitdense_batch(xs, state0, step_name, S, C,
                                           encs[0].state_lo, use_pallas,
                                           interpret, closure_mode)

@@ -391,15 +391,48 @@ def nemesis_package(test) -> dict:
         return {"nemesis": CrashTruncateNemesis(test, "/data/cs.wal/wal"),
                 "generator": gen.delay(1, gen.repeat(
                     {"type": "info", "f": "crash"}))}
+    if kind == "local-kill":
+        return {"nemesis": LocalKillNemesis(),
+                "generator": gen.cycle_gen([
+                    gen.sleep(1.5), {"type": "info", "f": "kill"},
+                    gen.sleep(0.7), {"type": "info", "f": "restart"}])}
     if kind == "none":
         return {"nemesis": jnemesis.noop(), "generator": None}
     raise ValueError(f"unknown nemesis profile {kind!r}")
 
 
+class LocalKillNemesis(jnemesis.Nemesis):
+    """Crash nemesis for LOCAL mode (LocalMerkleeyesDB): SIGKILLs the
+    shared native merkleeyes mid-run and restarts it on the same WAL —
+    the docker-less parallel of the cluster `crash` nemesis. Committed
+    txs must survive via WAL replay; in-flight ops surface as
+    indeterminate (client.py maps connection errors), and the history
+    must still check linearizable."""
+
+    def setup(self, test):
+        self.db = test["db"]
+        assert hasattr(self.db, "kill_server"), \
+            "local-kill requires a LocalMerkleeyesDB"
+        return self
+
+    def invoke(self, test, op):
+        if op["f"] == "kill":
+            self.db.kill_server()
+            return jnemesis._ok(op, value="killed (SIGKILL, WAL kept)")
+        if op["f"] == "restart":
+            self.db.restart_server()
+            return jnemesis._ok(op, value="restarted (WAL replayed)")
+        raise ValueError(f"unknown local-kill op {op['f']!r}")
+
+    def teardown(self, test):
+        # leave the server down/up as-is: DB.teardown owns shutdown
+        return None
+
+
 NEMESES = ["changing-validators", "peekaboo-dup-validators",
            "split-dup-validators", "half-partitions", "ring-partitions",
            "single-partitions", "clocks", "crash", "truncate-merkleeyes",
-           "truncate-tendermint", "none"]
+           "truncate-tendermint", "local-kill", "none"]
 
 
 # ------------------------------------------------------------ workloads

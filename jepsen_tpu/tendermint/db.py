@@ -311,6 +311,19 @@ class LocalMerkleeyesDB(jdb.DB):
             self.server.stop()
             self.server = None
 
+    # ---- crash-nemesis surface (local parallel of the cluster kill
+    # nemesis): SIGKILL the shared process / restart it on the SAME
+    # wal path, so committed txs must come back via WAL replay
+    def kill_server(self):
+        with self._lock:
+            if self.server is not None:
+                self.server.kill()
+
+    def restart_server(self):
+        with self._lock:
+            if self.server is not None and self.server.proc is None:
+                self.server.start()
+
 
 def local_transport_for(test, node):
     """transport factory for local mode: every node reaches the one

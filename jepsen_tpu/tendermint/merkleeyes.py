@@ -277,6 +277,20 @@ class LocalServer:
                 self.proc.wait()
             self.proc = None
 
+    def kill(self):
+        """SIGKILL — the crash-nemesis path: no graceful shutdown, no
+        flush; recovery must come from the WAL. Idempotent. The stale
+        socket file is removed so a later start()'s readiness probe
+        cannot race against it."""
+        if self.proc is not None:
+            self.proc.kill()
+            self.proc.wait()
+            self.proc = None
+        try:
+            os.unlink(self.sock_path)
+        except FileNotFoundError:
+            pass
+
     def client(self):
         """A connected client speaking this server's protocol."""
         return client_for(("unix", self.sock_path), self.proto).connect()

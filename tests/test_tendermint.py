@@ -479,3 +479,36 @@ def test_cli_test_all_local(tmp_path, monkeypatch, capsys):
     out = capsys.readouterr().out
     assert code == 0, out
     assert "2 successes" in out and "0 failures" in out
+
+
+def test_local_kill_recover_end_to_end(tmp_path):
+    """Crash-recovery e2e: the local-kill nemesis SIGKILLs the native
+    merkleeyes mid-run and restarts it on the same WAL, repeatedly.
+    Committed writes must survive replay — the history (with its
+    connection-error fails/indeterminates) must still check
+    linearizable, and the nemesis must actually have fired."""
+    from jepsen_tpu import core as jcore
+    with gen.fixed_rand(13):
+        t = tcore.test_map({
+            "nodes": ["n1"],
+            "ssh": {"dummy": True},
+            "db": td.LocalMerkleeyesDB(workdir=str(tmp_path)),
+            "transport_for": td.local_transport_for,
+            "nemesis_name": "local-kill",
+            "time_limit": 8,
+            "quiesce": 0,
+            "ops_per_key": 30,
+            "concurrency": 4,
+        })
+        completed = jcore.run(t)
+    res = completed["results"]
+    history = completed["history"]
+    kills = [o for o in history
+             if o.get("process") == "nemesis" and o.get("f") == "kill"
+             and o.get("type") == "info" and o.get("value")]
+    restarts = [o for o in history
+                if o.get("process") == "nemesis"
+                and o.get("f") == "restart" and o.get("value")]
+    assert kills and restarts, "nemesis never fired"
+    assert res["valid?"] is True, res
+    assert res["linear"]["valid?"] is True
