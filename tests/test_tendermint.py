@@ -512,3 +512,28 @@ def test_local_kill_recover_end_to_end(tmp_path):
     assert kills and restarts, "nemesis never fired"
     assert res["valid?"] is True, res
     assert res["linear"]["valid?"] is True
+
+
+def test_local_kill_set_workload_end_to_end(tmp_path):
+    """Crash-recovery e2e on the SET workload: set-full semantics must
+    hold across SIGKILL/WAL-replay cycles — an element whose write was
+    acknowledged before a kill must be readable after the restart."""
+    from jepsen_tpu import core as jcore
+    with gen.fixed_rand(29):
+        t = tcore.test_map({
+            "nodes": ["n1"],
+            "ssh": {"dummy": True},
+            "db": td.LocalMerkleeyesDB(workdir=str(tmp_path)),
+            "transport_for": td.local_transport_for,
+            "workload": "set",
+            "nemesis_name": "local-kill",
+            "time_limit": 7,
+            "quiesce": 0,
+            "concurrency": 4,
+        })
+        completed = jcore.run(t)
+    res = completed["results"]
+    history = completed["history"]
+    assert any(o.get("process") == "nemesis" and o.get("f") == "kill"
+               and o.get("value") for o in history), "nemesis never fired"
+    assert res["valid?"] is True, res
