@@ -154,6 +154,7 @@ def sec_multikey(label: str = None):
     rs = bitdense.check_batch_bitdense(pre)
     device_secs = perf_counter() - t0
     assert all(r["valid?"] is True for r in rs), rs[:3]
+    closure = rs[0].get("closure")
     e2e_secs = encode_secs + device_secs
     dev_rate = total_ops / e2e_secs
 
@@ -183,6 +184,7 @@ def sec_multikey(label: str = None):
           "value": round(dev_rate, 1), "unit": "ops/sec",
           "vs_baseline": round(dev_rate / host32_rate, 2),
           **line_extra,
+          "closure": closure,
           "device_only_secs": round(device_secs, 3),
           "encode_secs": round(encode_secs, 3),
           "device_only_ops_per_sec": round(total_ops / device_secs, 1),
@@ -209,6 +211,7 @@ def sec_adv(L: int, host_deadline: float, skip_host: bool,
     r = bitdense.check_encoded_bitdense(e)      # steady state
     dev_secs = perf_counter() - t0
     assert r["valid?"] is True, r
+    closure = r.get("closure")
     R = e.n_returns
 
     host_info = {"deadline_secs": host_deadline}
@@ -246,6 +249,7 @@ def sec_adv(L: int, host_deadline: float, skip_host: bool,
           "value": round(L / dev_secs, 1), "unit": "ops/sec",
           "vs_baseline": speedup,
           "L": L,
+          "closure": closure,
           "device_secs": round(dev_secs, 3),
           "device_compile_secs": round(warm_secs - dev_secs, 2),
           "host_est_secs": round(host_est, 1) if host_est else None,

@@ -256,7 +256,10 @@ class LocalServer:
         while time.monotonic() < deadline:
             if os.path.exists(self.sock_path):
                 try:
-                    with self.client() as cl:
+                    # __enter__ performs the connect — client() would
+                    # connect twice and leak the first socket
+                    with client_for(("unix", self.sock_path),
+                                    self.proto) as cl:
                         cl.echo(b"ping")
                     return self
                 except OSError:
