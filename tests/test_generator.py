@@ -330,3 +330,26 @@ def test_on_update_swaps_generator():
     assert fs[0] == "write"
     assert fs.count("read") == 2
     assert len(fs) <= 4
+
+
+@pytest.mark.slow
+def test_pure_generator_rate_beats_reference_claim():
+    """The reference documents >20,000 ops/sec single-threaded pure
+    generation (generator.clj:68-70); this build measures ~50k on a
+    dev container. Floor at the reference's claim so a combinator
+    regression that halves generation throughput fails loudly."""
+    import time
+
+    def make():
+        return gen.limit(30000, gen.mix([
+            lambda: {"f": "write", "value": 1},
+            lambda: {"f": "read", "value": None}]))
+
+    quick_ops(make())                            # warm
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        h = quick_ops(make())
+        best = max(best, len(h) / (time.perf_counter() - t0))
+    assert best > 20_000, f"generation rate {best:.0f} ops/s below " \
+                          f"the reference's documented 20k floor"
