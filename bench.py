@@ -47,6 +47,13 @@ The headline is computed by the parent from whichever sections
 completed, so the driver always records a result. Children re-emit
 their JSON lines on stdout; the parent forwards them verbatim and
 parses them to thread host-baseline estimates between sections.
+
+A DEAD runtime is detected ONCE, up front: a bounded pre-probe child
+touches the backend before any device section; if it hangs, all
+device sections are skipped at once (per-section skip lines) and the
+bench drops straight to the labeled CPU fallback, instead of paying
+one full timeout per section (BENCH_r04 spent ~13 minutes of budget
+rediscovering the same wedge four times).
 """
 
 from __future__ import annotations
@@ -129,6 +136,24 @@ def _adv_encoded(L):
 
 
 # ======================= child sections ============================
+
+def sec_probe():
+    """Minimal device touch: backend init + one tiny compiled op.
+
+    Runs FIRST under its own short timeout so a wedged runtime (PJRT
+    client creation blocking forever — the observed tunnel-outage
+    failure mode) costs the bench ONE bounded probe instead of one
+    full timeout per device section: BENCH_r04 burned ~13 minutes of
+    budget rediscovering the same dead runtime four times, one 180s+
+    timeout per section."""
+    import jax
+    import jax.numpy as jnp
+    devs = jax.devices()
+    x = jnp.ones((8, 8), jnp.float32)
+    jax.jit(lambda a: a @ a)(x).block_until_ready()
+    emit({"metric": "device pre-probe", "value": 1.0, "unit": "ok",
+          "platform": devs[0].platform, "n_devices": len(devs)})
+
 
 def sec_multikey(label: str = None):
     from jepsen_tpu.histories import rand_register_history
@@ -413,17 +438,64 @@ def main():
         return BUDGET_SECS - (monotonic() - t_start)
 
     hung = []              # (kind, L) sections killed on timeout
-
-    # ---------------- 1. multi-key north-star shape ----------------
-    multikey, st = run_section(["multikey"],
-                               min(sec_timeout("multikey"), BUDGET_SECS))
-    mk_line = next((p for p in multikey if p.get("value")), None)
-    if st == "hung":
-        hung.append(("multikey", None))
-
-    # ---------------- 2. adversarial single-key --------------------
+    mk_line = None
     adv_results = {}       # L -> parsed line (with L, device_secs, host)
 
+    # ---------------- 0. bounded device pre-probe ------------------
+    # Fail a dead runtime ONCE: a single short child touches the
+    # backend; if it hangs/crashes, every device section is skipped at
+    # once (each with its own machine-readable skip line, so the
+    # record stays per-section complete) and control drops straight to
+    # the labeled CPU fallback below. A wedge that develops MID-bench
+    # is still caught by the per-section isolation + retry.
+    probe_to = min(max(1.0,
+                       float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+                       * TIMEOUT_SCALE), BUDGET_SECS)
+
+    def probe_once():
+        parsed, st = run_section(["probe"], probe_to)
+        ok = (st == "ok"
+              and any(p.get("metric") == "device pre-probe"
+                      and p.get("value") for p in parsed))
+        return ok, st
+
+    probe_ok, st = probe_once()
+    if not probe_ok and left() > probe_to + 60:
+        # one retry: a single probe hang/crash must not relabel a
+        # healthy-chip round as cpu-fallback over a transient blip —
+        # mid-bench hangs get a retry for the same reason
+        note(f"device pre-probe failed ({st}) — retrying once")
+        probe_ok, st = probe_once()
+    if not probe_ok:
+        note(f"device pre-probe failed twice ({st}) — skipping ALL "
+             f"device sections at once; straight to the labeled CPU "
+             f"fallback")
+        how = (f"hung past {probe_to:.0f}s" if st == "hung"
+               else f"child {st}ed")
+        skip = (f"device pre-probe {how} (twice) — runtime "
+                f"unreachable; all device sections skipped at once")
+        emit({"metric": f"multi-key {N_KEYS}x{OPS_PER_KEY}-op "
+                        f"cas-register (north-star shape)",
+              "value": None, "unit": "ops/sec", "skipped": skip})
+        for L in ADV_SIZES:
+            emit({"metric": f"adversarial single-key {L}-op",
+                  "value": None, "unit": "ops/sec", "skipped": skip})
+        emit({"metric": "adversarial via frontier-sharded engine",
+              "value": None, "unit": "ops/sec", "skipped": skip})
+        emit({"metric": f"max adversarial (2^{ADV_K}-config) history "
+                        f"length verified @ {MAXLEN_RUN_BUDGET}s "
+                        f"device budget",
+              "value": None, "unit": "ops", "skipped": skip})
+
+    # ---------------- 1. multi-key north-star shape ----------------
+    if probe_ok:
+        multikey, st = run_section(
+            ["multikey"], min(sec_timeout("multikey"), BUDGET_SECS))
+        mk_line = next((p for p in multikey if p.get("value")), None)
+        if st == "hung":
+            hung.append(("multikey", None))
+
+    # ---------------- 2. adversarial single-key --------------------
     def run_adv(L):
         deadline = HOST_DEADLINES[L]
         skip_host = left() < deadline + 90
@@ -443,7 +515,7 @@ def main():
                 adv_results[L] = p
         return st
 
-    for L in ADV_SIZES:
+    for L in (ADV_SIZES if probe_ok else []):
         if left() < min(90, sec_timeout("adv", L)):
             emit({"metric": f"adversarial single-key {L}-op",
                   "value": None,
@@ -473,7 +545,7 @@ def main():
 
     # ---------------- 3. sharded engine on the local mesh ----------
     pick = 10000 if not SMOKE else (400 if 400 in adv_results else None)
-    if pick in adv_results and left() > 120:
+    if probe_ok and pick in adv_results and left() > 120:
         run_section(["sharded", pick,
                      adv_results[pick].get("host_est_secs") or ""],
                     min(sec_timeout("sharded"), left()))
@@ -484,7 +556,7 @@ def main():
     # floor (2.5x the per-run budget), so a child is never started
     # that could not run a single probe
     to = min(sec_timeout("maxlen"), left())
-    if to - 30 > 2.5 * MAXLEN_RUN_BUDGET:
+    if probe_ok and to - 30 > 2.5 * MAXLEN_RUN_BUDGET:
         run_section(["maxlen", to - 30], to)
 
     # ---------------- HEADLINE (last line: the driver's record) ----
@@ -565,6 +637,15 @@ def child_main(argv: list) -> None:
         argv = argv[:i] + argv[i + 2:]
     sec = argv[0]
     faulthandler.dump_traceback_later(max(20, to - 10), exit=False)
+    if (os.environ.get("JEPSEN_TPU_TEST_WEDGE") == "1"
+            and os.environ.get("JAX_PLATFORMS") != "cpu"):
+        # test seam: simulate the observed tunnel wedge (PJRT client
+        # creation blocking forever, uninterruptible by Python
+        # signals) in every child not pinned to cpu — mirroring
+        # production, where cpu-pinned children survive an outage
+        import time
+        while True:
+            time.sleep(3600)
     plat = os.environ.get("JAX_PLATFORMS")
     if plat:
         # env alone is not enough on this image — the TPU plugin's
@@ -575,7 +656,9 @@ def child_main(argv: list) -> None:
         except Exception:  # noqa: BLE001
             pass
     _enable_compile_cache()
-    if sec == "multikey":
+    if sec == "probe":
+        sec_probe()
+    elif sec == "multikey":
         sec_multikey(argv[1] if len(argv) > 1 else None)
     elif sec == "adv":
         L, deadline, skip_host = int(argv[1]), float(argv[2]), \

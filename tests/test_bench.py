@@ -17,6 +17,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -48,13 +49,21 @@ def test_bench_total_hang_lands_on_labeled_cpu_fallback():
     The parent runs the PRODUCTION (non-smoke) configuration: the
     fallback child must be forced onto SMOKE shapes regardless, because
     the full 84-key batch cannot finish on a host CPU inside any window
-    (BENCH_r03's fallback recorded null for exactly this reason)."""
-    r = _run({"BENCH_TIMEOUT_SCALE": "0.02", "BENCH_SMOKE": ""},
+    (BENCH_r03's fallback recorded null for exactly this reason).
+
+    BENCH_PROBE_TIMEOUT is pinned high so the cpu-pinned pre-probe
+    SUCCEEDS and this test keeps covering the per-section
+    hang-isolation + retry machinery (the probe-skip path has its own
+    test below)."""
+    r = _run({"BENCH_TIMEOUT_SCALE": "0.02", "BENCH_SMOKE": "",
+              "BENCH_PROBE_TIMEOUT": "6000"},
              timeout=500)
     assert r.returncode == 0, r.stderr[-2000:]
     lines = _json_lines(r.stdout)
-    skips = [l for l in lines if "skipped" in l]
-    assert skips, "no per-section skip lines emitted"
+    assert any(l.get("metric") == "device pre-probe" for l in lines), \
+        "probe was meant to pass in this test"
+    skips = [l for l in lines if "timeout/hang" in str(l.get("skipped"))]
+    assert skips, "no per-section hang-kill skip lines emitted"
     head = lines[-1]
     for k in ("metric", "value", "unit", "vs_baseline"):
         assert k in head, head
@@ -79,6 +88,34 @@ def test_bench_hang_plus_exhausted_budget_emits_error_headline():
     assert head["value"] is None and "error" in head, head
     for k in ("metric", "value", "unit", "vs_baseline"):
         assert k in head, head
+
+
+@pytest.mark.slow
+def test_bench_wedged_runtime_fails_once_and_finishes_fast():
+    """A dead device runtime must be discovered ONCE by the bounded
+    pre-probe, not once per device section (BENCH_r04 burned ~13 min
+    of budget rediscovering the same wedge four times, one 180s+
+    timeout each). With every non-cpu child wedged via the test seam
+    (JEPSEN_TPU_TEST_WEDGE simulates the PJRT hang; cpu-pinned
+    children survive, as in production), the FULL production-shape
+    bench must land the labeled CPU-fallback headline in under 60s."""
+    t0 = time.monotonic()
+    r = _run({"BENCH_SMOKE": "", "JAX_PLATFORMS": "",
+              "JEPSEN_TPU_TEST_WEDGE": "1", "BENCH_PROBE_TIMEOUT": "5"},
+             timeout=120)
+    wall = time.monotonic() - t0
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert wall < 60, f"wedged bench took {wall:.0f}s (budget: <60s)"
+    lines = _json_lines(r.stdout)
+    head = lines[-1]
+    assert head.get("backend") == "cpu-fallback", head
+    assert "CPU FALLBACK" in head["metric"], head
+    assert "8x40" in head["metric"], head          # smoke shapes forced
+    # every device section got its own machine-readable skip line,
+    # all attributed to the single pre-probe failure
+    skips = [l for l in lines
+             if "pre-probe" in str(l.get("skipped", ""))]
+    assert len(skips) >= 7, lines   # multikey + 4 adv + sharded + maxlen
 
 
 @pytest.mark.slow
