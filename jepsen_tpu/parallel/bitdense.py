@@ -339,6 +339,79 @@ def check_encoded_bitdense(e: EncodedHistory,
     return out
 
 
+def _normalize_cost(ca) -> dict:
+    # older jax returns [dict] per device program, newer a flat dict
+    d = ca[0] if isinstance(ca, (list, tuple)) else ca
+    return {"flops": float(d.get("flops", 0.0)),
+            "bytes_accessed": float(d.get("bytes accessed", 0.0))}
+
+
+def cost_analysis_encoded(e: EncodedHistory,
+                          use_pallas: bool = False,
+                          closure_mode: str = "while") -> dict:
+    """Hardware-independent analytical prior: flops / bytes accessed
+    from XLA's cost model over the LOWERED (traced, uncompiled) HLO of
+    a check of `e` under the given closure variant. No device
+    execution — usable on CPU to rank while/fori/pallas before any
+    chip measurement exists (tools/perf_ab.py emits this as each
+    shape's cost prior and cross-checks it once measured).
+
+    CAVEATS the callers must carry: (1) XLA's HLO cost model counts
+    every loop BODY once — trip counts are data-dependent — so these
+    numbers are per-iteration work (they rank closure VARIANTS, whose
+    bodies differ), not end-to-end totals; model totals by multiplying
+    with the known static trip counts (n_returns scan steps, exactly
+    ceil(C/2) closure trips for fori). (2) The pallas row is NOT
+    backend-independent: off-TPU the interpret-mode EMULATION is
+    costed, on TPU the kernel body is a custom call the cost model
+    cannot see — the "program" field says which program the numbers
+    describe, and cross-backend pallas comparisons are invalid."""
+    from jepsen_tpu.parallel.dense import _xs_dense
+    S = n_states(e)
+    C = max(5, e.n_slots)
+    use_pallas, interpret, mode = _resolve_cost_variant(
+        use_pallas, S, C, closure_mode)
+    lowered = _check_bitdense.lower(
+        _xs_dense(e, C), jnp.int32(e.state0), e.step_name, S, C,
+        e.state_lo, use_pallas, interpret, mode)
+    return _annotate_cost(lowered.cost_analysis(), use_pallas,
+                          interpret, mode)
+
+
+def cost_analysis_batch(encs, use_pallas: bool = False,
+                        closure_mode: str = "while") -> dict:
+    """Batch-path analogue of cost_analysis_encoded (same padded
+    program check_batch_bitdense would run, meshless)."""
+    from jepsen_tpu.parallel.encode import pad_batch
+    xs, state0, S, C, _ = pad_batch(encs, min_slots=5)
+    use_pallas, interpret, mode = _resolve_cost_variant(
+        use_pallas, S, C, closure_mode)
+    lowered = _check_bitdense_batch.lower(
+        xs, state0, encs[0].step_name, S, C, encs[0].state_lo,
+        use_pallas, interpret, mode)
+    return _annotate_cost(lowered.cost_analysis(), use_pallas,
+                          interpret, mode)
+
+
+def _resolve_cost_variant(use_pallas, S, C, closure_mode):
+    """The same gates the execution paths use (no bare kernel asserts
+    on unsupported shapes — an explicit use_pallas=True downgrades
+    exactly like check_encoded_bitdense would)."""
+    use_pallas, interpret = _resolve_use_pallas(
+        use_pallas, S, C, jax.default_backend())
+    return use_pallas, interpret, _resolve_closure_mode(closure_mode,
+                                                        use_pallas)
+
+
+def _annotate_cost(ca, use_pallas, interpret, mode) -> dict:
+    out = _normalize_cost(ca)
+    out["program"] = (("pallas-interpret-emulation" if interpret
+                       else "pallas-kernel-custom-call "
+                            "(body uncounted by the HLO cost model)")
+                      if use_pallas else f"xla-{mode}")
+    return out
+
+
 def check_batch_bitdense(encs, mesh=None, use_pallas: bool = None,
                          closure_mode: str = None) -> list:
     """Batched per-key check. Callers must ensure the COMBINED padded

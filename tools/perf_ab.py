@@ -42,6 +42,28 @@ def emit(obj):
     print(json.dumps(obj), flush=True)
 
 
+def _cost_priors(lower_one, pallas_ok: bool) -> dict:
+    """Per-variant analytical prior from XLA's trace-time cost model
+    (bitdense.cost_analysis_*): a ranking signal that exists even when
+    no chip is reachable, and a cross-check on the measured ratios
+    once one is. The while/fori rows are backend-independent; the
+    pallas row is not (its 'program' field says what was costed).
+    `lower_one(use_pallas, mode)` returns {"flops", "bytes_accessed",
+    "program"}."""
+    out = {}
+    for name, (up, mode) in {"while": (False, "while"),
+                             "fori": (False, "fori"),
+                             "pallas": (True, "while")}.items():
+        if name == "pallas" and not pallas_ok:
+            out[name] = {"skipped": "unsupported shape"}
+            continue
+        try:
+            out[name] = lower_one(up, mode)
+        except Exception as err:  # noqa: BLE001 — the prior is
+            out[name] = {"error": repr(err)}   # advisory, never fatal
+    return out
+
+
 def _steady(fn):
     fn()                                    # cold: compile + warm cache
     best = float("inf")
@@ -110,6 +132,7 @@ def main():
     model = CASRegister()
     ratios = {}
     fori_ratios = {}
+    cost_table = {}
 
     # ---- single-key adversarial ----
     for L in ([200, 400] if smoke else [1000, 10000]):
@@ -118,6 +141,15 @@ def main():
             n_ops=L, k_crashed=(11 if smoke else 12), seed=7)
         e = enc_mod.encode(model, h)
         S, C = bitdense.n_states(e), max(5, e.n_slots)
+        cost = _cost_priors(
+            lambda up, mode: bitdense.cost_analysis_encoded(
+                e, use_pallas=up, closure_mode=mode),
+            pk.supported(S, C))
+        # static trip counts: the cost model counts loop bodies once,
+        # so totals are modeled as body-cost x trips by the consumer
+        cost["trips"] = {"scan_events": e.n_returns,
+                         "fori_closure": -(-C // 2)}
+        cost_table[f"single-{L}"] = cost
         # while and fori are pure XLA: measured on EVERY shape — the
         # fori decision must never be settled by a pallas support skip
         t_xla = _steady(lambda: bitdense.check_encoded_bitdense(
@@ -148,6 +180,13 @@ def main():
     encs = [enc_mod.encode(model, h) for h in keys]
     S = max(bitdense.n_states(e) for e in encs)
     C = max(5, max(e.n_slots for e in encs))
+    cost = _cost_priors(
+        lambda up, mode: bitdense.cost_analysis_batch(
+            encs, use_pallas=up, closure_mode=mode),
+        pk.supported(S, C))
+    cost["trips"] = {"scan_events": max(e.n_returns for e in encs),
+                     "fori_closure": -(-C // 2)}
+    cost_table["batch"] = cost
     t_xla = _steady(lambda: bitdense.check_batch_bitdense(
         encs, use_pallas=False, closure_mode="while"))
     t_fori = _steady(lambda: bitdense.check_batch_bitdense(
@@ -166,6 +205,24 @@ def main():
     else:
         line["pallas_skipped"] = f"unsupported S={S} C={C}"
     emit(line)
+
+    # analytical prior table: flops/bytes per (shape, variant) from
+    # XLA's trace-time cost model — exists without any chip; once a
+    # measurement lands, a large disagreement between the prior's
+    # byte/flop ranking and the measured ratio flags dispatch/sync
+    # overhead (not compute) as the bottleneck
+    emit({"cost_table": cost_table,
+          "note": "trace-time XLA cost_analysis (flops / bytes "
+                  "accessed) per closure variant; advisory only — "
+                  "defaults flip on MEASURED ratios, never on the "
+                  "prior. Loop bodies are counted ONCE by the cost "
+                  "model (trip counts are data-dependent): these rank "
+                  "per-iteration variant cost; model totals via the "
+                  "'trips' entry. The pallas row's 'program' field "
+                  "says which program was costed (interpret emulation "
+                  "off-TPU vs an uncountable kernel custom call on "
+                  "it) — pallas priors are NOT comparable across "
+                  "backends"})
 
     if not bitdense.is_tpu_platform(backend):
         # interpret-mode timings measure the interpreter, not the
