@@ -347,7 +347,7 @@ def _normalize_cost(ca) -> dict:
 
 
 def cost_analysis_encoded(e: EncodedHistory,
-                          use_pallas: bool = False,
+                          use_pallas: bool = None,
                           closure_mode: str = "while") -> dict:
     """Hardware-independent analytical prior: flops / bytes accessed
     from XLA's cost model over the LOWERED (traced, uncompiled) HLO of
@@ -378,7 +378,7 @@ def cost_analysis_encoded(e: EncodedHistory,
                           interpret, mode)
 
 
-def cost_analysis_batch(encs, use_pallas: bool = False,
+def cost_analysis_batch(encs, use_pallas: bool = None,
                         closure_mode: str = "while") -> dict:
     """Batch-path analogue of cost_analysis_encoded (same padded
     program check_batch_bitdense would run, meshless)."""

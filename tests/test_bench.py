@@ -119,6 +119,41 @@ def test_bench_wedged_runtime_fails_once_and_finishes_fast():
 
 
 @pytest.mark.slow
+def test_bench_big_shapes_preflight_on_cpu():
+    """No bench shape may be first-exercised on expensive hardware:
+    the 10k/50k adversarial history build + encode + the packed-host
+    duel — exactly what bench.sec_adv runs before its device call —
+    must complete green on CPU inside the bench's own deadlines.
+    (The device call itself is covered at these shapes by maxlen's CPU
+    smoke at 51200 ops and the adv section contract test.)"""
+    from time import monotonic, perf_counter
+
+    import bench
+    from jepsen_tpu.checker import linear_packed
+    from jepsen_tpu.parallel import bitdense
+
+    assert bench.ADV_K == 12, "preflight must cover the bench's real k"
+    for L in (10000, 50000):
+        t0 = perf_counter()
+        _, _, e = bench._adv_encoded(L)
+        build_secs = perf_counter() - t0
+        assert build_secs < 60, (L, build_secs)
+        assert bitdense.fits_bitdense(bitdense.n_states(e), e.n_slots)
+        deadline = bench.HOST_DEADLINES[L]
+        t0 = perf_counter()
+        rh = linear_packed.check_encoded(e,
+                                         deadline=monotonic() + deadline)
+        wall = perf_counter() - t0
+        # the duel must respect its deadline (+grace for one event) and
+        # either finish or report real progress the estimate scales from
+        assert wall < deadline + 10, (L, wall)
+        if rh["valid?"] == "unknown":
+            assert rh.get("events-done", 0) > 0, rh
+        else:
+            assert rh["valid?"] is True, rh
+
+
+@pytest.mark.slow
 def test_bench_adv_section_contract():
     r = _run({}, args=["--section", "adv", "200", "5", "0", "",
                        "--timeout", "200"])

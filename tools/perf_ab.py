@@ -64,6 +64,17 @@ def _cost_priors(lower_one, pallas_ok: bool) -> dict:
     return out
 
 
+def _cost_entry(lower_one, pallas_ok: bool, scan_events: int,
+                C: int) -> dict:
+    """One cost_table row: per-variant priors + the static trip counts
+    (the cost model counts loop bodies once, so totals are modeled as
+    body-cost x trips by the consumer)."""
+    cost = _cost_priors(lower_one, pallas_ok)
+    cost["trips"] = {"scan_events": scan_events,
+                     "fori_closure": -(-C // 2)}
+    return cost
+
+
 def _steady(fn):
     fn()                                    # cold: compile + warm cache
     best = float("inf")
@@ -141,15 +152,10 @@ def main():
             n_ops=L, k_crashed=(11 if smoke else 12), seed=7)
         e = enc_mod.encode(model, h)
         S, C = bitdense.n_states(e), max(5, e.n_slots)
-        cost = _cost_priors(
+        cost_table[f"single-{L}"] = _cost_entry(
             lambda up, mode: bitdense.cost_analysis_encoded(
                 e, use_pallas=up, closure_mode=mode),
-            pk.supported(S, C))
-        # static trip counts: the cost model counts loop bodies once,
-        # so totals are modeled as body-cost x trips by the consumer
-        cost["trips"] = {"scan_events": e.n_returns,
-                         "fori_closure": -(-C // 2)}
-        cost_table[f"single-{L}"] = cost
+            pk.supported(S, C), e.n_returns, C)
         # while and fori are pure XLA: measured on EVERY shape — the
         # fori decision must never be settled by a pallas support skip
         t_xla = _steady(lambda: bitdense.check_encoded_bitdense(
@@ -180,13 +186,10 @@ def main():
     encs = [enc_mod.encode(model, h) for h in keys]
     S = max(bitdense.n_states(e) for e in encs)
     C = max(5, max(e.n_slots for e in encs))
-    cost = _cost_priors(
+    cost_table["batch"] = _cost_entry(
         lambda up, mode: bitdense.cost_analysis_batch(
             encs, use_pallas=up, closure_mode=mode),
-        pk.supported(S, C))
-    cost["trips"] = {"scan_events": max(e.n_returns for e in encs),
-                     "fori_closure": -(-C // 2)}
-    cost_table["batch"] = cost
+        pk.supported(S, C), max(e.n_returns for e in encs), C)
     t_xla = _steady(lambda: bitdense.check_batch_bitdense(
         encs, use_pallas=False, closure_mode="while"))
     t_fori = _steady(lambda: bitdense.check_batch_bitdense(
