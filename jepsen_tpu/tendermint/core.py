@@ -391,6 +391,34 @@ def nemesis_package(test) -> dict:
         return {"nemesis": CrashTruncateNemesis(test, "/data/cs.wal/wal"),
                 "generator": gen.delay(1, gen.repeat(
                     {"type": "info", "f": "crash"}))}
+    if kind == "deployed-mix":
+        # The deployed-cluster fault sweep in one profile: a network
+        # partition (MemNet grudge or iptables, whichever net the test
+        # carries), one validator-set ADD through the live app, and a
+        # crash+truncate cycle — staged deterministically so a single
+        # e2e drives deploy -> faults -> final reads -> verdict (the
+        # closest runnable parallel of the reference's docker run,
+        # README.md:19-35). The ADD transition is the one family that
+        # never touches node daemons, so the stage is safe on any
+        # topology; destroy/create coverage lives in the
+        # changing-validators profile.
+        return {"nemesis": jnemesis.compose([
+                    ({"start": "start", "stop": "stop"},
+                     jnemesis.partition_random_halves()),
+                    ({"transition": "transition"},
+                     ChangingValidatorsNemesis()),
+                    ({"crash": "crash"},
+                     CrashTruncateNemesis(
+                         test, "/jepsen/jepsen.db/000001.log")),
+                ]),
+                "generator": [gen.sleep(1),
+                              {"type": "info", "f": "start"},
+                              gen.sleep(2.5),
+                              {"type": "info", "f": "stop"},
+                              gen.sleep(0.5),
+                              gen.once(_add_transition_op),
+                              gen.sleep(0.5),
+                              {"type": "info", "f": "crash"}]}
     if kind == "local-kill":
         return {"nemesis": LocalKillNemesis(),
                 "generator": gen.cycle_gen([
@@ -429,10 +457,20 @@ class LocalKillNemesis(jnemesis.Nemesis):
         return None
 
 
+def _add_transition_op(test, ctx):
+    """One validator-set ADD against the LIVE config (a transactional
+    valset read via refresh_config, then a fresh random validator at
+    the read version — the version CAS proves the read was current)."""
+    cfg = test.get("refresh_config", refresh_config)(test)
+    return {"type": "info", "f": "transition",
+            "value": {"type": "add", "version": cfg["version"],
+                      "validator": tv.gen_validator()}}
+
+
 NEMESES = ["changing-validators", "peekaboo-dup-validators",
            "split-dup-validators", "half-partitions", "ring-partitions",
            "single-partitions", "clocks", "crash", "truncate-merkleeyes",
-           "truncate-tendermint", "local-kill", "none"]
+           "truncate-tendermint", "local-kill", "deployed-mix", "none"]
 
 
 # ------------------------------------------------------------ workloads

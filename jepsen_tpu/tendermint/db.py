@@ -52,8 +52,22 @@ laddr = "tcp://0.0.0.0:26657"
 """
 
 
-def base_dir(test) -> str:
+def node_base_dir(test, node) -> str:
+    """Per-node base dir. A real cluster shares BASE_DIR per machine;
+    a single-host multi-node deployment (Local remote, the docker-less
+    parallel of the reference's 5-container run, docker/README.md)
+    gives every node its own directory via test["base_dirs"]."""
+    dirs = test.get("base_dirs") or {}
+    if node is not None and node in dirs:
+        return dirs[node]
     return test.get("base_dir", BASE_DIR)
+
+
+def base_dir(test) -> str:
+    """The CURRENT node's base dir: inside on_nodes the control scope
+    carries the node, so every path helper below is per-node exactly
+    where commands run per-node."""
+    return node_base_dir(test, c.scope.host)
 
 
 def socket_file(test) -> str:
@@ -253,7 +267,14 @@ class TendermintDB(jdb.DB, jdb.Process, jdb.LogFiles):
 
         start_merkleeyes(test, node)
         start_tendermint(test, node)
-        nt.install()
+        if test.get("seed_app_valset") and node == consensus_node(test):
+            seed_app_valset(test, node)
+        with self._lock:
+            # /opt/jepsen is per-MACHINE: single-host multi-node
+            # deployments would otherwise race N gccs onto one binary;
+            # on a real cluster this merely serializes an idempotent
+            # per-node compile
+            nt.install()
 
     def teardown(self, test, node):
         try:
@@ -338,3 +359,80 @@ def http_transport_for(test, node):
     """transport factory for cluster mode: tendermint RPC on the node."""
     from jepsen_tpu.tendermint import client as tc
     return tc.HttpTransport(node)
+
+
+# ------------------------------------------- single-host cluster mode
+
+
+def seed_app_valset(test, node, timeout: float = 10.0) -> None:
+    """InitChain stand-in for stub-tendermint deployments (opt-in via
+    test["seed_app_valset"]): push the genesis validators into the
+    deployed app's validator set, which the REAL binary does on chain
+    start via ABCI InitChain (the reference leaves this to tendermint,
+    db.clj:49-56 only writes genesis.json). Without it the app's
+    valset is empty and the first refresh_config would reconcile the
+    genesis validators away. Polls the daemon's socket: start_daemon
+    backgrounds with no readiness wait."""
+    import time as _time
+
+    from jepsen_tpu.tendermint import client as tc
+    t = tc.SocketTransport(
+        ("unix", node_base_dir(test, node) + "/merkleeyes.sock"))
+    vc = test["validator_config"][0]
+    deadline = _time.monotonic() + timeout
+    for pub, v in sorted(vc["validators"].items()):
+        while True:
+            try:
+                tc.validator_set_change(t, pub, v["votes"])
+                break
+            except OSError:
+                if _time.monotonic() > deadline:
+                    raise
+                _time.sleep(0.05)
+
+
+def consensus_node(test) -> str:
+    """The node whose deployed merkleeyes stands in for the replicated
+    state machine under routed_transport_for."""
+    return test.get("consensus_node") or (test.get("nodes") or ["n1"])[0]
+
+
+class _PartitionedTransport:
+    """A transport on the wrong side of a grudge: every use times out.
+    Raised at USE (not open) so the clients' _map_errors taxonomy
+    applies — writes/cas surface as indeterminate :info, reads as
+    :fail — exactly how a minority node's RPC behaves in the real
+    cluster: the connection opens, the commit never comes."""
+
+    def __init__(self, node, target):
+        self.node, self.target = node, target
+
+    def _cut(self):
+        raise TimeoutError(
+            f"partition: {self.node} cannot reach {self.target}")
+
+    def broadcast_tx(self, tx):
+        self._cut()
+
+    def abci_query(self, path, data):
+        self._cut()
+
+
+def routed_transport_for(test, node):
+    """Cluster-mode transport for a single-host deployment (Local
+    remote): every client routes to the consensus node's DEPLOYED
+    merkleeyes socket — consensus collapses to one linearizable app,
+    as in local mode, but through the daemon TendermintDB actually
+    deployed and manages — and the route honors the test's net: a
+    client whose node holds a grudge against the consensus node gets
+    the partitioned transport above. The remaining distance to the
+    reference's semantics is real replication (the real tendermint
+    binary + docker, README.md:19-35)."""
+    from jepsen_tpu.tendermint import client as tc
+    target = consensus_node(test)
+    net = test.get("net")
+    if (net is not None and node is not None and node != target
+            and not net.reachable(node, target)):
+        return _PartitionedTransport(node, target)
+    return tc.SocketTransport(
+        ("unix", node_base_dir(test, target) + "/merkleeyes.sock"))

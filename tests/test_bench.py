@@ -126,12 +126,20 @@ def test_bench_big_shapes_preflight_on_cpu():
     must complete green on CPU inside the bench's own deadlines.
     (The device call itself is covered at these shapes by maxlen's CPU
     smoke at 51200 ops and the adv section contract test.)"""
+    import importlib
     from time import monotonic, perf_counter
 
     import bench
     from jepsen_tpu.checker import linear_packed
     from jepsen_tpu.parallel import bitdense
 
+    if bench.SMOKE or bench.ADV_K != 12:
+        # module-level shape constants read the env at import: pin the
+        # production shapes regardless of ambient BENCH_* vars (the
+        # sibling tests get this for free by running bench via _run())
+        for var in ("BENCH_SMOKE", "BENCH_ADV_K"):
+            os.environ.pop(var, None)
+        bench = importlib.reload(bench)
     assert bench.ADV_K == 12, "preflight must cover the bench's real k"
     for L in (10000, 50000):
         t0 = perf_counter()
