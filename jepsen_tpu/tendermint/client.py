@@ -82,14 +82,16 @@ class HttpTransport:
     """Tendermint RPC over HTTP (client.clj:79-102). Used against real
     clusters; requires network reachability to node:26657."""
 
-    def __init__(self, node: str, timeout: float = 10.0):
+    def __init__(self, node: str, timeout: float = 10.0,
+                 port: int = PORT):
         self.node = node
         self.timeout = timeout
+        self.port = port
 
     def _get(self, path: str, params: dict) -> dict:
         import urllib.parse
         import urllib.request
-        url = (f"http://{self.node}:{PORT}{path}?"
+        url = (f"http://{self.node}:{self.port}{path}?"
                + urllib.parse.urlencode(params))
         with urllib.request.urlopen(url, timeout=self.timeout) as resp:
             return _json.loads(resp.read().decode("utf-8"))

@@ -48,7 +48,7 @@ peer_gossip_sleep_duration = "10ms"
 flush_throttle_timeout = "10ms"
 
 [rpc]
-laddr = "tcp://0.0.0.0:26657"
+laddr = "tcp://0.0.0.0:{rpc_port}"
 """
 
 
@@ -88,6 +88,16 @@ def tendermint_log(test) -> str:
 
 def merkleeyes_pid(test) -> str:
     return base_dir(test) + "/merkleeyes.pid"
+
+
+def rpc_port(test, node=None) -> int:
+    """The node's tendermint RPC port. Real clusters keep the default
+    on every machine; single-host multi-node deployments give each
+    node its own via test["rpc_ports"]."""
+    ports = test.get("rpc_ports") or {}
+    if node is None:
+        node = c.scope.host
+    return int(ports.get(node, 26657))
 
 
 def tendermint_pid(test) -> str:
@@ -135,7 +145,7 @@ def write_config(test) -> None:
         fd, tmp = tempfile.mkstemp(suffix=".toml")
         try:
             with _os.fdopen(fd, "w") as f:
-                f.write(CONFIG_TOML)
+                f.write(CONFIG_TOML.format(rpc_port=rpc_port(test)))
             c.upload([tmp], base_dir(test) + "/config/config.toml")
         finally:
             _os.unlink(tmp)
@@ -181,6 +191,33 @@ def start_merkleeyes(test, node) -> str:
             "--proto", "abci",
             "--wal", base_dir(test) + "/jepsen/jepsen.db/000001.log")
     return "started"
+
+
+def await_tendermint_rpc(test, node, timeout: float) -> None:
+    """Bounded NODE-SIDE poll of tendermint's RPC /status endpoint —
+    a real readiness wait where the reference sleeps a flat second
+    after start (db.clj:204). Runs through the control plane (curl on
+    the node against its own localhost), so Local remotes and real
+    clusters behave identically. Raises TimeoutError when the RPC
+    never comes up."""
+    import time as _time
+    port = rpc_port(test, node)
+    deadline = _time.monotonic() + timeout
+    while True:
+        try:
+            c.exec_("curl", "-sf", "--max-time", "2",
+                    f"http://127.0.0.1:{port}/status")
+            return
+        except c.RemoteError as err:
+            if err.exit in (126, 127):
+                # missing/unrunnable curl is a node-image problem, not
+                # "not ready" — burning the timeout would misdirect
+                raise
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"tendermint RPC on {node}:{port} not ready after "
+                    f"{timeout}s")
+            _time.sleep(0.25)
 
 
 def stop_tendermint(test, node) -> str:
@@ -267,6 +304,8 @@ class TendermintDB(jdb.DB, jdb.Process, jdb.LogFiles):
 
         start_merkleeyes(test, node)
         start_tendermint(test, node)
+        if test.get("await_rpc_timeout"):
+            await_tendermint_rpc(test, node, test["await_rpc_timeout"])
         if test.get("seed_app_valset") and node == consensus_node(test):
             seed_app_valset(test, node)
         with self._lock:
@@ -356,9 +395,11 @@ def local_transport_for(test, node):
 
 
 def http_transport_for(test, node):
-    """transport factory for cluster mode: tendermint RPC on the node."""
+    """transport factory for cluster mode: tendermint RPC on the node,
+    at the node's configured port (test["rpc_ports"] honored end to
+    end: config.toml, readiness poll, and clients agree)."""
     from jepsen_tpu.tendermint import client as tc
-    return tc.HttpTransport(node)
+    return tc.HttpTransport(node, port=rpc_port(test, node))
 
 
 # ------------------------------------------- single-host cluster mode
