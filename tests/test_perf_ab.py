@@ -71,6 +71,36 @@ def test_cost_analysis_batch_populated_on_cpu():
     assert c["flops"] > 0 and c["bytes_accessed"] > 0, c
 
 
+def test_disagreeing_flags_wrong_variants_only():
+    """The correctness gate compares full results (verdict +
+    counterexample) against the while baseline, ignoring the closure
+    label, and names exactly the variants that differ."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "perf_ab", os.path.join(REPO, "tools", "perf_ab.py"))
+    perf_ab = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(perf_ab)
+
+    ok = {"valid?": True, "engine": "bitdense", "closure": "xla-while"}
+    same = dict(ok, closure="xla-fori")
+    wrong = dict(ok, closure="pallas")
+    wrong["valid?"] = False
+    assert perf_ab._disagreeing(
+        {"while": [ok], "fori": [same], "pallas": [dict(ok)]}) == set()
+    assert perf_ab._disagreeing(
+        {"while": [ok], "fori": [same], "pallas": [wrong]}) == {"pallas"}
+    # EVERY run counts: one early wrong answer flags even when the
+    # final run agrees (nondeterministic kernels must not slip through)
+    assert perf_ab._disagreeing(
+        {"while": [ok, ok], "fori": [wrong, same]}) == {"fori"}
+    # a nondeterministic BASELINE flags itself (vetoes everything)
+    assert perf_ab._disagreeing(
+        {"while": [ok, wrong], "fori": [same]}) == {"while"}
+    # batch form: run lists hold per-key result lists
+    assert perf_ab._disagreeing(
+        {"while": [[ok, ok]], "fori": [[same, wrong]]}) == {"fori"}
+
+
 @pytest.mark.slow
 def test_perf_ab_emits_cost_table_on_cpu():
     """Full smoke run of the harness: the aggregated cost_table line
@@ -94,4 +124,7 @@ def test_perf_ab_emits_cost_table_on_cpu():
             assert cost[variant]["program"] == f"xla-{variant}"
         assert cost["trips"]["scan_events"] > 0, (shape, cost)
         assert cost["trips"]["fori_closure"] > 0, (shape, cost)
+    # all three variants agreed on every shape (interpret-mode pallas
+    # included): the correctness gate must stay silent on a clean run
+    assert not [l for l in lines if "correctness_mismatch" in l], lines
     assert "verdict" in lines[-1]

@@ -12,7 +12,11 @@ real chip:
     BENCH_SMOKE=1 python tools/perf_ab.py  # tiny shapes (CI sanity)
 
 Measures, per shape, steady-state wall time (cold run first to absorb
-compiles; results fetched to host, so timings include the device sync):
+compiles; results fetched to host, so timings include the device sync),
+and CORRECTNESS: each variant's result is compared against the while
+baseline on every timed shape — a variant that ever disagrees is
+vetoed from the verdict regardless of its speed (the on-chip gate the
+pallas non-interpret lowering must pass before any default flip):
 
   single-key adversarial 1k / 10k   (the bench's headline shape)
   multi-key 84x120 batch            (the reference workload shape)
@@ -85,6 +89,41 @@ def _steady(fn):
     return best
 
 
+def _strip_closure(r):
+    if isinstance(r, list):
+        return [_strip_closure(x) for x in r]
+    return {k: v for k, v in r.items() if k != "closure"}
+
+
+def _timed(res: dict, name: str, check) -> float:
+    """Time `check` via _steady, recording the result of EVERY
+    execution (cold + each repeat) under res[name] — a
+    nondeterministically-wrong kernel that happens to answer
+    correctly on its last run must still flag."""
+    def f():
+        res.setdefault(name, []).append(check())
+    return _steady(f)
+
+
+def _disagreeing(results: dict) -> set:
+    """Correctness gate: every run of every measured variant must
+    return the SAME result (verdict + counterexample fields; the
+    closure label aside) as the while baseline's first run — a faster
+    wrong kernel must never win. Returns the variant names with any
+    disagreeing run (emitted; they veto the matching verdict below;
+    'while' itself can flag, vetoing everything: it means the
+    measurement is nondeterministic)."""
+    vals = {k: [_strip_closure(r) for r in runs]
+            for k, runs in results.items()}
+    base = vals["while"][0]
+    bad = {k for k, runs in vals.items()
+           if any(r != base for r in runs)}
+    if bad:
+        emit({"correctness_mismatch":
+              {k: vals[k] for k in sorted(bad | {"while"})}})
+    return bad
+
+
 def _probe_backend(timeout: float = 120.0):
     """Resolve the default backend in a THROWAWAY subprocess under a
     timeout: on this image a dead TPU tunnel blocks forever inside
@@ -144,6 +183,7 @@ def main():
     ratios = {}
     fori_ratios = {}
     cost_table = {}
+    bad_variants = set()       # variants that ever disagreed
 
     # ---- single-key adversarial ----
     for L in ([200, 400] if smoke else [1000, 10000]):
@@ -157,11 +197,17 @@ def main():
                 e, use_pallas=up, closure_mode=mode),
             pk.supported(S, C), e.n_returns, C)
         # while and fori are pure XLA: measured on EVERY shape — the
-        # fori decision must never be settled by a pallas support skip
-        t_xla = _steady(lambda: bitdense.check_encoded_bitdense(
-            e, use_pallas=False, closure_mode="while"))
-        t_fori = _steady(lambda: bitdense.check_encoded_bitdense(
-            e, use_pallas=False, closure_mode="fori"))
+        # fori decision must never be settled by a pallas support skip.
+        # Every execution's RESULT is captured for the correctness gate.
+        res = {}
+
+        def timed(name, **kw):
+            return _timed(res, name,
+                          lambda: bitdense.check_encoded_bitdense(
+                              e, **kw))
+
+        t_xla = timed("while", use_pallas=False, closure_mode="while")
+        t_fori = timed("fori", use_pallas=False, closure_mode="fori")
         fori_ratios[f"single-{L}"] = t_xla / t_fori
         line = {"shape": f"single-key {L}-op adversarial", "S": S,
                 "C": C,
@@ -169,13 +215,13 @@ def main():
                 "fori_secs": round(t_fori, 3),
                 "fori_speedup": round(t_xla / t_fori, 2)}
         if pk.supported(S, C):
-            t_pl = _steady(lambda: bitdense.check_encoded_bitdense(
-                e, use_pallas=True))
+            t_pl = timed("pallas", use_pallas=True)
             ratios[f"single-{L}"] = t_xla / t_pl
             line.update(pallas_secs=round(t_pl, 3),
                         pallas_speedup=round(t_xla / t_pl, 2))
         else:
             line["pallas_skipped"] = f"unsupported S={S} C={C}"
+        bad_variants |= _disagreeing(res)
         emit(line)
 
     # ---- multi-key batch ----
@@ -190,23 +236,27 @@ def main():
         lambda up, mode: bitdense.cost_analysis_batch(
             encs, use_pallas=up, closure_mode=mode),
         pk.supported(S, C), max(e.n_returns for e in encs), C)
-    t_xla = _steady(lambda: bitdense.check_batch_bitdense(
-        encs, use_pallas=False, closure_mode="while"))
-    t_fori = _steady(lambda: bitdense.check_batch_bitdense(
-        encs, use_pallas=False, closure_mode="fori"))
+    res = {}
+
+    def timed_batch(name, **kw):
+        return _timed(res, name,
+                      lambda: bitdense.check_batch_bitdense(encs, **kw))
+
+    t_xla = timed_batch("while", use_pallas=False, closure_mode="while")
+    t_fori = timed_batch("fori", use_pallas=False, closure_mode="fori")
     fori_ratios["batch"] = t_xla / t_fori
     line = {"shape": f"batch {n_keys}x{ops_per_key}", "S": S, "C": C,
             "xla_secs": round(t_xla, 3),
             "fori_secs": round(t_fori, 3),
             "fori_speedup": round(t_xla / t_fori, 2)}
     if pk.supported(S, C):
-        t_pl = _steady(lambda: bitdense.check_batch_bitdense(
-            encs, use_pallas=True))
+        t_pl = timed_batch("pallas", use_pallas=True)
         ratios["batch"] = t_xla / t_pl
         line.update(pallas_secs=round(t_pl, 3),
                     pallas_speedup=round(t_xla / t_pl, 2))
     else:
         line["pallas_skipped"] = f"unsupported S={S} C={C}"
+    bad_variants |= _disagreeing(res)
     emit(line)
 
     # analytical prior table: flops/bytes per (shape, variant) from
@@ -240,13 +290,22 @@ def main():
                         if fori_ratios
                         and min(fori_ratios.values()) >= 1.1
                         else "keep-while")
+        # correctness vetoes speed: a variant that EVER disagreed with
+        # the while baseline cannot become the default, whatever it won
+        if "pallas" in bad_variants or "while" in bad_variants:
+            verdict = "keep-opt-in (CORRECTNESS MISMATCH — see the " \
+                      "correctness_mismatch lines)"
+        if "fori" in bad_variants or "while" in bad_variants:
+            fori_verdict = "keep-while (CORRECTNESS MISMATCH — see " \
+                           "the correctness_mismatch lines)"
     emit({"backend": backend, "verdict": verdict,
           "fori_verdict": fori_verdict,
           "ratios": {k: round(v, 2) for k, v in ratios.items()},
           "fori_ratios": {k: round(v, 2) for k, v in fori_ratios.items()},
           "rule": "pallas default-on iff it wins >=1.1x on EVERY "
-                  "measured shape on the tpu backend; fori likewise "
-                  "vs the while closure (flip "
+                  "measured shape on the tpu backend AND never "
+                  "disagreed with the while baseline's results; fori "
+                  "likewise vs the while closure (flip "
                   "bitdense._resolve_closure_mode). If both win, "
                   "pallas takes precedence (it replaces the XLA loop "
                   "entirely)"})
