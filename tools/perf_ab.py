@@ -95,14 +95,33 @@ def _strip_closure(r):
     return {k: v for k, v in r.items() if k != "closure"}
 
 
-def _timed(res: dict, name: str, check) -> float:
+PROFILE_DIR = os.environ.get("PERF_AB_PROFILE")
+
+
+def _timed(res: dict, name: str, check, shape: str = "") -> float:
     """Time `check` via _steady, recording the result of EVERY
     execution (cold + each repeat) under res[name] — a
     nondeterministically-wrong kernel that happens to answer
-    correctly on its last run must still flag."""
+    correctly on its last run must still flag.
+
+    With PERF_AB_PROFILE=<dir>, one extra post-timing run per
+    (shape, variant) is captured under jax.profiler.trace into its own
+    subdirectory — the diagnosis artifact for WHERE the time goes
+    (dispatch/sync vs compute; the r3 multikey regression suspicion),
+    kept out of the timed runs so profiling overhead never skews the
+    measured ratios."""
     def f():
         res.setdefault(name, []).append(check())
-    return _steady(f)
+    t = _steady(f)
+    if PROFILE_DIR:
+        import jax
+        sub = os.path.join(PROFILE_DIR,
+                           f"{shape or 'shape'}-{name}".replace(" ", "_"))
+        os.makedirs(sub, exist_ok=True)
+        with jax.profiler.trace(sub):
+            check()
+        emit({"profile": sub, "shape": shape, "variant": name})
+    return t
 
 
 def _disagreeing(results: dict) -> set:
@@ -204,7 +223,8 @@ def main():
         def timed(name, **kw):
             return _timed(res, name,
                           lambda: bitdense.check_encoded_bitdense(
-                              e, **kw))
+                              e, **kw),
+                          shape=f"single-{L}")
 
         t_xla = timed("while", use_pallas=False, closure_mode="while")
         t_fori = timed("fori", use_pallas=False, closure_mode="fori")
@@ -240,7 +260,8 @@ def main():
 
     def timed_batch(name, **kw):
         return _timed(res, name,
-                      lambda: bitdense.check_batch_bitdense(encs, **kw))
+                      lambda: bitdense.check_batch_bitdense(encs, **kw),
+                      shape="batch")
 
     t_xla = timed_batch("while", use_pallas=False, closure_mode="while")
     t_fori = timed_batch("fori", use_pallas=False, closure_mode="fori")
