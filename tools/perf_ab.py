@@ -114,14 +114,34 @@ def _timed(res: dict, name: str, check, shape: str = "") -> float:
         res.setdefault(name, []).append(check())
     t = _steady(f)
     if PROFILE_DIR:
-        import jax
-        sub = os.path.join(PROFILE_DIR,
-                           f"{shape or 'shape'}-{name}".replace(" ", "_"))
-        os.makedirs(sub, exist_ok=True)
-        with jax.profiler.trace(sub):
-            check()
-        emit({"profile": sub, "shape": shape, "variant": name})
+        try:
+            import jax
+            sub = os.path.join(
+                PROFILE_DIR, _run_token(),
+                f"{shape or 'shape'}-{name}".replace(" ", "_"))
+            os.makedirs(sub, exist_ok=True)
+            with jax.profiler.trace(sub):
+                f()          # result feeds the correctness gate too
+            emit({"profile": sub, "shape": shape, "variant": name})
+        except Exception as err:  # noqa: BLE001 — the capture is
+            # advisory, never fatal: timings and verdict already stand
+            emit({"profile_error": repr(err), "shape": shape,
+                  "variant": name})
     return t
+
+
+_RUN_TOKEN = None
+
+
+def _run_token() -> str:
+    """One fresh subdirectory per harness invocation, so re-running
+    into the same PERF_AB_PROFILE dir never mixes trace sessions."""
+    global _RUN_TOKEN
+    if _RUN_TOKEN is None:
+        from datetime import datetime
+        _RUN_TOKEN = (datetime.now().strftime("%Y%m%d-%H%M%S")
+                      + f"-p{os.getpid()}")
+    return _RUN_TOKEN
 
 
 def _disagreeing(results: dict) -> set:
