@@ -454,11 +454,17 @@ def main():
 
     def probe_once():
         parsed, st = run_section(["probe"], probe_to)
+        probe_parsed[:] = parsed
         ok = (st == "ok"
               and any(p.get("metric") == "device pre-probe"
                       and p.get("value") for p in parsed))
         return ok, st
 
+    def probe_platform():
+        return next((p.get("platform") for p in probe_parsed
+                     if p.get("metric") == "device pre-probe"), None)
+
+    probe_parsed = []
     probe_ok, st = probe_once()
     if not probe_ok and left() > probe_to + 60:
         # one retry: a single probe hang/crash must not relabel a
@@ -572,6 +578,8 @@ def main():
               "value": ten_k["value"],
               "unit": "ops/sec",
               "vs_baseline": ten_k.get("vs_baseline"),
+              "backend": probe_platform(),
+              "closure": ten_k.get("closure"),
               "methodology": "vs this repo's packed int-config host "
                              "engine (same algorithm and encoding as "
                              "the device; our fastest CPU "
@@ -586,7 +594,9 @@ def main():
                         f"cas-register, device end-to-end",
               "value": mk_line["value"],
               "unit": "ops/sec",
-              "vs_baseline": mk_line.get("vs_baseline")})
+              "vs_baseline": mk_line.get("vs_baseline"),
+              "backend": probe_platform(),
+              "closure": mk_line.get("closure")})
     else:
         # EVERY device section hung or crashed — almost certainly a
         # dead TPU runtime (observed in the wild: the tunnel wedges
