@@ -15,12 +15,15 @@ a reshape/flip — a pure VMEM permutation, no HBM gathers.
 SURVEY.md §7.1 step 4: "Pallas kernels where XLA fuses poorly (hash
 probe, bitset ops)". This is the bitset-ops kernel.
 
-Enabled via JEPSEN_TPU_PALLAS=1 (read at trace time by
-parallel.bitdense) or the explicit `closure_fixpoint` call; shapes are
-gated to W >= 128 (one full lane tile) and S <= 64 (the s-axis
-reduction is trace-unrolled). CI differential-tests the kernel in
-interpreter mode on CPU; on hardware it is opt-in until measured —
-flags do not get to claim speedups.
+Default ON for a real-TPU platform since the r5 on-chip A/B
+(tools/perf_ab.py: 18.9x on single-1k, 54.4x on single-10k, 1.42x on
+the 84x120 batch vs the XLA while closure, bit-identical results on
+every run; JEPSEN_TPU_PALLAS=0 opts out, =1 forces interpret mode
+elsewhere). Shapes are gated to W >= 128 (one full lane tile) and
+S <= 64 (the s-axis reduction is trace-unrolled). CI
+differential-tests the kernel in interpreter mode on CPU; the default
+flipped only when the hardware measurement landed — flags do not get
+to claim speedups.
 """
 
 from __future__ import annotations
@@ -46,10 +49,17 @@ def supported(S: int, C: int) -> bool:
 
 def _xor_shuffle(G, jb: int):
     """y[..., w] = x[..., w ^ jb] for power-of-two jb: swap adjacent
-    jb-wide halves — a reshape/flip, no gather."""
+    jb-wide halves. Spelled as two lane-rotations + per-lane select:
+    Mosaic has no `rev` lowering (jnp.flip dies) and rejects 4-D
+    reshapes of the lane axis (vector<SxW> -> vector<SxW/2x2x1> is an
+    "unsupported shape cast") — both discovered on the real chip;
+    interpret mode accepts either spelling. Verified on v5e: jnp.roll
+    lowers to supported lane shifts."""
     S, W = G.shape
-    G4 = G.reshape(S, W // (2 * jb), 2, jb)
-    return jnp.flip(G4, axis=2).reshape(S, W)
+    up = jnp.roll(G, -jb, axis=1)               # y[w] = G[w + jb]
+    dn = jnp.roll(G, jb, axis=1)                # y[w] = G[w - jb]
+    wid = lax.broadcasted_iota(jnp.int32, (S, W), 1)
+    return jnp.where((wid & jb) == 0, up, dn)
 
 
 def _closure_kernel(plan, S: int, C: int, W: int,

@@ -197,9 +197,10 @@ def test_pallas_closure_under_shard_map_interpret():
 
 def test_batch_pallas_on_mesh_differential():
     """check_batch_bitdense with the key axis sharded over the 8-device
-    mesh and the pallas closure forced on (the default keeps mesh
-    batches on XLA until the hardware A/B): verdicts and fail events
-    must match the XLA path on the same mesh."""
+    mesh and the pallas closure forced on (on this CPU mesh the default
+    resolves to XLA; on a real-TPU mesh it is pallas since the r5
+    on-chip A/B): verdicts and fail events must match the XLA path on
+    the same mesh."""
     import jax
     from jax.sharding import Mesh
 
@@ -231,15 +232,29 @@ def test_batch_pallas_on_mesh_differential():
     for rx, rp in zip(rs_xla, rs_pl):
         assert rx.get("fail-event") == rp.get("fail-event")
 
-    # and on a TPU-platform mesh the DEFAULT stays on XLA pending the
-    # on-chip A/B even with the env opt-in set (the guard keys off the
-    # mesh's platform, so it must be stubbed on the CPU test mesh)
+    # default resolution after the r5 on-chip A/B verdict (default-on,
+    # every shape won, zero disagreements): a real-TPU platform gets
+    # pallas non-interpret by default, JEPSEN_TPU_PALLAS=0 opts out,
+    # and non-TPU platforms stay off unless the flag forces interpret
+    import os as _os
     import unittest.mock as mock
-    with mock.patch.dict(__import__("os").environ,
-                         {"JEPSEN_TPU_PALLAS": "1"}),             mock.patch.object(bitdense, "is_tpu_platform",
-                              side_effect=lambda p: True):
-        rs_default = bitdense.check_batch_bitdense(encs, mesh=mesh)
-    assert all(r["closure"].startswith("xla") for r in rs_default)
+    env = dict(_os.environ)
+    env.pop("JEPSEN_TPU_PALLAS", None)   # hermetic: a developer's
+    with mock.patch.dict(_os.environ, env, clear=True):   # exported
+        # flag must not flip these default-resolution asserts
+        assert bitdense._resolve_use_pallas(None, 17, 12, "axon") \
+            == (True, False)
+        assert bitdense._resolve_use_pallas(None, 17, 12, "cpu") \
+            == (False, True)
+        # unsupported shapes still downgrade regardless of platform
+        assert bitdense._resolve_use_pallas(None, 128, 12, "axon")[0] \
+            is False
+    with mock.patch.dict(_os.environ, {"JEPSEN_TPU_PALLAS": "0"}):
+        assert bitdense._resolve_use_pallas(None, 17, 12, "axon") \
+            == (False, False)
+    with mock.patch.dict(_os.environ, {"JEPSEN_TPU_PALLAS": "1"}):
+        assert bitdense._resolve_use_pallas(None, 17, 12, "cpu") \
+            == (True, True)
 
 
 def test_fori_closure_mode_differential():

@@ -41,6 +41,24 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 SMOKE = os.environ.get("BENCH_SMOKE") == "1"
 REPEATS = 3
 
+# PERF_AB_VARIANTS=while,pallas skips the others. Exists because a
+# variant can crash the TPU *worker process* (observed r5: the fori
+# closure on the 10k adversarial shape took down the worker), which
+# poisons the whole PJRT client — no in-process try/except can recover
+# it. A skipped variant keeps its prior verdict; 'while' (the baseline)
+# is always measured.
+_VARIANTS = {v.strip() for v in os.environ.get(
+    "PERF_AB_VARIANTS", "while,fori,pallas").split(",") if v.strip()}
+_UNKNOWN = _VARIANTS - {"while", "fori", "pallas"}
+if _UNKNOWN:   # a typo must not silently skip a real variant
+    raise SystemExit(f"PERF_AB_VARIANTS: unknown variant(s) "
+                     f"{sorted(_UNKNOWN)}; valid: while,fori,pallas")
+_VARIANTS.add("while")
+
+
+def _want(name: str) -> bool:
+    return name in _VARIANTS
+
 
 def emit(obj):
     print(json.dumps(obj), flush=True)
@@ -247,19 +265,30 @@ def main():
                           shape=f"single-{L}")
 
         t_xla = timed("while", use_pallas=False, closure_mode="while")
-        t_fori = timed("fori", use_pallas=False, closure_mode="fori")
-        fori_ratios[f"single-{L}"] = t_xla / t_fori
         line = {"shape": f"single-key {L}-op adversarial", "S": S,
                 "C": C,
-                "xla_secs": round(t_xla, 3),
-                "fori_secs": round(t_fori, 3),
-                "fori_speedup": round(t_xla / t_fori, 2)}
-        if pk.supported(S, C):
-            t_pl = timed("pallas", use_pallas=True)
-            ratios[f"single-{L}"] = t_xla / t_pl
-            line.update(pallas_secs=round(t_pl, 3),
-                        pallas_speedup=round(t_xla / t_pl, 2))
-        else:
+                "xla_secs": round(t_xla, 3)}
+        if _want("fori"):
+            t_fori = timed("fori", use_pallas=False,
+                           closure_mode="fori")
+            fori_ratios[f"single-{L}"] = t_xla / t_fori
+            line.update(fori_secs=round(t_fori, 3),
+                        fori_speedup=round(t_xla / t_fori, 2))
+        if _want("pallas") and pk.supported(S, C):
+            # a variant that fails to COMPILE (e.g. a Mosaic lowering
+            # gap only the real chip reveals — the r5 jnp.flip `rev`
+            # find) must veto itself, not kill the while/fori
+            # measurements the bench decision also needs
+            try:
+                t_pl = timed("pallas", use_pallas=True)
+            except Exception as err:  # noqa: BLE001
+                line["pallas_error"] = repr(err)[:300]
+                bad_variants.add("pallas")
+            else:
+                ratios[f"single-{L}"] = t_xla / t_pl
+                line.update(pallas_secs=round(t_pl, 3),
+                            pallas_speedup=round(t_xla / t_pl, 2))
+        elif _want("pallas"):
             line["pallas_skipped"] = f"unsupported S={S} C={C}"
         bad_variants |= _disagreeing(res)
         emit(line)
@@ -284,18 +313,25 @@ def main():
                       shape="batch")
 
     t_xla = timed_batch("while", use_pallas=False, closure_mode="while")
-    t_fori = timed_batch("fori", use_pallas=False, closure_mode="fori")
-    fori_ratios["batch"] = t_xla / t_fori
     line = {"shape": f"batch {n_keys}x{ops_per_key}", "S": S, "C": C,
-            "xla_secs": round(t_xla, 3),
-            "fori_secs": round(t_fori, 3),
-            "fori_speedup": round(t_xla / t_fori, 2)}
-    if pk.supported(S, C):
-        t_pl = timed_batch("pallas", use_pallas=True)
-        ratios["batch"] = t_xla / t_pl
-        line.update(pallas_secs=round(t_pl, 3),
-                    pallas_speedup=round(t_xla / t_pl, 2))
-    else:
+            "xla_secs": round(t_xla, 3)}
+    if _want("fori"):
+        t_fori = timed_batch("fori", use_pallas=False,
+                             closure_mode="fori")
+        fori_ratios["batch"] = t_xla / t_fori
+        line.update(fori_secs=round(t_fori, 3),
+                    fori_speedup=round(t_xla / t_fori, 2))
+    if _want("pallas") and pk.supported(S, C):
+        try:
+            t_pl = timed_batch("pallas", use_pallas=True)
+        except Exception as err:  # noqa: BLE001
+            line["pallas_error"] = repr(err)[:300]
+            bad_variants.add("pallas")
+        else:
+            ratios["batch"] = t_xla / t_pl
+            line.update(pallas_secs=round(t_pl, 3),
+                        pallas_speedup=round(t_xla / t_pl, 2))
+    elif _want("pallas"):
         line["pallas_skipped"] = f"unsupported S={S} C={C}"
     bad_variants |= _disagreeing(res)
     emit(line)
@@ -324,23 +360,35 @@ def main():
         verdict = "no-verdict (non-tpu backend: interpret-mode timings)"
         fori_verdict = verdict
     else:
-        verdict = ("default-on"
-                   if ratios and min(ratios.values()) >= 1.1
-                   else "keep-opt-in")
-        fori_verdict = ("default-fori"
-                        if fori_ratios
-                        and min(fori_ratios.values()) >= 1.1
-                        else "keep-while")
+        # a variant filtered out by PERF_AB_VARIANTS was not measured —
+        # its verdict line must say so, never a definitive keep/flip
+        # (the run's reader would otherwise revert a default that this
+        # run produced no evidence against)
+        if not _want("pallas"):
+            verdict = "not-measured (pallas skipped by PERF_AB_VARIANTS)"
+        else:
+            verdict = ("default-on"
+                       if ratios and min(ratios.values()) >= 1.1
+                       else "keep-opt-in")
+        if not _want("fori"):
+            fori_verdict = ("not-measured (fori skipped by "
+                            "PERF_AB_VARIANTS)")
+        else:
+            fori_verdict = ("default-fori"
+                            if fori_ratios
+                            and min(fori_ratios.values()) >= 1.1
+                            else "keep-while")
         # correctness vetoes speed: a variant that EVER disagreed with
         # the while baseline cannot become the default, whatever it won
         if "pallas" in bad_variants or "while" in bad_variants:
-            verdict = "keep-opt-in (CORRECTNESS MISMATCH — see the " \
-                      "correctness_mismatch lines)"
+            verdict = "keep-opt-in (VARIANT VETOED — see the " \
+                      "correctness_mismatch / pallas_error lines)"
         if "fori" in bad_variants or "while" in bad_variants:
-            fori_verdict = "keep-while (CORRECTNESS MISMATCH — see " \
-                           "the correctness_mismatch lines)"
+            fori_verdict = "keep-while (VARIANT VETOED — see the " \
+                           "correctness_mismatch lines)"
     emit({"backend": backend, "verdict": verdict,
           "fori_verdict": fori_verdict,
+          "variants_measured": sorted(_VARIANTS),
           "ratios": {k: round(v, 2) for k, v in ratios.items()},
           "fori_ratios": {k: round(v, 2) for k, v in fori_ratios.items()},
           "rule": "pallas default-on iff it wins >=1.1x on EVERY "
