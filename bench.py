@@ -285,7 +285,8 @@ def sec_adv(L: int, host_deadline: float, skip_host: bool,
                       "32x scaling applies"})
 
 
-def sec_sharded(L: int, host_est: float | None):
+def sec_sharded(L: int, host_est: float | None,
+                cap_log: int | None = None):
     import jax
     import numpy as np
     from jax.sharding import Mesh
@@ -293,20 +294,30 @@ def sec_sharded(L: int, host_est: float | None):
 
     _, _, e = _adv_encoded(L)
     mesh = Mesh(np.array(jax.devices()), ("frontier",))
-    cap0 = (1 << 12) if SMOKE else (1 << 17)
+    # cap_log is the parent's downshift lever: the r5 chip session saw
+    # the 2^17-capacity program crash the TPU *worker process* on its
+    # first hardware contact, so a crashed first attempt is retried in
+    # a fresh child at a smaller tier — an overflowed "unknown" line
+    # still beats no line. The downshift also caps GROWTH below the
+    # known-fatal tier (2^17): overflow-doubling from 2^13 would
+    # otherwise climb right back into it.
+    if cap_log is not None:
+        cap0, max_cap = 1 << cap_log, 1 << min(cap_log + 3, 16)
+    else:
+        cap0, max_cap = ((1 << 12) if SMOKE else (1 << 17)), 1 << 20
     t0 = perf_counter()
     r = sharded.check_encoded_sharded(e, mesh, capacity=cap0,
-                                      max_capacity=1 << 20)
+                                      max_capacity=max_cap)
     warm = perf_counter() - t0
     cap = r.get("capacity", cap0)
     if cap != cap0:
         # capacity grew during the warm run: compile the final tier
         # before measuring, so the steady number holds no compile
         sharded.check_encoded_sharded(e, mesh, capacity=cap,
-                                      max_capacity=1 << 20)
+                                      max_capacity=max_cap)
     t0 = perf_counter()
     r = sharded.check_encoded_sharded(e, mesh, capacity=cap,
-                                      max_capacity=1 << 20)
+                                      max_capacity=max_cap)
     dev_secs = perf_counter() - t0
     line = {"metric": f"adversarial {L}-op via frontier-sharded engine",
             "value": round(L / dev_secs, 1), "unit": "ops/sec",
@@ -552,8 +563,38 @@ def main():
     # ---------------- 3. sharded engine on the local mesh ----------
     pick = 10000 if not SMOKE else (400 if 400 in adv_results else None)
     if probe_ok and pick in adv_results and left() > 120:
-        run_section(["sharded", pick,
-                     adv_results[pick].get("host_est_secs") or ""],
+        parsed, st = run_section(
+            ["sharded", pick,
+             adv_results[pick].get("host_est_secs") or ""],
+            min(sec_timeout("sharded"), left()))
+        if st != "ok" and not any(p.get("value") for p in parsed) \
+                and not SMOKE and left() > 180:
+            # r5 on-chip: the default 2^17-capacity program crashed
+            # the TPU worker (child rc=1, PJRT client dead). A fresh
+            # child at a smaller tier can still land a sharded line —
+            # possibly an "unknown" overflow, which is honest evidence.
+            # SMOKE already runs the smallest sensible tier (2^12), so
+            # a downshift retry only exists for the production shape.
+            # A HUNG child usually means the runtime wedged (a tunnel
+            # outage survives worker restarts), where any retry just
+            # burns another timeout — a crashed worker restarts, a
+            # wedge doesn't, so gate the retry on a short re-probe.
+            retry_ok = True
+            if st == "hung":
+                probe2, p2st = run_section(["probe"], 90)
+                if p2st != "ok" or not any(
+                        p.get("value") for p in probe2):
+                    note("sharded section hung and the runtime no "
+                         "longer answers a probe — skipping the "
+                         "downshift retry (wedged, not crashed)")
+                    retry_ok = False
+            if retry_ok:
+                note("sharded section crashed/hung; retrying in a "
+                     "fresh child at capacity 2^13")
+                run_section(
+                    ["sharded", pick,
+                     adv_results[pick].get("host_est_secs") or "",
+                     "13"],
                     min(sec_timeout("sharded"), left()))
 
     # ---------------- 4. max length verified @ 60s -----------------
@@ -678,7 +719,8 @@ def child_main(argv: list) -> None:
     elif sec == "sharded":
         L = int(argv[1])
         host_est = float(argv[2]) if len(argv) > 2 and argv[2] else None
-        sec_sharded(L, host_est)
+        cap_log = int(argv[3]) if len(argv) > 3 and argv[3] else None
+        sec_sharded(L, host_est, cap_log)
     elif sec == "maxlen":
         sec_maxlen(float(argv[1]))
     else:
