@@ -225,3 +225,51 @@ def test_fuzz_fake_device_invalid_ends_in_correct_verdict():
                              {k: r[k] for k in r
                               if k != "final-paths"}))
     assert not failures, failures
+
+
+@pytest.mark.fuzz
+def test_fuzz_pallas_agrees_with_xla_closure():
+    """Randomized pallas-vs-XLA-closure differential on kernel-
+    supported shapes. The main fuzz loop's shapes sit below the pallas
+    gate (C >= 12 means 2^12-config mask spaces, where the WGL oracle
+    cannot terminate), so the kernel's fuzz oracle is the XLA while
+    closure itself — the same algebra under a different execution,
+    exactly the equivalence the r5 on-chip A/B correctness gate
+    enforced. Verdicts AND fail events must match on clean (valid by
+    construction) and value-corrupted variants; pallas now being the
+    real-TPU default makes this the default-path fuzz."""
+    from jepsen_tpu.histories import adversarial_register_history
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.parallel import pallas_kernels as pk
+
+    failures = []
+    runs = 0
+    for seed in range(max(3, N_SEEDS)):
+        # FIXED op counts so compiled shapes repeat across seeds (each
+        # distinct length is a fresh XLA CPU compile); k varies the
+        # mask-space width across the kernel's W tiers
+        n_ops = (48, 96, 144)[seed % 3]
+        k = (11, 12)[seed % 2]
+        for variant in ("clean", "corrupt"):
+            h = adversarial_register_history(
+                n_ops=n_ops, k_crashed=k, seed=3000 + seed)
+            if variant == "corrupt":
+                h = corrupt_history(h, seed=seed, n_corruptions=1)
+            e = enc_mod.encode(CASRegister(), h)
+            S, C = bitdense.n_states(e), max(5, e.n_slots)
+            if not pk.supported(S, C):
+                continue
+            r_xla = bitdense.check_encoded_bitdense(
+                e, use_pallas=False, closure_mode="while")
+            r_pl = bitdense.check_encoded_bitdense(e, use_pallas=True)
+            # guard vacuity: if the resolve logic ever downgrades an
+            # explicit use_pallas=True, this would silently compare
+            # xla against xla
+            assert r_pl["closure"] == "pallas", r_pl
+            runs += 1
+            strip = lambda r: {k_: v for k_, v in r.items()  # noqa: E731
+                               if k_ != "closure"}
+            if strip(r_xla) != strip(r_pl):
+                failures.append((seed, variant, n_ops, k, r_xla, r_pl))
+    assert not failures, failures
+    assert runs >= 2 * max(3, N_SEEDS) - 1, runs

@@ -69,6 +69,27 @@ def test_pallas_closure_vs_semantic_oracle(seed):
                                 f"words differ"
 
 
+def test_xor_shuffle_is_the_xor_permutation():
+    """_xor_shuffle must realise y[..., w] = x[..., w ^ jb] exactly,
+    for every power-of-two stride the kernel uses (jb = 1 .. W/2).
+    Guards the r5 rewrite: the original reshape/flip spelling was
+    semantically identical but uncompilable by Mosaic (no `rev`, no
+    4-D lane reshape), so the spelling changed on-chip — this pins the
+    permutation itself, independent of the full-kernel differential."""
+    import jax
+
+    rng = np.random.default_rng(11)
+    for S, W in ((13, 256), (6, 128)):
+        x = rng.integers(0, 2**32, size=(S, W), dtype=np.uint32)
+        jb = 1
+        while jb <= W // 2:
+            got = np.asarray(jax.jit(
+                pk._xor_shuffle, static_argnums=1)(x, jb))
+            want = x[:, np.arange(W) ^ jb]
+            np.testing.assert_array_equal(got, want, err_msg=f"jb={jb}")
+            jb <<= 1
+
+
 def test_pallas_supported_gate():
     assert pk.supported(6, 12)       # W=128
     assert not pk.supported(6, 11)   # W=64: below one lane tile
