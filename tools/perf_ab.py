@@ -336,6 +336,80 @@ def main():
     bad_variants |= _disagreeing(res)
     emit(line)
 
+    # ---- batch bucketing strategy (advisory measurement) ----
+    # The bench's 84-key batch pads every key to the max slot count
+    # (the r5 run: slots 11..15 -> one C=15 / W=1024 program; keys
+    # needing W=64 pay 16x the word-work), because engine.check_batch's
+    # power-of-two tiers put slots 9..16 in ONE tier. Exact-C grouping
+    # trades ~2.9x less word-work against one compile + dispatch per
+    # group. This measures that trade on the same encs; a measured win
+    # here is the evidence for changing engine.check_batch's bucketing
+    # (no default flips from this line — it's a strategy prior, and on
+    # CPU it mostly measures compile count).
+    from collections import defaultdict
+    groups = defaultdict(list)           # C -> [(orig_idx, enc)]
+    for i, e in enumerate(encs):
+        groups[max(5, e.n_slots)].append((i, e))
+    if len(groups) > 1:
+        gres = {}
+
+        def run_grouped(**kw):
+            outs = [None] * len(encs)
+            for cc in sorted(groups):
+                idxs = [i for i, _ in groups[cc]]
+                rs = bitdense.check_batch_bitdense(
+                    [e for _, e in groups[cc]], **kw)
+                for i, r in zip(idxs, rs):
+                    outs[i] = r
+            return outs
+
+        def timed_grouped(name, **kw):
+            return _timed(gres, name, lambda: run_grouped(**kw),
+                          shape="batch-bucketed")
+
+        t_gx = timed_grouped("while", use_pallas=False,
+                             closure_mode="while")
+        gline = {"shape": f"batch {n_keys}x{ops_per_key} exact-C "
+                          f"bucketed ({len(groups)} groups)",
+                 "groups": {str(cc): len(g)
+                            for cc, g in sorted(groups.items())},
+                 "xla_secs": round(t_gx, 3),
+                 "xla_vs_padded": round(t_xla / t_gx, 2)}
+        if _want("pallas"):
+            # groups below the kernel floor (W < 128) downgrade to the
+            # XLA closure inside _resolve_use_pallas — exactly what the
+            # real-TPU default does per shape, so the mixed execution
+            # IS the default path; the per-group closure labels say
+            # which groups ran which
+            try:
+                t_gp = timed_grouped("pallas", use_pallas=True)
+            except Exception as err:  # noqa: BLE001
+                gline["pallas_error"] = repr(err)[:300]
+            else:
+                # label each group by the closure that actually RAN
+                # (stamped on the result rows by the engine's own
+                # resolve), not a harness-side re-derivation of the gate
+                first_run = gres["pallas"][0]
+                gline.update(
+                    pallas_secs=round(t_gp, 3),
+                    pallas_closures={
+                        str(cc): first_run[g[0][0]]["closure"]
+                        for cc, g in sorted(groups.items())})
+                # ratio only against the PADDED BATCH's own pallas
+                # timing ("pallas_secs" in line proves it completed);
+                # res["pallas"] being non-empty is not enough — a
+                # partial batch failure would leave t_pl holding the
+                # single-key loop's value
+                if "pallas_secs" in line:
+                    gline["pallas_vs_padded"] = round(t_pl / t_gp, 2)
+        # correctness: run_grouped restores original key order, so the
+        # comparison against the padded batch's while baseline is exact
+        base = _strip_closure(res["while"][0])
+        for gname, gruns in gres.items():
+            if any(_strip_closure(gr) != base for gr in gruns):
+                gline[f"{gname}_mismatch"] = True
+        emit(gline)
+
     # analytical prior table: flops/bytes per (shape, variant) from
     # XLA's trace-time cost model — exists without any chip; once a
     # measurement lands, a large disagreement between the prior's
