@@ -750,18 +750,33 @@ def encode_batch(model, histories, pad_slots: Optional[int] = None,
 
 
 def check_batch(model, histories, capacity: int = 512,
-                max_capacity: int = 1 << 18, mesh=None) -> list:
+                max_capacity: int = 1 << 18, mesh=None,
+                bucket: str = "tier") -> list:
     """Check many per-key histories in one device program per
-    slot-window tier: vmap over the key axis; with a mesh (and K
+    slot-window bucket: vmap over the key axis; with a mesh (and K
     divisible by its size) the key axis is sharded across devices —
     data parallelism over ICI.
 
-    Keys are bucketed by power-of-two slot-window width before padding:
-    one wide key (say C=20) must not force every narrow key through a
-    2^20-mask program (measured on v5e: a 336-key batch with a C=20
-    straggler ran ~6x slower un-bucketed). Each bucket independently
-    dispatches to the bit-packed dense engine (parallel.bitdense) when
-    its combined padded dims fit, sparse frontier mode otherwise."""
+    `bucket` picks the grouping strategy before padding:
+
+    - "tier" (default): power-of-two slot-window tiers — one wide key
+      (say C=20) must not force every narrow key through a 2^20-mask
+      program (measured on v5e: a 336-key batch with a C=20 straggler
+      ran ~6x slower un-bucketed).
+    - "exact": one bucket per exact slot count. Tiers are coarse at
+      the top of a tier: the reference workload's 84 keys span slots
+      11..15 — one tier — so all pad to W=1024 while most need 256 or
+      less (~2.9x the word-work). Exact buckets trade that against one
+      compile + dispatch per distinct C. tools/perf_ab.py measures the
+      trade ("batch ... exact-C bucketed" line); stays opt-in until an
+      on-chip win is recorded there — flags do not get to claim
+      speedups.
+
+    Each bucket independently dispatches to the bit-packed dense
+    engine (parallel.bitdense) when its combined padded dims fit,
+    sparse frontier mode otherwise."""
+    if bucket not in ("tier", "exact"):
+        raise ValueError(f"unknown bucket strategy {bucket!r}")
     if not histories:
         return []
     from jepsen_tpu.parallel import bitdense
@@ -769,8 +784,15 @@ def check_batch(model, histories, capacity: int = 512,
     out: list = [None] * len(pre)
     buckets: dict = {}
     for i, e in enumerate(pre):
-        tier = 1 << max(2, (max(1, e.n_slots) - 1).bit_length())
-        buckets.setdefault(tier, []).append(i)
+        if bucket == "exact":
+            # floor at bitdense's min_slots=5: narrower keys pad to
+            # the same C=5 program anyway, so splitting them would be
+            # pure dispatch overhead (and perf_ab's measured grouping
+            # uses the same floor)
+            key = max(5, e.n_slots)
+        else:
+            key = 1 << max(2, (max(1, e.n_slots) - 1).bit_length())
+        buckets.setdefault(key, []).append(i)
     for tier in sorted(buckets):
         idxs = buckets[tier]
         sub = [pre[i] for i in idxs]

@@ -634,6 +634,27 @@ def test_check_batch_c_tier_bucketing():
     assert rs[-1]["valid?"] == "unknown"         # wide bucket overflowed
 
 
+def test_check_batch_exact_bucketing_matches_tier():
+    """bucket="exact" (one program per distinct slot count — the
+    opt-in strategy tools/perf_ab.py's bucketed line measures) must be
+    verdict- and localization-identical to the default tiers on a
+    mixed-C batch with an invalid key; a bogus strategy name raises."""
+    batch = [rand_register_history(n_ops=40, n_processes=3 + (s % 4),
+                                   crash_p=0.04, seed=500 + s)
+             for s in range(8)]
+    batch[5] = corrupt_history(batch[5], seed=3, n_corruptions=2)
+    rs_tier = engine.check_batch(CASRegister(), batch, capacity=128,
+                                 max_capacity=4096)
+    rs_exact = engine.check_batch(CASRegister(), batch, capacity=128,
+                                  max_capacity=4096, bucket="exact")
+    strip = lambda rs: [{k: v for k, v in r.items()  # noqa: E731
+                         if k != "closure"} for r in rs]
+    assert strip(rs_tier) == strip(rs_exact)
+    assert rs_exact[5]["valid?"] is False
+    with pytest.raises(ValueError, match="bucket"):
+        engine.check_batch(CASRegister(), [], bucket="bogus")
+
+
 def test_dispatcher_jax_route():
     from jepsen_tpu.checker import linearizable
     h = _h(
