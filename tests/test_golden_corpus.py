@@ -54,6 +54,32 @@ def test_golden_corpus_all_engines(entry):
 
 
 @pytest.mark.fuzz
+@pytest.mark.parametrize("entry", [e for e in MANIFEST
+                                   if "1k-crashheavy" in e["file"]],
+                         ids=lambda e: e["file"])
+def test_golden_corpus_pallas_closure(entry):
+    """The corpus entries wide enough for the VMEM kernel (C >= 12 —
+    the two 1k crash-heavy registers) must reproduce their recorded
+    verdicts through the forced pallas path (interpret mode on this
+    CPU backend; the closure label proves no silent downgrade). Pallas
+    is the real-TPU default since the r5 on-chip A/B, so the corpus
+    contract extends to it."""
+    from jepsen_tpu.parallel import bitdense, pallas_kernels as pk
+    from jepsen_tpu.parallel import encode as enc_mod
+
+    h = History.from_edn((GOLDEN / entry["file"]).read_text()).index()
+    e = enc_mod.encode(MODELS[entry["model"]](), h)
+    S, C = bitdense.n_states(e), max(5, e.n_slots)
+    assert pk.supported(S, C), (S, C)
+    r = bitdense.check_encoded_bitdense(e, use_pallas=True)
+    assert r["closure"] == "pallas", r
+    assert r["valid?"] is entry["valid"], r
+    if entry["valid"] is False:
+        r_x = bitdense.check_encoded_bitdense(e, use_pallas=False)
+        assert r.get("fail-event") == r_x.get("fail-event"), (r, r_x)
+
+
+@pytest.mark.fuzz
 @pytest.mark.parametrize("entry", MANIFEST,
                          ids=[e["file"] for e in MANIFEST])
 def test_golden_corpus_sharded_engine(entry):
