@@ -661,18 +661,72 @@ def main():
             env_extra={"JAX_PLATFORMS": "cpu", "BENCH_SMOKE": "1"})
         fb = next((p for p in parsed if p.get("value")), None)
         if fb is not None:
-            emit({"metric": f"{fb['metric']} — CPU FALLBACK on SMOKE "
-                            f"shapes (TPU runtime unreachable; NOT a "
-                            f"device number)",
-                  "value": fb["value"],
-                  "unit": "ops/sec",
-                  "vs_baseline": fb.get("vs_baseline"),
-                  "backend": "cpu-fallback"})
+            line = {"metric": f"{fb['metric']} — CPU FALLBACK on SMOKE "
+                              f"shapes (TPU runtime unreachable; NOT a "
+                              f"device number)",
+                    "value": fb["value"],
+                    "unit": "ops/sec",
+                    "vs_baseline": fb.get("vs_baseline"),
+                    "backend": "cpu-fallback"}
+            prior = _prior_onchip_headline()
+            if prior:
+                # a pointer, not a measurement: this run measured
+                # nothing on a device — the reference says where a
+                # real chip DID measure this bench, so a fallback
+                # record never buries existing hardware evidence
+                line["prior_onchip_headline"] = prior
+            emit(line)
             return
-        emit({"metric": "linearizability check throughput",
-              "value": None, "unit": "ops/sec", "vs_baseline": None,
-              "error": "no section completed (device runtime down?) — "
-                       "see the per-section lines above"})
+        err_line = {"metric": "linearizability check throughput",
+                    "value": None, "unit": "ops/sec",
+                    "vs_baseline": None,
+                    "error": "no section completed (device runtime "
+                             "down?) — see the per-section lines above"}
+        prior = _prior_onchip_headline()
+        if prior:
+            # the deadest-runtime record must point at the evidence too
+            err_line["prior_onchip_headline"] = prior
+        emit(err_line)
+
+
+def _prior_onchip_headline():
+    """Newest (by mtime — filename sort would rank r100 before r99)
+    recorded on-chip headline from bench_results/*.jsonl (committed
+    measurement artifacts — see PERF_R05.md), or None. Attached to
+    fallback/error headlines as `prior_onchip_headline` so a
+    dead-runtime round still points at the hardware evidence."""
+    import glob
+    base = os.path.dirname(os.path.abspath(__file__))
+    paths = glob.glob(os.path.join(base, "bench_results",
+                                   "bench_*_onchip.jsonl"))
+    best = None
+    for path in sorted(paths, key=lambda p: os.path.getmtime(p)):
+        lines = []
+        try:
+            with open(path) as f:
+                for ln in f:
+                    # these artifacts are written by runs that can be
+                    # killed mid-write: one truncated line must not
+                    # discard the file's valid headlines
+                    try:
+                        lines.append(json.loads(ln))
+                    except ValueError:
+                        continue
+        except OSError:
+            continue
+        for p in reversed(lines):
+            if isinstance(p, dict) and p.get("value") \
+                    and p.get("backend") not in (None, "cpu-fallback"):
+                best = {"file": os.path.relpath(path, base),
+                        "metric": p.get("metric"),
+                        "value": p.get("value"),
+                        "vs_baseline": p.get("vs_baseline"),
+                        "backend": p.get("backend"),
+                        "note": "recorded artifact from a prior "
+                                "healthy-chip run, NOT this run's "
+                                "measurement"}
+                break
+    return best
 
 
 def child_main(argv: list) -> None:

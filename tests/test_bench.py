@@ -111,6 +111,11 @@ def test_bench_wedged_runtime_fails_once_and_finishes_fast():
     assert head.get("backend") == "cpu-fallback", head
     assert "CPU FALLBACK" in head["metric"], head
     assert "8x40" in head["metric"], head          # smoke shapes forced
+    # a fallback must still point at the committed hardware evidence
+    # (bench_results/*_onchip.jsonl exists in-repo since r5)
+    prior = head.get("prior_onchip_headline")
+    assert prior and prior["backend"] == "tpu", head
+    assert "NOT this run's measurement" in prior["note"], prior
     # every device section got its own machine-readable skip line,
     # all attributed to the single pre-probe failure
     skips = [l for l in lines
