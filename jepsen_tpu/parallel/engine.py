@@ -28,6 +28,7 @@ shards the *frontier* axis with collective dedupe for giant single keys.
 from __future__ import annotations
 
 import functools
+import os as _os
 from typing import Optional
 
 import numpy as np
@@ -751,13 +752,14 @@ def encode_batch(model, histories, pad_slots: Optional[int] = None,
 
 def check_batch(model, histories, capacity: int = 512,
                 max_capacity: int = 1 << 18, mesh=None,
-                bucket: str = "tier") -> list:
+                bucket: Optional[str] = None) -> list:
     """Check many per-key histories in one device program per
     slot-window bucket: vmap over the key axis; with a mesh (and K
     divisible by its size) the key axis is sharded across devices —
     data parallelism over ICI.
 
-    `bucket` picks the grouping strategy before padding:
+    `bucket` picks the grouping strategy before padding (default: the
+    JEPSEN_TPU_BUCKET env var, else "tier"):
 
     - "tier" (default): power-of-two slot-window tiers — one wide key
       (say C=20) must not force every narrow key through a 2^20-mask
@@ -775,6 +777,10 @@ def check_batch(model, histories, capacity: int = 512,
     Each bucket independently dispatches to the bit-packed dense
     engine (parallel.bitdense) when its combined padded dims fit,
     sparse frontier mode otherwise."""
+    if bucket is None:
+        # JEPSEN_TPU_BUCKET gives deployments the lever without a code
+        # change, same opt-in philosophy as the other perf flags
+        bucket = _os.environ.get("JEPSEN_TPU_BUCKET", "tier")
     if bucket not in ("tier", "exact"):
         raise ValueError(f"unknown bucket strategy {bucket!r}")
     if not histories:

@@ -653,6 +653,17 @@ def test_check_batch_exact_bucketing_matches_tier():
     assert rs_exact[5]["valid?"] is False
     with pytest.raises(ValueError, match="bucket"):
         engine.check_batch(CASRegister(), [], bucket="bogus")
+    # the env lever resolves the None default (and bad values raise
+    # even on an empty batch)
+    import os
+    import unittest.mock as mock
+    with mock.patch.dict(os.environ, {"JEPSEN_TPU_BUCKET": "exact"}):
+        rs_env = engine.check_batch(CASRegister(), batch[:2],
+                                    capacity=128, max_capacity=4096)
+    assert strip(rs_env) == strip(rs_tier[:2])
+    with mock.patch.dict(os.environ, {"JEPSEN_TPU_BUCKET": "bogus"}), \
+            pytest.raises(ValueError, match="bucket"):
+        engine.check_batch(CASRegister(), [])
 
 
 def test_dispatcher_jax_route():
