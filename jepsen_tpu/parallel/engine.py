@@ -777,16 +777,37 @@ def check_batch(model, histories, capacity: int = 512,
     Each bucket independently dispatches to the bit-packed dense
     engine (parallel.bitdense) when its combined padded dims fit,
     sparse frontier mode otherwise."""
+    bucket = _resolve_bucket(bucket)   # fail-fast: before the encode
+    pre = [enc_mod.encode(model, h) for h in histories]
+    return check_batch_encoded(model, pre, capacity=capacity,
+                               max_capacity=max_capacity, mesh=mesh,
+                               bucket=bucket)
+
+
+def _resolve_bucket(bucket: Optional[str]) -> str:
     if bucket is None:
         # JEPSEN_TPU_BUCKET gives deployments the lever without a code
         # change, same opt-in philosophy as the other perf flags
         bucket = _os.environ.get("JEPSEN_TPU_BUCKET", "tier")
     if bucket not in ("tier", "exact"):
         raise ValueError(f"unknown bucket strategy {bucket!r}")
-    if not histories:
+    return bucket
+
+
+def check_batch_encoded(model, pre, capacity: int = 512,
+                        max_capacity: int = 1 << 18, mesh=None,
+                        bucket: Optional[str] = None) -> list:
+    """check_batch on ALREADY-ENCODED keys (the bucketing + dispatch
+    half without the encode half). Public so callers that time or
+    cache the encode separately — bench.sec_multikey's encode/device
+    split, re-analysis over a stored columnar history — drive the
+    same bucketing policy as check_batch. Results keep `pre`'s
+    order."""
+    if not pre:
+        _resolve_bucket(bucket)
         return []
+    bucket = _resolve_bucket(bucket)
     from jepsen_tpu.parallel import bitdense
-    pre = [enc_mod.encode(model, h) for h in histories]
     out: list = [None] * len(pre)
     buckets: dict = {}
     for i, e in enumerate(pre):

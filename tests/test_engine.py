@@ -665,6 +665,16 @@ def test_check_batch_exact_bucketing_matches_tier():
             pytest.raises(ValueError, match="bucket"):
         engine.check_batch(CASRegister(), [])
 
+    # the encoded-entry half (public for encode/device-split callers)
+    # preserves input order and matches the full path
+    pre = [enc_mod.encode(CASRegister(), h) for h in batch]
+    rs_enc = engine.check_batch_encoded(CASRegister(), pre,
+                                        capacity=128,
+                                        max_capacity=4096,
+                                        bucket="exact")
+    assert strip(rs_enc) == strip(rs_tier)
+    assert engine.check_batch_encoded(CASRegister(), []) == []
+
 
 def test_dispatcher_jax_route():
     from jepsen_tpu.checker import linearizable
