@@ -150,6 +150,9 @@ def sec_probe():
     import jax.numpy as jnp
     devs = jax.devices()
     x = jnp.ones((8, 8), jnp.float32)
+    # one-shot jit in a probe subprocess that exits right after: there
+    # is no second call for a module-level wrapper's cache to serve
+    # jepsen-lint: disable=recompile-closure-capture
     jax.jit(lambda a: a @ a)(x).block_until_ready()
     emit({"metric": "device pre-probe", "value": 1.0, "unit": "ok",
           "platform": devs[0].platform, "n_devices": len(devs)})
@@ -690,17 +693,30 @@ def main():
 
 
 def _prior_onchip_headline():
-    """Newest (by mtime — filename sort would rank r100 before r99)
-    recorded on-chip headline from bench_results/*.jsonl (committed
-    measurement artifacts — see PERF_R05.md), or None. Attached to
-    fallback/error headlines as `prior_onchip_headline` so a
-    dead-runtime round still points at the hardware evidence."""
+    """Newest recorded on-chip headline from bench_results/*.jsonl
+    (committed measurement artifacts — see PERF_R05.md), or None.
+    "Newest" means the highest PARSED round number in
+    `bench_r<N>_onchip.jsonl` — these are committed files, and git
+    checkouts do not preserve mtime, so a fresh clone's mtimes are
+    checkout order, not measurement order (plain filename sort is no
+    better: it ranks r100 before r99). Files whose name carries no
+    round number fall back to mtime and rank below any parsed round.
+    Attached to fallback/error headlines as `prior_onchip_headline` so
+    a dead-runtime round still points at the hardware evidence."""
     import glob
+    import re
     base = os.path.dirname(os.path.abspath(__file__))
     paths = glob.glob(os.path.join(base, "bench_results",
                                    "bench_*_onchip.jsonl"))
+
+    def order(p):
+        m = re.match(r"bench_r(\d+)_onchip\.jsonl$", os.path.basename(p))
+        if m:
+            return (1, int(m.group(1)), 0.0)
+        return (0, 0, os.path.getmtime(p))
+
     best = None
-    for path in sorted(paths, key=lambda p: os.path.getmtime(p)):
+    for path in sorted(paths, key=order):
         lines = []
         try:
             with open(path) as f:
@@ -742,7 +758,8 @@ def child_main(argv: list) -> None:
         argv = argv[:i] + argv[i + 2:]
     sec = argv[0]
     faulthandler.dump_traceback_later(max(20, to - 10), exit=False)
-    if (os.environ.get("JEPSEN_TPU_TEST_WEDGE") == "1"
+    from jepsen_tpu import envflags
+    if (envflags.env_bool("JEPSEN_TPU_TEST_WEDGE", default=False)
             and os.environ.get("JAX_PLATFORMS") != "cpu"):
         # test seam: simulate the observed tunnel wedge (PJRT client
         # creation blocking forever, uninterruptible by Python

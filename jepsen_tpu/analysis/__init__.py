@@ -1,0 +1,142 @@
+"""`jepsen-tpu lint` — tracing-safety & concurrency static analysis.
+
+An AST-based linter enforcing the purity contract the paper's layering
+implies (host canonicalisation vs. device frontier expansion) plus the
+concurrency and env-flag hygiene the round-5 hardware window showed we
+need enforced mechanically, the way Jepsen itself enforces history
+invariants. Three rule families:
+
+  purity       host effects / numpy / tracer branches inside traced code
+  recompile    jit-cache defeats and undecided buffer donation
+  concurrency  unlocked cross-thread writes; JEPSEN_TPU_* env reads
+               outside the validated accessor (jepsen_tpu.envflags)
+
+Pure `ast` work: no JAX import, no device init — safe and fast on
+CPU-only CI even with a wedged PJRT runtime. Entry points:
+
+    python -m jepsen_tpu.analysis --check      # CI gate, exit 0/1
+    jepsen lint [paths...] [--json]            # CLI subcommand
+    run_lint(paths=None, root=None)            # library API
+
+Suppressions: `# jepsen-lint: disable=<rule>[,<rule>]` on the line (or
+anywhere in the enclosing statement, or on the enclosing `def` line to
+cover the body), `disable-file=<rule>` for a whole file, and
+`# jepsen-lint: device` to mark a traced root the call graph cannot
+see. Bare or unknown-rule suppressions are themselves findings. See
+docs/linting.md.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+from jepsen_tpu.analysis import concurrency, purity, recompile
+from jepsen_tpu.analysis.core import (  # noqa: F401  (public API)
+    RULES, Finding, SourceFile, default_targets, expand_targets,
+)
+from jepsen_tpu.analysis.report import (  # noqa: F401
+    format_json, format_text, save_to_store, summarize,
+)
+
+_FAMILIES = (purity.check, recompile.check, concurrency.check)
+
+
+def repo_root() -> str:
+    """The repo checkout this package lives in."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def lint_file(path: str, root: Optional[str] = None) -> List[Finding]:
+    """All findings for one file (suppressed ones included, marked)."""
+    root = root or repo_root()
+    sf = SourceFile(path, root)
+    findings: List[Finding] = []
+    for fam in _FAMILIES:
+        findings.extend(fam(sf))
+    findings = sf.apply_suppressions(findings)
+    for line, msg in sf.suppressions.bad:
+        findings.append(Finding("bad-suppression", sf.relpath, line, 0,
+                                msg))
+    # deterministic order regardless of reachability-set iteration
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def run_lint(paths: Optional[Sequence[str]] = None,
+             root: Optional[str] = None,
+             rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint `paths` (default: the repo's production tree — jepsen_tpu/,
+    tools/, bench.py, __graft_entry__.py). `rules` filters to a subset
+    of rule names."""
+    root = root or repo_root()
+    files = (expand_targets(paths, root) if paths
+             else default_targets(root))
+    findings: List[Finding] = []
+    for path in files:
+        findings.extend(lint_file(path, root))
+    if rules:
+        findings = [f for f in findings if f.rule in set(rules)]
+    return findings
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI body shared by `python -m jepsen_tpu.analysis` and the
+    `jepsen lint` subcommand. Exit contract: 0 clean, 1 findings,
+    2 usage error."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="jepsen-tpu lint",
+        description="tracing-safety & concurrency static analysis")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: the repo tree)")
+    p.add_argument("--check", action="store_true",
+                   help="CI gate mode: print active findings only")
+    p.add_argument("--json", action="store_true",
+                   help="emit the JSON report")
+    p.add_argument("--rules", help="comma-separated rule subset")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="include suppressed findings in text output")
+    p.add_argument("--save-store", action="store_true",
+                   help="persist lint.json/lint.txt into a store/ run "
+                        "dir (store.Store('lint'))")
+    try:
+        args = p.parse_args(list(argv) if argv is not None else None)
+    except SystemExit as e:
+        return 0 if e.code in (0, None) else 2
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}: {desc}")
+        return 0
+
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    try:
+        findings = run_lint(args.paths or None, rules=rules)
+    except (OSError, SyntaxError, ValueError) as e:
+        # a missing/unreadable/undecodable/unparseable target is a
+        # USAGE error (2), not "findings found" (1) — CI must not
+        # misread a typo'd path as a lint verdict. ValueError covers
+        # UnicodeDecodeError (non-UTF8 bytes) and ast's NUL-byte
+        # rejection.
+        import sys
+        print(f"lint: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(format_json(findings))
+    else:
+        print(format_text(findings,
+                          show_suppressed=args.show_suppressed
+                          and not args.check))
+    if args.save_store:
+        import sys
+
+        from jepsen_tpu import store as jstore
+        d = save_to_store(findings, jstore.Store("lint"))
+        # stderr: stdout is the (documented machine-parseable) report
+        print(f"report saved under {d}", file=sys.stderr)
+    return 0 if all(f.suppressed for f in findings) else 1

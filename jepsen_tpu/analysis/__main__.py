@@ -1,0 +1,14 @@
+"""`python -m jepsen_tpu.analysis --check` — the CI gate entry point.
+
+Exit contract (mirrors cli.py's validity codes at the two ends that
+matter for CI): 0 = clean (every finding suppressed with a rule name),
+1 = active findings, 2 = usage error. Pure-AST, CPU-only, no JAX
+device init — safe to run first in the tier-1 flow.
+"""
+
+import sys
+
+from jepsen_tpu import analysis
+
+if __name__ == "__main__":
+    sys.exit(analysis.main())

@@ -1,0 +1,171 @@
+"""Concurrency rules: the threaded interpreter and control transports.
+
+concurrency-unlocked-shared-write
+    A lightweight race detector over thread-run code. Roots are
+    functions handed to `threading.Thread(target=...)`, Timer targets,
+    and `executor.submit(...)` callables, plus everything they
+    reference (same reachability machinery as the purity pass). Inside
+    those, an attribute write whose base object is *not local* to the
+    writing function (a closed-over or global object — i.e. state
+    another thread can also see) is flagged unless the write sits
+    inside a `with <something lock-ish>` block. Writes to locals and
+    subscript stores are out of scope (per-index list writes under the
+    GIL are the project's accepted fan-in idiom, see util.real_pmap).
+
+env-flag-accessor
+    Every read of a JEPSEN_TPU_* environment variable must go through
+    jepsen_tpu.envflags (the validated accessor). A raw
+    os.environ/os.getenv read reintroduces the round-5 failure mode:
+    a malformed value silently flipping a measured default.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set
+
+from jepsen_tpu.analysis import core
+from jepsen_tpu.analysis.core import Finding, FuncInfo, SourceFile
+
+_LOCKISH = re.compile(r"lock|cond|sem|mutex|barrier", re.IGNORECASE)
+
+_ENV_PREFIX = "JEPSEN_TPU_"
+_ENV_READ_CALLS = {"os.environ.get", "os.getenv", "os.environ.pop",
+                   "os.environ.setdefault"}
+
+
+# ------------------------------------------------------- thread roots
+
+def _thread_roots(sf: SourceFile) -> List[FuncInfo]:
+    mod_funcs = core.module_functions(sf)
+    by_node = {f.node: f for f in sf.functions}
+    roots: List[FuncInfo] = []
+
+    def add(node: Optional[ast.AST], scope: Optional[FuncInfo]):
+        if isinstance(node, ast.Lambda):
+            fi = by_node.get(node)
+            if fi is not None:
+                roots.append(fi)
+        elif isinstance(node, ast.Name):
+            fi = (scope.resolve(node.id, mod_funcs) if scope is not None
+                  else mod_funcs.get(node.id))
+            if fi is not None:
+                roots.append(fi)
+        elif isinstance(node, ast.Attribute):
+            # bound method handed to the thread (target=self._poll,
+            # target=worker.run): resolve by attribute name against
+            # this file's methods — an over-approximation on name
+            # collisions, which is the right direction for a race
+            # detector
+            roots.extend(f for f in sf.functions
+                         if f.is_method and f.name == node.attr)
+
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = sf.dotted(node.func) or ""
+        leaf = dotted.split(".")[-1]
+        scope = sf.func_of(node)
+        if leaf in ("Thread", "Timer"):
+            for kw in node.keywords:
+                if kw.arg in ("target", "function"):
+                    add(kw.value, scope)
+        elif leaf == "submit" and node.args:
+            add(node.args[0], scope)
+    return roots
+
+
+def _under_lock(sf: SourceFile, node: ast.AST) -> bool:
+    """Some ancestor `with` statement's context expression looks like a
+    lock (RLock/Condition/read()/write() wrappers included)."""
+    cur = sf.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                try:
+                    src = ast.unparse(item.context_expr)
+                except Exception:  # pragma: no cover - unparse is total
+                    src = ""
+                if _LOCKISH.search(src):
+                    return True
+        cur = sf.parents.get(cur)
+    return False
+
+
+def _race_findings(sf: SourceFile) -> List[Finding]:
+    roots = _thread_roots(sf)
+    if not roots:
+        return []
+    reachable = core.reach(sf, roots)
+    findings: List[Finding] = []
+    for fi in reachable:
+        global_names: Set[str] = set()
+        for node in core.walk_own(fi.node):
+            if isinstance(node, ast.Global):
+                global_names.update(node.names)
+        for node in core.walk_own(fi.node):
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name):
+                    base = t.value.id
+                    if base in fi.locals or base == "self":
+                        continue
+                    if not _under_lock(sf, node):
+                        findings.append(sf.finding(
+                            "concurrency-unlocked-shared-write", t,
+                            f"`{base}.{t.attr}` written in thread-run "
+                            f"function `{fi.name}` on a shared "
+                            f"(closed-over/global) object with no lock "
+                            f"in scope"))
+                elif isinstance(t, ast.Name) and t.id in global_names:
+                    if not _under_lock(sf, node):
+                        findings.append(sf.finding(
+                            "concurrency-unlocked-shared-write", t,
+                            f"global `{t.id}` written in thread-run "
+                            f"function `{fi.name}` with no lock in "
+                            f"scope"))
+    return findings
+
+
+# ---------------------------------------------------- env-flag hygiene
+
+def _env_findings(sf: SourceFile) -> List[Finding]:
+    if sf.relpath == core.ENV_ACCESSOR_RELPATH.replace("\\", "/"):
+        return []
+    findings: List[Finding] = []
+
+    def is_prefixed(node: ast.AST) -> bool:
+        return isinstance(node, ast.Constant) \
+            and isinstance(node.value, str) \
+            and node.value.startswith(_ENV_PREFIX)
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call):
+            dotted = sf.dotted(node.func) or ""
+            if dotted in _ENV_READ_CALLS and node.args \
+                    and is_prefixed(node.args[0]):
+                findings.append(sf.finding(
+                    "env-flag-accessor", node,
+                    f"raw `{dotted}({node.args[0].value!r})` — read "
+                    f"JEPSEN_TPU_* flags through jepsen_tpu.envflags "
+                    f"(env_bool/env_choice) so malformed values fail "
+                    f"loudly instead of flipping defaults"))
+        elif isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, ast.Load):
+            dotted = sf.dotted(node.value) or ""
+            if dotted == "os.environ" and is_prefixed(node.slice):
+                findings.append(sf.finding(
+                    "env-flag-accessor", node,
+                    f"raw `os.environ[{node.slice.value!r}]` — read "
+                    f"JEPSEN_TPU_* flags through jepsen_tpu.envflags"))
+    return findings
+
+
+def check(sf: SourceFile) -> List[Finding]:
+    return _race_findings(sf) + _env_findings(sf)

@@ -1,0 +1,524 @@
+"""Linter infrastructure: findings, suppressions, and per-file AST facts.
+
+The analysis pass is pure ``ast`` work — no imports of the linted
+modules, no JAX, no device runtime — so it runs on CPU-only CI in
+milliseconds and cannot hang on a wedged PJRT backend (the exact
+failure mode that motivates several of its rules).
+
+Suppression syntax (see docs/linting.md):
+
+    x = np.arange(8)          # jepsen-lint: disable=purity-numpy-call
+    def _plan(C):             # jepsen-lint: disable=purity-numpy-call
+        ...                   # (a def-line comment covers the body)
+    # jepsen-lint: disable-file=concurrency-unlocked-shared-write
+    def step(...):            # jepsen-lint: device
+        ...                   # (marks a traced root the call-graph
+                              #  cannot see, e.g. dict-dispatched steps)
+
+Every ``disable`` must carry at least one known rule name; a bare or
+unknown-rule suppression is itself reported (rule ``bad-suppression``)
+so the repo-clean gate keeps the suppression inventory auditable.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# one entry per rule: name -> one-line description (docs + --list-rules)
+RULES: Dict[str, str] = {
+    "purity-host-call":
+        "host-side effect (time/random/os/threading/IO/print) inside "
+        "code reachable from a jit/vmap/pmap/shard_map/pallas trace",
+    "purity-numpy-call":
+        "numpy call inside traced code — legal only on trace-time "
+        "constants; on tracers it silently falls back to host or dies",
+    "purity-tracer-branch":
+        "Python-level branch (if/while/bool cast) on a jnp/lax value "
+        "inside traced code — forces a host sync or a tracer error",
+    "recompile-closure-capture":
+        "jax.jit created inside a function body — every call builds a "
+        "fresh wrapper, so the compile cache never hits",
+    "recompile-nonliteral-static-args":
+        "static_argnames/static_argnums computed at runtime (e.g. from "
+        "dict order) — cache keys become nondeterministic",
+    "recompile-donate-argnums":
+        "jit of a frontier-buffer entry point without donate_argnums/"
+        "donate_argnames — decide donation explicitly (or suppress "
+        "with the reason it is unsafe)",
+    "concurrency-unlocked-shared-write":
+        "attribute/global write to an object shared across threads "
+        "with no lock in scope",
+    "env-flag-accessor":
+        "JEPSEN_TPU_* environment variable read outside "
+        "jepsen_tpu.envflags — all flag reads go through the validated "
+        "accessor",
+    "bad-suppression":
+        "jepsen-lint suppression without a (known) rule name",
+}
+
+# the one module allowed to touch JEPSEN_TPU_* env vars directly
+ENV_ACCESSOR_RELPATH = os.path.join("jepsen_tpu", "envflags.py")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*jepsen-lint:\s*(?P<verb>disable-file|disable|device)"
+    r"(?:\s*=\s*(?P<rules>[A-Za-z0-9_\-,\s]+?))?\s*(?:#|$)")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative, '/'-separated
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+
+    def anchor(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def format(self) -> str:
+        tag = " [suppressed]" if self.suppressed else ""
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule}: "
+                f"{self.message}{tag}")
+
+
+class Suppressions:
+    """Parsed ``# jepsen-lint:`` comments of one file."""
+
+    def __init__(self):
+        self.file_rules: Set[str] = set()
+        self.line_rules: Dict[int, Set[str]] = {}
+        self.device_lines: Set[int] = set()
+        self.bad: List[Tuple[int, str]] = []
+
+    @classmethod
+    def parse(cls, text: str) -> "Suppressions":
+        sup = cls()
+        lines = text.splitlines()
+
+        def next_code_line(i: int) -> int:
+            """First line after i that carries code — blank and
+            comment-only lines between a directive and its statement
+            must not void the suppression."""
+            j = i + 1
+            while j <= len(lines):
+                body = lines[j - 1].split("#", 1)[0].strip()
+                if body:
+                    return j
+                j += 1
+            return i + 1
+
+        # real COMMENT tokens only: docstrings/strings that merely
+        # mention the marker (this package documents itself) never parse
+        # as directives
+        import io
+        import tokenize
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(text).readline))
+        except (tokenize.TokenError, IndentationError):
+            tokens = []
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT \
+                    or "jepsen-lint" not in tok.string:
+                continue
+            i = tok.start[0]
+            m = _SUPPRESS_RE.search(tok.string)
+            if m is None:
+                sup.bad.append((i, "unparseable jepsen-lint comment "
+                                   "(expected disable=<rule>[,<rule>], "
+                                   "disable-file=<rule>, or device)"))
+                continue
+            verb = m.group("verb")
+            # a comment-only line targets the next CODE line (so long
+            # statements can carry the suppression just above them,
+            # with explanatory comments in between)
+            own_line = tok.line.split("#", 1)[0].strip() == ""
+            target = next_code_line(i) if own_line else i
+            if verb == "device":
+                sup.device_lines.add(target)
+                continue
+            names = [r.strip() for r in (m.group("rules") or "").split(",")
+                     if r.strip()]
+            if not names:
+                sup.bad.append((i, f"'{verb}' without a rule name — every "
+                                   f"suppression must name its rule"))
+                continue
+            unknown = [r for r in names if r not in RULES]
+            if unknown:
+                sup.bad.append((i, f"unknown rule(s) {unknown} in "
+                                   f"'{verb}' (known: "
+                                   f"{sorted(RULES)})"))
+            known = [r for r in names if r in RULES]
+            if verb == "disable-file":
+                sup.file_rules.update(known)
+            else:
+                sup.line_rules.setdefault(target, set()).update(known)
+        return sup
+
+
+class SourceFile:
+    """One parsed file plus the derived facts every rule family needs:
+    parent links, import aliases, function table, statement spans, and
+    suppressions."""
+
+    def __init__(self, path: str, root: str):
+        self.path = path
+        self.relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as fh:
+            self.text = fh.read()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=path)
+        self.suppressions = Suppressions.parse(self.text)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.aliases = _import_aliases(self.tree)
+        self.functions = _collect_functions(self.tree)
+        self._by_node = {f.node: f for f in self.functions}
+
+    # ------------------------------------------------------ helpers
+    def func_of(self, node: ast.AST) -> Optional["FuncInfo"]:
+        """The innermost function whose body contains `node`."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if cur in self._by_node:
+                return self._by_node[cur]
+            cur = self.parents.get(cur)
+        return None
+
+    def stmt_span(self, node: ast.AST) -> Tuple[int, int]:
+        """Line span of the statement enclosing `node` (so one
+        suppression comment covers a multi-line statement)."""
+        cur = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = self.parents.get(cur)
+        if cur is None:
+            cur = node
+        return cur.lineno, getattr(cur, "end_lineno", cur.lineno)
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """'jax.jit'-style dotted name with the leading alias resolved
+        through this file's imports ('_os.environ' -> 'os.environ')."""
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        parts.append(self.aliases.get(cur.id, cur.id))
+        return ".".join(reversed(parts))
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(rule, self.relpath, node.lineno,
+                       getattr(node, "col_offset", 0), message)
+
+    def apply_suppressions(self, findings: Iterable[Finding]) -> List[Finding]:
+        """Mark each finding suppressed if a matching comment covers its
+        line, its enclosing statement, its enclosing def line, or the
+        whole file."""
+        sup = self.suppressions
+        # "def-line" coverage includes decorator lines: an own-line
+        # comment above `@jax.jit` targets the decorator, and it must
+        # mean the function, not silently nothing
+        def_spans = [(func_head_lines(f.node),
+                      getattr(f.node, "end_lineno", f.node.lineno))
+                     for f in self.functions
+                     if not isinstance(f.node, ast.Lambda)]
+        out = []
+        for fd in findings:
+            rules_at = set()
+            # exact line + any line of the enclosing statement span
+            span = self._span_at(fd.line)
+            for ln in range(span[0], span[1] + 1):
+                rules_at |= sup.line_rules.get(ln, set())
+            # a def-line (or decorator-line) comment covers the body
+            for heads, hi in def_spans:
+                if min(heads) <= fd.line <= hi:
+                    for ln in heads:
+                        rules_at |= sup.line_rules.get(ln, set())
+            if fd.rule in rules_at or fd.rule in sup.file_rules:
+                fd.suppressed = True
+            out.append(fd)
+        return out
+
+    def _span_at(self, line: int) -> Tuple[int, int]:
+        best: Optional[Tuple[int, int]] = None
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.stmt) \
+                    and not isinstance(node, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef,
+                                              ast.ClassDef)):
+                lo, hi = node.lineno, getattr(node, "end_lineno",
+                                              node.lineno)
+                if lo <= line <= hi and (
+                        best is None
+                        or (hi - lo) < (best[1] - best[0])):
+                    best = (lo, hi)
+        return best if best is not None else (line, line)
+
+
+class FuncInfo:
+    """A def/lambda with its lexical scope facts."""
+
+    def __init__(self, node, name: str, parent: Optional["FuncInfo"],
+                 is_method: bool = False):
+        self.node = node
+        self.name = name
+        self.parent = parent
+        self.is_method = is_method      # class attr, not a module name
+        self.children: Dict[str, "FuncInfo"] = {}
+        self.nested: List["FuncInfo"] = []
+        self.refs: Set[str] = set()     # Name loads in the body
+        self.locals: Set[str] = set()   # params + assigned names
+
+    def free_refs(self) -> Set[str]:
+        """Names referenced but not bound locally — the only ones that
+        can resolve to functions in enclosing/module scope."""
+        return self.refs - self.locals
+
+    def resolve(self, name: str,
+                module_funcs: Dict[str, "FuncInfo"]) -> Optional["FuncInfo"]:
+        scope: Optional[FuncInfo] = self
+        while scope is not None:
+            if name in scope.children:
+                return scope.children[name]
+            scope = scope.parent
+        return module_funcs.get(name)
+
+
+def _import_aliases(tree: ast.Module) -> Dict[str, str]:
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _collect_functions(tree: ast.Module) -> List[FuncInfo]:
+    out: List[FuncInfo] = []
+
+    def visit(node: ast.AST, scope: Optional[FuncInfo], in_class: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                name = getattr(child, "name", "<lambda>")
+                fi = FuncInfo(child, name, scope, is_method=in_class)
+                out.append(fi)
+                if scope is not None and not in_class:
+                    scope.children[name] = fi
+                if scope is not None:
+                    scope.nested.append(fi)
+                _fill_scope_facts(fi)
+                visit(child, fi, False)
+            elif isinstance(child, ast.ClassDef):
+                # methods live in the class namespace, not the enclosing
+                # scope: they must not shadow plain names in resolution
+                visit(child, scope, True)
+            else:
+                visit(child, scope, in_class)
+
+    visit(tree, None, False)
+    return out
+
+
+def _fill_scope_facts(fi: FuncInfo):
+    node = fi.node
+    args = node.args
+    for a in (list(args.posonlyargs) + list(args.args)
+              + list(args.kwonlyargs)
+              + ([args.vararg] if args.vararg else [])
+              + ([args.kwarg] if args.kwarg else [])):
+        fi.locals.add(a.arg)
+    for sub in _walk_own(node):
+        if isinstance(sub, ast.Name):
+            if isinstance(sub.ctx, ast.Load):
+                fi.refs.add(sub.id)
+            else:
+                fi.locals.add(sub.id)
+        elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fi.locals.add(sub.name)
+        elif isinstance(sub, ast.Global):
+            # a declared global is not a local — writes hit module state
+            fi.locals.difference_update(sub.names)
+
+
+def _walk_own(func_node) -> Iterable[ast.AST]:
+    """Walk a function body without descending into nested defs/lambdas
+    (their facts are collected on their own FuncInfo)."""
+    body = (func_node.body if isinstance(func_node.body, list)
+            else [func_node.body])
+    nested = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+    stack: List[ast.AST] = [n for n in body if not isinstance(n, nested)]
+    while stack:
+        node = stack.pop()
+        yield node
+        stack.extend(child for child in ast.iter_child_nodes(node)
+                     if not isinstance(child, nested))
+
+
+def walk_own(func_node) -> Iterable[ast.AST]:
+    """Public alias of the own-body walker for the rule families."""
+    return _walk_own(func_node)
+
+
+def func_head_lines(node) -> List[int]:
+    """The lines that 'mean this function' for comment targeting: the
+    def line plus every decorator line (an own-line comment above a
+    decorated def lands on the first decorator)."""
+    return [d.lineno for d in getattr(node, "decorator_list", [])] \
+        + [node.lineno]
+
+
+def module_functions(sf: SourceFile) -> Dict[str, FuncInfo]:
+    return {f.name: f for f in sf.functions if f.parent is None
+            and not f.is_method and not isinstance(f.node, ast.Lambda)}
+
+
+# ------------------------------------------------------------ traced roots
+
+# entry points whose callable arguments run under a trace
+_TRACE_ENTRIES = {"jit", "vmap", "pmap", "shard_map", "pallas_call",
+                  "scan", "while_loop", "fori_loop", "cond", "switch",
+                  "custom_jvp", "custom_vjp", "checkpoint", "remat"}
+
+
+def is_trace_entry(sf: SourceFile, call: ast.Call) -> bool:
+    dotted = sf.dotted(call.func)
+    if dotted is None:
+        return False
+    return dotted.split(".")[-1] in _TRACE_ENTRIES
+
+
+def is_jax_jit(sf: SourceFile, node: ast.AST) -> bool:
+    """`node` is an expression producing jax.jit (directly or via
+    functools.partial(jax.jit, ...))."""
+    if isinstance(node, ast.Call):
+        dotted = sf.dotted(node.func)
+        if dotted and dotted.split(".")[-1] == "partial" and node.args:
+            return is_jax_jit(sf, node.args[0])
+        return False
+    dotted = sf.dotted(node)
+    return bool(dotted) and dotted.split(".")[-1] == "jit" \
+        and ("jax" in dotted or dotted == "jit")
+
+
+def trace_roots(sf: SourceFile) -> List[FuncInfo]:
+    """Functions whose bodies run under a JAX trace: jit/vmap/pmap/
+    shard_map/pallas_call/lax-control-flow targets, decorated defs, and
+    `# jepsen-lint: device` pragma'd defs (for dispatch tables the call
+    graph cannot see)."""
+    mod_funcs = module_functions(sf)
+    roots: List[FuncInfo] = []
+    by_node = {f.node: f for f in sf.functions}
+
+    def add_target(node: ast.AST, scope: Optional[FuncInfo]):
+        if isinstance(node, ast.Lambda):
+            fi = by_node.get(node)
+            if fi is not None:
+                roots.append(fi)
+        elif isinstance(node, ast.Name):
+            base = scope if scope is not None else None
+            fi = (base.resolve(node.id, mod_funcs) if base is not None
+                  else mod_funcs.get(node.id))
+            if fi is not None:
+                roots.append(fi)
+        elif isinstance(node, ast.Call):
+            # partial(f, ...) — recurse into its arguments
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                add_target(a, scope)
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and is_trace_entry(sf, node):
+            scope = sf.func_of(node)
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                add_target(a, scope)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                d = dec.func if isinstance(dec, ast.Call) else dec
+                if is_jax_jit(sf, d) or is_jax_jit(sf, dec) or (
+                        sf.dotted(d) or "").split(".")[-1] in _TRACE_ENTRIES:
+                    fi = by_node.get(node)
+                    if fi is not None:
+                        roots.append(fi)
+            if any(ln in sf.suppressions.device_lines
+                   for ln in func_head_lines(node)):
+                fi = by_node.get(node)
+                if fi is not None:
+                    roots.append(fi)
+    return roots
+
+
+def reach(sf: SourceFile, roots: Sequence[FuncInfo]) -> Set[FuncInfo]:
+    """Transitive closure over name references and lexical nesting:
+    anything a traced function references (or defines inline) is traced
+    with it."""
+    mod_funcs = module_functions(sf)
+    seen: Set[FuncInfo] = set()
+    stack = list(roots)
+    while stack:
+        fi = stack.pop()
+        if fi in seen:
+            continue
+        seen.add(fi)
+        stack.extend(fi.nested)
+        for name in fi.free_refs():
+            target = fi.resolve(name, mod_funcs)
+            if target is not None and target is not fi:
+                stack.append(target)
+    return seen
+
+
+# ------------------------------------------------------------ file walking
+
+DEFAULT_TOP_FILES = ("bench.py", "__graft_entry__.py")
+DEFAULT_DIRS = ("jepsen_tpu", "tools")
+SKIP_PARTS = {"__pycache__", ".git", "node_modules", "store",
+              "bench_results"}
+
+
+def default_targets(root: str) -> List[str]:
+    out: List[str] = []
+    for fname in DEFAULT_TOP_FILES:
+        p = os.path.join(root, fname)
+        if os.path.isfile(p):
+            out.append(p)
+    for d in DEFAULT_DIRS:
+        base = os.path.join(root, d)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(x for x in dirnames
+                                 if x not in SKIP_PARTS)
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    out.append(os.path.join(dirpath, f))
+    return out
+
+
+def expand_targets(paths: Sequence[str], root: str) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        p = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(x for x in dirnames
+                                     if x not in SKIP_PARTS)
+                out.extend(os.path.join(dirpath, f)
+                           for f in sorted(filenames)
+                           if f.endswith(".py"))
+        else:
+            out.append(p)
+    return out

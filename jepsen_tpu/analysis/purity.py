@@ -1,0 +1,126 @@
+"""Device-purity rules: no host effects inside traced code.
+
+The paper's layering puts canonicalisation on the host and frontier
+expansion on the device; the boundary is `jax.jit` (and its relatives).
+Anything that crosses it — wall clocks, RNGs, env vars, file IO, locks,
+raw numpy — either runs once at trace time (a silent wrong-answer
+hazard: the value is frozen into the compiled program) or breaks the
+trace outright. These rules walk every function reachable from a
+jit/vmap/pmap/shard_map/pallas/lax-control-flow entry point (plus
+`# jepsen-lint: device` pragma'd dispatch-table steps) and flag:
+
+  purity-host-call     time/random/os/threading/subprocess/socket use,
+                       open()/input()/print()
+  purity-numpy-call    np.* calls (legal on trace-time constants only —
+                       suppress with the rule name where that is the
+                       intent, e.g. static index-table construction)
+  purity-tracer-branch Python `if`/`while`/bool()/int()/float() on a
+                       jnp/lax expression — host sync or tracer error
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from jepsen_tpu.analysis import core
+from jepsen_tpu.analysis.core import Finding, SourceFile
+
+# modules whose mere use inside a trace is a host effect
+_BANNED_MODULES = {
+    "time": "wall-clock/sleep",
+    "random": "host RNG (use jax.random with an explicit key)",
+    "os": "process state (env vars, fds)",
+    "threading": "locks/threads",
+    "subprocess": "process spawning",
+    "socket": "network IO",
+    "shutil": "file IO",
+    "pathlib": "file IO",
+}
+_NUMPY_MODULES = {"numpy", "numpy.random"}
+_BANNED_BUILTINS = {"open": "file IO", "input": "stdin",
+                    "print": "host stdout (use jax.debug.print)"}
+_JNP_MODULES = {"jax.numpy", "jax.lax", "jax.nn"}
+
+
+def _base_module(dotted: str) -> str:
+    return dotted.split(".")[0]
+
+
+def _is_jnp_expr(sf: SourceFile, node: ast.AST) -> bool:
+    """The expression contains a call/attribute rooted at jnp/lax."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute):
+            dotted = sf.dotted(sub)
+            if dotted and (dotted.rsplit(".", 1)[0] in _JNP_MODULES
+                           or _base_module(dotted) in ("jax",)
+                           and ".numpy." in f".{dotted}."):
+                return True
+            if dotted and dotted.startswith(("jax.numpy.", "jax.lax.")):
+                return True
+    return False
+
+
+def check(sf: SourceFile) -> List[Finding]:
+    roots = core.trace_roots(sf)
+    if not roots:
+        return []
+    reachable = core.reach(sf, roots)
+    findings: List[Finding] = []
+    seen_lines = set()
+
+    def emit(rule: str, node: ast.AST, msg: str):
+        # one finding per source position: `os.environ.get` must not
+        # double-report as both `os.environ` and `os.environ.get`
+        key = (rule, node.lineno, getattr(node, "col_offset", 0))
+        if key in seen_lines:
+            return
+        seen_lines.add(key)
+        findings.append(sf.finding(rule, node, msg))
+
+    for fi in reachable:
+        fname = fi.name
+        for node in core.walk_own(fi.node):
+            # host-module attribute use (call or bare reference)
+            if isinstance(node, ast.Attribute):
+                dotted = sf.dotted(node)
+                if not dotted:
+                    continue
+                base = _base_module(dotted)
+                full_mod = dotted.rsplit(".", 1)[0]
+                if base in _BANNED_MODULES and full_mod != "jax":
+                    emit("purity-host-call", node,
+                         f"`{dotted}` ({_BANNED_MODULES[base]}) inside "
+                         f"traced function `{fname}` — move it to the "
+                         f"host side of the jit boundary")
+                elif base in _NUMPY_MODULES or full_mod in _NUMPY_MODULES:
+                    emit("purity-numpy-call", node,
+                         f"`{dotted}` inside traced function `{fname}` "
+                         f"— numpy only sees trace-time constants here; "
+                         f"use jnp for anything derived from inputs")
+            # banned builtins
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id in _BANNED_BUILTINS \
+                    and node.func.id not in fi.locals:
+                emit("purity-host-call", node,
+                     f"`{node.func.id}()` "
+                     f"({_BANNED_BUILTINS[node.func.id]}) inside traced "
+                     f"function `{fname}`")
+            # Python-level branch on a traced value
+            elif isinstance(node, (ast.If, ast.While)):
+                if _is_jnp_expr(sf, node.test):
+                    emit("purity-tracer-branch", node,
+                         f"Python `{'if' if isinstance(node, ast.If) else 'while'}` "
+                         f"on a jnp/lax expression inside traced "
+                         f"function `{fname}` — use lax.cond/"
+                         f"lax.while_loop or jnp.where")
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id in ("bool", "int", "float") \
+                    and node.args and _is_jnp_expr(sf, node.args[0]):
+                emit("purity-tracer-branch", node,
+                     f"`{node.func.id}()` cast of a jnp/lax expression "
+                     f"inside traced function `{fname}` — forces a "
+                     f"host sync (concretization error under jit)")
+    return findings

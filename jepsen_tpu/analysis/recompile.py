@@ -1,0 +1,109 @@
+"""Recompilation / shape-hazard rules.
+
+XLA compiles one program per (function object, static-arg values,
+input shapes). Three ways the tree can silently defeat that cache:
+
+  recompile-closure-capture      `jax.jit(...)` evaluated inside a
+                                 function body — each call builds a new
+                                 wrapper object, so nothing ever hits
+                                 the cache (and closure-captured Python
+                                 scalars bake into the trace)
+  recompile-nonliteral-static-args  static_argnames/static_argnums
+                                 computed at runtime (dict order, list
+                                 comprehensions) — cache keys stop
+                                 being deterministic across processes
+  recompile-donate-argnums       the big frontier-buffer entry points
+                                 (parallel/engine|dense|bitdense|
+                                 sharded) jitted without an explicit
+                                 donation decision; donating the
+                                 multi-MB reachable-set/frontier
+                                 buffers halves HBM pressure, NOT
+                                 donating must be a recorded choice
+                                 (suppress with the reason)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from jepsen_tpu.analysis import core
+from jepsen_tpu.analysis.core import Finding, SourceFile
+
+# files whose jits move frontier-scale buffers: donation must be decided
+DONATE_FILES = {
+    "jepsen_tpu/parallel/engine.py",
+    "jepsen_tpu/parallel/dense.py",
+    "jepsen_tpu/parallel/bitdense.py",
+    "jepsen_tpu/parallel/sharded.py",
+}
+
+_STATIC_KWS = ("static_argnames", "static_argnums")
+_DONATE_KWS = ("donate_argnums", "donate_argnames")
+
+
+def _is_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return all(_is_literal(e) for e in node.elts)
+    return False
+
+
+def _jit_calls(sf: SourceFile):
+    """All (call_node, keywords, decorated_def) jax.jit applications:
+    direct calls, partial(jax.jit, ...) calls, and decorator forms."""
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call):
+            if core.is_jax_jit(sf, node.func):
+                yield node, node.keywords, None
+            elif core.is_jax_jit(sf, node):
+                # functools.partial(jax.jit, ...) — keywords ride the
+                # partial call itself
+                yield node, node.keywords, None
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and core.is_jax_jit(sf, dec):
+                    yield dec, dec.keywords, node
+                elif core.is_jax_jit(sf, dec):
+                    yield dec, [], node
+
+
+def check(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    seen = set()
+    for call, keywords, decorated in _jit_calls(sf):
+        key = (call.lineno, call.col_offset)
+        if key in seen:
+            continue
+        seen.add(key)
+
+        # jit created inside a function body (not a decorator): the
+        # wrapper — and its compile cache — dies with the call frame
+        if decorated is None and isinstance(call, ast.Call) \
+                and sf.func_of(call) is not None:
+            owner = sf.func_of(call)
+            findings.append(sf.finding(
+                "recompile-closure-capture", call,
+                f"jax.jit evaluated inside `{owner.name}` — a fresh "
+                f"wrapper per call never reuses the compile cache; "
+                f"hoist to module level (or memoize the wrapper once)"))
+
+        for kw in keywords:
+            if kw.arg in _STATIC_KWS and not _is_literal(kw.value):
+                findings.append(sf.finding(
+                    "recompile-nonliteral-static-args", kw.value,
+                    f"{kw.arg} is computed at runtime "
+                    f"(`{ast.unparse(kw.value)}`) — static-arg cache "
+                    f"keys must be literal and order-stable"))
+
+        if sf.relpath in DONATE_FILES:
+            kws = {kw.arg for kw in keywords}
+            if not kws.intersection(_DONATE_KWS):
+                findings.append(sf.finding(
+                    "recompile-donate-argnums", call,
+                    "jit of a frontier-buffer entry point with no "
+                    "donate_argnums/donate_argnames — donate the big "
+                    "buffers or suppress with the reason donation is "
+                    "unsafe here"))
+    return findings

@@ -1,0 +1,63 @@
+"""Report formatting for lint runs: human text, JSON, and store wiring.
+
+The JSON shape is a stable contract (tests and CI parse it):
+
+    {"findings": [{rule, path, line, col, message, suppressed}, ...],
+     "counts": {"total": N, "suppressed": M, "active": N - M},
+     "by_rule": {rule: active_count, ...},
+     "clean": bool}
+
+`save_to_store` drops lint.json + lint.txt into a jepsen store run
+directory (store.Store), so a lint pass rides the same artifact
+lifecycle as histories and checker results.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+from jepsen_tpu.analysis.core import Finding
+
+
+def summarize(findings: List[Finding]) -> Dict:
+    active = [f for f in findings if not f.suppressed]
+    by_rule: Dict[str, int] = {}
+    for f in active:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    return {
+        "findings": [f.to_dict() for f in findings],
+        "counts": {"total": len(findings),
+                   "suppressed": len(findings) - len(active),
+                   "active": len(active)},
+        "by_rule": dict(sorted(by_rule.items())),
+        "clean": not active,
+    }
+
+
+def format_json(findings: List[Finding]) -> str:
+    return json.dumps(summarize(findings), indent=2)
+
+
+def format_text(findings: List[Finding], show_suppressed: bool = False) -> str:
+    shown = [f for f in findings if show_suppressed or not f.suppressed]
+    lines = [f.format() for f in
+             sorted(shown, key=lambda f: (f.path, f.line, f.col))]
+    s = summarize(findings)
+    c = s["counts"]
+    lines.append(f"{c['active']} finding(s) "
+                 f"({c['suppressed']} suppressed, "
+                 f"{c['total']} total)")
+    if s["by_rule"]:
+        lines.append("by rule: " + ", ".join(
+            f"{r}={n}" for r, n in s["by_rule"].items()))
+    return "\n".join(lines)
+
+
+def save_to_store(findings: List[Finding], store) -> str:
+    """Write lint.json + lint.txt into a store.Store run dir; returns
+    the run directory."""
+    store.write_file(["lint.json"], format_json(findings) + "\n")
+    store.write_file(["lint.txt"],
+                     format_text(findings, show_suppressed=True) + "\n")
+    return store.dir

@@ -82,6 +82,15 @@ def base_parser(prog: str = "jepsen") -> argparse.ArgumentParser:
     s = sub.add_parser("serve", help="serve stored results over HTTP")
     s.add_argument("--port", type=int, default=8080)
     s.add_argument("--host", default="0.0.0.0")
+    # listed for --help discoverability only: run_cli dispatches `lint`
+    # to jepsen_tpu.analysis.main BEFORE parsing (its own parser is the
+    # single source of truth for lint flags and the 0/1/2 contract;
+    # argparse.REMAINDER cannot forward a leading optional)
+    li = sub.add_parser(
+        "lint", add_help=False,
+        help="tracing-safety & concurrency static analysis "
+             "(jepsen_tpu.analysis); exit 0 clean / 1 findings / "
+             "2 usage error")
     ta = sub.add_parser(
         "test-all", help="run a whole suite of tests in one go")
     common(ta)
@@ -92,7 +101,7 @@ def base_parser(prog: str = "jepsen") -> argparse.ArgumentParser:
                     help="comma-separated nemesis sweep (default: the "
                          "single --nemesis)")
     p._jepsen_subparsers = {"test": t, "analyze": a, "serve": s,
-                            "test-all": ta}
+                            "lint": li, "test-all": ta}
     return p
 
 
@@ -235,6 +244,8 @@ def run_serve_cmd(args) -> int:
     return EXIT_VALID
 
 
+
+
 def run_cli(test_fn: Optional[Callable[[Dict], Dict]] = None,
             argv: Optional[list] = None, prog: str = "jepsen",
             extend_parser: Optional[Callable] = None,
@@ -249,6 +260,14 @@ def run_cli(test_fn: Optional[Callable[[Dict], Dict]] = None,
         test_fn = lambda opts: jcore.make_test(  # noqa: E731
             {"nodes": opts["nodes"], "ssh": opts["ssh"],
              "concurrency": opts["concurrency"]})
+    raw = list(argv) if argv is not None else sys.argv[1:]
+    if raw[:1] == ["lint"]:
+        # forwarded BEFORE the main parser: argparse.REMAINDER drops a
+        # leading optional (`lint --check` errors), and the analysis
+        # package's own parser is the single source of truth for lint
+        # flags, help, and the 0/1/2 exit contract
+        from jepsen_tpu import analysis
+        return analysis.main(raw[1:])
     parser = base_parser(prog)
     if extend_parser is not None:
         extend_parser(parser)

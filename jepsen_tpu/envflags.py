@@ -1,0 +1,83 @@
+"""Validated accessors for the ``JEPSEN_TPU_*`` environment flags.
+
+Every read of a ``JEPSEN_TPU_*`` variable anywhere in the tree goes
+through this module; the ``env-flag-accessor`` rule in
+``jepsen_tpu.analysis`` enforces that mechanically. Why it exists: a
+malformed flag value must fail loudly at the read site, not silently
+revert a measured default. The motivating incident is the round-5
+pallas flip — with the old raw read (``flag == "1"``), a stray
+``JEPSEN_TPU_PALLAS=yes`` would have silently disabled the measured
+54x win, and nothing would have said so.
+
+Contract:
+
+* ``env_bool`` flags are strict tri-state: unset means "use the code
+  default", ``"1"`` means on, ``"0"`` means off, and anything else
+  raises :class:`EnvFlagError`.
+* ``env_choice`` flags accept exactly the listed strings.
+* Names must carry the ``JEPSEN_TPU_`` prefix — the accessor refuses
+  to read anything else, so the namespace stays greppable.
+
+This module must stay importable with no JAX and no device runtime:
+the static-analysis pass (and its CI gate) runs CPU-only before any
+backend exists, and engine modules import it at module scope.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+PREFIX = "JEPSEN_TPU_"
+
+
+class EnvFlagError(ValueError):
+    """A JEPSEN_TPU_* variable is set to a value outside its contract."""
+
+
+def env_raw(name: str, default: Optional[str] = None) -> Optional[str]:
+    """The raw string value of a prefixed flag (no validation beyond
+    the namespace check). Prefer the typed accessors below."""
+    if not name.startswith(PREFIX):
+        raise EnvFlagError(
+            f"{name!r} is not a {PREFIX}* flag — the accessor only "
+            f"serves the jepsen_tpu namespace")
+    return os.environ.get(name, default)
+
+
+def env_bool(name: str, default: Optional[bool] = None) -> Optional[bool]:
+    """Strict tri-state boolean flag.
+
+    Unset -> ``default`` (pass ``None`` to mean "let the code pick a
+    platform default"), ``"1"`` -> True, ``"0"`` -> False. Any other
+    value raises :class:`EnvFlagError` instead of silently counting as
+    an opt-out — the exact failure mode that nearly reverted the
+    measured pallas default in round 5.
+    """
+    raw = env_raw(name)
+    if raw is None:
+        return default
+    if raw == "1":
+        return True
+    if raw == "0":
+        return False
+    raise EnvFlagError(
+        f"{name}={raw!r}: must be '1' (on) or '0' (off); unset the "
+        f"variable to get the default")
+
+
+def env_choice(name: str, choices: Sequence[str],
+               default: Optional[str] = None,
+               what: str = "value") -> Optional[str]:
+    """A flag restricted to an explicit set of strings. Unset ->
+    ``default``; anything outside ``choices`` raises
+    :class:`EnvFlagError` (the message names ``what`` so callers'
+    error-matching tests read naturally)."""
+    raw = env_raw(name)
+    if raw is None:
+        return default
+    if raw in choices:
+        return raw
+    raise EnvFlagError(
+        f"{name}={raw!r}: unknown {what} (expected one of "
+        f"{tuple(choices)})")

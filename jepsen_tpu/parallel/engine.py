@@ -28,7 +28,6 @@ shards the *frontier* axis with collective dedupe for giant single keys.
 from __future__ import annotations
 
 import functools
-import os as _os
 from typing import Optional
 
 import numpy as np
@@ -37,6 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from jepsen_tpu import envflags
 from jepsen_tpu.parallel import encode as enc_mod
 from jepsen_tpu.parallel.encode import EncodedHistory, EncodeError
 from jepsen_tpu.parallel.steps import STEPS
@@ -187,7 +187,16 @@ def _check_impl(xs, state0, step_name: str, N: int):
     return valid, fail_r, overflow, maxf, steps_n
 
 
-@functools.partial(jax.jit, static_argnames=("step_name", "N"))
+# donation decision (recompile-donate-argnums) for the three jits
+# below: NOT donated. The xs event tables and state0 are reused across
+# the capacity-tier retry loops (check_encoded and _check_batch_sparse
+# re-dispatch the SAME arrays at doubled N after an overflow; the
+# resumable path re-runs a chunk after growing the checkpoint) —
+# donating them would invalidate the retry inputs. The frontier carry
+# is rebuilt per call, so there is no persistent caller buffer to
+# reclaim either.
+@functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
+                   static_argnames=("step_name", "N"))
 def _check_device_resumable(xs, carry0, step_name: str, N: int):
     """One chunk of events from an explicit carry; returns the final
     carry plus the overflow flag so the host can checkpoint between
@@ -197,10 +206,14 @@ def _check_device_resumable(xs, carry0, step_name: str, N: int):
     return carry, jnp.any(ovfs)
 
 
+# same donation decision as _check_device_resumable above
+# jepsen-lint: disable=recompile-donate-argnums
 _check_device = jax.jit(_check_impl, static_argnames=("step_name", "N"))
 
 
-@functools.partial(jax.jit, static_argnames=("step_name", "N"))
+# same donation decision as _check_device_resumable above
+@functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
+                   static_argnames=("step_name", "N"))
 def _check_device_batch(xs, state0, step_name: str, N: int):
     return jax.vmap(
         lambda x, s0: _check_impl(x, s0, step_name, N)
@@ -787,8 +800,11 @@ def check_batch(model, histories, capacity: int = 512,
 def _resolve_bucket(bucket: Optional[str]) -> str:
     if bucket is None:
         # JEPSEN_TPU_BUCKET gives deployments the lever without a code
-        # change, same opt-in philosophy as the other perf flags
-        bucket = _os.environ.get("JEPSEN_TPU_BUCKET", "tier")
+        # change, same opt-in philosophy as the other perf flags; the
+        # validated accessor raises on values outside the contract
+        bucket = envflags.env_choice("JEPSEN_TPU_BUCKET",
+                                     ("tier", "exact"), default="tier",
+                                     what="bucket strategy")
     if bucket not in ("tier", "exact"):
         raise ValueError(f"unknown bucket strategy {bucket!r}")
     return bucket

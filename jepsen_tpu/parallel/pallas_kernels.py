@@ -103,9 +103,11 @@ def _closure_kernel(plan, S: int, C: int, W: int,
     out_ref[:] = B_final
 
 
-def closure_call(sel, B, C: int, interpret: bool = False):
+def closure_call(sel, B, C: int, interpret: bool = False):  # jepsen-lint: disable=purity-numpy-call
     """Traceable (un-jitted) pallas invocation — usable inside an outer
-    scan/cond. sel [C, S, S] u32, B [S, W] u32 -> B' [S, W]."""
+    scan/cond. sel [C, S, S] u32, B [S, W] u32 -> B' [S, W].
+    np here builds the static word tables only (trace-time constants,
+    same rationale as bitdense._plan)."""
     from jepsen_tpu.parallel.bitdense import _plan
     S, W = B.shape
     W_plan, plan = _plan(C)

@@ -312,8 +312,15 @@ def _sharded2d_impl(xs, state0, step_name: str, Nd: int,
         lambda st, ml, mh, lv: route2(st, ml, mh, lv, B1f, B2f))
 
 
-@functools.partial(jax.jit, static_argnames=("step_name", "Nd", "n_slice",
-                                             "n_chip", "mesh"))
+# donation decision (recompile-donate-argnums) for the three sharded
+# jits: NOT donated. xs/state0 are replicated inputs reused across the
+# capacity-doubling retry loop in check_encoded_sharded (the SAME
+# device arrays re-dispatch at doubled Nd), and the resumable path
+# re-runs a chunk from the same placed carry after overflow — donation
+# would invalidate the retries.
+@functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
+                   static_argnames=("step_name", "Nd", "n_slice",
+                                    "n_chip", "mesh"))
 def _check_sharded2d(xs, state0, step_name: str, Nd: int, n_slice: int,
                      n_chip: int, mesh: Mesh):
     fn = jax.shard_map(
@@ -327,8 +334,10 @@ def _check_sharded2d(xs, state0, step_name: str, Nd: int, n_slice: int,
     return fn(xs, state0)
 
 
-@functools.partial(jax.jit, static_argnames=("step_name", "Nd", "n_dev",
-                                             "mesh", "exchange"))
+# same donation decision as _check_sharded2d above
+@functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
+                   static_argnames=("step_name", "Nd", "n_dev",
+                                    "mesh", "exchange"))
 def _check_sharded(xs, state0, step_name: str, Nd: int, n_dev: int,
                    mesh: Mesh, exchange: str = "route"):
     fn = jax.shard_map(
@@ -373,8 +382,10 @@ def _sharded_resume_impl(xs, st, ml, mh, live, ok, fail_r, r_idx, maxf,
     return carry, scan_ovf | pre_ovf
 
 
-@functools.partial(jax.jit, static_argnames=("step_name", "Nd", "n_dev",
-                                             "mesh"))
+# same donation decision as _check_sharded2d above
+@functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
+                   static_argnames=("step_name", "Nd", "n_dev",
+                                    "mesh"))
 def _check_sharded_resume(xs, st, ml, mh, live, ok, fail_r, r_idx, maxf,
                           step_name: str, Nd: int, n_dev: int,
                           mesh: Mesh):

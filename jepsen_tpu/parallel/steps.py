@@ -4,7 +4,10 @@ Each step has signature
 
     step(state: i32, f: i32, a0: i32, a1: i32, wild: bool) -> (state': i32, ok: bool)
 
-operating on scalars (the engine vmaps over configs × slots). States and
+operating on scalars (the engine vmaps over configs × slots). The
+`# jepsen-lint: device` pragmas mark each step as a traced root for the
+static purity pass: dispatch rides the STEPS dict, which a call graph
+cannot see (docs/linting.md). States and
 args are interned int32s (nil = -1). `wild` marks calls whose outcome is
 unknown (crashed reads): they apply as the identity and always succeed.
 
@@ -24,7 +27,7 @@ from jepsen_tpu.models import (
 )
 
 
-def register_step(state, f, a0, a1, wild):
+def register_step(state, f, a0, a1, wild):  # jepsen-lint: device
     """Register / CAS-register family (models.Register, models.CASRegister;
     knossos.model register/cas-register semantics).
 
@@ -49,7 +52,7 @@ def register_step(state, f, a0, a1, wild):
     return jnp.where(ok, new_state, state), ok
 
 
-def mutex_step(state, f, a0, a1, wild):
+def mutex_step(state, f, a0, a1, wild):  # jepsen-lint: device
     """Mutex (models.Mutex): state 0=unlocked, 1=locked."""
     is_acq = f == F_ACQUIRE
     is_rel = f == F_RELEASE
@@ -61,7 +64,7 @@ def mutex_step(state, f, a0, a1, wild):
     return jnp.where(ok, new_state, state), ok
 
 
-def gset_step(state, f, a0, a1, wild):
+def gset_step(state, f, a0, a1, wild):  # jepsen-lint: device
     """Grow-only set (models.GSet; knossos.model/set): state is the
     element bitmask itself — bit b set iff element with lane b has been
     added. Lanes are assigned by the encoder's prepare pass; histories
@@ -82,7 +85,7 @@ def gset_step(state, f, a0, a1, wild):
     return jnp.where(ok, new_state, state), ok
 
 
-def uqueue_step(state, f, a0, a1, wild):
+def uqueue_step(state, f, a0, a1, wild):  # jepsen-lint: device
     """Unordered queue (models.UnorderedQueue; knossos.model/
     unordered-queue): state packs one count lane per distinct value —
     a0 is the lane's bit offset, a1 its unshifted mask. Lane widths are
@@ -112,7 +115,7 @@ def uqueue_step(state, f, a0, a1, wild):
     return jnp.where(ok, new_state, state), ok
 
 
-def fifo_step(state, f, a0, a1, wild):
+def fifo_step(state, f, a0, a1, wild):  # jepsen-lint: device
     """Strict FIFO queue (models.FIFOQueue; knossos.model/fifo-queue):
     state is a sequence of v-bit value-code lanes, head at the LOW
     bits, code 0 = empty lane — so the occupied depth is implicit in

@@ -1,0 +1,71 @@
+"""Lint fixture: concurrency + env-flag-hygiene violations. NEVER
+imported — parsed by tests/test_lint.py only (line numbers are
+asserted there)."""
+
+import os
+import threading
+
+
+class Shared:
+    def __init__(self):
+        self.value = 0
+        self.lock = threading.Lock()
+
+
+def spawn_unlocked(shared):
+    def run():
+        shared.value = 42           # line 17: concurrency-unlocked-shared-write
+
+    t = threading.Thread(target=run)
+    t.start()
+    return t
+
+
+def spawn_locked(shared):
+    def run():
+        with shared.lock:
+            shared.value = 42       # locked: clean
+
+
+    t = threading.Thread(target=run)
+    t.start()
+    return t
+
+
+COUNTER = 0
+
+
+def spawn_global():
+    def bump():
+        global COUNTER
+        COUNTER = COUNTER + 1       # line 41: concurrency-unlocked-shared-write
+
+    threading.Thread(target=bump).start()
+
+
+def read_flags():
+    # the exact JEPSEN_TPU_PALLAS regression the linter must catch when
+    # reintroduced (bitdense read this raw before the accessor existed)
+    a = os.environ.get("JEPSEN_TPU_PALLAS")      # line 49: env-flag-accessor
+    b = os.getenv("JEPSEN_TPU_CLOSURE")          # line 50: env-flag-accessor
+    c = os.environ["JEPSEN_TPU_BUCKET"]          # line 51: env-flag-accessor
+    d = os.environ.get("NOT_OURS")               # foreign namespace: clean
+    return a, b, c, d
+
+
+class Box:
+    latest = 0
+
+
+SHARED_BOX = Box()
+
+
+class Poller:
+    """Bound-method thread target (the membership-nemesis shape):
+    the method must be analyzed too, not just Name/Lambda targets."""
+
+    def start(self):
+        threading.Thread(target=self._poll, daemon=True).start()
+
+    def _poll(self):
+        SHARED_BOX.latest = 1  # line 71: concurrency-unlocked-shared-write

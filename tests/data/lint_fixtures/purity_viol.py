@@ -1,0 +1,51 @@
+"""Lint fixture: device-purity violations. NEVER imported — parsed by
+tests/test_lint.py only (line numbers below are asserted there; edit
+with care)."""
+
+import os
+import random
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def helper(x):
+    # reachable from the jitted root via the call below
+    t = time.time()                       # line 18: purity-host-call
+    return x + t
+
+
+@jax.jit
+def traced_root(x):
+    y = helper(x)
+    noise = random.random()               # line 25: purity-host-call
+    flag = os.environ.get("SOME_VAR")     # line 26: purity-host-call
+    tbl = np.arange(8)                    # line 27: purity-numpy-call
+    if jnp.any(y > 0):                    # line 28: purity-tracer-branch
+        y = y + 1
+    while jnp.sum(y) > 0:                 # line 30: purity-tracer-branch
+        y = y - 1
+    ok = bool(jnp.all(y == 0))            # line 32: purity-tracer-branch
+    return y, noise, flag, tbl, ok
+
+
+def scan_user(xs):
+    def body(carry, x):
+        with open("/tmp/leak") as fh:     # line 38: purity-host-call
+            _ = fh
+        print("tracing", x)               # line 40: purity-host-call
+        return carry + x, x
+
+    return lax.scan(body, jnp.float32(0), xs)
+
+
+def host_side_is_fine():
+    # NOT reachable from any trace entry: none of these may be flagged
+    t = time.time()
+    r = random.random()
+    a = np.arange(4)
+    return t, r, a

@@ -1,0 +1,30 @@
+"""Lint fixture: recompilation hazards. NEVER imported — parsed by
+tests/test_lint.py only (line numbers are asserted there)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+CFG = {"a": 1, "b": 2}
+
+
+def per_call_jit(x):
+    # a fresh wrapper every call: the compile cache never hits
+    f = jax.jit(lambda a: a * 2)          # line 14: recompile-closure-capture
+    return f(x)
+
+
+def scalar_capture(scale):
+    def inner(a):
+        return a * scale
+
+    return jax.jit(inner)(jnp.ones(3))    # line 22: recompile-closure-capture
+
+
+@functools.partial(jax.jit, static_argnames=tuple(CFG.keys()))  # line 25
+def dict_order_static(x, a=1, b=2):
+    return x + a + b
+
+
+good = jax.jit(lambda a: a + 1, static_argnames=("n",))  # literal: clean

@@ -1,0 +1,70 @@
+"""Lint fixture: suppression syntax. NEVER imported — parsed by
+tests/test_lint.py only (line numbers are asserted there)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# jepsen-lint: disable-file=purity-tracer-branch
+
+
+@jax.jit
+def traced(x):
+    tbl = np.arange(4)  # jepsen-lint: disable=purity-numpy-call
+    # jepsen-lint: disable=purity-host-call
+    t = time.time()
+    if jnp.any(x > 0):  # covered by the disable-file above
+        x = x + 1
+    return x + tbl.sum() + t
+
+
+@jax.jit
+def whole_fn(y):  # jepsen-lint: disable=purity-numpy-call
+    # the def-line comment covers the entire body
+    a = np.arange(3)
+    b = np.zeros(3)
+    return y + a + b
+
+
+@jax.jit
+def naked(x):
+    t = time.time()  # jepsen-lint: disable
+    return x + t     # the bare disable above is bad-suppression
+
+
+@jax.jit
+def unknown_rule(x):
+    t = time.time()  # jepsen-lint: disable=not-a-rule
+    return x + t
+
+
+# own-line comment above a DECORATED def lands on the decorator line —
+# it must still cover the function body
+# jepsen-lint: disable=purity-host-call
+@jax.jit
+def decorated_covered(x):
+    t = time.time()
+    return x + t
+
+
+import functools  # noqa: E402
+
+
+# device pragma above a decorated def must still register the root
+# jepsen-lint: device
+@functools.lru_cache(None)
+def pragma_decorated(x):
+    t = time.time()
+    return x + t
+
+
+@jax.jit
+def gap_suppressed(x):
+    # jepsen-lint: disable=purity-numpy-call
+    # an explanatory comment (or blank line) between the directive and
+    # the statement must not void the suppression
+
+    tbl = np.arange(5)
+    return x + tbl

@@ -179,3 +179,52 @@ def test_bench_adv_section_contract():
         assert k in line, line
     assert line["L"] == 200 and line["value"] > 0
     assert line["unit"] == "ops/sec"
+
+
+def test_prior_onchip_headline_orders_by_round_number(tmp_path,
+                                                      monkeypatch):
+    """Artifact selection must rank bench_r<N>_onchip.jsonl by PARSED
+    round number — git checkouts do not preserve mtime, so a fresh
+    clone can easily give an older round the newest mtime. Unparsable
+    names fall back to mtime and rank below any parsed round."""
+    import importlib
+
+    import bench
+
+    results = tmp_path / "bench_results"
+    results.mkdir()
+
+    def write(name, value, backend="tpu"):
+        p = results / name
+        p.write_text(json.dumps({"metric": "headline", "value": value,
+                                 "vs_baseline": 1.0,
+                                 "backend": backend}) + "\n")
+        return p
+
+    r2 = write("bench_r2_onchip.jsonl", 222.0)
+    r10 = write("bench_r10_onchip.jsonl", 1010.0)
+    # checkout order inverted: the OLD round has the NEWEST mtime (and
+    # a filename sort would also pick r2 over r10)
+    now = time.time()
+    os.utime(r10, (now - 1000, now - 1000))
+    os.utime(r2, (now, now))
+
+    monkeypatch.setattr(bench, "__file__",
+                        str(tmp_path / "bench.py"))
+    prior = bench._prior_onchip_headline()
+    assert prior is not None and prior["value"] == 1010.0, prior
+    assert prior["file"].endswith("bench_r10_onchip.jsonl"), prior
+
+    # a no-round artifact with the newest mtime still loses to a
+    # parsed round...
+    noround = write("bench_manual_onchip.jsonl", 555.0)
+    os.utime(noround, (now + 10, now + 10))
+    assert bench._prior_onchip_headline()["value"] == 1010.0
+
+    # ...but decides by mtime when no round parses anywhere
+    r2.unlink()
+    r10.unlink()
+    write("bench_alpha_onchip.jsonl", 111.0)
+    os.utime(results / "bench_alpha_onchip.jsonl", (now - 50, now - 50))
+    assert bench._prior_onchip_headline()["value"] == 555.0
+    importlib.reload(bench)

@@ -310,3 +310,73 @@ def test_fori_closure_mode_differential():
         assert rf["closure"] == "xla-fori"
         assert rw["valid?"] is rf["valid?"], (rw, rf)
         assert rw.get("fail-event") == rf.get("fail-event")
+
+
+def test_batch_pallas_multidevice_mesh_falls_back_to_xla(monkeypatch):
+    """An unmeasured multi-device Mosaic lowering gap on the DEFAULT
+    pallas path must degrade to the XLA closure with a note — not
+    crash the batch check. Explicit use_pallas=True (kernel tests, A/B
+    runs) and single-device runs must still see the real error.
+    Simulated by failing the engine call whenever the pallas variant
+    is requested (the real trigger needs multi-chip TPU hardware)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from jepsen_tpu.histories import adversarial_register_history
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.parallel import encode as enc_mod
+
+    hs = [adversarial_register_history(n_ops=40, k_crashed=11, seed=s)
+          for s in range(3)]
+    hs.append(_with_impossible_read(hs[0]))
+    encs = [enc_mod.encode(CASRegister(), h) for h in hs]
+    mesh = Mesh(np.array(jax.devices()[:4]), ("keys",))
+
+    baseline = bitdense.check_batch_bitdense(encs, mesh=mesh,
+                                             use_pallas=False)
+
+    real = bitdense._check_bitdense_batch
+
+    def failing_on_pallas(*args):
+        if args[6]:  # use_pallas
+            raise RuntimeError("Mosaic lowering gap (simulated)")
+        return real(*args)
+
+    monkeypatch.setattr(bitdense, "_check_bitdense_batch",
+                        failing_on_pallas)
+    # true DEFAULT path: use_pallas=None, no env flag, and the
+    # platform gate resolving ON (as it would on a real TPU mesh)
+    monkeypatch.delenv("JEPSEN_TPU_PALLAS", raising=False)
+    monkeypatch.setattr(bitdense, "_resolve_use_pallas",
+                        lambda up, S, C, platform: (True, True))
+    rs = bitdense.check_batch_bitdense(encs, mesh=mesh)
+    assert [r["valid?"] for r in rs] == [r["valid?"] for r in baseline]
+    assert rs[-1]["valid?"] is False
+    assert rs[-1]["fail-event"] == baseline[-1]["fail-event"]
+    for r in rs:
+        assert r["closure"] == "xla-while"
+        assert "pallas closure failed on a 4-device mesh" \
+            in r["closure-note"]
+
+    # explicit request: the error must surface
+    with pytest.raises(RuntimeError, match="Mosaic"):
+        bitdense.check_batch_bitdense(encs, mesh=mesh, use_pallas=True)
+
+    # ...and a malformed env flag (never consulted when the arg is
+    # explicit) must not shadow the real pallas error in the handler
+    monkeypatch.setenv("JEPSEN_TPU_PALLAS", "yes")
+    with pytest.raises(RuntimeError, match="Mosaic"):
+        bitdense.check_batch_bitdense(encs, mesh=mesh, use_pallas=True)
+    monkeypatch.delenv("JEPSEN_TPU_PALLAS")
+
+    # env-forced =1 is a force ("=1 forces it on" is the documented
+    # contract): it must surface the error too, not degrade silently
+    monkeypatch.setenv("JEPSEN_TPU_PALLAS", "1")
+    with pytest.raises(RuntimeError, match="Mosaic"):
+        bitdense.check_batch_bitdense(encs, mesh=mesh)
+    monkeypatch.delenv("JEPSEN_TPU_PALLAS")
+
+    # single-device (no mesh): the default path must also surface it —
+    # the 1-device config IS the measured one, a failure there is news
+    with pytest.raises(RuntimeError, match="Mosaic"):
+        bitdense.check_batch_bitdense(encs)

@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""`jepsen-tpu lint` runner — the tools/ entry for CI and hooks.
+
+Thin wrapper over jepsen_tpu.analysis with the CLI exit-code contract:
+0 = clean (every finding suppressed, each suppression naming its
+rule), 1 = active findings, 2 = usage error. Pure AST work: CPU-only,
+no JAX import, no device init — safe to run first in the tier-1 flow
+and on machines with a wedged device runtime.
+
+    python tools/lint.py --check          # the CI gate
+    python tools/lint.py --json           # machine-readable report
+    python tools/lint.py jepsen_tpu/parallel --show-suppressed
+
+Equivalent entry points: `python -m jepsen_tpu.analysis` and the
+`jepsen lint` CLI subcommand (jepsen_tpu.cli).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from jepsen_tpu import analysis  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(analysis.main())
