@@ -127,12 +127,16 @@ def _enable_compile_cache():
 
 
 def _adv_encoded(L):
+    """(model, history, encoded, encode_secs) — encode timed so every
+    device section can report its encode/transfer/device split."""
     from jepsen_tpu.histories import adversarial_register_history
     from jepsen_tpu.models import CASRegister
     from jepsen_tpu.parallel import encode as enc_mod
     model = CASRegister()
     h = adversarial_register_history(n_ops=L, k_crashed=ADV_K, seed=7)
-    return model, h, enc_mod.encode(model, h)
+    t0 = perf_counter()
+    e = enc_mod.encode(model, h)
+    return model, h, e, perf_counter() - t0
 
 
 # ======================= child sections ============================
@@ -178,12 +182,18 @@ def sec_multikey(label: str = None):
     C_max = max(e.n_slots for e in pre)
     assert bitdense.fits_bitdense(S_max, C_max), (S_max, C_max)
     bitdense.check_batch_bitdense(pre)          # warm up (jit compile)
+    # measured via the dispatch/finalize split so the JSONL carries the
+    # pad+place (transfer) vs search (device) separation; their sum is
+    # the same wall the old single check_batch_bitdense call measured
     t0 = perf_counter()
-    rs = bitdense.check_batch_bitdense(pre)
-    device_secs = perf_counter() - t0
+    pending = bitdense.dispatch_batch_bitdense(pre)
+    rs = pending.finalize()
+    batch_secs = perf_counter() - t0
+    transfer_secs = pending.transfer_secs
+    device_secs = batch_secs - transfer_secs
     assert all(r["valid?"] is True for r in rs), rs[:3]
     closure = rs[0].get("closure")
-    e2e_secs = encode_secs + device_secs
+    e2e_secs = encode_secs + batch_secs
     dev_rate = total_ops / e2e_secs
 
     # Host baseline = checker.linear_packed: int-config frontier over
@@ -213,9 +223,11 @@ def sec_multikey(label: str = None):
           "vs_baseline": round(dev_rate / host32_rate, 2),
           **line_extra,
           "closure": closure,
-          "device_only_secs": round(device_secs, 3),
+          "device_only_secs": round(batch_secs, 3),
           "encode_secs": round(encode_secs, 3),
-          "device_only_ops_per_sec": round(total_ops / device_secs, 1),
+          "transfer_secs": round(transfer_secs, 4),
+          "device_secs": round(device_secs, 3),
+          "device_only_ops_per_sec": round(total_ops / batch_secs, 1),
           "host_seq_ops_per_sec": round(host_rate, 1),
           "host_cpus": os.cpu_count() or 1,
           "baseline": "packed int-config host engine (our fastest CPU "
@@ -224,20 +236,71 @@ def sec_multikey(label: str = None):
                       "(per-key checks parallelize perfectly, so 32x is "
                       "the host's true ceiling)"})
 
+    # -- pipelined e2e: the same batch through the pipelined executor
+    # (encode/transfer overlapped with device work, parallel.pipeline),
+    # with the encode/transfer/device split reported PER BUCKET. Run
+    # once cache-less to warm the chunk-shape compiles, then measure a
+    # steady cache-less pass (the overlap win) and a cache-hit pass
+    # (the re-analysis win). Verdict parity with the serial line is
+    # asserted — a pipelined speedup that changed answers would be a
+    # bug report, not a result.
+    from jepsen_tpu.parallel import engine, pipeline as pipe_mod
+    engine.check_batch(model, keys, pipeline=True, cache=False)  # warm
+    pstats = {}
+    t0 = perf_counter()
+    rs_p = engine.check_batch(model, keys, pipeline=True, cache=False,
+                              pipeline_stats=pstats)
+    pipe_secs = perf_counter() - t0
+    assert [r["valid?"] for r in rs_p] == [r["valid?"] for r in rs]
+    # explicit capacity: the cached pass must measure cache hits even
+    # under JEPSEN_TPU_ENCODE_CACHE=0 in the ambient env (an explicit
+    # arg overrides the flag, same contract as the other perf flags)
+    cache = pipe_mod.EncodeCache(max_entries=N_KEYS + 8)
+    engine.check_batch(model, keys, pipeline=True, cache=cache)  # fill
+    cstats = {}
+    t0 = perf_counter()
+    rs_c = engine.check_batch(model, keys, pipeline=True, cache=cache,
+                              pipeline_stats=cstats)
+    cached_secs = perf_counter() - t0
+    assert [r["valid?"] for r in rs_c] == [r["valid?"] for r in rs]
+    assert cstats["cache"]["encodes"] == 0, cstats["cache"]
+    emit({"metric": f"multi-key {N_KEYS}x{OPS_PER_KEY}-op cas-register "
+                    f"(north-star shape), pipelined {what}",
+          "value": round(total_ops / pipe_secs, 1), "unit": "ops/sec",
+          "vs_baseline": round(total_ops / pipe_secs / host32_rate, 2),
+          **line_extra,
+          "closure": closure,
+          "serial_e2e_secs": round(e2e_secs, 3),
+          "pipelined_e2e_secs": round(pipe_secs, 3),
+          "cached_e2e_secs": round(cached_secs, 3),
+          "cache": cstats["cache"],
+          "buckets": pstats["buckets"],
+          "note": "pipelined = encode + transfer overlapped with "
+                  "device search (JEPSEN_TPU_PIPELINE); cached = "
+                  "second pass over the same histories, zero "
+                  "re-encodes; buckets carry the per-bucket "
+                  "encode/transfer/device split"})
+
 
 def sec_adv(L: int, host_deadline: float, skip_host: bool,
             host_est_hint: float | None):
     from jepsen_tpu.checker import linear_packed
     from jepsen_tpu.parallel import bitdense
 
-    _, _, e = _adv_encoded(L)
+    _, _, e, encode_secs = _adv_encoded(L)
     assert bitdense.fits_bitdense(bitdense.n_states(e), e.n_slots)
     t0 = perf_counter()
     r = bitdense.check_encoded_bitdense(e)      # cold (compile per R)
     warm_secs = perf_counter() - t0
+    tms = {}
     t0 = perf_counter()
-    r = bitdense.check_encoded_bitdense(e)      # steady state
-    dev_secs = perf_counter() - t0
+    r = bitdense.check_encoded_bitdense(e, timings=tms)  # steady state
+    steady_secs = perf_counter() - t0
+    # dev_secs keeps the HISTORICAL meaning (whole steady call — the
+    # quantity the r5 artifacts recorded and the rate/speedup below
+    # use); the split keys are uniform across sections: device_secs =
+    # search only, transfer_secs reported separately
+    dev_secs = steady_secs
     assert r["valid?"] is True, r
     closure = r.get("closure")
     R = e.n_returns
@@ -278,7 +341,13 @@ def sec_adv(L: int, host_deadline: float, skip_host: bool,
           "vs_baseline": speedup,
           "L": L,
           "closure": closure,
-          "device_secs": round(dev_secs, 3),
+          # split keys, uniform across sections: device_secs = search
+          # only; steady_secs = the whole steady call (the r5
+          # artifacts' old "device_secs"), which value/vs_baseline use
+          "device_secs": round(tms["device_secs"], 3),
+          "encode_secs": round(encode_secs, 3),
+          "transfer_secs": round(tms["transfer_secs"], 4),
+          "steady_secs": round(steady_secs, 3),
           "device_compile_secs": round(warm_secs - dev_secs, 2),
           "host_est_secs": round(host_est, 1) if host_est else None,
           "host": host_info,
@@ -295,8 +364,20 @@ def sec_sharded(L: int, host_est: float | None,
     from jax.sharding import Mesh
     from jepsen_tpu.parallel import sharded
 
-    _, _, e = _adv_encoded(L)
+    _, _, e, encode_secs = _adv_encoded(L)
     mesh = Mesh(np.array(jax.devices()), ("frontier",))
+    # H2D split: an explicit replicated placement of the event tables
+    # onto the mesh, blocked on — the same arrays the engine ships
+    # (its own internal placement is what the device_secs then pays)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    t0 = perf_counter()
+    placed = jax.device_put(
+        {"slot_f": e.slot_f, "slot_a0": e.slot_a0, "slot_a1": e.slot_a1,
+         "slot_wild": e.slot_wild, "slot_occ": e.slot_occ,
+         "ev_slot": e.ev_slot}, NamedSharding(mesh, P()))
+    jax.block_until_ready(placed)
+    transfer_secs = perf_counter() - t0
+    del placed
     # cap_log is the parent's downshift lever: the r5 chip session saw
     # the 2^17-capacity program crash the TPU *worker process* on its
     # first hardware contact, so a crashed first attempt is retried in
@@ -328,9 +409,15 @@ def sec_sharded(L: int, host_est: float | None,
             if host_est else None,
             "devices": r.get("devices"), "valid": r.get("valid?"),
             "device_secs": round(dev_secs, 2),
+            "encode_secs": round(encode_secs, 3),
+            "transfer_secs": round(transfer_secs, 4),
             "warm_secs": round(warm, 2),
             "note": "owner-routed all-to-all exchange; multi-device "
-                    "behavior exercised on the 8-way CPU mesh in CI"}
+                    "behavior exercised on the 8-way CPU mesh in CI; "
+                    "the sharded engine has no transfer/search seam, "
+                    "so device_secs includes its internal placement "
+                    "and transfer_secs is a separate explicit "
+                    "measurement of the same arrays"}
     if cap == cap0:
         # warm and steady runs share one shape, so the difference IS
         # the compile; after tier growth it would also contain whole
@@ -357,13 +444,15 @@ def sec_maxlen(budget_secs: float):
     budget_per_run = MAXLEN_RUN_BUDGET
     L = 400 if SMOKE else 10000
     prev_dt = None
+    split = {}   # encode/transfer/device of the last PASSING probe
     while left() > 2.5 * budget_per_run:
         if prev_dt is not None and prev_dt * 2 > 1.5 * budget_per_run:
             break   # doubling would clearly blow the budget; stop early
-        _, _, e = _adv_encoded(L)
+        _, _, e, encode_secs = _adv_encoded(L)
         bitdense.check_encoded_bitdense(e)          # compile, uncounted
+        tms = {}
         t0 = perf_counter()
-        r = bitdense.check_encoded_bitdense(e)
+        r = bitdense.check_encoded_bitdense(e, timings=tms)
         dt = perf_counter() - t0
         assert r["valid?"] is True, r
         note(f"max-length probe L={L}: {dt:.1f}s steady")
@@ -371,6 +460,9 @@ def sec_maxlen(budget_secs: float):
             max_len = L
             L *= 2
             prev_dt = dt
+            split = {"encode_secs": round(encode_secs, 3),
+                     "transfer_secs": round(tms["transfer_secs"], 4),
+                     "device_secs": round(tms["device_secs"], 3)}
         else:
             break
     if max_len:
@@ -379,8 +471,11 @@ def sec_maxlen(budget_secs: float):
                         f"budget",
               "value": max_len, "unit": "ops",
               "vs_baseline": None,
+              **split,
               "note": "steady-state device time; per-shape compile "
-                      "excluded (one-time, cached)"})
+                      "excluded (one-time, cached); "
+                      "encode/transfer/device split is the verified "
+                      "(largest passing) length's"})
 
 
 # ======================= parent orchestrator =======================

@@ -81,3 +81,48 @@ def env_choice(name: str, choices: Sequence[str],
     raise EnvFlagError(
         f"{name}={raw!r}: unknown {what} (expected one of "
         f"{tuple(choices)})")
+
+
+def env_int(name: str, default: Optional[int] = None,
+            min_value: Optional[int] = None,
+            what: str = "value") -> Optional[int]:
+    """An integer flag. Unset -> ``default``; a non-integer value or
+    one below ``min_value`` raises :class:`EnvFlagError` — same
+    fail-loud contract as the other accessors (a malformed cache-size
+    flag must not silently disable the cache or blow up later in an
+    unrelated stack)."""
+    raw = env_raw(name)
+    if raw is None:
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        raise EnvFlagError(
+            f"{name}={raw!r}: must be an integer {what}; unset the "
+            f"variable to get the default")
+    if min_value is not None and v < min_value:
+        raise EnvFlagError(
+            f"{name}={raw!r}: {what} must be >= {min_value}")
+    return v
+
+
+# Registry of the JEPSEN_TPU_* flags in circulation — one line per
+# flag, naming the accessor and the owning module, so the namespace
+# stays auditable in one place (the env-flag-accessor lint rule keeps
+# every READ going through this module; this table documents what a
+# grep for the prefix should find):
+#
+#   JEPSEN_TPU_PALLAS        env_bool    parallel.bitdense — closure
+#                            kernel default (r5 on-chip verdict)
+#   JEPSEN_TPU_CLOSURE       env_choice  parallel.bitdense — XLA loop
+#                            shape ("while"/"fori")
+#   JEPSEN_TPU_BUCKET        env_choice  parallel.engine — batch
+#                            bucketing strategy ("tier"/"exact")
+#   JEPSEN_TPU_PIPELINE      env_bool    parallel.engine — route
+#                            check_batch through the pipelined
+#                            executor (parallel.pipeline); opt-in
+#                            until bench records a win
+#   JEPSEN_TPU_ENCODE_CACHE  env_int     parallel.pipeline — encode
+#                            cache capacity in entries (0 disables)
+#   JEPSEN_TPU_TEST_WEDGE    env_bool    bench — test seam simulating
+#                            a wedged PJRT runtime

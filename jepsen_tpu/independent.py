@@ -285,11 +285,19 @@ class IndependentChecker(Checker):
 
     When the wrapped checker is a device-capable Linearizable, the keys
     are checked as one batched device program (the P5 batch axis)
-    rather than one host search per key."""
+    rather than one host search per key.
 
-    def __init__(self, checker: Checker, batch_device: bool = True):
+    `pipeline` routes that batch through the pipelined executor
+    (engine.check_batch(pipeline=...): host encode / transfer / device
+    search overlapped, encode cache consulted). None defers to the
+    JEPSEN_TPU_PIPELINE env flag — opt-in, results identical either
+    way."""
+
+    def __init__(self, checker: Checker, batch_device: bool = True,
+                 pipeline: Optional[bool] = None):
         self.checker = checker
         self.batch_device = batch_device
+        self.pipeline = pipeline
 
     def check(self, test, history, opts=None):
         opts = opts or {}
@@ -362,7 +370,7 @@ class IndependentChecker(Checker):
             # engine (engine._escalate_overflow)
             mesh = (test or {}).get("mesh")
             rs = engine.check_batch(model, [subs[k] for k in ks],
-                                    mesh=mesh)
+                                    mesh=mesh, pipeline=self.pipeline)
             return {k: {**r, "analyzer": "jax"} for k, r in zip(ks, rs)}, None
         except EncodeError as err:
             # legitimately not device-encodable (a gset key past the
@@ -400,5 +408,6 @@ def _edn_pprint(x) -> str:
     return edn.dumps(x) + "\n"
 
 
-def checker(c: Checker, batch_device: bool = True) -> IndependentChecker:
-    return IndependentChecker(c, batch_device)
+def checker(c: Checker, batch_device: bool = True,
+            pipeline: Optional[bool] = None) -> IndependentChecker:
+    return IndependentChecker(c, batch_device, pipeline=pipeline)

@@ -30,6 +30,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Tuple
 
+import numpy as np
+
 
 class Inconsistent:
     """The op cannot be applied to this state (knossos.model/inconsistent)."""
@@ -253,6 +255,15 @@ class PackedSpec:
     step_name: str
     encode_call: Callable[..., Tuple[int, int, int, bool]]
     f_codes: dict
+    # optional bulk hook: (calls) -> (f, a0, a1, wild) numpy arrays —
+    # row i identical to encode_call(calls[i].f, .value, .result,
+    # .crashed), including the interning ORDER (encode() must produce
+    # the same arrays whichever path runs). Exists because the
+    # per-call Python loop is the measured constant on the batched
+    # end-to-end path (PERF_R05: encode-bound, not search-bound); the
+    # bulk form preallocates the arrays and keeps the dispatch
+    # overhead to one call per history instead of one per op.
+    encode_calls: Callable = None
     # dense-engine state domain: states are the contiguous ints
     # [state_lo, state_lo + n_states(intern)); register family uses
     # interned value codes with nil = -1, mutex uses {0, 1}
@@ -307,11 +318,58 @@ def pack_spec(model: Model, intern) -> Optional[PackedSpec]:
                 return (F_CAS, intern.code(old), intern.code(new), False)
             raise ValueError(f"register family: unknown f {f!r}")
 
+        def encode_calls(cs):
+            # bulk row-wise mirror of encode_call — same branches, same
+            # interning order (read interns only when constraining).
+            # Accumulates in Python lists and converts once at the end:
+            # per-element numpy stores cost more than the whole row's
+            # logic, and the per-call tuple + function call are the
+            # measured overhead this hook exists to remove
+            # (tools/perf_encode.py).
+            fs, a0, a1, wild = [], [], [], []
+            code = intern.code
+            for c in cs:
+                cf = c.f
+                w = False
+                x0 = x1 = -1
+                if cf == "read":
+                    v = c.result if c.result is not None else c.value
+                    fc = F_READ
+                    if c.crashed or v is None:
+                        w = True
+                    else:
+                        x0 = code(v)
+                elif cf == "write":
+                    if c.value is None:
+                        fc = F_READ
+                        w = True
+                    else:
+                        fc = F_WRITE
+                        x0 = code(c.value)
+                elif cf == "cas":
+                    if c.value is None:
+                        fc = F_READ
+                        w = True
+                    else:
+                        old, new = c.value
+                        fc = F_CAS
+                        x0 = code(old)
+                        x1 = code(new)
+                else:
+                    raise ValueError(f"register family: unknown f {cf!r}")
+                fs.append(fc)
+                a0.append(x0)
+                a1.append(x1)
+                wild.append(w)
+            return (np.array(fs, np.int32), np.array(a0, np.int32),
+                    np.array(a1, np.int32), np.array(wild, bool))
+
         cls = type(model)
         return PackedSpec(
             state0=state0,
             step_name="register",
             encode_call=encode_call,
+            encode_calls=encode_calls,
             f_codes={"read": F_READ, "write": F_WRITE, "cas": F_CAS},
             state_lo=-1,
             n_states=lambda intern: len(intern) + 1,
@@ -326,10 +384,24 @@ def pack_spec(model: Model, intern) -> Optional[PackedSpec]:
                 return (F_RELEASE, -1, -1, False)
             raise ValueError(f"mutex: unknown f {f!r}")
 
+        def encode_calls(cs):
+            fs = []
+            for c in cs:
+                if c.f == "acquire":
+                    fs.append(F_ACQUIRE)
+                elif c.f == "release":
+                    fs.append(F_RELEASE)
+                else:
+                    raise ValueError(f"mutex: unknown f {c.f!r}")
+            n = len(cs)
+            return (np.array(fs, np.int32), np.full(n, -1, np.int32),
+                    np.full(n, -1, np.int32), np.zeros(n, bool))
+
         return PackedSpec(
             state0=1 if model.locked else 0,
             step_name="mutex",
             encode_call=encode_call,
+            encode_calls=encode_calls,
             f_codes={"acquire": F_ACQUIRE, "release": F_RELEASE},
             state_lo=0,
             n_states=lambda intern: 2,
@@ -398,6 +470,27 @@ def _gset_spec(model: "GSet") -> PackedSpec:
             return (F_READ, _gset_mask(v), -1, False)
         raise ValueError(f"gset: unknown f {f!r}")
 
+    def encode_calls(cs):
+        fs, a0, wild = [], [], []
+        for c in cs:
+            if c.f == "add":
+                fs.append(F_ADD)
+                a0.append(lanes[c.value])
+                wild.append(False)
+            elif c.f == "read":
+                v = c.result if not c.crashed else None
+                fs.append(F_READ)
+                if v is None:
+                    a0.append(-1)
+                    wild.append(True)
+                else:
+                    a0.append(_gset_mask(v))
+                    wild.append(False)
+            else:
+                raise ValueError(f"gset: unknown f {c.f!r}")
+        return (np.array(fs, np.int32), np.array(a0, np.int32),
+                np.full(len(cs), -1, np.int32), np.array(wild, bool))
+
     def unpack_state(code, intern):
         return GSet(frozenset(v for v, b in lanes.items()
                               if (code >> b) & 1))
@@ -406,6 +499,7 @@ def _gset_spec(model: "GSet") -> PackedSpec:
         state0=0,  # finalized by prepare (needs the lane table)
         step_name="gset",
         encode_call=encode_call,
+        encode_calls=encode_calls,
         f_codes={"add": F_ADD, "read": F_READ},
         state_lo=0,
         n_states=lambda intern: 1 << len(lanes),
@@ -482,6 +576,22 @@ def _fifo_spec(model: "FIFOQueue") -> PackedSpec:
             return (F_DEQ, lanes[v], width[0], False)
         raise ValueError(f"fifo-queue: unknown f {f!r}")
 
+    def encode_calls(cs):
+        fs, a0 = [], []
+        for c in cs:
+            if c.f == "enqueue":
+                fs.append(F_ENQ)
+                a0.append(lanes[c.value])
+            elif c.f == "dequeue":
+                v = None if c.crashed else c.result
+                fs.append(F_DEQ)
+                a0.append(-1 if v is None else lanes[v])
+            else:
+                raise ValueError(f"fifo-queue: unknown f {c.f!r}")
+        n = len(cs)
+        return (np.array(fs, np.int32), np.array(a0, np.int32),
+                np.full(n, width[0], np.int32), np.zeros(n, bool))
+
     def unpack_state(code, intern):
         by_code = {c: v for v, c in lanes.items()}
         items = []
@@ -495,6 +605,7 @@ def _fifo_spec(model: "FIFOQueue") -> PackedSpec:
         state0=0,  # finalized by prepare
         step_name="fifo",
         encode_call=encode_call,
+        encode_calls=encode_calls,
         f_codes={"enqueue": F_ENQ, "dequeue": F_DEQ},
         state_lo=0,
         n_states=lambda intern: 1 << (bound[0] * width[0]),
@@ -564,6 +675,35 @@ def _uqueue_spec(model: "UnorderedQueue") -> PackedSpec:
             return (F_DEQ, o, m, False)
         raise ValueError(f"unordered-queue: unknown f {f!r}")
 
+    def encode_calls(cs):
+        fs, a0, a1, wild = [], [], [], []
+        for c in cs:
+            w = False
+            x0 = x1 = -1
+            if c.f == "enqueue":
+                if c.value is None:
+                    fc = F_READ
+                    w = True
+                else:
+                    fc = F_ENQ
+                    x0, x1 = lanes[c.value]
+            elif c.f == "dequeue":
+                v = None if c.crashed else c.result
+                if v is None:
+                    fc = F_READ
+                    w = True
+                else:
+                    fc = F_DEQ
+                    x0, x1 = lanes[v]
+            else:
+                raise ValueError(f"unordered-queue: unknown f {c.f!r}")
+            fs.append(fc)
+            a0.append(x0)
+            a1.append(x1)
+            wild.append(w)
+        return (np.array(fs, np.int32), np.array(a0, np.int32),
+                np.array(a1, np.int32), np.array(wild, bool))
+
     def unpack_state(code, intern):
         items = []
         for v, (o, m) in lanes.items():
@@ -576,6 +716,7 @@ def _uqueue_spec(model: "UnorderedQueue") -> PackedSpec:
         state0=0,  # finalized by prepare
         step_name="uqueue",
         encode_call=encode_call,
+        encode_calls=encode_calls,
         f_codes={"enqueue": F_ENQ, "dequeue": F_DEQ},
         state_lo=0,
         n_states=lambda intern: 1 << total_bits[0],

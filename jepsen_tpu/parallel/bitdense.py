@@ -346,14 +346,22 @@ def n_states(e: EncodedHistory) -> int:
 
 def check_encoded_bitdense(e: EncodedHistory,
                            use_pallas: bool = None,
-                           closure_mode: str = None) -> dict:
+                           closure_mode: str = None,
+                           timings: dict = None) -> dict:
     """Single-key bit-packed check. `use_pallas` routes the closure
     through the VMEM-resident pallas kernel (parallel.pallas_kernels);
     default: ON for a real-TPU platform (r5 on-chip A/B verdict;
     JEPSEN_TPU_PALLAS=0/1 overrides), and only for shapes the kernel
     supports (the same default governs the batch path).
     `closure_mode` picks the XLA loop shape ("while"/"fori", see
-    _resolve_closure_mode); ignored when pallas runs."""
+    _resolve_closure_mode); ignored when pallas runs.
+
+    `timings`, when a dict, receives a `transfer_secs`/`device_secs`
+    split (bench's per-section JSONL keys): the event tables are then
+    explicitly placed and BLOCKED on before the search is issued, so
+    the two numbers are a clean H2D / search separation — at the cost
+    of serializing transfer against compute, which is why the default
+    (timings=None) path is untouched."""
     if e.n_returns == 0:
         return {"valid?": True, "engine": "bitdense"}
     from jepsen_tpu.parallel.dense import _xs_dense
@@ -362,10 +370,21 @@ def check_encoded_bitdense(e: EncodedHistory,
     use_pallas, interpret = _resolve_use_pallas(
         use_pallas, S, C, jax.default_backend())
     closure_mode = _resolve_closure_mode(closure_mode, use_pallas)
-    valid, fail_r = _check_bitdense(_xs_dense(e, C), jnp.int32(e.state0),
+    xs = _xs_dense(e, C)
+    if timings is not None:
+        from time import perf_counter
+        t0 = perf_counter()
+        xs = {k: jnp.asarray(v) for k, v in xs.items()}
+        jax.block_until_ready(xs)
+        timings["transfer_secs"] = perf_counter() - t0
+        t0 = perf_counter()
+    valid, fail_r = _check_bitdense(xs, jnp.int32(e.state0),
                                     e.step_name, S, C, e.state_lo,
                                     use_pallas, interpret, closure_mode)
-    out = {"valid?": bool(valid), "engine": "bitdense",
+    valid_b = bool(valid)  # materializes: the device wait ends here
+    if timings is not None:
+        timings["device_secs"] = perf_counter() - t0
+    out = {"valid?": valid_b, "engine": "bitdense",
            "states": S, "slots": C,
            "closure": "pallas" if use_pallas
            else f"xla-{closure_mode}"}
@@ -453,46 +472,48 @@ def _annotate_cost(ca, use_pallas, interpret, mode) -> dict:
     return out
 
 
-def check_batch_bitdense(encs, mesh=None, use_pallas: bool = None,
-                         closure_mode: str = None) -> list:
-    """Batched per-key check. Callers must ensure the COMBINED padded
-    dims fit (fits_bitdense(max S, max C)) — individually-fitting keys
-    can combine into an over-budget program; engine.check_batch does
-    this check and falls back to per-key dispatch otherwise.
-    `use_pallas` routes each key's closure through the VMEM-resident
-    kernel (vmapped over keys); default: ON for a real-TPU platform
-    (r5 on-chip A/B; JEPSEN_TPU_PALLAS=0/1 overrides), gated to shapes
-    the kernel supports at the PADDED dims.
-    `closure_mode` picks the XLA loop shape ("while"/"fori")."""
-    if not encs:
-        return []
-    from jepsen_tpu.parallel.encode import pad_batch
-    step_name = encs[0].step_name
-    xs, state0, S, C, R = pad_batch(encs, mesh=mesh, min_slots=5)
-    # gate on where the batch actually lives: pad_batch pins it to the
-    # mesh when one is given, regardless of the process default backend
-    platform = (mesh.devices.flat[0].platform if mesh is not None
-                else jax.default_backend())
-    # Mesh-sharded TPU batches follow the same default as the rest
-    # (_resolve_use_pallas: ON for a real-TPU platform). The guard that
-    # used to pin them to XLA came off with the r5 on-chip measurement:
-    # the non-interpret SPMD lowering (shard_map -> mosaic) compiled
-    # and ran on a real 1-device TPU mesh, agreed with the XLA closure
-    # on all 84 keys, and won 1.48x; the multi-device slicing logic is
-    # differential-tested on the 8-way CPU mesh (tests/test_pallas.py).
-    up, interpret = _resolve_use_pallas(use_pallas, S, C, platform)
-    mode = _resolve_closure_mode(closure_mode, up)
-    n_dev = 1 if mesh is None else int(np.asarray(mesh.devices).size)
-    note = None
-    try:
-        valid, fail_r = _check_bitdense_batch(xs, state0, step_name, S, C,
-                                              encs[0].state_lo, up,
-                                              interpret, mode)
-        # materialize inside the try: async dispatch surfaces runtime
-        # failures here, not at the call
-        valid = np.asarray(valid)
-        fail_r = np.asarray(fail_r)
-    except Exception as err:  # noqa: BLE001 — see the gate below
+class PendingBitdenseBatch:
+    """A batched bitdense check that has been ISSUED but not consumed.
+
+    JAX dispatch is async: construction pads + places the batch
+    (`transfer_secs` records that host-side cost) and enqueues the
+    device program, returning while it runs; `finalize()` blocks on
+    the results and builds the per-key dicts (`device_wait_secs`
+    records the blocked wait). The pipelined executor
+    (parallel.pipeline) leans on this split to overlap the next
+    chunk's host encode with this chunk's device search;
+    check_batch_bitdense() is dispatch + finalize back to back."""
+
+    def __init__(self, encs, xs, state0, S, C, up, interpret, mode,
+                 n_dev, use_pallas_arg, closure_mode_arg,
+                 transfer_secs):
+        self.encs = encs
+        self.xs = xs
+        self.state0 = state0
+        self.S = S
+        self.C = C
+        self.up = up
+        self.interpret = interpret
+        self.mode = mode
+        self.n_dev = n_dev
+        self.use_pallas_arg = use_pallas_arg
+        self.closure_mode_arg = closure_mode_arg
+        self.transfer_secs = transfer_secs
+        self.device_wait_secs = None
+        self.note = None
+        self._results = None
+        self._issue()
+
+    def _issue(self):
+        try:
+            self._valid, self._fail_r = _check_bitdense_batch(
+                self.xs, self.state0, self.encs[0].step_name, self.S,
+                self.C, self.encs[0].state_lo, self.up, self.interpret,
+                self.mode)
+        except Exception:  # noqa: BLE001 — see _fallback_or_raise
+            self._fallback_or_raise()
+
+    def _fallback_or_raise(self):
         # The r5 hardware window measured the SPMD pallas lowering on a
         # 1-device TPU mesh only; the multi-device slicing is
         # differential-tested on CPU meshes but its Mosaic lowering is
@@ -508,34 +529,110 @@ def check_batch_bitdense(encs, mesh=None, use_pallas: bool = None,
         # flag was never consulted, and a malformed value must not
         # shadow the real pallas error here (short-circuit skips it);
         # with use_pallas=None a malformed value already raised in
-        # _resolve_use_pallas before this try.
-        if not (up and use_pallas is None and n_dev > 1
+        # _resolve_use_pallas before the dispatch.
+        import sys
+        err = sys.exc_info()[1]
+        if not (self.up and self.use_pallas_arg is None
+                and self.n_dev > 1
                 and envflags.env_bool("JEPSEN_TPU_PALLAS") is not True):
             raise
-        up = False
-        mode = _resolve_closure_mode(closure_mode, False)
+        self.up = False
+        self.mode = _resolve_closure_mode(self.closure_mode_arg, False)
         import logging
         logging.getLogger(__name__).warning(
             "default-path pallas closure failed on a %d-device mesh "
             "(%r) — falling back to the xla-%s closure for this "
-            "batch", n_dev, err, mode)
-        note = (f"pallas closure failed on a {n_dev}-device mesh "
-                f"({type(err).__name__}); fell back to the xla-{mode} "
-                f"closure (multi-device Mosaic lowering is unmeasured)")
-        valid, fail_r = _check_bitdense_batch(xs, state0, step_name, S, C,
-                                              encs[0].state_lo, False,
-                                              interpret, mode)
-        valid = np.asarray(valid)
-        fail_r = np.asarray(fail_r)
-    closure = "pallas" if up else f"xla-{mode}"
-    out = []
-    for k, e in enumerate(encs):
-        r = {"valid?": bool(valid[k]), "engine": "bitdense",
-             "closure": closure}
-        if note is not None:
-            r["closure-note"] = note
-        if not r["valid?"]:
-            from jepsen_tpu.parallel.encode import fail_op_fields
-            r.update(fail_op_fields(e, int(fail_r[k])))
-        out.append(r)
-    return out
+            "batch", self.n_dev, err, self.mode)
+        self.note = (f"pallas closure failed on a {self.n_dev}-device "
+                     f"mesh ({type(err).__name__}); fell back to the "
+                     f"xla-{self.mode} closure (multi-device Mosaic "
+                     f"lowering is unmeasured)")
+        self._valid, self._fail_r = _check_bitdense_batch(
+            self.xs, self.state0, self.encs[0].step_name, self.S,
+            self.C, self.encs[0].state_lo, False, self.interpret,
+            self.mode)
+
+    def finalize(self) -> list:
+        if self._results is not None:
+            return self._results
+        from time import perf_counter
+        t0 = perf_counter()
+        try:
+            # materialize inside the try: async dispatch surfaces
+            # runtime failures here, not at the issue
+            valid = np.asarray(self._valid)
+            fail_r = np.asarray(self._fail_r)
+        except Exception:  # noqa: BLE001 — same gate as at issue time
+            self._fallback_or_raise()
+            valid = np.asarray(self._valid)
+            fail_r = np.asarray(self._fail_r)
+        self.device_wait_secs = perf_counter() - t0
+        closure = "pallas" if self.up else f"xla-{self.mode}"
+        out = []
+        for k, e in enumerate(self.encs):
+            r = {"valid?": bool(valid[k]), "engine": "bitdense",
+                 "closure": closure}
+            if self.note is not None:
+                r["closure-note"] = self.note
+            if not r["valid?"]:
+                from jepsen_tpu.parallel.encode import fail_op_fields
+                r.update(fail_op_fields(e, int(fail_r[k])))
+            out.append(r)
+        self._results = out
+        return out
+
+
+def dispatch_batch_bitdense(encs, mesh=None, use_pallas: bool = None,
+                            closure_mode: str = None,
+                            min_states: int = 0,
+                            min_slots: int = 5,
+                            min_returns: int = 0) -> PendingBitdenseBatch:
+    """Pad, place, and ISSUE a batched per-key check without consuming
+    the results — returns a PendingBitdenseBatch whose finalize()
+    blocks and builds the per-key dicts.
+    `min_states`/`min_slots`/`min_returns` floor the padded dims so a
+    CHUNK of a larger bucket compiles and resolves (pallas gating
+    included) at the bucket's (S, C, R) — without the R floor every
+    chunk's local max n_returns would be its own compile."""
+    from time import perf_counter
+
+    from jepsen_tpu.parallel.encode import pad_batch
+    t0 = perf_counter()
+    xs, state0, S, C, R = pad_batch(encs, mesh=mesh, min_slots=min_slots,
+                                    min_states=min_states,
+                                    min_returns=min_returns)
+    transfer_secs = perf_counter() - t0
+    # gate on where the batch actually lives: pad_batch pins it to the
+    # mesh when one is given, regardless of the process default backend
+    platform = (mesh.devices.flat[0].platform if mesh is not None
+                else jax.default_backend())
+    # Mesh-sharded TPU batches follow the same default as the rest
+    # (_resolve_use_pallas: ON for a real-TPU platform). The guard that
+    # used to pin them to XLA came off with the r5 on-chip measurement:
+    # the non-interpret SPMD lowering (shard_map -> mosaic) compiled
+    # and ran on a real 1-device TPU mesh, agreed with the XLA closure
+    # on all 84 keys, and won 1.48x; the multi-device slicing logic is
+    # differential-tested on the 8-way CPU mesh (tests/test_pallas.py).
+    up, interpret = _resolve_use_pallas(use_pallas, S, C, platform)
+    mode = _resolve_closure_mode(closure_mode, up)
+    n_dev = 1 if mesh is None else int(np.asarray(mesh.devices).size)
+    return PendingBitdenseBatch(encs, xs, state0, S, C, up, interpret,
+                                mode, n_dev, use_pallas, closure_mode,
+                                transfer_secs)
+
+
+def check_batch_bitdense(encs, mesh=None, use_pallas: bool = None,
+                         closure_mode: str = None) -> list:
+    """Batched per-key check. Callers must ensure the COMBINED padded
+    dims fit (fits_bitdense(max S, max C)) — individually-fitting keys
+    can combine into an over-budget program; engine.check_batch does
+    this check and falls back to per-key dispatch otherwise.
+    `use_pallas` routes each key's closure through the VMEM-resident
+    kernel (vmapped over keys); default: ON for a real-TPU platform
+    (r5 on-chip A/B; JEPSEN_TPU_PALLAS=0/1 overrides), gated to shapes
+    the kernel supports at the PADDED dims.
+    `closure_mode` picks the XLA loop shape ("while"/"fori")."""
+    if not encs:
+        return []
+    return dispatch_batch_bitdense(encs, mesh=mesh, use_pallas=use_pallas,
+                                   closure_mode=closure_mode).finalize()

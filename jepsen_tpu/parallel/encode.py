@@ -60,6 +60,12 @@ class EncodedHistory:
     spec: object = None     # the *prepared* PackedSpec — models whose
     # packing is history-dependent (gset lanes, queue widths) need this
     # exact instance for unpack_state during counterexample extraction
+    model_pruned: bool = False  # the model-specific wildcard prune
+    # dropped calls AFTER spec.prepare ran — `calls` then no longer
+    # equals the list the spec's lane tables were built from, so a
+    # prepare re-run over `calls` may assign different lanes (the
+    # encode cache refuses to persist such entries: a disk reload
+    # could not rebuild an unpack-correct spec)
 
     @property
     def n_returns(self) -> int:
@@ -85,12 +91,47 @@ def fail_op_fields(e: "EncodedHistory", r: int) -> dict:
             "fail-event": int(r)}
 
 
-def encode(model, history, pad_slots: Optional[int] = None) -> EncodedHistory:
-    """Encode (model, history) for the device engine.
+@dataclass
+class PreparedHistory:
+    """Stage-1 encode output: packed per-call ops + slot assignment —
+    everything except the [R, C] snapshot tables. The pipelined
+    executor (parallel.pipeline) buckets on `n_slots`/`n_states` from
+    this stage and defers `finish_encode` (the allocation-heavy table
+    fill) into the device-overlapped stream; for any history,
+    finish_encode(prepare_encode(model, h)) is array-identical to
+    encode(model, h)."""
 
-    Raises EncodeError if the model isn't packable or the open-call
-    window exceeds MAX_SLOTS.
-    """
+    cs: list
+    intern: Intern
+    spec: object
+    enc_f: np.ndarray       # [n] per-call packed ops (post-prune)
+    enc_a0: np.ndarray
+    enc_a1: np.ndarray
+    enc_wild: np.ndarray
+    r_open: np.ndarray      # [n] first snapshot row while open
+    r_close: np.ndarray     # [n] last row (own return / end)
+    call_slot: np.ndarray   # [n]
+    ev_slot: np.ndarray     # [R]
+    ret_call: np.ndarray    # [R]
+    n_slots: int
+    n_returns: int
+    model_pruned: bool = False  # see EncodedHistory.model_pruned
+
+    @property
+    def n_states(self) -> int:
+        return (self.spec.n_states(self.intern) if self.spec.n_states
+                else len(self.intern) + 1)
+
+
+def prepare_encode(model, history, use_bulk: bool = True) -> PreparedHistory:
+    """Stage 1 of encode(): pack the calls and assign window slots.
+
+    Raises EncodeError exactly where encode() would (unpackable model,
+    prepare budget, > MAX_SLOTS window). `use_bulk=False` forces the
+    row-wise encode_call loop even when the spec has a bulk hook —
+    the differential seam tools/perf_encode.py and the parity tests
+    drive (both paths must produce identical arrays, including the
+    interning order)."""
     intern = Intern()
     spec = model_ns.pack_spec(model, intern)
     if spec is None:
@@ -101,6 +142,23 @@ def encode(model, history, pad_slots: Optional[int] = None) -> EncodedHistory:
     if spec.prepare is not None:
         spec.prepare(cs, intern)  # may raise EncodeError (host fallback)
 
+    # per-call packed ops as arrays: the bulk hook when the family has
+    # one (the per-call Python loop is the measured constant on the
+    # batched e2e path), the row-wise loop otherwise
+    if use_bulk and spec.encode_calls is not None:
+        enc_f, enc_a0, enc_a1, enc_wild = spec.encode_calls(cs)
+        enc_f = np.asarray(enc_f, np.int32)
+        enc_a0 = np.asarray(enc_a0, np.int32)
+        enc_a1 = np.asarray(enc_a1, np.int32)
+        enc_wild = np.asarray(enc_wild, bool)
+    else:
+        packed = [spec.encode_call(c.f, c.value, c.result, c.crashed)
+                  for c in cs]
+        enc_f = np.fromiter((pk[0] for pk in packed), np.int32, len(packed))
+        enc_a0 = np.fromiter((pk[1] for pk in packed), np.int32, len(packed))
+        enc_a1 = np.fromiter((pk[2] for pk in packed), np.int32, len(packed))
+        enc_wild = np.fromiter((pk[3] for pk in packed), bool, len(packed))
+
     # Prune crashed calls that pack to wildcards (identity step, always
     # ok, never returns): they may linearize at any point or never, so
     # dropping them is sound — and each one would otherwise double the
@@ -108,13 +166,14 @@ def encode(model, history, pad_slots: Optional[int] = None) -> EncodedHistory:
     # crashed *reads* before the model is known; this generalizes to
     # whatever the model family declares unconstrained (e.g. crashed
     # dequeues with unknown results).
-    packed = [spec.encode_call(c.f, c.value, c.result, c.crashed)
-              for c in cs]
-    if any(c.crashed and pk[3] for c, pk in zip(cs, packed)):
-        kept = [(c, pk) for c, pk in zip(cs, packed)
-                if not (c.crashed and pk[3])]
-        cs = [c for c, _ in kept]
-        packed = [pk for _, pk in kept]
+    crashed = np.fromiter((c.crashed for c in cs), bool, len(cs))
+    drop = crashed & enc_wild
+    model_pruned = bool(drop.any())
+    if model_pruned:
+        keep = ~drop
+        cs = [c for c, k in zip(cs, keep) if k]
+        enc_f, enc_a0, enc_a1, enc_wild = (
+            enc_f[keep], enc_a0[keep], enc_a1[keep], enc_wild[keep])
         for j, c in enumerate(cs):
             c.index = j
 
@@ -128,20 +187,8 @@ def encode(model, history, pad_slots: Optional[int] = None) -> EncodedHistory:
             events.append((c.complete_index, 1, c.index))
     events.sort()
 
-    # per-call packed ops as arrays
-    enc_f = np.fromiter((pk[0] for pk in packed), np.int32, len(packed))
-    enc_a0 = np.fromiter((pk[1] for pk in packed), np.int32, len(packed))
-    enc_a1 = np.fromiter((pk[2] for pk in packed), np.int32, len(packed))
-    enc_wild = np.fromiter((pk[3] for pk in packed), bool, len(packed))
-
-    # Slot assignment, then per-return snapshots by INTERVAL FILL: a
-    # call occupying slot s appears identically in every snapshot row
-    # from the first return after its invoke through the row of its own
-    # return (snapshots are taken just before the returning call is
-    # removed, so its own row includes it; crashed calls stay to the
-    # end). One contiguous slice write per (call, column) replaces ten
-    # full-width numpy ops per return row — encode sits on the e2e
-    # bench path, so its constant matters.
+    # Slot assignment: smallest free slot at invoke, freed after the
+    # call's own return row (crashed calls hold theirs to the end).
     free: list = []  # min-heap of free slots
     n_slots = 0
     n = len(cs)
@@ -173,6 +220,26 @@ def encode(model, history, pad_slots: Optional[int] = None) -> EncodedHistory:
             r += 1
             heapq.heappush(free, s)
 
+    return PreparedHistory(
+        cs=cs, intern=intern, spec=spec,
+        enc_f=enc_f, enc_a0=enc_a0, enc_a1=enc_a1, enc_wild=enc_wild,
+        r_open=r_open, r_close=r_close, call_slot=call_slot,
+        ev_slot=ev_slot, ret_call=ret_call,
+        n_slots=n_slots, n_returns=R, model_pruned=model_pruned)
+
+
+def finish_encode(prep: PreparedHistory,
+                  pad_slots: Optional[int] = None) -> EncodedHistory:
+    """Stage 2 of encode(): build the per-return snapshot tables by
+    INTERVAL FILL — a call occupying slot s appears identically in
+    every snapshot row from the first return after its invoke through
+    the row of its own return (snapshots are taken just before the
+    returning call is removed, so its own row includes it; crashed
+    calls stay to the end). One contiguous slice write per
+    (call, column) replaces ten full-width numpy ops per return row —
+    encode sits on the e2e bench path, so its constant matters."""
+    spec, intern, cs = prep.spec, prep.intern, prep.cs
+    n, R, n_slots = len(cs), prep.n_returns, prep.n_slots
     # allocate at the FINAL padded width (pad_slots may exceed n_slots)
     C = max(1, min(MAX_SLOTS, max(pad_slots or n_slots, n_slots)))
     slot_f = np.full((R, C), -1, np.int32)
@@ -181,26 +248,40 @@ def encode(model, history, pad_slots: Optional[int] = None) -> EncodedHistory:
     slot_wild = np.zeros((R, C), bool)
     slot_occ = np.zeros((R, C), bool)
     for cid in range(n):
-        a, b = int(r_open[cid]), int(r_close[cid])
+        a, b = int(prep.r_open[cid]), int(prep.r_close[cid])
         if a > b:
             continue  # invoked after the last return: in no snapshot
-        s = int(call_slot[cid])
+        s = int(prep.call_slot[cid])
         slot_occ[a:b + 1, s] = True
-        slot_f[a:b + 1, s] = enc_f[cid]
-        slot_a0[a:b + 1, s] = enc_a0[cid]
-        slot_a1[a:b + 1, s] = enc_a1[cid]
-        slot_wild[a:b + 1, s] = enc_wild[cid]
+        slot_f[a:b + 1, s] = prep.enc_f[cid]
+        slot_a0[a:b + 1, s] = prep.enc_a0[cid]
+        slot_a1[a:b + 1, s] = prep.enc_a1[cid]
+        slot_wild[a:b + 1, s] = prep.enc_wild[cid]
 
     return EncodedHistory(
         slot_f=slot_f, slot_a0=slot_a0, slot_a1=slot_a1,
         slot_wild=slot_wild, slot_occ=slot_occ,
-        ev_slot=ev_slot, ret_call=ret_call,
+        ev_slot=prep.ev_slot, ret_call=prep.ret_call,
         state0=spec.state0, step_name=spec.step_name,
         n_calls=len(cs), n_slots=n_slots, calls=cs, intern=intern,
         state_lo=spec.state_lo,
-        n_states=spec.n_states(intern) if spec.n_states else len(intern) + 1,
+        n_states=prep.n_states,
         spec=spec,
+        model_pruned=prep.model_pruned,
     )
+
+
+def encode(model, history, pad_slots: Optional[int] = None,
+           use_bulk: bool = True) -> EncodedHistory:
+    """Encode (model, history) for the device engine.
+
+    Raises EncodeError if the model isn't packable or the open-call
+    window exceeds MAX_SLOTS. Two stages under the hood
+    (prepare_encode -> finish_encode) so the pipelined executor can
+    bucket on the cheap stage and overlap the expensive one with
+    device work; this one-shot form is their exact composition."""
+    return finish_encode(prepare_encode(model, history, use_bulk=use_bulk),
+                         pad_slots)
 
 
 def place_batch(xs: dict, state0, mesh):
@@ -227,19 +308,24 @@ def place_batch(xs: dict, state0, mesh):
     return xs, state0
 
 
-def pad_batch(encs: list, mesh=None, min_slots: int = 1):
+def pad_batch(encs: list, mesh=None, min_slots: int = 1,
+              min_states: int = 0, min_returns: int = 0):
     """Pad per-key encoded histories to one (K, R, C) batch and build the
     scanned arrays; with a mesh the batch is explicitly placed on it via
     `place_batch`. Shared by the sparse, dense, and bitdense batch
     checkers. `min_slots` floors C so engines with a structural minimum
     (bitdense needs one full 32-mask word, C >= 5) get slot tables that
-    actually match the C they were compiled for. Returns
+    actually match the C they were compiled for; `min_states` and
+    `min_returns` floor S and R the same way (the pipelined executor
+    pads every chunk of a bucket to the BUCKET's dims — without the R
+    floor each chunk's local max n_returns would be its own jit shape,
+    one compile per chunk instead of per bucket). Returns
     (xs, state0, S, C, R)."""
     import jax.numpy as jnp
 
-    S = max(e.n_states for e in encs)
+    S = max(min_states, max(e.n_states for e in encs))
     C = max(min_slots, max(e.slot_f.shape[1] for e in encs))
-    R = max(e.n_returns for e in encs)
+    R = max(min_returns, max(e.n_returns for e in encs))
     K = len(encs)
 
     def pad(attr, fill, dtype):

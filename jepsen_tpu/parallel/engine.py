@@ -443,7 +443,7 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
 
 
 def analysis(model, history, capacity: int = 1024,
-             max_capacity: int = 1 << 20) -> dict:
+             max_capacity: int = 1 << 20, encode_cache=None) -> dict:
     """knossos-style (model, history) -> result on the device engine.
 
     Falls back to the host WGL engine when the model can't pack or the
@@ -455,11 +455,22 @@ def analysis(model, history, capacity: int = 1024,
     `{"valid?": "unknown"}` — histories that never prune (e.g. invalid
     queue histories, where every enqueue-order hypothesis stays live)
     otherwise escalate through every tier before deciding.
+
+    `encode_cache` (an EncodeCache, or True for the process default)
+    memoizes the host encode across re-analyses of the same history —
+    content-keyed, so a mutated history never hits stale (see
+    parallel.pipeline). Default: no caching, the historical behavior.
     """
     from jepsen_tpu.history import History
     h = history if isinstance(history, History) else History.wrap(history)
     try:
-        e = enc_mod.encode(model, h)
+        if encode_cache is not None and encode_cache is not False:
+            from jepsen_tpu.parallel import pipeline as pipe_mod
+            e = pipe_mod.encode_cached(
+                model, h,
+                cache=None if encode_cache is True else encode_cache)
+        else:
+            e = enc_mod.encode(model, h)
     except EncodeError as err:
         from jepsen_tpu.checker import wgl
         import logging
@@ -759,13 +770,25 @@ def encode_batch(model, histories, pad_slots: Optional[int] = None,
     if encs is None:
         encs = [enc_mod.encode(model, h, pad_slots=pad_slots)
                 for h in histories]
+    elif pad_slots is not None:
+        # a pre-encoded history's slot tables are already allocated at
+        # their final width — silently ignoring pad_slots here (the old
+        # behavior) would hand back a batch narrower than the caller
+        # asked for, which only surfaces later as a shape mismatch in
+        # whatever program the caller compiled for the requested width
+        raise ValueError(
+            "encode_batch: pad_slots cannot be combined with "
+            "pre-encoded encs (their slot tables are already at final "
+            "width) — re-encode with pad_slots, or pass encs alone")
     xs, state0, _, _, _ = enc_mod.pad_batch(encs, mesh=mesh)
     return encs, xs, state0
 
 
 def check_batch(model, histories, capacity: int = 512,
                 max_capacity: int = 1 << 18, mesh=None,
-                bucket: Optional[str] = None) -> list:
+                bucket: Optional[str] = None,
+                pipeline: Optional[bool] = None, cache=None,
+                pipeline_stats: Optional[dict] = None) -> list:
     """Check many per-key histories in one device program per
     slot-window bucket: vmap over the key axis; with a mesh (and K
     divisible by its size) the key axis is sharded across devices —
@@ -789,8 +812,37 @@ def check_batch(model, histories, capacity: int = 512,
 
     Each bucket independently dispatches to the bit-packed dense
     engine (parallel.bitdense) when its combined padded dims fit,
-    sparse frontier mode otherwise."""
+    sparse frontier mode otherwise.
+
+    `pipeline` routes the batch through the pipelined executor
+    (parallel.pipeline): host encode, H2D transfer, and device search
+    overlap instead of running as three serial phases, and encodings
+    come from the digest-keyed encode cache (`cache`; pass False to
+    disable, None for the process default). Default: the
+    JEPSEN_TPU_PIPELINE env flag, else off — opt-in until bench
+    records a win (flags do not get to claim speedups). Results are
+    bit-identical to the serial path either way (docs/performance.md).
+    `pipeline_stats`, when a dict, receives the per-bucket
+    encode/transfer/device split the bench reports."""
     bucket = _resolve_bucket(bucket)   # fail-fast: before the encode
+    if _resolve_pipeline(pipeline):
+        from jepsen_tpu.parallel import pipeline as pipe_mod
+        return pipe_mod.check_batch_pipelined(
+            model, histories, capacity=capacity,
+            max_capacity=max_capacity, mesh=mesh, bucket=bucket,
+            cache=cache, stats=pipeline_stats)
+    if (cache is not None and cache is not False) \
+            or pipeline_stats is not None:
+        # the serial path consults no cache and fills no stats —
+        # silently ignoring these arguments would be the same trap
+        # this PR closed in encode_batch(pad_slots, encs): the caller
+        # clearly wanted the pipelined executor, so say so. cache=False
+        # ("no caching") is exempt: the serial path already satisfies
+        # it by doing nothing, so it must not crash env-flag-dependently
+        raise ValueError(
+            "check_batch: cache/pipeline_stats are pipelined-executor "
+            "arguments — pass pipeline=True (or set "
+            "JEPSEN_TPU_PIPELINE=1) to use them")
     pre = [enc_mod.encode(model, h) for h in histories]
     return check_batch_encoded(model, pre, capacity=capacity,
                                max_capacity=max_capacity, mesh=mesh,
@@ -810,6 +862,28 @@ def _resolve_bucket(bucket: Optional[str]) -> str:
     return bucket
 
 
+def _resolve_pipeline(pipeline: Optional[bool]) -> bool:
+    if pipeline is None:
+        pipeline = envflags.env_bool("JEPSEN_TPU_PIPELINE",
+                                     default=False)
+    return bool(pipeline)
+
+
+def bucket_key(n_slots: int, bucket: str) -> int:
+    """The bucket a key with `n_slots` open-call slots lands in under
+    the given strategy — shared by the serial (check_batch_encoded)
+    and pipelined (parallel.pipeline) executors so their grouping, and
+    therefore their padded programs and per-key result dicts, match
+    exactly."""
+    if bucket == "exact":
+        # floor at bitdense's min_slots=5: narrower keys pad to
+        # the same C=5 program anyway, so splitting them would be
+        # pure dispatch overhead (and perf_ab's measured grouping
+        # uses the same floor)
+        return max(5, n_slots)
+    return 1 << max(2, (max(1, n_slots) - 1).bit_length())
+
+
 def check_batch_encoded(model, pre, capacity: int = 512,
                         max_capacity: int = 1 << 18, mesh=None,
                         bucket: Optional[str] = None) -> list:
@@ -827,15 +901,7 @@ def check_batch_encoded(model, pre, capacity: int = 512,
     out: list = [None] * len(pre)
     buckets: dict = {}
     for i, e in enumerate(pre):
-        if bucket == "exact":
-            # floor at bitdense's min_slots=5: narrower keys pad to
-            # the same C=5 program anyway, so splitting them would be
-            # pure dispatch overhead (and perf_ab's measured grouping
-            # uses the same floor)
-            key = max(5, e.n_slots)
-        else:
-            key = 1 << max(2, (max(1, e.n_slots) - 1).bit_length())
-        buckets.setdefault(key, []).append(i)
+        buckets.setdefault(bucket_key(e.n_slots, bucket), []).append(i)
     for tier in sorted(buckets):
         idxs = buckets[tier]
         sub = [pre[i] for i in idxs]

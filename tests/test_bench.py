@@ -148,7 +148,7 @@ def test_bench_big_shapes_preflight_on_cpu():
     assert bench.ADV_K == 12, "preflight must cover the bench's real k"
     for L in (10000, 50000):
         t0 = perf_counter()
-        _, _, e = bench._adv_encoded(L)
+        _, _, e, _ = bench._adv_encoded(L)
         build_secs = perf_counter() - t0
         assert build_secs < 60, (L, build_secs)
         assert bitdense.fits_bitdense(bitdense.n_states(e), e.n_slots)
@@ -175,10 +175,46 @@ def test_bench_adv_section_contract():
     assert len(lines) == 1, lines
     line = lines[0]
     for k in ("metric", "value", "unit", "vs_baseline", "L",
-              "device_secs", "host_est_secs"):
+              "device_secs", "host_est_secs",
+              # the per-section encode/transfer/device split keys —
+              # every device section must carry them so pipeline wins
+              # are measurable against prior artifacts
+              "encode_secs", "transfer_secs"):
         assert k in line, line
     assert line["L"] == 200 and line["value"] > 0
     assert line["unit"] == "ops/sec"
+    assert line["encode_secs"] >= 0 and line["transfer_secs"] >= 0
+    # device_secs is uniformly SEARCH-ONLY across sections; the old
+    # whole-call quantity lives on as steady_secs in this section
+    assert line["device_secs"] <= line["steady_secs"], line
+
+
+@pytest.mark.slow
+def test_bench_multikey_section_contract():
+    """The multikey section must emit BOTH the serial device line
+    (with the encode/transfer/device split keys) and the pipelined
+    line (with the per-bucket split + cache counters showing the
+    second pass re-encoded nothing)."""
+    r = _run({}, args=["--section", "multikey", "--timeout", "200"],
+             timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = _json_lines(r.stdout)
+    serial = [l for l in lines if "north-star shape" in l["metric"]
+              and "pipelined" not in l["metric"]]
+    piped = [l for l in lines if "pipelined" in l["metric"]]
+    assert len(serial) == 1 and len(piped) == 1, lines
+    for k in ("encode_secs", "transfer_secs", "device_secs",
+              "device_only_secs"):
+        assert k in serial[0], serial[0]
+    p = piped[0]
+    for k in ("serial_e2e_secs", "pipelined_e2e_secs",
+              "cached_e2e_secs", "buckets", "cache"):
+        assert k in p, p
+    assert p["cache"]["encodes"] == 0, p["cache"]
+    for b in p["buckets"]:
+        for k in ("tier", "keys", "engine", "encode_secs",
+                  "transfer_secs", "device_wait_secs"):
+            assert k in b, b
 
 
 def test_prior_onchip_headline_orders_by_round_number(tmp_path,

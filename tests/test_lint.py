@@ -305,6 +305,35 @@ def test_envflags_choice_and_namespace_guard(monkeypatch):
         pass
 
 
+def test_envflags_int_accessor(monkeypatch):
+    from jepsen_tpu import envflags
+
+    monkeypatch.delenv("JEPSEN_TPU_ENCODE_CACHE", raising=False)
+    assert envflags.env_int("JEPSEN_TPU_ENCODE_CACHE") is None
+    assert envflags.env_int("JEPSEN_TPU_ENCODE_CACHE",
+                            default=256) == 256
+    monkeypatch.setenv("JEPSEN_TPU_ENCODE_CACHE", "0")
+    assert envflags.env_int("JEPSEN_TPU_ENCODE_CACHE",
+                            default=256, min_value=0) == 0
+    monkeypatch.setenv("JEPSEN_TPU_ENCODE_CACHE", "1024")
+    assert envflags.env_int("JEPSEN_TPU_ENCODE_CACHE") == 1024
+    # malformed or below-floor values fail loudly, never silently
+    # revert to the default (the envflags contract)
+    for bad in ("many", "1.5", ""):
+        monkeypatch.setenv("JEPSEN_TPU_ENCODE_CACHE", bad)
+        try:
+            envflags.env_int("JEPSEN_TPU_ENCODE_CACHE")
+            raise AssertionError(f"{bad!r} did not raise")
+        except envflags.EnvFlagError as e:
+            assert "JEPSEN_TPU_ENCODE_CACHE" in str(e)
+    monkeypatch.setenv("JEPSEN_TPU_ENCODE_CACHE", "-3")
+    try:
+        envflags.env_int("JEPSEN_TPU_ENCODE_CACHE", min_value=0)
+        raise AssertionError("below-floor did not raise")
+    except envflags.EnvFlagError as e:
+        assert ">= 0" in str(e)
+
+
 def test_resolve_use_pallas_rejects_malformed_flag(monkeypatch):
     """The satellite regression: JEPSEN_TPU_PALLAS outside {'0','1'}
     must raise at resolve time, not silently disable the measured
