@@ -223,6 +223,13 @@ def sec_multikey(label: str = None):
           "vs_baseline": round(dev_rate / host32_rate, 2),
           **line_extra,
           "closure": closure,
+          # uniform dedupe keys (docs/performance.md "Dedup
+          # strategies"): bitdense sections report "dense" (the
+          # reachable-set tensor is a complete visited set; no sparse
+          # counter exists) — real counters live on the sparse/sharded
+          # lines and the adv section's dedupe A/B advisory
+          "dedupe": rs[0].get("dedupe"),
+          "configs_stepped": rs[0].get("configs-stepped"),
           "device_only_secs": round(batch_secs, 3),
           "encode_secs": round(encode_secs, 3),
           "transfer_secs": round(transfer_secs, 4),
@@ -270,6 +277,8 @@ def sec_multikey(label: str = None):
           "vs_baseline": round(total_ops / pipe_secs / host32_rate, 2),
           **line_extra,
           "closure": closure,
+          "dedupe": cstats.get("dedupe"),
+          "configs_stepped": None,   # bitdense buckets: see above
           "serial_e2e_secs": round(e2e_secs, 3),
           "pipelined_e2e_secs": round(pipe_secs, 3),
           "cached_e2e_secs": round(cached_secs, 3),
@@ -341,6 +350,8 @@ def sec_adv(L: int, host_deadline: float, skip_host: bool,
           "vs_baseline": speedup,
           "L": L,
           "closure": closure,
+          "dedupe": r.get("dedupe"),
+          "configs_stepped": r.get("configs-stepped"),
           # split keys, uniform across sections: device_secs = search
           # only; steady_secs = the whole steady call (the r5
           # artifacts' old "device_secs"), which value/vs_baseline use
@@ -355,6 +366,55 @@ def sec_adv(L: int, host_deadline: float, skip_host: bool,
                       "threaded — a single key cannot be "
                       "parallelized by knossos linear/wgl, so no "
                       "32x scaling applies"})
+
+    # -- sparse-engine dedupe A/B (advisory): the frontier engine's
+    # sort vs hash strategies on the same encoded history, with the
+    # configs-stepped counters that make the delta-frontier work
+    # reduction visible even on CPU. Emitted AFTER the section's main
+    # line (the parent harvests partial output, so a slow advisory can
+    # never cost the headline) and bounded to L <= 1000 — the sparse
+    # engine at 10k+ is the pre-bitdense cost profile, and the 1k
+    # counters already show the asymptotics. Flip decisions belong to
+    # tools/perf_ab.py's dedupe line; this records the counters in the
+    # BENCH_* record.
+    if L <= 1000:
+        from jepsen_tpu.histories import adversarial_register_history
+        from jepsen_tpu.models import CASRegister
+        from jepsen_tpu.parallel import encode as enc_mod, engine
+        # derated k: the full-k sparse frontier peaks at ~10*2^k
+        # configs (k=12 -> capacity 2^16), minutes per strategy on a
+        # CPU advisory run — the DELTA asymptotics show at any k, and
+        # the full-k wall-clock decision belongs to tools/perf_ab.py
+        # on a healthy chip
+        k_ab = min(ADV_K, 6)
+        e_ab = enc_mod.encode(CASRegister(), adversarial_register_history(
+            n_ops=L, k_crashed=k_ab, seed=7))
+        cap = 1 << (k_ab + 4)        # one tier: peak ~10*2^k configs
+        ab = {}
+        for strat in ("sort", "hash"):
+            engine.check_encoded(e_ab, capacity=cap,
+                                 max_capacity=cap * 4,
+                                 dedupe=strat)        # compile
+            t0 = perf_counter()
+            ra = engine.check_encoded(e_ab, capacity=cap,
+                                      max_capacity=cap * 4, dedupe=strat)
+            ab[strat] = {"secs": round(perf_counter() - t0, 3),
+                         "configs_stepped": ra.get("configs-stepped"),
+                         "valid": ra.get("valid?")}
+        assert ab["sort"]["valid"] == ab["hash"]["valid"] is True, ab
+        emit({"metric": f"adversarial single-key {L}-op sparse-engine "
+                        f"dedupe A/B (advisory, 2^{k_ab} open configs)",
+              "value": ab["hash"]["secs"], "unit": "secs",
+              "vs_baseline": None, "L": L,
+              "dedupe": ab,
+              "hash_vs_sort_secs": round(
+                  ab["sort"]["secs"] / max(ab["hash"]["secs"], 1e-9), 2),
+              "note": "sparse frontier engine only (the bitdense line "
+                      "above is the measured path); configs_stepped is "
+                      "the closure work actually paid — hash steps the "
+                      "delta, sort re-steps the whole frontier every "
+                      "closure iteration. Flip decisions ride "
+                      "tools/perf_ab.py's full-k sparse-dedupe lines"})
 
 
 def sec_sharded(L: int, host_est: float | None,
@@ -408,6 +468,8 @@ def sec_sharded(L: int, host_est: float | None,
             "vs_baseline": round(host_est / dev_secs, 1)
             if host_est else None,
             "devices": r.get("devices"), "valid": r.get("valid?"),
+            "dedupe": r.get("dedupe"),
+            "configs_stepped": r.get("configs-stepped"),
             "device_secs": round(dev_secs, 2),
             "encode_secs": round(encode_secs, 3),
             "transfer_secs": round(transfer_secs, 4),
@@ -462,7 +524,9 @@ def sec_maxlen(budget_secs: float):
             prev_dt = dt
             split = {"encode_secs": round(encode_secs, 3),
                      "transfer_secs": round(tms["transfer_secs"], 4),
-                     "device_secs": round(tms["device_secs"], 3)}
+                     "device_secs": round(tms["device_secs"], 3),
+                     "dedupe": r.get("dedupe"),
+                     "configs_stepped": r.get("configs-stepped")}
         else:
             break
     if max_len:

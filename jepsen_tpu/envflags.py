@@ -118,6 +118,12 @@ def env_int(name: str, default: Optional[int] = None,
 #                            shape ("while"/"fori")
 #   JEPSEN_TPU_BUCKET        env_choice  parallel.engine — batch
 #                            bucketing strategy ("tier"/"exact")
+#   JEPSEN_TPU_DEDUPE        env_choice  parallel.engine — sparse
+#                            frontier dedupe strategy ("sort"/"hash":
+#                            lexsort vs delta-frontier closure over a
+#                            device-resident hash visited-set, also
+#                            sharded by owner in parallel.sharded);
+#                            opt-in until bench records a win
 #   JEPSEN_TPU_PIPELINE      env_bool    parallel.engine — route
 #                            check_batch through the pipelined
 #                            executor (parallel.pipeline); opt-in

@@ -291,13 +291,17 @@ class IndependentChecker(Checker):
     (engine.check_batch(pipeline=...): host encode / transfer / device
     search overlapped, encode cache consulted). None defers to the
     JEPSEN_TPU_PIPELINE env flag — opt-in, results identical either
-    way."""
+    way. `dedupe` likewise threads the frontier dedupe strategy to the
+    sparse device buckets (None defers to JEPSEN_TPU_DEDUPE; results
+    identical either way — engine._resolve_dedupe)."""
 
     def __init__(self, checker: Checker, batch_device: bool = True,
-                 pipeline: Optional[bool] = None):
+                 pipeline: Optional[bool] = None,
+                 dedupe: Optional[str] = None):
         self.checker = checker
         self.batch_device = batch_device
         self.pipeline = pipeline
+        self.dedupe = dedupe
 
     def check(self, test, history, opts=None):
         opts = opts or {}
@@ -370,7 +374,8 @@ class IndependentChecker(Checker):
             # engine (engine._escalate_overflow)
             mesh = (test or {}).get("mesh")
             rs = engine.check_batch(model, [subs[k] for k in ks],
-                                    mesh=mesh, pipeline=self.pipeline)
+                                    mesh=mesh, pipeline=self.pipeline,
+                                    dedupe=self.dedupe)
             return {k: {**r, "analyzer": "jax"} for k, r in zip(ks, rs)}, None
         except EncodeError as err:
             # legitimately not device-encodable (a gset key past the
@@ -409,5 +414,7 @@ def _edn_pprint(x) -> str:
 
 
 def checker(c: Checker, batch_device: bool = True,
-            pipeline: Optional[bool] = None) -> IndependentChecker:
-    return IndependentChecker(c, batch_device, pipeline=pipeline)
+            pipeline: Optional[bool] = None,
+            dedupe: Optional[str] = None) -> IndependentChecker:
+    return IndependentChecker(c, batch_device, pipeline=pipeline,
+                              dedupe=dedupe)

@@ -386,6 +386,11 @@ def check_encoded_bitdense(e: EncodedHistory,
         timings["device_secs"] = perf_counter() - t0
     out = {"valid?": valid_b, "engine": "bitdense",
            "states": S, "slots": C,
+           # the dense reachable-set tensor IS a complete visited set —
+           # the sparse sort/hash strategies (JEPSEN_TPU_DEDUPE) have
+           # nothing to select here; the tag keeps result schemas
+           # uniform across engines
+           "dedupe": "dense",
            "closure": "pallas" if use_pallas
            else f"xla-{closure_mode}"}
     if not out["valid?"]:
@@ -571,7 +576,8 @@ class PendingBitdenseBatch:
         out = []
         for k, e in enumerate(self.encs):
             r = {"valid?": bool(valid[k]), "engine": "bitdense",
-                 "closure": closure}
+                 "dedupe": "dense",  # complete visited set by
+                 "closure": closure}  # construction (see check_encoded)
             if self.note is not None:
                 r["closure-note"] = self.note
             if not r["valid?"]:

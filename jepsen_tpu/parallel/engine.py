@@ -45,6 +45,111 @@ from jepsen_tpu.parallel.steps import STEPS
 # ------------------------------------------------------------ device core
 
 
+# Bounded linear-probe length for the hash visited-set. At the table's
+# <= 50% load factor (capacity 2N for an N-row frontier) a 32-probe
+# cluster is vanishingly rare under the mixed hash; exhaustion raises
+# the overflow flag and rides the existing capacity-escalation retry
+# (doubling N doubles the table, halving the load factor) instead of
+# ever dropping a config.
+_PROBE_LIMIT = 32
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(1, (int(n) - 1).bit_length())
+
+
+def _resolve_dedupe(dedupe: Optional[str]) -> str:
+    """The frontier dedupe strategy: "sort" (lexsort + adjacent-compare,
+    the historical path) or "hash" (delta-frontier closure over a
+    device-resident open-addressed visited set). Default: the
+    JEPSEN_TPU_DEDUPE env flag, else "sort" — opt-in until bench
+    records the win, the same precedent as JEPSEN_TPU_PIPELINE
+    (docs/performance.md "Dedup strategies")."""
+    if dedupe is None:
+        dedupe = envflags.env_choice("JEPSEN_TPU_DEDUPE",
+                                     ("sort", "hash"), default="sort",
+                                     what="dedupe strategy")
+    if dedupe not in ("sort", "hash"):
+        raise ValueError(f"unknown dedupe strategy {dedupe!r}")
+    return dedupe
+
+
+def _table_hash(st, ml, mh):
+    """Slot mixing for the open-addressed visited set. Deliberately a
+    DIFFERENT mix than sharded._hash_config: the sharded engine buckets
+    ownership by that hash mod n_dev, so a device's owned configs all
+    share its low bits — reusing it for table slots would turn every
+    per-device table into one giant collision cluster."""
+    h = (st.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)) \
+        ^ (ml * jnp.uint32(0xC2B2AE35)) ^ (mh * jnp.uint32(0x27D4EB2F))
+    h ^= h >> 16
+    h = h * jnp.uint32(0x165667B1)
+    h ^= h >> 13
+    return h
+
+
+def _empty_table(T: int):
+    return (jnp.zeros(T, jnp.int32), jnp.zeros(T, jnp.uint32),
+            jnp.zeros(T, jnp.uint32), jnp.zeros(T, bool))
+
+
+def _hash_insert(c_st, c_ml, c_mh, c_live, table, probe_limit: int):
+    """Parallel bounded-linear-probe insert of candidate configs into
+    the open-addressed visited set `table` ((st, ml, mh, occ) arrays of
+    one power-of-two length T).
+
+    Each live candidate probes from _table_hash(row) & (T-1); per
+    round it drops on an equal occupant (already visited), claims an
+    empty slot (racing claimants are arbitrated by a scatter-min of the
+    candidate index; losers RE-CHECK the same slot next round, because
+    the winner there may hold an equal key — a duplicate inside this
+    same batch), or advances past an occupied different slot. The loop
+    runs until every candidate resolves or exhausts `probe_limit`
+    probes (<= 2*probe_limit rounds: every pending candidate resolves
+    or advances at least every second round).
+
+    Returns (table', fresh, overflow): `fresh` flags candidates that
+    claimed a slot (first sighting), `overflow` that some candidate
+    exhausted its probes — the caller escalates capacity, it never
+    silently drops a config."""
+    t_st, t_ml, t_mh, t_occ = table
+    M = c_st.shape[0]
+    T = t_st.shape[0]
+    maskT = jnp.uint32(T - 1)
+    h0 = _table_hash(c_st, c_ml, c_mh)
+    idx = jnp.arange(M, dtype=jnp.int32)
+
+    def cond(s):
+        return jnp.any(s["pending"] & (s["off"] < probe_limit))
+
+    def body(s):
+        t_st, t_ml, t_mh, t_occ = s["table"]
+        pending, off, fresh = s["pending"], s["off"], s["fresh"]
+        act = pending & (off < probe_limit)
+        slot = ((h0 + off.astype(jnp.uint32)) & maskT).astype(jnp.int32)
+        occ = t_occ[slot]
+        same = occ & (t_st[slot] == c_st) & (t_ml[slot] == c_ml) \
+            & (t_mh[slot] == c_mh)
+        try_claim = act & ~occ
+        claim = jnp.full(T, M, jnp.int32).at[
+            jnp.where(try_claim, slot, T)].min(idx, mode="drop")
+        won = try_claim & (claim[slot] == idx)
+        wslot = jnp.where(won, slot, T)
+        t_st = t_st.at[wslot].set(c_st, mode="drop")
+        t_ml = t_ml.at[wslot].set(c_ml, mode="drop")
+        t_mh = t_mh.at[wslot].set(c_mh, mode="drop")
+        t_occ = t_occ.at[wslot].set(True, mode="drop")
+        return {"table": (t_st, t_ml, t_mh, t_occ),
+                "pending": pending & ~(act & same) & ~won,
+                "off": off + (act & occ & ~same).astype(jnp.int32),
+                "fresh": fresh | won}
+
+    out = lax.while_loop(cond, body, {
+        "table": (t_st, t_ml, t_mh, t_occ), "pending": c_live,
+        "off": jnp.zeros(M, jnp.int32), "fresh": jnp.zeros(M, bool)})
+    return out["table"], out["fresh"], jnp.any(out["pending"])
+
+
 def _slot_bits(C: int):
     js = jnp.arange(C, dtype=jnp.uint32)
     one = jnp.uint32(1)
@@ -82,21 +187,49 @@ def _dedupe_compact(st, ml, mh, live, N):
 
 def _initial_carry(state0, N: int):
     """The scan carry at event 0: one live config (the initial model
-    state, nothing linearized)."""
+    state, nothing linearized). The trailing int32 is the
+    configs-stepped counter (closure work actually paid, in configs
+    expanded — see _scan_step_factory)."""
     st0 = jnp.zeros(N, jnp.int32).at[0].set(state0)
     ml0 = jnp.zeros(N, jnp.uint32)
     mh0 = jnp.zeros(N, jnp.uint32)
     live0 = jnp.arange(N) < 1
     return (st0, ml0, mh0, live0, jnp.array(True), jnp.int32(-1),
-            jnp.int32(0), jnp.int32(1), jnp.int32(0))
+            jnp.int32(0), jnp.int32(1), jnp.int32(0), jnp.int32(0))
 
 
-def _scan_step_factory(step_name: str, N: int, C: int):
+def _scan_step_factory(step_name: str, N: int, C: int,
+                       dedupe: str = "sort", probe_limit: int = 0):
     """The per-return-event scan step, parameterized by model step,
-    frontier capacity, and slot-window width. Shared by the one-shot
-    and the resumable (checkpointed) entry points."""
+    frontier capacity, slot-window width, and dedupe strategy. Shared
+    by the one-shot and the resumable (checkpointed) entry points.
+
+    dedupe="sort": every closure iteration re-steps the WHOLE live
+    frontier and dedupes by a full lexsort over all N*(C+1) candidate
+    rows — the historical path.
+
+    dedupe="hash": the delta-frontier closure. The frontier is kept
+    compacted, the closure carry holds a split index (rows [0, n_old)
+    were expanded in earlier iterations, rows [n_old, count) are the
+    delta discovered last iteration), only the delta expands, and
+    membership is an open-addressed hash visited-set (capacity
+    _next_pow2(2N), _hash_insert) reused across all closure iterations
+    of one return event — each configuration is expanded exactly once
+    per event, the Wing&Gong/Lowe seen-set realised on-device. Probe
+    exhaustion raises the overflow flag and rides the same
+    capacity-escalation retry as a full frontier. Verdicts,
+    counterexample localization, max-frontier and iteration counts are
+    identical to the sort path (frontier ROW ORDER differs; tests pin
+    everything order-independent).
+
+    Both strategies accumulate a configs-stepped counter (sort: the
+    whole live frontier per iteration; hash: the delta) — the counter
+    that makes the delta win measurable even on CPU advisory runs."""
     step = STEPS[step_name]
     bit_lo, bit_hi = _slot_bits(C)
+    if probe_limit <= 0:
+        probe_limit = _PROBE_LIMIT
+    T = _next_pow2(2 * N)
 
     # model step vmapped over configs x slots
     step_cc = jax.vmap(
@@ -105,12 +238,12 @@ def _scan_step_factory(step_name: str, N: int, C: int):
     )
 
     def closure_cond(c):
-        _, _, _, _, changed, overflow, _ = c
+        _, _, _, _, changed, overflow, _, _ = c
         return changed & ~overflow
 
     def make_closure_body(ev):
         def body(c):
-            st, ml, mh, live, _, _, iters = c
+            st, ml, mh, live, _, _, iters, stepped = c
             cand_st, cand_ok = step_cc(
                 st, ev["slot_f"], ev["slot_a0"], ev["slot_a1"], ev["slot_wild"]
             )
@@ -127,20 +260,92 @@ def _scan_step_factory(step_name: str, N: int, C: int):
             old_count = jnp.sum(live)
             st2, ml2, mh2, live2, count, ovf = _dedupe_compact(
                 all_st, all_ml, all_mh, all_live, N)
-            return st2, ml2, mh2, live2, count > old_count, ovf, iters + 1
+            return (st2, ml2, mh2, live2, count > old_count, ovf,
+                    iters + 1, stepped + old_count)
         return body
 
+    def hash_closure_cond(c):
+        return c["changed"] & ~c["ovf"]
+
+    def make_hash_closure_body(ev):
+        def body(c):
+            st, ml, mh = c["st"], c["ml"], c["mh"]
+            n_old, count = c["n_old"], c["count"]
+            cand_st, cand_ok = step_cc(
+                st, ev["slot_f"], ev["slot_a0"], ev["slot_a1"],
+                ev["slot_wild"])
+            row = jnp.arange(N)
+            delta = (row >= n_old) & (row < count)
+            already = ((ml[:, None] & bit_lo[None, :])
+                       | (mh[:, None] & bit_hi[None, :])) != 0
+            legal = (delta[:, None] & ev["slot_occ"][None, :]
+                     & ~already & cand_ok)
+            c_st = cand_st.reshape(-1)
+            c_ml = (ml[:, None] | bit_lo[None, :]).reshape(-1)
+            c_mh = (mh[:, None] | bit_hi[None, :]).reshape(-1)
+            table, fresh, p_ovf = _hash_insert(
+                c_st, c_ml, c_mh, legal.reshape(-1), c["table"],
+                probe_limit)
+            # append the fresh rows contiguously after `count`: they
+            # are the next iteration's delta. Rows past N scatter-drop;
+            # the overflow flag aborts before anything consumes them.
+            n_fresh = jnp.sum(fresh)
+            pos = jnp.where(fresh, count + jnp.cumsum(fresh) - 1, N)
+            return {
+                "st": st.at[pos].set(c_st, mode="drop"),
+                "ml": ml.at[pos].set(c_ml, mode="drop"),
+                "mh": mh.at[pos].set(c_mh, mode="drop"),
+                "n_old": count,
+                "count": jnp.minimum(count + n_fresh, N),
+                "table": table,
+                "changed": n_fresh > 0,
+                "ovf": c["ovf"] | p_ovf | (count + n_fresh > N),
+                "iters": c["iters"] + 1,
+                "stepped": c["stepped"] + (count - n_old),
+            }
+        return body
+
+    def run_closure(ev, st, ml, mh, live, run, stepped):
+        """-> (st2, ml2, mh2, live2, ovf, iters, stepped2)."""
+        if dedupe == "sort":
+            st2, ml2, mh2, live2, _, ovf, iters, stepped2 = \
+                lax.while_loop(
+                    closure_cond, make_closure_body(ev),
+                    (st, ml, mh, live, run, jnp.array(False),
+                     jnp.int32(0), stepped))
+            return st2, ml2, mh2, live2, ovf, iters, stepped2
+        # hash: seed the per-event visited set with the live frontier
+        # (compacting it in the same pass — post-filter frontiers have
+        # holes); iteration 0's delta is the whole frontier, exactly
+        # the rows the sort path would step first
+        table, fresh0, p0 = _hash_insert(st, ml, mh, live,
+                                         _empty_table(T), probe_limit)
+        m0 = jnp.sum(fresh0)
+        pos0 = jnp.where(fresh0, jnp.cumsum(fresh0) - 1, N)
+        out = lax.while_loop(hash_closure_cond, make_hash_closure_body(ev), {
+            "st": jnp.zeros(N, jnp.int32).at[pos0].set(st, mode="drop"),
+            "ml": jnp.zeros(N, jnp.uint32).at[pos0].set(ml, mode="drop"),
+            "mh": jnp.zeros(N, jnp.uint32).at[pos0].set(mh, mode="drop"),
+            "n_old": jnp.int32(0), "count": m0, "table": table,
+            "changed": run, "ovf": p0, "iters": jnp.int32(0),
+            "stepped": stepped})
+        live2 = jnp.arange(N) < out["count"]
+        return (out["st"], out["ml"], out["mh"], live2, out["ovf"],
+                out["iters"], out["stepped"])
+
     def scan_step(carry, ev):
-        st, ml, mh, live, ok, fail_r, r_idx, maxf, steps_n = carry
+        st, ml, mh, live, ok, fail_r, r_idx, maxf, steps_n, stepped = carry
         is_pad = ev["ev_slot"] < 0
         run = ok & ~is_pad
 
         # closure: expand until no new configs (skipped when run=False:
         # the initial `changed` flag is `run`)
-        st2, ml2, mh2, live2, _, ovf, iters = lax.while_loop(
-            closure_cond, make_closure_body(ev),
-            (st, ml, mh, live, run, jnp.array(False), jnp.int32(0)),
-        )
+        st2, ml2, mh2, live2, ovf, iters, stepped2 = run_closure(
+            ev, st, ml, mh, live, run, stepped)
+        # the hash prologue runs unconditionally (lax.scan cannot skip
+        # an event) — a pad/settled event's probe flag must not leak
+        # into the host's capacity-escalation decision
+        ovf = run & ovf
 
         # filter: returning call must have linearized; then free its slot
         s = jnp.maximum(ev["ev_slot"], 0).astype(jnp.uint32)
@@ -166,25 +371,33 @@ def _scan_step_factory(step_name: str, N: int, C: int):
         live_o = jnp.where(run, live3, live)
         maxf = jnp.maximum(maxf, jnp.where(run, jnp.sum(live2), 0))
         # count closure iterations only; the host multiplies by N*C in
-        # Python (int32 would overflow at large capacities)
+        # Python (int32 would overflow at large capacities). The
+        # configs-stepped counter is the TRUE work: configs actually
+        # expanded (sort: whole frontier per iteration; hash: the
+        # delta) — both strategies record it so the reduction is
+        # visible in the same units.
         steps_n = steps_n + jnp.where(run, iters, 0)
+        stepped_o = jnp.where(run, stepped2, stepped)
         return (st_o, ml_o, mh_o, live_o, new_ok, new_fail,
-                r_idx + 1, maxf, steps_n), ovf
+                r_idx + 1, maxf, steps_n, stepped_o), ovf
 
     return scan_step
 
 
-def _check_impl(xs, state0, step_name: str, N: int):
+def _check_impl(xs, state0, step_name: str, N: int,
+                dedupe: str = "sort", probe_limit: int = 0):
     """Scan over all return events from scratch. xs: dict of [R, ...]
     arrays. Returns (valid, fail_event, overflow, max_frontier,
-    steps_evaluated)."""
+    steps_evaluated, configs_stepped)."""
     C = xs["slot_f"].shape[1]
     carry0 = _initial_carry(state0, N)
-    carry, ovfs = lax.scan(_scan_step_factory(step_name, N, C), carry0, xs)
-    _, _, _, live, ok, fail_r, _, maxf, steps_n = carry
+    carry, ovfs = lax.scan(
+        _scan_step_factory(step_name, N, C, dedupe, probe_limit),
+        carry0, xs)
+    _, _, _, live, ok, fail_r, _, maxf, steps_n, stepped = carry
     overflow = jnp.any(ovfs)
     valid = ok & (jnp.sum(live) > 0) & ~overflow
-    return valid, fail_r, overflow, maxf, steps_n
+    return valid, fail_r, overflow, maxf, steps_n, stepped
 
 
 # donation decision (recompile-donate-argnums) for the three jits
@@ -196,27 +409,36 @@ def _check_impl(xs, state0, step_name: str, N: int):
 # is rebuilt per call, so there is no persistent caller buffer to
 # reclaim either.
 @functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
-                   static_argnames=("step_name", "N"))
-def _check_device_resumable(xs, carry0, step_name: str, N: int):
+                   static_argnames=("step_name", "N", "dedupe",
+                                    "probe_limit"))
+def _check_device_resumable(xs, carry0, step_name: str, N: int,
+                            dedupe: str = "sort", probe_limit: int = 0):
     """One chunk of events from an explicit carry; returns the final
     carry plus the overflow flag so the host can checkpoint between
     chunks."""
     C = xs["slot_f"].shape[1]
-    carry, ovfs = lax.scan(_scan_step_factory(step_name, N, C), carry0, xs)
+    carry, ovfs = lax.scan(
+        _scan_step_factory(step_name, N, C, dedupe, probe_limit),
+        carry0, xs)
     return carry, jnp.any(ovfs)
 
 
 # same donation decision as _check_device_resumable above
 # jepsen-lint: disable=recompile-donate-argnums
-_check_device = jax.jit(_check_impl, static_argnames=("step_name", "N"))
+_check_device = jax.jit(_check_impl,
+                        static_argnames=("step_name", "N", "dedupe",
+                                         "probe_limit"))
 
 
 # same donation decision as _check_device_resumable above
 @functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
-                   static_argnames=("step_name", "N"))
-def _check_device_batch(xs, state0, step_name: str, N: int):
+                   static_argnames=("step_name", "N", "dedupe",
+                                    "probe_limit"))
+def _check_device_batch(xs, state0, step_name: str, N: int,
+                        dedupe: str = "sort", probe_limit: int = 0):
     return jax.vmap(
-        lambda x, s0: _check_impl(x, s0, step_name, N)
+        lambda x, s0: _check_impl(x, s0, step_name, N, dedupe,
+                                  probe_limit)
     )(xs, state0)
 
 
@@ -256,11 +478,14 @@ class FrontierCheckpoint:
 
     Saved as .npz; history identity is guarded by a digest of the
     encoded event arrays — resuming against a different history is an
-    error, not silent corruption."""
+    error, not silent corruption. Format versioning rides the meta
+    array's LENGTH: v1 checkpoints carried 6 scalars, v2 appends the
+    configs-stepped counter — v1 files load with stepped=0 (the
+    counter is advisory; the search state is complete without it)."""
 
     def __init__(self, event_index: int, capacity: int, step_name: str,
                  history_digest: str, st, ml, mh, live, ok, fail_r,
-                 maxf, steps_n):
+                 maxf, steps_n, stepped: int = 0):
         self.event_index = int(event_index)
         self.capacity = int(capacity)
         self.step_name = step_name
@@ -273,6 +498,7 @@ class FrontierCheckpoint:
         self.fail_r = int(fail_r)
         self.maxf = int(maxf)
         self.steps_n = int(steps_n)
+        self.stepped = int(stepped)
 
     def carry(self, device=None):
         """The device scan carry this checkpoint resumes from. With
@@ -281,7 +507,8 @@ class FrontierCheckpoint:
         return _place((self.st, self.ml, self.mh, self.live,
                        np.bool_(self.ok), np.int32(self.fail_r),
                        np.int32(self.event_index), np.int32(self.maxf),
-                       np.int32(self.steps_n)), device)
+                       np.int32(self.steps_n), np.int32(self.stepped)),
+                      device)
 
     def grown(self, new_capacity: int) -> "FrontierCheckpoint":
         """Re-embed the frontier into a larger capacity (overflow
@@ -295,7 +522,8 @@ class FrontierCheckpoint:
             np.concatenate([self.ml, np.zeros(pad, np.uint32)]),
             np.concatenate([self.mh, np.zeros(pad, np.uint32)]),
             np.concatenate([self.live, np.zeros(pad, bool)]),
-            self.ok, self.fail_r, self.maxf, self.steps_n)
+            self.ok, self.fail_r, self.maxf, self.steps_n,
+            self.stepped)
 
     def save(self, path: str) -> str:
         # np.savez appends .npz to suffix-less paths; normalize so
@@ -306,7 +534,7 @@ class FrontierCheckpoint:
             path, st=self.st, ml=self.ml, mh=self.mh, live=self.live,
             meta=np.array([self.event_index, self.capacity,
                            int(self.ok), self.fail_r, self.maxf,
-                           self.steps_n], np.int64),
+                           self.steps_n, self.stepped], np.int64),
             step_name=np.array(self.step_name),
             history_digest=np.array(self.history_digest))
         return path
@@ -316,10 +544,15 @@ class FrontierCheckpoint:
         if not path.endswith(".npz"):
             path = path + ".npz"
         z = np.load(path, allow_pickle=False)
-        ev, cap, ok, fail_r, maxf, steps_n = z["meta"].tolist()
+        meta = z["meta"].tolist()
+        # v1 checkpoints predate the configs-stepped counter: 6 meta
+        # scalars instead of 7 — load with stepped=0 rather than
+        # rejecting a resumable search state over an advisory counter
+        ev, cap, ok, fail_r, maxf, steps_n = meta[:6]
+        stepped = meta[6] if len(meta) > 6 else 0
         return cls(ev, cap, str(z["step_name"]), str(z["history_digest"]),
                    z["st"], z["ml"], z["mh"], z["live"], bool(ok),
-                   fail_r, maxf, steps_n)
+                   fail_r, maxf, steps_n, stepped)
 
 
 def history_digest(e: EncodedHistory) -> str:
@@ -338,7 +571,8 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
                             checkpoint_every: int = 256,
                             checkpoint_cb=None,
                             resume: Optional[FrontierCheckpoint] = None,
-                            device=None) -> dict:
+                            device=None,
+                            dedupe: Optional[str] = None) -> dict:
     """check_encoded with mid-search checkpointing: events are processed
     in chunks of `checkpoint_every`; after each chunk the frontier is
     pulled to host and handed to checkpoint_cb(FrontierCheckpoint) (e.g.
@@ -349,6 +583,7 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
     as check_encoded(device=...): never the default backend."""
     if e.n_returns == 0:
         return {"valid?": True, "max-frontier": 0, "capacity": 0}
+    dedupe = _resolve_dedupe(dedupe)
     digest = history_digest(e)
     if resume is not None:
         if resume.history_digest != digest:
@@ -378,7 +613,7 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
         hi = min(R, lo + checkpoint_every)
         chunk = _place({k: v[lo:hi] for k, v in xs_np.items()}, device)
         carry, overflow = _check_device_resumable(
-            chunk, cp.carry(device), e.step_name, cp.capacity)
+            chunk, cp.carry(device), e.step_name, cp.capacity, dedupe)
         if bool(overflow):
             if cp.capacity * 2 > max_capacity:
                 return {"valid?": "unknown",
@@ -388,16 +623,19 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
                         "checkpoint": cp}
             cp = cp.grown(cp.capacity * 2)
             continue  # re-run the same chunk at doubled capacity
-        st, ml, mh, live, ok, fail_r, r_idx, maxf, steps_n = \
+        st, ml, mh, live, ok, fail_r, r_idx, maxf, steps_n, stepped = \
             [np.asarray(x) for x in carry]
         cp = FrontierCheckpoint(int(r_idx), cp.capacity, e.step_name,
                                 digest, st, ml, mh, live, bool(ok),
-                                int(fail_r), int(maxf), int(steps_n))
+                                int(fail_r), int(maxf), int(steps_n),
+                                int(stepped))
         if checkpoint_cb is not None:
             checkpoint_cb(cp)
     out = {"valid?": cp.ok and bool(cp.live.any()),
            "max-frontier": cp.maxf,
            "capacity": cp.capacity,
+           "dedupe": dedupe,
+           "configs-stepped": cp.stepped,
            # approximate when capacity grew mid-search: iterations from
            # earlier chunks ran at smaller capacities
            "explored": cp.steps_n * cp.capacity * len(e.slot_f[0])}
@@ -410,31 +648,49 @@ _fail_op = enc_mod.fail_op_fields
 
 
 def check_encoded(e: EncodedHistory, capacity: int = 1024,
-                  max_capacity: int = 1 << 20, device=None) -> dict:
+                  max_capacity: int = 1 << 20, device=None,
+                  dedupe: Optional[str] = None,
+                  probe_limit: int = 0) -> dict:
     """Check one encoded history, doubling frontier capacity on overflow
     (re-jit per capacity tier; tiers are cached by jax.jit). With
     `device` every input is explicitly placed there and the search runs
     on it — never on the default backend, which may be a broken TPU
-    runtime while the caller deliberately runs on a CPU mesh."""
+    runtime while the caller deliberately runs on a CPU mesh.
+
+    `dedupe` picks the frontier dedupe strategy (_resolve_dedupe:
+    "sort"/"hash"/None = the JEPSEN_TPU_DEDUPE flag). Verdicts and
+    counterexample fields are identical either way; "configs-stepped"
+    records the closure work actually paid — strictly less under
+    "hash" whenever a closure runs more than one iteration (the delta
+    stops re-stepping the settled majority). `probe_limit` bounds the
+    hash path's linear probes (0 = the default _PROBE_LIMIT; a test
+    seam — probe exhaustion escalates capacity exactly like a full
+    frontier)."""
     if e.n_returns == 0:
         return {"valid?": True, "max-frontier": 0, "capacity": 0}
+    dedupe = _resolve_dedupe(dedupe)
     xs = _xs_from_encoded(e, device)
     state0 = _place(np.int32(e.state0), device)
     N = max(64, capacity)
     while True:
-        valid, fail_r, overflow, maxf, steps_n = _check_device(
-            xs, state0, e.step_name, N)
+        valid, fail_r, overflow, maxf, steps_n, stepped = _check_device(
+            xs, state0, e.step_name, N, dedupe, probe_limit)
         if not bool(overflow):
             break
         if N * 2 > max_capacity:
             return {"valid?": "unknown",
                     "error": f"frontier overflow at capacity {N}",
-                    "capacity": N}
+                    "capacity": N, "dedupe": dedupe}
         N *= 2
     out = {
         "valid?": bool(valid),
         "max-frontier": int(maxf),
         "capacity": N,
+        "dedupe": dedupe,
+        "configs-stepped": int(stepped),
+        # the historical trajectory metric (iters x N x C), preserved
+        # under its old key for cross-round comparability; the true
+        # work lives in configs-stepped
         "explored": int(steps_n) * N * len(e.slot_f[0]),
     }
     if not out["valid?"]:
@@ -443,7 +699,8 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
 
 
 def analysis(model, history, capacity: int = 1024,
-             max_capacity: int = 1 << 20, encode_cache=None) -> dict:
+             max_capacity: int = 1 << 20, encode_cache=None,
+             dedupe: Optional[str] = None) -> dict:
     """knossos-style (model, history) -> result on the device engine.
 
     Falls back to the host WGL engine when the model can't pack or the
@@ -460,6 +717,10 @@ def analysis(model, history, capacity: int = 1024,
     memoizes the host encode across re-analyses of the same history —
     content-keyed, so a mutated history never hits stale (see
     parallel.pipeline). Default: no caching, the historical behavior.
+
+    `dedupe` picks the sparse engine's frontier dedupe strategy
+    (check_encoded; None defers to JEPSEN_TPU_DEDUPE) — verdict- and
+    counterexample-identical either way.
     """
     from jepsen_tpu.history import History
     h = history if isinstance(history, History) else History.wrap(history)
@@ -482,9 +743,13 @@ def analysis(model, history, capacity: int = 1024,
         return r
     from jepsen_tpu.parallel import bitdense
     if bitdense.fits_bitdense(bitdense.n_states(e), e.n_slots):
+        # the dense bitmap IS a complete visited set — the sparse
+        # dedupe strategy has nothing to select there (its result says
+        # dedupe="dense"); the flag governs the sparse dispatch below
         r = bitdense.check_encoded_bitdense(e)
     else:
-        r = check_encoded(e, capacity=capacity, max_capacity=max_capacity)
+        r = check_encoded(e, capacity=capacity,
+                          max_capacity=max_capacity, dedupe=dedupe)
     if r["valid?"] is False:
         apply_final_paths(r, model, e)
     return r
@@ -788,7 +1053,8 @@ def check_batch(model, histories, capacity: int = 512,
                 max_capacity: int = 1 << 18, mesh=None,
                 bucket: Optional[str] = None,
                 pipeline: Optional[bool] = None, cache=None,
-                pipeline_stats: Optional[dict] = None) -> list:
+                pipeline_stats: Optional[dict] = None,
+                dedupe: Optional[str] = None) -> list:
     """Check many per-key histories in one device program per
     slot-window bucket: vmap over the key axis; with a mesh (and K
     divisible by its size) the key axis is sharded across devices —
@@ -825,12 +1091,13 @@ def check_batch(model, histories, capacity: int = 512,
     `pipeline_stats`, when a dict, receives the per-bucket
     encode/transfer/device split the bench reports."""
     bucket = _resolve_bucket(bucket)   # fail-fast: before the encode
+    dedupe = _resolve_dedupe(dedupe)   # likewise
     if _resolve_pipeline(pipeline):
         from jepsen_tpu.parallel import pipeline as pipe_mod
         return pipe_mod.check_batch_pipelined(
             model, histories, capacity=capacity,
             max_capacity=max_capacity, mesh=mesh, bucket=bucket,
-            cache=cache, stats=pipeline_stats)
+            cache=cache, stats=pipeline_stats, dedupe=dedupe)
     if (cache is not None and cache is not False) \
             or pipeline_stats is not None:
         # the serial path consults no cache and fills no stats —
@@ -846,7 +1113,7 @@ def check_batch(model, histories, capacity: int = 512,
     pre = [enc_mod.encode(model, h) for h in histories]
     return check_batch_encoded(model, pre, capacity=capacity,
                                max_capacity=max_capacity, mesh=mesh,
-                               bucket=bucket)
+                               bucket=bucket, dedupe=dedupe)
 
 
 def _resolve_bucket(bucket: Optional[str]) -> str:
@@ -886,17 +1153,22 @@ def bucket_key(n_slots: int, bucket: str) -> int:
 
 def check_batch_encoded(model, pre, capacity: int = 512,
                         max_capacity: int = 1 << 18, mesh=None,
-                        bucket: Optional[str] = None) -> list:
+                        bucket: Optional[str] = None,
+                        dedupe: Optional[str] = None) -> list:
     """check_batch on ALREADY-ENCODED keys (the bucketing + dispatch
     half without the encode half). Public so callers that time or
     cache the encode separately — bench.sec_multikey's encode/device
     split, re-analysis over a stored columnar history — drive the
     same bucketing policy as check_batch. Results keep `pre`'s
-    order."""
+    order. `dedupe` governs the sparse buckets (bitdense buckets are
+    a complete visited set by construction; their results say
+    dedupe="dense")."""
     if not pre:
         _resolve_bucket(bucket)
+        _resolve_dedupe(dedupe)
         return []
     bucket = _resolve_bucket(bucket)
+    dedupe = _resolve_dedupe(dedupe)
     from jepsen_tpu.parallel import bitdense
     out: list = [None] * len(pre)
     buckets: dict = {}
@@ -911,14 +1183,14 @@ def check_batch_encoded(model, pre, capacity: int = 512,
             rs = bitdense.check_batch_bitdense(sub, mesh=mesh)
         else:
             rs = _check_batch_sparse(model, sub, capacity, max_capacity,
-                                     mesh)
+                                     mesh, dedupe=dedupe)
         for i, r in zip(idxs, rs):
             out[i] = r
     return out
 
 
 def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
-                        mesh=None) -> list:
+                        mesh=None, dedupe: str = "sort") -> list:
     """Sparse-frontier batch path with per-key capacity-tier retry."""
     step_name = pre[0].step_name
     K = len(pre)
@@ -932,12 +1204,13 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
     while pending:
         encs_t = [pre[i] for i in pending]
         _, xs, state0 = encode_batch(model, [], encs=encs_t, mesh=mesh)
-        valid, fail_r, overflow, maxf, steps_n = _check_device_batch(
-            xs, state0, step_name, N)
+        valid, fail_r, overflow, maxf, steps_n, stepped = \
+            _check_device_batch(xs, state0, step_name, N, dedupe)
         valid = np.asarray(valid)
         fail_r = np.asarray(fail_r)
         overflow = np.asarray(overflow)
         maxf = np.asarray(maxf)
+        stepped = np.asarray(stepped)
         retry = []
         for j, i in enumerate(pending):
             if bool(overflow[j]):
@@ -945,7 +1218,8 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
                 continue
             e = pre[i]
             r = {"valid?": bool(valid[j]), "max-frontier": int(maxf[j]),
-                 "capacity": N}
+                 "capacity": N, "dedupe": dedupe,
+                 "configs-stepped": int(stepped[j])}
             if not r["valid?"]:
                 r.update(enc_mod.fail_op_fields(e, int(fail_r[j])))
             out[i] = r
@@ -953,14 +1227,16 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
             break
         if N * 2 > max_capacity:
             for i in retry:
-                out[i] = _escalate_overflow(pre[i], N, mesh)
+                out[i] = _escalate_overflow(pre[i], N, mesh,
+                                            dedupe=dedupe)
             break
         pending = retry
         N *= 2
     return out
 
 
-def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh) -> dict:
+def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh,
+                       dedupe: str = "sort") -> dict:
     """A key too wide for the batch program escalates instead of dying
     as "unknown": first the single-key sparse engine at 4x the batch
     ceiling, then — with a mesh — the frontier-sharded engine, whose
@@ -980,7 +1256,8 @@ def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh) -> dict:
     # a batch-overflow key would hang in escalation
     dev = None if mesh is None else np.asarray(mesh.devices).flat[0]
     r = check_encoded(e, capacity=min(batch_cap * 2, ceil_single),
-                      max_capacity=ceil_single, device=dev)
+                      max_capacity=ceil_single, device=dev,
+                      dedupe=dedupe)
     if r["valid?"] != "unknown":
         r["escalated"] = "single"
         return r
@@ -1003,7 +1280,7 @@ def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh) -> dict:
             ceil_sharded = min(batch_cap * 4 * n_dev, 1 << 24)
             rs = sharded.check_encoded_sharded(
                 e, mesh, capacity=min(batch_cap * 8, ceil_sharded),
-                max_capacity=ceil_sharded)
+                max_capacity=ceil_sharded, dedupe=dedupe)
             if rs["valid?"] != "unknown":
                 rs["escalated"] = "sharded"
                 return rs

@@ -429,7 +429,8 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
                           workers: Optional[int] = None,
                           chunk_keys: int = DEFAULT_CHUNK_KEYS,
                           depth: int = 2,
-                          stats: Optional[dict] = None) -> list:
+                          stats: Optional[dict] = None,
+                          dedupe: Optional[str] = None) -> list:
     """engine.check_batch with the three host/device phases overlapped
     (module docstring). Same arguments and bit-identical results;
     extras:
@@ -444,12 +445,18 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
     stats       optional dict, filled with the per-bucket
                 encode/transfer/device split and cache counters —
                 the numbers bench.py's multikey section reports
+    dedupe      frontier dedupe strategy for sparse buckets
+                (engine._resolve_dedupe; None = JEPSEN_TPU_DEDUPE) —
+                recorded in stats so the bench lines can say which
+                strategy was active
     """
     bucket = engine._resolve_bucket(bucket)
+    dedupe = engine._resolve_dedupe(dedupe)
     if stats is None:
         stats = {}
     K = len(histories)
-    stats.update({"n_keys": K, "bucket": bucket, "buckets": []})
+    stats.update({"n_keys": K, "bucket": bucket, "dedupe": dedupe,
+                  "buckets": []})
     if K == 0:
         return []
     if cache is None:
@@ -550,7 +557,8 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
                 bstat["chunks"] = 1
                 sub = [enc_of(i) for i in idxs]
                 rs = engine._check_batch_sparse(model, sub, capacity,
-                                                max_capacity, mesh)
+                                                max_capacity, mesh,
+                                                dedupe=dedupe)
                 for i, r in zip(idxs, rs):
                     out[i] = r
         while pending:

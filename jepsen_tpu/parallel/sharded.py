@@ -50,10 +50,27 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from jepsen_tpu.parallel.encode import EncodedHistory
-from jepsen_tpu.parallel.engine import _slot_bits, _xs_from_encoded
+from jepsen_tpu.parallel.engine import (_PROBE_LIMIT, _empty_table,
+                                        _hash_insert, _next_pow2,
+                                        _resolve_dedupe, _slot_bits,
+                                        _xs_from_encoded)
 from jepsen_tpu.parallel.steps import STEPS
 
 AXIS = "frontier"
+
+
+def _shard_map(f, mesh, in_specs, out_specs, check_vma=False):
+    """jax.shard_map across jax versions: the top-level name landed
+    after 0.4.x — older builds (this image's 0.4.37 among them) carry
+    it as jax.experimental.shard_map.shard_map with the replication
+    check named check_rep instead of check_vma. Every sharded entry
+    point routes through here so the engine runs on both."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as esm
+    return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
 
 
 def _hash_config(st, ml, mh):
@@ -126,7 +143,8 @@ def _route_to_owners(st, ml, mh, legal, n_dev: int, B: int):
 
 
 def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
-                  my_idx, axes, route_cand, route_front):
+                  my_idx, axes, route_cand, route_front,
+                  dedupe: str = "sort", probe_limit: int = 0):
     """The topology-independent event scan (runs INSIDE shard_map),
     from an explicit initial carry — shared by the fresh-start core and
     the resumable chunk runner.
@@ -135,10 +153,24 @@ def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
     live)` / `route_front(...)` deliver candidate / surviving rows to
     their hash-owner devices (returning an overflow flag) — the ONLY
     things that differ between the flat 1-D mesh, the all-gather A/B
-    path, and the hierarchical multi-slice topology."""
+    path, and the hierarchical multi-slice topology.
+
+    dedupe="hash" replaces the per-iteration sort-dedupe with the
+    delta-frontier closure over per-device open-addressed visited sets
+    (engine._hash_insert): each device's table holds exactly the
+    configs it owns, so the union of tables IS the device-sharded hash
+    set of BASELINE.json, and the owner-routed all-to-all feeds
+    inserts directly. Only the rows discovered last iteration expand;
+    membership is cumulative across the closure iterations of one
+    return event. The per-event post-filter re-route (ownership moves
+    when the slot bit clears) keeps the sort-based compact — it runs
+    once per event, not once per closure iteration."""
     step = STEPS[step_name]
     C = xs["slot_f"].shape[1]
     bit_lo, bit_hi = _slot_bits(C)
+    if probe_limit <= 0:
+        probe_limit = _PROBE_LIMIT
+    Td = _next_pow2(2 * Nd)
 
     step_cc = jax.vmap(
         jax.vmap(step, in_axes=(None, 0, 0, 0, 0)),
@@ -146,12 +178,12 @@ def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
     )
 
     def closure_cond(c):
-        _, _, _, _, changed, overflow = c
+        _, _, _, _, changed, overflow, _ = c
         return changed & ~overflow
 
     def make_closure_body(ev):
         def body(c):
-            st, ml, mh, live, _, _ = c
+            st, ml, mh, live, _, _, stepped = c
             cand_st, cand_ok = step_cc(
                 st, ev["slot_f"], ev["slot_a0"], ev["slot_a1"],
                 ev["slot_wild"])
@@ -173,16 +205,94 @@ def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
                 all_st, all_ml, all_mh, all_live, Nd, n_dev, my_idx)
             new_n = lax.psum(cnt, axes)
             g_ovf = lax.psum((ovf | route_ovf).astype(jnp.int32), axes) > 0
-            return st2, ml2, mh2, live2, new_n > old_n, g_ovf
+            return (st2, ml2, mh2, live2, new_n > old_n, g_ovf,
+                    stepped + old_n)
         return body
 
+    def hash_closure_cond(c):
+        return c["changed"] & ~c["ovf"]
+
+    def make_hash_closure_body(ev):
+        def body(c):
+            st, ml, mh = c["st"], c["ml"], c["mh"]
+            n_old, count = c["n_old"], c["count"]
+            cand_st, cand_ok = step_cc(
+                st, ev["slot_f"], ev["slot_a0"], ev["slot_a1"],
+                ev["slot_wild"])
+            row = jnp.arange(Nd)
+            delta = (row >= n_old) & (row < count)
+            already = ((ml[:, None] & bit_lo[None, :])
+                       | (mh[:, None] & bit_hi[None, :])) != 0
+            legal = (delta[:, None] & ev["slot_occ"][None, :]
+                     & ~already & cand_ok)
+            c_st, c_ml, c_mh, c_live, route_ovf = route_cand(
+                cand_st.reshape(-1),
+                (ml[:, None] | bit_lo[None, :]).reshape(-1),
+                (mh[:, None] | bit_hi[None, :]).reshape(-1),
+                legal.reshape(-1))
+            # the gather A/B exchange broadcasts EVERY candidate to
+            # every device; inserting only owned rows is what keeps
+            # each table (and the frontier) a partition, not a replica
+            owner = _hash_config(c_st, c_ml, c_mh) % jnp.uint32(n_dev)
+            c_live = c_live & (owner == my_idx)
+            table, fresh, p_ovf = _hash_insert(
+                c_st, c_ml, c_mh, c_live, c["table"], probe_limit)
+            n_fresh = jnp.sum(fresh)
+            pos = jnp.where(fresh, count + jnp.cumsum(fresh) - 1, Nd)
+            l_ovf = (p_ovf | route_ovf
+                     | (count + n_fresh > Nd)).astype(jnp.int32)
+            g_new, g_delta, g_ovf = lax.psum(
+                (n_fresh, count - n_old, l_ovf), axes)
+            return {
+                "st": st.at[pos].set(c_st, mode="drop"),
+                "ml": ml.at[pos].set(c_ml, mode="drop"),
+                "mh": mh.at[pos].set(c_mh, mode="drop"),
+                "n_old": count,
+                "count": jnp.minimum(count + n_fresh, Nd),
+                "table": table,
+                "changed": g_new > 0,
+                "ovf": c["ovf"] | (g_ovf > 0),
+                "stepped": c["stepped"] + g_delta,
+            }
+        return body
+
+    def run_closure(ev, st, ml, mh, live, run, stepped):
+        """-> (st2, ml2, mh2, live2, ovf, stepped2)."""
+        if dedupe == "sort":
+            st2, ml2, mh2, live2, _, ovf, stepped2 = lax.while_loop(
+                closure_cond, make_closure_body(ev),
+                (st, ml, mh, live, run, jnp.array(False), stepped))
+            return st2, ml2, mh2, live2, ovf, stepped2
+        # seed the per-event visited set with the local frontier
+        # (owned rows by invariant), compacting it in the same pass
+        table, fresh0, p0 = _hash_insert(st, ml, mh, live,
+                                         _empty_table(Td), probe_limit)
+        m0 = jnp.sum(fresh0)
+        pos0 = jnp.where(fresh0, jnp.cumsum(fresh0) - 1, Nd)
+        g_p0 = lax.psum(p0.astype(jnp.int32), axes) > 0
+        out = lax.while_loop(
+            hash_closure_cond, make_hash_closure_body(ev), {
+                "st": jnp.zeros(Nd, jnp.int32).at[pos0].set(
+                    st, mode="drop"),
+                "ml": jnp.zeros(Nd, jnp.uint32).at[pos0].set(
+                    ml, mode="drop"),
+                "mh": jnp.zeros(Nd, jnp.uint32).at[pos0].set(
+                    mh, mode="drop"),
+                "n_old": jnp.int32(0), "count": m0, "table": table,
+                "changed": run, "ovf": g_p0, "stepped": stepped})
+        live2 = jnp.arange(Nd) < out["count"]
+        return (out["st"], out["ml"], out["mh"], live2, out["ovf"],
+                out["stepped"])
+
     def scan_step(carry, ev):
-        st, ml, mh, live, ok, fail_r, r_idx, maxf = carry
+        st, ml, mh, live, ok, fail_r, r_idx, maxf, stepped = carry
         run = ok & (ev["ev_slot"] >= 0)
-        st2, ml2, mh2, live2, _, ovf = lax.while_loop(
-            closure_cond, make_closure_body(ev),
-            (st, ml, mh, live, run, jnp.array(False)),
-        )
+        st2, ml2, mh2, live2, ovf, stepped2 = run_closure(
+            ev, st, ml, mh, live, run, stepped)
+        # the hash prologue runs unconditionally (lax.scan cannot skip
+        # an event): gate its probe flag so a pad/settled event never
+        # leaks into the capacity-escalation decision
+        ovf = run & ovf
         s = jnp.maximum(ev["ev_slot"], 0).astype(jnp.uint32)
         one = jnp.uint32(1)
         blo = jnp.where(s < 32, one << jnp.minimum(s, 31),
@@ -214,18 +324,20 @@ def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
         maxf = jnp.maximum(maxf, jnp.where(run,
                                            lax.psum(jnp.sum(live2), axes),
                                            0))
+        stepped_o = jnp.where(run, stepped2, stepped)
         return (st_o, ml_o, mh_o, live_o, new_ok, new_fail,
-                r_idx + 1, maxf), ovf
+                r_idx + 1, maxf, stepped_o), ovf
 
     carry, ovfs = lax.scan(scan_step, carry0, xs)
     return carry, jnp.any(ovfs)
 
 
 def _sharded_core(xs, state0, step_name: str, Nd: int, n_dev: int,
-                  my_idx, axes, route_cand, route_front):
+                  my_idx, axes, route_cand, route_front,
+                  dedupe: str = "sort"):
     """Fresh-start wrapper over _sharded_scan: seed the initial config
     on its hash-owner device, scan the whole history, reduce to the
-    (valid, fail, overflow, maxf) scalars."""
+    (valid, fail, overflow, maxf, stepped) scalars."""
     # initial config lives on its hash-owner device
     st0v = jnp.full(Nd, state0, jnp.int32)
     owner0 = _hash_config(jnp.int32(state0), jnp.uint32(0),
@@ -233,12 +345,13 @@ def _sharded_core(xs, state0, step_name: str, Nd: int, n_dev: int,
     live0 = (jnp.arange(Nd) < 1) & (owner0 == my_idx)
     carry0 = (st0v, jnp.zeros(Nd, jnp.uint32), jnp.zeros(Nd, jnp.uint32),
               live0, jnp.array(True), jnp.int32(-1), jnp.int32(0),
-              jnp.int32(1))
+              jnp.int32(1), jnp.int32(0))
     carry, overflow = _sharded_scan(xs, carry0, step_name, Nd, n_dev,
-                                    my_idx, axes, route_cand, route_front)
-    _, _, _, live, ok, fail_r, _, maxf = carry
+                                    my_idx, axes, route_cand, route_front,
+                                    dedupe)
+    _, _, _, live, ok, fail_r, _, maxf, stepped = carry
     valid = ok & (lax.psum(jnp.sum(live), axes) > 0) & ~overflow
-    return valid, fail_r, overflow, maxf
+    return valid, fail_r, overflow, maxf, stepped
 
 
 def _flat_routes(Nd: int, C: int, n_dev: int):
@@ -255,7 +368,7 @@ def _flat_routes(Nd: int, C: int, n_dev: int):
 
 
 def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int,
-                  exchange: str = "route"):
+                  exchange: str = "route", dedupe: str = "sort"):
     """1-D topology adapter: flat owner routing over AXIS, or the
     all-gather broadcast (A/B measurement path)."""
     C = xs["slot_f"].shape[1]
@@ -268,14 +381,14 @@ def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int,
             return g(st), g(ml), g(mh), g(lv), jnp.array(False)
         route_cand = route_front = _bcast
     return _sharded_core(xs, state0, step_name, Nd, n_dev, my_idx,
-                         (AXIS,), route_cand, route_front)
+                         (AXIS,), route_cand, route_front, dedupe)
 
 
 AX_SLICE, AX_CHIP = "slice", "chip"
 
 
 def _sharded2d_impl(xs, state0, step_name: str, Nd: int,
-                    n_slice: int, n_chip: int):
+                    n_slice: int, n_chip: int, dedupe: str = "sort"):
     """2-D topology adapter (slice x chip): the multi-slice story.
     Owner routing is HIERARCHICAL — stage 1 delivers candidates to the
     owner's chip COLUMN over the intra-slice axis (ICI); stage 2
@@ -309,7 +422,8 @@ def _sharded2d_impl(xs, state0, step_name: str, Nd: int,
     return _sharded_core(
         xs, state0, step_name, Nd, D, my_idx, (AX_SLICE, AX_CHIP),
         lambda st, ml, mh, lv: route2(st, ml, mh, lv, B1c, B2c),
-        lambda st, ml, mh, lv: route2(st, ml, mh, lv, B1f, B2f))
+        lambda st, ml, mh, lv: route2(st, ml, mh, lv, B1f, B2f),
+        dedupe)
 
 
 # donation decision (recompile-donate-argnums) for the three sharded
@@ -320,15 +434,15 @@ def _sharded2d_impl(xs, state0, step_name: str, Nd: int,
 # would invalidate the retries.
 @functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
                    static_argnames=("step_name", "Nd", "n_slice",
-                                    "n_chip", "mesh"))
+                                    "n_chip", "mesh", "dedupe"))
 def _check_sharded2d(xs, state0, step_name: str, Nd: int, n_slice: int,
-                     n_chip: int, mesh: Mesh):
-    fn = jax.shard_map(
+                     n_chip: int, mesh: Mesh, dedupe: str = "sort"):
+    fn = _shard_map(
         lambda x, s0: _sharded2d_impl(x, s0, step_name, Nd, n_slice,
-                                      n_chip),
+                                      n_chip, dedupe),
         mesh=mesh,
         in_specs=(P(), P()),
-        out_specs=(P(), P(), P(), P()),
+        out_specs=(P(), P(), P(), P(), P()),
         check_vma=False,
     )
     return fn(xs, state0)
@@ -337,21 +451,24 @@ def _check_sharded2d(xs, state0, step_name: str, Nd: int, n_slice: int,
 # same donation decision as _check_sharded2d above
 @functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
                    static_argnames=("step_name", "Nd", "n_dev",
-                                    "mesh", "exchange"))
+                                    "mesh", "exchange", "dedupe"))
 def _check_sharded(xs, state0, step_name: str, Nd: int, n_dev: int,
-                   mesh: Mesh, exchange: str = "route"):
-    fn = jax.shard_map(
-        lambda x, s0: _sharded_impl(x, s0, step_name, Nd, n_dev, exchange),
+                   mesh: Mesh, exchange: str = "route",
+                   dedupe: str = "sort"):
+    fn = _shard_map(
+        lambda x, s0: _sharded_impl(x, s0, step_name, Nd, n_dev, exchange,
+                                    dedupe),
         mesh=mesh,
         in_specs=(P(), P()),       # tables + state replicated
-        out_specs=(P(), P(), P(), P()),
+        out_specs=(P(), P(), P(), P(), P()),
         check_vma=False,
     )
     return fn(xs, state0)
 
 
 def _sharded_resume_impl(xs, st, ml, mh, live, ok, fail_r, r_idx, maxf,
-                         step_name: str, Nd: int, n_dev: int):
+                         stepped, step_name: str, Nd: int, n_dev: int,
+                         dedupe: str = "sort"):
     """Resume-from-carry adapter (runs INSIDE shard_map), 1-D topology.
 
     Restored rows arrive laid out however the host scattered them — a
@@ -375,30 +492,31 @@ def _sharded_resume_impl(xs, st, ml, mh, live, ok, fail_r, r_idx, maxf,
         r_st, r_ml, r_mh, r_live, Nd, n_dev, my_idx)
     pre_ovf = lax.psum((rt_ovf | d_ovf).astype(jnp.int32), (AXIS,)) > 0
 
-    carry0 = (st2, ml2, mh2, live2, ok, fail_r, r_idx, maxf)
+    carry0 = (st2, ml2, mh2, live2, ok, fail_r, r_idx, maxf, stepped)
     carry, scan_ovf = _sharded_scan(xs, carry0, step_name, Nd, n_dev,
                                     my_idx, (AXIS,), route_cand,
-                                    route_front)
+                                    route_front, dedupe)
     return carry, scan_ovf | pre_ovf
 
 
 # same donation decision as _check_sharded2d above
 @functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
                    static_argnames=("step_name", "Nd", "n_dev",
-                                    "mesh"))
+                                    "mesh", "dedupe"))
 def _check_sharded_resume(xs, st, ml, mh, live, ok, fail_r, r_idx, maxf,
-                          step_name: str, Nd: int, n_dev: int,
-                          mesh: Mesh):
-    fn = jax.shard_map(
-        lambda x, *c: _sharded_resume_impl(x, *c, step_name, Nd, n_dev),
+                          stepped, step_name: str, Nd: int, n_dev: int,
+                          mesh: Mesh, dedupe: str = "sort"):
+    fn = _shard_map(
+        lambda x, *c: _sharded_resume_impl(x, *c, step_name, Nd, n_dev,
+                                           dedupe),
         mesh=mesh,
         in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS),
-                  P(), P(), P(), P()),
+                  P(), P(), P(), P(), P()),
         out_specs=((P(AXIS), P(AXIS), P(AXIS), P(AXIS),
-                    P(), P(), P(), P()), P()),
+                    P(), P(), P(), P(), P()), P()),
         check_vma=False,
     )
-    return fn(xs, st, ml, mh, live, ok, fail_r, r_idx, maxf)
+    return fn(xs, st, ml, mh, live, ok, fail_r, r_idx, maxf, stepped)
 
 
 def check_encoded_sharded_resumable(e: EncodedHistory, mesh: Mesh,
@@ -406,7 +524,8 @@ def check_encoded_sharded_resumable(e: EncodedHistory, mesh: Mesh,
                                     max_capacity: int = 1 << 22,
                                     checkpoint_every: int = 256,
                                     checkpoint_cb=None,
-                                    resume=None) -> dict:
+                                    resume=None,
+                                    dedupe=None) -> dict:
     """check_encoded_sharded with mid-search checkpointing — the
     sharded arm of the checker's checkpoint/resume capability
     (SURVEY.md §5.4; engine.check_encoded_resumable is the single-
@@ -440,6 +559,7 @@ def check_encoded_sharded_resumable(e: EncodedHistory, mesh: Mesh,
     devs = devs.reshape(-1)
     mesh = Mesh(devs, (AXIS,))
     n_dev = devs.size
+    dedupe = _resolve_dedupe(dedupe)
     digest = history_digest(e)
     if resume is not None:
         if resume.history_digest != digest:
@@ -484,25 +604,28 @@ def check_encoded_sharded_resumable(e: EncodedHistory, mesh: Mesh,
             jax.device_put(np.int32(cp.fail_r), rep),
             jax.device_put(np.int32(cp.event_index), rep),
             jax.device_put(np.int32(cp.maxf), rep),
-            e.step_name, Nd, n_dev, mesh)
+            jax.device_put(np.int32(cp.stepped), rep),
+            e.step_name, Nd, n_dev, mesh, dedupe)
         if bool(overflow):
             if N * 2 > max_capacity:
                 return {"valid?": "unknown",
                         "error": f"frontier overflow at capacity {N}",
                         "capacity": N, "devices": n_dev,
-                        "checkpoint": cp}
+                        "dedupe": dedupe, "checkpoint": cp}
             cp = cp.grown(N * 2)    # N extra dead rows
             continue                # re-run the same chunk
-        st, ml, mh, live, ok, fail_r, r_idx, maxf = \
+        st, ml, mh, live, ok, fail_r, r_idx, maxf, stepped = \
             [np.asarray(x) for x in carry]
         cp = FrontierCheckpoint(int(r_idx), N, e.step_name, digest,
                                 st, ml, mh, live, bool(ok),
-                                int(fail_r), int(maxf), cp.steps_n)
+                                int(fail_r), int(maxf), cp.steps_n,
+                                int(stepped))
         if checkpoint_cb is not None:
             checkpoint_cb(cp)
     out = {"valid?": cp.ok and bool(cp.live.any()),
            "max-frontier": cp.maxf, "capacity": cp.capacity,
-           "devices": n_dev}
+           "devices": n_dev, "dedupe": dedupe,
+           "configs-stepped": cp.stepped}
     if not out["valid?"]:
         from jepsen_tpu.parallel.encode import fail_op_fields
         out.update(fail_op_fields(e, cp.fail_r))
@@ -512,7 +635,8 @@ def check_encoded_sharded_resumable(e: EncodedHistory, mesh: Mesh,
 def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
                           capacity: int = 8192,
                           max_capacity: int = 1 << 22,
-                          exchange: str = "route") -> dict:
+                          exchange: str = "route",
+                          dedupe=None) -> dict:
     """Check one encoded history with the frontier sharded over `mesh`.
 
     Topology: a mesh whose device array is 2-D (both dims > 1) with
@@ -524,11 +648,19 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
     path) always flattens.
 
     `capacity` is the GLOBAL frontier capacity; it doubles on overflow
-    (frontier past capacity, or an owner bucket past its 2x-uniform
-    slack) by re-jitting at the next tier, like
-    `engine.check_encoded`."""
+    (frontier past capacity, an owner bucket past its 2x-uniform
+    slack, or — under dedupe="hash" — a visited-set probe exhaustion)
+    by re-jitting at the next tier, like `engine.check_encoded`.
+
+    `dedupe` picks the per-iteration dedupe: "sort" (owner-filtered
+    lexsort) or "hash" (delta-frontier closure over per-device
+    open-addressed visited sets — the device-sharded hash set of
+    BASELINE.json); None defers to JEPSEN_TPU_DEDUPE. Verdicts and
+    counterexample fields are identical; "configs-stepped" records
+    the global closure work actually paid."""
     if e.n_returns == 0:
         return {"valid?": True, "max-frontier": 0, "capacity": 0}
+    dedupe = _resolve_dedupe(dedupe)
     # A 2-D device array + "route" = the multi-slice topology: axis 0
     # is the slice (DCN) axis, axis 1 the intra-slice chip (ICI) axis,
     # and the exchange goes hierarchical. Anything else flattens onto
@@ -553,20 +685,23 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
     while True:
         Nd = (N + n_dev - 1) // n_dev
         if hier:
-            valid, fail_r, overflow, maxf = _check_sharded2d(
-                xs, state0, e.step_name, Nd, n_slice, n_chip, mesh)
+            valid, fail_r, overflow, maxf, stepped = _check_sharded2d(
+                xs, state0, e.step_name, Nd, n_slice, n_chip, mesh,
+                dedupe)
         else:
-            valid, fail_r, overflow, maxf = _check_sharded(
-                xs, state0, e.step_name, Nd, n_dev, mesh, exchange)
+            valid, fail_r, overflow, maxf, stepped = _check_sharded(
+                xs, state0, e.step_name, Nd, n_dev, mesh, exchange,
+                dedupe)
         if not bool(overflow):
             break
         if N * 2 > max_capacity:
             return {"valid?": "unknown",
                     "error": f"frontier overflow at capacity {N}",
-                    "capacity": N}
+                    "capacity": N, "dedupe": dedupe}
         N *= 2
     out = {"valid?": bool(valid), "max-frontier": int(maxf),
-           "capacity": N, "devices": n_dev}
+           "capacity": N, "devices": n_dev, "dedupe": dedupe,
+           "configs-stepped": int(stepped)}
     if hier:
         out["mesh"] = f"{n_slice}x{n_chip} (hierarchical exchange)"
     if not out["valid?"]:
@@ -576,7 +711,8 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
 
 
 def analysis(model, history, mesh: Mesh, capacity: int = 8192,
-             max_capacity: int = 1 << 22, exchange: str = "route") -> dict:
+             max_capacity: int = 1 << 22, exchange: str = "route",
+             dedupe=None) -> dict:
     """knossos-style (model, history) -> result with the frontier
     sharded over `mesh`; on failure, counterexample paths come from the
     same windowed host re-search as `engine.analysis` (the seed frontier
@@ -599,7 +735,8 @@ def analysis(model, history, mesh: Mesh, capacity: int = 8192,
         r["fallback"] = str(err)
         return r
     r = check_encoded_sharded(e, mesh, capacity=capacity,
-                              max_capacity=max_capacity, exchange=exchange)
+                              max_capacity=max_capacity,
+                              exchange=exchange, dedupe=dedupe)
     if r["valid?"] is False:
         engine.apply_final_paths(r, model, e)
     return r

@@ -20,3 +20,14 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache for the suite (same lever bench.py gives
+# its children): the sharded shard_map programs cost 5-20s each to
+# compile on the CPU backend, and re-runs of the suite re-pay every one
+# of them. The cache lives in the repo tree (gitignored) so it survives
+# across sessions on the same workspace; a fresh clone just runs cold.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

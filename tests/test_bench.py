@@ -172,21 +172,37 @@ def test_bench_adv_section_contract():
                        "--timeout", "200"])
     assert r.returncode == 0, r.stderr[-2000:]
     lines = _json_lines(r.stdout)
-    assert len(lines) == 1, lines
+    # the main device line, then the sparse-engine dedupe A/B advisory
+    assert len(lines) == 2, lines
     line = lines[0]
     for k in ("metric", "value", "unit", "vs_baseline", "L",
               "device_secs", "host_est_secs",
               # the per-section encode/transfer/device split keys —
               # every device section must carry them so pipeline wins
               # are measurable against prior artifacts
-              "encode_secs", "transfer_secs"):
+              "encode_secs", "transfer_secs",
+              # the uniform dedupe schema: strategy + configs-stepped
+              # counter in every device section (bitdense sections
+              # report "dense"/None; real counters ride the advisory)
+              "dedupe", "configs_stepped"):
         assert k in line, line
     assert line["L"] == 200 and line["value"] > 0
     assert line["unit"] == "ops/sec"
+    assert line["dedupe"] == "dense", line
     assert line["encode_secs"] >= 0 and line["transfer_secs"] >= 0
     # device_secs is uniformly SEARCH-ONLY across sections; the old
     # whole-call quantity lives on as steady_secs in this section
     assert line["device_secs"] <= line["steady_secs"], line
+    # the dedupe A/B advisory: both strategies decided the key, and the
+    # delta-frontier counter is STRICTLY below the sort path's on this
+    # adversarial shape — the work reduction, visible on CPU
+    ab = lines[1]
+    assert "dedupe A/B" in ab["metric"], ab
+    for strat in ("sort", "hash"):
+        d = ab["dedupe"][strat]
+        assert d["valid"] is True and d["configs_stepped"] > 0, ab
+    assert ab["dedupe"]["hash"]["configs_stepped"] \
+        < ab["dedupe"]["sort"]["configs_stepped"], ab
 
 
 @pytest.mark.slow
@@ -204,9 +220,12 @@ def test_bench_multikey_section_contract():
     piped = [l for l in lines if "pipelined" in l["metric"]]
     assert len(serial) == 1 and len(piped) == 1, lines
     for k in ("encode_secs", "transfer_secs", "device_secs",
-              "device_only_secs"):
+              "device_only_secs", "dedupe", "configs_stepped"):
         assert k in serial[0], serial[0]
+    # bitdense batch: the dense tensor is itself the visited set
+    assert serial[0]["dedupe"] == "dense", serial[0]
     p = piped[0]
+    assert p["dedupe"] in ("sort", "hash"), p   # the resolved strategy
     for k in ("serial_e2e_secs", "pipelined_e2e_secs",
               "cached_e2e_secs", "buckets", "cache"):
         assert k in p, p
@@ -215,6 +234,38 @@ def test_bench_multikey_section_contract():
         for k in ("tier", "keys", "engine", "encode_secs",
                   "transfer_secs", "device_wait_secs"):
             assert k in b, b
+
+
+def test_sharded_section_line_carries_dedupe_schema(monkeypatch,
+                                                    capsys):
+    """The sharded section's JSON line must carry the uniform dedupe
+    schema — the ACTIVE strategy and the real configs-stepped counter
+    from the engine result (this is the section where the counter is a
+    genuine int, not the bitdense "dense"/None placeholder). The
+    engine is stubbed: its own result keys are pinned by
+    tests/test_sharded.py; this pins the result->line mapping without
+    paying a multi-minute sharded search in CI."""
+    import importlib
+    import bench
+    from jepsen_tpu.parallel import sharded
+
+    canned = {"valid?": True, "devices": 8, "capacity": 4096,
+              "max-frontier": 7, "dedupe": "hash",
+              "configs-stepped": 12345}
+    monkeypatch.setattr(sharded, "check_encoded_sharded",
+                        lambda *a, **k: dict(canned))
+    monkeypatch.setattr(bench, "ADV_K", 4)   # tiny encode, same path
+    bench.sec_sharded(64, None, cap_log=8)
+    lines = _json_lines(capsys.readouterr().out)
+    assert len(lines) == 1, lines
+    line = lines[0]
+    for k in ("metric", "value", "unit", "vs_baseline", "dedupe",
+              "configs_stepped", "device_secs", "encode_secs",
+              "transfer_secs"):
+        assert k in line, line
+    assert line["dedupe"] == "hash"
+    assert line["configs_stepped"] == 12345
+    importlib.reload(bench)
 
 
 def test_prior_onchip_headline_orders_by_round_number(tmp_path,

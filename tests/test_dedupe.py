@@ -1,0 +1,239 @@
+"""Delta-frontier closure + hash visited-set (JEPSEN_TPU_DEDUPE=hash)
+vs the sort-dedupe path: verdict/counterexample/statistics parity, the
+configs-stepped work reduction, probe-overflow capacity escalation, and
+the flag/checkpoint plumbing. The deep six-family sweep (incl. the
+sharded-mesh case) lives in the fuzz tier (test_fuzz_differential);
+this file is the fast always-on pin."""
+
+import os
+import unittest.mock as mock
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.histories import (adversarial_register_history,
+                                  corrupt_history, rand_fifo_history,
+                                  rand_gset_history, rand_queue_history,
+                                  rand_register_history)
+from jepsen_tpu.history import History, invoke_op, ok_op
+from jepsen_tpu.models import (CASRegister, FIFOQueue, GSet, Mutex,
+                               UnorderedQueue)
+from jepsen_tpu.parallel import encode as enc_mod, engine
+
+# Everything order-independent in a sparse result must MATCH between
+# strategies: verdict, failing op + event, max-frontier, capacity, and
+# the historical explored metric (iteration counts are identical — the
+# delta closure converges in exactly the sort closure's iterations).
+# Only the frontier ROW ORDER and configs-stepped may differ.
+PIN = ("valid?", "op", "fail-event", "max-frontier", "capacity",
+       "explored")
+
+
+def _pin(r):
+    return {k: r.get(k) for k in PIN}
+
+
+def _parity(e, capacity=128, max_capacity=4096):
+    rs = engine.check_encoded(e, capacity=capacity,
+                              max_capacity=max_capacity, dedupe="sort")
+    rh = engine.check_encoded(e, capacity=capacity,
+                              max_capacity=max_capacity, dedupe="hash")
+    assert _pin(rs) == _pin(rh), (rs, rh)
+    if rs["valid?"] != "unknown":
+        assert rh["configs-stepped"] <= rs["configs-stepped"], (rs, rh)
+        assert rh["dedupe"] == "hash" and rs["dedupe"] == "sort"
+    return rs, rh
+
+
+FAMILIES = [
+    ("cas-register", CASRegister,
+     lambda: rand_register_history(n_ops=40, n_processes=5, n_values=3,
+                                   crash_p=0.06, fail_p=0.08, seed=31)),
+    # (plain Register shares the "register" device step with
+    # CASRegister — the fuzz tier covers it; no extra compile here)
+    ("gset", GSet,
+     lambda: rand_gset_history(n_ops=36, n_processes=4, n_elements=9,
+                               crash_p=0.06, seed=33)),
+    ("uqueue", UnorderedQueue,
+     lambda: rand_queue_history(n_ops=26, n_processes=4, n_values=3,
+                                crash_p=0.06, seed=34)),
+    ("fifo", FIFOQueue,
+     lambda: rand_fifo_history(n_ops=24, n_processes=4, n_values=3,
+                               crash_p=0.05, seed=35)),
+]
+
+
+@pytest.mark.parametrize("name,Model,gen", FAMILIES,
+                         ids=[c[0] for c in FAMILIES])
+def test_hash_parity_clean_and_corrupted(name, Model, gen):
+    h = gen()
+    for variant in (h, corrupt_history(h, seed=7, n_corruptions=2)):
+        try:
+            e = enc_mod.encode(Model(), variant)
+        except enc_mod.EncodeError:
+            continue  # family/shape not device-encodable: nothing to pin
+        _parity(e)
+
+
+def test_hash_parity_mutex_invalid():
+    # mutex has no corruptible values; a double-acquire is the invalid
+    # case, localized identically by both strategies
+    h = History.wrap([
+        invoke_op(0, "acquire", None), ok_op(0, "acquire", None),
+        invoke_op(1, "acquire", None), ok_op(1, "acquire", None),
+    ]).index()
+    e = enc_mod.encode(Mutex(), h)
+    rs, rh = _parity(e, capacity=64, max_capacity=256)
+    assert rs["valid?"] is False
+
+
+def test_hash_steps_strictly_fewer_on_adversarial():
+    """The acceptance shape: on an adversarial history (deep closures
+    over held-open crashed writes) the delta-frontier path must pay
+    STRICTLY less closure work — the settled majority stops being
+    re-stepped. Pinned via the configs-stepped counters."""
+    h = adversarial_register_history(n_ops=120, k_crashed=6, seed=7)
+    e = enc_mod.encode(CASRegister(), h)
+    # capacity sized to the peak (~10*2^(k-1)) so neither strategy pays
+    # the escalation ladder's extra compiles in this fast tier
+    rs, rh = _parity(e, capacity=1024, max_capacity=4096)
+    assert rs["valid?"] is True
+    assert rh["configs-stepped"] < rs["configs-stepped"], (rs, rh)
+
+
+def test_probe_overflow_escalates_capacity_not_verdict():
+    """Probe exhaustion in the visited set must degrade into the
+    existing capacity-escalation retry (bigger table = lower load
+    factor), never a wrong verdict or a dropped config. probe_limit=1
+    makes every collision an exhaustion — the check still lands the
+    sort verdict, at a (possibly) higher tier."""
+    h = rand_register_history(n_ops=50, n_processes=5, n_values=4,
+                              crash_p=0.05, fail_p=0.05, seed=11)
+    e = enc_mod.encode(CASRegister(), h)
+    ref = engine.check_encoded(e, capacity=64, dedupe="sort")
+    r1 = engine.check_encoded(e, capacity=64, max_capacity=1 << 14,
+                              dedupe="hash", probe_limit=1)
+    assert r1["valid?"] == ref["valid?"]
+    assert r1.get("op") == ref.get("op")
+    assert r1["capacity"] >= ref["capacity"]
+
+
+def test_frontier_overflow_same_unknown_as_sort():
+    # m concurrent writes -> ~m * 2^(m-1) configs: blows every tier
+    ops = []
+    for p in range(26):
+        ops.append(invoke_op(p, "write", 1000 + p))
+    for p in range(26):
+        ops.append(ok_op(p, "write", 1000 + p))
+    e = enc_mod.encode(CASRegister(), History.wrap(ops).index())
+    for strat in ("sort", "hash"):
+        r = engine.check_encoded(e, capacity=64, max_capacity=256,
+                                 dedupe=strat)
+        assert r["valid?"] == "unknown" and "overflow" in r["error"], r
+        assert r["dedupe"] == strat
+
+
+def test_env_flag_resolution_and_validation():
+    from jepsen_tpu.envflags import EnvFlagError
+    assert engine._resolve_dedupe(None) == "sort"   # the default
+    assert engine._resolve_dedupe("hash") == "hash"
+    with pytest.raises(ValueError, match="dedupe"):
+        engine._resolve_dedupe("bogus")
+    with mock.patch.dict(os.environ, {"JEPSEN_TPU_DEDUPE": "hash"}):
+        assert engine._resolve_dedupe(None) == "hash"
+    with mock.patch.dict(os.environ, {"JEPSEN_TPU_DEDUPE": "bogus"}), \
+            pytest.raises(EnvFlagError, match="dedupe strategy"):
+        engine._resolve_dedupe(None)
+    # the flag actually reaches the engine: a check under the env flag
+    # reports the strategy it ran
+    h = rand_register_history(n_ops=24, n_processes=3, crash_p=0.0,
+                              seed=5)
+    e = enc_mod.encode(CASRegister(), h)
+    with mock.patch.dict(os.environ, {"JEPSEN_TPU_DEDUPE": "hash"}):
+        r = engine.check_encoded(e, capacity=64)
+    assert r["dedupe"] == "hash" and r["valid?"] is True
+
+
+def test_resumable_hash_matches_oneshot_and_checkpoints_stepped():
+    h = rand_register_history(n_ops=120, n_processes=6, n_values=4,
+                              crash_p=0.01, fail_p=0.05, busy=0.7,
+                              seed=10)
+    e = enc_mod.encode(CASRegister(), h)
+    ref = engine.check_encoded(e, capacity=256, dedupe="hash")
+    res = engine.check_encoded_resumable(e, capacity=256,
+                                         checkpoint_every=16,
+                                         dedupe="hash")
+    assert res["valid?"] == ref["valid?"]
+    assert res["max-frontier"] == ref["max-frontier"]
+    assert res["configs-stepped"] == ref["configs-stepped"]
+    assert res["dedupe"] == "hash"
+
+
+def test_checkpoint_v1_files_load_with_zero_stepped(tmp_path):
+    """FrontierCheckpoint format versioning: v2 saves carry the
+    configs-stepped counter; a v1 file (6 meta scalars, written by
+    prior rounds) must still load and resume — the counter is
+    advisory, the search state is complete without it."""
+    h = rand_register_history(n_ops=60, n_processes=4, crash_p=0.02,
+                              fail_p=0.05, seed=3)
+    e = enc_mod.encode(CASRegister(), h)
+    cps = []
+    ref = engine.check_encoded_resumable(e, capacity=64,
+                                         checkpoint_every=8,
+                                         dedupe="hash",
+                                         checkpoint_cb=cps.append)
+    cp = cps[0]
+    assert cp.stepped > 0
+    # v2 roundtrip keeps the counter
+    p = cp.save(str(tmp_path / "v2"))
+    assert engine.FrontierCheckpoint.load(p).stepped == cp.stepped
+    # hand-write a v1 file: meta truncated to the 6 legacy scalars
+    v1 = str(tmp_path / "v1.npz")
+    np.savez_compressed(
+        v1, st=cp.st, ml=cp.ml, mh=cp.mh, live=cp.live,
+        meta=np.array([cp.event_index, cp.capacity, int(cp.ok),
+                       cp.fail_r, cp.maxf, cp.steps_n], np.int64),
+        step_name=np.array(cp.step_name),
+        history_digest=np.array(cp.history_digest))
+    lo = engine.FrontierCheckpoint.load(v1)
+    assert lo.stepped == 0 and lo.event_index == cp.event_index
+    res = engine.check_encoded_resumable(e, resume=lo, dedupe="hash")
+    assert res["valid?"] == ref["valid?"]
+
+
+def test_batch_and_pipeline_thread_the_strategy():
+    """check_batch(dedupe=...) must reach the sparse buckets (results
+    tagged, verdicts identical to sort) in both the serial and the
+    pipelined executor; bitdense buckets report dedupe="dense". The
+    state-rich FIFO keys route sparse, the register keys bitdense."""
+    regs = [rand_register_history(n_ops=24, n_processes=3, crash_p=0.02,
+                                  seed=600 + s) for s in range(3)]
+    fifo = rand_fifo_history(n_ops=36, n_processes=6, n_values=3,
+                             crash_p=0.15, seed=5)
+
+    rs = engine.check_batch(CASRegister(), regs, capacity=64,
+                            max_capacity=2048, dedupe="hash")
+    assert all(r["dedupe"] == "dense" for r in rs), rs
+
+    pre = [enc_mod.encode(FIFOQueue(), fifo)]
+    r_sort = engine._check_batch_sparse(FIFOQueue(), pre, 128, 2048,
+                                        dedupe="sort")[0]
+    r_hash = engine._check_batch_sparse(FIFOQueue(), pre, 128, 2048,
+                                        dedupe="hash")[0]
+    assert r_sort["valid?"] == r_hash["valid?"]
+    assert r_sort["max-frontier"] == r_hash["max-frontier"]
+    assert r_hash["configs-stepped"] <= r_sort["configs-stepped"]
+    assert r_hash["dedupe"] == "hash"
+
+    # pipelined executor: strategy recorded in stats, sparse results
+    # identical to the serial path under the same strategy
+    stats = {}
+    rs_p = engine.check_batch(FIFOQueue(), [fifo], capacity=128,
+                              max_capacity=2048, pipeline=True,
+                              cache=False, pipeline_stats=stats,
+                              dedupe="hash")
+    assert stats["dedupe"] == "hash"
+    assert rs_p[0] == r_hash, (rs_p[0], r_hash)
+
+    with pytest.raises(ValueError, match="dedupe"):
+        engine.check_batch(CASRegister(), [], dedupe="bogus")

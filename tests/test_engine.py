@@ -795,10 +795,10 @@ def test_escalation_single_tier_pinned_to_callers_mesh(monkeypatch):
     seen = {}
     real = engine.check_encoded
 
-    def spy(e, capacity=1024, max_capacity=1 << 20, device=None):
+    def spy(e, capacity=1024, max_capacity=1 << 20, device=None, **kw):
         seen["device"] = device
         return real(e, capacity=capacity, max_capacity=max_capacity,
-                    device=device)
+                    device=device, **kw)
 
     monkeypatch.setattr(engine, "check_encoded", spy)
     mid = rand_fifo_history(n_ops=40, n_processes=6, n_values=3,

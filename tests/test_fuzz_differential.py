@@ -153,6 +153,13 @@ def test_fuzz_engines_agree_with_wgl(name, Model, gen):
                 # by the loop below
                 engines["sparse"] = lambda: engine.check_encoded(
                     e, max_capacity=1 << 15)
+                # the delta-frontier hash visited-set variant
+                # (JEPSEN_TPU_DEDUPE=hash) against the same oracle on
+                # every family, clean + corrupted — the randomized arm
+                # of the dedupe parity matrix (tests/test_dedupe.py is
+                # the deterministic pin)
+                engines["sparse-hash"] = lambda: engine.check_encoded(
+                    e, max_capacity=1 << 15, dedupe="hash")
                 if dense.fits_dense(dense.n_states(e), e.n_slots):
                     engines["dense"] = lambda: dense.check_encoded_dense(e)
                 if bitdense.fits_bitdense(bitdense.n_states(e),
@@ -224,6 +231,47 @@ def test_fuzz_fake_device_invalid_ends_in_correct_verdict():
             failures.append((seed, int(fail_r), n_ops,
                              {k: r[k] for k in r
                               if k != "final-paths"}))
+    assert not failures, failures
+
+
+@pytest.mark.fuzz
+def test_fuzz_sharded_hash_parity_on_mesh():
+    """Randomized sort-vs-hash parity for the frontier-SHARDED engine
+    on the 8-way CPU mesh: per-device open-addressed visited sets fed
+    by the owner-routed all-to-all must land the exact sort-path
+    result — verdict, failing op/event, max-frontier — on clean and
+    value-corrupted histories (the order-independent pins; row order
+    and configs-stepped differ by design)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.parallel import sharded
+
+    mesh = Mesh(np.array(jax.devices()), ("frontier",))
+    failures = []
+    pin = lambda r: {k: r.get(k) for k in  # noqa: E731
+                     ("valid?", "op", "fail-event", "max-frontier",
+                      "capacity")}
+    for seed in range(max(3, N_SEEDS)):
+        # FIXED op count so compiled shapes repeat across seeds (each
+        # distinct (R, C) is a fresh XLA CPU compile of the whole
+        # sharded scan)
+        h = rand_register_history(n_ops=48, n_processes=5, n_values=3,
+                                  crash_p=0.06, fail_p=0.06,
+                                  seed=4000 + seed)
+        for variant in ("clean", "corrupt"):
+            hv = h if variant == "clean" else corrupt_history(
+                h, seed=seed, n_corruptions=2)
+            e = enc_mod.encode(CASRegister(), hv)
+            rs = sharded.check_encoded_sharded(e, mesh, capacity=512,
+                                               dedupe="sort")
+            rh = sharded.check_encoded_sharded(e, mesh, capacity=512,
+                                               dedupe="hash")
+            if pin(rs) != pin(rh) \
+                    or rh.get("configs-stepped", 0) \
+                    > rs.get("configs-stepped", 0):
+                failures.append((seed, variant, rs, rh))
     assert not failures, failures
 
 

@@ -203,8 +203,12 @@ def test_pallas_closure_under_shard_map_interpret():
         )(sel_k, b_k)
 
     # check_vma=False: pallas_call's ShapeDtypeStruct carries no vma
-    # annotation; the value check would reject it under shard_map
-    sharded_fn = jax.jit(jax.shard_map(
+    # annotation; the value check would reject it under shard_map.
+    # Routed through the engine's jax-version shim (jax.shard_map vs
+    # jax.experimental.shard_map/check_rep) like every sharded entry
+    # point.
+    from jepsen_tpu.parallel.sharded import _shard_map
+    sharded_fn = jax.jit(_shard_map(
         per_shard, mesh=mesh,
         in_specs=(P("keys"), P("keys")), out_specs=P("keys"),
         check_vma=False))
@@ -278,11 +282,18 @@ def test_batch_pallas_on_mesh_differential():
             == (True, True)
 
 
+@pytest.mark.slow
 def test_fori_closure_mode_differential():
     """The fixed-trip fori closure must be verdict- and fail-event-
     equal to the converge-and-stop while closure (its trip bound
     ceil(C/2) double-expansions is a worst-case convergence proof — a
-    wrong bound shows up here as a missed expansion on deep chains)."""
+    wrong bound shows up here as a missed expansion on deep chains).
+
+    slow-marked: ~3 minutes of k=11 adversarial + crashy-FIFO device
+    searches differentially testing an OPT-IN closure mode (fori lost
+    the r5 on-chip A/B 0.3x and stays non-default; fori correctness
+    also rides tools/perf_ab.py's gate on every measured run) — the
+    single second-largest sink in the default suite."""
     from jepsen_tpu.histories import (adversarial_register_history,
                                       rand_fifo_history)
     from jepsen_tpu.models import CASRegister, FIFOQueue

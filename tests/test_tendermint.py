@@ -951,10 +951,15 @@ def test_real_tendermint_binary_deploy_network_gated(tmp_path):
 
 
 @pytest.mark.fuzz
+@pytest.mark.slow
 def test_local_kill_soak(tmp_path):
     """Soak tier (deselected by default, like the reference's :perf
     tier): 45s of cas-register at concurrency 8 through continuous
-    SIGKILL/WAL-replay cycles. Stresses reconnect storms, indeterminate
+    SIGKILL/WAL-replay cycles. Carries BOTH markers: the fuzz mark
+    alone only deselects under the addopts default — a tier-1 style
+    `-m 'not slow'` invocation overrides addopts' `-m "not fuzz"` and
+    was silently pulling this ~200s container-flaky soak (noted flaky
+    in CHANGES.md PR 2) into every default-suite run. Stresses reconnect storms, indeterminate
     retry tainting, and WAL recovery under load far past the smoke
     e2es; the history must still check linearizable."""
     from jepsen_tpu import core as jcore

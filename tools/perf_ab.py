@@ -55,6 +55,19 @@ if _UNKNOWN:   # a typo must not silently skip a real variant
                      f"{sorted(_UNKNOWN)}; valid: while,fori,pallas")
 _VARIANTS.add("while")
 
+# PERF_AB_DEDUPE=sort,hash (default both) selects the sparse-engine
+# frontier-dedupe strategies the advisory A/B measures on the
+# single-key adversarial shapes — the one-command measurement the
+# JEPSEN_TPU_DEDUPE flip-to-default decision waits on. Same
+# skip-a-crashing-variant rationale as PERF_AB_VARIANTS; empty
+# (PERF_AB_DEDUPE=) skips the block entirely.
+_DEDUPE = [v.strip() for v in os.environ.get(
+    "PERF_AB_DEDUPE", "sort,hash").split(",") if v.strip()]
+_UNKNOWN_D = set(_DEDUPE) - {"sort", "hash"}
+if _UNKNOWN_D:
+    raise SystemExit(f"PERF_AB_DEDUPE: unknown strategy(ies) "
+                     f"{sorted(_UNKNOWN_D)}; valid: sort,hash")
+
 
 def _want(name: str) -> bool:
     return name in _VARIANTS
@@ -243,11 +256,14 @@ def main():
     bad_variants = set()       # variants that ever disagreed
 
     # ---- single-key adversarial ----
-    for L in ([200, 400] if smoke else [1000, 10000]):
+    adv_sizes = []           # the Ls measured — the dedupe A/B derates
+    for L in ([200, 400] if smoke else [1000, 10000]):  # its own shapes
         # k=11 keeps the smoke shapes inside kernel support (C >= 12)
+        k_crashed = 11 if smoke else 12
         h = adversarial_register_history(
-            n_ops=L, k_crashed=(11 if smoke else 12), seed=7)
+            n_ops=L, k_crashed=k_crashed, seed=7)
         e = enc_mod.encode(model, h)
+        adv_sizes.append(L)
         S, C = bitdense.n_states(e), max(5, e.n_slots)
         cost_table[f"single-{L}"] = _cost_entry(
             lambda up, mode: bitdense.cost_analysis_encoded(
@@ -292,6 +308,63 @@ def main():
             line["pallas_skipped"] = f"unsupported S={S} C={C}"
         bad_variants |= _disagreeing(res)
         emit(line)
+
+    # ---- sparse-engine frontier dedupe (advisory A/B) ----
+    # sort (lexsort every closure iteration) vs hash (delta-frontier
+    # closure over the device-resident visited set) on the SAME
+    # adversarial shapes, through the public engine.check_encoded with
+    # dedupe explicitly set — exactly what JEPSEN_TPU_DEDUPE would
+    # switch. The configs-stepped counters are emitted alongside the
+    # timings so the work reduction is visible even where the wall
+    # times are noise (CPU). Verdict + localization + max-frontier must
+    # agree between strategies (the counters differ by design); a
+    # mismatch vetoes the dedupe verdict like any correctness failure.
+    dedupe_ratios = {}
+    dedupe_bad = set()
+    if _DEDUPE:
+        from jepsen_tpu.parallel import engine as eng_mod
+        # shape policy: the adversarial frontier peaks at ~10*2^k
+        # configs, so full-k sparse runs cost minutes per strategy —
+        # smoke (CPU) derates to k=6 (the delta asymptotics show at
+        # any k; CI keeps the block exercised), the chip measures the
+        # bench's real k at L=1000 (the representative sparse shape;
+        # 10k at full k is tens of minutes per strategy and adds no
+        # new information to the flip decision)
+        if smoke:
+            dedupe_shapes = [(L, 6) for L in adv_sizes]
+        else:
+            dedupe_shapes = [(1000, 12)]
+        for L, k_d in dedupe_shapes:
+            e = enc_mod.encode(model, adversarial_register_history(
+                n_ops=L, k_crashed=k_d, seed=7))
+            cap = 1 << (k_d + 4)     # peak ~10*2^k configs, one tier
+            dres = {}
+            dline = {"shape": f"single-key {L}-op adversarial "
+                              f"sparse-dedupe (2^{k_d} open configs)"}
+            for strat in _DEDUPE:
+                t = _timed(dres, strat,
+                           lambda s=strat: eng_mod.check_encoded(
+                               e, capacity=cap, max_capacity=cap * 4,
+                               dedupe=s),
+                           shape=f"dedupe-{L}")
+                r0 = dres[strat][0]
+                dline[f"{strat}_secs"] = round(t, 3)
+                dline[f"{strat}_configs_stepped"] = \
+                    r0.get("configs-stepped")
+            pin = lambda r: {k_: r.get(k_) for k_ in  # noqa: E731
+                             ("valid?", "op", "fail-event",
+                              "max-frontier")}
+            base = pin(dres[_DEDUPE[0]][0])
+            for strat, runs in dres.items():
+                if any(pin(r) != base for r in runs):
+                    dline[f"{strat}_mismatch"] = True
+                    dedupe_bad.add(strat)
+            if "sort" in dres and "hash" in dres:
+                dedupe_ratios[f"single-{L}"] = \
+                    dline["sort_secs"] / max(dline["hash_secs"], 1e-9)
+                dline["hash_speedup"] = round(
+                    dedupe_ratios[f"single-{L}"], 2)
+            emit(dline)
 
     # ---- multi-key batch ----
     n_keys, ops_per_key = (8, 40) if smoke else (84, 120)
@@ -433,6 +506,9 @@ def main():
         # kernel — never let them flip the default
         verdict = "no-verdict (non-tpu backend: interpret-mode timings)"
         fori_verdict = verdict
+        dedupe_verdict = ("no-verdict (non-tpu backend: cpu timings "
+                          "don't flip defaults; the configs_stepped "
+                          "counters stand on any backend)")
     else:
         # a variant filtered out by PERF_AB_VARIANTS was not measured —
         # its verdict line must say so, never a definitive keep/flip
@@ -460,10 +536,26 @@ def main():
         if "fori" in bad_variants or "while" in bad_variants:
             fori_verdict = "keep-while (VARIANT VETOED — see the " \
                            "correctness_mismatch lines)"
+        if not ({"sort", "hash"} <= set(_DEDUPE)):
+            dedupe_verdict = ("not-measured (a strategy skipped by "
+                              "PERF_AB_DEDUPE)")
+        elif dedupe_bad:
+            dedupe_verdict = ("keep-sort (STRATEGY VETOED — see the "
+                              "*_mismatch keys on the sparse-dedupe "
+                              "lines)")
+        else:
+            dedupe_verdict = ("default-hash"
+                              if dedupe_ratios
+                              and min(dedupe_ratios.values()) >= 1.1
+                              else "keep-sort")
     emit({"backend": backend, "verdict": verdict,
           "fori_verdict": fori_verdict,
+          "dedupe_verdict": dedupe_verdict,
           "variants_measured": sorted(_VARIANTS),
+          "dedupe_measured": sorted(_DEDUPE),
           "ratios": {k: round(v, 2) for k, v in ratios.items()},
+          "dedupe_ratios": {k: round(v, 2)
+                            for k, v in dedupe_ratios.items()},
           "fori_ratios": {k: round(v, 2) for k, v in fori_ratios.items()},
           "rule": "pallas default-on iff it wins >=1.1x on EVERY "
                   "measured shape on the tpu backend AND never "
@@ -471,7 +563,11 @@ def main():
                   "likewise vs the while closure (flip "
                   "bitdense._resolve_closure_mode). If both win, "
                   "pallas takes precedence (it replaces the XLA loop "
-                  "entirely)"})
+                  "entirely). dedupe=hash flips JEPSEN_TPU_DEDUPE's "
+                  "default (engine._resolve_dedupe) under the same "
+                  ">=1.1x-on-every-shape + never-disagreed rule, "
+                  "measured on the sparse engine's sparse-dedupe "
+                  "lines above"})
 
 
 if __name__ == "__main__":
