@@ -65,6 +65,16 @@ import sys
 import threading
 from time import monotonic, perf_counter
 
+from jepsen_tpu import obs
+
+# set in child_main when JEPSEN_TPU_TRACE is on: the repo-relative path
+# this section's Chrome trace will be written to. emit() stamps it onto
+# every JSON line the section produces ("trace": <relpath>) so the
+# BENCH_* record points at the span evidence; with tracing off the key
+# is absent and the line schema is byte-for-byte the historical one
+# (pinned by tests/test_bench.py).
+TRACE_REL = None
+
 # -------- north-star multi-key shape (reference workload dimensions)
 SMOKE = os.environ.get("BENCH_SMOKE") == "1"   # tiny shapes for CI/CPU
 N_KEYS = 8 if SMOKE else 84
@@ -106,6 +116,8 @@ def sec_timeout(key: str, L: int | None = None) -> float:
 
 
 def emit(obj):
+    if TRACE_REL is not None and "trace" not in obj:
+        obj = {**obj, "trace": TRACE_REL}
     print(json.dumps(obj), flush=True)
 
 
@@ -134,9 +146,9 @@ def _adv_encoded(L):
     from jepsen_tpu.parallel import encode as enc_mod
     model = CASRegister()
     h = adversarial_register_history(n_ops=L, k_crashed=ADV_K, seed=7)
-    t0 = perf_counter()
-    e = enc_mod.encode(model, h)
-    return model, h, e, perf_counter() - t0
+    with obs.timer("bench.adv.encode", L=L) as tm:
+        e = enc_mod.encode(model, h)
+    return model, h, e, tm.wall
 
 
 # ======================= child sections ============================
@@ -175,9 +187,11 @@ def sec_multikey(label: str = None):
         for k in range(N_KEYS)]
     total_ops = N_KEYS * OPS_PER_KEY
 
-    t0 = perf_counter()
-    pre = [enc_mod.encode(model, h) for h in keys]
-    encode_secs = perf_counter() - t0
+    # obs.timer: the recorded span and the emitted seconds are the
+    # SAME clock reads — the split line and the trace cannot disagree
+    with obs.timer("bench.multikey.encode", keys=N_KEYS) as tm:
+        pre = [enc_mod.encode(model, h) for h in keys]
+    encode_secs = tm.wall
     S_max = max(bitdense.n_states(e) for e in pre)
     C_max = max(e.n_slots for e in pre)
     assert bitdense.fits_bitdense(S_max, C_max), (S_max, C_max)
@@ -185,10 +199,10 @@ def sec_multikey(label: str = None):
     # measured via the dispatch/finalize split so the JSONL carries the
     # pad+place (transfer) vs search (device) separation; their sum is
     # the same wall the old single check_batch_bitdense call measured
-    t0 = perf_counter()
-    pending = bitdense.dispatch_batch_bitdense(pre)
-    rs = pending.finalize()
-    batch_secs = perf_counter() - t0
+    with obs.timer("bench.multikey.serial", keys=N_KEYS) as tm:
+        pending = bitdense.dispatch_batch_bitdense(pre)
+        rs = pending.finalize()
+    batch_secs = tm.wall
     transfer_secs = pending.transfer_secs
     device_secs = batch_secs - transfer_secs
     assert all(r["valid?"] is True for r in rs), rs[:3]
@@ -205,11 +219,12 @@ def sec_multikey(label: str = None):
     # serialize — so measuring "parallel" wall time would just
     # re-measure one core and, on a many-core box, silently present a
     # single-core rate as the 32-core baseline.)
-    t0 = perf_counter()
-    for h in keys[:HOST_SAMPLE_KEYS]:
-        rh = linear_packed.analysis(model, h, deadline=monotonic() + 60)
-        assert rh["valid?"] is True, rh
-    host_secs = perf_counter() - t0
+    with obs.timer("bench.multikey.host", keys=HOST_SAMPLE_KEYS) as tm:
+        for h in keys[:HOST_SAMPLE_KEYS]:
+            rh = linear_packed.analysis(model, h,
+                                        deadline=monotonic() + 60)
+            assert rh["valid?"] is True, rh
+    host_secs = tm.wall
     host_rate = HOST_SAMPLE_KEYS * OPS_PER_KEY / host_secs
     host32_rate = host_rate * 32
 
@@ -254,10 +269,10 @@ def sec_multikey(label: str = None):
     from jepsen_tpu.parallel import engine, pipeline as pipe_mod
     engine.check_batch(model, keys, pipeline=True, cache=False)  # warm
     pstats = {}
-    t0 = perf_counter()
-    rs_p = engine.check_batch(model, keys, pipeline=True, cache=False,
-                              pipeline_stats=pstats)
-    pipe_secs = perf_counter() - t0
+    with obs.timer("bench.multikey.pipelined", keys=N_KEYS) as tm:
+        rs_p = engine.check_batch(model, keys, pipeline=True,
+                                  cache=False, pipeline_stats=pstats)
+    pipe_secs = tm.wall
     assert [r["valid?"] for r in rs_p] == [r["valid?"] for r in rs]
     # explicit capacity: the cached pass must measure cache hits even
     # under JEPSEN_TPU_ENCODE_CACHE=0 in the ambient env (an explicit
@@ -265,10 +280,10 @@ def sec_multikey(label: str = None):
     cache = pipe_mod.EncodeCache(max_entries=N_KEYS + 8)
     engine.check_batch(model, keys, pipeline=True, cache=cache)  # fill
     cstats = {}
-    t0 = perf_counter()
-    rs_c = engine.check_batch(model, keys, pipeline=True, cache=cache,
-                              pipeline_stats=cstats)
-    cached_secs = perf_counter() - t0
+    with obs.timer("bench.multikey.cached", keys=N_KEYS) as tm:
+        rs_c = engine.check_batch(model, keys, pipeline=True,
+                                  cache=cache, pipeline_stats=cstats)
+    cached_secs = tm.wall
     assert [r["valid?"] for r in rs_c] == [r["valid?"] for r in rs]
     assert cstats["cache"]["encodes"] == 0, cstats["cache"]
     emit({"metric": f"multi-key {N_KEYS}x{OPS_PER_KEY}-op cas-register "
@@ -298,13 +313,13 @@ def sec_adv(L: int, host_deadline: float, skip_host: bool,
 
     _, _, e, encode_secs = _adv_encoded(L)
     assert bitdense.fits_bitdense(bitdense.n_states(e), e.n_slots)
-    t0 = perf_counter()
-    r = bitdense.check_encoded_bitdense(e)      # cold (compile per R)
-    warm_secs = perf_counter() - t0
+    with obs.timer("bench.adv.cold", L=L) as tm:
+        r = bitdense.check_encoded_bitdense(e)  # cold (compile per R)
+    warm_secs = tm.wall
     tms = {}
-    t0 = perf_counter()
-    r = bitdense.check_encoded_bitdense(e, timings=tms)  # steady state
-    steady_secs = perf_counter() - t0
+    with obs.timer("bench.adv.steady", L=L) as tm:
+        r = bitdense.check_encoded_bitdense(e, timings=tms)  # steady
+    steady_secs = tm.wall
     # dev_secs keeps the HISTORICAL meaning (whole steady call — the
     # quantity the r5 artifacts recorded and the rate/speedup below
     # use); the split keys are uniform across sections: device_secs =
@@ -395,10 +410,12 @@ def sec_adv(L: int, host_deadline: float, skip_host: bool,
             engine.check_encoded(e_ab, capacity=cap,
                                  max_capacity=cap * 4,
                                  dedupe=strat)        # compile
-            t0 = perf_counter()
-            ra = engine.check_encoded(e_ab, capacity=cap,
-                                      max_capacity=cap * 4, dedupe=strat)
-            ab[strat] = {"secs": round(perf_counter() - t0, 3),
+            with obs.timer("bench.adv.dedupe_ab", L=L,
+                           strategy=strat) as tm:
+                ra = engine.check_encoded(e_ab, capacity=cap,
+                                          max_capacity=cap * 4,
+                                          dedupe=strat)
+            ab[strat] = {"secs": round(tm.wall, 3),
                          "configs_stepped": ra.get("configs-stepped"),
                          "valid": ra.get("valid?")}
         assert ab["sort"]["valid"] == ab["hash"]["valid"] is True, ab
@@ -459,10 +476,10 @@ def sec_sharded(L: int, host_est: float | None,
         # before measuring, so the steady number holds no compile
         sharded.check_encoded_sharded(e, mesh, capacity=cap,
                                       max_capacity=max_cap)
-    t0 = perf_counter()
-    r = sharded.check_encoded_sharded(e, mesh, capacity=cap,
-                                      max_capacity=max_cap)
-    dev_secs = perf_counter() - t0
+    with obs.timer("bench.sharded.steady", L=L, capacity=cap) as tm:
+        r = sharded.check_encoded_sharded(e, mesh, capacity=cap,
+                                          max_capacity=max_cap)
+    dev_secs = tm.wall
     line = {"metric": f"adversarial {L}-op via frontier-sharded engine",
             "value": round(L / dev_secs, 1), "unit": "ops/sec",
             "vs_baseline": round(host_est / dev_secs, 1)
@@ -513,9 +530,9 @@ def sec_maxlen(budget_secs: float):
         _, _, e, encode_secs = _adv_encoded(L)
         bitdense.check_encoded_bitdense(e)          # compile, uncounted
         tms = {}
-        t0 = perf_counter()
-        r = bitdense.check_encoded_bitdense(e, timings=tms)
-        dt = perf_counter() - t0
+        with obs.timer("bench.maxlen.probe", L=L) as tm:
+            r = bitdense.check_encoded_bitdense(e, timings=tms)
+        dt = tm.wall
         assert r["valid?"] is True, r
         note(f"max-length probe L={L}: {dt:.1f}s steady")
         if dt <= budget_per_run:
@@ -544,11 +561,15 @@ def sec_maxlen(budget_secs: float):
 
 # ======================= parent orchestrator =======================
 
-def run_section(argv: list, timeout: float, env_extra: dict = None):
+def run_section(argv: list, timeout: float, env_extra: dict = None,
+                trace_suffix: str = ""):
     """Spawn `python bench.py --section ...`; forward the child's
     stdout lines as they arrive, parse the JSON ones, kill on timeout.
     The ACTUAL timeout rides along as the final `--timeout` argv so
     the child can schedule its pre-kill stack dump just before it.
+    `trace_suffix` joins the child's chrome-trace filename — retries
+    MUST pass one, or the retry child would overwrite the file the
+    first attempt's already-emitted lines point at.
     Returns (parsed JSON objects, status) — status in
     {"ok", "crash", "hung"}. parsed holds whatever JSON lines arrived
     BEFORE a kill — a child can emit its result line and then hang in
@@ -556,6 +577,8 @@ def run_section(argv: list, timeout: float, env_extra: dict = None):
     harvesting those partial results."""
     cmd = [sys.executable, os.path.abspath(__file__), "--section"] + \
         [str(a) for a in argv] + ["--timeout", f"{timeout:.0f}"]
+    if trace_suffix:
+        cmd += ["--trace-suffix", trace_suffix]
     env = None
     if env_extra:
         env = dict(os.environ)
@@ -625,8 +648,9 @@ def main():
                        float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
                        * TIMEOUT_SCALE), BUDGET_SECS)
 
-    def probe_once():
-        parsed, st = run_section(["probe"], probe_to)
+    def probe_once(trace_suffix=""):
+        parsed, st = run_section(["probe"], probe_to,
+                                 trace_suffix=trace_suffix)
         probe_parsed[:] = parsed
         ok = (st == "ok"
               and any(p.get("metric") == "device pre-probe"
@@ -644,7 +668,7 @@ def main():
         # healthy-chip round as cpu-fallback over a transient blip —
         # mid-bench hangs get a retry for the same reason
         note(f"device pre-probe failed ({st}) — retrying once")
-        probe_ok, st = probe_once()
+        probe_ok, st = probe_once("retry")
     if not probe_ok:
         note(f"device pre-probe failed twice ({st}) — skipping ALL "
              f"device sections at once; straight to the labeled CPU "
@@ -675,7 +699,7 @@ def main():
             hung.append(("multikey", None))
 
     # ---------------- 2. adversarial single-key --------------------
-    def run_adv(L):
+    def run_adv(L, trace_suffix=""):
         deadline = HOST_DEADLINES[L]
         skip_host = left() < deadline + 90
         hint = ""
@@ -688,7 +712,8 @@ def main():
                 hint = prev["host_est_secs"] * (L / prev["L"])
         args = ["adv", L, deadline, int(skip_host), hint]
         parsed, st = run_section(
-            args, min(sec_timeout("adv", L), max(left(), 60)))
+            args, min(sec_timeout("adv", L), max(left(), 60)),
+            trace_suffix=trace_suffix)
         for p in parsed:
             if p.get("L") == L and p.get("value") is not None:
                 adv_results[L] = p
@@ -715,11 +740,12 @@ def main():
             if L in adv_results or left() < 120:
                 continue
             note(f"retrying hung adv L={L} (transient flake?)")
-            run_adv(L)
+            run_adv(L, trace_suffix="retry")
         elif kind == "multikey" and mk_line is None and left() > 120:
             note("retrying hung multikey section (transient flake?)")
             parsed, _ = run_section(
-                ["multikey"], min(sec_timeout("multikey"), left()))
+                ["multikey"], min(sec_timeout("multikey"), left()),
+                trace_suffix="retry")
             mk_line = next((p for p in parsed if p.get("value")), None)
 
     # ---------------- 3. sharded engine on the local mesh ----------
@@ -743,7 +769,8 @@ def main():
             # wedge doesn't, so gate the retry on a short re-probe.
             retry_ok = True
             if st == "hung":
-                probe2, p2st = run_section(["probe"], 90)
+                probe2, p2st = run_section(["probe"], 90,
+                                           trace_suffix="sharded-gate")
                 if p2st != "ok" or not any(
                         p.get("value") for p in probe2):
                     note("sharded section hung and the runtime no "
@@ -757,7 +784,8 @@ def main():
                     ["sharded", pick,
                      adv_results[pick].get("host_est_secs") or "",
                      "13"],
-                    min(sec_timeout("sharded"), left()))
+                    min(sec_timeout("sharded"), left()),
+                    trace_suffix="retry13")
 
     # ---------------- 4. max length verified @ 60s -----------------
     # the child's own probe budget sits INSIDE the kill timeout, with
@@ -915,6 +943,11 @@ def child_main(argv: list) -> None:
         i = argv.index("--timeout")
         to = float(argv[i + 1])
         argv = argv[:i] + argv[i + 2:]
+    trace_suffix = ""
+    if "--trace-suffix" in argv:
+        i = argv.index("--trace-suffix")
+        trace_suffix = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
     sec = argv[0]
     faulthandler.dump_traceback_later(max(20, to - 10), exit=False)
     from jepsen_tpu import envflags
@@ -937,24 +970,69 @@ def child_main(argv: list) -> None:
         except Exception:  # noqa: BLE001
             pass
     _enable_compile_cache()
-    if sec == "probe":
-        sec_probe()
-    elif sec == "multikey":
-        sec_multikey(argv[1] if len(argv) > 1 else None)
-    elif sec == "adv":
-        L, deadline, skip_host = int(argv[1]), float(argv[2]), \
-            bool(int(argv[3]))
-        hint = float(argv[4]) if len(argv) > 4 and argv[4] else None
-        sec_adv(L, deadline, skip_host, hint)
-    elif sec == "sharded":
-        L = int(argv[1])
-        host_est = float(argv[2]) if len(argv) > 2 and argv[2] else None
-        cap_log = int(argv[3]) if len(argv) > 3 and argv[3] else None
-        sec_sharded(L, host_est, cap_log)
-    elif sec == "maxlen":
-        sec_maxlen(float(argv[1]))
-    else:
-        raise SystemExit(f"unknown section {sec!r}")
+    global TRACE_REL
+    flusher = None
+    if obs.enabled():
+        # the pointer is computed BEFORE the section runs so every
+        # line it emits carries it; the trace itself is written after
+        # (and on a crash — partial spans still diagnose the hang).
+        # The first section arg (adv/sharded L, multikey label) joins
+        # the filename: four adv children must not overwrite each
+        # other's evidence while their lines point at it; a parent
+        # retry passes --trace-suffix for the same reason.
+        tag = "_".join([sec] + [str(a) for a in argv[1:2] if a])
+        if trace_suffix:
+            tag += "_" + trace_suffix
+        tag = "".join(ch if ch.isalnum() or ch in "-_" else "-"
+                      for ch in tag)
+        TRACE_REL = os.path.join("store", "bench_traces",
+                                 f"bench_{tag}.trace.json")
+
+        # A HUNG child never reaches the finally below — the parent's
+        # proc.kill() is SIGKILL — so flush the partial trace shortly
+        # before the kill time (alongside the faulthandler stack
+        # dump): the spans recorded so far are exactly the evidence a
+        # hang diagnosis needs, and the pointer the child already
+        # stamped on its lines must not dangle. write_chrome_trace
+        # reads a copy of the span buffer, so the normal end-of-
+        # section write below simply supersedes this one.
+        def _flush_partial():
+            try:
+                obs.write_chrome_trace(TRACE_REL)
+            except Exception:  # noqa: BLE001 — best-effort, pre-kill
+                pass
+        flusher = threading.Timer(max(10.0, to - 10.0), _flush_partial)
+        flusher.daemon = True
+        flusher.start()
+    try:
+        if sec == "probe":
+            sec_probe()
+        elif sec == "multikey":
+            sec_multikey(argv[1] if len(argv) > 1 else None)
+        elif sec == "adv":
+            L, deadline, skip_host = int(argv[1]), float(argv[2]), \
+                bool(int(argv[3]))
+            hint = float(argv[4]) if len(argv) > 4 and argv[4] else None
+            sec_adv(L, deadline, skip_host, hint)
+        elif sec == "sharded":
+            L = int(argv[1])
+            host_est = float(argv[2]) if len(argv) > 2 and argv[2] \
+                else None
+            cap_log = int(argv[3]) if len(argv) > 3 and argv[3] else None
+            sec_sharded(L, host_est, cap_log)
+        elif sec == "maxlen":
+            sec_maxlen(float(argv[1]))
+        else:
+            raise SystemExit(f"unknown section {sec!r}")
+    finally:
+        if flusher is not None:
+            # cancel() is a no-op once the timer callback is already
+            # executing — join so a section finishing right at the
+            # flush deadline can't interleave two writers on one file
+            flusher.cancel()
+            flusher.join(timeout=30)
+        if TRACE_REL is not None:
+            obs.write_chrome_trace(TRACE_REL)
 
 
 if __name__ == "__main__":

@@ -39,6 +39,10 @@ RULES: Dict[str, str] = {
     "purity-tracer-branch":
         "Python-level branch (if/while/bool cast) on a jnp/lax value "
         "inside traced code — forces a host sync or a tracer error",
+    "purity-obs-in-trace":
+        "obs.span()/timer()/metrics-registry call inside traced code — "
+        "the side effect fires once at trace time, not per execution, "
+        "so the span/counter silently lies",
     "recompile-closure-capture":
         "jax.jit created inside a function body — every call builds a "
         "fresh wrapper, so the compile cache never hits",

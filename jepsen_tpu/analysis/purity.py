@@ -16,6 +16,12 @@ jit/vmap/pmap/shard_map/pallas/lax-control-flow entry point (plus
                        intent, e.g. static index-table construction)
   purity-tracer-branch Python `if`/`while`/bool()/int()/float() on a
                        jnp/lax expression — host sync or tracer error
+  purity-obs-in-trace  obs.span()/timer()/metrics-registry use — the
+                       telemetry side effect fires ONCE at trace time
+                       (the span records the trace, the counter bumps
+                       once), then never again for any execution of
+                       the compiled program: a silently lying metric.
+                       Instrument the host seam around the jit instead.
 """
 
 from __future__ import annotations
@@ -41,6 +47,13 @@ _NUMPY_MODULES = {"numpy", "numpy.random"}
 _BANNED_BUILTINS = {"open": "file IO", "input": "stdin",
                     "print": "host stdout (use jax.debug.print)"}
 _JNP_MODULES = {"jax.numpy", "jax.lax", "jax.nn"}
+
+# the telemetry package (jepsen_tpu.obs): spans and registry metrics
+# are host-side effects — inside a trace they fire at trace time only.
+# Matched by resolved module prefix, so `from jepsen_tpu import obs`,
+# `import jepsen_tpu.obs as obs`, and `from jepsen_tpu.obs import
+# span` all flag.
+_OBS_PREFIX = "jepsen_tpu.obs"
 
 
 def _base_module(dotted: str) -> str:
@@ -98,6 +111,13 @@ def check(sf: SourceFile) -> List[Finding]:
                          f"`{dotted}` inside traced function `{fname}` "
                          f"— numpy only sees trace-time constants here; "
                          f"use jnp for anything derived from inputs")
+                elif dotted == _OBS_PREFIX \
+                        or dotted.startswith(_OBS_PREFIX + "."):
+                    emit("purity-obs-in-trace", node,
+                         f"`{dotted}` inside traced function `{fname}` "
+                         f"— spans/metrics fire at trace time, not run "
+                         f"time; instrument the host seam around the "
+                         f"jit instead")
             # banned builtins
             elif isinstance(node, ast.Call) \
                     and isinstance(node.func, ast.Name) \
@@ -107,6 +127,19 @@ def check(sf: SourceFile) -> List[Finding]:
                      f"`{node.func.id}()` "
                      f"({_BANNED_BUILTINS[node.func.id]}) inside traced "
                      f"function `{fname}`")
+            # obs primitives imported bare (`from jepsen_tpu.obs
+            # import span`): the Attribute branch can't see these —
+            # resolve the call name through the import aliases
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id not in fi.locals \
+                    and (sf.dotted(node.func) or "").startswith(
+                        _OBS_PREFIX + "."):
+                emit("purity-obs-in-trace", node,
+                     f"`{node.func.id}()` "
+                     f"(= {sf.dotted(node.func)}) inside traced "
+                     f"function `{fname}` — spans/metrics fire at "
+                     f"trace time, not run time")
             # Python-level branch on a traced value
             elif isinstance(node, (ast.If, ast.While)):
                 if _is_jnp_expr(sf, node.test):

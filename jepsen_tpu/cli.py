@@ -91,6 +91,14 @@ def base_parser(prog: str = "jepsen") -> argparse.ArgumentParser:
         help="tracing-safety & concurrency static analysis "
              "(jepsen_tpu.analysis); exit 0 clean / 1 findings / "
              "2 usage error")
+    # listed for --help discoverability only, like lint: run_cli
+    # dispatches `probe` BEFORE parsing (jepsen_tpu.probe owns its
+    # flags and the 0/1/2 healthy/wedged/no-backend exit contract)
+    pr = sub.add_parser(
+        "probe", add_help=False,
+        help="bounded device-runtime health check (subprocess "
+             "jax.devices() with timeout + retry); exit 0 healthy / "
+             "1 wedged / 2 no-backend")
     ta = sub.add_parser(
         "test-all", help="run a whole suite of tests in one go")
     common(ta)
@@ -101,7 +109,7 @@ def base_parser(prog: str = "jepsen") -> argparse.ArgumentParser:
                     help="comma-separated nemesis sweep (default: the "
                          "single --nemesis)")
     p._jepsen_subparsers = {"test": t, "analyze": a, "serve": s,
-                            "lint": li, "test-all": ta}
+                            "lint": li, "probe": pr, "test-all": ta}
     return p
 
 
@@ -268,6 +276,12 @@ def run_cli(test_fn: Optional[Callable[[Dict], Dict]] = None,
         # flags, help, and the 0/1/2 exit contract
         from jepsen_tpu import analysis
         return analysis.main(raw[1:])
+    if raw[:1] == ["probe"]:
+        # same pre-parse forwarding as lint: jepsen_tpu.probe owns its
+        # flags and the 0/1/2 healthy/wedged/no-backend contract (the
+        # r05 runbook's automation hook — see docs/observability.md)
+        from jepsen_tpu import probe
+        return probe.main(raw[1:])
     parser = base_parser(prog)
     if extend_parser is not None:
         extend_parser(parser)

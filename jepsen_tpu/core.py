@@ -143,6 +143,9 @@ def analyze(test: Dict, history: History) -> Dict:
     store: Optional[jstore.Store] = test.get("store")
     if store is not None:
         store.save_2(results)
+        # span/metric artifacts ride the same run dir as the results
+        # they describe (no-op unless JEPSEN_TPU_TRACE is on)
+        store.save_telemetry()
     test["results"] = results
     return results
 

@@ -106,6 +106,26 @@ def env_int(name: str, default: Optional[int] = None,
     return v
 
 
+def env_path(name: str, what: str = "path") -> Optional[str]:
+    """A tri-state *destination* flag: unset or ``"0"`` -> ``None``
+    (feature off), ``"1"`` -> ``""`` (feature on, caller picks the
+    default destination), anything else -> that value as a filesystem
+    path (feature on, write there). Whitespace-only values raise —
+    a stray ``JEPSEN_TPU_TRACE=" "`` must not silently create a
+    directory named after the typo. Used by the telemetry flags
+    (``JEPSEN_TPU_TRACE``, ``JEPSEN_TPU_JAX_PROFILE``)."""
+    raw = env_raw(name)
+    if raw is None or raw == "0":
+        return None
+    if raw == "1":
+        return ""
+    if not raw.strip():
+        raise EnvFlagError(
+            f"{name}={raw!r}: must be '0' (off), '1' (on, default "
+            f"destination), or a {what}")
+    return raw
+
+
 # Registry of the JEPSEN_TPU_* flags in circulation — one line per
 # flag, naming the accessor and the owning module, so the namespace
 # stays auditable in one place (the env-flag-accessor lint rule keeps
@@ -132,3 +152,11 @@ def env_int(name: str, default: Optional[int] = None,
 #                            cache capacity in entries (0 disables)
 #   JEPSEN_TPU_TEST_WEDGE    env_bool    bench — test seam simulating
 #                            a wedged PJRT runtime
+#   JEPSEN_TPU_TRACE         env_path    obs — span tracing: "0"/unset
+#                            off (a true no-op), "1" on (artifacts land
+#                            in the store run dir / bench trace dir),
+#                            <path> on + Chrome trace JSON written there
+#   JEPSEN_TPU_JAX_PROFILE   env_path    obs — wrap device dispatch in
+#                            jax.profiler.trace(<dir>) with
+#                            TraceAnnotation-named steps so host spans
+#                            line up with the TPU timeline in Perfetto

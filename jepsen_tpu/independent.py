@@ -21,6 +21,7 @@ import logging
 from typing import Callable, Iterable, Optional, Sequence
 
 from jepsen_tpu import generator as gen
+from jepsen_tpu import obs
 from jepsen_tpu.checker.core import Checker, check_safe, merge_valid
 from jepsen_tpu.history import History, Op
 from jepsen_tpu.util import bounded_pmap
@@ -314,18 +315,28 @@ class IndependentChecker(Checker):
             history = kv_history(history)
         subs = split_history(history)
         ks = list(subs)
+        obs.counter("independent.keys").inc(len(ks))
 
-        results, fallback = self._batched_device_results(test, subs)
-        if results is None:
-            pairs = bounded_pmap(
-                lambda k: (k, check_safe(
-                    self.checker, test, subs[k],
-                    {**opts,
-                     "subdirectory": list(opts.get("subdirectory", []))
-                     + [DIR, k],
-                     "history-key": k})),
-                ks)
-            results = dict(pairs)
+        with obs.span("independent.check", keys=len(ks)):
+            results, fallback = self._batched_device_results(test, subs)
+            if results is None:
+                # per-key host checks run on bounded_pmap threads:
+                # propagate the span context so each key's span nests
+                # under independent.check
+                wrap = obs.ctx_runner()
+
+                def check_key(k):
+                    with obs.span("independent.key", key=str(k)):
+                        return (k, check_safe(
+                            self.checker, test, subs[k],
+                            {**opts,
+                             "subdirectory":
+                                 list(opts.get("subdirectory", []))
+                                 + [DIR, k],
+                             "history-key": k}))
+
+                pairs = bounded_pmap(wrap(check_key), ks)
+                results = dict(pairs)
 
         self._persist(test, opts, subs, results)
         # only proven-invalid keys; "unknown" (e.g. a crashed per-key
@@ -373,20 +384,23 @@ class IndependentChecker(Checker):
             # and lets overflow keys escalate to the frontier-sharded
             # engine (engine._escalate_overflow)
             mesh = (test or {}).get("mesh")
-            rs = engine.check_batch(model, [subs[k] for k in ks],
-                                    mesh=mesh, pipeline=self.pipeline,
-                                    dedupe=self.dedupe)
+            with obs.span("independent.device_batch", keys=len(ks)):
+                rs = engine.check_batch(model, [subs[k] for k in ks],
+                                        mesh=mesh, pipeline=self.pipeline,
+                                        dedupe=self.dedupe)
             return {k: {**r, "analyzer": "jax"} for k, r in zip(ks, rs)}, None
         except EncodeError as err:
             # legitimately not device-encodable (a gset key past the
             # 31-element budget, a > 64-slot crash pile-up): the host
             # path is correct but 100-300x slower, so still say so
             reason = f"not device-encodable: {err}"
+            obs.counter("independent.device_fallbacks").inc()
             log.warning("device batch check skipped (%s) — using the "
                         "host per-key checker", reason)
             return None, reason
         except Exception as err:  # noqa: BLE001 - host path still checks
             reason = f"{type(err).__name__}: {err}"
+            obs.counter("independent.device_fallbacks").inc()
             log.warning(
                 "device batch check FAILED (%s) — falling back to the "
                 "host per-key checker; results will be correct but the "

@@ -1,0 +1,43 @@
+"""Unified telemetry for the checker pipeline: span tracing + metrics.
+
+One subsystem, three pieces (each documented in its module;
+docs/observability.md is the operator guide):
+
+  tracer    ``span("name", key=...)`` context managers — nested,
+            contextvar-propagated (incl. across the pipeline's worker
+            pool via ``ctx_runner``), wall + process CPU time, gated by
+            ``JEPSEN_TPU_TRACE`` and compiled to a no-op singleton when
+            off. ``timer`` is the always-measuring variant whose
+            recorded span and returned wall time are the same clock
+            reads — bench split lines and trace spans cannot disagree.
+  metrics   counters / gauges / histograms under stable dotted names
+            (``pipeline.cache.hits``, ``engine.configs_stepped``, ...)
+            — always on, the home for every one-off counter the
+            checker used to carry in private dicts.
+  export    Chrome trace-event JSON (opens in Perfetto, one track per
+            host thread + one per device bucket), JSONL into the store
+            run dir, an end-of-run summary table, and the
+            ``JEPSEN_TPU_JAX_PROFILE`` bridge that lines host spans up
+            with ``jax.profiler`` TPU captures.
+
+Import-safe by construction: no JAX at import time, no device init —
+engine modules import this at module scope and must survive a wedged
+PJRT runtime (the same contract as envflags).
+
+NEVER call ``obs.span(...)`` or registry methods inside jit-traced
+code: the side effect fires at trace time, once, not per execution —
+the ``purity-obs-in-trace`` lint rule enforces this mechanically.
+"""
+
+from jepsen_tpu.obs.export import (  # noqa: F401
+    chrome_trace, export_run, jsonl_events, summary, write_chrome_trace,
+    write_jsonl,
+)
+from jepsen_tpu.obs.metrics import (  # noqa: F401
+    Registry, counter, gauge, histogram, registry,
+)
+from jepsen_tpu.obs.tracer import (  # noqa: F401
+    Span, Tracer, configure, ctx_runner, current_span, device_annotation,
+    enabled, jax_profile_dir, maybe_jax_profile, reset, span, timer,
+    tracer,
+)

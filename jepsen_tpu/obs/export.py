@@ -1,0 +1,228 @@
+"""Telemetry exporters: JSONL events, Chrome trace-event JSON
+(Perfetto-openable), and the end-of-run human summary table.
+
+Chrome trace format (the ``ui.perfetto.dev`` / ``chrome://tracing``
+interchange): a JSON array of event objects. We emit complete events
+(``"ph": "X"`` with ``ts``/``dur`` in microseconds) plus ``"M"``
+metadata events naming processes and threads:
+
+  * pid 1 ("host"): one track per host thread that opened spans —
+    the encode worker pool renders as parallel lanes under the
+    pipeline's stage spans;
+  * pid 2 ("device"): one track per device bucket (synthetic spans
+    recorded via ``Tracer.add_span(track=...)`` for each chunk's
+    dispatch->finalize window — no host thread "runs" these).
+
+Open the file in Perfetto next to a ``jax.profiler`` capture of the
+same run (``JEPSEN_TPU_JAX_PROFILE``) and the host spans line up with
+the TPU timeline — docs/observability.md walks through it.
+
+The JSONL export is the machine-readable sibling: one span object per
+line (``Span.to_dict``) followed by one ``{"type": "metric", ...}``
+line per registry entry — greppable, and what the store run dir keeps
+(``telemetry.jsonl``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from jepsen_tpu.obs import metrics as _metrics
+from jepsen_tpu.obs import tracer as _tracer
+
+HOST_PID = 1
+DEVICE_PID = 2
+
+
+def chrome_trace(tr: Optional[_tracer.Tracer] = None) -> List[dict]:
+    """The trace-event array for the active (or given) tracer's spans.
+    Empty list when tracing is off — a valid trace document either
+    way."""
+    tr = tr or _tracer.tracer()
+    if tr is None:
+        return []
+    spans = tr.spans()
+    events: List[dict] = [
+        {"ph": "M", "pid": HOST_PID, "name": "process_name",
+         "args": {"name": "host"}},
+        {"ph": "M", "pid": DEVICE_PID, "name": "process_name",
+         "args": {"name": "device"}},
+    ]
+    # stable synthetic tids for device-bucket tracks, in first-seen
+    # order; host tracks use the real thread idents
+    track_tid: Dict[str, int] = {}
+    seen_threads: Dict[int, str] = {}
+    for s in spans:
+        if s.track is not None:
+            if s.track not in track_tid:
+                tid = len(track_tid) + 1
+                track_tid[s.track] = tid
+                events.append({"ph": "M", "pid": DEVICE_PID, "tid": tid,
+                               "name": "thread_name",
+                               "args": {"name": s.track}})
+            pid, tid = DEVICE_PID, track_tid[s.track]
+        else:
+            tid, tname = s.thread
+            if tid not in seen_threads:
+                seen_threads[tid] = tname
+                events.append({"ph": "M", "pid": HOST_PID, "tid": tid,
+                               "name": "thread_name",
+                               "args": {"name": tname}})
+            pid = HOST_PID
+        args = dict(s.args)
+        args["span_id"] = s.sid
+        if s.parent is not None:
+            args["parent_id"] = s.parent
+        if s.cpu:
+            args["cpu_secs"] = round(s.cpu, 6)
+        events.append({
+            "ph": "X", "pid": pid, "tid": tid, "name": s.name,
+            "cat": s.name.split(".")[0],
+            "ts": round((s.t0 - tr.epoch) * 1e6, 1),
+            "dur": round(max(s.t1 - s.t0, 0.0) * 1e6, 1),
+            "args": args,
+        })
+    return events
+
+
+def write_chrome_trace(path: str,
+                       tr: Optional[_tracer.Tracer] = None) -> str:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump(chrome_trace(tr), fh)
+    return path
+
+
+def jsonl_events(tr: Optional[_tracer.Tracer] = None,
+                 reg: Optional[_metrics.Registry] = None,
+                 snap: Optional[Dict[str, dict]] = None) -> List[dict]:
+    """``snap`` overrides the registry read — export_run passes the
+    per-run delta so artifacts describe one run, not the process."""
+    tr = tr or _tracer.tracer()
+    if snap is None:
+        snap = (reg or _metrics.registry()).snapshot()
+    out: List[dict] = []
+    if tr is not None:
+        out.extend(s.to_dict() for s in tr.spans())
+    for name, m in snap.items():
+        d = dict(m)
+        # the metric's own kind moves aside so every JSONL line keys
+        # uniformly on "type": "span" | "metric"
+        d["metric_type"] = d.pop("type")
+        out.append({"type": "metric", "name": name, **d})
+    return out
+
+
+def write_jsonl(path: str, tr: Optional[_tracer.Tracer] = None,
+                reg: Optional[_metrics.Registry] = None,
+                snap: Optional[Dict[str, dict]] = None) -> str:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as fh:
+        for obj in jsonl_events(tr, reg, snap):
+            fh.write(json.dumps(obj) + "\n")
+    return path
+
+
+def summary(tr: Optional[_tracer.Tracer] = None,
+            reg: Optional[_metrics.Registry] = None,
+            snap: Optional[Dict[str, dict]] = None) -> str:
+    """The end-of-run human table: spans aggregated by name
+    (count / total wall / mean / total CPU), then every registry
+    metric. Plain text, aligned, stable column order — the thing a
+    human reads before deciding whether to open the trace."""
+    tr = tr or _tracer.tracer()
+    if snap is None:
+        snap = (reg or _metrics.registry()).snapshot()
+    lines: List[str] = []
+    if tr is not None:
+        agg: Dict[str, list] = {}
+        for s in tr.spans():
+            a = agg.setdefault(s.name, [0, 0.0, 0.0])
+            a[0] += 1
+            a[1] += s.wall
+            a[2] += s.cpu
+        if agg:
+            lines.append(f"{'span':<28} {'count':>7} {'total_s':>10} "
+                         f"{'mean_ms':>10} {'cpu_s':>9}")
+            for name in sorted(agg):
+                n, wall, cpu = agg[name]
+                lines.append(f"{name:<28} {n:>7} {wall:>10.4f} "
+                             f"{wall / n * 1e3:>10.3f} {cpu:>9.4f}")
+    if snap:
+        if lines:
+            lines.append("")
+        lines.append(f"{'metric':<36} {'type':<10} value")
+        for name, m in snap.items():
+            if m["type"] == "counter":
+                val = str(m["value"])
+            elif m["type"] == "gauge":
+                # max None: a per-run delta where the run's own peak
+                # stayed below the process high-water (delta() doc)
+                mx = "n/a" if m["max"] is None else m["max"]
+                val = f"{m['value']} (max {mx})"
+            else:
+                val = (f"n={m['count']} total={m['total']} "
+                       f"mean={m['mean']}")
+            lines.append(f"{name:<36} {m['type']:<10} {val}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# registry state at the last export_run, so each run's artifacts carry
+# the metrics THIS run moved (counters as deltas), not the process's
+# cumulative totals — a `--test-count 3` / test-all loop analyzes
+# several runs in one process
+_last_reg_snapshot: Dict[str, dict] = {}
+
+
+def export_run(run_dir: str) -> Optional[dict]:
+    """Write the run-dir telemetry artifacts — ``telemetry.jsonl``,
+    ``trace.json`` (Chrome trace-event), ``telemetry.txt`` (summary) —
+    and, when ``JEPSEN_TPU_TRACE`` named an explicit path, the Chrome
+    trace there too. Returns the artifact paths, or None when tracing
+    is off (the registry alone does not warrant run-dir files: every
+    run would grow three artifacts nobody asked for).
+
+    Per-run semantics: the tracer's span buffer is DRAINED after the
+    export and counters are reported as deltas since the previous
+    export_run — in a process that analyzes several runs, each run
+    dir describes that run alone (and span memory stays bounded)."""
+    global _last_reg_snapshot
+    tr = _tracer.tracer()
+    if tr is None:
+        return None
+    os.makedirs(run_dir, exist_ok=True)
+    reg = _metrics.registry()
+    # ONE snapshot serves both the per-run delta and the next
+    # baseline — a counter bumped between two separate reads would
+    # vanish from both this run's artifacts and the next's
+    now = reg.snapshot()
+    run_snap = reg.delta(_last_reg_snapshot, now)
+    out = {
+        "jsonl": write_jsonl(os.path.join(run_dir, "telemetry.jsonl"),
+                             tr, snap=run_snap),
+        "trace": write_chrome_trace(os.path.join(run_dir, "trace.json"),
+                                    tr),
+    }
+    with open(os.path.join(run_dir, "telemetry.txt"), "w") as fh:
+        fh.write(summary(tr, snap=run_snap))
+    out["summary"] = os.path.join(run_dir, "telemetry.txt")
+    if tr.path:
+        # the buffer is drained per run, so one fixed destination would
+        # only ever hold the LAST run's spans in a --test-count /
+        # test-all process — run 2 onward gets a numbered sibling
+        # (t.json, t.2.json, ...) instead of silently replacing run 1
+        tr.flag_exports += 1
+        dest = tr.path
+        if tr.flag_exports > 1:
+            root, ext = os.path.splitext(tr.path)
+            dest = f"{root}.{tr.flag_exports}{ext or '.json'}"
+        out["flag_trace"] = write_chrome_trace(dest, tr)
+    _last_reg_snapshot = now
+    tr.drain()
+    return out

@@ -1,0 +1,229 @@
+"""Metrics registry: counters / gauges / histograms under stable
+dotted names.
+
+This absorbs the one-off telemetry the checker grew ad hoc —
+``pipeline_stats`` dicts, encode-cache hit/miss counters,
+``configs_stepped``, capacity-escalation retries, overflow
+re-dispatches — so every layer increments the same named metric and
+every consumer (bench split lines, the end-of-run summary table, the
+JSONL export) reads one source of truth. The naming scheme is
+``<layer>.<thing>`` (docs/observability.md lists every name in
+circulation); names are cheap to mint but MUST stay stable once a
+bench line or test reads them.
+
+Always on: a counter increment is a lock + integer add — unlike spans
+there is no trace-time cost worth gating, and the end-of-run summary
+is most useful precisely when nobody thought to enable tracing.
+``snapshot()`` / ``delta()`` give consumers a consistent point-in-time
+read; tests reset the default registry between cases via ``reset()``.
+
+Thread-safety: one lock per metric (pipeline pool threads bump cache
+counters concurrently); registry creation is double-checked under a
+registry lock so two threads minting the same name get one object.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class Counter:
+    """Monotonic count (events, retries, cache hits)."""
+
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1):
+        with self._lock:
+            self.value += n
+
+    def snapshot(self) -> dict:
+        return {"type": "counter", "value": self.value}
+
+
+class Gauge:
+    """A level (in-flight depth, bytes resident) with a high-water
+    mark — the max is what the summary table reports for depths.
+    ``nops`` counts level movements: it is how ``Registry.delta``
+    tells "this gauge moved during the window and returned to the
+    same level" apart from "nothing happened" (a value/max-only
+    snapshot cannot)."""
+
+    __slots__ = ("name", "value", "max", "nops", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+        self.max = 0
+        self.nops = 0
+        self._lock = threading.Lock()
+
+    def set(self, v):
+        with self._lock:
+            self.value = v
+            self.nops += 1
+            if v > self.max:
+                self.max = v
+
+    def inc(self, n=1):
+        with self._lock:
+            self.value += n
+            self.nops += 1
+            if self.value > self.max:
+                self.max = self.value
+
+    def dec(self, n=1):
+        with self._lock:
+            self.value -= n
+            self.nops += 1
+
+    def snapshot(self) -> dict:
+        return {"type": "gauge", "value": self.value, "max": self.max,
+                "nops": self.nops}
+
+
+class Histogram:
+    """Streaming aggregate of observations (seconds, sizes):
+    count/total/min/max — enough for the summary table and the bench
+    split lines without bucket-boundary bikeshedding."""
+
+    __slots__ = ("name", "count", "total", "vmin", "vmax", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.vmin = None
+        self.vmax = None
+        self._lock = threading.Lock()
+
+    def observe(self, v: float):
+        with self._lock:
+            self.count += 1
+            self.total += v
+            if self.vmin is None or v < self.vmin:
+                self.vmin = v
+            if self.vmax is None or v > self.vmax:
+                self.vmax = v
+
+    def snapshot(self) -> dict:
+        return {"type": "histogram", "count": self.count,
+                "total": round(self.total, 6),
+                "min": self.vmin, "max": self.vmax,
+                "mean": round(self.total / self.count, 6)
+                if self.count else None}
+
+
+class Registry:
+    """Name -> metric, minted on first use. Type collisions raise: a
+    name cannot be a counter in one layer and a gauge in another —
+    that is exactly the drift this registry exists to end."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def _get(self, name: str, cls):
+        m = self._metrics.get(name)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(name)
+                if m is None:
+                    m = cls(name)
+                    self._metrics[name] = m
+        if not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as "
+                f"{type(m).__name__}, requested {cls.__name__}")
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            ms = list(self._metrics.values())
+        return {m.name: m.snapshot() for m in
+                sorted(ms, key=lambda m: m.name)}
+
+    def delta(self, before: Dict[str, dict],
+              now: Optional[Dict[str, dict]] = None) -> Dict[str, dict]:
+        """Type-aware diff against an earlier snapshot() — how the
+        per-run export (and the bench) reports what THIS window moved
+        without resetting global state mid-run. A metric with no
+        activity in the window is omitted.
+
+        counters: the value difference. histograms: count/total
+        differences with the mean recomputed; min/max only when every
+        observation is the window's own (no prior count) — a window
+        slice of a streaming min/max is otherwise unknowable. gauges:
+        included when the level moved (``nops`` advanced), reporting
+        the current value; ``max`` carries the high-water only when
+        this window raised it, else None — the window's own peak is
+        not recoverable from level snapshots.
+
+        Pass ``now`` (a snapshot captured by the caller) to diff two
+        fixed points and reuse ``now`` as the next baseline — leaving
+        no gap for concurrent increments to fall into."""
+        if now is None:
+            now = self.snapshot()
+        out = {}
+        for name, snap in now.items():
+            prev = before.get(name)
+            if snap["type"] == "counter":
+                d = snap["value"] - (prev["value"] if prev else 0)
+                if d:
+                    out[name] = {"type": "counter", "value": d}
+            elif snap["type"] == "gauge":
+                pn = prev["nops"] if prev else 0
+                if snap["nops"] != pn:
+                    raised = prev is None or snap["max"] > prev["max"]
+                    out[name] = {"type": "gauge", "value": snap["value"],
+                                 "max": snap["max"] if raised else None,
+                                 "nops": snap["nops"] - pn}
+            else:
+                pc = prev["count"] if prev else 0
+                dc = snap["count"] - pc
+                if dc:
+                    dt = round(snap["total"]
+                               - (prev["total"] if prev else 0.0), 6)
+                    out[name] = {"type": "histogram", "count": dc,
+                                 "total": dt,
+                                 "min": snap["min"] if pc == 0 else None,
+                                 "max": snap["max"] if pc == 0 else None,
+                                 "mean": round(dt / dc, 6)}
+        return out
+
+    def reset(self):
+        with self._lock:
+            self._metrics.clear()
+
+
+_default = Registry()
+
+
+def registry() -> Registry:
+    return _default
+
+
+def counter(name: str) -> Counter:
+    return _default.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return _default.gauge(name)
+
+
+def histogram(name: str) -> Histogram:
+    return _default.histogram(name)

@@ -1,0 +1,350 @@
+"""Span tracing for the checker pipeline — Dapper-style nested spans
+(Sigelman et al., 2010) over the host phases of a check.
+
+A span is a named, attributed wall-clock + CPU-time interval:
+
+    with obs.span("pipeline.dispatch", tier=8, chunk=2):
+        ...
+
+Spans nest through a ``contextvars.ContextVar``: the span active when a
+child opens becomes its parent, which is what makes the per-key /
+per-chunk trees in the Chrome trace render as stacks. Worker-pool
+threads do not inherit contextvars automatically — the pipeline
+captures the submitting thread's context via :func:`ctx_runner` so
+spans opened on pool threads still hang off the submitting span (one
+``Context.copy()`` per task: a Context object cannot be entered by two
+threads at once).
+
+Gating: ``JEPSEN_TPU_TRACE`` via the validated accessor
+(``envflags.env_path``) — unset/``0`` disabled, ``1`` enabled,
+``<path>`` enabled + the Chrome trace additionally written there at
+export time. When DISABLED, ``span()`` returns a process-wide singleton
+no-op context manager: no span object, no clock read, no lock — the
+hot path (one call per key per stage) costs two attribute loads and a
+``None`` check, and tests/test_obs.py pins a per-call CPU budget and
+zero retained allocations. Flag changes after import are picked up via
+:func:`reset` (tests) — a real run sets the env before the process
+starts.
+
+Thread-safety: finished spans append to one lock-protected list; the
+contextvar handles per-thread currency. ``process_time()`` is
+process-wide, so a span's ``cpu`` reads "CPU seconds the process spent
+while this span was open" — comparable across spans only when the
+machine isn't oversubscribed, which is exactly how the bench uses it.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import threading
+from time import perf_counter, process_time
+from typing import Callable, Dict, List, Optional
+
+from jepsen_tpu import envflags
+
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "jepsen_tpu_obs_span", default=None)
+
+
+class Tracer:
+    """Collects finished spans for one tracing session."""
+
+    def __init__(self, path: str = ""):
+        self.path = path            # JEPSEN_TPU_TRACE=<path> ("" = none)
+        self.epoch = perf_counter()  # trace time origin (ts 0 in exports)
+        self.flag_exports = 0       # export_run count, for <path> runs
+        self._lock = threading.Lock()
+        self._spans: List["Span"] = []
+        self._ids = itertools.count(1)
+
+    def next_id(self) -> int:
+        return next(self._ids)
+
+    def record(self, span: "Span"):
+        with self._lock:
+            self._spans.append(span)
+
+    def spans(self) -> List["Span"]:
+        with self._lock:
+            return list(self._spans)
+
+    def drain(self) -> List["Span"]:
+        """Hand over the finished spans and clear the buffer — how
+        export_run keeps artifacts per-run (and memory bounded) in a
+        process that analyzes several runs (`--test-count`,
+        test-all)."""
+        with self._lock:
+            out = self._spans
+            self._spans = []
+            return out
+
+    def add_span(self, name: str, t0: float, t1: float,
+                 track: Optional[str] = None, parent: Optional[int] = None,
+                 **args) -> "Span":
+        """Record an interval measured elsewhere (e.g. a device
+        program's dispatch->finalize window) as a finished span on an
+        explicit `track` — these become the per-device-bucket rows in
+        the Chrome trace, since no host thread "runs" them."""
+        s = Span(self, name, args)
+        s.sid = self.next_id()
+        s.parent = parent
+        s.t0, s.t1 = t0, t1
+        s.cpu = 0.0
+        s.track = track if track is not None else "device"
+        s.thread = None
+        self.record(s)
+        return s
+
+
+class Span:
+    """One open (then finished) span. Context-manager protocol; also
+    usable pre-populated via Tracer.add_span. ``wall``/``cpu`` are
+    valid after ``__exit__`` — :func:`timer` exploits that to make the
+    recorded span and the caller's measured number one and the same
+    clock read."""
+
+    __slots__ = ("tracer", "name", "args", "sid", "parent", "t0", "t1",
+                 "cpu", "_cpu0", "_tok", "thread", "track")
+
+    def __init__(self, tracer: Optional[Tracer], name: str, args: dict):
+        self.tracer = tracer
+        self.name = name
+        self.args = args
+        self.sid = 0
+        self.parent = None
+        self.t0 = self.t1 = 0.0
+        self.cpu = 0.0
+        self.thread = None
+        self.track = None
+
+    def __enter__(self) -> "Span":
+        tr = self.tracer
+        if tr is not None:
+            self.sid = tr.next_id()
+            par = _current.get()
+            self.parent = par.sid if par is not None else None
+            self._tok = _current.set(self)
+        else:
+            self._tok = None
+        t = threading.current_thread()
+        self.thread = (t.ident, t.name)
+        self._cpu0 = process_time()
+        self.t0 = perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.t1 = perf_counter()
+        self.cpu = process_time() - self._cpu0
+        if self._tok is not None:
+            _current.reset(self._tok)
+        if self.tracer is not None:
+            self.tracer.record(self)
+        return False
+
+    def set(self, **kw):
+        """Attach attributes discovered mid-span (e.g. the resolved
+        capacity tier)."""
+        self.args.update(kw)
+
+    @property
+    def wall(self) -> float:
+        return self.t1 - self.t0
+
+    def to_dict(self) -> dict:
+        d = {"type": "span", "name": self.name, "id": self.sid,
+             "parent": self.parent,
+             "t0": round(self.t0, 6), "wall": round(self.wall, 6),
+             "cpu": round(self.cpu, 6)}
+        if self.thread is not None:
+            d["thread"] = self.thread[1]
+            d["tid"] = self.thread[0]
+        if self.track is not None:
+            d["track"] = self.track
+        if self.args:
+            d["args"] = dict(self.args)
+        return d
+
+
+class _NoopSpan:
+    """The disabled-path singleton: enters/exits without touching a
+    clock, a lock, or the heap. `set` swallows attributes (they were
+    built by the caller either way)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **kw):
+        pass
+
+    wall = 0.0
+    cpu = 0.0
+
+
+_NOOP = _NoopSpan()
+
+# module tracer state: None = disabled; _UNSET = not yet resolved from
+# the env (first span()/enabled() call resolves — import stays cheap
+# and monkeypatched env in tests is honored if they reset() first)
+_UNSET = object()
+_state = _UNSET
+_state_lock = threading.Lock()
+
+
+def _resolve():
+    global _state
+    with _state_lock:
+        if _state is _UNSET:
+            path = envflags.env_path("JEPSEN_TPU_TRACE",
+                                     what="trace output path")
+            _state = None if path is None else Tracer(path)
+    return _state
+
+
+def enabled() -> bool:
+    st = _state
+    if st is _UNSET:
+        st = _resolve()
+    return st is not None
+
+
+def tracer() -> Optional[Tracer]:
+    """The active Tracer, or None when tracing is off."""
+    st = _state
+    if st is _UNSET:
+        st = _resolve()
+    return st
+
+
+def span(name: str, **args):
+    """A traced interval — the hot-path entry point. Disabled: returns
+    the no-op singleton (nothing allocated beyond the caller's own
+    kwargs, nothing timed)."""
+    st = _state
+    if st is _UNSET:
+        st = _resolve()
+    if st is None:
+        return _NOOP
+    return Span(st, name, args)
+
+
+def timer(name: str, **args) -> Span:
+    """An ALWAYS-measuring interval: the context manager's
+    ``wall``/``cpu`` are valid whether tracing is on or off, and when
+    it is on the recorded span is the SAME clock reads — the mechanism
+    by which bench split lines and trace spans can never disagree
+    (one measurement site). Not for hot paths: it allocates a Span per
+    call even when disabled; use :func:`span` there."""
+    st = _state
+    if st is _UNSET:
+        st = _resolve()
+    return Span(st, name, args)
+
+
+def configure(on: bool = True, path: str = "") -> Optional[Tracer]:
+    """Programmatic gate (tests, embedding): force tracing on/off
+    regardless of the env flag. Returns the new tracer (or None)."""
+    global _state
+    with _state_lock:
+        _state = Tracer(path) if on else None
+    return _state
+
+
+def reset():
+    """Drop the session and re-resolve from the env on next use —
+    how tests flip JEPSEN_TPU_TRACE mid-process."""
+    global _state
+    with _state_lock:
+        _state = _UNSET
+
+
+def current_span() -> Optional[Span]:
+    return _current.get()
+
+
+def ctx_runner() -> Callable:
+    """Span-context propagation for worker pools. Captures the calling
+    thread's context ONCE; returns ``wrap(fn) -> fn'`` where each
+    ``fn'`` call runs under a fresh copy of that context, so spans
+    opened on the pool thread nest under the span active at capture
+    time. Disabled tracing returns the identity wrap (zero overhead).
+    One ``Context.copy()`` per call is mandatory, not defensive: a
+    Context raises if entered concurrently from two threads."""
+    if not enabled():
+        return lambda fn: fn
+    ctx = contextvars.copy_context()
+
+    def wrap(fn):
+        def run(*a, **kw):
+            return ctx.copy().run(fn, *a, **kw)
+        return run
+    return wrap
+
+
+# ------------------------------------------------- jax.profiler bridge
+
+
+def jax_profile_dir() -> Optional[str]:
+    """The JEPSEN_TPU_JAX_PROFILE directory, or None when off. "1"
+    maps to the default capture dir so the flag composes with the
+    runbook's `JEPSEN_TPU_JAX_PROFILE=1 jepsen test ...` shorthand."""
+    d = envflags.env_path("JEPSEN_TPU_JAX_PROFILE", what="profile dir")
+    if d == "":
+        return "store/jax_profile"
+    return d
+
+
+class _MaybeCtx:
+    """Context manager that delegates to a lazily-built inner context
+    (or nothing). Exists so the obs module never imports jax at import
+    time — engine modules must stay import-safe under a wedged
+    runtime."""
+
+    __slots__ = ("_factory", "_inner")
+
+    def __init__(self, factory):
+        self._factory = factory
+        self._inner = None
+
+    def __enter__(self):
+        if self._factory is not None:
+            self._inner = self._factory()
+            self._inner.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        if self._inner is not None:
+            return bool(self._inner.__exit__(*exc))
+        return False
+
+
+def maybe_jax_profile() -> _MaybeCtx:
+    """jax.profiler.trace(dir) when JEPSEN_TPU_JAX_PROFILE is set, else
+    a no-op — wraps a whole batched check so the TPU capture and the
+    host spans share a session."""
+    d = jax_profile_dir()
+    if d is None:
+        return _MaybeCtx(None)
+
+    def make():
+        import jax
+        return jax.profiler.trace(d)
+    return _MaybeCtx(make)
+
+
+def device_annotation(name: str) -> _MaybeCtx:
+    """jax.profiler.TraceAnnotation(name) when profiling is on, else a
+    no-op — names the dispatch step in the TPU timeline so host spans
+    line up with device work in Perfetto."""
+    if jax_profile_dir() is None:
+        return _MaybeCtx(None)
+
+    def make():
+        import jax
+        return jax.profiler.TraceAnnotation(name)
+    return _MaybeCtx(make)

@@ -27,6 +27,7 @@ for windows too wide to materialise (C > ~24).
 from __future__ import annotations
 
 import functools
+import logging
 
 import numpy as np
 
@@ -35,8 +36,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from jepsen_tpu import envflags
+from jepsen_tpu import obs
 from jepsen_tpu.parallel.encode import EncodedHistory
 from jepsen_tpu.parallel.steps import STEPS
+
+_log = logging.getLogger(__name__)
 
 MAX_C = 24  # 2^24 masks = 512k words per state row
 
@@ -378,10 +382,13 @@ def check_encoded_bitdense(e: EncodedHistory,
         jax.block_until_ready(xs)
         timings["transfer_secs"] = perf_counter() - t0
         t0 = perf_counter()
-    valid, fail_r = _check_bitdense(xs, jnp.int32(e.state0),
-                                    e.step_name, S, C, e.state_lo,
-                                    use_pallas, interpret, closure_mode)
-    valid_b = bool(valid)  # materializes: the device wait ends here
+    with obs.span("bitdense.check", S=S, C=C), \
+            obs.device_annotation(f"bitdense single S{S} C{C}"):
+        valid, fail_r = _check_bitdense(xs, jnp.int32(e.state0),
+                                        e.step_name, S, C, e.state_lo,
+                                        use_pallas, interpret,
+                                        closure_mode)
+        valid_b = bool(valid)  # materializes: the device wait ends here
     if timings is not None:
         timings["device_secs"] = perf_counter() - t0
     out = {"valid?": valid_b, "engine": "bitdense",
@@ -510,11 +517,20 @@ class PendingBitdenseBatch:
         self._issue()
 
     def _issue(self):
+        # the annotation names this dispatch in a jax.profiler TPU
+        # capture (JEPSEN_TPU_JAX_PROFILE) so the device timeline
+        # row lines up with the host's bitdense.dispatch span.
+        # Built OUTSIDE the try: a telemetry/env-flag error (e.g. a
+        # malformed JEPSEN_TPU_JAX_PROFILE) must surface as itself,
+        # not be misdiagnosed as a pallas closure failure
+        ann = obs.device_annotation(
+            f"bitdense K{len(self.encs)} S{self.S} C{self.C}")
         try:
-            self._valid, self._fail_r = _check_bitdense_batch(
-                self.xs, self.state0, self.encs[0].step_name, self.S,
-                self.C, self.encs[0].state_lo, self.up, self.interpret,
-                self.mode)
+            with ann:
+                self._valid, self._fail_r = _check_bitdense_batch(
+                    self.xs, self.state0, self.encs[0].step_name, self.S,
+                    self.C, self.encs[0].state_lo, self.up,
+                    self.interpret, self.mode)
         except Exception:  # noqa: BLE001 — see _fallback_or_raise
             self._fallback_or_raise()
 
@@ -543,8 +559,8 @@ class PendingBitdenseBatch:
             raise
         self.up = False
         self.mode = _resolve_closure_mode(self.closure_mode_arg, False)
-        import logging
-        logging.getLogger(__name__).warning(
+        obs.counter("bitdense.pallas_fallbacks").inc()
+        _log.warning(
             "default-path pallas closure failed on a %d-device mesh "
             "(%r) — falling back to the xla-%s closure for this "
             "batch", self.n_dev, err, self.mode)
@@ -560,18 +576,19 @@ class PendingBitdenseBatch:
     def finalize(self) -> list:
         if self._results is not None:
             return self._results
-        from time import perf_counter
-        t0 = perf_counter()
-        try:
-            # materialize inside the try: async dispatch surfaces
-            # runtime failures here, not at the issue
-            valid = np.asarray(self._valid)
-            fail_r = np.asarray(self._fail_r)
-        except Exception:  # noqa: BLE001 — same gate as at issue time
-            self._fallback_or_raise()
-            valid = np.asarray(self._valid)
-            fail_r = np.asarray(self._fail_r)
-        self.device_wait_secs = perf_counter() - t0
+        # same single-measurement-site contract as dispatch: the
+        # bitdense.finalize span IS the device_wait_secs clock reads
+        with obs.timer("bitdense.finalize", keys=len(self.encs)) as tm:
+            try:
+                # materialize inside the try: async dispatch surfaces
+                # runtime failures here, not at the issue
+                valid = np.asarray(self._valid)
+                fail_r = np.asarray(self._fail_r)
+            except Exception:  # noqa: BLE001 — same gate as at issue
+                self._fallback_or_raise()
+                valid = np.asarray(self._valid)
+                fail_r = np.asarray(self._fail_r)
+        self.device_wait_secs = tm.wall
         closure = "pallas" if self.up else f"xla-{self.mode}"
         out = []
         for k, e in enumerate(self.encs):
@@ -600,14 +617,17 @@ def dispatch_batch_bitdense(encs, mesh=None, use_pallas: bool = None,
     CHUNK of a larger bucket compiles and resolves (pallas gating
     included) at the bucket's (S, C, R) — without the R floor every
     chunk's local max n_returns would be its own compile."""
-    from time import perf_counter
-
     from jepsen_tpu.parallel.encode import pad_batch
-    t0 = perf_counter()
-    xs, state0, S, C, R = pad_batch(encs, mesh=mesh, min_slots=min_slots,
-                                    min_states=min_states,
-                                    min_returns=min_returns)
-    transfer_secs = perf_counter() - t0
+    obs.counter("bitdense.dispatches").inc()
+    # obs.timer: one clock-read pair serves both the recorded span and
+    # the transfer_secs the stats/bench lines report — they cannot
+    # disagree (the same contract bench.py rides)
+    with obs.timer("bitdense.pad_place", keys=len(encs)) as tm:
+        xs, state0, S, C, R = pad_batch(encs, mesh=mesh,
+                                        min_slots=min_slots,
+                                        min_states=min_states,
+                                        min_returns=min_returns)
+    transfer_secs = tm.wall
     # gate on where the batch actually lives: pad_batch pins it to the
     # mesh when one is given, regardless of the process default backend
     platform = (mesh.devices.flat[0].platform if mesh is not None

@@ -28,6 +28,7 @@ shards the *frontier* axis with collective dedupe for giant single keys.
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Optional
 
 import numpy as np
@@ -37,9 +38,12 @@ import jax.numpy as jnp
 from jax import lax
 
 from jepsen_tpu import envflags
+from jepsen_tpu import obs
 from jepsen_tpu.parallel import encode as enc_mod
 from jepsen_tpu.parallel.encode import EncodedHistory, EncodeError
 from jepsen_tpu.parallel.steps import STEPS
+
+_log = logging.getLogger(__name__)
 
 
 # ------------------------------------------------------------ device core
@@ -672,16 +676,22 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
     xs = _xs_from_encoded(e, device)
     state0 = _place(np.int32(e.state0), device)
     N = max(64, capacity)
-    while True:
-        valid, fail_r, overflow, maxf, steps_n, stepped = _check_device(
-            xs, state0, e.step_name, N, dedupe, probe_limit)
-        if not bool(overflow):
-            break
-        if N * 2 > max_capacity:
-            return {"valid?": "unknown",
-                    "error": f"frontier overflow at capacity {N}",
-                    "capacity": N, "dedupe": dedupe}
-        N *= 2
+    with obs.span("engine.search", returns=e.n_returns,
+                  dedupe=dedupe) as sp:
+        while True:
+            valid, fail_r, overflow, maxf, steps_n, stepped = \
+                _check_device(xs, state0, e.step_name, N, dedupe,
+                              probe_limit)
+            if not bool(overflow):
+                break
+            if N * 2 > max_capacity:
+                return {"valid?": "unknown",
+                        "error": f"frontier overflow at capacity {N}",
+                        "capacity": N, "dedupe": dedupe}
+            N *= 2
+            obs.counter("engine.capacity_escalations").inc()
+        sp.set(capacity=N)
+    obs.counter("engine.configs_stepped").inc(int(stepped))
     out = {
         "valid?": bool(valid),
         "max-frontier": int(maxf),
@@ -725,17 +735,18 @@ def analysis(model, history, capacity: int = 1024,
     from jepsen_tpu.history import History
     h = history if isinstance(history, History) else History.wrap(history)
     try:
-        if encode_cache is not None and encode_cache is not False:
-            from jepsen_tpu.parallel import pipeline as pipe_mod
-            e = pipe_mod.encode_cached(
-                model, h,
-                cache=None if encode_cache is True else encode_cache)
-        else:
-            e = enc_mod.encode(model, h)
+        with obs.span("engine.encode"):
+            if encode_cache is not None and encode_cache is not False:
+                from jepsen_tpu.parallel import pipeline as pipe_mod
+                e = pipe_mod.encode_cached(
+                    model, h,
+                    cache=None if encode_cache is True else encode_cache)
+            else:
+                e = enc_mod.encode(model, h)
     except EncodeError as err:
         from jepsen_tpu.checker import wgl
-        import logging
-        logging.getLogger(__name__).warning(
+        obs.counter("engine.host_fallbacks").inc()
+        _log.warning(
             "history not device-checkable (%s) — using the host WGL "
             "engine; expect it to be orders of magnitude slower", err)
         r = wgl.analysis(model, h)
@@ -780,11 +791,9 @@ def _disagreement_recheck(model, e: EncodedHistory, note: str) -> dict:
     searches exhaustively; the device engine's approximations — padded
     slots, packed states — are the suspect side of a disagreement). An
     over-budget recheck keeps the device verdict, tagged."""
-    import logging
     import time as _time
 
     from jepsen_tpu.checker import wgl
-    log = logging.getLogger(__name__)
     n_history = max(c.complete_index for c in e.calls) + 1
     host = wgl.check_calls(
         model, list(e.calls), n_history,
@@ -802,17 +811,21 @@ def _disagreement_recheck(model, e: EncodedHistory, note: str) -> dict:
             out["op"] = host["op"]
         return out
     if host.get("valid?") is True:
-        log.error("device engine false-invalid: %s, and the bounded "
-                  "full-host recheck says VALID — overriding the device "
-                  "verdict (this may hide a device-engine bug; please "
-                  "report the history)", note)
+        # counted, not just logged: a false-invalid override is the
+        # loudest possible device-engine signal, and the registry makes
+        # it greppable in telemetry exports across a whole run
+        obs.counter("engine.false_invalid").inc()
+        _log.error("device engine false-invalid: %s, and the bounded "
+                   "full-host recheck says VALID — overriding the device "
+                   "verdict (this may hide a device-engine bug; please "
+                   "report the history)", note)
         return {"valid?": True, "final-paths": [], "configs": [],
                 "engine-disagreement": note + "; full-host recheck says "
                                               "valid — device verdict "
                                               "overridden"}
-    log.warning("final-paths: %s; the bounded full-host recheck was "
-                "indecisive (%s) — keeping the device verdict",
-                note, host.get("error", "?"))
+    _log.warning("final-paths: %s; the bounded full-host recheck was "
+                 "indecisive (%s) — keeping the device verdict",
+                 note, host.get("error", "?"))
     return {"final-paths": [], "configs": [],
             "final-paths-note": note + "; bounded full-host recheck "
                                        "indecisive — device verdict "
@@ -859,15 +872,14 @@ def extract_final_paths(model, e: EncodedHistory, fail_r: int,
             model, e, "host re-search of the failing prefix came back "
                       "valid while the device said invalid")
 
-    import logging
-    log = logging.getLogger(__name__)
-
     def _empty(note: str) -> dict:
         # an invalid history with no paths is a loud event, same policy
         # as the device-fallback tagging in independent.py — silence
-        # here would look like "no counterexample available" by design
-        log.warning("final-paths extraction returned nothing for an "
-                    "invalid history: %s", note)
+        # here would look like "no counterexample available" by design;
+        # the counter makes it visible in the run's telemetry too
+        obs.counter("engine.final_paths_missing").inc()
+        _log.warning("final-paths extraction returned nothing for an "
+                     "invalid history: %s", note)
         return {"final-paths": [], "configs": [], "final-paths-note": note}
 
     from jepsen_tpu import models as model_ns
@@ -1110,10 +1122,13 @@ def check_batch(model, histories, capacity: int = 512,
             "check_batch: cache/pipeline_stats are pipelined-executor "
             "arguments — pass pipeline=True (or set "
             "JEPSEN_TPU_PIPELINE=1) to use them")
-    pre = [enc_mod.encode(model, h) for h in histories]
-    return check_batch_encoded(model, pre, capacity=capacity,
-                               max_capacity=max_capacity, mesh=mesh,
-                               bucket=bucket, dedupe=dedupe)
+    with obs.span("engine.check_batch", keys=len(histories),
+                  bucket=bucket), obs.maybe_jax_profile():
+        with obs.span("engine.encode_batch", keys=len(histories)):
+            pre = [enc_mod.encode(model, h) for h in histories]
+        return check_batch_encoded(model, pre, capacity=capacity,
+                                   max_capacity=max_capacity, mesh=mesh,
+                                   bucket=bucket, dedupe=dedupe)
 
 
 def _resolve_bucket(bucket: Optional[str]) -> str:
@@ -1203,14 +1218,17 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
     N = max(64, capacity)
     while pending:
         encs_t = [pre[i] for i in pending]
-        _, xs, state0 = encode_batch(model, [], encs=encs_t, mesh=mesh)
-        valid, fail_r, overflow, maxf, steps_n, stepped = \
-            _check_device_batch(xs, state0, step_name, N, dedupe)
-        valid = np.asarray(valid)
-        fail_r = np.asarray(fail_r)
-        overflow = np.asarray(overflow)
-        maxf = np.asarray(maxf)
-        stepped = np.asarray(stepped)
+        with obs.span("engine.sparse_batch", keys=len(pending),
+                      capacity=N, dedupe=dedupe):
+            _, xs, state0 = encode_batch(model, [], encs=encs_t,
+                                         mesh=mesh)
+            valid, fail_r, overflow, maxf, steps_n, stepped = \
+                _check_device_batch(xs, state0, step_name, N, dedupe)
+            valid = np.asarray(valid)
+            fail_r = np.asarray(fail_r)
+            overflow = np.asarray(overflow)
+            maxf = np.asarray(maxf)
+            stepped = np.asarray(stepped)
         retry = []
         for j, i in enumerate(pending):
             if bool(overflow[j]):
@@ -1220,6 +1238,7 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
             r = {"valid?": bool(valid[j]), "max-frontier": int(maxf[j]),
                  "capacity": N, "dedupe": dedupe,
                  "configs-stepped": int(stepped[j])}
+            obs.counter("engine.configs_stepped").inc(int(stepped[j]))
             if not r["valid?"]:
                 r.update(enc_mod.fail_op_fields(e, int(fail_r[j])))
             out[i] = r
@@ -1230,6 +1249,9 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
                 out[i] = _escalate_overflow(pre[i], N, mesh,
                                             dedupe=dedupe)
             break
+        # keys that overflowed re-dispatch at the doubled tier — the
+        # counter the capacity-retry ladder's cost is visible through
+        obs.counter("engine.overflow_redispatch").inc(len(retry))
         pending = retry
         N *= 2
     return out
@@ -1248,6 +1270,7 @@ def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh,
     set for latency/memory reasons stays meaningful. Reports which
     tier decided via "escalated". The first batch run already proved
     batch_cap overflows, so every tier starts at 2x."""
+    obs.counter("engine.capacity_escalations").inc()
     ceil_single = min(batch_cap * 4, 1 << 21)
     # pin the single tier to the caller's mesh: check_encoded on the
     # default backend would break the invariant the batch and sharded
@@ -1289,8 +1312,8 @@ def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh,
             # turn a decidable batch into a crash; but a broken sharded
             # engine must be LOUD (the same rule as independent.py's
             # device-fallback), not a buried result key
-            import logging
-            logging.getLogger(__name__).warning(
+            obs.counter("engine.escalation_errors").inc()
+            _log.warning(
                 "sharded escalation tier crashed (%r) — key left "
                 "unknown; this may hide a sharded-engine regression",
                 err)

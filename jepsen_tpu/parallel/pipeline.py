@@ -51,6 +51,7 @@ speedups until bench records the win — see docs/performance.md).
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
 import threading
 from collections import OrderedDict, deque
@@ -61,9 +62,12 @@ from typing import Optional
 
 from jepsen_tpu import envflags
 from jepsen_tpu import models as model_ns
+from jepsen_tpu import obs
 from jepsen_tpu.parallel import encode as enc_mod
 from jepsen_tpu.parallel import engine
 from jepsen_tpu.parallel.encode import EncodedHistory
+
+_log = logging.getLogger(__name__)
 
 DEFAULT_CACHE_ENTRIES = 256
 DEFAULT_CHUNK_KEYS = 32
@@ -248,8 +252,7 @@ class EncodeCache:
             # optimization; a value that won't pickle (exotic op
             # payloads) must not fail the check. But say so: silence
             # would look like the store dir works when it doesn't.
-            import logging
-            logging.getLogger(__name__).warning(
+            _log.warning(
                 "encode cache: could not persist entry %s (%r) — "
                 "in-memory cache unaffected", key, err)
 
@@ -268,8 +271,7 @@ class EncodeCache:
             e = EncodedHistory(spec=None, **payload["fields"])
         except Exception as err:  # noqa: BLE001 — a corrupt/stale
             # entry degrades to a miss, loudly
-            import logging
-            logging.getLogger(__name__).warning(
+            _log.warning(
                 "encode cache: unreadable persisted entry %s (%r) — "
                 "treating as a miss", key, err)
             return None
@@ -361,26 +363,32 @@ def encode_cached(model, history, cache: Optional[EncodeCache] = None,
     return e
 
 
-def _lookup_or_prepare(model, h, cache: Optional[EncodeCache]) -> _KeyInfo:
-    t0 = perf_counter()
-    ckey = None
-    if cache is not None:
-        ckey = encode_cache_key(model, h)
-        e = cache.get(ckey, model)
+def _lookup_or_prepare(model, h, cache: Optional[EncodeCache],
+                       key: Optional[int] = None) -> _KeyInfo:
+    # the timer runs on a pool thread; ctx_runner propagation in the
+    # executor makes it nest under the pipeline.run root span. timer,
+    # not span: the recorded span IS the prep_secs fed to
+    # pipeline_stats, so the two can never disagree.
+    e = prep = ckey = None
+    with obs.timer("pipeline.prepare", key=key) as sp:
+        if cache is not None:
+            ckey = encode_cache_key(model, h)
+            e = cache.get(ckey, model)
         if e is not None:
-            return _KeyInfo(ckey, e, None, perf_counter() - t0, True)
-    prep = enc_mod.prepare_encode(model, h)
-    return _KeyInfo(ckey, None, prep, perf_counter() - t0, False)
+            sp.set(hit=True)
+        else:
+            prep = enc_mod.prepare_encode(model, h)
+    return _KeyInfo(ckey, e, prep, sp.wall, e is not None)
 
 
-def _fill(prep, cache: Optional[EncodeCache], ckey: Optional[str]):
-    t0 = perf_counter()
-    e = enc_mod.finish_encode(prep)
-    dt = perf_counter() - t0
+def _fill(prep, cache: Optional[EncodeCache], ckey: Optional[str],
+          key: Optional[int] = None):
+    with obs.timer("pipeline.encode", key=key) as sp:
+        e = enc_mod.finish_encode(prep)
     if cache is not None:
         cache.note_encode()
         cache.put(ckey, e)
-    return e, dt
+    return e, sp.wall
 
 
 def _chunks(idxs: list, chunk_keys: int, align: int = 1) -> list:
@@ -473,6 +481,43 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
 
     from jepsen_tpu.parallel import bitdense
 
+    root = obs.span("pipeline.run", keys=K, bucket=bucket, dedupe=dedupe)
+    with root, obs.maybe_jax_profile():
+        out = _stream(model, histories, capacity, max_capacity, mesh,
+                      bucket, cache, workers, chunk_keys, depth, stats,
+                      dedupe, bitdense)
+    if c0 is not None:
+        c1 = cache.counters()
+        stats["cache"] = {k: c1[k] - c0[k] for k in
+                          ("hits", "disk_hits", "misses", "encodes")}
+        stats["cache"]["entries"] = c1["entries"]
+        # the SAME deltas feed the registry: the bench line's cache
+        # block and the telemetry export read one measurement
+        reg = obs.registry()
+        for k in ("hits", "disk_hits", "misses", "encodes"):
+            if stats["cache"][k]:
+                reg.counter(f"pipeline.cache.{k}").inc(stats["cache"][k])
+    return out
+
+
+def _stream(model, histories, capacity, max_capacity, mesh, bucket,
+            cache, workers, chunk_keys, depth, stats, dedupe,
+            bitdense) -> list:
+    """The executor body (check_batch_pipelined's docstring), under the
+    pipeline.run root span. Telemetry it feeds: pipeline.prepare /
+    pipeline.encode spans on the pool threads (nested via ctx_runner),
+    pipeline.dispatch / pipeline.finalize spans per chunk on the main
+    thread, one synthetic device-track span per chunk's in-flight
+    window (the "one track per device bucket" rows in the Chrome
+    trace), the pipeline.inflight depth gauge, and the
+    pipeline.keys/chunks counters — all from the same clock reads that
+    fill the caller-visible `stats` dict."""
+    K = len(histories)
+    reg = obs.registry()
+    reg.counter("pipeline.keys").inc(K)
+    inflight = reg.gauge("pipeline.inflight")
+    wrap = obs.ctx_runner()
+
     t_wall = perf_counter()
     out: list = [None] * K
     n_workers = workers or min(8, max(2, os.cpu_count() or 2))
@@ -481,7 +526,9 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
         # n_slots/n_states land here, so the bucketing below consumes
         # exactly what the serial path's would.
         infos = list(pool.map(
-            lambda h: _lookup_or_prepare(model, h, cache), histories))
+            wrap(lambda ih: _lookup_or_prepare(model, ih[1], cache,
+                                               key=ih[0])),
+            enumerate(histories)))
         stats["prepare_secs"] = round(perf_counter() - t_wall, 4)
 
         buckets: dict = {}
@@ -496,8 +543,8 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
         fills = {}
         for i in order:
             if infos[i].enc is None:
-                fills[i] = pool.submit(_fill, infos[i].prep, cache,
-                                       infos[i].ckey)
+                fills[i] = pool.submit(wrap(_fill), infos[i].prep,
+                                       cache, infos[i].ckey, i)
 
         def enc_of(i):
             info = infos[i]
@@ -512,8 +559,23 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
         bstats: list = []
 
         def drain_one():
-            chunk_idxs, pb, bstat = pending.popleft()
-            rs = pb.finalize()
+            chunk_idxs, pb, bstat, chunk_no, t_issue = pending.popleft()
+            with obs.span("pipeline.finalize", tier=bstat["tier"],
+                          chunk=chunk_no, keys=len(chunk_idxs)):
+                rs = pb.finalize()
+            inflight.set(len(pending))
+            tr = obs.tracer()
+            if tr is not None:
+                # the chunk's whole in-flight window on a per-bucket
+                # device track: issue -> results materialized. An
+                # approximation of device occupancy (JAX async dispatch
+                # hides the exact kernel window; the jax.profiler
+                # capture has ground truth), but the right shape for
+                # seeing overlap in Perfetto.
+                tr.add_span("device.search", t_issue, perf_counter(),
+                            track=f"bucket-{bstat['tier']}",
+                            chunk=chunk_no, keys=len(chunk_idxs),
+                            engine=bstat["engine"])
             bstat["transfer_secs"] += pb.transfer_secs
             bstat["device_wait_secs"] += pb.device_wait_secs
             for i, r in zip(chunk_idxs, rs):
@@ -541,11 +603,18 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
                     # R floor matters most, since per-chunk local
                     # maxima would otherwise make every chunk its own
                     # compile
-                    pb = bitdense.dispatch_batch_bitdense(
-                        sub, mesh=mesh, min_states=S_max,
-                        min_slots=max(5, C_max), min_returns=R_max)
-                    pending.append((chunk, pb, bstat))
+                    t_issue = perf_counter()
+                    with obs.span("pipeline.dispatch", tier=tier,
+                                  chunk=bstat["chunks"],
+                                  keys=len(chunk)):
+                        pb = bitdense.dispatch_batch_bitdense(
+                            sub, mesh=mesh, min_states=S_max,
+                            min_slots=max(5, C_max), min_returns=R_max)
+                    pending.append((chunk, pb, bstat, bstat["chunks"],
+                                    t_issue))
                     bstat["chunks"] += 1
+                    reg.counter("pipeline.chunks").inc()
+                    inflight.set(len(pending))
                     while len(pending) >= depth:
                         drain_one()
             else:
@@ -555,10 +624,13 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
                 # overlaps any earlier chunks left in flight)
                 bstat["engine"] = "sparse"
                 bstat["chunks"] = 1
+                reg.counter("pipeline.chunks").inc()
                 sub = [enc_of(i) for i in idxs]
-                rs = engine._check_batch_sparse(model, sub, capacity,
-                                                max_capacity, mesh,
-                                                dedupe=dedupe)
+                with obs.span("pipeline.sparse", tier=tier,
+                              keys=len(idxs)):
+                    rs = engine._check_batch_sparse(model, sub, capacity,
+                                                    max_capacity, mesh,
+                                                    dedupe=dedupe)
                 for i, r in zip(idxs, rs):
                     out[i] = r
         while pending:
@@ -570,12 +642,12 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
             bstat["transfer_secs"] = round(bstat["transfer_secs"], 4)
             bstat["device_wait_secs"] = round(
                 bstat["device_wait_secs"], 4)
+            # per-bucket split -> registry histograms: the telemetry
+            # export reports the same numbers the stats dict carries
+            for key in ("encode_secs", "transfer_secs",
+                        "device_wait_secs"):
+                reg.histogram(f"pipeline.{key}").observe(bstat[key])
 
     stats["buckets"] = bstats
     stats["e2e_secs"] = round(perf_counter() - t_wall, 4)
-    if c0 is not None:
-        c1 = cache.counters()
-        stats["cache"] = {k: c1[k] - c0[k] for k in
-                          ("hits", "disk_hits", "misses", "encodes")}
-        stats["cache"]["entries"] = c1["entries"]
     return out

@@ -41,6 +41,7 @@ DCN latency punishes message count, not bytes. CI exercises this on
 from __future__ import annotations
 
 import functools
+import logging
 
 import numpy as np
 
@@ -49,12 +50,15 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from jepsen_tpu import obs
 from jepsen_tpu.parallel.encode import EncodedHistory
 from jepsen_tpu.parallel.engine import (_PROBE_LIMIT, _empty_table,
                                         _hash_insert, _next_pow2,
                                         _resolve_dedupe, _slot_bits,
                                         _xs_from_encoded)
 from jepsen_tpu.parallel.steps import STEPS
+
+_log = logging.getLogger(__name__)
 
 AXIS = "frontier"
 
@@ -551,8 +555,7 @@ def check_encoded_sharded_resumable(e: EncodedHistory, mesh: Mesh,
         return {"valid?": True, "max-frontier": 0, "capacity": 0}
     devs = np.asarray(mesh.devices)
     if devs.ndim == 2 and devs.shape[0] > 1 and devs.shape[1] > 1:
-        import logging
-        logging.getLogger(__name__).warning(
+        _log.warning(
             "resumable sharded check flattens the 2-D mesh to the flat "
             "1-D exchange — the hierarchical multi-slice routing of "
             "check_encoded_sharded is not used on this path")
@@ -682,23 +685,34 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
     xs = _xs_from_encoded(e, device=rep)
     state0 = jax.device_put(np.int32(e.state0), rep)
     N = max(64 * n_dev, capacity)
-    while True:
-        Nd = (N + n_dev - 1) // n_dev
-        if hier:
-            valid, fail_r, overflow, maxf, stepped = _check_sharded2d(
-                xs, state0, e.step_name, Nd, n_slice, n_chip, mesh,
-                dedupe)
-        else:
-            valid, fail_r, overflow, maxf, stepped = _check_sharded(
-                xs, state0, e.step_name, Nd, n_dev, mesh, exchange,
-                dedupe)
-        if not bool(overflow):
-            break
-        if N * 2 > max_capacity:
-            return {"valid?": "unknown",
-                    "error": f"frontier overflow at capacity {N}",
-                    "capacity": N, "dedupe": dedupe}
-        N *= 2
+    with obs.span("sharded.search", devices=n_dev, dedupe=dedupe,
+                  returns=e.n_returns) as sp:
+        while True:
+            Nd = (N + n_dev - 1) // n_dev
+            # one span per capacity-tier attempt, per-device capacity
+            # attached — the escalation ladder renders as widening
+            # steps in the trace
+            with obs.span("sharded.tier", capacity=N, per_device=Nd), \
+                    obs.device_annotation(f"sharded N{N} D{n_dev}"):
+                if hier:
+                    valid, fail_r, overflow, maxf, stepped = \
+                        _check_sharded2d(xs, state0, e.step_name, Nd,
+                                         n_slice, n_chip, mesh, dedupe)
+                else:
+                    valid, fail_r, overflow, maxf, stepped = \
+                        _check_sharded(xs, state0, e.step_name, Nd,
+                                       n_dev, mesh, exchange, dedupe)
+                overflow = bool(overflow)
+            if not overflow:
+                break
+            if N * 2 > max_capacity:
+                return {"valid?": "unknown",
+                        "error": f"frontier overflow at capacity {N}",
+                        "capacity": N, "dedupe": dedupe}
+            N *= 2
+            obs.counter("engine.capacity_escalations").inc()
+        sp.set(capacity=N)
+    obs.counter("engine.configs_stepped").inc(int(stepped))
     out = {"valid?": bool(valid), "max-frontier": int(maxf),
            "capacity": N, "devices": n_dev, "dedupe": dedupe,
            "configs-stepped": int(stepped)}
@@ -727,8 +741,8 @@ def analysis(model, history, mesh: Mesh, capacity: int = 8192,
         # same host fallback as engine.analysis — the two entry points
         # must be interchangeable for non-packable inputs
         from jepsen_tpu.checker import wgl
-        import logging
-        logging.getLogger(__name__).warning(
+        obs.counter("engine.host_fallbacks").inc()
+        _log.warning(
             "history not device-checkable (%s) — using the host WGL "
             "engine; expect it to be orders of magnitude slower", err)
         r = wgl.analysis(model, h)

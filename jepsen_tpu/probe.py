@@ -1,0 +1,151 @@
+"""`jepsen probe` — bounded device-runtime health check.
+
+The r05 chip outage (PROBES_r05.log) was diagnosed with a hand-rolled
+loop: spawn ``jax.devices()`` in a throwaway subprocess under a
+timeout, because a wedged PJRT runtime blocks FOREVER inside
+``make_c_api_client`` with no Python-level signal delivery — the probe
+process takes the hang, never the operator's shell. This module is
+that loop as a first-class subcommand, emitting the same verdict-line
+format the runbook used by hand:
+
+    2026-07-31T03:46:32Z probe: HEALTHY — jax.devices() -> ['tpu'] in 2.5s (tpu platform)
+    2026-07-31T02:18:07Z probe: hung past 100s (attempt 1/3)
+    2026-07-31T02:28:00Z probe: WEDGED — all 3 attempts hung past 100s
+
+Exit contract (the runbook's automation hook):
+
+    0  healthy     jax.devices() answered within the timeout
+    1  wedged      every attempt hung past the timeout (the r05
+                   signature: runtime up but unreachable)
+    2  no-backend  the child ran but failed (no devices / import error
+                   / plugin crash) — a different failure class: retries
+                   won't help, fix the environment
+
+Usage: ``jepsen probe [--timeout 100] [--retries 3] [--interval 30]``
+(also ``python -m jepsen_tpu.probe``). The parent never imports jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime as _dt
+import subprocess
+import sys
+import time
+from typing import Optional, Sequence
+
+EXIT_HEALTHY = 0
+EXIT_WEDGED = 1
+EXIT_NO_BACKEND = 2
+
+# the child: honor JAX_PLATFORMS via jax.config too (the axon plugin's
+# backend hook ignores the env var alone — same pinning as bench.py),
+# then enumerate devices and print one machine-parseable line
+_CHILD_CODE = (
+    "import json, os, sys\n"
+    "import jax\n"
+    "p = os.environ.get('JAX_PLATFORMS')\n"
+    "if p:\n"
+    "    jax.config.update('jax_platforms', p)\n"
+    "ds = jax.devices()\n"
+    "print('JEPSEN_PROBE ' + json.dumps(sorted({d.platform for d in ds})"
+    " + [len(ds)]))\n"
+)
+
+
+def _now() -> str:
+    return _dt.datetime.now(_dt.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+
+
+def _emit(msg: str, out=None):
+    print(f"{_now()} probe: {msg}", file=out or sys.stdout, flush=True)
+
+
+def probe_once(timeout: float) -> dict:
+    """One bounded ``jax.devices()`` child. Returns
+    {"status": "healthy"|"hung"|"failed", ...}: healthy carries
+    platforms/n_devices/secs, failed carries rc + a stderr tail."""
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run([sys.executable, "-c", _CHILD_CODE],
+                              capture_output=True, text=True,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired:
+        # subprocess.run kills the child on timeout (SIGKILL after
+        # terminate) — the hang dies with it, as the runbook's manual
+        # `kill -9` did
+        return {"status": "hung", "secs": time.monotonic() - t0}
+    secs = time.monotonic() - t0
+    if proc.returncode == 0:
+        for ln in proc.stdout.splitlines():
+            if ln.startswith("JEPSEN_PROBE "):
+                import json
+                payload = json.loads(ln[len("JEPSEN_PROBE "):])
+                return {"status": "healthy", "secs": secs,
+                        "platforms": payload[:-1],
+                        "n_devices": payload[-1]}
+    return {"status": "failed", "secs": secs, "rc": proc.returncode,
+            "err": (proc.stderr or proc.stdout).strip()[-300:]}
+
+
+def run_probe(timeout: float = 100.0, retries: int = 3,
+              interval: float = 0.0, out=None) -> int:
+    """The retry loop: probe until healthy or attempts run out,
+    emitting one verdict line per attempt (PROBES_r05.log format) and
+    a final summary line. Returns the exit code."""
+    retries = max(1, retries)
+    for attempt in range(1, retries + 1):
+        r = probe_once(timeout)
+        if r["status"] == "healthy":
+            plats = r["platforms"]
+            _emit(f"HEALTHY — jax.devices() -> {plats} in "
+                  f"{r['secs']:.1f}s ({'/'.join(plats)} platform, "
+                  f"{r['n_devices']} device(s))", out)
+            return EXIT_HEALTHY
+        if r["status"] == "hung":
+            _emit(f"hung past {timeout:.0f}s "
+                  f"(attempt {attempt}/{retries})", out)
+        else:
+            # a child that RAN and failed is not a wedge — retrying
+            # cannot help (no plugin, no devices, import error), so
+            # don't burn the operator's time on the remaining attempts
+            _emit(f"NO BACKEND — jax.devices() failed rc={r['rc']} "
+                  f"in {r['secs']:.1f}s ({r['err'].splitlines()[-1] if r['err'] else '?'})",
+                  out)
+            return EXIT_NO_BACKEND
+        if attempt < retries and interval > 0:
+            time.sleep(interval)
+    _emit(f"WEDGED — all {retries} attempt(s) hung past "
+          f"{timeout:.0f}s (the PJRT make_c_api_client wedge "
+          f"signature; see PROBES_r05.log / docs/observability.md)",
+          out)
+    return EXIT_WEDGED
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="jepsen probe",
+        description="bounded device-runtime health check: subprocess "
+                    "jax.devices() with timeout + retry; exit 0 "
+                    "healthy / 1 wedged / 2 no-backend")
+    p.add_argument("--timeout", type=float, default=100.0,
+                   help="seconds before one attempt counts as hung "
+                        "(default: 100, the r05 runbook's bound)")
+    p.add_argument("--retries", type=int, default=3,
+                   help="attempts before the WEDGED verdict")
+    p.add_argument("--interval", type=float, default=0.0,
+                   help="seconds between attempts")
+    try:
+        args = p.parse_args(list(argv) if argv is not None else None)
+    except SystemExit as e:
+        # argparse exits 2 on usage errors, which collides with the
+        # no-backend code — keep --help at 0 and map misuse to the
+        # CLI's bad-args convention via a distinct code
+        return 0 if e.code in (0, None) else 254
+    return run_probe(timeout=args.timeout, retries=args.retries,
+                     interval=args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

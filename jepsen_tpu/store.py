@@ -102,6 +102,16 @@ class Store:
         self.write_file(["results.json"],
                         json.dumps(results, indent=2, default=str))
 
+    def save_telemetry(self) -> Optional[Dict]:
+        """Telemetry artifacts when JEPSEN_TPU_TRACE is on:
+        telemetry.jsonl (spans + metrics), trace.json (Chrome
+        trace-event — opens in Perfetto), telemetry.txt (the summary
+        table). A no-op (returns None) when tracing is off — runs must
+        not grow artifacts nobody asked for. Called by core.run /
+        core.analyze after save_2; safe to call again (overwrites)."""
+        from jepsen_tpu import obs
+        return obs.export_run(self.dir)
+
     # ---------------------------------------------------------- logging
     def start_logging(self) -> logging.Logger:
         """Console + per-run jepsen.log (store.clj:399-439)."""

@@ -1,0 +1,51 @@
+"""Lint fixture: obs telemetry calls inside traced code. NEVER
+imported — parsed by tests/test_lint.py only (line numbers below are
+asserted there; edit with care)."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from jepsen_tpu import obs
+from jepsen_tpu.obs import span
+
+
+def traced_helper(x):
+    # reachable from the jitted root below
+    obs.counter("engine.bad").inc()       # line 15: purity-obs-in-trace
+    return x + 1
+
+
+@jax.jit
+def traced_root(x):
+    with obs.span("engine.step"):         # line 21: purity-obs-in-trace
+        y = traced_helper(x)
+    with span("bare.import"):             # line 23: purity-obs-in-trace
+        y = y * 2
+    obs.registry().gauge("g").set(1)      # line 25: purity-obs-in-trace
+    return y
+
+
+def scan_user(xs):
+    def body(carry, x):
+        obs.histogram("h").observe(1.0)   # line 31: purity-obs-in-trace
+        return carry + x, x
+
+    return lax.scan(body, jnp.float32(0), xs)
+
+
+def suppressed_trace_constant(x):
+    @jax.jit
+    def inner(y):  # jepsen-lint: disable=purity-obs-in-trace,recompile-closure-capture
+        obs.counter("deliberate").inc()
+        return y
+
+    return inner(x)
+
+
+def host_side_is_fine(model, xs):
+    # NOT under any trace entry: spans/metrics here are the intended
+    # pattern and must not flag
+    with obs.span("engine.search", keys=len(xs)):
+        obs.counter("engine.keys").inc(len(xs))
+        return [model(x) for x in xs]

@@ -265,7 +265,76 @@ def test_sharded_section_line_carries_dedupe_schema(monkeypatch,
         assert k in line, line
     assert line["dedupe"] == "hash"
     assert line["configs_stepped"] == 12345
+    # the telemetry schema pin: with tracing OFF (the default here) the
+    # line carries NO trace pointer — the split-line contract is
+    # byte-for-byte the historical one
+    assert "trace" not in line, line
     importlib.reload(bench)
+
+
+def test_bench_emit_trace_pointer_gated_on_tracing(monkeypatch,
+                                                   capsys):
+    """Sections stamp `trace=<relpath>` onto their JSON lines exactly
+    when tracing is on (TRACE_REL set by child_main): the pointer
+    appears on every line of a traced section and on none of an
+    untraced one, and never clobbers an explicit key."""
+    import bench
+
+    bench_line = {"metric": "m", "value": 1.0, "unit": "ops/sec",
+                  "vs_baseline": None}
+    monkeypatch.setattr(bench, "TRACE_REL", None)
+    bench.emit(dict(bench_line))
+    off = _json_lines(capsys.readouterr().out)[0]
+    assert "trace" not in off
+    rel = "store/bench_traces/bench_adv.trace.json"
+    monkeypatch.setattr(bench, "TRACE_REL", rel)
+    bench.emit(dict(bench_line))
+    on = _json_lines(capsys.readouterr().out)[0]
+    assert on["trace"] == rel
+    # identical schema otherwise
+    assert {k: v for k, v in on.items() if k != "trace"} == off
+
+
+def test_bench_child_trace_suffix_and_crash_write(tmp_path):
+    """A retry child's chrome trace lands at a `_<suffix>`-suffixed
+    filename (so a retry can never overwrite the file the first
+    attempt's emitted lines point at), and the trace is written even
+    when the section body raises — the finally-block export."""
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env.update({"JAX_PLATFORMS": "cpu", "JEPSEN_TPU_TRACE": "1",
+                "PYTHONPATH": REPO})
+    r = subprocess.run(
+        [sys.executable, BENCH, "--section", "nosuch",
+         "--timeout", "60", "--trace-suffix", "retry"],
+        capture_output=True, text=True, env=env, cwd=tmp_path,
+        timeout=120)
+    assert r.returncode != 0           # unknown section exits nonzero
+    trace = tmp_path / "store" / "bench_traces" / \
+        "bench_nosuch_retry.trace.json"
+    assert trace.is_file(), (r.stdout, r.stderr)
+    assert isinstance(json.loads(trace.read_text()), list)
+
+
+def test_run_section_threads_trace_suffix(monkeypatch, capsys):
+    """run_section forwards trace_suffix to the child argv as
+    `--trace-suffix <s>` (and omits the flag entirely when empty) —
+    the parent-side half of the retry-filename contract."""
+    import bench
+
+    cmds = []
+
+    def fake_popen(cmd, **kw):
+        cmds.append(cmd)
+        raise OSError("not really spawning")
+
+    monkeypatch.setattr(bench.subprocess, "Popen", fake_popen)
+    bench.run_section(["multikey"], 60, trace_suffix="retry")
+    bench.run_section(["multikey"], 60)
+    capsys.readouterr()
+    i = cmds[0].index("--trace-suffix")
+    assert cmds[0][i + 1] == "retry"
+    assert "--trace-suffix" not in cmds[1]
 
 
 def test_prior_onchip_headline_orders_by_round_number(tmp_path,

@@ -58,6 +58,35 @@ def test_purity_fixture_findings_with_anchors():
                for f in fs)
 
 
+def test_obs_in_trace_fixture_findings_with_anchors():
+    """The telemetry-purity rule: obs.span()/registry calls inside
+    traced code flag (module-attribute, bare-import, and nested-scan
+    forms), carry the usual suppression escape, and never fire on the
+    host-side instrumentation pattern."""
+    fs = _lint("obs_viol.py")
+    assert _anchors(fs, "purity-obs-in-trace") == [
+        (15, False), (21, False), (23, False), (25, False),
+        (31, False), (40, True)]
+    # the host-side span/counter block at the bottom stays clean
+    assert not any(f.line > 45 and f.rule == "purity-obs-in-trace"
+                   for f in fs)
+
+
+def test_obs_in_trace_repo_sweep_green():
+    """The instrumented engine files carry obs calls on the HOST side
+    only — the new rule must not fire on the production tree (that is
+    the PR's own acceptance: instrumentation never leaked into a
+    trace)."""
+    for rel in ("jepsen_tpu/parallel/engine.py",
+                "jepsen_tpu/parallel/bitdense.py",
+                "jepsen_tpu/parallel/sharded.py",
+                "jepsen_tpu/parallel/pipeline.py"):
+        fs = analysis.lint_file(os.path.join(REPO, rel), REPO)
+        bad = [f for f in fs if f.rule == "purity-obs-in-trace"
+               and not f.suppressed]
+        assert bad == [], "\n".join(f.format() for f in bad)
+
+
 # ---------------------------------------------------------- recompile
 
 

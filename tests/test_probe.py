@@ -1,0 +1,116 @@
+"""Tests for `jepsen probe` (jepsen_tpu.probe) — the r05 runbook's
+hand-rolled device-health loop as a first-class subcommand.
+
+The wedge and no-backend paths are driven by swapping the child code
+(the same seam the runbook's real failures exercised: a child that
+never answers vs a child that errors), so no TPU — and no actual
+100-second wait — is needed. The healthy path runs the REAL child
+pinned to the CPU backend."""
+
+import io
+import re
+from unittest import mock
+
+import pytest
+
+from jepsen_tpu import probe
+
+# one verdict line per attempt, PROBES_r05.log format: utc timestamp,
+# "probe:", verdict text
+_LINE = re.compile(r"^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}Z probe: ")
+
+
+def _run(out, **kw):
+    buf = io.StringIO()
+    rc = probe.run_probe(out=buf, **kw)
+    lines = buf.getvalue().splitlines()
+    assert lines and all(_LINE.match(ln) for ln in lines), lines
+    out.extend(lines)
+    return rc
+
+
+def test_probe_healthy_on_cpu(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    lines = []
+    rc = _run(lines, timeout=120.0, retries=1)
+    assert rc == probe.EXIT_HEALTHY == 0
+    assert "HEALTHY" in lines[-1] and "jax.devices()" in lines[-1]
+    assert "cpu" in lines[-1]
+
+
+def test_probe_wedged_exhausts_retries_and_exits_1():
+    with mock.patch.object(probe, "_CHILD_CODE",
+                           "import time; time.sleep(3600)"):
+        lines = []
+        rc = _run(lines, timeout=0.8, retries=2)
+    assert rc == probe.EXIT_WEDGED == 1
+    hung = [ln for ln in lines if "(attempt " in ln]
+    assert len(hung) == 2                       # one line per attempt
+    assert "attempt 1/2" in hung[0] and "attempt 2/2" in hung[1]
+    assert "WEDGED" in lines[-1]
+
+
+def test_probe_no_backend_fails_fast_without_retries():
+    """A child that RAN and failed is a different failure class:
+    retrying cannot help, so the loop must stop after one attempt."""
+    with mock.patch.object(probe, "_CHILD_CODE",
+                           "raise RuntimeError('no plugin')") as _, \
+            mock.patch.object(probe, "probe_once",
+                              wraps=probe.probe_once) as spy:
+        lines = []
+        rc = _run(lines, timeout=30.0, retries=3)
+    assert rc == probe.EXIT_NO_BACKEND == 2
+    assert spy.call_count == 1
+    assert "NO BACKEND" in lines[-1]
+    assert "no plugin" in lines[-1]
+
+
+def test_probe_recovers_mid_loop():
+    """hung-then-healthy (the r05 03:46Z recovery): the loop keeps
+    probing and the final verdict is HEALTHY / 0."""
+    calls = {"n": 0}
+    real = probe.probe_once
+
+    def flaky(timeout):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return {"status": "hung", "secs": timeout}
+        return {"status": "healthy", "secs": 1.2,
+                "platforms": ["tpu"], "n_devices": 4}
+
+    with mock.patch.object(probe, "probe_once", flaky):
+        lines = []
+        rc = _run(lines, timeout=5.0, retries=3)
+    assert rc == 0
+    assert "hung past" in lines[0] and "HEALTHY" in lines[-1]
+    assert "4 device(s)" in lines[-1]
+    assert real is probe.probe_once is not flaky or True
+
+
+def test_probe_cli_dispatch(monkeypatch):
+    """`jepsen probe ...` forwards pre-parse like lint, honoring the
+    probe module's own flags and exit contract."""
+    from jepsen_tpu import cli
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert cli.main(["probe", "--timeout", "120", "--retries", "1"]) == 0
+    # usage errors map to the CLI's bad-args convention, not exit 2
+    # (which means no-backend here)
+    assert probe.main(["--not-a-flag"]) == 254
+
+
+@pytest.mark.parametrize("argv,expect", [
+    (["--timeout", "7.5", "--retries", "2", "--interval", "1"],
+     (7.5, 2, 1.0)),
+    ([], (100.0, 3, 0.0)),
+])
+def test_probe_flag_parsing(argv, expect, monkeypatch):
+    seen = {}
+
+    def fake(timeout, retries, interval):
+        seen.update(timeout=timeout, retries=retries, interval=interval)
+        return 0
+
+    monkeypatch.setattr(probe, "run_probe", fake)
+    assert probe.main(argv) == 0
+    assert (seen["timeout"], seen["retries"], seen["interval"]) == expect

@@ -33,10 +33,11 @@ from __future__ import annotations
 import json
 import os
 import sys
-from time import perf_counter
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+from jepsen_tpu import obs  # noqa: E402  (sys.path bootstrap above)
 
 SMOKE = os.environ.get("BENCH_SMOKE") == "1"
 REPEATS = 3
@@ -110,13 +111,20 @@ def _cost_entry(lower_one, pallas_ok: bool, scan_events: int,
     return cost
 
 
-def _steady(fn):
+def _steady(fn, shape: str = "", variant: str = ""):
+    """Best-of-REPEATS steady wall time, measured through obs.timer so
+    the recorded spans (with shape/variant attrs) and the emitted
+    numbers are the same clock reads — run with JEPSEN_TPU_TRACE=1 and
+    the measurement session itself opens in Perfetto. The best-of is
+    also fed to the perf_ab.steady_secs registry histogram."""
     fn()                                    # cold: compile + warm cache
     best = float("inf")
     for _ in range(REPEATS):
-        t0 = perf_counter()
-        fn()
-        best = min(best, perf_counter() - t0)
+        with obs.timer("perf_ab.run", shape=shape,
+                       variant=variant) as tm:
+            fn()
+        best = min(best, tm.wall)
+    obs.histogram("perf_ab.steady_secs").observe(best)
     return best
 
 
@@ -143,7 +151,7 @@ def _timed(res: dict, name: str, check, shape: str = "") -> float:
     measured ratios."""
     def f():
         res.setdefault(name, []).append(check())
-    t = _steady(f)
+    t = _steady(f, shape=shape, variant=name)
     if PROFILE_DIR:
         try:
             import jax
