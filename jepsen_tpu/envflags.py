@@ -144,6 +144,22 @@ def env_path(name: str, what: str = "path") -> Optional[str]:
 #                            device-resident hash visited-set, also
 #                            sharded by owner in parallel.sharded);
 #                            opt-in until bench records a win
+#   JEPSEN_TPU_SPARSE_PALLAS env_bool    parallel.engine — fuse the
+#                            hash dedupe path into the VMEM-resident
+#                            pallas frontier kernel
+#                            (parallel.sparse_kernels; whole-event
+#                            closure single-device, per-iteration
+#                            insert in parallel.sharded); "1" forces
+#                            it on (interpret mode off-TPU, like
+#                            JEPSEN_TPU_PALLAS); opt-in until
+#                            tools/perf_ab.py's hash-pallas strategy
+#                            records the on-chip win
+#   JEPSEN_TPU_PROBE_LIMIT   env_int     parallel.engine — bounded
+#                            linear-probe length of the hash
+#                            visited-set (default 32, min 1); one
+#                            knob for the XLA and pallas hash paths;
+#                            exhaustion escalates capacity, never
+#                            drops a config
 #   JEPSEN_TPU_PIPELINE      env_bool    parallel.engine — route
 #                            check_batch through the pipelined
 #                            executor (parallel.pipeline); opt-in

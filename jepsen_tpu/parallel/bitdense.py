@@ -637,8 +637,13 @@ def dispatch_batch_bitdense(encs, mesh=None, use_pallas: bool = None,
     # used to pin them to XLA came off with the r5 on-chip measurement:
     # the non-interpret SPMD lowering (shard_map -> mosaic) compiled
     # and ran on a real 1-device TPU mesh, agreed with the XLA closure
-    # on all 84 keys, and won 1.48x; the multi-device slicing logic is
-    # differential-tested on the 8-way CPU mesh (tests/test_pallas.py).
+    # on all 84 keys, and won 1.48x. Provenance caveat: that run's raw
+    # JSONL was not retained — no bench_results/ artifact records it;
+    # the only committed evidence is the PERF_R05.md session table
+    # (its provenance note), below the repo's raw-lines standard, so a
+    # future chip session should re-record it. The multi-device
+    # slicing logic is differential-tested on the 8-way CPU mesh
+    # (tests/test_pallas.py).
     up, interpret = _resolve_use_pallas(use_pallas, S, C, platform)
     mode = _resolve_closure_mode(closure_mode, up)
     n_dev = 1 if mesh is None else int(np.asarray(mesh.devices).size)

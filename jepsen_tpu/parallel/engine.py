@@ -49,13 +49,71 @@ _log = logging.getLogger(__name__)
 # ------------------------------------------------------------ device core
 
 
-# Bounded linear-probe length for the hash visited-set. At the table's
-# <= 50% load factor (capacity 2N for an N-row frontier) a 32-probe
-# cluster is vanishingly rare under the mixed hash; exhaustion raises
-# the overflow flag and rides the existing capacity-escalation retry
-# (doubling N doubles the table, halving the load factor) instead of
-# ever dropping a config.
-_PROBE_LIMIT = 32
+def _resolve_probe_limit(probe_limit: int = 0) -> int:
+    """Bounded linear-probe length for the hash visited-set. A positive
+    argument (the test seam threaded through the jits) wins; otherwise
+    the validated JEPSEN_TPU_PROBE_LIMIT flag, default 32. At the
+    table's <= 50% load factor (capacity 2N for an N-row frontier) a
+    32-probe cluster is vanishingly rare under the mixed hash;
+    exhaustion raises the overflow flag and rides the existing
+    capacity-escalation retry (doubling N doubles the table, halving
+    the load factor) instead of ever dropping a config. One knob for
+    BOTH the XLA and the pallas hash paths — the host entry points
+    resolve it eagerly so the value keys the jit cache (an env change
+    between calls recompiles instead of going stale)."""
+    if probe_limit and probe_limit > 0:
+        return int(probe_limit)
+    return envflags.env_int("JEPSEN_TPU_PROBE_LIMIT", default=32,
+                            min_value=1, what="probe limit")
+
+
+def _resolve_sparse_pallas(sparse_pallas, N: int, C: int, platform: str,
+                           dedupe: str):
+    """The sparse engine's fused-frontier-kernel gate -> (mode, note)
+    with mode one of "off" / "on" / "interpret".
+
+    `sparse_pallas` None defers to the strict tri-state
+    JEPSEN_TPU_SPARSE_PALLAS flag (default OFF until a chip A/B
+    records the win — the JEPSEN_TPU_PIPELINE / JEPSEN_TPU_DEDUPE
+    precedent; "1" forces it on, in interpret mode off-TPU like
+    JEPSEN_TPU_PALLAS). The kernel is the hash path's fused form, so
+    requesting it under dedupe="sort" is a contradiction and raises;
+    a shape past the kernel's VMEM budget (sparse_kernels.supported)
+    downgrades to the XLA hash closure with a note — the bitdense
+    mesh-fallback precedent: the default path degrades, never errors."""
+    if dedupe != "hash":
+        if sparse_pallas:
+            raise ValueError(
+                "sparse_pallas=True requires dedupe='hash' — the fused "
+                "frontier kernel is the hash path's implementation")
+        if sparse_pallas is None and envflags.env_bool(
+                "JEPSEN_TPU_SPARSE_PALLAS", default=False):
+            # the env-only misconfiguration must be LOUD: "=1 forces it
+            # on" with the dedupe flag left at sort would otherwise
+            # read as kernel-measured while the kernel never ran — the
+            # 'measured and lost' trap the perf_ab typo-guard closes
+            _log.warning(
+                "JEPSEN_TPU_SPARSE_PALLAS=1 has no effect under "
+                "dedupe=%r — the fused frontier kernel is the hash "
+                "path's implementation; set JEPSEN_TPU_DEDUPE=hash",
+                dedupe)
+        return "off", None
+    if sparse_pallas is None:
+        sparse_pallas = envflags.env_bool("JEPSEN_TPU_SPARSE_PALLAS",
+                                          default=False)
+    if not sparse_pallas:
+        return "off", None
+    from jepsen_tpu.parallel import sparse_kernels as sk
+    if not sk.supported(N, C):
+        obs.counter("engine.sparse_pallas_fallbacks").inc()
+        note = (f"sparse frontier kernel skipped at capacity {N} "
+                f"(C={C}): probe state would exceed the kernel's VMEM "
+                f"budget — fell back to the XLA hash closure for this "
+                f"tier")
+        _log.warning("%s", note)
+        return "off", note
+    from jepsen_tpu.parallel.bitdense import is_tpu_platform
+    return ("on" if is_tpu_platform(platform) else "interpret"), None
 
 
 def _next_pow2(n: int) -> int:
@@ -154,6 +212,87 @@ def _hash_insert(c_st, c_ml, c_mh, c_live, table, probe_limit: int):
     return out["table"], out["fresh"], jnp.any(out["pending"])
 
 
+def _hash_insert_append(c_st, c_ml, c_mh, c_live, st, ml, mh, count,
+                        table, probe_limit: int, N: int):
+    """_hash_insert plus the contiguous append of the fresh rows after
+    `count` — one closure iteration's whole visited-set transaction.
+    Shared verbatim by the XLA hash path, the fused frontier kernel
+    (sparse_kernels.frontier_closure_call via _hash_event_closure), and
+    the sharded per-device insert kernel (sparse_kernels.
+    hash_insert_call), so the three implementations cannot diverge.
+
+    Returns (st2, ml2, mh2, table2, count2, n_fresh, ovf): `ovf` is
+    probe exhaustion OR the append running past the N-row frontier
+    (rows past N scatter-drop; the flag aborts before anything
+    consumes them)."""
+    table2, fresh, p_ovf = _hash_insert(c_st, c_ml, c_mh, c_live, table,
+                                        probe_limit)
+    n_fresh = jnp.sum(fresh)
+    pos = jnp.where(fresh, count + jnp.cumsum(fresh) - 1, N)
+    st2 = st.at[pos].set(c_st, mode="drop")
+    ml2 = ml.at[pos].set(c_ml, mode="drop")
+    mh2 = mh.at[pos].set(c_mh, mode="drop")
+    return (st2, ml2, mh2, table2, jnp.minimum(count + n_fresh, N),
+            n_fresh, p_ovf | (count + n_fresh > N))
+
+
+def _hash_event_closure(step_cc, ev, st, ml, mh, live, run, N: int,
+                        C: int, T: int, probe_limit: int):
+    """The whole per-event delta-frontier closure (dedupe="hash") on
+    plain arrays: seed the fresh visited set with the live frontier
+    (compacting it in the same pass — post-filter frontiers have
+    holes; iteration 0's delta is the whole frontier, exactly the rows
+    the sort path would step first), then expand only the delta until
+    no fresh configs appear. Shared VERBATIM by the XLA path
+    (_scan_step_factory) and the fused pallas kernel
+    (sparse_kernels.frontier_closure_call runs exactly this function
+    over VMEM-resident values), so the two cannot diverge.
+
+    Returns (st2, ml2, mh2, count, ovf, iters, stepped) with `stepped`
+    the configs expanded during THIS event's closure."""
+    bit_lo, bit_hi = _slot_bits(C)
+    st0, ml0, mh0, table, m0, _, p0 = _hash_insert_append(
+        st, ml, mh, live, jnp.zeros(N, jnp.int32),
+        jnp.zeros(N, jnp.uint32), jnp.zeros(N, jnp.uint32),
+        jnp.int32(0), _empty_table(T), probe_limit, N)
+
+    def cond(c):
+        return c["changed"] & ~c["ovf"]
+
+    def body(c):
+        st, ml, mh = c["st"], c["ml"], c["mh"]
+        n_old, count = c["n_old"], c["count"]
+        cand_st, cand_ok = step_cc(st, ev["slot_f"], ev["slot_a0"],
+                                   ev["slot_a1"], ev["slot_wild"])
+        row = jnp.arange(N)
+        delta = (row >= n_old) & (row < count)
+        already = ((ml[:, None] & bit_lo[None, :])
+                   | (mh[:, None] & bit_hi[None, :])) != 0
+        legal = (delta[:, None] & ev["slot_occ"][None, :]
+                 & ~already & cand_ok)
+        st2, ml2, mh2, table2, count2, n_fresh, ins_ovf = \
+            _hash_insert_append(
+                cand_st.reshape(-1),
+                (ml[:, None] | bit_lo[None, :]).reshape(-1),
+                (mh[:, None] | bit_hi[None, :]).reshape(-1),
+                legal.reshape(-1), st, ml, mh, count, c["table"],
+                probe_limit, N)
+        return {"st": st2, "ml": ml2, "mh": mh2,
+                "n_old": count, "count": count2, "table": table2,
+                "changed": n_fresh > 0,
+                "ovf": c["ovf"] | ins_ovf,
+                "iters": c["iters"] + 1,
+                "stepped": c["stepped"] + (count - n_old)}
+
+    out = lax.while_loop(cond, body, {
+        "st": st0, "ml": ml0, "mh": mh0,
+        "n_old": jnp.int32(0), "count": m0, "table": table,
+        "changed": run, "ovf": p0, "iters": jnp.int32(0),
+        "stepped": jnp.int32(0)})
+    return (out["st"], out["ml"], out["mh"], out["count"], out["ovf"],
+            out["iters"], out["stepped"])
+
+
 def _slot_bits(C: int):
     js = jnp.arange(C, dtype=jnp.uint32)
     one = jnp.uint32(1)
@@ -203,7 +342,8 @@ def _initial_carry(state0, N: int):
 
 
 def _scan_step_factory(step_name: str, N: int, C: int,
-                       dedupe: str = "sort", probe_limit: int = 0):
+                       dedupe: str = "sort", probe_limit: int = 0,
+                       sparse_pallas: str = "off"):
     """The per-return-event scan step, parameterized by model step,
     frontier capacity, slot-window width, and dedupe strategy. Shared
     by the one-shot and the resumable (checkpointed) entry points.
@@ -212,19 +352,28 @@ def _scan_step_factory(step_name: str, N: int, C: int,
     frontier and dedupes by a full lexsort over all N*(C+1) candidate
     rows — the historical path.
 
-    dedupe="hash": the delta-frontier closure. The frontier is kept
-    compacted, the closure carry holds a split index (rows [0, n_old)
-    were expanded in earlier iterations, rows [n_old, count) are the
-    delta discovered last iteration), only the delta expands, and
-    membership is an open-addressed hash visited-set (capacity
-    _next_pow2(2N), _hash_insert) reused across all closure iterations
-    of one return event — each configuration is expanded exactly once
-    per event, the Wing&Gong/Lowe seen-set realised on-device. Probe
-    exhaustion raises the overflow flag and rides the same
-    capacity-escalation retry as a full frontier. Verdicts,
-    counterexample localization, max-frontier and iteration counts are
-    identical to the sort path (frontier ROW ORDER differs; tests pin
-    everything order-independent).
+    dedupe="hash": the delta-frontier closure (_hash_event_closure).
+    The frontier is kept compacted, the closure carry holds a split
+    index (rows [0, n_old) were expanded in earlier iterations, rows
+    [n_old, count) are the delta discovered last iteration), only the
+    delta expands, and membership is an open-addressed hash
+    visited-set (capacity _next_pow2(2N), _hash_insert) reused across
+    all closure iterations of one return event — each configuration is
+    expanded exactly once per event, the Wing&Gong/Lowe seen-set
+    realised on-device. Probe exhaustion raises the overflow flag and
+    rides the same capacity-escalation retry as a full frontier.
+    Verdicts, counterexample localization, max-frontier and iteration
+    counts are identical to the sort path (frontier ROW ORDER differs;
+    tests pin everything order-independent).
+
+    `sparse_pallas` ("off"/"on"/"interpret", resolved by
+    _resolve_sparse_pallas) fuses the whole per-event hash closure into
+    ONE pallas_call (parallel.sparse_kernels): candidate rows, the
+    visited-set table, and the event's slot tables stay VMEM-resident
+    for every closure iteration, so the N*(C+1) candidate arrays never
+    round-trip HBM and the probe/claim while_loops cost no
+    per-iteration dispatch. The kernel body IS _hash_event_closure, so
+    results are identical by construction.
 
     Both strategies accumulate a configs-stepped counter (sort: the
     whole live frontier per iteration; hash: the delta) — the counter
@@ -232,7 +381,9 @@ def _scan_step_factory(step_name: str, N: int, C: int,
     step = STEPS[step_name]
     bit_lo, bit_hi = _slot_bits(C)
     if probe_limit <= 0:
-        probe_limit = _PROBE_LIMIT
+        # host entry points resolve eagerly; this is the safety net for
+        # internal callers (e.g. _frontier_at's default-arg path)
+        probe_limit = _resolve_probe_limit(0)
     T = _next_pow2(2 * N)
 
     # model step vmapped over configs x slots
@@ -268,47 +419,6 @@ def _scan_step_factory(step_name: str, N: int, C: int,
                     iters + 1, stepped + old_count)
         return body
 
-    def hash_closure_cond(c):
-        return c["changed"] & ~c["ovf"]
-
-    def make_hash_closure_body(ev):
-        def body(c):
-            st, ml, mh = c["st"], c["ml"], c["mh"]
-            n_old, count = c["n_old"], c["count"]
-            cand_st, cand_ok = step_cc(
-                st, ev["slot_f"], ev["slot_a0"], ev["slot_a1"],
-                ev["slot_wild"])
-            row = jnp.arange(N)
-            delta = (row >= n_old) & (row < count)
-            already = ((ml[:, None] & bit_lo[None, :])
-                       | (mh[:, None] & bit_hi[None, :])) != 0
-            legal = (delta[:, None] & ev["slot_occ"][None, :]
-                     & ~already & cand_ok)
-            c_st = cand_st.reshape(-1)
-            c_ml = (ml[:, None] | bit_lo[None, :]).reshape(-1)
-            c_mh = (mh[:, None] | bit_hi[None, :]).reshape(-1)
-            table, fresh, p_ovf = _hash_insert(
-                c_st, c_ml, c_mh, legal.reshape(-1), c["table"],
-                probe_limit)
-            # append the fresh rows contiguously after `count`: they
-            # are the next iteration's delta. Rows past N scatter-drop;
-            # the overflow flag aborts before anything consumes them.
-            n_fresh = jnp.sum(fresh)
-            pos = jnp.where(fresh, count + jnp.cumsum(fresh) - 1, N)
-            return {
-                "st": st.at[pos].set(c_st, mode="drop"),
-                "ml": ml.at[pos].set(c_ml, mode="drop"),
-                "mh": mh.at[pos].set(c_mh, mode="drop"),
-                "n_old": count,
-                "count": jnp.minimum(count + n_fresh, N),
-                "table": table,
-                "changed": n_fresh > 0,
-                "ovf": c["ovf"] | p_ovf | (count + n_fresh > N),
-                "iters": c["iters"] + 1,
-                "stepped": c["stepped"] + (count - n_old),
-            }
-        return body
-
     def run_closure(ev, st, ml, mh, live, run, stepped):
         """-> (st2, ml2, mh2, live2, ovf, iters, stepped2)."""
         if dedupe == "sort":
@@ -318,24 +428,21 @@ def _scan_step_factory(step_name: str, N: int, C: int,
                     (st, ml, mh, live, run, jnp.array(False),
                      jnp.int32(0), stepped))
             return st2, ml2, mh2, live2, ovf, iters, stepped2
-        # hash: seed the per-event visited set with the live frontier
-        # (compacting it in the same pass — post-filter frontiers have
-        # holes); iteration 0's delta is the whole frontier, exactly
-        # the rows the sort path would step first
-        table, fresh0, p0 = _hash_insert(st, ml, mh, live,
-                                         _empty_table(T), probe_limit)
-        m0 = jnp.sum(fresh0)
-        pos0 = jnp.where(fresh0, jnp.cumsum(fresh0) - 1, N)
-        out = lax.while_loop(hash_closure_cond, make_hash_closure_body(ev), {
-            "st": jnp.zeros(N, jnp.int32).at[pos0].set(st, mode="drop"),
-            "ml": jnp.zeros(N, jnp.uint32).at[pos0].set(ml, mode="drop"),
-            "mh": jnp.zeros(N, jnp.uint32).at[pos0].set(mh, mode="drop"),
-            "n_old": jnp.int32(0), "count": m0, "table": table,
-            "changed": run, "ovf": p0, "iters": jnp.int32(0),
-            "stepped": stepped})
-        live2 = jnp.arange(N) < out["count"]
-        return (out["st"], out["ml"], out["mh"], live2, out["ovf"],
-                out["iters"], out["stepped"])
+        if sparse_pallas != "off":
+            # the fused kernel: the whole per-event closure inside one
+            # pallas_call, frontier + table + slot tables VMEM-resident
+            from jepsen_tpu.parallel import sparse_kernels as sk
+            st2, ml2, mh2, count, ovf, iters, d = \
+                sk.frontier_closure_call(
+                    step_name, ev, st, ml, mh, live, run, N, C,
+                    probe_limit,
+                    interpret=(sparse_pallas == "interpret"))
+        else:
+            st2, ml2, mh2, count, ovf, iters, d = _hash_event_closure(
+                step_cc, ev, st, ml, mh, live, run, N, C, T,
+                probe_limit)
+        live2 = jnp.arange(N) < count
+        return st2, ml2, mh2, live2, ovf, iters, stepped + d
 
     def scan_step(carry, ev):
         st, ml, mh, live, ok, fail_r, r_idx, maxf, steps_n, stepped = carry
@@ -389,14 +496,16 @@ def _scan_step_factory(step_name: str, N: int, C: int,
 
 
 def _check_impl(xs, state0, step_name: str, N: int,
-                dedupe: str = "sort", probe_limit: int = 0):
+                dedupe: str = "sort", probe_limit: int = 0,
+                sparse_pallas: str = "off"):
     """Scan over all return events from scratch. xs: dict of [R, ...]
     arrays. Returns (valid, fail_event, overflow, max_frontier,
     steps_evaluated, configs_stepped)."""
     C = xs["slot_f"].shape[1]
     carry0 = _initial_carry(state0, N)
     carry, ovfs = lax.scan(
-        _scan_step_factory(step_name, N, C, dedupe, probe_limit),
+        _scan_step_factory(step_name, N, C, dedupe, probe_limit,
+                           sparse_pallas),
         carry0, xs)
     _, _, _, live, ok, fail_r, _, maxf, steps_n, stepped = carry
     overflow = jnp.any(ovfs)
@@ -414,15 +523,17 @@ def _check_impl(xs, state0, step_name: str, N: int,
 # reclaim either.
 @functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
                    static_argnames=("step_name", "N", "dedupe",
-                                    "probe_limit"))
+                                    "probe_limit", "sparse_pallas"))
 def _check_device_resumable(xs, carry0, step_name: str, N: int,
-                            dedupe: str = "sort", probe_limit: int = 0):
+                            dedupe: str = "sort", probe_limit: int = 0,
+                            sparse_pallas: str = "off"):
     """One chunk of events from an explicit carry; returns the final
     carry plus the overflow flag so the host can checkpoint between
     chunks."""
     C = xs["slot_f"].shape[1]
     carry, ovfs = lax.scan(
-        _scan_step_factory(step_name, N, C, dedupe, probe_limit),
+        _scan_step_factory(step_name, N, C, dedupe, probe_limit,
+                           sparse_pallas),
         carry0, xs)
     return carry, jnp.any(ovfs)
 
@@ -431,18 +542,19 @@ def _check_device_resumable(xs, carry0, step_name: str, N: int,
 # jepsen-lint: disable=recompile-donate-argnums
 _check_device = jax.jit(_check_impl,
                         static_argnames=("step_name", "N", "dedupe",
-                                         "probe_limit"))
+                                         "probe_limit", "sparse_pallas"))
 
 
 # same donation decision as _check_device_resumable above
 @functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
                    static_argnames=("step_name", "N", "dedupe",
-                                    "probe_limit"))
+                                    "probe_limit", "sparse_pallas"))
 def _check_device_batch(xs, state0, step_name: str, N: int,
-                        dedupe: str = "sort", probe_limit: int = 0):
+                        dedupe: str = "sort", probe_limit: int = 0,
+                        sparse_pallas: str = "off"):
     return jax.vmap(
         lambda x, s0: _check_impl(x, s0, step_name, N, dedupe,
-                                  probe_limit)
+                                  probe_limit, sparse_pallas)
     )(xs, state0)
 
 
@@ -576,7 +688,9 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
                             checkpoint_cb=None,
                             resume: Optional[FrontierCheckpoint] = None,
                             device=None,
-                            dedupe: Optional[str] = None) -> dict:
+                            dedupe: Optional[str] = None,
+                            probe_limit: int = 0,
+                            sparse_pallas: Optional[bool] = None) -> dict:
     """check_encoded with mid-search checkpointing: events are processed
     in chunks of `checkpoint_every`; after each chunk the frontier is
     pulled to host and handed to checkpoint_cb(FrontierCheckpoint) (e.g.
@@ -588,6 +702,8 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
     if e.n_returns == 0:
         return {"valid?": True, "max-frontier": 0, "capacity": 0}
     dedupe = _resolve_dedupe(dedupe)
+    probe_limit = _resolve_probe_limit(probe_limit)
+    platform = getattr(device, "platform", None) or jax.default_backend()
     digest = history_digest(e)
     if resume is not None:
         if resume.history_digest != digest:
@@ -612,19 +728,28 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
         "ev_slot": e.ev_slot,
     }
     R = e.n_returns
+    mode, note = "off", None
     while cp.event_index < R and cp.ok:
         lo = cp.event_index
         hi = min(R, lo + checkpoint_every)
+        # re-resolve per chunk: capacity may have grown past the
+        # kernel's VMEM gate mid-search (same degrade-with-note
+        # contract as check_encoded's tier loop)
+        mode, note = _resolve_sparse_pallas(
+            sparse_pallas, cp.capacity, e.slot_f.shape[1], platform,
+            dedupe)
         chunk = _place({k: v[lo:hi] for k, v in xs_np.items()}, device)
         carry, overflow = _check_device_resumable(
-            chunk, cp.carry(device), e.step_name, cp.capacity, dedupe)
+            chunk, cp.carry(device), e.step_name, cp.capacity, dedupe,
+            probe_limit, mode)
         if bool(overflow):
             if cp.capacity * 2 > max_capacity:
-                return {"valid?": "unknown",
-                        "error": f"frontier overflow at capacity "
-                                 f"{cp.capacity}",
-                        "capacity": cp.capacity,
-                        "checkpoint": cp}
+                return _tag_sparse_closure(
+                    {"valid?": "unknown",
+                     "error": f"frontier overflow at capacity "
+                              f"{cp.capacity}",
+                     "capacity": cp.capacity,
+                     "checkpoint": cp}, mode, note)
             cp = cp.grown(cp.capacity * 2)
             continue  # re-run the same chunk at doubled capacity
         st, ml, mh, live, ok, fail_r, r_idx, maxf, steps_n, stepped = \
@@ -643,6 +768,7 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
            # approximate when capacity grew mid-search: iterations from
            # earlier chunks ran at smaller capacities
            "explored": cp.steps_n * cp.capacity * len(e.slot_f[0])}
+    _tag_sparse_closure(out, mode, note)
     if not out["valid?"]:
         out.update(_fail_op(e, cp.fail_r))
     return out
@@ -651,10 +777,24 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
 _fail_op = enc_mod.fail_op_fields
 
 
+def _tag_sparse_closure(out: dict, mode: str, note) -> dict:
+    """Stamp which hash-closure implementation ran — bitdense's
+    "closure"/"closure-note" vocabulary. Only when the kernel was
+    REQUESTED (mode on, or a downgrade note): the flag-off result dict
+    stays byte-identical to the pre-kernel schema."""
+    if mode != "off":
+        out["closure"] = "pallas"
+    elif note is not None:
+        out["closure"] = "xla-hash"
+        out["closure-note"] = note
+    return out
+
+
 def check_encoded(e: EncodedHistory, capacity: int = 1024,
                   max_capacity: int = 1 << 20, device=None,
                   dedupe: Optional[str] = None,
-                  probe_limit: int = 0) -> dict:
+                  probe_limit: int = 0,
+                  sparse_pallas: Optional[bool] = None) -> dict:
     """Check one encoded history, doubling frontier capacity on overflow
     (re-jit per capacity tier; tiers are cached by jax.jit). With
     `device` every input is explicitly placed there and the search runs
@@ -667,30 +807,48 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
     records the closure work actually paid — strictly less under
     "hash" whenever a closure runs more than one iteration (the delta
     stops re-stepping the settled majority). `probe_limit` bounds the
-    hash path's linear probes (0 = the default _PROBE_LIMIT; a test
-    seam — probe exhaustion escalates capacity exactly like a full
-    frontier)."""
+    hash path's linear probes (0 = the JEPSEN_TPU_PROBE_LIMIT flag,
+    default 32; a test seam — probe exhaustion escalates capacity
+    exactly like a full frontier).
+
+    `sparse_pallas` routes the hash closure through the fused VMEM
+    frontier kernel (parallel.sparse_kernels; None = the
+    JEPSEN_TPU_SPARSE_PALLAS flag, default off until the chip A/B).
+    Results are identical by construction — the kernel body is the
+    same _hash_event_closure trace; the gate re-resolves per capacity
+    tier, so an escalation past the kernel's VMEM budget degrades to
+    the XLA hash closure with a "closure-note" rather than erroring."""
     if e.n_returns == 0:
         return {"valid?": True, "max-frontier": 0, "capacity": 0}
     dedupe = _resolve_dedupe(dedupe)
+    probe_limit = _resolve_probe_limit(probe_limit)
+    platform = getattr(device, "platform", None) or jax.default_backend()
+    C = e.slot_f.shape[1]
     xs = _xs_from_encoded(e, device)
     state0 = _place(np.int32(e.state0), device)
     N = max(64, capacity)
     with obs.span("engine.search", returns=e.n_returns,
                   dedupe=dedupe) as sp:
         while True:
+            mode, note = _resolve_sparse_pallas(sparse_pallas, N, C,
+                                                platform, dedupe)
             valid, fail_r, overflow, maxf, steps_n, stepped = \
                 _check_device(xs, state0, e.step_name, N, dedupe,
-                              probe_limit)
+                              probe_limit, mode)
             if not bool(overflow):
                 break
             if N * 2 > max_capacity:
-                return {"valid?": "unknown",
-                        "error": f"frontier overflow at capacity {N}",
-                        "capacity": N, "dedupe": dedupe}
+                return _tag_sparse_closure(
+                    {"valid?": "unknown",
+                     "error": f"frontier overflow at capacity {N}",
+                     "capacity": N, "dedupe": dedupe}, mode, note)
             N *= 2
             obs.counter("engine.capacity_escalations").inc()
         sp.set(capacity=N)
+        if mode != "off":
+            # only when the kernel was requested: the flag-off trace
+            # schema stays identical, like the result dict
+            sp.set(closure="pallas")
     obs.counter("engine.configs_stepped").inc(int(stepped))
     out = {
         "valid?": bool(valid),
@@ -703,6 +861,7 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
         # work lives in configs-stepped
         "explored": int(steps_n) * N * len(e.slot_f[0]),
     }
+    _tag_sparse_closure(out, mode, note)
     if not out["valid?"]:
         out.update(_fail_op(e, int(fail_r)))
     return out
@@ -710,7 +869,8 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
 
 def analysis(model, history, capacity: int = 1024,
              max_capacity: int = 1 << 20, encode_cache=None,
-             dedupe: Optional[str] = None) -> dict:
+             dedupe: Optional[str] = None,
+             sparse_pallas: Optional[bool] = None) -> dict:
     """knossos-style (model, history) -> result on the device engine.
 
     Falls back to the host WGL engine when the model can't pack or the
@@ -730,7 +890,8 @@ def analysis(model, history, capacity: int = 1024,
 
     `dedupe` picks the sparse engine's frontier dedupe strategy
     (check_encoded; None defers to JEPSEN_TPU_DEDUPE) — verdict- and
-    counterexample-identical either way.
+    counterexample-identical either way; `sparse_pallas` its fused
+    VMEM kernel (None defers to JEPSEN_TPU_SPARSE_PALLAS).
     """
     from jepsen_tpu.history import History
     h = history if isinstance(history, History) else History.wrap(history)
@@ -760,7 +921,8 @@ def analysis(model, history, capacity: int = 1024,
         r = bitdense.check_encoded_bitdense(e)
     else:
         r = check_encoded(e, capacity=capacity,
-                          max_capacity=max_capacity, dedupe=dedupe)
+                          max_capacity=max_capacity, dedupe=dedupe,
+                          sparse_pallas=sparse_pallas)
     if r["valid?"] is False:
         apply_final_paths(r, model, e)
     return r
@@ -1066,7 +1228,8 @@ def check_batch(model, histories, capacity: int = 512,
                 bucket: Optional[str] = None,
                 pipeline: Optional[bool] = None, cache=None,
                 pipeline_stats: Optional[dict] = None,
-                dedupe: Optional[str] = None) -> list:
+                dedupe: Optional[str] = None,
+                sparse_pallas: Optional[bool] = None) -> list:
     """Check many per-key histories in one device program per
     slot-window bucket: vmap over the key axis; with a mesh (and K
     divisible by its size) the key axis is sharded across devices —
@@ -1101,7 +1264,11 @@ def check_batch(model, histories, capacity: int = 512,
     records a win (flags do not get to claim speedups). Results are
     bit-identical to the serial path either way (docs/performance.md).
     `pipeline_stats`, when a dict, receives the per-bucket
-    encode/transfer/device split the bench reports."""
+    encode/transfer/device split the bench reports.
+
+    `sparse_pallas` routes the sparse buckets' hash closure through the
+    fused VMEM frontier kernel (check_encoded's docstring; None = the
+    JEPSEN_TPU_SPARSE_PALLAS flag)."""
     bucket = _resolve_bucket(bucket)   # fail-fast: before the encode
     dedupe = _resolve_dedupe(dedupe)   # likewise
     if _resolve_pipeline(pipeline):
@@ -1109,7 +1276,8 @@ def check_batch(model, histories, capacity: int = 512,
         return pipe_mod.check_batch_pipelined(
             model, histories, capacity=capacity,
             max_capacity=max_capacity, mesh=mesh, bucket=bucket,
-            cache=cache, stats=pipeline_stats, dedupe=dedupe)
+            cache=cache, stats=pipeline_stats, dedupe=dedupe,
+            sparse_pallas=sparse_pallas)
     if (cache is not None and cache is not False) \
             or pipeline_stats is not None:
         # the serial path consults no cache and fills no stats —
@@ -1128,7 +1296,8 @@ def check_batch(model, histories, capacity: int = 512,
             pre = [enc_mod.encode(model, h) for h in histories]
         return check_batch_encoded(model, pre, capacity=capacity,
                                    max_capacity=max_capacity, mesh=mesh,
-                                   bucket=bucket, dedupe=dedupe)
+                                   bucket=bucket, dedupe=dedupe,
+                                   sparse_pallas=sparse_pallas)
 
 
 def _resolve_bucket(bucket: Optional[str]) -> str:
@@ -1169,7 +1338,8 @@ def bucket_key(n_slots: int, bucket: str) -> int:
 def check_batch_encoded(model, pre, capacity: int = 512,
                         max_capacity: int = 1 << 18, mesh=None,
                         bucket: Optional[str] = None,
-                        dedupe: Optional[str] = None) -> list:
+                        dedupe: Optional[str] = None,
+                        sparse_pallas: Optional[bool] = None) -> list:
     """check_batch on ALREADY-ENCODED keys (the bucketing + dispatch
     half without the encode half). Public so callers that time or
     cache the encode separately — bench.sec_multikey's encode/device
@@ -1198,18 +1368,27 @@ def check_batch_encoded(model, pre, capacity: int = 512,
             rs = bitdense.check_batch_bitdense(sub, mesh=mesh)
         else:
             rs = _check_batch_sparse(model, sub, capacity, max_capacity,
-                                     mesh, dedupe=dedupe)
+                                     mesh, dedupe=dedupe,
+                                     sparse_pallas=sparse_pallas)
         for i, r in zip(idxs, rs):
             out[i] = r
     return out
 
 
 def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
-                        mesh=None, dedupe: str = "sort") -> list:
+                        mesh=None, dedupe: str = "sort",
+                        probe_limit: int = 0,
+                        sparse_pallas: Optional[bool] = None) -> list:
     """Sparse-frontier batch path with per-key capacity-tier retry."""
     step_name = pre[0].step_name
     K = len(pre)
     out: list = [None] * K
+    probe_limit = _resolve_probe_limit(probe_limit)
+    # the padded batch runs one program: gate the kernel on where the
+    # batch actually lives (the mesh when given), like bitdense does
+    platform = (np.asarray(mesh.devices).flat[0].platform
+                if mesh is not None else jax.default_backend())
+    C = max(e.slot_f.shape[1] for e in pre)
     # Per-key capacity retry: keys are bucketed by the capacity tier
     # they need — only keys that overflowed re-run (at doubled
     # capacity), so one hot key never drags the whole batch through
@@ -1218,12 +1397,15 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
     N = max(64, capacity)
     while pending:
         encs_t = [pre[i] for i in pending]
+        mode, note = _resolve_sparse_pallas(sparse_pallas, N, C,
+                                            platform, dedupe)
         with obs.span("engine.sparse_batch", keys=len(pending),
                       capacity=N, dedupe=dedupe):
             _, xs, state0 = encode_batch(model, [], encs=encs_t,
                                          mesh=mesh)
             valid, fail_r, overflow, maxf, steps_n, stepped = \
-                _check_device_batch(xs, state0, step_name, N, dedupe)
+                _check_device_batch(xs, state0, step_name, N, dedupe,
+                                    probe_limit, mode)
             valid = np.asarray(valid)
             fail_r = np.asarray(fail_r)
             overflow = np.asarray(overflow)
@@ -1238,6 +1420,7 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
             r = {"valid?": bool(valid[j]), "max-frontier": int(maxf[j]),
                  "capacity": N, "dedupe": dedupe,
                  "configs-stepped": int(stepped[j])}
+            _tag_sparse_closure(r, mode, note)
             obs.counter("engine.configs_stepped").inc(int(stepped[j]))
             if not r["valid?"]:
                 r.update(enc_mod.fail_op_fields(e, int(fail_r[j])))
@@ -1247,7 +1430,8 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
         if N * 2 > max_capacity:
             for i in retry:
                 out[i] = _escalate_overflow(pre[i], N, mesh,
-                                            dedupe=dedupe)
+                                            dedupe=dedupe,
+                                            sparse_pallas=sparse_pallas)
             break
         # keys that overflowed re-dispatch at the doubled tier — the
         # counter the capacity-retry ladder's cost is visible through
@@ -1258,7 +1442,8 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
 
 
 def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh,
-                       dedupe: str = "sort") -> dict:
+                       dedupe: str = "sort",
+                       sparse_pallas: Optional[bool] = None) -> dict:
     """A key too wide for the batch program escalates instead of dying
     as "unknown": first the single-key sparse engine at 4x the batch
     ceiling, then — with a mesh — the frontier-sharded engine, whose
@@ -1280,7 +1465,7 @@ def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh,
     dev = None if mesh is None else np.asarray(mesh.devices).flat[0]
     r = check_encoded(e, capacity=min(batch_cap * 2, ceil_single),
                       max_capacity=ceil_single, device=dev,
-                      dedupe=dedupe)
+                      dedupe=dedupe, sparse_pallas=sparse_pallas)
     if r["valid?"] != "unknown":
         r["escalated"] = "single"
         return r
@@ -1303,7 +1488,8 @@ def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh,
             ceil_sharded = min(batch_cap * 4 * n_dev, 1 << 24)
             rs = sharded.check_encoded_sharded(
                 e, mesh, capacity=min(batch_cap * 8, ceil_sharded),
-                max_capacity=ceil_sharded, dedupe=dedupe)
+                max_capacity=ceil_sharded, dedupe=dedupe,
+                sparse_pallas=sparse_pallas)
             if rs["valid?"] != "unknown":
                 rs["escalated"] = "sharded"
                 return rs

@@ -438,7 +438,8 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
                           chunk_keys: int = DEFAULT_CHUNK_KEYS,
                           depth: int = 2,
                           stats: Optional[dict] = None,
-                          dedupe: Optional[str] = None) -> list:
+                          dedupe: Optional[str] = None,
+                          sparse_pallas: Optional[bool] = None) -> list:
     """engine.check_batch with the three host/device phases overlapped
     (module docstring). Same arguments and bit-identical results;
     extras:
@@ -457,6 +458,9 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
                 (engine._resolve_dedupe; None = JEPSEN_TPU_DEDUPE) —
                 recorded in stats so the bench lines can say which
                 strategy was active
+    sparse_pallas  route the sparse buckets' hash closure through the
+                fused VMEM frontier kernel (engine.check_encoded's
+                docstring; None = JEPSEN_TPU_SPARSE_PALLAS)
     """
     bucket = engine._resolve_bucket(bucket)
     dedupe = engine._resolve_dedupe(dedupe)
@@ -485,7 +489,7 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
     with root, obs.maybe_jax_profile():
         out = _stream(model, histories, capacity, max_capacity, mesh,
                       bucket, cache, workers, chunk_keys, depth, stats,
-                      dedupe, bitdense)
+                      dedupe, bitdense, sparse_pallas)
     if c0 is not None:
         c1 = cache.counters()
         stats["cache"] = {k: c1[k] - c0[k] for k in
@@ -502,7 +506,7 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
 
 def _stream(model, histories, capacity, max_capacity, mesh, bucket,
             cache, workers, chunk_keys, depth, stats, dedupe,
-            bitdense) -> list:
+            bitdense, sparse_pallas=None) -> list:
     """The executor body (check_batch_pipelined's docstring), under the
     pipeline.run root span. Telemetry it feeds: pipeline.prepare /
     pipeline.encode spans on the pool threads (nested via ctx_runner),
@@ -628,9 +632,9 @@ def _stream(model, histories, capacity, max_capacity, mesh, bucket,
                 sub = [enc_of(i) for i in idxs]
                 with obs.span("pipeline.sparse", tier=tier,
                               keys=len(idxs)):
-                    rs = engine._check_batch_sparse(model, sub, capacity,
-                                                    max_capacity, mesh,
-                                                    dedupe=dedupe)
+                    rs = engine._check_batch_sparse(
+                        model, sub, capacity, max_capacity, mesh,
+                        dedupe=dedupe, sparse_pallas=sparse_pallas)
                 for i, r in zip(idxs, rs):
                     out[i] = r
         while pending:

@@ -52,9 +52,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from jepsen_tpu import obs
 from jepsen_tpu.parallel.encode import EncodedHistory
-from jepsen_tpu.parallel.engine import (_PROBE_LIMIT, _empty_table,
-                                        _hash_insert, _next_pow2,
-                                        _resolve_dedupe, _slot_bits,
+from jepsen_tpu.parallel.engine import (_empty_table,
+                                        _hash_insert_append, _next_pow2,
+                                        _resolve_dedupe,
+                                        _resolve_probe_limit,
+                                        _slot_bits, _tag_sparse_closure,
                                         _xs_from_encoded)
 from jepsen_tpu.parallel.steps import STEPS
 
@@ -148,7 +150,8 @@ def _route_to_owners(st, ml, mh, legal, n_dev: int, B: int):
 
 def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
                   my_idx, axes, route_cand, route_front,
-                  dedupe: str = "sort", probe_limit: int = 0):
+                  dedupe: str = "sort", probe_limit: int = 0,
+                  sparse_pallas: str = "off"):
     """The topology-independent event scan (runs INSIDE shard_map),
     from an explicit initial carry — shared by the fresh-start core and
     the resumable chunk runner.
@@ -168,13 +171,39 @@ def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
     membership is cumulative across the closure iterations of one
     return event. The per-event post-filter re-route (ownership moves
     when the slot bit clears) keeps the sort-based compact — it runs
-    once per event, not once per closure iteration."""
+    once per event, not once per closure iteration.
+
+    `sparse_pallas` ("off"/"on"/"interpret") fuses each iteration's
+    visited-set transaction — probe, scatter-min claim, loser
+    re-check, fresh-row append — into one pallas_call per device
+    (sparse_kernels.hash_insert_call), keeping the received candidate
+    buffer, the owned table, and the frontier tile VMEM-resident for
+    the whole claim loop. The expansion and the owner routing stay in
+    XLA: the all-to-all collective cannot live inside a kernel. A
+    call-site whose (statically known) buffer shape exceeds the VMEM
+    gate downgrades itself to the plain XLA insert."""
     step = STEPS[step_name]
     C = xs["slot_f"].shape[1]
     bit_lo, bit_hi = _slot_bits(C)
     if probe_limit <= 0:
-        probe_limit = _PROBE_LIMIT
+        # host entry points resolve eagerly (the value keys the jit
+        # cache); this is the safety net for default-arg callers
+        probe_limit = _resolve_probe_limit(0)
     Td = _next_pow2(2 * Nd)
+
+    def insert_append(c_st, c_ml, c_mh, c_live, st, ml, mh, count,
+                      table):
+        """One visited-set transaction — fused kernel when enabled and
+        the static shapes fit, the plain XLA form otherwise."""
+        if sparse_pallas != "off":
+            from jepsen_tpu.parallel import sparse_kernels as sk
+            if sk.insert_supported(int(c_st.shape[0]), Nd):
+                return sk.hash_insert_call(
+                    c_st, c_ml, c_mh, c_live, st, ml, mh, count, table,
+                    probe_limit, Nd,
+                    interpret=(sparse_pallas == "interpret"))
+        return _hash_insert_append(c_st, c_ml, c_mh, c_live, st, ml,
+                                   mh, count, table, probe_limit, Nd)
 
     step_cc = jax.vmap(
         jax.vmap(step, in_axes=(None, 0, 0, 0, 0)),
@@ -239,20 +268,18 @@ def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
             # each table (and the frontier) a partition, not a replica
             owner = _hash_config(c_st, c_ml, c_mh) % jnp.uint32(n_dev)
             c_live = c_live & (owner == my_idx)
-            table, fresh, p_ovf = _hash_insert(
-                c_st, c_ml, c_mh, c_live, c["table"], probe_limit)
-            n_fresh = jnp.sum(fresh)
-            pos = jnp.where(fresh, count + jnp.cumsum(fresh) - 1, Nd)
-            l_ovf = (p_ovf | route_ovf
-                     | (count + n_fresh > Nd)).astype(jnp.int32)
+            st2, ml2, mh2, table, count2, n_fresh, ins_ovf = \
+                insert_append(c_st, c_ml, c_mh, c_live, st, ml, mh,
+                              count, c["table"])
+            l_ovf = (ins_ovf | route_ovf).astype(jnp.int32)
             g_new, g_delta, g_ovf = lax.psum(
                 (n_fresh, count - n_old, l_ovf), axes)
             return {
-                "st": st.at[pos].set(c_st, mode="drop"),
-                "ml": ml.at[pos].set(c_ml, mode="drop"),
-                "mh": mh.at[pos].set(c_mh, mode="drop"),
+                "st": st2,
+                "ml": ml2,
+                "mh": mh2,
                 "n_old": count,
-                "count": jnp.minimum(count + n_fresh, Nd),
+                "count": count2,
                 "table": table,
                 "changed": g_new > 0,
                 "ovf": c["ovf"] | (g_ovf > 0),
@@ -268,20 +295,18 @@ def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
                 (st, ml, mh, live, run, jnp.array(False), stepped))
             return st2, ml2, mh2, live2, ovf, stepped2
         # seed the per-event visited set with the local frontier
-        # (owned rows by invariant), compacting it in the same pass
-        table, fresh0, p0 = _hash_insert(st, ml, mh, live,
-                                         _empty_table(Td), probe_limit)
-        m0 = jnp.sum(fresh0)
-        pos0 = jnp.where(fresh0, jnp.cumsum(fresh0) - 1, Nd)
+        # (owned rows by invariant), compacting it in the same pass;
+        # the append overflow arm of insert_append is unreachable here
+        # (at most Nd seed rows fit an Nd frontier), so its flag is
+        # the pure probe-exhaustion signal the sort of carry expects
+        st0, ml0, mh0, table, m0, _, p0 = insert_append(
+            st, ml, mh, live, jnp.zeros(Nd, jnp.int32),
+            jnp.zeros(Nd, jnp.uint32), jnp.zeros(Nd, jnp.uint32),
+            jnp.int32(0), _empty_table(Td))
         g_p0 = lax.psum(p0.astype(jnp.int32), axes) > 0
         out = lax.while_loop(
             hash_closure_cond, make_hash_closure_body(ev), {
-                "st": jnp.zeros(Nd, jnp.int32).at[pos0].set(
-                    st, mode="drop"),
-                "ml": jnp.zeros(Nd, jnp.uint32).at[pos0].set(
-                    ml, mode="drop"),
-                "mh": jnp.zeros(Nd, jnp.uint32).at[pos0].set(
-                    mh, mode="drop"),
+                "st": st0, "ml": ml0, "mh": mh0,
                 "n_old": jnp.int32(0), "count": m0, "table": table,
                 "changed": run, "ovf": g_p0, "stepped": stepped})
         live2 = jnp.arange(Nd) < out["count"]
@@ -338,7 +363,8 @@ def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
 
 def _sharded_core(xs, state0, step_name: str, Nd: int, n_dev: int,
                   my_idx, axes, route_cand, route_front,
-                  dedupe: str = "sort"):
+                  dedupe: str = "sort", probe_limit: int = 0,
+                  sparse_pallas: str = "off"):
     """Fresh-start wrapper over _sharded_scan: seed the initial config
     on its hash-owner device, scan the whole history, reduce to the
     (valid, fail, overflow, maxf, stepped) scalars."""
@@ -352,7 +378,7 @@ def _sharded_core(xs, state0, step_name: str, Nd: int, n_dev: int,
               jnp.int32(1), jnp.int32(0))
     carry, overflow = _sharded_scan(xs, carry0, step_name, Nd, n_dev,
                                     my_idx, axes, route_cand, route_front,
-                                    dedupe)
+                                    dedupe, probe_limit, sparse_pallas)
     _, _, _, live, ok, fail_r, _, maxf, stepped = carry
     valid = ok & (lax.psum(jnp.sum(live), axes) > 0) & ~overflow
     return valid, fail_r, overflow, maxf, stepped
@@ -372,7 +398,8 @@ def _flat_routes(Nd: int, C: int, n_dev: int):
 
 
 def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int,
-                  exchange: str = "route", dedupe: str = "sort"):
+                  exchange: str = "route", dedupe: str = "sort",
+                  probe_limit: int = 0, sparse_pallas: str = "off"):
     """1-D topology adapter: flat owner routing over AXIS, or the
     all-gather broadcast (A/B measurement path)."""
     C = xs["slot_f"].shape[1]
@@ -385,14 +412,16 @@ def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int,
             return g(st), g(ml), g(mh), g(lv), jnp.array(False)
         route_cand = route_front = _bcast
     return _sharded_core(xs, state0, step_name, Nd, n_dev, my_idx,
-                         (AXIS,), route_cand, route_front, dedupe)
+                         (AXIS,), route_cand, route_front, dedupe,
+                         probe_limit, sparse_pallas)
 
 
 AX_SLICE, AX_CHIP = "slice", "chip"
 
 
 def _sharded2d_impl(xs, state0, step_name: str, Nd: int,
-                    n_slice: int, n_chip: int, dedupe: str = "sort"):
+                    n_slice: int, n_chip: int, dedupe: str = "sort",
+                    probe_limit: int = 0, sparse_pallas: str = "off"):
     """2-D topology adapter (slice x chip): the multi-slice story.
     Owner routing is HIERARCHICAL — stage 1 delivers candidates to the
     owner's chip COLUMN over the intra-slice axis (ICI); stage 2
@@ -427,7 +456,7 @@ def _sharded2d_impl(xs, state0, step_name: str, Nd: int,
         xs, state0, step_name, Nd, D, my_idx, (AX_SLICE, AX_CHIP),
         lambda st, ml, mh, lv: route2(st, ml, mh, lv, B1c, B2c),
         lambda st, ml, mh, lv: route2(st, ml, mh, lv, B1f, B2f),
-        dedupe)
+        dedupe, probe_limit, sparse_pallas)
 
 
 # donation decision (recompile-donate-argnums) for the three sharded
@@ -438,12 +467,15 @@ def _sharded2d_impl(xs, state0, step_name: str, Nd: int,
 # would invalidate the retries.
 @functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
                    static_argnames=("step_name", "Nd", "n_slice",
-                                    "n_chip", "mesh", "dedupe"))
+                                    "n_chip", "mesh", "dedupe",
+                                    "probe_limit", "sparse_pallas"))
 def _check_sharded2d(xs, state0, step_name: str, Nd: int, n_slice: int,
-                     n_chip: int, mesh: Mesh, dedupe: str = "sort"):
+                     n_chip: int, mesh: Mesh, dedupe: str = "sort",
+                     probe_limit: int = 0, sparse_pallas: str = "off"):
     fn = _shard_map(
         lambda x, s0: _sharded2d_impl(x, s0, step_name, Nd, n_slice,
-                                      n_chip, dedupe),
+                                      n_chip, dedupe, probe_limit,
+                                      sparse_pallas),
         mesh=mesh,
         in_specs=(P(), P()),
         out_specs=(P(), P(), P(), P(), P()),
@@ -455,13 +487,15 @@ def _check_sharded2d(xs, state0, step_name: str, Nd: int, n_slice: int,
 # same donation decision as _check_sharded2d above
 @functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
                    static_argnames=("step_name", "Nd", "n_dev",
-                                    "mesh", "exchange", "dedupe"))
+                                    "mesh", "exchange", "dedupe",
+                                    "probe_limit", "sparse_pallas"))
 def _check_sharded(xs, state0, step_name: str, Nd: int, n_dev: int,
                    mesh: Mesh, exchange: str = "route",
-                   dedupe: str = "sort"):
+                   dedupe: str = "sort", probe_limit: int = 0,
+                   sparse_pallas: str = "off"):
     fn = _shard_map(
         lambda x, s0: _sharded_impl(x, s0, step_name, Nd, n_dev, exchange,
-                                    dedupe),
+                                    dedupe, probe_limit, sparse_pallas),
         mesh=mesh,
         in_specs=(P(), P()),       # tables + state replicated
         out_specs=(P(), P(), P(), P(), P()),
@@ -472,7 +506,8 @@ def _check_sharded(xs, state0, step_name: str, Nd: int, n_dev: int,
 
 def _sharded_resume_impl(xs, st, ml, mh, live, ok, fail_r, r_idx, maxf,
                          stepped, step_name: str, Nd: int, n_dev: int,
-                         dedupe: str = "sort"):
+                         dedupe: str = "sort", probe_limit: int = 0,
+                         sparse_pallas: str = "off"):
     """Resume-from-carry adapter (runs INSIDE shard_map), 1-D topology.
 
     Restored rows arrive laid out however the host scattered them — a
@@ -499,20 +534,25 @@ def _sharded_resume_impl(xs, st, ml, mh, live, ok, fail_r, r_idx, maxf,
     carry0 = (st2, ml2, mh2, live2, ok, fail_r, r_idx, maxf, stepped)
     carry, scan_ovf = _sharded_scan(xs, carry0, step_name, Nd, n_dev,
                                     my_idx, (AXIS,), route_cand,
-                                    route_front, dedupe)
+                                    route_front, dedupe, probe_limit,
+                                    sparse_pallas)
     return carry, scan_ovf | pre_ovf
 
 
 # same donation decision as _check_sharded2d above
 @functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
                    static_argnames=("step_name", "Nd", "n_dev",
-                                    "mesh", "dedupe"))
+                                    "mesh", "dedupe", "probe_limit",
+                                    "sparse_pallas"))
 def _check_sharded_resume(xs, st, ml, mh, live, ok, fail_r, r_idx, maxf,
                           stepped, step_name: str, Nd: int, n_dev: int,
-                          mesh: Mesh, dedupe: str = "sort"):
+                          mesh: Mesh, dedupe: str = "sort",
+                          probe_limit: int = 0,
+                          sparse_pallas: str = "off"):
     fn = _shard_map(
         lambda x, *c: _sharded_resume_impl(x, *c, step_name, Nd, n_dev,
-                                           dedupe),
+                                           dedupe, probe_limit,
+                                           sparse_pallas),
         mesh=mesh,
         in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS),
                   P(), P(), P(), P(), P()),
@@ -523,13 +563,52 @@ def _check_sharded_resume(xs, st, ml, mh, live, ok, fail_r, r_idx, maxf,
     return fn(xs, st, ml, mh, live, ok, fail_r, r_idx, maxf, stepped)
 
 
+def _resolve_sparse_pallas(sparse_pallas, Nd: int, C: int, n_chip: int,
+                           n_slice: int, exchange: str, platform: str,
+                           dedupe: str):
+    """Sharded arm of engine._resolve_sparse_pallas — same flag, same
+    tri-state, but gated on the per-device INSERT shapes: the largest
+    candidate buffer a device receives from the exchange (flat route:
+    n_dev buckets of the 2x-uniform width; hierarchical: the stage-2
+    receive; gather: every candidate on every device) plus its own
+    Nd-row frontier tile. Returns (mode, note) like the engine's."""
+    from jepsen_tpu.parallel.engine import \
+        _resolve_sparse_pallas as engine_resolve
+    # flag / tri-state / platform / dedupe-contradiction resolution on
+    # a trivially-supported shape; the buffer gate below is the
+    # sharded-specific part
+    mode, _ = engine_resolve(sparse_pallas, 1, 1, platform, dedupe)
+    if mode == "off":
+        return mode, None
+    n_dev = n_chip * n_slice
+    if exchange == "gather":
+        M = n_dev * Nd * C
+    elif n_slice > 1:
+        B1 = max(64, -(-2 * Nd * C // n_chip))
+        M = n_slice * max(64, -(-2 * n_chip * B1 // n_slice))
+    else:
+        M = n_dev * max(64, -(-2 * Nd * C // n_dev))
+    from jepsen_tpu.parallel import sparse_kernels as sk
+    if not sk.insert_supported(M, Nd):
+        obs.counter("engine.sparse_pallas_fallbacks").inc()
+        note = (f"sparse insert kernel skipped at per-device capacity "
+                f"{Nd} (C={C}, exchange buffer {M} rows): probe state "
+                f"would exceed the kernel's VMEM budget — fell back to "
+                f"the XLA hash insert for this tier")
+        _log.warning("%s", note)
+        return "off", note
+    return mode, None
+
+
 def check_encoded_sharded_resumable(e: EncodedHistory, mesh: Mesh,
                                     capacity: int = 8192,
                                     max_capacity: int = 1 << 22,
                                     checkpoint_every: int = 256,
                                     checkpoint_cb=None,
                                     resume=None,
-                                    dedupe=None) -> dict:
+                                    dedupe=None,
+                                    probe_limit: int = 0,
+                                    sparse_pallas=None) -> dict:
     """check_encoded_sharded with mid-search checkpointing — the
     sharded arm of the checker's checkpoint/resume capability
     (SURVEY.md §5.4; engine.check_encoded_resumable is the single-
@@ -563,6 +642,8 @@ def check_encoded_sharded_resumable(e: EncodedHistory, mesh: Mesh,
     mesh = Mesh(devs, (AXIS,))
     n_dev = devs.size
     dedupe = _resolve_dedupe(dedupe)
+    probe_limit = _resolve_probe_limit(probe_limit)
+    platform = devs[0].platform
     digest = history_digest(e)
     if resume is not None:
         if resume.history_digest != digest:
@@ -588,6 +669,7 @@ def check_encoded_sharded_resumable(e: EncodedHistory, mesh: Mesh,
         "ev_slot": e.ev_slot,
     }
     R = e.n_returns
+    mode, note = "off", None
     while cp.event_index < R and cp.ok:
         # global capacity must divide the mesh; grow to the next
         # multiple when the checkpoint came from a different topology
@@ -595,6 +677,11 @@ def check_encoded_sharded_resumable(e: EncodedHistory, mesh: Mesh,
         if N != cp.capacity:
             cp = cp.grown(N)
         Nd = N // n_dev
+        # re-resolve per chunk: capacity growth can cross the kernel's
+        # VMEM gate mid-search (degrade-with-note, never an error)
+        mode, note = _resolve_sparse_pallas(
+            sparse_pallas, Nd, e.slot_f.shape[1], n_dev, 1, "route",
+            platform, dedupe)
         lo, hi = cp.event_index, min(R, cp.event_index + checkpoint_every)
         chunk = {k: jax.device_put(np.asarray(v[lo:hi]), rep)
                  for k, v in xs_np.items()}
@@ -608,13 +695,14 @@ def check_encoded_sharded_resumable(e: EncodedHistory, mesh: Mesh,
             jax.device_put(np.int32(cp.event_index), rep),
             jax.device_put(np.int32(cp.maxf), rep),
             jax.device_put(np.int32(cp.stepped), rep),
-            e.step_name, Nd, n_dev, mesh, dedupe)
+            e.step_name, Nd, n_dev, mesh, dedupe, probe_limit, mode)
         if bool(overflow):
             if N * 2 > max_capacity:
-                return {"valid?": "unknown",
-                        "error": f"frontier overflow at capacity {N}",
-                        "capacity": N, "devices": n_dev,
-                        "dedupe": dedupe, "checkpoint": cp}
+                return _tag_sparse_closure(
+                    {"valid?": "unknown",
+                     "error": f"frontier overflow at capacity {N}",
+                     "capacity": N, "devices": n_dev,
+                     "dedupe": dedupe, "checkpoint": cp}, mode, note)
             cp = cp.grown(N * 2)    # N extra dead rows
             continue                # re-run the same chunk
         st, ml, mh, live, ok, fail_r, r_idx, maxf, stepped = \
@@ -629,6 +717,7 @@ def check_encoded_sharded_resumable(e: EncodedHistory, mesh: Mesh,
            "max-frontier": cp.maxf, "capacity": cp.capacity,
            "devices": n_dev, "dedupe": dedupe,
            "configs-stepped": cp.stepped}
+    _tag_sparse_closure(out, mode, note)
     if not out["valid?"]:
         from jepsen_tpu.parallel.encode import fail_op_fields
         out.update(fail_op_fields(e, cp.fail_r))
@@ -639,7 +728,9 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
                           capacity: int = 8192,
                           max_capacity: int = 1 << 22,
                           exchange: str = "route",
-                          dedupe=None) -> dict:
+                          dedupe=None,
+                          probe_limit: int = 0,
+                          sparse_pallas=None) -> dict:
     """Check one encoded history with the frontier sharded over `mesh`.
 
     Topology: a mesh whose device array is 2-D (both dims > 1) with
@@ -660,10 +751,18 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
     open-addressed visited sets — the device-sharded hash set of
     BASELINE.json); None defers to JEPSEN_TPU_DEDUPE. Verdicts and
     counterexample fields are identical; "configs-stepped" records
-    the global closure work actually paid."""
+    the global closure work actually paid.
+
+    `sparse_pallas` (None = JEPSEN_TPU_SPARSE_PALLAS) fuses each
+    closure iteration's per-device visited-set transaction into one
+    pallas kernel (sparse_kernels.hash_insert_call) — probe, claim
+    arbitration, and fresh-row append run VMEM-resident; the
+    owner-routing collectives stay in XLA. `probe_limit` as in
+    engine.check_encoded (one knob for every hash path)."""
     if e.n_returns == 0:
         return {"valid?": True, "max-frontier": 0, "capacity": 0}
     dedupe = _resolve_dedupe(dedupe)
+    probe_limit = _resolve_probe_limit(probe_limit)
     # A 2-D device array + "route" = the multi-slice topology: axis 0
     # is the slice (DCN) axis, axis 1 the intra-slice chip (ICI) axis,
     # and the exchange goes hierarchical. Anything else flattens onto
@@ -684,11 +783,16 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
     rep = NamedSharding(mesh, P())
     xs = _xs_from_encoded(e, device=rep)
     state0 = jax.device_put(np.int32(e.state0), rep)
+    platform = np.asarray(mesh.devices).flat[0].platform
     N = max(64 * n_dev, capacity)
     with obs.span("sharded.search", devices=n_dev, dedupe=dedupe,
                   returns=e.n_returns) as sp:
         while True:
             Nd = (N + n_dev - 1) // n_dev
+            mode, note = _resolve_sparse_pallas(
+                sparse_pallas, Nd, e.slot_f.shape[1],
+                n_chip if hier else n_dev, n_slice if hier else 1,
+                exchange, platform, dedupe)
             # one span per capacity-tier attempt, per-device capacity
             # attached — the escalation ladder renders as widening
             # steps in the trace
@@ -697,25 +801,33 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
                 if hier:
                     valid, fail_r, overflow, maxf, stepped = \
                         _check_sharded2d(xs, state0, e.step_name, Nd,
-                                         n_slice, n_chip, mesh, dedupe)
+                                         n_slice, n_chip, mesh, dedupe,
+                                         probe_limit, mode)
                 else:
                     valid, fail_r, overflow, maxf, stepped = \
                         _check_sharded(xs, state0, e.step_name, Nd,
-                                       n_dev, mesh, exchange, dedupe)
+                                       n_dev, mesh, exchange, dedupe,
+                                       probe_limit, mode)
                 overflow = bool(overflow)
             if not overflow:
                 break
             if N * 2 > max_capacity:
-                return {"valid?": "unknown",
-                        "error": f"frontier overflow at capacity {N}",
-                        "capacity": N, "dedupe": dedupe}
+                return _tag_sparse_closure(
+                    {"valid?": "unknown",
+                     "error": f"frontier overflow at capacity {N}",
+                     "capacity": N, "dedupe": dedupe}, mode, note)
             N *= 2
             obs.counter("engine.capacity_escalations").inc()
         sp.set(capacity=N)
+        if mode != "off":
+            # only when the kernel was requested (engine.check_encoded
+            # precedent): flag-off trace schema stays identical
+            sp.set(closure="pallas")
     obs.counter("engine.configs_stepped").inc(int(stepped))
     out = {"valid?": bool(valid), "max-frontier": int(maxf),
            "capacity": N, "devices": n_dev, "dedupe": dedupe,
            "configs-stepped": int(stepped)}
+    _tag_sparse_closure(out, mode, note)
     if hier:
         out["mesh"] = f"{n_slice}x{n_chip} (hierarchical exchange)"
     if not out["valid?"]:
@@ -726,7 +838,7 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
 
 def analysis(model, history, mesh: Mesh, capacity: int = 8192,
              max_capacity: int = 1 << 22, exchange: str = "route",
-             dedupe=None) -> dict:
+             dedupe=None, sparse_pallas=None) -> dict:
     """knossos-style (model, history) -> result with the frontier
     sharded over `mesh`; on failure, counterexample paths come from the
     same windowed host re-search as `engine.analysis` (the seed frontier
@@ -750,7 +862,8 @@ def analysis(model, history, mesh: Mesh, capacity: int = 8192,
         return r
     r = check_encoded_sharded(e, mesh, capacity=capacity,
                               max_capacity=max_capacity,
-                              exchange=exchange, dedupe=dedupe)
+                              exchange=exchange, dedupe=dedupe,
+                              sparse_pallas=sparse_pallas)
     if r["valid?"] is False:
         engine.apply_final_paths(r, model, e)
     return r

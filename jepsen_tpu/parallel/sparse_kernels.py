@@ -1,0 +1,182 @@
+"""Pallas TPU kernels for the sparse frontier engine's hash dedupe path.
+
+SURVEY.md §7.1 step 4 names two kernels where XLA fuses poorly: bitset
+ops (parallel.pallas_kernels — the r5 18.9x-54.4x bitdense win) and
+the HASH PROBE. This module is the hash-probe one. Under
+JEPSEN_TPU_DEDUPE=hash the sparse engine's per-event closure
+(engine._hash_event_closure) is a fixpoint over small 1-D arrays —
+frontier rows, the open-addressed visited set, N*(C+1) candidate
+rows — and under plain XLA every closure iteration materialises the
+candidate arrays in HBM and runs the probe/claim while_loop of
+engine._hash_insert as a chain of tiny dispatches. Both kernels here
+run those loops inside a single `pallas_call`, so the probe state is
+VMEM-resident for its whole lifetime:
+
+  * `frontier_closure_call` — one call per RETURN EVENT: seed insert,
+    every delta-expansion iteration, every probe round, and the
+    survivor append all happen in VMEM. Used by the single-device
+    engine (`engine._scan_step_factory`). The kernel body is EXACTLY
+    `engine._hash_event_closure` — the XLA path runs the same function
+    on HBM-backed arrays — so the two implementations cannot diverge;
+    interpret-mode CI pins them bit-identical anyway.
+  * `hash_insert_call` — one call per CLOSURE ITERATION: the bounded
+    linear probe, scatter-min claim arbitration, loser re-check loop,
+    and fresh-row append of `engine._hash_insert_append`, fused. Used
+    by the sharded engine, whose owner-routed all-to-all must run
+    BETWEEN expansion and insert (a collective cannot live inside a
+    pallas kernel), so only the insert side fuses there.
+
+VMEM budget math (`supported`/`insert_supported`): the probe loop
+holds ~12 u32-sized live values per candidate row (the row triple, its
+hash, probe offset, pending/fresh flags, slot/occupancy temporaries)
+— 48 bytes per row — plus the 16-byte frontier rows and the 16*T
+(= 32N) table. Gated to 48*(M + N) <= 4 MiB against the ~16 MB VMEM,
+leaving the compiler generous headroom for double-buffering and
+spills; shapes past the gate fall back to the XLA hash closure with a
+note (engine._resolve_sparse_pallas — the bitdense mesh-fallback
+precedent).
+
+Flag: JEPSEN_TPU_SPARSE_PALLAS, strict tri-state, default OFF until a
+chip A/B records the win (tools/perf_ab.py's `hash-pallas` strategy
+under PERF_AB_DEDUPE owns the flip decision — flags do not get to
+claim speedups); "1" forces it on, in interpret mode off-TPU, like
+JEPSEN_TPU_PALLAS. The scatter/cumsum spellings inside the probe loop
+are interpret-verified on this image; their Mosaic lowerings are
+UNMEASURED on a real chip (the same class of gap that produced the r5
+jnp.flip / 4-D-reshape finds) — a forced-on run that hits a lowering
+gap must surface the real error, which is why only the shape gate,
+never a try/except, guards the forced path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+# Probe-state budget (bytes) the gate holds the kernels to — see the
+# module docstring for the per-row accounting behind the 48.
+VMEM_BUDGET = 4 << 20
+
+
+def insert_supported(M: int, N: int) -> bool:
+    """Can one fused insert of M candidate rows into an N-row frontier
+    (table 2N, probe temporaries ~12 u32 per candidate) stay inside
+    the VMEM budget?"""
+    return 48 * (M + N) <= VMEM_BUDGET
+
+
+def supported(N: int, C: int) -> bool:
+    """Whole-event closure gate: the per-iteration candidate block is
+    M = N*C rows."""
+    return insert_supported(N * C, N)
+
+
+def frontier_closure_call(step_name: str, ev, st, ml, mh, live, run,
+                          N: int, C: int, probe_limit: int,
+                          interpret: bool = False):
+    """Traceable (un-jitted) pallas invocation of one return event's
+    whole delta-frontier closure — usable inside the engine's outer
+    lax.scan, like pallas_kernels.closure_call. Inputs are the scan
+    step's frontier arrays ([N] st/ml/mh + live mask), the event's
+    slot tables ([C] rows of xs), and the run flag; returns
+    (st2, ml2, mh2, count, ovf, iters, stepped) exactly as
+    engine._hash_event_closure does — because the kernel body IS that
+    function, evaluated on VMEM-resident values."""
+    from jepsen_tpu.parallel.engine import _hash_event_closure, _next_pow2
+    from jepsen_tpu.parallel.steps import STEPS
+    step = STEPS[step_name]
+    step_cc = jax.vmap(
+        jax.vmap(step, in_axes=(None, 0, 0, 0, 0)),  # over slots
+        in_axes=(0, None, None, None, None),         # over configs
+    )
+    T = _next_pow2(2 * N)
+
+    def kernel(f_ref, a0_ref, a1_ref, w_ref, occ_ref,
+               st_ref, ml_ref, mh_ref, lv_ref, run_ref,
+               ost_ref, oml_ref, omh_ref, meta_ref):
+        # bool masks travel as int32 (i1 vectors are the shaky corner
+        # of Mosaic); reconstructed at the VMEM boundary
+        ev_v = {"slot_f": f_ref[:], "slot_a0": a0_ref[:],
+                "slot_a1": a1_ref[:], "slot_wild": w_ref[:] != 0,
+                "slot_occ": occ_ref[:] != 0}
+        st2, ml2, mh2, count, ovf, iters, stepped = _hash_event_closure(
+            step_cc, ev_v, st_ref[:], ml_ref[:], mh_ref[:],
+            lv_ref[:] != 0, run_ref[0] != 0, N, C, T, probe_limit)
+        ost_ref[:] = st2
+        oml_ref[:] = ml2
+        omh_ref[:] = mh2
+        meta_ref[:] = jnp.stack([count.astype(I32), ovf.astype(I32),
+                                 iters.astype(I32), stepped.astype(I32)])
+
+    st2, ml2, mh2, meta = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((N,), I32),
+                   jax.ShapeDtypeStruct((N,), U32),
+                   jax.ShapeDtypeStruct((N,), U32),
+                   jax.ShapeDtypeStruct((4,), I32)),
+        interpret=interpret,
+    )(ev["slot_f"], ev["slot_a0"], ev["slot_a1"],
+      ev["slot_wild"].astype(I32), ev["slot_occ"].astype(I32),
+      st, ml, mh, live.astype(I32),
+      jnp.reshape(run, (1,)).astype(I32))
+    return (st2, ml2, mh2, meta[0], meta[1] != 0, meta[2], meta[3])
+
+
+def hash_insert_call(c_st, c_ml, c_mh, c_live, st, ml, mh, count,
+                     table, probe_limit: int, N: int,
+                     interpret: bool = False):
+    """Traceable pallas invocation of one fused visited-set
+    transaction: engine._hash_insert_append (bounded probe +
+    scatter-min claim + loser re-check + fresh-row append) with the
+    candidate rows, the frontier tile, and the table VMEM-resident for
+    the whole claim loop. Used per closure iteration by the sharded
+    engine's per-device owned tables. `table` is the
+    (t_st, t_ml, t_mh, t_occ) tuple; occupancy crosses the kernel
+    boundary as int32 and comes back as bool, so the caller's
+    while-carry dtype never changes. Returns
+    (st2, ml2, mh2, table2, count2, n_fresh, ovf)."""
+    from jepsen_tpu.parallel.engine import _hash_insert_append
+    t_st, t_ml, t_mh, t_occ = table
+    T = t_st.shape[0]
+
+    def kernel(cst_ref, cml_ref, cmh_ref, clv_ref,
+               st_ref, ml_ref, mh_ref, cnt_ref,
+               tst_ref, tml_ref, tmh_ref, tocc_ref,
+               ost_ref, oml_ref, omh_ref,
+               otst_ref, otml_ref, otmh_ref, otocc_ref, meta_ref):
+        st2, ml2, mh2, tbl2, count2, n_fresh, ovf = _hash_insert_append(
+            cst_ref[:], cml_ref[:], cmh_ref[:], clv_ref[:] != 0,
+            st_ref[:], ml_ref[:], mh_ref[:], cnt_ref[0],
+            (tst_ref[:], tml_ref[:], tmh_ref[:], tocc_ref[:] != 0),
+            probe_limit, N)
+        ost_ref[:] = st2
+        oml_ref[:] = ml2
+        omh_ref[:] = mh2
+        otst_ref[:] = tbl2[0]
+        otml_ref[:] = tbl2[1]
+        otmh_ref[:] = tbl2[2]
+        otocc_ref[:] = tbl2[3].astype(I32)
+        meta_ref[:] = jnp.stack([count2.astype(I32),
+                                 n_fresh.astype(I32), ovf.astype(I32)])
+
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((N,), I32),
+                   jax.ShapeDtypeStruct((N,), U32),
+                   jax.ShapeDtypeStruct((N,), U32),
+                   jax.ShapeDtypeStruct((T,), I32),
+                   jax.ShapeDtypeStruct((T,), U32),
+                   jax.ShapeDtypeStruct((T,), U32),
+                   jax.ShapeDtypeStruct((T,), I32),
+                   jax.ShapeDtypeStruct((3,), I32)),
+        interpret=interpret,
+    )(c_st, c_ml, c_mh, c_live.astype(I32), st, ml, mh,
+      jnp.reshape(count, (1,)).astype(I32),
+      t_st, t_ml, t_mh, t_occ.astype(I32))
+    st2, ml2, mh2, tst2, tml2, tmh2, tocc2, meta = outs
+    return (st2, ml2, mh2, (tst2, tml2, tmh2, tocc2 != 0),
+            meta[0], meta[1], meta[2] != 0)

@@ -160,6 +160,19 @@ def test_fuzz_engines_agree_with_wgl(name, Model, gen):
                 # the deterministic pin)
                 engines["sparse-hash"] = lambda: engine.check_encoded(
                     e, max_capacity=1 << 15, dedupe="hash")
+                if seed == 0:
+                    # the fused-frontier-kernel arm of the same matrix
+                    # (tests/test_sparse_pallas.py is the deterministic
+                    # pin). First seed only: every distinct (R, S, C)
+                    # is its own interpret-kernel compile, and this
+                    # tier rides tier-1's budget; capacity tiers past
+                    # the kernel's VMEM gate degrade to the XLA hash
+                    # transparently (note-tagged), which is itself the
+                    # fallback contract under test
+                    engines["sparse-hash-pallas"] = \
+                        lambda: engine.check_encoded(
+                            e, max_capacity=1 << 15, dedupe="hash",
+                            sparse_pallas=True)
                 if dense.fits_dense(dense.n_states(e), e.n_slots):
                     engines["dense"] = lambda: dense.check_encoded_dense(e)
                 if bitdense.fits_bitdense(bitdense.n_states(e),

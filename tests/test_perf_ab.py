@@ -101,6 +101,26 @@ def test_disagreeing_flags_wrong_variants_only():
         {"while": [[ok, ok]], "fori": [[same, wrong]]}) == {"fori"}
 
 
+def test_perf_ab_dedupe_unknown_strategy_raises():
+    """A typo in PERF_AB_DEDUPE must abort the harness with the valid
+    set listed — a silently-skipped 'hash-palas' would read as
+    measured-and-lost on the chip session the flip decision waits on.
+    The check runs at module import, before any backend probe, so the
+    failure is fast and backend-independent."""
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env.update({"PERF_AB_DEDUPE": "sort,hash-palas",
+                "JAX_PLATFORMS": "cpu"})
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_ab.py")],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode != 0, r.stdout[-500:]
+    assert "unknown strategy" in r.stderr, r.stderr[-500:]
+    assert "hash-palas" in r.stderr
+    # the message must NAME the valid set, so the fix is in the error
+    assert "sort,hash,hash-pallas" in r.stderr, r.stderr[-500:]
+
+
 @pytest.mark.slow
 def test_perf_ab_emits_cost_table_on_cpu():
     """Full smoke run of the harness: the aggregated cost_table line

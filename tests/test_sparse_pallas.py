@@ -1,0 +1,316 @@
+"""Interpret-mode parity suite for the fused sparse frontier kernel
+(JEPSEN_TPU_SPARSE_PALLAS, parallel.sparse_kernels): the hash dedupe
+path through one VMEM-resident pallas_call per event closure
+(single-device) / per insert (sharded) must land verdict, failing op +
+event, max-frontier, capacity, explored, AND configs-stepped identical
+to both the sort strategy and the XLA hash strategy — across the
+sparse families, clean + corrupted, single-key / batch / pipelined /
+sharded / resumable — plus the probe-overflow -> capacity-escalation
+contract, the VMEM shape-gate fallback note, and the
+JEPSEN_TPU_SPARSE_PALLAS / JEPSEN_TPU_PROBE_LIMIT flag plumbing. The
+randomized arm (vs the WGL oracle) rides the fuzz tier
+(test_fuzz_differential's sparse-hash-pallas engine entry)."""
+
+import os
+import unittest.mock as mock
+
+import pytest
+
+from jepsen_tpu.histories import (adversarial_register_history,
+                                  corrupt_history, rand_fifo_history,
+                                  rand_gset_history, rand_queue_history,
+                                  rand_register_history)
+from jepsen_tpu.history import History, invoke_op, ok_op
+from jepsen_tpu.models import (CASRegister, FIFOQueue, GSet, Mutex,
+                               UnorderedQueue)
+from jepsen_tpu.parallel import (encode as enc_mod, engine,
+                                 sparse_kernels)
+
+# Everything order-independent must MATCH across the three
+# implementations (sort / XLA hash / pallas hash); only frontier ROW
+# ORDER may differ — and between the two hash forms not even that:
+# the kernel body is the same _hash_event_closure trace.
+PIN = ("valid?", "op", "fail-event", "max-frontier", "capacity",
+       "explored")
+
+
+def _pin(r):
+    return {k: r.get(k) for k in PIN}
+
+
+def _triple(e, capacity=128, max_capacity=4096):
+    """sort vs XLA hash vs pallas hash on one encoded history."""
+    rs = engine.check_encoded(e, capacity=capacity,
+                              max_capacity=max_capacity, dedupe="sort")
+    rh = engine.check_encoded(e, capacity=capacity,
+                              max_capacity=max_capacity, dedupe="hash")
+    rp = engine.check_encoded(e, capacity=capacity,
+                              max_capacity=max_capacity, dedupe="hash",
+                              sparse_pallas=True)
+    assert _pin(rs) == _pin(rh) == _pin(rp), (rs, rh, rp)
+    if rs["valid?"] != "unknown":
+        # the two hash forms share one trace: the advisory counter is
+        # bit-identical, not merely <= the sort path's
+        assert rp["configs-stepped"] == rh["configs-stepped"], (rh, rp)
+        assert rp["closure"] == "pallas", rp
+        assert "closure" not in rh, rh     # flag off => schema unchanged
+    return rs, rh, rp
+
+
+# same generators (and therefore the same compiled shapes) as
+# tests/test_dedupe.py's deterministic pin — the sort/XLA-hash programs
+# are shared with that module's jit cache; only the kernel variant
+# compiles fresh here
+FAMILIES = [
+    ("cas-register", CASRegister,
+     lambda: rand_register_history(n_ops=40, n_processes=5, n_values=3,
+                                   crash_p=0.06, fail_p=0.08, seed=31)),
+    ("gset", GSet,
+     lambda: rand_gset_history(n_ops=36, n_processes=4, n_elements=9,
+                               crash_p=0.06, seed=33)),
+    ("uqueue", UnorderedQueue,
+     lambda: rand_queue_history(n_ops=26, n_processes=4, n_values=3,
+                                crash_p=0.06, seed=34)),
+    ("fifo", FIFOQueue,
+     lambda: rand_fifo_history(n_ops=24, n_processes=4, n_values=3,
+                               crash_p=0.05, seed=35)),
+]
+
+
+@pytest.mark.parametrize("name,Model,gen", FAMILIES,
+                         ids=[c[0] for c in FAMILIES])
+def test_kernel_parity_clean_and_corrupted(name, Model, gen):
+    h = gen()
+    for variant in (h, corrupt_history(h, seed=7, n_corruptions=2)):
+        try:
+            e = enc_mod.encode(Model(), variant)
+        except enc_mod.EncodeError:
+            continue  # family/shape not device-encodable: nothing to pin
+        _triple(e)
+
+
+def test_kernel_parity_mutex_invalid():
+    h = History.wrap([
+        invoke_op(0, "acquire", None), ok_op(0, "acquire", None),
+        invoke_op(1, "acquire", None), ok_op(1, "acquire", None),
+    ]).index()
+    e = enc_mod.encode(Mutex(), h)
+    rs, _, _ = _triple(e, capacity=64, max_capacity=256)
+    assert rs["valid?"] is False
+
+
+def test_kernel_parity_adversarial_delta_counter():
+    """The acceptance shape: the kernel must report the same strict
+    configs-stepped reduction vs sort that the XLA hash path does."""
+    h = adversarial_register_history(n_ops=120, k_crashed=6, seed=7)
+    e = enc_mod.encode(CASRegister(), h)
+    rs, rh, rp = _triple(e, capacity=1024, max_capacity=4096)
+    assert rs["valid?"] is True
+    assert rp["configs-stepped"] < rs["configs-stepped"], (rs, rp)
+
+
+def test_probe_overflow_escalates_capacity_not_verdict():
+    """probe_limit=1 makes every collision a probe exhaustion INSIDE
+    the kernel — it must ride the capacity-escalation retry (bigger
+    table, lower load factor) to the sort verdict, never mis-verdict
+    or drop a config."""
+    h = rand_register_history(n_ops=50, n_processes=5, n_values=4,
+                              crash_p=0.05, fail_p=0.05, seed=11)
+    e = enc_mod.encode(CASRegister(), h)
+    ref = engine.check_encoded(e, capacity=64, dedupe="sort")
+    r1 = engine.check_encoded(e, capacity=64, max_capacity=1 << 14,
+                              dedupe="hash", probe_limit=1,
+                              sparse_pallas=True)
+    assert r1["valid?"] == ref["valid?"]
+    assert r1.get("op") == ref.get("op")
+    assert r1["capacity"] >= ref["capacity"]
+
+
+def test_vmem_shape_gate_falls_back_with_note():
+    """A capacity past the kernel's VMEM budget must degrade to the
+    XLA hash closure with closure="xla-hash" + a note — the bitdense
+    mesh-fallback precedent: the requested-kernel path degrades, it
+    never errors — and still produce the correct verdict."""
+    h = rand_register_history(n_ops=40, n_processes=5, n_values=3,
+                              crash_p=0.06, fail_p=0.08, seed=31)
+    e = enc_mod.encode(CASRegister(), h)
+    big = 16384
+    assert not sparse_kernels.supported(big, e.slot_f.shape[1])
+    ref = engine.check_encoded(e, capacity=big, dedupe="hash")
+    r = engine.check_encoded(e, capacity=big, dedupe="hash",
+                             sparse_pallas=True)
+    assert r["closure"] == "xla-hash"
+    assert "VMEM budget" in r["closure-note"]
+    assert r["valid?"] == ref["valid?"]
+    # the flag-off reference is tag-free: byte-identical schema
+    assert "closure" not in ref and "closure-note" not in ref
+
+
+def test_supported_budget_math():
+    """Pin the gate's accounting: 48 bytes of probe state per candidate
+    row (M = N*C) plus the frontier tile, against the 4 MiB budget."""
+    assert sparse_kernels.insert_supported(1024, 1024)
+    assert sparse_kernels.supported(1024, 14)          # bench-ish shape
+    assert not sparse_kernels.supported(16384, 7)
+    limit = sparse_kernels.VMEM_BUDGET // 48
+    assert sparse_kernels.insert_supported(limit - 64, 64)
+    assert not sparse_kernels.insert_supported(limit, 64)
+
+
+def test_env_flag_resolution_and_validation():
+    from jepsen_tpu.envflags import EnvFlagError
+    h = rand_register_history(n_ops=24, n_processes=3, crash_p=0.0,
+                              seed=5)
+    e = enc_mod.encode(CASRegister(), h)
+    # default: off, no tags
+    r = engine.check_encoded(e, capacity=64, dedupe="hash")
+    assert "closure" not in r
+    # JEPSEN_TPU_SPARSE_PALLAS=1 forces the kernel (interpret on CPU)
+    with mock.patch.dict(os.environ, {"JEPSEN_TPU_SPARSE_PALLAS": "1"}):
+        r = engine.check_encoded(e, capacity=64, dedupe="hash")
+    assert r["closure"] == "pallas" and r["valid?"] is True
+    # strict tri-state: anything else raises at the read site
+    with mock.patch.dict(os.environ,
+                         {"JEPSEN_TPU_SPARSE_PALLAS": "yes"}), \
+            pytest.raises(EnvFlagError, match="SPARSE_PALLAS"):
+        engine.check_encoded(e, capacity=64, dedupe="hash")
+    # the kernel is the hash path's form: requesting it under sort is
+    # a contradiction, loudly rejected (not silently ignored)
+    with pytest.raises(ValueError, match="dedupe='hash'"):
+        engine.check_encoded(e, capacity=64, dedupe="sort",
+                             sparse_pallas=True)
+
+
+def test_probe_limit_flag_one_knob_for_both_paths():
+    from jepsen_tpu.envflags import EnvFlagError
+    # explicit argument wins; unset flag -> default 32
+    assert engine._resolve_probe_limit(7) == 7
+    assert engine._resolve_probe_limit(0) == 32
+    with mock.patch.dict(os.environ, {"JEPSEN_TPU_PROBE_LIMIT": "3"}):
+        assert engine._resolve_probe_limit(0) == 3
+    for bad in ("0", "-2", "many"):
+        with mock.patch.dict(os.environ,
+                             {"JEPSEN_TPU_PROBE_LIMIT": bad}), \
+                pytest.raises(EnvFlagError, match="PROBE_LIMIT"):
+            engine._resolve_probe_limit(0)
+    # the flag reaches BOTH hash implementations: a 1-probe limit
+    # forces the same escalated capacity out of the XLA and the kernel
+    # path on a collision-heavy history
+    h = rand_register_history(n_ops=50, n_processes=5, n_values=4,
+                              crash_p=0.05, fail_p=0.05, seed=11)
+    e = enc_mod.encode(CASRegister(), h)
+    with mock.patch.dict(os.environ, {"JEPSEN_TPU_PROBE_LIMIT": "1"}):
+        rx = engine.check_encoded(e, capacity=64, max_capacity=1 << 14,
+                                  dedupe="hash")
+        rp = engine.check_encoded(e, capacity=64, max_capacity=1 << 14,
+                                  dedupe="hash", sparse_pallas=True)
+    ref = engine.check_encoded(e, capacity=64, max_capacity=1 << 14,
+                               dedupe="hash")
+    assert rx["capacity"] == rp["capacity"] >= ref["capacity"]
+    assert rx["valid?"] == rp["valid?"] == ref["valid?"]
+
+
+def test_batch_and_pipeline_thread_the_kernel():
+    """check_batch(sparse_pallas=True) must reach the sparse buckets in
+    both executors with results identical to the XLA hash path (modulo
+    the closure tag); bitdense buckets are untouched by the flag."""
+    regs = [rand_register_history(n_ops=24, n_processes=3, crash_p=0.02,
+                                  seed=600 + s) for s in range(3)]
+    fifo = rand_fifo_history(n_ops=36, n_processes=6, n_values=3,
+                             crash_p=0.15, seed=5)
+
+    rs = engine.check_batch(CASRegister(), regs, capacity=64,
+                            max_capacity=2048, dedupe="hash",
+                            sparse_pallas=True)
+    assert all(r["dedupe"] == "dense" for r in rs), rs
+
+    pre = [enc_mod.encode(FIFOQueue(), fifo)]
+    r_hash = engine._check_batch_sparse(FIFOQueue(), pre, 128, 2048,
+                                        dedupe="hash")[0]
+    r_pal = engine._check_batch_sparse(FIFOQueue(), pre, 128, 2048,
+                                       dedupe="hash",
+                                       sparse_pallas=True)[0]
+    strip = lambda r: {k: v for k, v in r.items()  # noqa: E731
+                       if k != "closure"}
+    assert strip(r_pal) == strip(r_hash), (r_pal, r_hash)
+    assert r_pal["closure"] == "pallas" and "closure" not in r_hash
+
+    stats = {}
+    rs_p = engine.check_batch(FIFOQueue(), [fifo], capacity=128,
+                              max_capacity=2048, pipeline=True,
+                              cache=False, pipeline_stats=stats,
+                              dedupe="hash", sparse_pallas=True)
+    assert stats["dedupe"] == "hash"
+    assert rs_p[0] == r_pal, (rs_p[0], r_pal)
+
+
+def test_resumable_kernel_matches_oneshot():
+    h = rand_register_history(n_ops=120, n_processes=6, n_values=4,
+                              crash_p=0.01, fail_p=0.05, busy=0.7,
+                              seed=10)
+    e = enc_mod.encode(CASRegister(), h)
+    ref = engine.check_encoded(e, capacity=256, dedupe="hash")
+    res = engine.check_encoded_resumable(e, capacity=256,
+                                         checkpoint_every=16,
+                                         dedupe="hash",
+                                         sparse_pallas=True)
+    assert res["valid?"] == ref["valid?"]
+    assert res["max-frontier"] == ref["max-frontier"]
+    assert res["configs-stepped"] == ref["configs-stepped"]
+    assert res["closure"] == "pallas"
+
+
+def test_sharded_1d_insert_kernel_parity():
+    """The sharded engine's per-device owned tables through the fused
+    insert kernel (1-D mesh): verdict/max-frontier/configs-stepped
+    identical to the XLA hash AND the sort strategies."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from jepsen_tpu.parallel import sharded
+
+    h = rand_register_history(n_ops=60, n_processes=6, n_values=4,
+                              crash_p=0.02, fail_p=0.05, seed=10)
+    e = enc_mod.encode(CASRegister(), h)
+    mesh = Mesh(np.array(jax.devices()), ("frontier",))
+    r_sort = sharded.check_encoded_sharded(e, mesh, capacity=512,
+                                           dedupe="sort")
+    r_hash = sharded.check_encoded_sharded(e, mesh, capacity=512,
+                                           dedupe="hash")
+    r_pal = sharded.check_encoded_sharded(e, mesh, capacity=512,
+                                          dedupe="hash",
+                                          sparse_pallas=True)
+    for k in ("valid?", "op", "fail-event", "max-frontier", "capacity"):
+        assert r_sort.get(k) == r_hash.get(k) == r_pal.get(k), \
+            (k, r_sort, r_hash, r_pal)
+    assert r_pal["configs-stepped"] == r_hash["configs-stepped"]
+    assert r_pal["closure"] == "pallas" and "closure" not in r_hash
+
+
+@pytest.mark.slow
+def test_sharded_2d_insert_kernel_parity():
+    """Hierarchical (slice x chip) exchange with the insert kernel —
+    slow tier: a fresh 2-D shard_map program is a 10s-class compile on
+    the CPU backend, and the 1-D case already pins the insert fusion;
+    this adds only the two-stage-routing composition."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from jepsen_tpu.parallel import sharded
+
+    h = rand_register_history(n_ops=60, n_processes=6, n_values=4,
+                              crash_p=0.02, fail_p=0.05, seed=10)
+    e = enc_mod.encode(CASRegister(), h)
+    mesh2d = Mesh(np.array(jax.devices()).reshape(2, 4),
+                  ("slice", "chip"))
+    r_hash = sharded.check_encoded_sharded(e, mesh2d, capacity=512,
+                                           dedupe="hash")
+    r_pal = sharded.check_encoded_sharded(e, mesh2d, capacity=512,
+                                          dedupe="hash",
+                                          sparse_pallas=True)
+    for k in ("valid?", "op", "fail-event", "max-frontier", "capacity",
+              "configs-stepped", "mesh"):
+        assert r_hash.get(k) == r_pal.get(k), (k, r_hash, r_pal)
+    assert r_pal["closure"] == "pallas"

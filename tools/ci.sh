@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# The repo's verification gate, in the order a reviewer runs it:
+#
+#   1. static analysis  — `python -m jepsen_tpu.analysis --check`
+#      (tracing-safety / recompile / concurrency lint; pure AST, no
+#      JAX init, exit 1 on any active finding — docs/linting.md)
+#   2. tier-1 tests     — the ROADMAP.md invocation verbatim: the
+#      full suite minus the slow tier on a virtual 8-device CPU mesh,
+#      under the documented 870s budget (timeout -k 10 870). The
+#      DOTS_PASSED line echoes the progress-dot count so a truncated
+#      run is visible even when pytest's summary is lost.
+#
+# Exits nonzero when either stage fails. README "Verifying a change"
+# points here; run from anywhere — the script cd's to the repo root.
+set -u
+cd "$(dirname "$0")/.." || exit 2
+
+echo "== lint gate =="
+python -m jepsen_tpu.analysis --check || exit 1
+
+echo "== tier-1 tests (870s budget) =="
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
+    | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+exit $rc

@@ -56,18 +56,25 @@ if _UNKNOWN:   # a typo must not silently skip a real variant
                      f"{sorted(_UNKNOWN)}; valid: while,fori,pallas")
 _VARIANTS.add("while")
 
-# PERF_AB_DEDUPE=sort,hash (default both) selects the sparse-engine
-# frontier-dedupe strategies the advisory A/B measures on the
-# single-key adversarial shapes — the one-command measurement the
-# JEPSEN_TPU_DEDUPE flip-to-default decision waits on. Same
+# PERF_AB_DEDUPE=sort,hash,hash-pallas (default all three) selects the
+# sparse-engine frontier-dedupe strategies the advisory A/B measures
+# on the single-key adversarial shapes — the one-command measurement
+# both the JEPSEN_TPU_DEDUPE and the JEPSEN_TPU_SPARSE_PALLAS
+# flip-to-default decisions wait on ("hash-pallas" = the hash strategy
+# through the fused VMEM frontier kernel, parallel.sparse_kernels;
+# measured only on shapes inside the kernel's VMEM gate). Same
 # skip-a-crashing-variant rationale as PERF_AB_VARIANTS; empty
-# (PERF_AB_DEDUPE=) skips the block entirely.
+# (PERF_AB_DEDUPE=) skips the block entirely. A typo raises with the
+# valid set listed — an unknown name silently skipped would read as
+# "measured and lost".
+_DEDUPE_VALID = ("sort", "hash", "hash-pallas")
 _DEDUPE = [v.strip() for v in os.environ.get(
-    "PERF_AB_DEDUPE", "sort,hash").split(",") if v.strip()]
-_UNKNOWN_D = set(_DEDUPE) - {"sort", "hash"}
+    "PERF_AB_DEDUPE", "sort,hash,hash-pallas").split(",") if v.strip()]
+_UNKNOWN_D = set(_DEDUPE) - set(_DEDUPE_VALID)
 if _UNKNOWN_D:
     raise SystemExit(f"PERF_AB_DEDUPE: unknown strategy(ies) "
-                     f"{sorted(_UNKNOWN_D)}; valid: sort,hash")
+                     f"{sorted(_UNKNOWN_D)}; valid: "
+                     f"{','.join(_DEDUPE_VALID)}")
 
 
 def _want(name: str) -> bool:
@@ -328,33 +335,51 @@ def main():
     # agree between strategies (the counters differ by design); a
     # mismatch vetoes the dedupe verdict like any correctness failure.
     dedupe_ratios = {}
+    sparse_pallas_ratios = {}
     dedupe_bad = set()
     if _DEDUPE:
         from jepsen_tpu.parallel import engine as eng_mod
+        from jepsen_tpu.parallel import sparse_kernels as sk
         # shape policy: the adversarial frontier peaks at ~10*2^k
         # configs, so full-k sparse runs cost minutes per strategy —
         # smoke (CPU) derates to k=6 (the delta asymptotics show at
         # any k; CI keeps the block exercised), the chip measures the
         # bench's real k at L=1000 (the representative sparse shape;
         # 10k at full k is tens of minutes per strategy and adds no
-        # new information to the flip decision)
+        # new information to the flip decision). The chip additionally
+        # measures k=8 (capacity 4096) — the largest full-support
+        # shape for the fused frontier kernel, whose VMEM gate excludes
+        # the 2^16-capacity k=12 shape; the sparse-pallas flip decision
+        # rides only shapes the kernel actually ran.
         if smoke:
             dedupe_shapes = [(L, 6) for L in adv_sizes]
         else:
-            dedupe_shapes = [(1000, 12)]
+            dedupe_shapes = [(1000, 12), (1000, 8)]
         for L, k_d in dedupe_shapes:
             e = enc_mod.encode(model, adversarial_register_history(
                 n_ops=L, k_crashed=k_d, seed=7))
             cap = 1 << (k_d + 4)     # peak ~10*2^k configs, one tier
+            shape_key = f"single-{L}@2^{k_d}"
             dres = {}
             dline = {"shape": f"single-key {L}-op adversarial "
                               f"sparse-dedupe (2^{k_d} open configs)"}
             for strat in _DEDUPE:
+                if strat == "hash-pallas":
+                    if not sk.supported(cap, e.slot_f.shape[1]):
+                        # measuring the note-and-fallback path would
+                        # time the XLA closure under the kernel's name
+                        dline["hash-pallas_skipped"] = (
+                            f"capacity {cap} past the kernel's VMEM "
+                            f"gate")
+                        continue
+                    kw = {"dedupe": "hash", "sparse_pallas": True}
+                else:
+                    kw = {"dedupe": strat}
                 t = _timed(dres, strat,
-                           lambda s=strat: eng_mod.check_encoded(
+                           lambda k=kw: eng_mod.check_encoded(
                                e, capacity=cap, max_capacity=cap * 4,
-                               dedupe=s),
-                           shape=f"dedupe-{L}")
+                               **k),
+                           shape=f"dedupe-{L}-2^{k_d}")
                 r0 = dres[strat][0]
                 dline[f"{strat}_secs"] = round(t, 3)
                 dline[f"{strat}_configs_stepped"] = \
@@ -362,16 +387,27 @@ def main():
             pin = lambda r: {k_: r.get(k_) for k_ in  # noqa: E731
                              ("valid?", "op", "fail-event",
                               "max-frontier")}
-            base = pin(dres[_DEDUPE[0]][0])
-            for strat, runs in dres.items():
-                if any(pin(r) != base for r in runs):
-                    dline[f"{strat}_mismatch"] = True
-                    dedupe_bad.add(strat)
+            if dres:
+                # dres can be empty: PERF_AB_DEDUPE=hash-pallas alone
+                # on a shape past the kernel's VMEM gate skips the
+                # only selected strategy — the line still emits (with
+                # the skip note), the harness must not die on it
+                base = pin(dres[next(iter(dres))][0])
+                for strat, runs in dres.items():
+                    if any(pin(r) != base for r in runs):
+                        dline[f"{strat}_mismatch"] = True
+                        dedupe_bad.add(strat)
             if "sort" in dres and "hash" in dres:
-                dedupe_ratios[f"single-{L}"] = \
+                dedupe_ratios[shape_key] = \
                     dline["sort_secs"] / max(dline["hash_secs"], 1e-9)
                 dline["hash_speedup"] = round(
-                    dedupe_ratios[f"single-{L}"], 2)
+                    dedupe_ratios[shape_key], 2)
+            if "hash" in dres and "hash-pallas" in dres:
+                sparse_pallas_ratios[shape_key] = (
+                    dline["hash_secs"]
+                    / max(dline["hash-pallas_secs"], 1e-9))
+                dline["hash_pallas_speedup"] = round(
+                    sparse_pallas_ratios[shape_key], 2)
             emit(dline)
 
     # ---- multi-key batch ----
@@ -517,6 +553,9 @@ def main():
         dedupe_verdict = ("no-verdict (non-tpu backend: cpu timings "
                           "don't flip defaults; the configs_stepped "
                           "counters stand on any backend)")
+        sparse_pallas_verdict = ("no-verdict (non-tpu backend: "
+                                 "interpret-mode kernel timings "
+                                 "measure the interpreter)")
     else:
         # a variant filtered out by PERF_AB_VARIANTS was not measured —
         # its verdict line must say so, never a definitive keep/flip
@@ -556,14 +595,31 @@ def main():
                               if dedupe_ratios
                               and min(dedupe_ratios.values()) >= 1.1
                               else "keep-sort")
+        if not ({"hash", "hash-pallas"} <= set(_DEDUPE)):
+            sparse_pallas_verdict = ("not-measured (a strategy skipped "
+                                     "by PERF_AB_DEDUPE)")
+        elif dedupe_bad & {"hash", "hash-pallas"}:
+            sparse_pallas_verdict = ("keep-opt-in (STRATEGY VETOED — "
+                                     "see the *_mismatch keys on the "
+                                     "sparse-dedupe lines)")
+        else:
+            sparse_pallas_verdict = (
+                "default-on"
+                if sparse_pallas_ratios
+                and min(sparse_pallas_ratios.values()) >= 1.1
+                else "keep-opt-in")
     emit({"backend": backend, "verdict": verdict,
           "fori_verdict": fori_verdict,
           "dedupe_verdict": dedupe_verdict,
+          "sparse_pallas_verdict": sparse_pallas_verdict,
           "variants_measured": sorted(_VARIANTS),
           "dedupe_measured": sorted(_DEDUPE),
           "ratios": {k: round(v, 2) for k, v in ratios.items()},
           "dedupe_ratios": {k: round(v, 2)
                             for k, v in dedupe_ratios.items()},
+          "sparse_pallas_ratios": {k: round(v, 2)
+                                   for k, v in
+                                   sparse_pallas_ratios.items()},
           "fori_ratios": {k: round(v, 2) for k, v in fori_ratios.items()},
           "rule": "pallas default-on iff it wins >=1.1x on EVERY "
                   "measured shape on the tpu backend AND never "
@@ -575,7 +631,11 @@ def main():
                   "default (engine._resolve_dedupe) under the same "
                   ">=1.1x-on-every-shape + never-disagreed rule, "
                   "measured on the sparse engine's sparse-dedupe "
-                  "lines above"})
+                  "lines above; hash-pallas (the fused VMEM frontier "
+                  "kernel, vs the XLA hash strategy, on the shapes "
+                  "inside the kernel's VMEM gate) flips "
+                  "JEPSEN_TPU_SPARSE_PALLAS's default "
+                  "(engine._resolve_sparse_pallas) under the same rule"})
 
 
 if __name__ == "__main__":
