@@ -958,13 +958,18 @@ def child_main(argv: list) -> None:
         argv = argv[:i] + argv[i + 2:]
     sec = argv[0]
     faulthandler.dump_traceback_later(max(20, to - 10), exit=False)
-    from jepsen_tpu import envflags
-    if (envflags.env_bool("JEPSEN_TPU_TEST_WEDGE", default=False)
+    from jepsen_tpu.resilience import faults as _faults
+    _wedge = _faults.decide("child")
+    if (_wedge is not None and _wedge.kind == "wedge"
             and os.environ.get("JAX_PLATFORMS") != "cpu"):
-        # test seam: simulate the observed tunnel wedge (PJRT client
-        # creation blocking forever, uninterruptible by Python
-        # signals) in every child not pinned to cpu — mirroring
-        # production, where cpu-pinned children survive an outage
+        # fault seam (resilience.faults, site "child"): simulate the
+        # observed tunnel wedge (PJRT client creation blocking
+        # forever, uninterruptible by Python signals) in every child
+        # not pinned to cpu — mirroring production, where cpu-pinned
+        # children survive an outage. JEPSEN_TPU_FAULTS=wedge@child
+        # drives it; the legacy JEPSEN_TPU_TEST_WEDGE=1 maps onto the
+        # same rule (faults.active_plan), so existing automation keeps
+        # working.
         import time
         while True:
             time.sleep(3600)

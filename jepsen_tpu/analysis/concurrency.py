@@ -17,6 +17,19 @@ env-flag-accessor
     jepsen_tpu.envflags (the validated accessor). A raw
     os.environ/os.getenv read reintroduces the round-5 failure mode:
     a malformed value silently flipping a measured default.
+
+concurrency-unsupervised-dispatch
+    Every call to a device-dispatch entry point (the jitted
+    _check_device*/_check_bitdense*/_check_sharded* functions) must
+    run inside a thunk handed to resilience.supervisor.dispatch — the
+    seam where fault injection, the watchdog, and the circuit breaker
+    live. Roots are callables passed to a `dispatch(...)` call (same
+    resolution as the thread-root detector); an entry-point call NOT
+    reachable from such a root is a dispatch the resilience layer
+    cannot see: it would hang forever on the r05 wedge signature and
+    its failures would never trip the breaker. The usual
+    `# jepsen-lint: disable=` escape applies (e.g. deliberate
+    benchmarking of the bare program).
 """
 
 from __future__ import annotations
@@ -133,6 +146,78 @@ def _race_findings(sf: SourceFile) -> List[Finding]:
     return findings
 
 
+# ------------------------------------------- supervised-dispatch seam
+
+# the jitted device-dispatch entry points (engine / bitdense / sharded)
+# whose every call must sit inside a supervisor.dispatch thunk
+_DISPATCH_ENTRIES = {
+    "_check_device", "_check_device_batch", "_check_device_resumable",
+    "_check_bitdense", "_check_bitdense_batch",
+    "_check_sharded", "_check_sharded2d", "_check_sharded_resume",
+}
+
+
+def _supervised_roots(sf: SourceFile) -> List[FuncInfo]:
+    """Callables passed (positionally or by keyword) to a call whose
+    dotted name ends in `dispatch` — the supervisor seam's thunks.
+    Same resolution machinery as the thread-root detector above."""
+    mod_funcs = core.module_functions(sf)
+    by_node = {f.node: f for f in sf.functions}
+    roots: List[FuncInfo] = []
+
+    def add(node: ast.AST, scope: Optional[FuncInfo]):
+        if isinstance(node, ast.Lambda):
+            fi = by_node.get(node)
+            if fi is not None:
+                roots.append(fi)
+        elif isinstance(node, ast.Name):
+            fi = (scope.resolve(node.id, mod_funcs) if scope is not None
+                  else mod_funcs.get(node.id))
+            if fi is not None:
+                roots.append(fi)
+        elif isinstance(node, ast.Attribute):
+            roots.extend(f for f in sf.functions
+                         if f.is_method and f.name == node.attr)
+
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = sf.dotted(node.func) or ""
+        if dotted.split(".")[-1] != "dispatch":
+            continue
+        scope = sf.func_of(node)
+        for arg in node.args:
+            add(arg, scope)
+        for kw in node.keywords:
+            if kw.arg == "thunk":
+                add(kw.value, scope)
+    return roots
+
+
+def _dispatch_findings(sf: SourceFile) -> List[Finding]:
+    calls = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call):
+            dotted = sf.dotted(node.func) or ""
+            if dotted.split(".")[-1] in _DISPATCH_ENTRIES:
+                calls.append((node, dotted))
+    if not calls:
+        return []
+    reachable = core.reach(sf, _supervised_roots(sf))
+    findings: List[Finding] = []
+    for node, dotted in calls:
+        fi = sf.func_of(node)
+        if fi is not None and fi in reachable:
+            continue
+        findings.append(sf.finding(
+            "concurrency-unsupervised-dispatch", node,
+            f"`{dotted}(...)` dispatched outside the "
+            f"resilience.supervisor seam — wrap it in a thunk passed "
+            f"to supervisor.dispatch(site, ...) so the watchdog, "
+            f"fault injection, and circuit breaker can see it"))
+    return findings
+
+
 # ---------------------------------------------------- env-flag hygiene
 
 def _env_findings(sf: SourceFile) -> List[Finding]:
@@ -168,4 +253,5 @@ def _env_findings(sf: SourceFile) -> List[Finding]:
 
 
 def check(sf: SourceFile) -> List[Finding]:
-    return _race_findings(sf) + _env_findings(sf)
+    return (_race_findings(sf) + _dispatch_findings(sf)
+            + _env_findings(sf))

@@ -56,6 +56,10 @@ RULES: Dict[str, str] = {
     "concurrency-unlocked-shared-write":
         "attribute/global write to an object shared across threads "
         "with no lock in scope",
+    "concurrency-unsupervised-dispatch":
+        "direct call to a device-dispatch entry point outside the "
+        "resilience.supervisor seam — faults, watchdog, and breaker "
+        "cannot see it (wrap in supervisor.dispatch(site, thunk))",
     "env-flag-accessor":
         "JEPSEN_TPU_* environment variable read outside "
         "jepsen_tpu.envflags — all flag reads go through the validated "

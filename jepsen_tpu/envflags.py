@@ -106,6 +106,37 @@ def env_int(name: str, default: Optional[int] = None,
     return v
 
 
+def env_float(name: str, default: Optional[float] = None,
+              min_value: Optional[float] = None,
+              what: str = "value") -> Optional[float]:
+    """A float flag (seconds-style knobs). Unset -> ``default``; a
+    non-numeric value or one below ``min_value`` raises
+    :class:`EnvFlagError` — a malformed watchdog timeout must not
+    silently disable the watchdog (the exact no-op failure the whole
+    module exists to prevent)."""
+    raw = env_raw(name)
+    if raw is None:
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        raise EnvFlagError(
+            f"{name}={raw!r}: must be a number {what}; unset the "
+            f"variable to get the default")
+    import math
+    if not math.isfinite(v):
+        # float() happily parses "inf"/"nan", but a non-finite
+        # watchdog/backoff would blow up far from the read site
+        # (Thread.join(inf) raises OverflowError per dispatch) — the
+        # exact silent-misconfiguration mode this accessor prevents
+        raise EnvFlagError(
+            f"{name}={raw!r}: {what} must be finite")
+    if min_value is not None and v < min_value:
+        raise EnvFlagError(
+            f"{name}={raw!r}: {what} must be >= {min_value}")
+    return v
+
+
 def env_path(name: str, what: str = "path") -> Optional[str]:
     """A tri-state *destination* flag: unset or ``"0"`` -> ``None``
     (feature off), ``"1"`` -> ``""`` (feature on, caller picks the
@@ -166,8 +197,38 @@ def env_path(name: str, what: str = "path") -> Optional[str]:
 #                            until bench records a win
 #   JEPSEN_TPU_ENCODE_CACHE  env_int     parallel.pipeline — encode
 #                            cache capacity in entries (0 disables)
-#   JEPSEN_TPU_TEST_WEDGE    env_bool    bench — test seam simulating
-#                            a wedged PJRT runtime
+#   JEPSEN_TPU_TEST_WEDGE    env_bool    resilience.faults — legacy
+#                            alias for the bench child-wedge seam; =1
+#                            now injects an implicit `wedge@child`
+#                            fault rule (prefer JEPSEN_TPU_FAULTS)
+#   JEPSEN_TPU_FAULTS        env_raw     resilience.faults — the
+#                            deterministic fault-injection plan:
+#                            comma-separated `<kind>@<site>[:<count>]`
+#                            specs (`wedge@dispatch:2`,
+#                            `raise@transfer:every=3`,
+#                            `flaky@search:n=1`); validated by
+#                            faults.parse_spec — a malformed spec
+#                            raises FaultSpecError (an EnvFlagError),
+#                            never a silent no-op
+#   JEPSEN_TPU_WATCHDOG      env_float   resilience.supervisor —
+#                            bounded wait (seconds) on every
+#                            supervised device dispatch; a dispatch
+#                            past the bound raises DispatchWedged
+#                            instead of hanging the process (the r05
+#                            make_c_api_client signature). Unset/0 =
+#                            off (the supervised call is a near-zero-
+#                            overhead passthrough)
+#   JEPSEN_TPU_DISPATCH_RETRIES env_int  resilience.supervisor — extra
+#                            attempts after a transient dispatch
+#                            failure while the breaker stays closed
+#                            (default 1, min 0)
+#   JEPSEN_TPU_BREAKER_THRESHOLD env_int resilience.breaker —
+#                            consecutive dispatch failures that open a
+#                            backend's circuit breaker (default 3,
+#                            min 1)
+#   JEPSEN_TPU_BREAKER_BACKOFF env_float resilience.breaker — base
+#                            open-state backoff seconds (default 1.0;
+#                            doubles per re-open, jittered, capped)
 #   JEPSEN_TPU_TRACE         env_path    obs — span tracing: "0"/unset
 #                            off (a true no-op), "1" on (artifacts land
 #                            in the store run dir / bench trace dir),

@@ -324,11 +324,23 @@ class IndependentChecker(Checker):
                 # propagate the span context so each key's span nests
                 # under independent.check
                 wrap = obs.ctx_runner()
+                checker = self.checker
+                if fallback is not None and fallback.get("no-redispatch"):
+                    # the backend's breaker is open: the per-key path
+                    # must NOT re-dispatch against it (that is the
+                    # breaker's whole contract) — per-key checks run a
+                    # host-only algorithm until the breaker's recovery
+                    # probe readmits the device
+                    from jepsen_tpu.checker.linearizable import \
+                        Linearizable
+                    model = (self.checker.model
+                             or (test or {}).get("model"))
+                    checker = Linearizable(model, algorithm="packed")
 
                 def check_key(k):
                     with obs.span("independent.key", key=str(k)):
                         return (k, check_safe(
-                            self.checker, test, subs[k],
+                            checker, test, subs[k],
                             {**opts,
                              "subdirectory":
                                  list(opts.get("subdirectory", []))
@@ -348,18 +360,26 @@ class IndependentChecker(Checker):
             "failures": failures,
         }
         if fallback is not None:
-            out["device-fallback"] = fallback
+            # the reason stays a plain string under the historical key
+            # (operators and tests grep it); the structured form —
+            # class, backend, breaker interaction — rides "resilience"
+            out["device-fallback"] = fallback["reason"]
+            out["resilience"] = fallback
         return out
 
     # -- device batch fast path
     def _batched_device_results(self, test, subs):
-        """(results, fallback-reason): results is None when the host
-        per-key path should run. A None fallback-reason means the
-        device path was simply not applicable (non-device checker,
-        unpackable model); a string means the device path was attempted
-        and FAILED — that is a loud event (warning + result tag), since
-        silently degrading to the host checker would hide a TPU
-        regression behind a 100-300x slowdown."""
+        """(results, fallback): results is None when the host per-key
+        path should run. A None fallback means the device path was
+        simply not applicable (non-device checker, unpackable model);
+        otherwise it is a structured dict — {"reason", "class",
+        "backend", "no-redispatch"} — saying the device path was
+        attempted and FAILED (or was breaker-refused without an
+        attempt). That is a loud event (warning + result tag + a
+        class-labeled counter), since silently degrading to the host
+        checker would hide a TPU regression behind a 100-300x
+        slowdown. "no-redispatch" tells check() the backend's breaker
+        is open, so the per-key path must not dispatch against it."""
         from jepsen_tpu.checker.linearizable import Linearizable
         c = self.checker
         if not (self.batch_device and isinstance(c, Linearizable)
@@ -372,18 +392,35 @@ class IndependentChecker(Checker):
         from jepsen_tpu.history import Intern
         from jepsen_tpu.parallel import engine
         from jepsen_tpu.parallel.encode import EncodeError
+        from jepsen_tpu.resilience import breaker as breaker_mod
+        from jepsen_tpu.resilience import supervisor as sup
         try:
             packable = model_ns.pack_spec(model, Intern()) is not None
         except Exception:  # noqa: BLE001 - spec probe blowing up is just
             packable = False  # "not packable": quiet host path, not a crash
         if not packable:
             return None, None
+        # a mesh on the test map shards the key axis across devices
+        # and lets overflow keys escalate to the frontier-sharded
+        # engine (engine._escalate_overflow)
+        mesh = (test or {}).get("mesh")
+        if mesh is not None:
+            import numpy as np
+            backend = np.asarray(mesh.devices).flat[0].platform
+        else:
+            import jax
+            backend = jax.default_backend()
+        if breaker_mod.any_tripped():
+            # consult the breaker BEFORE touching the device: an open
+            # breaker means dispatch is refused outright (allow() runs
+            # the half-open recovery probe when the backoff elapsed —
+            # a recovered runtime readmits itself here)
+            allowed, why = breaker_mod.breaker_for(backend).allow()
+            if not allowed:
+                return None, self._fallback("breaker-open", why,
+                                            backend, skip=True)
         try:
             ks = list(subs)
-            # a mesh on the test map shards the key axis across devices
-            # and lets overflow keys escalate to the frontier-sharded
-            # engine (engine._escalate_overflow)
-            mesh = (test or {}).get("mesh")
             with obs.span("independent.device_batch", keys=len(ks)):
                 rs = engine.check_batch(model, [subs[k] for k in ks],
                                         mesh=mesh, pipeline=self.pipeline,
@@ -393,19 +430,43 @@ class IndependentChecker(Checker):
             # legitimately not device-encodable (a gset key past the
             # 31-element budget, a > 64-slot crash pile-up): the host
             # path is correct but 100-300x slower, so still say so
-            reason = f"not device-encodable: {err}"
-            obs.counter("independent.device_fallbacks").inc()
+            return None, self._fallback(
+                "not-encodable", f"not device-encodable: {err}",
+                backend, skip=True)
+        except sup.DISPATCH_FAILURES as err:
+            cls = ("wedged" if isinstance(err, sup.DispatchWedged)
+                   else "breaker-open"
+                   if isinstance(err, sup.DeviceUnavailable)
+                   else "dispatch-error")
+            return None, self._fallback(
+                cls, f"{type(err).__name__}: {err}", backend)
+        except Exception as err:  # noqa: BLE001 - host path still checks
+            return None, self._fallback(
+                "dispatch-error", f"{type(err).__name__}: {err}",
+                backend)
+
+    @staticmethod
+    def _fallback(cls: str, reason: str, backend, skip=False) -> dict:
+        """One structured fallback record + its labeled counters.
+        `skip` marks paths where the device was never dispatched
+        (breaker refusal, un-encodable) vs an attempted-and-FAILED
+        dispatch, which warns louder."""
+        from jepsen_tpu.resilience import breaker as breaker_mod
+        obs.counter("independent.device_fallbacks").inc()
+        obs.counter(f"independent.device_fallbacks.{cls}").inc()
+        open_now = (cls == "breaker-open"
+                    or breaker_mod.breaker_for(backend).state
+                    != breaker_mod.CLOSED)
+        if skip:
             log.warning("device batch check skipped (%s) — using the "
                         "host per-key checker", reason)
-            return None, reason
-        except Exception as err:  # noqa: BLE001 - host path still checks
-            reason = f"{type(err).__name__}: {err}"
-            obs.counter("independent.device_fallbacks").inc()
+        else:
             log.warning(
                 "device batch check FAILED (%s) — falling back to the "
                 "host per-key checker; results will be correct but the "
                 "TPU path is broken", reason)
-            return None, reason
+        return {"class": cls, "reason": reason, "backend": backend,
+                "no-redispatch": open_now}
 
     # -- results/history persistence per key (independent.clj:292-300)
     def _persist(self, test, opts, subs, results):

@@ -39,6 +39,7 @@ from jepsen_tpu import envflags
 from jepsen_tpu import obs
 from jepsen_tpu.parallel.encode import EncodedHistory
 from jepsen_tpu.parallel.steps import STEPS
+from jepsen_tpu.resilience import supervisor as sup
 
 _log = logging.getLogger(__name__)
 
@@ -371,8 +372,9 @@ def check_encoded_bitdense(e: EncodedHistory,
     from jepsen_tpu.parallel.dense import _xs_dense
     S = n_states(e)
     C = max(5, e.n_slots)  # at least one full word
+    platform = jax.default_backend()
     use_pallas, interpret = _resolve_use_pallas(
-        use_pallas, S, C, jax.default_backend())
+        use_pallas, S, C, platform)
     closure_mode = _resolve_closure_mode(closure_mode, use_pallas)
     xs = _xs_dense(e, C)
     if timings is not None:
@@ -384,11 +386,17 @@ def check_encoded_bitdense(e: EncodedHistory,
         t0 = perf_counter()
     with obs.span("bitdense.check", S=S, C=C), \
             obs.device_annotation(f"bitdense single S{S} C{C}"):
-        valid, fail_r = _check_bitdense(xs, jnp.int32(e.state0),
-                                        e.step_name, S, C, e.state_lo,
-                                        use_pallas, interpret,
-                                        closure_mode)
-        valid_b = bool(valid)  # materializes: the device wait ends here
+        def _search():
+            valid, fail_r = _check_bitdense(xs, jnp.int32(e.state0),
+                                            e.step_name, S, C,
+                                            e.state_lo, use_pallas,
+                                            interpret, closure_mode)
+            # bool() materializes: async failures/hangs surface inside
+            # the supervised window (the device wait ends here)
+            return bool(valid), fail_r
+
+        valid_b, fail_r = sup.dispatch("dispatch", _search,
+                                       backend=platform)
     if timings is not None:
         timings["device_secs"] = perf_counter() - t0
     out = {"valid?": valid_b, "engine": "bitdense",
@@ -498,7 +506,7 @@ class PendingBitdenseBatch:
 
     def __init__(self, encs, xs, state0, S, C, up, interpret, mode,
                  n_dev, use_pallas_arg, closure_mode_arg,
-                 transfer_secs):
+                 transfer_secs, platform=None):
         self.encs = encs
         self.xs = xs
         self.state0 = state0
@@ -511,6 +519,7 @@ class PendingBitdenseBatch:
         self.use_pallas_arg = use_pallas_arg
         self.closure_mode_arg = closure_mode_arg
         self.transfer_secs = transfer_secs
+        self.platform = platform
         self.device_wait_secs = None
         self.note = None
         self._results = None
@@ -527,14 +536,40 @@ class PendingBitdenseBatch:
             f"bitdense K{len(self.encs)} S{self.S} C{self.C}")
         try:
             with ann:
-                self._valid, self._fail_r = _check_bitdense_batch(
-                    self.xs, self.state0, self.encs[0].step_name, self.S,
-                    self.C, self.encs[0].state_lo, self.up,
-                    self.interpret, self.mode)
+                # supervised (resilience.supervisor): faults inject
+                # here, the breaker records the outcome; the program is
+                # ISSUED inside the window, the async wait is
+                # finalize()'s own supervised window
+                self._valid, self._fail_r = sup.dispatch(
+                    "dispatch",
+                    lambda: _check_bitdense_batch(
+                        self.xs, self.state0, self.encs[0].step_name,
+                        self.S, self.C, self.encs[0].state_lo, self.up,
+                        self.interpret, self.mode),
+                    backend=self.platform)
         except Exception:  # noqa: BLE001 — see _fallback_or_raise
             self._fallback_or_raise()
 
     def _fallback_or_raise(self):
+        import sys
+
+        err = sys.exc_info()[1]
+        # supervised-dispatch failures (injected faults, watchdog
+        # wedges, an open breaker) are NOT pallas lowering gaps: they
+        # re-raise untouched so the callers' degradation contract —
+        # host fallback with a structured resilience note — takes
+        # over instead of a misdiagnosed closure fallback. EXCEPT a
+        # DeviceUnavailable that merely WRAPS a real thunk error
+        # (supervisor retry budget exhausted): the original error may
+        # be exactly the Mosaic lowering gap this fallback exists for,
+        # and the cheap XLA-closure downgrade must not silently turn
+        # into a 100-300x host degrade just because a watchdog was
+        # configured — unwrap and judge the original.
+        if isinstance(err, sup.DeviceUnavailable) \
+                and err.cause is not None:
+            err = err.cause
+        elif isinstance(err, sup.DISPATCH_FAILURES):
+            raise
         # The r5 hardware window measured the SPMD pallas lowering on a
         # 1-device TPU mesh only; the multi-device slicing is
         # differential-tested on CPU meshes but its Mosaic lowering is
@@ -551,8 +586,6 @@ class PendingBitdenseBatch:
         # shadow the real pallas error here (short-circuit skips it);
         # with use_pallas=None a malformed value already raised in
         # _resolve_use_pallas before the dispatch.
-        import sys
-        err = sys.exc_info()[1]
         if not (self.up and self.use_pallas_arg is None
                 and self.n_dev > 1
                 and envflags.env_bool("JEPSEN_TPU_PALLAS") is not True):
@@ -568,10 +601,13 @@ class PendingBitdenseBatch:
                      f"mesh ({type(err).__name__}); fell back to the "
                      f"xla-{self.mode} closure (multi-device Mosaic "
                      f"lowering is unmeasured)")
-        self._valid, self._fail_r = _check_bitdense_batch(
-            self.xs, self.state0, self.encs[0].step_name, self.S,
-            self.C, self.encs[0].state_lo, False, self.interpret,
-            self.mode)
+        self._valid, self._fail_r = sup.dispatch(
+            "dispatch",
+            lambda: _check_bitdense_batch(
+                self.xs, self.state0, self.encs[0].step_name, self.S,
+                self.C, self.encs[0].state_lo, False, self.interpret,
+                self.mode),
+            backend=self.platform)
 
     def finalize(self) -> list:
         if self._results is not None:
@@ -580,10 +616,15 @@ class PendingBitdenseBatch:
         # bitdense.finalize span IS the device_wait_secs clock reads
         with obs.timer("bitdense.finalize", keys=len(self.encs)) as tm:
             try:
-                # materialize inside the try: async dispatch surfaces
-                # runtime failures here, not at the issue
-                valid = np.asarray(self._valid)
-                fail_r = np.asarray(self._fail_r)
+                # materialize inside the try (and inside a supervised
+                # window: this wait is where a wedged runtime actually
+                # hangs): async dispatch surfaces runtime failures
+                # here, not at the issue
+                valid, fail_r = sup.dispatch(
+                    "dispatch",
+                    lambda: (np.asarray(self._valid),
+                             np.asarray(self._fail_r)),
+                    backend=self.platform)
             except Exception:  # noqa: BLE001 — same gate as at issue
                 self._fallback_or_raise()
                 valid = np.asarray(self._valid)
@@ -619,19 +660,23 @@ def dispatch_batch_bitdense(encs, mesh=None, use_pallas: bool = None,
     chunk's local max n_returns would be its own compile."""
     from jepsen_tpu.parallel.encode import pad_batch
     obs.counter("bitdense.dispatches").inc()
-    # obs.timer: one clock-read pair serves both the recorded span and
-    # the transfer_secs the stats/bench lines report — they cannot
-    # disagree (the same contract bench.py rides)
-    with obs.timer("bitdense.pad_place", keys=len(encs)) as tm:
-        xs, state0, S, C, R = pad_batch(encs, mesh=mesh,
-                                        min_slots=min_slots,
-                                        min_states=min_states,
-                                        min_returns=min_returns)
-    transfer_secs = tm.wall
     # gate on where the batch actually lives: pad_batch pins it to the
     # mesh when one is given, regardless of the process default backend
     platform = (mesh.devices.flat[0].platform if mesh is not None
                 else jax.default_backend())
+    # obs.timer: one clock-read pair serves both the recorded span and
+    # the transfer_secs the stats/bench lines report — they cannot
+    # disagree (the same contract bench.py rides). The placement runs
+    # through the supervised seam (site "transfer"): H2D against a
+    # wedged runtime hangs exactly like a dispatch does.
+    with obs.timer("bitdense.pad_place", keys=len(encs)) as tm:
+        xs, state0, S, C, R = sup.dispatch(
+            "transfer",
+            lambda: pad_batch(encs, mesh=mesh, min_slots=min_slots,
+                              min_states=min_states,
+                              min_returns=min_returns),
+            backend=platform)
+    transfer_secs = tm.wall
     # Mesh-sharded TPU batches follow the same default as the rest
     # (_resolve_use_pallas: ON for a real-TPU platform). The guard that
     # used to pin them to XLA came off with the r5 on-chip measurement:
@@ -649,7 +694,7 @@ def dispatch_batch_bitdense(encs, mesh=None, use_pallas: bool = None,
     n_dev = 1 if mesh is None else int(np.asarray(mesh.devices).size)
     return PendingBitdenseBatch(encs, xs, state0, S, C, up, interpret,
                                 mode, n_dev, use_pallas, closure_mode,
-                                transfer_secs)
+                                transfer_secs, platform=platform)
 
 
 def check_batch_bitdense(encs, mesh=None, use_pallas: bool = None,

@@ -42,6 +42,7 @@ from jepsen_tpu import obs
 from jepsen_tpu.parallel import encode as enc_mod
 from jepsen_tpu.parallel.encode import EncodedHistory, EncodeError
 from jepsen_tpu.parallel.steps import STEPS
+from jepsen_tpu.resilience import supervisor as sup
 
 _log = logging.getLogger(__name__)
 
@@ -690,7 +691,8 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
                             device=None,
                             dedupe: Optional[str] = None,
                             probe_limit: int = 0,
-                            sparse_pallas: Optional[bool] = None) -> dict:
+                            sparse_pallas: Optional[bool] = None,
+                            model=None) -> dict:
     """check_encoded with mid-search checkpointing: events are processed
     in chunks of `checkpoint_every`; after each chunk the frontier is
     pulled to host and handed to checkpoint_cb(FrontierCheckpoint) (e.g.
@@ -698,7 +700,17 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
     inside a chunk re-runs that chunk at doubled capacity — the
     checkpoint taken before the chunk stays valid. With `device`, every
     chunk and resumed carry is explicitly placed there — same invariant
-    as check_encoded(device=...): never the default backend."""
+    as check_encoded(device=...): never the default backend.
+
+    Degradation contract (docs/resilience.md): every chunk dispatch
+    runs through the supervised seam. A dispatch failure mid-search
+    never loses work or flips a verdict — the checkpoint taken before
+    the failing chunk is the recovery point: first ONE device retry
+    (the breaker's half-open probe gets to readmit a recovered
+    runtime), then, with `model` given, the remaining events resume on
+    the host from the checkpoint (resilience.recovery.host_resume);
+    without a model the failure re-raises with ``.checkpoint``
+    attached so the caller can resume later."""
     if e.n_returns == 0:
         return {"valid?": True, "max-frontier": 0, "capacity": 0}
     dedupe = _resolve_dedupe(dedupe)
@@ -729,6 +741,7 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
     }
     R = e.n_returns
     mode, note = "off", None
+    recovered = None
     while cp.event_index < R and cp.ok:
         lo = cp.event_index
         hi = min(R, lo + checkpoint_every)
@@ -738,10 +751,48 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
         mode, note = _resolve_sparse_pallas(
             sparse_pallas, cp.capacity, e.slot_f.shape[1], platform,
             dedupe)
-        chunk = _place({k: v[lo:hi] for k, v in xs_np.items()}, device)
-        carry, overflow = _check_device_resumable(
-            chunk, cp.carry(device), e.step_name, cp.capacity, dedupe,
-            probe_limit, mode)
+
+        def _chunk(lo=lo, hi=hi, cp=cp, mode=mode):
+            chunk = _place({k: v[lo:hi] for k, v in xs_np.items()},
+                           device)
+            carry, overflow = _check_device_resumable(
+                chunk, cp.carry(device), e.step_name, cp.capacity,
+                dedupe, probe_limit, mode)
+            # materialize inside the supervised window: async dispatch
+            # must fail (or hang) here, not at a later host read
+            return ([np.asarray(x) for x in carry], bool(overflow))
+
+        try:
+            carry, overflow = sup.dispatch("search", _chunk,
+                                           backend=platform)
+        except sup.DISPATCH_FAILURES as err:
+            # the checkpoint taken before this chunk is the recovery
+            # point: one device retry first (a recovered runtime —
+            # half-open probe passed, transient cleared — resumes
+            # right where it stopped, zero work lost) ...
+            try:
+                obs.counter("resilience.retries").inc()
+                with obs.span("resilience.device_resume",
+                              event=cp.event_index):
+                    carry, overflow = sup.dispatch("search", _chunk,
+                                                   backend=platform)
+                recovered = {
+                    "degraded": "device-resume",
+                    "site": getattr(err, "site", "search"),
+                    "reason": f"{type(err).__name__}: {err}",
+                    "resumed-from-event": cp.event_index}
+            except sup.DISPATCH_FAILURES as err2:
+                # ... then the host: with a model the remaining events
+                # resume from the checkpoint on the WGL path — verdict
+                # preserved, device progress kept
+                if model is not None:
+                    from jepsen_tpu.resilience import recovery
+                    return recovery.host_resume(
+                        model, e, cp, getattr(err2, "site", "search"),
+                        f"{type(err2).__name__}: {err2}",
+                        backend=platform)
+                err2.checkpoint = cp
+                raise
         if bool(overflow):
             if cp.capacity * 2 > max_capacity:
                 return _tag_sparse_closure(
@@ -768,6 +819,8 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
            # approximate when capacity grew mid-search: iterations from
            # earlier chunks ran at smaller capacities
            "explored": cp.steps_n * cp.capacity * len(e.slot_f[0])}
+    if recovered is not None:
+        out["resilience"] = recovered
     _tag_sparse_closure(out, mode, note)
     if not out["valid?"]:
         out.update(_fail_op(e, cp.fail_r))
@@ -824,17 +877,31 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
     probe_limit = _resolve_probe_limit(probe_limit)
     platform = getattr(device, "platform", None) or jax.default_backend()
     C = e.slot_f.shape[1]
-    xs = _xs_from_encoded(e, device)
-    state0 = _place(np.int32(e.state0), device)
+    # H2D placement and the search both run through the supervised
+    # dispatch seam (resilience.supervisor): faults are injectable,
+    # the watchdog bounds the wait, and the backend's breaker records
+    # the outcome. The search thunk MATERIALIZES its results so async
+    # dispatch surfaces failures (and hangs) inside the supervised
+    # window, not at a later host read.
+    xs, state0 = sup.dispatch(
+        "transfer",
+        lambda: (_xs_from_encoded(e, device),
+                 _place(np.int32(e.state0), device)),
+        backend=platform)
     N = max(64, capacity)
     with obs.span("engine.search", returns=e.n_returns,
                   dedupe=dedupe) as sp:
         while True:
             mode, note = _resolve_sparse_pallas(sparse_pallas, N, C,
                                                 platform, dedupe)
+
+            def _search(N=N, mode=mode):
+                out = _check_device(xs, state0, e.step_name, N,
+                                    dedupe, probe_limit, mode)
+                return [np.asarray(x) for x in out]
+
             valid, fail_r, overflow, maxf, steps_n, stepped = \
-                _check_device(xs, state0, e.step_name, N, dedupe,
-                              probe_limit, mode)
+                sup.dispatch("search", _search, backend=platform)
             if not bool(overflow):
                 break
             if N * 2 > max_capacity:
@@ -914,15 +981,26 @@ def analysis(model, history, capacity: int = 1024,
         r["fallback"] = str(err)
         return r
     from jepsen_tpu.parallel import bitdense
-    if bitdense.fits_bitdense(bitdense.n_states(e), e.n_slots):
-        # the dense bitmap IS a complete visited set — the sparse
-        # dedupe strategy has nothing to select there (its result says
-        # dedupe="dense"); the flag governs the sparse dispatch below
-        r = bitdense.check_encoded_bitdense(e)
-    else:
-        r = check_encoded(e, capacity=capacity,
-                          max_capacity=max_capacity, dedupe=dedupe,
-                          sparse_pallas=sparse_pallas)
+    try:
+        if bitdense.fits_bitdense(bitdense.n_states(e), e.n_slots):
+            # the dense bitmap IS a complete visited set — the sparse
+            # dedupe strategy has nothing to select there (its result
+            # says dedupe="dense"); the flag governs the sparse
+            # dispatch below
+            r = bitdense.check_encoded_bitdense(e)
+        else:
+            r = check_encoded(e, capacity=capacity,
+                              max_capacity=max_capacity, dedupe=dedupe,
+                              sparse_pallas=sparse_pallas)
+    except sup.DISPATCH_FAILURES as err:
+        # the degradation contract (docs/resilience.md): a dead device
+        # dispatch — wedged, crashed, or breaker-refused — degrades to
+        # the host WGL engine with the verdict preserved and a
+        # structured note saying so, instead of crashing the check
+        from jepsen_tpu.resilience import recovery
+        return recovery.host_check_encoded(
+            model, e, getattr(err, "site", "dispatch"),
+            f"{type(err).__name__}: {err}")
     if r["valid?"] is False:
         apply_final_paths(r, model, e)
     return r
@@ -998,8 +1076,23 @@ def apply_final_paths(r: dict, model, e: EncodedHistory) -> dict:
     """Merge extract_final_paths into a device-invalid result `r`, in
     place. When the disagreement recheck OVERRIDES the verdict to
     valid, the device's stale counterexample fields are dropped — a
-    valid result must not carry a phantom failing op."""
-    fp = extract_final_paths(model, e, int(r["fail-event"]))
+    valid result must not carry a phantom failing op.
+
+    A supervised-dispatch failure DURING extraction (the seed-frontier
+    re-scan is a device dispatch too) must not crash a verdict that is
+    already decided: the result keeps its verdict with an empty-paths
+    note instead (the same loud-but-not-fatal policy as _empty)."""
+    try:
+        fp = extract_final_paths(model, e, int(r["fail-event"]))
+    except sup.DISPATCH_FAILURES as err:
+        obs.counter("engine.final_paths_missing").inc()
+        _log.warning("final-paths extraction lost its device dispatch "
+                     "(%s) — verdict kept, paths empty", err)
+        r.setdefault("final-paths", [])
+        r.setdefault("configs", [])
+        r["final-paths-note"] = (f"extraction dispatch failed: "
+                                 f"{type(err).__name__}: {err}")
+        return r
     if fp.get("valid?") is True:
         for k in ("op", "fail-event"):
             r.pop(k, None)
@@ -1118,9 +1211,17 @@ def _frontier_at(e: EncodedHistory, start_ev: int):
     chunk = {k: jnp.asarray(v) for k, v in xs_np.items()}
     N = 1024
     while True:
-        carry0 = _initial_carry(jnp.int32(e.state0), N)
-        carry, overflow = _check_device_resumable(
-            chunk, carry0, e.step_name, N)
+        def _rescan(N=N):
+            carry0 = _initial_carry(jnp.int32(e.state0), N)
+            carry, overflow = _check_device_resumable(
+                chunk, carry0, e.step_name, N)
+            return carry, bool(overflow)
+
+        # supervised like every dispatch, but with no breaker backend:
+        # this re-scan runs INSIDE recovery/extraction paths, and its
+        # failure must not double-count against the breaker that is
+        # already handling the original one
+        carry, overflow = sup.dispatch("search", _rescan)
         if not bool(overflow):
             break
         if N * 2 > (1 << 20):
@@ -1365,7 +1466,18 @@ def check_batch_encoded(model, pre, capacity: int = 512,
         S_max = max(bitdense.n_states(e) for e in sub)
         C_max = max(e.n_slots for e in sub)
         if bitdense.fits_bitdense(S_max, C_max):
-            rs = bitdense.check_batch_bitdense(sub, mesh=mesh)
+            try:
+                rs = bitdense.check_batch_bitdense(sub, mesh=mesh)
+            except sup.DISPATCH_FAILURES as err:
+                # degradation contract: a dead bitdense dispatch costs
+                # this bucket the device path, not the batch the
+                # verdict — each key re-checks on the host WGL engine
+                # with a structured resilience note
+                from jepsen_tpu.resilience import recovery
+                reason = f"{type(err).__name__}: {err}"
+                rs = [recovery.host_check_encoded(
+                          model, e, getattr(err, "site", "dispatch"),
+                          reason) for e in sub]
         else:
             rs = _check_batch_sparse(model, sub, capacity, max_capacity,
                                      mesh, dedupe=dedupe,
@@ -1399,18 +1511,35 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
         encs_t = [pre[i] for i in pending]
         mode, note = _resolve_sparse_pallas(sparse_pallas, N, C,
                                             platform, dedupe)
-        with obs.span("engine.sparse_batch", keys=len(pending),
-                      capacity=N, dedupe=dedupe):
-            _, xs, state0 = encode_batch(model, [], encs=encs_t,
-                                         mesh=mesh)
-            valid, fail_r, overflow, maxf, steps_n, stepped = \
-                _check_device_batch(xs, state0, step_name, N, dedupe,
-                                    probe_limit, mode)
-            valid = np.asarray(valid)
-            fail_r = np.asarray(fail_r)
-            overflow = np.asarray(overflow)
-            maxf = np.asarray(maxf)
-            stepped = np.asarray(stepped)
+        try:
+            with obs.span("engine.sparse_batch", keys=len(pending),
+                          capacity=N, dedupe=dedupe):
+                _, xs, state0 = sup.dispatch(
+                    "transfer",
+                    lambda encs_t=encs_t: encode_batch(
+                        model, [], encs=encs_t, mesh=mesh),
+                    backend=platform)
+
+                def _search(xs=xs, state0=state0, N=N, mode=mode):
+                    out = _check_device_batch(xs, state0, step_name, N,
+                                              dedupe, probe_limit, mode)
+                    # materialize inside the supervised window
+                    return [np.asarray(x) for x in out]
+
+                valid, fail_r, overflow, maxf, steps_n, stepped = \
+                    sup.dispatch("search", _search, backend=platform)
+        except sup.DISPATCH_FAILURES as err:
+            # degradation contract: the keys still pending at the
+            # failure degrade to the host WGL path, each with a
+            # structured resilience note — keys already decided on
+            # the device keep their device results
+            from jepsen_tpu.resilience import recovery
+            reason = f"{type(err).__name__}: {err}"
+            for i in pending:
+                out[i] = recovery.host_check_encoded(
+                    model, pre[i], getattr(err, "site", "search"),
+                    reason, backend=platform)
+            break
         retry = []
         for j, i in enumerate(pending):
             if bool(overflow[j]):

@@ -66,6 +66,7 @@ from jepsen_tpu import obs
 from jepsen_tpu.parallel import encode as enc_mod
 from jepsen_tpu.parallel import engine
 from jepsen_tpu.parallel.encode import EncodedHistory
+from jepsen_tpu.resilience import supervisor as sup
 
 _log = logging.getLogger(__name__)
 
@@ -562,11 +563,30 @@ def _stream(model, histories, capacity, max_capacity, mesh, bucket,
         pending: deque = deque()
         bstats: list = []
 
+        def degrade_chunk(chunk_idxs, err, bstat):
+            """A failed chunk degrades ONLY ITS KEYS to the host WGL
+            path with a structured resilience note (the degradation
+            contract, docs/resilience.md) — the rest of the batch
+            keeps its device results instead of dying with the chunk."""
+            from jepsen_tpu.resilience import recovery
+            reason = f"{type(err).__name__}: {err}"
+            reg.counter("pipeline.chunks_degraded").inc()
+            site = getattr(err, "site", "pipeline")
+            for i in chunk_idxs:
+                out[i] = recovery.host_check_encoded(
+                    model, enc_of(i), site, reason)
+            bstat["degraded"] = bstat.get("degraded", 0) + len(chunk_idxs)
+
         def drain_one():
             chunk_idxs, pb, bstat, chunk_no, t_issue = pending.popleft()
-            with obs.span("pipeline.finalize", tier=bstat["tier"],
-                          chunk=chunk_no, keys=len(chunk_idxs)):
-                rs = pb.finalize()
+            try:
+                with obs.span("pipeline.finalize", tier=bstat["tier"],
+                              chunk=chunk_no, keys=len(chunk_idxs)):
+                    rs = sup.dispatch("pipeline", pb.finalize)
+            except sup.DISPATCH_FAILURES as err:
+                degrade_chunk(chunk_idxs, err, bstat)
+                inflight.set(len(pending))
+                return
             inflight.set(len(pending))
             tr = obs.tracer()
             if tr is not None:
@@ -608,12 +628,27 @@ def _stream(model, histories, capacity, max_capacity, mesh, bucket,
                     # maxima would otherwise make every chunk its own
                     # compile
                     t_issue = perf_counter()
-                    with obs.span("pipeline.dispatch", tier=tier,
-                                  chunk=bstat["chunks"],
-                                  keys=len(chunk)):
-                        pb = bitdense.dispatch_batch_bitdense(
-                            sub, mesh=mesh, min_states=S_max,
-                            min_slots=max(5, C_max), min_returns=R_max)
+                    try:
+                        with obs.span("pipeline.dispatch", tier=tier,
+                                      chunk=bstat["chunks"],
+                                      keys=len(chunk)):
+                            # site "pipeline" wraps the (itself
+                            # supervised) bitdense dispatch so the
+                            # fault matrix can target chunk dispatch
+                            # specifically; the inner sites own the
+                            # breaker bookkeeping
+                            pb = sup.dispatch(
+                                "pipeline",
+                                lambda sub=sub: bitdense.
+                                dispatch_batch_bitdense(
+                                    sub, mesh=mesh, min_states=S_max,
+                                    min_slots=max(5, C_max),
+                                    min_returns=R_max))
+                    except sup.DISPATCH_FAILURES as err:
+                        degrade_chunk(chunk, err, bstat)
+                        bstat["chunks"] += 1
+                        reg.counter("pipeline.chunks").inc()
+                        continue
                     pending.append((chunk, pb, bstat, bstat["chunks"],
                                     t_issue))
                     bstat["chunks"] += 1

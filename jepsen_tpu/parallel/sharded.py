@@ -59,6 +59,7 @@ from jepsen_tpu.parallel.engine import (_empty_table,
                                         _slot_bits, _tag_sparse_closure,
                                         _xs_from_encoded)
 from jepsen_tpu.parallel.steps import STEPS
+from jepsen_tpu.resilience import supervisor as sup
 
 _log = logging.getLogger(__name__)
 
@@ -683,19 +684,36 @@ def check_encoded_sharded_resumable(e: EncodedHistory, mesh: Mesh,
             sparse_pallas, Nd, e.slot_f.shape[1], n_dev, 1, "route",
             platform, dedupe)
         lo, hi = cp.event_index, min(R, cp.event_index + checkpoint_every)
-        chunk = {k: jax.device_put(np.asarray(v[lo:hi]), rep)
-                 for k, v in xs_np.items()}
-        carry, overflow = _check_sharded_resume(
-            chunk,
-            jax.device_put(cp.st, shard), jax.device_put(cp.ml, shard),
-            jax.device_put(cp.mh, shard),
-            jax.device_put(cp.live, shard),
-            jax.device_put(np.bool_(cp.ok), rep),
-            jax.device_put(np.int32(cp.fail_r), rep),
-            jax.device_put(np.int32(cp.event_index), rep),
-            jax.device_put(np.int32(cp.maxf), rep),
-            jax.device_put(np.int32(cp.stepped), rep),
-            e.step_name, Nd, n_dev, mesh, dedupe, probe_limit, mode)
+
+        def _chunk(cp=cp, Nd=Nd, mode=mode, lo=lo, hi=hi):
+            chunk = {k: jax.device_put(np.asarray(v[lo:hi]), rep)
+                     for k, v in xs_np.items()}
+            carry, overflow = _check_sharded_resume(
+                chunk,
+                jax.device_put(cp.st, shard),
+                jax.device_put(cp.ml, shard),
+                jax.device_put(cp.mh, shard),
+                jax.device_put(cp.live, shard),
+                jax.device_put(np.bool_(cp.ok), rep),
+                jax.device_put(np.int32(cp.fail_r), rep),
+                jax.device_put(np.int32(cp.event_index), rep),
+                jax.device_put(np.int32(cp.maxf), rep),
+                jax.device_put(np.int32(cp.stepped), rep),
+                e.step_name, Nd, n_dev, mesh, dedupe, probe_limit,
+                mode)
+            # materialize inside the supervised window
+            return [np.asarray(x) for x in carry], bool(overflow)
+
+        try:
+            carry, overflow = sup.dispatch("sharded", _chunk,
+                                           backend=platform)
+        except sup.DISPATCH_FAILURES as err:
+            # the mid-search contract: no work lost — the checkpoint
+            # taken before this chunk rides the exception so the
+            # caller can resume (on any topology; the checkpoint is
+            # topology-independent) once the runtime recovers
+            err.checkpoint = cp
+            raise
         if bool(overflow):
             if N * 2 > max_capacity:
                 return _tag_sparse_closure(
@@ -781,9 +799,14 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
     # on the default backend (it can be a broken TPU runtime while we
     # deliberately run on a CPU mesh — the MULTICHIP_r01 crash mode)
     rep = NamedSharding(mesh, P())
-    xs = _xs_from_encoded(e, device=rep)
-    state0 = jax.device_put(np.int32(e.state0), rep)
     platform = np.asarray(mesh.devices).flat[0].platform
+    # supervised H2D placement — a wedged runtime hangs here exactly
+    # like it does at dispatch (site "transfer")
+    xs, state0 = sup.dispatch(
+        "transfer",
+        lambda: (_xs_from_encoded(e, device=rep),
+                 jax.device_put(np.int32(e.state0), rep)),
+        backend=platform)
     N = max(64 * n_dev, capacity)
     with obs.span("sharded.search", devices=n_dev, dedupe=dedupe,
                   returns=e.n_returns) as sp:
@@ -798,16 +821,26 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
             # steps in the trace
             with obs.span("sharded.tier", capacity=N, per_device=Nd), \
                     obs.device_annotation(f"sharded N{N} D{n_dev}"):
-                if hier:
-                    valid, fail_r, overflow, maxf, stepped = \
-                        _check_sharded2d(xs, state0, e.step_name, Nd,
-                                         n_slice, n_chip, mesh, dedupe,
-                                         probe_limit, mode)
-                else:
-                    valid, fail_r, overflow, maxf, stepped = \
-                        _check_sharded(xs, state0, e.step_name, Nd,
-                                       n_dev, mesh, exchange, dedupe,
-                                       probe_limit, mode)
+                def _tier(Nd=Nd, mode=mode):
+                    if hier:
+                        out = _check_sharded2d(xs, state0, e.step_name,
+                                               Nd, n_slice, n_chip,
+                                               mesh, dedupe,
+                                               probe_limit, mode)
+                    else:
+                        out = _check_sharded(xs, state0, e.step_name,
+                                             Nd, n_dev, mesh, exchange,
+                                             dedupe, probe_limit, mode)
+                    # materialize inside the supervised window: async
+                    # failures/hangs surface here, not at a host read
+                    return [np.asarray(x) for x in out]
+
+                # supervised dispatch (resilience.supervisor): site
+                # "sharded" so the fault matrix can target the tier
+                # path; failures degrade at the callers (analysis /
+                # engine._escalate_overflow)
+                valid, fail_r, overflow, maxf, stepped = sup.dispatch(
+                    "sharded", _tier, backend=platform)
                 overflow = bool(overflow)
             if not overflow:
                 break
@@ -860,10 +893,19 @@ def analysis(model, history, mesh: Mesh, capacity: int = 8192,
         r = wgl.analysis(model, h)
         r["fallback"] = str(err)
         return r
-    r = check_encoded_sharded(e, mesh, capacity=capacity,
-                              max_capacity=max_capacity,
-                              exchange=exchange, dedupe=dedupe,
-                              sparse_pallas=sparse_pallas)
+    try:
+        r = check_encoded_sharded(e, mesh, capacity=capacity,
+                                  max_capacity=max_capacity,
+                                  exchange=exchange, dedupe=dedupe,
+                                  sparse_pallas=sparse_pallas)
+    except sup.DISPATCH_FAILURES as err:
+        # degradation contract (docs/resilience.md): a dead sharded
+        # tier degrades to the host WGL engine, verdict preserved,
+        # with a structured resilience note — same as engine.analysis
+        from jepsen_tpu.resilience import recovery
+        return recovery.host_check_encoded(
+            model, e, getattr(err, "site", "sharded"),
+            f"{type(err).__name__}: {err}")
     if r["valid?"] is False:
         engine.apply_final_paths(r, model, e)
     return r

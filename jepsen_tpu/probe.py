@@ -90,13 +90,18 @@ def probe_once(timeout: float) -> dict:
 
 
 def run_probe(timeout: float = 100.0, retries: int = 3,
-              interval: float = 0.0, out=None) -> int:
+              interval: float = 0.0, out=None,
+              record: Optional[list] = None) -> int:
     """The retry loop: probe until healthy or attempts run out,
     emitting one verdict line per attempt (PROBES_r05.log format) and
-    a final summary line. Returns the exit code."""
+    a final summary line. Returns the exit code. `record`, when a
+    list, receives each attempt's raw result dict — the structured
+    side of the verdict lines (probe_json builds on it)."""
     retries = max(1, retries)
     for attempt in range(1, retries + 1):
         r = probe_once(timeout)
+        if record is not None:
+            record.append(r)
         if r["status"] == "healthy":
             plats = r["platforms"]
             _emit(f"HEALTHY — jax.devices() -> {plats} in "
@@ -123,6 +128,44 @@ def run_probe(timeout: float = 100.0, retries: int = 3,
     return EXIT_WEDGED
 
 
+_VERDICTS = {EXIT_HEALTHY: "healthy", EXIT_WEDGED: "wedged",
+             EXIT_NO_BACKEND: "no-backend"}
+
+
+def probe_json(timeout: float = 100.0, retries: int = 3,
+               interval: float = 0.0, out=None) -> dict:
+    """The probe loop as one machine-readable document — the contract
+    both ``jepsen probe --json`` and the circuit breaker's half-open
+    recovery check consume (jepsen_tpu.resilience.breaker), so
+    external automation and the in-process breaker read the SAME
+    health surface. `out` receives the human verdict lines (default:
+    discarded under --json's stdout-JSON contract; the CLI routes
+    them to stderr).
+
+    Schema: verdict (healthy|wedged|no-backend), exit (the 0/1/2
+    runbook code), attempts (each raw probe_once result), elapsed_secs,
+    timeout, retries; healthy additionally carries platforms and
+    n_devices from the answering attempt."""
+    import io
+    t0 = time.monotonic()
+    record: list = []
+    code = run_probe(timeout=timeout, retries=retries,
+                     interval=interval, out=out or io.StringIO(),
+                     record=record)
+    doc = {
+        "verdict": _VERDICTS.get(code, "unknown"),
+        "exit": code,
+        "attempts": record,
+        "elapsed_secs": round(time.monotonic() - t0, 3),
+        "timeout": timeout,
+        "retries": retries,
+    }
+    if code == EXIT_HEALTHY and record:
+        doc["platforms"] = record[-1].get("platforms")
+        doc["n_devices"] = record[-1].get("n_devices")
+    return doc
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="jepsen probe",
@@ -136,6 +179,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="attempts before the WEDGED verdict")
     p.add_argument("--interval", type=float, default=0.0,
                    help="seconds between attempts")
+    p.add_argument("--json", action="store_true",
+                   help="emit one machine-readable JSON document on "
+                        "stdout (verdict lines go to stderr); exit "
+                        "code unchanged")
     try:
         args = p.parse_args(list(argv) if argv is not None else None)
     except SystemExit as e:
@@ -143,6 +190,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # no-backend code — keep --help at 0 and map misuse to the
         # CLI's bad-args convention via a distinct code
         return 0 if e.code in (0, None) else 254
+    if args.json:
+        # verdict lines keep flowing (stderr) so an operator tailing
+        # the run still sees the runbook format; stdout is exactly one
+        # JSON document for automation (the breaker's contract)
+        import json
+        doc = probe_json(timeout=args.timeout, retries=args.retries,
+                         interval=args.interval, out=sys.stderr)
+        print(json.dumps(doc))
+        return doc["exit"]
     return run_probe(timeout=args.timeout, retries=args.retries,
                      interval=args.interval)
 

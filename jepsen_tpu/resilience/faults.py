@@ -1,0 +1,244 @@
+"""Deterministic fault injection behind ``JEPSEN_TPU_FAULTS``.
+
+PAPER.md's subject is a framework that exists to inject faults into
+systems under test and verify they stay correct; the r05 outage showed
+the checker itself had no way to practice that discipline on its own
+weakest layer, the device-runtime boundary. This module is the seam:
+a validated spec drives deterministic fault firings at the supervised
+dispatch sites (``resilience.supervisor``), so CI can walk every
+degradation path on CPU without a chip, an outage, or a race.
+
+Spec grammar (comma-separated rules)::
+
+    JEPSEN_TPU_FAULTS = <kind>@<site>[:<count>][,<rule>...]
+
+    kind   wedge   the dispatch never returns (the r05 PJRT
+                   make_c_api_client signature); surfaces as
+                   DispatchWedged via the supervisor's watchdog
+           raise   the dispatch raises (a crashed device program);
+                   surfaces as InjectedCrash — not retried, the
+                   callers' degradation paths take over
+           flaky   a transient failure (TransientFault); the
+                   supervisor retries it within the breaker budget
+    site   dispatch   bitdense single/batch device program
+           transfer   host->device placement (pad/place)
+           search     sparse-engine device search
+           sharded    frontier-sharded tier dispatch
+           pipeline   pipelined-executor chunk dispatch
+           child      bench child-process startup (the old
+                      JEPSEN_TPU_TEST_WEDGE seam)
+    count  N         shorthand for n=N
+           n=N       fire on the first N invocations of the site
+           every=K   fire on every K-th invocation (K, 2K, ...)
+           (absent)  fire on every invocation
+
+Validation is strict: an unknown kind/site/argument raises
+:class:`FaultSpecError` (an ``envflags.EnvFlagError``) at the first
+read — a typo'd fault plan must never silently test nothing
+(satellite contract: bad specs raise, never no-op). The legacy
+``JEPSEN_TPU_TEST_WEDGE=1`` bench seam maps onto an implicit
+``wedge@child`` rule, so the old flag keeps working while every
+consumer reads one plan.
+
+Deterministic by construction: firing depends only on the per-site
+invocation count, never on time or randomness, so a fault-matrix test
+run is exactly reproducible.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from jepsen_tpu import envflags
+
+KINDS = ("wedge", "raise", "flaky")
+SITES = ("dispatch", "transfer", "search", "sharded", "pipeline",
+         "child")
+
+
+class FaultSpecError(envflags.EnvFlagError):
+    """A JEPSEN_TPU_FAULTS spec outside the grammar above."""
+
+
+class FaultInjected(RuntimeError):
+    """Base of the injected-failure exceptions (site + rule attached)."""
+
+    def __init__(self, site: str, rule: "FaultRule"):
+        super().__init__(f"injected {rule.kind} fault at site "
+                         f"{site!r} ({rule.spec})")
+        self.site = site
+        self.rule = rule
+
+
+class InjectedCrash(FaultInjected):
+    """``raise@<site>`` — a crashed dispatch; not retried."""
+
+
+class TransientFault(FaultInjected):
+    """``flaky@<site>`` — a transient failure; the supervisor retries."""
+
+
+@dataclass(frozen=True)
+class FaultRule:
+    kind: str
+    site: str
+    n: Optional[int] = None       # fire on the first n invocations
+    every: Optional[int] = None   # fire on every k-th invocation
+    spec: str = ""                # the raw rule text, for messages
+
+    def fires(self, count: int) -> bool:
+        """Whether this rule fires on the count-th (1-based)
+        invocation of its site."""
+        if self.every is not None:
+            return count % self.every == 0
+        if self.n is not None:
+            return count <= self.n
+        return True
+
+
+def parse_spec(raw: str) -> List[FaultRule]:
+    """Parse a JEPSEN_TPU_FAULTS value into rules, strictly."""
+    rules: List[FaultRule] = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        head, sep, arg = part.partition(":")
+        if "@" not in head:
+            raise FaultSpecError(
+                f"JEPSEN_TPU_FAULTS rule {part!r}: expected "
+                f"<kind>@<site>[:<count>]")
+        kind, _, site = head.partition("@")
+        kind, site = kind.strip(), site.strip()
+        if kind not in KINDS:
+            raise FaultSpecError(
+                f"JEPSEN_TPU_FAULTS rule {part!r}: unknown fault kind "
+                f"{kind!r} (expected one of {KINDS})")
+        if site not in SITES:
+            raise FaultSpecError(
+                f"JEPSEN_TPU_FAULTS rule {part!r}: unknown site "
+                f"{site!r} (expected one of {SITES})")
+        if site == "child" and kind != "wedge":
+            # the bench child consults the seam once at startup and
+            # only implements the wedge (the r05 signature); accepting
+            # raise/flaky here would be a spec that silently tests
+            # nothing — the exact failure validation exists to prevent
+            raise FaultSpecError(
+                f"JEPSEN_TPU_FAULTS rule {part!r}: site 'child' only "
+                f"supports kind 'wedge' (the bench child-startup "
+                f"seam)")
+        n = every = None
+        if sep:
+            arg = arg.strip()
+            key, eq, val = arg.partition("=")
+            if not eq:
+                key, val = "n", arg
+            key = key.strip()
+            try:
+                ival = int(val.strip())
+            except ValueError:
+                ival = -1
+            if key not in ("n", "every") or ival < 1:
+                raise FaultSpecError(
+                    f"JEPSEN_TPU_FAULTS rule {part!r}: bad count "
+                    f"{arg!r} (expected N, n=N, or every=K with a "
+                    f"positive integer)")
+            if key == "n":
+                n = ival
+            else:
+                every = ival
+        rules.append(FaultRule(kind, site, n, every, part))
+    return rules
+
+
+class FaultPlan:
+    """A parsed spec plus per-site invocation counters (thread-safe).
+
+    ``decide(site)`` counts one invocation and returns the first rule
+    that fires, or None. ``wedge_event`` is what an injected wedge
+    blocks on — the supervisor sets it after the watchdog verdict so
+    the blocked worker thread exits instead of leaking (a REAL wedge
+    cannot be released; its daemon thread is the documented cost of
+    the r05 failure mode, bounded by the circuit breaker)."""
+
+    def __init__(self, rules: List[FaultRule]):
+        self.rules = rules
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.wedge_event = threading.Event()
+
+    def decide(self, site: str) -> Optional[FaultRule]:
+        with self._lock:
+            c = self._counts.get(site, 0) + 1
+            self._counts[site] = c
+        for r in self.rules:
+            if r.site == site and r.fires(c):
+                return r
+        return None
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+
+# plan cache, keyed on the raw env values so an env change between
+# calls rebuilds (and re-validates) the plan instead of going stale
+_cache_lock = threading.Lock()
+_cache: Tuple[Optional[str], Optional[str], Optional[FaultPlan]] = \
+    (None, None, None)
+
+
+def _raw_env() -> Tuple[Optional[str], Optional[str]]:
+    return (envflags.env_raw("JEPSEN_TPU_FAULTS"),
+            envflags.env_raw("JEPSEN_TPU_TEST_WEDGE"))
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The process fault plan, or None when no faults are configured.
+    Cached on the raw env strings; parse errors raise at every read
+    (fail-loud, per the envflags contract)."""
+    global _cache
+    raw, legacy = _raw_env()
+    if raw is None and legacy in (None, "0"):
+        return None
+    with _cache_lock:
+        craw, clegacy, plan = _cache
+        if craw == raw and clegacy == legacy and plan is not None:
+            return plan
+        rules = parse_spec(raw) if raw else []
+        # the legacy bench seam: =1 injects the child wedge (strict
+        # tri-state read — a malformed value raises, as it always did)
+        if envflags.env_bool("JEPSEN_TPU_TEST_WEDGE", default=False):
+            rules.append(FaultRule("wedge", "child",
+                                   spec="wedge@child (legacy "
+                                        "JEPSEN_TPU_TEST_WEDGE=1)"))
+        plan = FaultPlan(rules) if rules else None
+        _cache = (raw, legacy, plan)
+        return plan
+
+
+def active() -> bool:
+    """Cheap activity probe for the supervisor's fast path: true iff a
+    fault plan is configured (raw env reads only — no parse on the
+    no-op path; validation happens when the plan is actually built)."""
+    raw, legacy = _raw_env()
+    return raw is not None or legacy not in (None, "0")
+
+
+def decide(site: str) -> Optional[FaultRule]:
+    """Count one invocation of ``site`` against the active plan and
+    return the rule that fires, if any."""
+    plan = active_plan()
+    return plan.decide(site) if plan is not None else None
+
+
+def reset():
+    """Drop the cached plan and its counters (test isolation)."""
+    global _cache
+    with _cache_lock:
+        _, _, plan = _cache
+        if plan is not None:
+            plan.wedge_event.set()
+        _cache = (None, None, None)

@@ -1,0 +1,170 @@
+"""Degradation paths that preserve verdicts when device dispatch dies.
+
+The degradation contract (docs/resilience.md): a device failure
+mid-check must never lose work or flip a verdict. Two shapes:
+
+  host_check_encoded   re-check a whole encoded key on the host WGL
+                       engine — correct but orders of magnitude
+                       slower, so every result is tagged with a
+                       structured ``resilience`` note naming what
+                       degraded and why.
+  host_resume          resume a sparse search from its
+                       :class:`~jepsen_tpu.parallel.engine.FrontierCheckpoint`
+                       on the host: the checkpointed frontier is the
+                       COMPLETE set of reachable configurations at
+                       event ``cp.event_index`` (the device dedupe
+                       preserves completeness), so the history is
+                       valid iff some frontier row linearizes the
+                       remaining suffix. Each row seeds a host WGL
+                       search over exactly the window machinery
+                       ``engine.extract_final_paths`` already uses —
+                       device-side progress is kept, only the suffix
+                       re-runs on host.
+
+Both count ``resilience.recovered_keys`` — the gauge of verdicts that
+survived a device failure.
+
+JAX-free at module scope; engine/wgl imports are lazy (this module is
+imported by the engines' exception paths and must never re-enter a
+wedged runtime).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from jepsen_tpu import obs
+
+_log = logging.getLogger(__name__)
+
+# past this many live frontier rows, per-seed host searches would cost
+# more than one whole-history WGL pass — degrade to that instead
+MAX_RESUME_SEEDS = 128
+
+
+def resilience_note(site: str, reason: str, degraded: str,
+                    backend: Optional[str] = None, **extra) -> dict:
+    """The structured ``resilience`` annotation results carry when a
+    degradation path ran: what degraded, where, and why."""
+    note = {"degraded": degraded, "site": site, "reason": reason}
+    if backend:
+        note["backend"] = backend
+    note.update(extra)
+    return note
+
+
+def host_check_encoded(model, e, site: str, reason: str,
+                       backend: Optional[str] = None) -> dict:
+    """Whole-key host WGL check of an encoded history — the terminal
+    degradation tier. The verdict is authoritative (WGL searches
+    exhaustively); the result says loudly that the device path died."""
+    from jepsen_tpu.checker import wgl
+    obs.counter("resilience.recovered_keys").inc()
+    _log.warning(
+        "device dispatch failed at site %r (%s) — re-checking the key "
+        "on the host WGL engine; the verdict is preserved but the "
+        "device path is broken", site, reason)
+    n_history = (max(c.complete_index for c in e.calls) + 1
+                 if e.calls else 0)
+    with obs.span("resilience.host_check", site=site):
+        r = wgl.check_calls(model, list(e.calls), n_history)
+    r["analyzer"] = "wgl"
+    r["resilience"] = resilience_note(site, reason, "host-wgl", backend)
+    return r
+
+
+def host_resume(model, e, cp, site: str, reason: str,
+                backend: Optional[str] = None,
+                max_seeds: int = MAX_RESUME_SEEDS) -> dict:
+    """Resume a checkpointed sparse search on the host (module
+    docstring). Falls back to :func:`host_check_encoded` when the
+    frontier can't seed a host search (no unpack_state, too many live
+    rows, or indecisive seed searches) — slower, never wrong."""
+    import numpy as np
+
+    from jepsen_tpu import models as model_ns
+    from jepsen_tpu.checker import wgl
+    from jepsen_tpu.parallel import engine
+
+    start_ev = int(cp.event_index)
+    if start_ev <= 0:
+        return host_check_encoded(model, e, site, reason, backend)
+    if not cp.ok:
+        # the device already decided before the failure: the verdict
+        # is final, only the counterexample extraction remains
+        r = {"valid?": False, "max-frontier": cp.maxf,
+             "capacity": cp.capacity, "dedupe": "resumed",
+             "configs-stepped": cp.stepped}
+        r.update(engine._fail_op(e, int(cp.fail_r)))
+        engine.apply_final_paths(r, model, e)
+        r["resilience"] = resilience_note(
+            site, reason, "checkpoint-verdict", backend,
+            **{"resumed-from-event": start_ev})
+        obs.counter("resilience.recovered_keys").inc()
+        return r
+    spec = e.spec or model_ns.pack_spec(model, e.intern)
+    live_idx = np.nonzero(np.asarray(cp.live))[0]
+    if (spec is None or spec.unpack_state is None
+            or len(live_idx) > max_seeds):
+        return host_check_encoded(model, e, site, reason, backend)
+
+    # recovered_keys counts once per key, at whichever path actually
+    # ships the verdict — the indecisive fallback below delegates to
+    # host_check_encoded, which counts for itself
+    occupants = engine._slot_occupants_before(e, start_ev)
+    boundary = e.calls[int(e.ret_call[start_ev])].complete_index
+    last_idx = max(c.complete_index for c in e.calls)
+    st = np.asarray(cp.st)
+    ml = np.asarray(cp.ml)
+    mh = np.asarray(cp.mh)
+    fail_report = None
+    indecisive = False
+    with obs.span("resilience.host_resume", site=site,
+                  seeds=len(live_idx), from_event=start_ev):
+        for i in live_idx:
+            mask = int(ml[i]) | (int(mh[i]) << 32)
+            linearized = frozenset(
+                cid for s, cid in occupants.items() if (mask >> s) & 1)
+            seed_model = spec.unpack_state(int(st[i]), e.intern)
+            cs = engine._window_calls(e.calls, boundary, last_idx,
+                                      linearized)
+            host = wgl.check_calls(seed_model, cs, last_idx + 1)
+            if host.get("valid?") is True:
+                # some reachable configuration linearizes the suffix:
+                # the whole history is valid — device progress kept
+                obs.counter("resilience.recovered_keys").inc()
+                return {
+                    "valid?": True, "max-frontier": cp.maxf,
+                    "capacity": cp.capacity,
+                    "configs-stepped": cp.stepped,
+                    "resilience": resilience_note(
+                        site, reason, "host-resume", backend,
+                        **{"resumed-from-event": start_ev,
+                           "seeds": int(len(live_idx))}),
+                }
+            if host.get("valid?") is False:
+                fail_report = fail_report or host
+            else:
+                indecisive = True
+    if indecisive or fail_report is None:
+        # a seed search that couldn't decide means the seeded window
+        # machinery may be the wrong side — never ship a verdict off
+        # an indecisive resume
+        return host_check_encoded(model, e, site,
+                                  reason + "; host resume indecisive",
+                                  backend)
+    # every reachable configuration fails to linearize the suffix:
+    # invalid, with the host's consistent failure report
+    obs.counter("resilience.recovered_keys").inc()
+    r = {"valid?": False, "max-frontier": cp.maxf,
+         "capacity": cp.capacity, "configs-stepped": cp.stepped,
+         "final-paths": fail_report.get("final-paths", []),
+         "configs": fail_report.get("configs", []),
+         "resilience": resilience_note(
+             site, reason, "host-resume", backend,
+             **{"resumed-from-event": start_ev,
+                "seeds": int(len(live_idx))})}
+    if fail_report.get("op"):
+        r["op"] = fail_report["op"]
+    return r

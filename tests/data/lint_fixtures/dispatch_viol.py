@@ -1,0 +1,52 @@
+"""Fixture: concurrency-unsupervised-dispatch violations.
+
+A device-dispatch entry point called outside a supervisor.dispatch
+thunk is a dispatch the resilience layer cannot see. Lines are pinned
+by tests/test_lint.py — keep the layout stable.
+"""
+from jepsen_tpu.resilience import supervisor as sup
+
+
+def _check_device(xs, state0):          # stand-in for the jitted entry
+    return xs, state0
+
+
+def _check_bitdense_batch(xs, state0):
+    return xs, state0
+
+
+def bad_direct_call(xs, state0):
+    # VIOLATION (next line): bare dispatch, no supervision
+    return _check_device(xs, state0)
+
+
+def bad_via_helper(xs, state0):
+    # VIOLATION (next line): also bare — the helper is not a
+    # supervised root either
+    return _check_bitdense_batch(xs, state0)
+
+
+def good_lambda(xs, state0):
+    return sup.dispatch("search", lambda: _check_device(xs, state0))
+
+
+def good_named_thunk(xs, state0):
+    def _run():
+        return _check_device(xs, state0)
+    return sup.dispatch("search", _run, backend="cpu")
+
+
+def good_reachable_helper(xs, state0):
+    def _materialize():
+        return list(_helper(xs, state0))
+    return sup.dispatch("dispatch", _materialize)
+
+
+def _helper(xs, state0):
+    # reachable FROM a supervised thunk: not a violation
+    return _check_bitdense_batch(xs, state0)
+
+
+def suppressed_call(xs, state0):
+    # deliberate bare-program benchmark, rule-named escape
+    return _check_device(xs, state0)  # jepsen-lint: disable=concurrency-unsupervised-dispatch

@@ -123,6 +123,15 @@ def test_concurrency_fixture_findings_with_anchors():
     assert races == [(17, False), (41, False), (71, False)]
 
 
+def test_unsupervised_dispatch_fixture_findings_with_anchors():
+    """Device-dispatch entry calls outside a supervisor.dispatch thunk
+    flag; thunks (lambda, named, via a reachable helper) and the
+    rule-named suppression stay clean."""
+    fs = _lint("dispatch_viol.py")
+    hits = _anchors(fs, "concurrency-unsupervised-dispatch")
+    assert hits == [(20, False), (26, False), (52, True)]
+
+
 def test_env_hygiene_catches_reintroduced_pallas_read():
     """The acceptance regression: a raw JEPSEN_TPU_PALLAS read (what
     bitdense did before the accessor) must be caught with a correct

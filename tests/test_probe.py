@@ -99,6 +99,46 @@ def test_probe_cli_dispatch(monkeypatch):
     assert probe.main(["--not-a-flag"]) == 254
 
 
+def test_probe_json_healthy_schema(monkeypatch):
+    """probe_json is the machine-readable side of the verdict lines —
+    the SAME contract the circuit breaker's half-open recovery check
+    consumes (resilience.breaker), pinned here."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    doc = probe.probe_json(timeout=120.0, retries=1)
+    assert doc["verdict"] == "healthy" and doc["exit"] == 0
+    assert doc["retries"] == 1 and doc["timeout"] == 120.0
+    assert doc["elapsed_secs"] >= 0
+    assert doc["attempts"][-1]["status"] == "healthy"
+    assert "cpu" in doc["platforms"] and doc["n_devices"] >= 1
+
+
+def test_probe_json_wedged_and_no_backend():
+    with mock.patch.object(probe, "_CHILD_CODE",
+                           "import time; time.sleep(3600)"):
+        doc = probe.probe_json(timeout=0.5, retries=2)
+    assert (doc["verdict"], doc["exit"]) == ("wedged", 1)
+    assert [a["status"] for a in doc["attempts"]] == ["hung", "hung"]
+    with mock.patch.object(probe, "_CHILD_CODE",
+                           "raise RuntimeError('no plugin')"):
+        doc = probe.probe_json(timeout=30.0, retries=3)
+    assert (doc["verdict"], doc["exit"]) == ("no-backend", 2)
+    assert len(doc["attempts"]) == 1      # fail-fast, no retries
+
+
+def test_probe_json_cli(monkeypatch, capsys):
+    """`jepsen probe --json`: exactly one JSON document on stdout,
+    verdict lines on stderr, exit code unchanged."""
+    import json
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    rc = probe.main(["--json", "--timeout", "120", "--retries", "1"])
+    cap = capsys.readouterr()
+    doc = json.loads(cap.out)
+    assert rc == doc["exit"] == 0 and doc["verdict"] == "healthy"
+    # the runbook's verdict-line format still flows, on stderr
+    assert any(_LINE.match(ln) for ln in cap.err.splitlines())
+
+
 @pytest.mark.parametrize("argv,expect", [
     (["--timeout", "7.5", "--retries", "2", "--interval", "1"],
      (7.5, 2, 1.0)),

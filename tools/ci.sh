@@ -4,6 +4,10 @@
 #   1. static analysis  — `python -m jepsen_tpu.analysis --check`
 #      (tracing-safety / recompile / concurrency lint; pure AST, no
 #      JAX init, exit 1 on any active finding — docs/linting.md)
+#   1b. fault-injection smoke — tools/fault_smoke.py: a wedge, a
+#      crash, and a flaky failure injected at the supervised dispatch
+#      sites on CPU, verdicts asserted identical to the clean run
+#      (the docs/resilience.md degradation contract, at smoke scale)
 #   2. tier-1 tests     — the ROADMAP.md invocation verbatim: the
 #      full suite minus the slow tier on a virtual 8-device CPU mesh,
 #      under the documented 870s budget (timeout -k 10 870). The
@@ -17,6 +21,9 @@ cd "$(dirname "$0")/.." || exit 2
 
 echo "== lint gate =="
 python -m jepsen_tpu.analysis --check || exit 1
+
+echo "== fault-injection smoke =="
+env JAX_PLATFORMS=cpu python tools/fault_smoke.py || exit 1
 
 echo "== tier-1 tests (870s budget) =="
 set -o pipefail

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""CI fault-injection smoke: the degradation contract in one minute.
+
+Runs a small checker workload clean, then re-runs it with a wedge, a
+crash, and a flaky failure injected at the supervised dispatch sites
+(CPU, interpret-safe), asserting every verdict is IDENTICAL to the
+clean run — the acceptance bar of docs/resilience.md, at smoke scale.
+`tools/ci.sh` invokes this right after the lint gate; exit 0 = the
+degradation paths hold, 1 = a verdict flipped or a path crashed.
+
+Deliberately tiny histories: this is a wiring check (every fault class
+actually reaches a supervised site and degrades correctly), not a
+stress test — tests/test_resilience.py carries the full matrix.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    from jepsen_tpu import resilience
+    from jepsen_tpu.histories import corrupt_history, rand_register_history
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.parallel import engine
+
+    m = CASRegister()
+    hs = [rand_register_history(n_ops=24, n_processes=3, seed=s)
+          for s in range(3)]
+    hs[1] = corrupt_history(hs[1], seed=1, n_corruptions=2)
+
+    clean = [engine.analysis(m, h)["valid?"] for h in hs]
+    print(f"fault-smoke: clean verdicts {clean}")
+
+    failures = 0
+    for spec in ("wedge@dispatch:n=1,wedge@search:n=1",
+                 "raise@dispatch,raise@search,raise@transfer",
+                 "flaky@dispatch:n=1,flaky@search:n=1"):
+        os.environ["JEPSEN_TPU_FAULTS"] = spec
+        resilience.reset()
+        try:
+            got = [engine.analysis(m, h)["valid?"] for h in hs]
+        except Exception as err:  # noqa: BLE001 — a crash IS the failure
+            print(f"fault-smoke: {spec!r} CRASHED: {err!r}")
+            failures += 1
+            continue
+        finally:
+            del os.environ["JEPSEN_TPU_FAULTS"]
+            resilience.reset()
+        if got == clean:
+            print(f"fault-smoke: {spec!r} -> verdicts preserved")
+        else:
+            print(f"fault-smoke: {spec!r} FLIPPED verdicts: "
+                  f"{got} != {clean}")
+            failures += 1
+
+    if failures:
+        print(f"fault-smoke: {failures} degradation path(s) broken")
+        return 1
+    print("fault-smoke: all degradation paths preserve verdicts")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
