@@ -104,6 +104,7 @@ SEC_TIMEOUTS = {
             else {1000: 180, 5000: 240, 10000: 300, 50000: 480}),
     "sharded": 90 if SMOKE else 300,
     "maxlen": 120 if SMOKE else 360,
+    "stream": 90 if SMOKE else 240,
 }
 
 
@@ -567,6 +568,57 @@ def sec_maxlen(budget_secs: float):
                       "(largest passing) length's"})
 
 
+def sec_stream():
+    """Advisory (BENCH_STREAM=1 only): incremental frontier extension
+    (parallel.extend.HistorySession) vs a full re-encode + re-check of
+    every prefix, on a growing history fed as deltas — the streaming
+    checker's economics (docs/streaming.md). Emitted only when the
+    flag is on, so the default bench schema stays byte-identical
+    (pinned by tests/test_bench.py). `full_secs` includes each
+    prefix's compile — that IS the cost full re-checking re-pays,
+    while the incremental path reuses a handful of quantized chunk
+    shapes."""
+    from jepsen_tpu.histories import rand_register_history
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.parallel import encode as enc_mod, engine
+    from jepsen_tpu.parallel import extend as ext
+    from jepsen_tpu.history import History
+
+    n_ops = int(os.environ.get("BENCH_STREAM_OPS",
+                               "200" if SMOKE else "2000"))
+    deltas = int(os.environ.get("BENCH_STREAM_DELTAS",
+                                "8" if SMOKE else "20"))
+    m = CASRegister()
+    h = list(rand_register_history(n_ops=n_ops, n_processes=6,
+                                   n_values=4, crash_p=0.03,
+                                   fail_p=0.05, busy=0.7, seed=11))
+    step = -(-len(h) // deltas)
+    cuts = [min(len(h), (i + 1) * step) for i in range(deltas)]
+    with obs.timer("bench.stream.incremental") as ti:
+        s = ext.HistorySession(m, capacity=1024)
+        lo = 0
+        for cut in cuts:
+            s.extend(h[lo:cut])
+            lo = cut
+            ri = s.check()
+    with obs.timer("bench.stream.full") as tf:
+        for cut in cuts:
+            e = enc_mod.encode(m, History.wrap(h[:cut]))
+            rf = engine.check_encoded(e, capacity=1024)
+    emit({"metric": f"streaming incremental extension vs full "
+                    f"re-check ({len(h)}-op history in {deltas} "
+                    f"deltas) [advisory]",
+          "value": round(len(h) / max(ti.wall, 1e-9), 1),
+          "unit": "ops/sec", "vs_baseline": None,
+          "stream": {"deltas": deltas, "ops": len(h),
+                     "incremental_secs": round(ti.wall, 4),
+                     "full_secs": round(tf.wall, 4),
+                     "speedup": round(tf.wall / max(ti.wall, 1e-9), 2),
+                     "verdicts_match": ri["valid?"] == rf["valid?"],
+                     "final_resume_event":
+                         ri["stream"]["resumed-from-event"]}})
+
+
 # ======================= parent orchestrator =======================
 
 def run_section(argv: list, timeout: float, env_extra: dict = None,
@@ -705,6 +757,14 @@ def main():
         mk_line = next((p for p in multikey if p.get("value")), None)
         if st == "hung":
             hung.append(("multikey", None))
+
+    # ---------------- 1b. streaming advisory (flag-gated) ----------
+    # BENCH_STREAM=1 only: an advisory incremental-extend vs full
+    # re-check line — gated so the default bench schema (and its
+    # budget) stays byte-identical when off
+    if probe_ok and os.environ.get("BENCH_STREAM") == "1" \
+            and left() > 90:
+        run_section(["stream"], min(sec_timeout("stream"), left()))
 
     # ---------------- 2. adversarial single-key --------------------
     def run_adv(L, trace_suffix=""):
@@ -1035,6 +1095,8 @@ def child_main(argv: list) -> None:
             sec_sharded(L, host_est, cap_log)
         elif sec == "maxlen":
             sec_maxlen(float(argv[1]))
+        elif sec == "stream":
+            sec_stream()
         else:
             raise SystemExit(f"unknown section {sec!r}")
     finally:

@@ -152,6 +152,7 @@ def _race_findings(sf: SourceFile) -> List[Finding]:
 # whose every call must sit inside a supervisor.dispatch thunk
 _DISPATCH_ENTRIES = {
     "_check_device", "_check_device_batch", "_check_device_resumable",
+    "_check_device_batch_resumable",
     "_check_bitdense", "_check_bitdense_batch",
     "_check_sharded", "_check_sharded2d", "_check_sharded_resume",
 }

@@ -79,9 +79,26 @@ def base_parser(prog: str = "jepsen") -> argparse.ArgumentParser:
     a = sub.add_parser("analyze", help="re-check a stored history")
     common(a)
     a.add_argument("--run-dir", help="store/<name>/<timestamp> to re-check")
-    s = sub.add_parser("serve", help="serve stored results over HTTP")
+    s = sub.add_parser(
+        "serve",
+        help="serve stored results over HTTP; with --checker, run the "
+             "streaming checker service instead")
     s.add_argument("--port", type=int, default=8080)
     s.add_argument("--host", default="0.0.0.0")
+    s.add_argument("--checker", action="store_true",
+                   help="run the streaming checker service: JSONL "
+                        "delta requests on stdin, verdict responses "
+                        "on stdout (docs/streaming.md)")
+    s.add_argument("--model", default="cas-register",
+                   choices=sorted(SERVE_MODELS),
+                   help="model family for --checker")
+    s.add_argument("--wal-dir", default=None,
+                   help="delta WAL + checkpoint-store directory for "
+                        "--checker (default: JEPSEN_TPU_SERVE_WAL)")
+    s.add_argument("--dedupe", default=None,
+                   choices=("sort", "hash"),
+                   help="frontier dedupe strategy for --checker "
+                        "(default: JEPSEN_TPU_DEDUPE)")
     # listed for --help discoverability only: run_cli dispatches `lint`
     # to jepsen_tpu.analysis.main BEFORE parsing (its own parser is the
     # single source of truth for lint flags and the 0/1/2 contract;
@@ -246,7 +263,33 @@ def run_test_all_cmd(test_fn: Callable[[Dict], Dict], args,
     return EXIT_VALID
 
 
+# model families the streaming checker service can be started with
+# (jepsen serve --checker --model <name>); values are jepsen_tpu.models
+# class names, instantiated with their defaults
+SERVE_MODELS = {
+    "register": "Register",
+    "cas-register": "CASRegister",
+    "mutex": "Mutex",
+    "gset": "GSet",
+    "fifo": "FIFOQueue",
+    "uqueue": "UnorderedQueue",
+}
+
+
 def run_serve_cmd(args) -> int:
+    if getattr(args, "checker", False):
+        # the streaming checker service (docs/streaming.md): deltas in,
+        # verdicts out, over the JSONL stdio transport — jax imports
+        # stay inside this branch so the results browser keeps working
+        # against a wedged device runtime
+        from jepsen_tpu import models as model_ns
+        from jepsen_tpu.serve import CheckerService, default_wal_dir
+        from jepsen_tpu.serve.stdio import run_stdio
+        model = getattr(model_ns, SERVE_MODELS[args.model])()
+        svc = CheckerService(model,
+                             wal_dir=args.wal_dir or default_wal_dir(),
+                             dedupe=args.dedupe)
+        return run_stdio(svc)
     from jepsen_tpu import web
     web.serve(host=args.host, port=args.port)
     return EXIT_VALID

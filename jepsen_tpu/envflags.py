@@ -237,3 +237,29 @@ def env_path(name: str, what: str = "path") -> Optional[str]:
 #                            jax.profiler.trace(<dir>) with
 #                            TraceAnnotation-named steps so host spans
 #                            line up with the TPU timeline in Perfetto
+#   JEPSEN_TPU_SERVE_QUEUE   env_int     serve.service — per-key
+#                            pending-delta queue bound (default 64,
+#                            min 1); a full queue BLOCKS the producer
+#                            (backpressure), never buffers unboundedly
+#   JEPSEN_TPU_SERVE_GLOBAL  env_int     serve.service — global
+#                            pending-ops hard bound across all keys
+#                            (default 65536, min 1); the service's
+#                            memory ceiling for unapplied deltas
+#   JEPSEN_TPU_SERVE_HIGH_WATER env_int  serve.service — pending-ops
+#                            level past which NEW deltas are shed with
+#                            a structured {shed, reason} response
+#                            (default: 3/4 of the global bound; 0
+#                            disables shedding — producers then block
+#                            at the hard bound instead)
+#   JEPSEN_TPU_SERVE_EVICT_SECS env_float serve.service — idle seconds
+#                            before a key's frontier is frozen to the
+#                            checkpoint store and its in-memory state
+#                            dropped (default 300; 0 disables; thaw on
+#                            the next delta is transparent and
+#                            digest-guarded)
+#   JEPSEN_TPU_SERVE_WAL     env_path    serve.service — the delta WAL
+#                            + checkpoint-store directory: unset/"0"
+#                            no WAL (in-memory service, no eviction),
+#                            "1" store/serve_wal, <path> there; every
+#                            ADMITTED delta is fsynced here before the
+#                            producer sees {"accepted"}

@@ -490,8 +490,16 @@ def _scan_step_factory(step_name: str, N: int, C: int,
         # visible in the same units.
         steps_n = steps_n + jnp.where(run, iters, 0)
         stepped_o = jnp.where(run, stepped2, stepped)
+        # pad events do not advance the return-event index: a resumed
+        # carry's r_idx must equal the number of REAL events processed
+        # so a checkpoint taken after a quantum-padded chunk (the
+        # streaming extension pads chunks to few jit shapes, and the
+        # batched form interleaves per-key pads) resumes at the right
+        # event. Identical for the historical paths — their pads only
+        # ever trail the last real event.
         return (st_o, ml_o, mh_o, live_o, new_ok, new_fail,
-                r_idx + 1, maxf, steps_n, stepped_o), ovf
+                r_idx + jnp.where(is_pad, 0, 1), maxf, steps_n,
+                stepped_o), ovf
 
     return scan_step
 
@@ -559,6 +567,33 @@ def _check_device_batch(xs, state0, step_name: str, N: int,
     )(xs, state0)
 
 
+# same donation decision as _check_device_resumable above
+@functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
+                   static_argnames=("step_name", "N", "dedupe",
+                                    "probe_limit", "sparse_pallas"))
+def _check_device_batch_resumable(xs, carry0, step_name: str, N: int,
+                                  dedupe: str = "sort",
+                                  probe_limit: int = 0,
+                                  sparse_pallas: str = "off"):
+    """The streaming extension's batched scan: one chunk of events per
+    key from an explicit per-key carry — jepsen_tpu.parallel.extend
+    stacks shape-compatible sessions' frontiers and advances them in
+    ONE device program (the cross-key delta batching the serve layer
+    dispatches). Pad events (ev_slot < 0) leave a key's carry
+    untouched, event index included, so per-key chunks of different
+    real lengths share the padded shape. Returns (carry_batch,
+    overflow[K])."""
+    C = xs["slot_f"].shape[2]
+    step = _scan_step_factory(step_name, N, C, dedupe, probe_limit,
+                              sparse_pallas)
+
+    def one(x, c):
+        carry, ovfs = lax.scan(step, c, x)
+        return carry, jnp.any(ovfs)
+
+    return jax.vmap(one)(xs, carry0)
+
+
 # ------------------------------------------------------------- host API
 
 
@@ -616,6 +651,22 @@ class FrontierCheckpoint:
         self.maxf = int(maxf)
         self.steps_n = int(steps_n)
         self.stepped = int(stepped)
+
+    @classmethod
+    def fresh(cls, e, capacity: int,
+              digest: Optional[str] = None) -> "FrontierCheckpoint":
+        """The event-0 checkpoint for an encoded history: one live
+        config (the initial model state, nothing linearized) — shared
+        by the resumable entry point and the streaming extension
+        (parallel.extend) so the two cannot diverge."""
+        N = max(64, capacity)
+        cp = cls(0, N, e.step_name,
+                 digest if digest is not None else history_digest(e),
+                 np.zeros(N, np.int32), np.zeros(N, np.uint32),
+                 np.zeros(N, np.uint32), np.arange(N) < 1,
+                 True, -1, 1, 0)
+        cp.st[0] = e.state0
+        return cp
 
     def carry(self, device=None):
         """The device scan carry this checkpoint resumes from. With
@@ -727,13 +778,8 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
         cp = resume
         N = cp.capacity
     else:
-        N = max(64, capacity)
-        cp = FrontierCheckpoint(
-            0, N, e.step_name, digest,
-            np.zeros(N, np.int32), np.zeros(N, np.uint32),
-            np.zeros(N, np.uint32), np.arange(N) < 1,
-            True, -1, 1, 0)
-        cp.st[0] = e.state0
+        cp = FrontierCheckpoint.fresh(e, capacity, digest)
+        N = cp.capacity
     xs_np = {
         "slot_f": e.slot_f, "slot_a0": e.slot_a0, "slot_a1": e.slot_a1,
         "slot_wild": e.slot_wild, "slot_occ": e.slot_occ,
@@ -1315,11 +1361,19 @@ def encode_batch(model, histories, pad_slots: Optional[int] = None,
         # their final width — silently ignoring pad_slots here (the old
         # behavior) would hand back a batch narrower than the caller
         # asked for, which only surfaces later as a shape mismatch in
-        # whatever program the caller compiled for the requested width
-        raise ValueError(
-            "encode_batch: pad_slots cannot be combined with "
-            "pre-encoded encs (their slot tables are already at final "
-            "width) — re-encode with pad_slots, or pass encs alone")
+        # whatever program the caller compiled for the requested width.
+        # The one legal case: every enc was already padded to exactly
+        # the requested width (the streaming extension pre-allocates
+        # its group tier's width — parallel.extend), in which case the
+        # request is a no-op rather than a conflict.
+        if any(e.slot_f.shape[1] != pad_slots for e in encs):
+            raise ValueError(
+                "encode_batch: pad_slots conflicts with pre-encoded "
+                "encs whose slot tables are at a different width (their "
+                "tables are already final) — re-encode with pad_slots, "
+                "or grow them through the extension API "
+                "(jepsen_tpu.parallel.extend.extend_encoded / "
+                "HistorySession), which pre-allocates matching widths")
     xs, state0, _, _, _ = enc_mod.pad_batch(encs, mesh=mesh)
     return encs, xs, state0
 

@@ -1,0 +1,13 @@
+"""Streaming checker service (docs/streaming.md): a long-lived,
+crash-safe front over the incremental extension engine
+(``jepsen_tpu.parallel.extend``) — per-key history deltas in, online
+verdicts out, with backpressure, load shedding, idle-frontier
+eviction, and WAL replay. ``jepsen serve --checker`` is the CLI
+ingress (``serve.stdio``)."""
+
+from jepsen_tpu.serve.service import (  # noqa: F401
+    CheckerService, default_wal_dir,
+)
+from jepsen_tpu.serve.wal import (  # noqa: F401
+    CheckpointStore, DeltaWAL, WALError,
+)

@@ -1,0 +1,666 @@
+"""The streaming checker service: crash-safe incremental checking of
+per-key history deltas, with backpressure, shedding, and eviction.
+
+``jepsen.core/run!`` interleaves test execution with analysis
+(PAPER.md L6/L7); this is that loop as a long-lived service over the
+TPU engine. Producers submit per-key deltas; the service extends each
+key's frontier incrementally (``parallel.extend.HistorySession``),
+batches shape-compatible keys into one device program per scan leg
+(``parallel.extend.advance_sessions``), and serves verdicts that are
+bit-identical to a one-shot batch check of the same prefix.
+
+Robustness is the headline property, by construction:
+
+* **No admitted delta is ever silently dropped.** ``submit`` appends
+  to the per-key WAL (``serve.wal.DeltaWAL``) BEFORE acknowledging;
+  the final verdict's ``seq`` accounts for every accepted delta.
+* **Bounded memory.** Per-key queues are bounded in deltas, the
+  global backlog in ops; a slow producer BLOCKS (backpressure), and
+  past the high-water mark new deltas are shed with a structured
+  ``{"shed": True, "reason": ...}`` instead of buffering — the
+  service degrades by refusing work, never by OOM.
+* **Crash safety.** A kill-and-restart replays the WAL through the
+  deterministic encode + scan: bit-identical verdicts, duplicate
+  deltas detected by sequence number (idempotent replay).
+* **Eviction.** Idle keys freeze their frontier to the checkpoint
+  store and drop their in-memory state; the next delta thaws them
+  transparently (digest-guarded — a mismatch rescans, never trusts a
+  stale frontier).
+* **Device failure.** Every scan runs through the PR-6 resilience
+  seam: a wedge mid-dispatch resumes from the checkpoint, a dead or
+  breaker-open backend degrades the remaining suffix to the host WGL
+  engine with the structured ``resilience`` note — verdicts never
+  flip (docs/resilience.md).
+
+Threading: producers call ``submit``/``result`` from any thread; one
+worker thread owns every session and the device. ``asyncio`` fronts
+wrap the blocking calls with ``run_in_executor`` (the bounded
+``submit`` IS the backpressure; see docs/streaming.md).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from jepsen_tpu import envflags, obs
+from jepsen_tpu.history import TYPES
+from jepsen_tpu.parallel import extend as ext
+from jepsen_tpu.serve.wal import CheckpointStore, DeltaWAL
+
+_log = logging.getLogger(__name__)
+
+DEFAULT_PER_KEY_QUEUE = 64       # pending deltas per key
+DEFAULT_GLOBAL_BOUND = 65536     # pending ops across all keys
+DEFAULT_EVICT_SECS = 300.0
+
+
+def _resolve_per_key_queue(v: Optional[int]) -> int:
+    if v is not None:
+        return int(v)
+    return envflags.env_int("JEPSEN_TPU_SERVE_QUEUE",
+                            default=DEFAULT_PER_KEY_QUEUE, min_value=1,
+                            what="per-key queue bound")
+
+
+def _resolve_global_bound(v: Optional[int]) -> int:
+    if v is not None:
+        return int(v)
+    return envflags.env_int("JEPSEN_TPU_SERVE_GLOBAL",
+                            default=DEFAULT_GLOBAL_BOUND, min_value=1,
+                            what="global pending-ops bound")
+
+
+def _resolve_high_water(v: Optional[int], global_bound: int) -> int:
+    if v is None:
+        v = envflags.env_int("JEPSEN_TPU_SERVE_HIGH_WATER",
+                             default=-1, min_value=0,
+                             what="shed high-water")
+        if v == -1:
+            v = (global_bound * 3) // 4   # shed before the hard block
+    return int(v)
+
+
+def _resolve_evict_secs(v: Optional[float]) -> float:
+    if v is not None:
+        return float(v)
+    return envflags.env_float("JEPSEN_TPU_SERVE_EVICT_SECS",
+                              default=DEFAULT_EVICT_SECS, min_value=0.0,
+                              what="eviction idle seconds")
+
+
+def default_wal_dir() -> Optional[str]:
+    """The JEPSEN_TPU_SERVE_WAL flag: unset/0 -> no WAL (in-memory
+    service), 1 -> ``store/serve_wal``, path -> that directory."""
+    import os
+    v = envflags.env_path("JEPSEN_TPU_SERVE_WAL", what="WAL directory")
+    if v == "":
+        return os.path.join("store", "serve_wal")
+    return v
+
+
+class _Key:
+    """Per-key service state; every field is guarded by the service
+    condition except ``session``, which only the worker touches."""
+
+    __slots__ = ("key", "session", "pending", "enq_seq", "applied_seq",
+                 "last_result", "last_activity", "finalized",
+                 "finalize_requested", "needs_check", "pending_ops",
+                 "wal_next", "broken", "wal_dead")
+
+    def __init__(self, key):
+        self.key = key
+        self.session = None
+        self.pending: deque = deque()     # (seq, [Op, ...])
+        self.enq_seq = 0
+        self.applied_seq = 0
+        self.last_result: Optional[dict] = None
+        self.last_activity = 0.0
+        self.finalized = False
+        self.finalize_requested = False
+        self.needs_check = False
+        self.pending_ops = 0
+        self.wal_next = 1   # next seq allowed to write the WAL (the
+        # per-key seq-ordered handoff that keeps file order == seq
+        # order without holding the service lock across an fsync)
+        self.broken = False     # worker crash lost state and no WAL
+        # can rebuild it: the key refuses further deltas instead of
+        # silently restarting from a truncated history
+        self.wal_dead = False   # a WAL append for this key stalled or
+        # failed: later seqs must not write (no holes below an
+        # acknowledged delta) — producers get durable=False answers
+
+
+class CheckerService:
+    """The streaming checker (module docstring). Construct, submit
+    deltas, read results; ``close(drain=True)`` is the graceful
+    shutdown. Usable as a context manager."""
+
+    def __init__(self, model, wal_dir: Optional[str] = None, *,
+                 capacity: int = 1024, max_capacity: int = 1 << 20,
+                 dedupe: Optional[str] = None, probe_limit: int = 0,
+                 sparse_pallas: Optional[bool] = None, device=None,
+                 bucket: Optional[str] = None,
+                 per_key_queue: Optional[int] = None,
+                 global_bound: Optional[int] = None,
+                 high_water: Optional[int] = None,
+                 evict_idle_secs: Optional[float] = None,
+                 recover: bool = True, start_worker: bool = True,
+                 clock=time.monotonic):
+        self.model = model
+        self.capacity = capacity
+        self.max_capacity = max_capacity
+        self.dedupe = dedupe
+        self.probe_limit = probe_limit
+        self.sparse_pallas = sparse_pallas
+        self.device = device
+        self.bucket = bucket
+        self.per_key_queue = _resolve_per_key_queue(per_key_queue)
+        self.global_bound = _resolve_global_bound(global_bound)
+        self.high_water = _resolve_high_water(high_water,
+                                              self.global_bound)
+        self.evict_idle_secs = _resolve_evict_secs(evict_idle_secs)
+        self._clock = clock
+        self._wal = DeltaWAL(wal_dir) if wal_dir else None
+        self._cps = (CheckpointStore(wal_dir + "/checkpoints")
+                     if wal_dir else None)
+        self._keys: Dict = {}
+        self._cond = threading.Condition()
+        self._pending_ops = 0
+        self._inflight = 0
+        self._stop = False
+        self.max_pending_seen = 0   # high-water mark, for bound tests
+        if recover and self._wal is not None:
+            self._recover()
+        self._worker = None
+        if start_worker:
+            self.start_worker()
+
+    def start_worker(self) -> None:
+        """Spawn the worker thread (the constructor's default).
+        ``start_worker=False`` + a later call makes producer-side
+        behavior — admission, backpressure, shedding — exactly
+        observable in tests: nothing drains until the worker runs."""
+        if self._worker is not None:
+            return
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="jepsen-serve-worker")
+        self._worker.start()
+
+    # ------------------------------------------------- producer API
+
+    def submit(self, key, ops, seq: Optional[int] = None,
+               timeout: Optional[float] = None,
+               wait: bool = False) -> dict:
+        """Admit one delta for ``key``. Returns one of::
+
+            {"accepted": True, "seq": n, "key": k}
+            {"duplicate": True, "seq": n, "key": k}   idempotent replay
+            {"shed": True, "reason": ..., "key": k}   overload
+            {"error": ..., "key": k}                  malformed request
+
+        Blocks (backpressure) while the key's queue or the global
+        backlog is full, up to ``timeout`` seconds (then sheds). With
+        ``wait=True``, additionally blocks until this delta's verdict
+        is computed and returns it (the smoke-test convenience)."""
+        ops = list(ops)
+        for o in ops:
+            t = o.get("type") if hasattr(o, "get") else None
+            if t not in TYPES:
+                return {"error": f"delta op {o!r}: type must be one of "
+                                 f"{TYPES}", "key": key}
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cond:
+            ks = self._keys.get(key)
+            if ks is None:
+                ks = self._keys[key] = _Key(key)
+                obs.counter("serve.keys_admitted").inc()
+            # validate-then-wait-then-REVALIDATE: every check runs
+            # again after a cond.wait released the lock — a concurrent
+            # producer may have taken the seq or finalized the key
+            # while this one slept
+            while True:
+                if ks.broken:
+                    return {"error": "key state was lost to a worker "
+                                     "crash and no WAL is configured "
+                                     "to rebuild it — restart the "
+                                     "stream under a new key",
+                            "key": key}
+                if ks.finalized or ks.finalize_requested:
+                    return {"error": "key is finalized", "key": key}
+                my_seq = int(seq) if seq is not None else ks.enq_seq + 1
+                if my_seq <= ks.enq_seq:
+                    obs.counter("serve.duplicate_deltas").inc()
+                    return {"duplicate": True, "seq": my_seq,
+                            "key": key}
+                if my_seq != ks.enq_seq + 1:
+                    return {"error": f"sequence gap: expected "
+                                     f"{ks.enq_seq + 1}, got {my_seq}",
+                            "key": key}
+                if self.high_water \
+                        and self._pending_ops + len(ops) \
+                        > self.high_water:
+                    obs.counter("serve.sheds").inc()
+                    return {"shed": True,
+                            "reason": f"pending ops past high-water "
+                                      f"({self._pending_ops}+"
+                                      f"{len(ops)} > "
+                                      f"{self.high_water})",
+                            "key": key}
+                if len(ks.pending) < self.per_key_queue \
+                        and self._pending_ops + len(ops) \
+                        <= self.global_bound:
+                    break   # admitted
+                if self._stop:
+                    return {"shed": True, "reason": "service stopping",
+                            "key": key}
+                rem = (None if deadline is None
+                       else deadline - self._clock())
+                if rem is not None and rem <= 0:
+                    obs.counter("serve.sheds").inc()
+                    return {"shed": True,
+                            "reason": "backpressure timeout "
+                                      "(queue full)", "key": key}
+                self._cond.wait(0.5 if rem is None else min(rem, 0.5))
+            # reserve the seq + queue slot under the lock (pending
+            # stays seq-ordered because reservations are), then write
+            # the WAL OUTSIDE it — an fsync must not serialize every
+            # other key's producers and the worker on one lock
+            ks.pending.append((my_seq, ops))
+            ks.enq_seq = my_seq
+            ks.pending_ops += len(ops)
+            self._pending_ops += len(ops)
+            self.max_pending_seen = max(self.max_pending_seen,
+                                        self._pending_ops)
+            obs.counter("serve.deltas").inc()
+            obs.counter("serve.delta_ops").inc(len(ops))
+            obs.gauge("serve.pending_ops").set(self._pending_ops)
+            self._cond.notify_all()
+        durable = self._wal is not None
+        if self._wal is not None:
+            # per-key seq-ordered handoff: seq N's bytes land before
+            # N+1's, so a crash can truncate the WAL only at the tail,
+            # never leave a hole below an acknowledged delta. The wait
+            # honors the caller deadline and shutdown — one stalled
+            # fsync (a sick disk) must not block later producers
+            # forever; it instead marks the key's WAL dead so no later
+            # seq writes (no holes), and answers carry durable=False.
+            give_up = False
+            with self._cond:
+                while ks.wal_next != my_seq and not ks.wal_dead:
+                    if self._stop:
+                        give_up = True
+                        break
+                    rem = (None if deadline is None
+                           else deadline - self._clock())
+                    if rem is not None and rem <= 0:
+                        give_up = True
+                        break
+                    self._cond.wait(0.5 if rem is None
+                                    else min(rem, 0.5))
+                if give_up or ks.wal_dead:
+                    ks.wal_dead = True
+                    durable = False
+                    self._cond.notify_all()
+            if durable:
+                try:
+                    self._wal.append(key, my_seq, ops)
+                except Exception as err:  # noqa: BLE001 — a failed
+                    # append must not hold the handoff or hide the
+                    # durability loss from the producer
+                    durable = False
+                    obs.counter("serve.wal_errors").inc()
+                    _log.warning("WAL append failed for key %r seq "
+                                 "%d (%r) — delta applies in-process "
+                                 "only", key, my_seq, err)
+                    with self._cond:
+                        ks.wal_dead = True
+                        self._cond.notify_all()
+                else:
+                    with self._cond:
+                        ks.wal_next = my_seq + 1
+                        self._cond.notify_all()
+        if wait:
+            rem = None if deadline is None else deadline - self._clock()
+            r = self.result(key, min_seq=my_seq, timeout=rem)
+            if not durable and self._wal is not None:
+                r["durable"] = False
+            return r
+        out = {"accepted": True, "seq": my_seq, "key": key}
+        if not durable and self._wal is not None:
+            obs.counter("serve.nondurable_acks").inc()
+            out["durable"] = False
+        return out
+
+    def result(self, key, min_seq: Optional[int] = None,
+               timeout: Optional[float] = None) -> dict:
+        """The verdict covering the key's applied deltas; blocks until
+        at least ``min_seq`` (default: everything enqueued so far) has
+        been applied."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cond:
+            ks = self._keys.get(key)
+            if ks is None:
+                return {"error": "unknown key", "key": key}
+            target = ks.enq_seq if min_seq is None else int(min_seq)
+            while ks.applied_seq < target or ks.last_result is None \
+                    or ks.needs_check:
+                rem = (None if deadline is None
+                       else deadline - self._clock())
+                if rem is not None and rem <= 0:
+                    return {"error": "timeout waiting for verdict",
+                            "key": key, "applied-seq": ks.applied_seq}
+                self._cond.wait(0.5 if rem is None else min(rem, 0.5))
+            r = dict(ks.last_result)
+            r["seq"] = ks.applied_seq
+            r["key"] = key
+            return r
+
+    def finalize(self, key, timeout: Optional[float] = None) -> dict:
+        """Drain the key's pending deltas, run the final check
+        (counterexample extraction included), and seal the key —
+        further deltas get ``{"error": "key is finalized"}``."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cond:
+            ks = self._keys.get(key)
+            if ks is None:
+                return {"error": "unknown key", "key": key}
+            ks.finalize_requested = True
+            self._cond.notify_all()
+            while not ks.finalized:
+                rem = (None if deadline is None
+                       else deadline - self._clock())
+                if rem is not None and rem <= 0:
+                    return {"error": "timeout waiting for finalize",
+                            "key": key}
+                self._cond.wait(0.5 if rem is None else min(rem, 0.5))
+            r = dict(ks.last_result or {})
+            r["seq"] = ks.applied_seq
+            r["key"] = key
+            return r
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every admitted delta has been applied (graceful
+        shutdown's first half). True when drained."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cond:
+            while self._pending_ops > 0 or self._inflight > 0 \
+                    or any(ks.needs_check
+                           or (ks.finalize_requested
+                               and not ks.finalized)
+                           for ks in self._keys.values()):
+                rem = (None if deadline is None
+                       else deadline - self._clock())
+                if rem is not None and rem <= 0:
+                    return False
+                self._cond.wait(0.5 if rem is None else min(rem, 0.5))
+            return True
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Graceful shutdown: drain (unless told not to), stop the
+        worker, close the WAL. Admitted-but-unapplied deltas survive
+        in the WAL either way — the restart replays them."""
+        if drain:
+            self.drain(timeout=timeout)
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=30)
+        if self._wal is not None:
+            self._wal.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close(drain=not any(exc))
+        return False
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {"keys": len(self._keys),
+                    "keys_live": sum(1 for k in self._keys.values()
+                                     if k.session is not None),
+                    "pending_ops": self._pending_ops,
+                    "max_pending_seen": self.max_pending_seen}
+
+    # ------------------------------------------------------ recovery
+
+    def _recover(self) -> None:
+        """Rebuild every key from its WAL (synchronously, before the
+        worker starts): replay is deterministic, so the recomputed
+        verdicts are bit-identical to the pre-crash ones. An evicted
+        checkpoint, when present and digest-matched, spares the replay
+        its device re-scan of the settled prefix."""
+        for key in self._wal.keys():
+            deltas = self._wal.replay(key)
+            if not deltas:
+                continue
+            cp, meta = (self._cps.load(key) if self._cps is not None
+                        else (None, None))
+            applied = int(meta.get("applied_seq", 0)) if meta else 0
+            base = [op for seq, ops in deltas if seq <= applied
+                    for op in ops]
+            rest = [(seq, ops) for seq, ops in deltas if seq > applied]
+            ks = _Key(key)
+            sess = self._new_session(key)
+            if base:
+                with obs.span("serve.thaw", key=str(key)):
+                    sess.thaw(base, cp)
+                ks.applied_seq = applied
+                ks.needs_check = True
+            ks.session = sess
+            if meta and meta.get("finalized"):
+                ks.finalize_requested = True
+            ks.enq_seq = deltas[-1][0]
+            ks.wal_next = deltas[-1][0] + 1
+            ks.pending.extend(rest)
+            ks.pending_ops = sum(len(ops) for _, ops in rest)
+            self._pending_ops += ks.pending_ops
+            ks.last_activity = self._clock()
+            self._keys[key] = ks
+            obs.counter("serve.replayed_deltas").inc(len(deltas))
+        if self._keys:
+            _log.info("serve: recovered %d key(s) from the WAL",
+                      len(self._keys))
+
+    # -------------------------------------------------- worker side
+
+    def _new_session(self, key) -> ext.HistorySession:
+        return ext.HistorySession(
+            self.model, capacity=self.capacity,
+            max_capacity=self.max_capacity, dedupe=self.dedupe,
+            probe_limit=self.probe_limit,
+            sparse_pallas=self.sparse_pallas, device=self.device,
+            key=key)
+
+    def _session_for(self, ks: _Key) -> ext.HistorySession:
+        if ks.session is not None:
+            return ks.session
+        # evicted: thaw transparently from checkpoint store + WAL
+        sess = self._new_session(ks.key)
+        cp, _meta = (self._cps.load(ks.key)
+                     if self._cps is not None else (None, None))
+        ops = [op for seq, dops in
+               (self._wal.replay(ks.key) if self._wal else [])
+               if seq <= ks.applied_seq for op in dops]
+        if ops:
+            with obs.span("serve.thaw", key=str(ks.key)):
+                sess.thaw(ops, cp)
+            obs.counter("serve.thaws").inc()
+        ks.session = sess
+        return sess
+
+    def _work_available_locked(self) -> bool:
+        return any(ks.pending or ks.needs_check
+                   or (ks.finalize_requested and not ks.finalized)
+                   for ks in self._keys.values())
+
+    def _take_work_locked(self) -> list:
+        """Pop every key's pending deltas (coalesced, seq order) and
+        settle the backpressure accounting HERE — ops leave the queue
+        exactly once, so no later error path can double-decrement.
+        In-flight work is bounded by what the queue admitted."""
+        batch = []
+        for ks in self._keys.values():
+            if not (ks.pending or ks.needs_check
+                    or (ks.finalize_requested and not ks.finalized)):
+                continue
+            ops = []
+            last_seq = None
+            while ks.pending:
+                seq, dops = ks.pending.popleft()
+                ops.extend(dops)
+                last_seq = seq
+            ks.pending_ops -= len(ops)
+            self._pending_ops -= len(ops)
+            final = ks.finalize_requested and not ks.finalized
+            batch.append((ks, ops, last_seq, final))
+        if batch:
+            obs.gauge("serve.pending_ops").set(self._pending_ops)
+            self._cond.notify_all()   # queue space freed: release
+            # blocked producers now, not after the device work
+        return batch
+
+    def _crashed_entry(self, ks: _Key, err) -> dict:
+        """Per-entry failure isolation: a loud error verdict, and the
+        in-memory session is DROPPED so the next delta thaw-replays
+        the WAL instead of extending a session that may have missed
+        acknowledged ops. Without a WAL there is nothing to replay —
+        the key is POISONED (further deltas refused) rather than
+        silently rebuilt from a truncated history."""
+        obs.counter("serve.worker_errors").inc()
+        _log.exception("serve worker: key %r failed", ks.key)
+        ks.session = None
+        if self._wal is None:
+            ks.broken = True
+        return {"valid?": "unknown",
+                "error": f"serve worker crashed on this key: "
+                         f"{type(err).__name__}: {err}"}
+
+    def _process(self, batch: list) -> None:
+        # phase 1 (no lock): apply deltas; a crash costs ONE key
+        entries = []
+        for ks, ops, last_seq, final in batch:
+            sess = err_r = None
+            if ks.broken:
+                # poisoned (worker crash, no WAL): keep serving the
+                # error verdict; never rebuild from a truncated stream
+                entries.append((ks, None, last_seq, final,
+                                dict(ks.last_result or {
+                                    "valid?": "unknown",
+                                    "error": "key poisoned"})))
+                continue
+            try:
+                sess = self._session_for(ks)
+                if ops:
+                    with obs.span("serve.apply", key=str(ks.key),
+                                  ops=len(ops)):
+                        sess.extend(ops)
+            except Exception as err:  # noqa: BLE001 — isolate per key
+                err_r = self._crashed_entry(ks, err)
+            entries.append((ks, sess, last_seq, final, err_r))
+        # phase 2 (no lock): one batched advance over the live ones
+        live = [e for e in entries if e[4] is None]
+        try:
+            with obs.span("serve.advance", keys=len(live)):
+                rs = ext.advance_sessions([e[1] for e in live],
+                                          bucket=self.bucket)
+            results = dict(zip((id(e[0]) for e in live), rs))
+        except Exception as err:  # noqa: BLE001 — advance_sessions
+            # degrades internally; anything escaping is a bug, and it
+            # must cost these keys a loud verdict, not the worker
+            results = {id(e[0]): self._crashed_entry(e[0], err)
+                       for e in live}
+        # phase 3 (no lock): finalization — counterexample extraction
+        # is a real device dispatch and must not stall every other
+        # key's submit/result behind the service lock
+        for ks, sess, _last_seq, final, err_r in entries:
+            if final and err_r is None and id(ks) in results \
+                    and sess is not None:
+                try:
+                    results[id(ks)] = sess.finalize()
+                except Exception as err:  # noqa: BLE001
+                    results[id(ks)] = self._crashed_entry(ks, err)
+        # phase 4: publish under the lock
+        with self._cond:
+            for ks, sess, last_seq, final, err_r in entries:
+                ks.last_result = (err_r if err_r is not None
+                                  else results[id(ks)])
+                ks.needs_check = False
+                if final:
+                    ks.finalized = True
+                if last_seq is not None:
+                    ks.applied_seq = last_seq
+                ks.last_activity = self._clock()
+            self._cond.notify_all()
+
+    def _maybe_evict(self) -> None:
+        if self._cps is None or self.evict_idle_secs <= 0:
+            return
+        now = self._clock()
+        with self._cond:
+            victims = [ks for ks in self._keys.values()
+                       if ks.session is not None and not ks.pending
+                       and not ks.needs_check
+                       and not (ks.finalize_requested
+                                and not ks.finalized)
+                       and now - ks.last_activity
+                       > self.evict_idle_secs]
+        for ks in victims:
+            with obs.span("serve.evict", key=str(ks.key)):
+                meta = ks.session.freeze(
+                    self._cps.checkpoint_path(ks.key))
+            meta["applied_seq"] = ks.applied_seq
+            meta["finalized"] = ks.finalized
+            self._cps.save(ks.key, meta)
+            with self._cond:
+                ks.session = None
+            obs.counter("serve.evictions").inc()
+        if victims:
+            with self._cond:
+                live = sum(1 for k in self._keys.values()
+                           if k.session is not None)
+            obs.gauge("serve.keys_live").set(live)
+
+    def _run(self) -> None:
+        poll = (min(0.25, max(0.01, self.evict_idle_secs / 4))
+                if self._cps is not None and self.evict_idle_secs > 0
+                else 0.5)
+        while True:
+            with self._cond:
+                while not self._stop \
+                        and not self._work_available_locked():
+                    self._cond.wait(timeout=poll)
+                    if self._cps is not None:
+                        break   # wake to run the eviction sweep
+                if self._stop and not self._work_available_locked():
+                    return
+                batch = self._take_work_locked()
+                self._inflight = len(batch)
+            try:
+                if batch:
+                    self._process(batch)
+            except Exception as err:  # noqa: BLE001 — _process
+                # isolates failures per key; anything reaching here is
+                # a bug in the batching itself. The worker must
+                # survive it: publish loud error verdicts (accounting
+                # was settled at take time) and drop the sessions so
+                # the WAL replay recovers the truth on the next delta.
+                with self._cond:
+                    for ks, _ops, last_seq, _final in batch:
+                        ks.last_result = self._crashed_entry(ks, err)
+                        ks.needs_check = False
+                        if last_seq is not None:
+                            ks.applied_seq = last_seq
+                    self._cond.notify_all()
+            finally:
+                with self._cond:
+                    self._inflight = 0
+                    self._cond.notify_all()
+            self._maybe_evict()

@@ -1,0 +1,82 @@
+"""JSONL stdio front-end for the streaming checker service — the
+``jepsen serve --checker`` transport.
+
+One JSON request per input line, one JSON response per output line
+(machine-first, like the bench's emit contract). Requests::
+
+    {"key": K, "ops": [<op map>, ...], "seq": N?}   submit a delta
+    {"op": "result",   "key": K}                    current verdict
+    {"op": "finalize", "key": K}                    drain + final check
+    {"op": "drain"}                                 apply everything
+    {"op": "stop"}                                  graceful shutdown
+
+Op maps are the history schema ({"type", "process", "f", "value",
+...}); responses are the service's structured dicts (``accepted`` /
+``shed`` / ``duplicate`` / verdicts) with non-JSON values stringified.
+An HTTP or asyncio ingress wraps the same :class:`CheckerService`
+calls; this transport exists so the service is drivable from CI and a
+shell with zero extra dependencies.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from jepsen_tpu.history import Op, _hashable
+
+
+def _jsonable(obj):
+    return json.loads(json.dumps(obj, default=str))
+
+
+def _key(req):
+    # JSON list keys (jepsen.independent [k sub] tuples) arrive as
+    # lists — canonicalize to the hashable form the service keys on
+    return _hashable(req.get("key"))
+
+
+def run_stdio(service, lines_in=None, out=None) -> int:
+    """Drive ``service`` from a JSONL stream; returns an exit code.
+    The service is closed (with drain) on EOF or ``stop``."""
+    lines_in = sys.stdin if lines_in is None else lines_in
+    out = sys.stdout if out is None else out
+
+    def emit(obj):
+        out.write(json.dumps(_jsonable(obj)) + "\n")
+        out.flush()
+
+    try:
+        for line in lines_in:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+            except ValueError as err:
+                emit({"error": f"bad request line: {err}"})
+                continue
+            op = req.get("op")
+            if op == "stop":
+                emit({"stopped": True})
+                break
+            if op == "drain":
+                emit({"drained": service.drain(
+                    timeout=req.get("timeout"))})
+            elif op == "result":
+                emit(service.result(_key(req),
+                                    timeout=req.get("timeout")))
+            elif op == "finalize":
+                emit(service.finalize(_key(req),
+                                      timeout=req.get("timeout")))
+            elif "ops" in req:
+                emit(service.submit(_key(req),
+                                    [Op(o) for o in req["ops"]],
+                                    seq=req.get("seq"),
+                                    timeout=req.get("timeout"),
+                                    wait=bool(req.get("wait"))))
+            else:
+                emit({"error": f"unknown request {req!r}"})
+    finally:
+        service.close(drain=True)
+    return 0

@@ -1,0 +1,256 @@
+"""Per-key delta write-ahead log for the streaming checker service.
+
+The robustness contract (docs/streaming.md): a delta the service has
+ADMITTED is durable before the producer sees ``{"accepted": ...}`` —
+a kill-and-restart replays the WAL through the deterministic encode +
+scan and lands bit-identical verdicts. Format: one append-only JSONL
+file per key under the WAL root,
+
+    {"key": "<edn of the key>"}                 header, first line
+    {"seq": 1, "ops": ["<edn op>", ...]}        one line per delta
+
+Ops are EDN-serialized individually (``history.op_to_edn_str`` — the
+store's exact round-trip format), so replay reconstructs the op
+stream byte-for-byte. Sequence numbers are the idempotence key:
+``replay`` drops duplicate/stale seqs, so re-submitting a delta after
+a crash (the client can't know whether the pre-crash submit landed)
+is a no-op, never a double-apply.
+
+Crash tolerance: every append is flushed + fsynced before returning;
+a torn final line (the process died mid-write — that delta was never
+acknowledged) is detected on replay, logged, counted
+(``serve.wal_torn``), and ignored. Undecodable lines BEFORE the tail
+mean real corruption and raise :class:`WALError` rather than silently
+replaying a hole in an acknowledged stream.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from jepsen_tpu import edn, obs
+from jepsen_tpu.history import _hashable, op_from_edn, op_to_edn_str
+
+_log = logging.getLogger(__name__)
+
+
+class WALError(RuntimeError):
+    """An acknowledged region of a WAL file cannot be replayed."""
+
+
+def _safe_name(key) -> str:
+    """Filesystem-safe, collision-free file stem for an arbitrary EDN
+    key: readable prefix + content digest (the digest is the identity;
+    the prefix is for humans)."""
+    s = edn.dumps(key)
+    digest = hashlib.sha1(s.encode()).hexdigest()[:10]
+    prefix = "".join(ch if ch.isalnum() or ch in "-_" else "-"
+                     for ch in s)[:40]
+    return f"{prefix or 'key'}_{digest}"
+
+
+class DeltaWAL:
+    """Append-only per-key delta log under ``root`` (module docstring).
+    Thread-safe; the service appends from producer threads and replays
+    from the worker."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.Lock()          # handle/lock creation
+        self._files: Dict[str, object] = {}    # stem -> open handle
+        # per-stem write locks: independent keys fsync CONCURRENTLY —
+        # one global lock here would re-serialize exactly what the
+        # service's seq-ordered handoff exists to avoid
+        self._stem_locks: Dict[str, threading.Lock] = {}
+
+    # -- write path
+
+    @staticmethod
+    def _repair_tail(path: str) -> None:
+        """Truncate a torn (newline-less) trailing line before the
+        first append of this process. The partial line is an
+        UNACKNOWLEDGED mid-write kill — replay already ignores it, but
+        appending after it would concatenate the next record onto the
+        partial bytes, turning an acknowledged delta into an
+        unparseable line on the following restart."""
+        try:
+            with open(path, "rb+") as fh:
+                fh.seek(0, os.SEEK_END)
+                if fh.tell() == 0:
+                    return
+                fh.seek(-1, os.SEEK_END)
+                if fh.read(1) == b"\n":
+                    return
+                fh.seek(0)
+                data = fh.read()
+                cut = data.rfind(b"\n")
+                fh.truncate(cut + 1 if cut >= 0 else 0)
+            obs.counter("serve.wal_torn").inc()
+            _log.warning("WAL %s: truncated a torn trailing line "
+                         "before appending (the delta was never "
+                         "acknowledged)", path)
+        except OSError as err:
+            _log.warning("WAL %s: could not repair tail (%r)", path,
+                         err)
+
+    def append(self, key, seq: int, ops) -> None:
+        stem = _safe_name(key)
+        line = json.dumps({"seq": int(seq),
+                           "ops": [op_to_edn_str(o) for o in ops]})
+        with self._lock:
+            slock = self._stem_locks.setdefault(stem, threading.Lock())
+        with slock:
+            with self._lock:
+                fh = self._files.get(stem)
+            if fh is None:
+                path = os.path.join(self.root, stem + ".wal")
+                fresh = not os.path.exists(path)
+                if not fresh:
+                    self._repair_tail(path)
+                fh = open(path, "a")
+                if fresh:
+                    fh.write(json.dumps({"key": edn.dumps(key)}) + "\n")
+                with self._lock:
+                    self._files[stem] = fh
+            fh.write(line + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            for fh in self._files.values():
+                try:
+                    fh.close()
+                except OSError:
+                    pass
+            self._files.clear()
+            self._stem_locks.clear()
+
+    # -- replay path
+
+    def keys(self) -> list:
+        """Every key with a WAL file (decoded from the headers)."""
+        out = []
+        for name in sorted(os.listdir(self.root)):
+            if not name.endswith(".wal"):
+                continue
+            path = os.path.join(self.root, name)
+            try:
+                with open(path) as fh:
+                    head = fh.readline()
+                # EDN round-trips sequences as lists; the service keys
+                # a dict on these, so canonicalize to the hashable
+                # form (nested tuples) — same identity either way,
+                # because _safe_name hashes the EDN text
+                out.append(_hashable(edn.loads(json.loads(head)["key"])))
+            except Exception as err:  # noqa: BLE001 — a header we
+                # cannot read means the whole file is suspect; this is
+                # acknowledged data, so it must be loud, not skipped
+                raise WALError(
+                    f"unreadable WAL header in {path}: {err!r}") from err
+        return out
+
+    def replay(self, key) -> List[Tuple[int, list]]:
+        """The key's admitted deltas as ``[(seq, [Op, ...]), ...]`` in
+        ascending seq order, duplicates dropped. Tolerates exactly one
+        torn TRAILING line (an unacknowledged mid-write kill)."""
+        path = os.path.join(self.root, _safe_name(key) + ".wal")
+        if not os.path.exists(path):
+            return []
+        with open(path) as fh:
+            lines = fh.read().splitlines()
+        out: List[Tuple[int, list]] = []
+        seen = set()
+        for i, line in enumerate(lines[1:], start=2):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+                seq = int(rec["seq"])
+                ops = [op_from_edn(edn.loads(s)) for s in rec["ops"]]
+            except Exception as err:  # noqa: BLE001 — decode failure
+                if i == len(lines):
+                    obs.counter("serve.wal_torn").inc()
+                    _log.warning(
+                        "WAL %s: torn trailing line ignored (the "
+                        "delta was never acknowledged): %r", path, err)
+                    break
+                raise WALError(
+                    f"corrupt WAL line {i} in {path} (not the tail — "
+                    f"acknowledged data): {err!r}") from err
+            if seq in seen:
+                continue
+            seen.add(seq)
+            out.append((seq, ops))
+        out.sort(key=lambda t: t[0])
+        return out
+
+    def last_seq(self, key) -> int:
+        deltas = self.replay(key)
+        return deltas[-1][0] if deltas else 0
+
+
+# -------------------------------------------------- checkpoint store
+
+
+class CheckpointStore:
+    """The eviction side-car: a frozen session's FrontierCheckpoint
+    (.npz, via ``FrontierCheckpoint.save``) plus a small JSON meta
+    record (applied seq, op count, digest) under ``root``. Thaw reads
+    both; a missing/mismatched pair degrades to a from-scratch rescan
+    of the WAL replay — slower, never wrong."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _paths(self, key) -> Tuple[str, str]:
+        stem = os.path.join(self.root, _safe_name(key))
+        return stem + ".npz", stem + ".json"
+
+    def save(self, key, meta: dict) -> None:
+        _npz, jpath = self._paths(key)
+        tmp = jpath + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(meta, fh)
+        os.replace(tmp, jpath)
+
+    def checkpoint_path(self, key) -> str:
+        return self._paths(key)[0]
+
+    def load(self, key) -> Tuple[Optional[object], Optional[dict]]:
+        """(FrontierCheckpoint | None, meta | None)."""
+        npz, jpath = self._paths(key)
+        if not os.path.exists(jpath):
+            return None, None
+        try:
+            with open(jpath) as fh:
+                meta = json.load(fh)
+        except Exception as err:  # noqa: BLE001 — a checkpoint is an
+            # optimization; unreadable meta degrades to WAL replay
+            _log.warning("checkpoint meta %s unreadable (%r) — "
+                         "thaw will rescan from the WAL", jpath, err)
+            return None, None
+        cp = None
+        if meta.get("checkpoint") and os.path.exists(npz):
+            try:
+                from jepsen_tpu.parallel import engine
+                cp = engine.FrontierCheckpoint.load(npz)
+            except Exception as err:  # noqa: BLE001 — same posture
+                _log.warning("checkpoint %s unreadable (%r) — thaw "
+                             "will rescan from the WAL", npz, err)
+                cp = None
+        return cp, meta
+
+    def drop(self, key) -> None:
+        for p in self._paths(key):
+            try:
+                os.remove(p)
+            except OSError:
+                pass

@@ -272,6 +272,37 @@ def test_sharded_section_line_carries_dedupe_schema(monkeypatch,
     importlib.reload(bench)
 
 
+def test_bench_stream_section_contract(monkeypatch, capsys):
+    """The BENCH_STREAM-gated streaming advisory: its line schema when
+    it runs, and the default schema's byte-identity when it doesn't —
+    main() only spawns the section under BENCH_STREAM=1, so with the
+    flag unset no new line ever appears (the sparse-pallas-advisory
+    gating precedent)."""
+    import bench
+
+    monkeypatch.setenv("BENCH_STREAM_OPS", "60")
+    monkeypatch.setenv("BENCH_STREAM_DELTAS", "3")
+    bench.sec_stream()
+    lines = _json_lines(capsys.readouterr().out)
+    assert len(lines) == 1, lines
+    line = lines[0]
+    for k in ("metric", "value", "unit", "vs_baseline", "stream"):
+        assert k in line, line
+    assert "[advisory]" in line["metric"]
+    st = line["stream"]
+    for k in ("deltas", "ops", "incremental_secs", "full_secs",
+              "speedup", "verdicts_match", "final_resume_event"):
+        assert k in st, st
+    # the acceptance property rides the bench too: delta-fed and
+    # one-shot verdicts agree on every prefix the section compared
+    assert st["verdicts_match"] is True
+    assert st["final_resume_event"] > 0
+    # gating pin: the parent only runs the section behind the flag
+    with open(bench.__file__) as fh:
+        src = fh.read()
+    assert 'os.environ.get("BENCH_STREAM") == "1"' in src
+
+
 def test_bench_emit_trace_pointer_gated_on_tracing(monkeypatch,
                                                    capsys):
     """Sections stamp `trace=<relpath>` onto their JSON lines exactly

@@ -19,8 +19,10 @@ ENGINE_MODULES = [
     "jepsen_tpu.parallel.engine",
     "jepsen_tpu.parallel.sharded",
     "jepsen_tpu.parallel.pallas_kernels",
+    "jepsen_tpu.parallel.extend",
     "jepsen_tpu.models",
     "jepsen_tpu.independent",
+    "jepsen_tpu.serve.service",
 ]
 
 _PROBE = r"""

@@ -8,6 +8,10 @@
 #      crash, and a flaky failure injected at the supervised dispatch
 #      sites on CPU, verdicts asserted identical to the clean run
 #      (the docs/resilience.md degradation contract, at smoke scale)
+#   1c. streaming-checker smoke — tools/serve_smoke.py: the serve
+#      service in-process, two keys' deltas (one with an injected
+#      wedge), final verdicts asserted identical to the one-shot
+#      batch check, clean drain (docs/streaming.md, at smoke scale)
 #   2. tier-1 tests     — the ROADMAP.md invocation verbatim: the
 #      full suite minus the slow tier on a virtual 8-device CPU mesh,
 #      under the documented 870s budget (timeout -k 10 870). The
@@ -24,6 +28,9 @@ python -m jepsen_tpu.analysis --check || exit 1
 
 echo "== fault-injection smoke =="
 env JAX_PLATFORMS=cpu python tools/fault_smoke.py || exit 1
+
+echo "== streaming-checker smoke =="
+env JAX_PLATFORMS=cpu python tools/serve_smoke.py || exit 1
 
 echo "== tier-1 tests (870s budget) =="
 set -o pipefail

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""CI streaming-checker smoke: the serve robustness contract in a few
+seconds on CPU.
+
+Starts the CheckerService in-process, streams two keys' histories as
+deltas (one key with an injected wedge mid-stream via
+JEPSEN_TPU_FAULTS), and asserts:
+
+  * every delta verdict exists and the FINAL verdicts are identical
+    (verdict + counterexample fields) to a one-shot batch check of the
+    same histories — delta feeding never changes semantics;
+  * the injected wedge degrades with a structured note instead of
+    flipping a verdict or hanging the service;
+  * graceful drain: zero pending ops at close, every admitted delta
+    accounted for in the final seq.
+
+`tools/ci.sh` runs this right after fault_smoke. This is a wiring
+check; tests/test_serve.py carries the full matrix (families,
+evict/thaw, WAL replay, overload).
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    from jepsen_tpu import resilience
+    from jepsen_tpu.histories import corrupt_history, \
+        rand_register_history
+    from jepsen_tpu.history import History
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.parallel import encode as enc_mod, engine
+    from jepsen_tpu.serve import CheckerService
+
+    m = CASRegister()
+    h1 = list(rand_register_history(n_ops=24, n_processes=4,
+                                    n_values=3, crash_p=0.05, seed=41))
+    h2 = list(corrupt_history(
+        rand_register_history(n_ops=24, n_processes=4, n_values=3,
+                              crash_p=0.05, seed=42),
+        seed=1, n_corruptions=2))
+    refs = {k: engine.check_encoded(
+        enc_mod.encode(m, History.wrap(h)), capacity=256,
+        dedupe="sort") for k, h in (("k1", h1), ("k2", h2))}
+    pin = lambda r: {k: r.get(k) for k in  # noqa: E731
+                     ("valid?", "op", "fail-event", "max-frontier")}
+
+    failures = 0
+    wal = tempfile.mkdtemp(prefix="jepsen_serve_smoke_")
+    svc = CheckerService(m, wal_dir=wal, capacity=256, dedupe="sort")
+    try:
+        cuts = [(0, 16), (16, 32), (32, 48)]
+        for i, (a, b) in enumerate(cuts):
+            if i == 1:
+                # a wedge mid-stream: the second delta's dispatch dies
+                # and must degrade (checkpoint resume / host WGL), not
+                # hang or flip
+                os.environ["JEPSEN_TPU_FAULTS"] = "wedge@search:n=1"
+                resilience.reset()
+            try:
+                for key, h in (("k1", h1), ("k2", h2)):
+                    r = svc.submit(key, h[a:b], wait=True, timeout=120)
+                    if "valid?" not in r:
+                        print(f"serve-smoke: delta {i} on {key} got "
+                              f"no verdict: {r}")
+                        failures += 1
+            finally:
+                if i == 1:
+                    del os.environ["JEPSEN_TPU_FAULTS"]
+                    resilience.reset()
+        finals = {k: svc.finalize(k, timeout=120) for k in refs}
+        if not svc.drain(timeout=60):
+            print("serve-smoke: drain did not complete")
+            failures += 1
+        stats = svc.stats()
+        if stats["pending_ops"] != 0:
+            print(f"serve-smoke: pending ops after drain: {stats}")
+            failures += 1
+    finally:
+        svc.close()
+    for k, ref in refs.items():
+        if pin(finals[k]) != pin(ref):
+            print(f"serve-smoke: {k} final verdict diverged from the "
+                  f"one-shot check: {pin(finals[k])} != {pin(ref)}")
+            failures += 1
+        if finals[k]["seq"] != 3:   # 3 deltas accepted per key
+            print(f"serve-smoke: {k} final seq {finals[k]['seq']} != 3 "
+                  f"— an admitted delta went missing")
+            failures += 1
+    if failures:
+        print(f"serve-smoke: {failures} failure(s)")
+        return 1
+    print(f"serve-smoke: streamed verdicts identical to batch "
+          f"(k1={finals['k1']['valid?']}, k2={finals['k2']['valid?']}), "
+          f"wedge degraded cleanly, drain clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
