@@ -99,6 +99,12 @@ def base_parser(prog: str = "jepsen") -> argparse.ArgumentParser:
                    choices=("sort", "hash"),
                    help="frontier dedupe strategy for --checker "
                         "(default: JEPSEN_TPU_DEDUPE)")
+    s.add_argument("--ops-port", type=int, default=None,
+                   help="with --checker: serve /metrics (Prometheus "
+                        "text), /healthz, and /status on this port "
+                        "(0 = OS-assigned; default: "
+                        "JEPSEN_TPU_OPS_PORT, unset = no ops "
+                        "endpoint — docs/observability.md)")
     # listed for --help discoverability only: run_cli dispatches `lint`
     # to jepsen_tpu.analysis.main BEFORE parsing (its own parser is the
     # single source of truth for lint flags and the 0/1/2 contract;
@@ -116,6 +122,14 @@ def base_parser(prog: str = "jepsen") -> argparse.ArgumentParser:
         help="bounded device-runtime health check (subprocess "
              "jax.devices() with timeout + retry); exit 0 healthy / "
              "1 wedged / 2 no-backend")
+    # listed for --help discoverability only, like lint/probe: run_cli
+    # dispatches `status` BEFORE parsing (jepsen_tpu.obs.httpd owns its
+    # flags and the 0/1/2 ready/degraded/unreachable exit contract)
+    st = sub.add_parser(
+        "status", add_help=False,
+        help="fetch /status + /healthz from a running `jepsen serve "
+             "--checker --ops-port N` and print the operator summary; "
+             "exit 0 ready / 1 degraded / 2 unreachable")
     ta = sub.add_parser(
         "test-all", help="run a whole suite of tests in one go")
     common(ta)
@@ -126,7 +140,8 @@ def base_parser(prog: str = "jepsen") -> argparse.ArgumentParser:
                     help="comma-separated nemesis sweep (default: the "
                          "single --nemesis)")
     p._jepsen_subparsers = {"test": t, "analyze": a, "serve": s,
-                            "lint": li, "probe": pr, "test-all": ta}
+                            "lint": li, "probe": pr, "status": st,
+                            "test-all": ta}
     return p
 
 
@@ -283,13 +298,46 @@ def run_serve_cmd(args) -> int:
         # stay inside this branch so the results browser keeps working
         # against a wedged device runtime
         from jepsen_tpu import models as model_ns
+        from jepsen_tpu.obs import httpd as ops_httpd
         from jepsen_tpu.serve import CheckerService, default_wal_dir
         from jepsen_tpu.serve.stdio import run_stdio
         model = getattr(model_ns, SERVE_MODELS[args.model])()
         svc = CheckerService(model,
                              wal_dir=args.wal_dir or default_wal_dir(),
                              dedupe=args.dedupe)
-        return run_stdio(svc)
+        # the live ops surface (docs/observability.md "Ops endpoint"):
+        # off unless --ops-port / JEPSEN_TPU_OPS_PORT names a port, so
+        # a bare serve is byte-identical to the pre-ops service. The
+        # continuous chip watch rides JEPSEN_TPU_PROBE_INTERVAL
+        # independently — its gauges also feed flight-recorder dumps.
+        from jepsen_tpu import probe as probe_mod
+        watch = probe_mod.start_watch_from_env()
+        port = ops_httpd.resolve_ops_port(
+            getattr(args, "ops_port", None))
+        ops = None
+        if port is not None:
+
+            def _health():
+                doc = svc.health()
+                if watch is not None:
+                    p = watch.status()
+                    doc["checks"]["probe"] = p
+                    doc["ok"] = doc["ok"] and p["ok"]
+                return doc
+
+            ops = ops_httpd.start_ops_server(
+                port, host=args.host, health_fn=_health,
+                status_fn=svc.status, refresh_fn=svc.refresh_gauges)
+            print(f"ops endpoint: http://{args.host}:{ops.port} "
+                  f"(/metrics /healthz /status — `jepsen status "
+                  f"--port {ops.port}`)", file=sys.stderr)
+        try:
+            return run_stdio(svc)
+        finally:
+            if ops is not None:
+                ops.close()
+            if watch is not None:
+                watch.stop()
     from jepsen_tpu import web
     web.serve(host=args.host, port=args.port)
     return EXIT_VALID
@@ -325,6 +373,13 @@ def run_cli(test_fn: Optional[Callable[[Dict], Dict]] = None,
         # r05 runbook's automation hook — see docs/observability.md)
         from jepsen_tpu import probe
         return probe.main(raw[1:])
+    if raw[:1] == ["status"]:
+        # same pre-parse forwarding: the ops-endpoint client owns its
+        # flags and the 0/1/2 ready/degraded/unreachable contract, and
+        # importing it never touches jax — `jepsen status` must answer
+        # against a wedged runtime
+        from jepsen_tpu.obs import httpd as ops_httpd
+        return ops_httpd.status_main(raw[1:])
     parser = base_parser(prog)
     if extend_parser is not None:
         extend_parser(parser)

@@ -263,3 +263,26 @@ def env_path(name: str, what: str = "path") -> Optional[str]:
 #                            "1" store/serve_wal, <path> there; every
 #                            ADMITTED delta is fsynced here before the
 #                            producer sees {"accepted"}
+#   JEPSEN_TPU_OPS_PORT      env_int     obs.httpd — the live ops
+#                            endpoint port for `jepsen serve
+#                            --checker` (/metrics Prometheus text,
+#                            /healthz, /status; 0 = OS-assigned;
+#                            unset = no endpoint, serve behavior
+#                            byte-identical to the pre-ops service);
+#                            `--ops-port` overrides
+#   JEPSEN_TPU_PROBE_INTERVAL env_float  probe — continuous chip
+#                            watch: re-run the subprocess probe_json
+#                            every N seconds on a daemon thread and
+#                            publish probe.chip_healthy /
+#                            probe.last_ok_age_secs gauges (feeding
+#                            /healthz + flight dumps); unset/0 = off
+#                            (no thread)
+#   JEPSEN_TPU_FLIGHT_RECORDER env_int   obs.tracer — crash flight
+#                            recorder: retain the last N closed spans
+#                            in a bounded ring EVEN WITH TRACING OFF
+#                            ("1" = the default 256; N>=2 = that
+#                            capacity), dumped as a Chrome-trace file
+#                            (+ metric delta) on DispatchWedged,
+#                            breaker open, serve shed, or serve
+#                            worker error; unset/0 = off — span() is
+#                            then the historical no-op singleton

@@ -16,9 +16,15 @@ docs/observability.md is the operator guide):
             checker used to carry in private dicts.
   export    Chrome trace-event JSON (opens in Perfetto, one track per
             host thread + one per device bucket), JSONL into the store
-            run dir, an end-of-run summary table, and the
+            run dir, an end-of-run summary table, the
             ``JEPSEN_TPU_JAX_PROFILE`` bridge that lines host spans up
-            with ``jax.profiler`` TPU captures.
+            with ``jax.profiler`` TPU captures, and the flight
+            recorder's crash dump (``flight_dump``).
+  httpd     the live ops surface (import ``jepsen_tpu.obs.httpd``
+            explicitly): ``/metrics`` Prometheus text + ``/healthz`` +
+            ``/status`` on a stdlib HTTP daemon thread behind
+            ``jepsen serve --ops-port``, plus the ``jepsen status``
+            client.
 
 Import-safe by construction: no JAX at import time, no device init —
 engine modules import this at module scope and must survive a wedged
@@ -30,14 +36,15 @@ the ``purity-obs-in-trace`` lint rule enforces this mechanically.
 """
 
 from jepsen_tpu.obs.export import (  # noqa: F401
-    chrome_trace, export_run, jsonl_events, summary, write_chrome_trace,
-    write_jsonl,
+    chrome_trace, export_run, flight_dump, flight_reset, jsonl_events,
+    set_flight_dir, summary, write_chrome_trace, write_jsonl,
 )
 from jepsen_tpu.obs.metrics import (  # noqa: F401
-    Registry, counter, gauge, histogram, registry,
+    BUCKET_LADDER, Registry, counter, gauge, hist_quantile, histogram,
+    registry,
 )
 from jepsen_tpu.obs.tracer import (  # noqa: F401
     Span, Tracer, configure, ctx_runner, current_span, device_annotation,
-    enabled, jax_profile_dir, maybe_jax_profile, reset, span, timer,
-    tracer,
+    enabled, flight_active, jax_profile_dir, maybe_jax_profile, reset,
+    span, timer, tracer,
 )

@@ -26,24 +26,31 @@ line per registry entry — greppable, and what the store run dir keeps
 from __future__ import annotations
 
 import json
+import logging
 import os
+import threading
 from typing import Dict, List, Optional
 
 from jepsen_tpu.obs import metrics as _metrics
 from jepsen_tpu.obs import tracer as _tracer
 
+_log = logging.getLogger(__name__)
+
 HOST_PID = 1
 DEVICE_PID = 2
 
 
-def chrome_trace(tr: Optional[_tracer.Tracer] = None) -> List[dict]:
+def chrome_trace(tr: Optional[_tracer.Tracer] = None,
+                 spans: Optional[List] = None) -> List[dict]:
     """The trace-event array for the active (or given) tracer's spans.
     Empty list when tracing is off — a valid trace document either
-    way."""
+    way. ``spans`` overrides the tracer's buffer read — the flight
+    dump passes the ring's retained spans."""
     tr = tr or _tracer.tracer()
     if tr is None:
         return []
-    spans = tr.spans()
+    if spans is None:
+        spans = tr.spans()
     events: List[dict] = [
         {"ph": "M", "pid": HOST_PID, "name": "process_name",
          "args": {"name": "host"}},
@@ -194,7 +201,10 @@ def export_run(run_dir: str) -> Optional[dict]:
     dir describes that run alone (and span memory stays bounded)."""
     global _last_reg_snapshot
     tr = _tracer.tracer()
-    if tr is None:
+    if tr is None or tr.flight_only:
+        # a flight-only recorder (JEPSEN_TPU_FLIGHT_RECORDER with
+        # tracing off) must not grow run-dir artifacts: its output
+        # surface is the crash dump alone
         return None
     os.makedirs(run_dir, exist_ok=True)
     reg = _metrics.registry()
@@ -226,3 +236,88 @@ def export_run(run_dir: str) -> Optional[dict]:
     _last_reg_snapshot = now
     tr.drain()
     return out
+
+
+# --------------------------------------------------- flight recorder
+
+# where crash dumps land when the caller doesn't say (the serve
+# service points this at its WAL directory so postmortem evidence
+# lives next to the WAL it explains)
+_flight_dir = os.path.join("store", "flight")
+_flight_lock = threading.Lock()
+_flight_seq = 0
+# a shed storm or a flapping breaker must not fill the disk with
+# near-identical dumps: past the cap, dumps are counted but skipped
+FLIGHT_MAX_DUMPS = 25
+
+
+def set_flight_dir(path: str) -> None:
+    """Redirect flight-recorder dumps (default ``store/flight``)."""
+    global _flight_dir
+    _flight_dir = path
+
+
+def flight_reset() -> None:
+    """Test isolation: restart the dump sequence (and therefore the
+    per-process cap) and restore the default destination."""
+    global _flight_seq, _flight_dir
+    with _flight_lock:
+        _flight_seq = 0
+        _flight_dir = os.path.join("store", "flight")
+
+
+def flight_dump(reason: str,
+                dest_dir: Optional[str] = None) -> Optional[str]:
+    """Dump the flight ring as a Chrome-trace file — the postmortem
+    artifact for a crashed or degraded service when nobody had tracing
+    on. Returns the path written, or None when no recorder is armed
+    (the common case: JEPSEN_TPU_FLIGHT_RECORDER unset costs exactly
+    this None check at the hook sites) or the per-process dump cap is
+    reached.
+
+    The file is the Perfetto-openable object form: ``traceEvents``
+    (the ring's retained spans) plus a ``flight`` block carrying the
+    trigger reason and the registry delta since the recorder was
+    armed — spans show WHERE the time went, the delta shows WHAT
+    moved (sheds, watchdog kills, breaker opens) before the trigger.
+    """
+    global _flight_seq
+    tr = _tracer.tracer()
+    if tr is None or not _tracer.flight_active():
+        return None
+    with _flight_lock:
+        if _flight_seq >= FLIGHT_MAX_DUMPS:
+            _metrics.counter("obs.flight_dumps_skipped").inc()
+            return None
+        _flight_seq += 1
+        seq = _flight_seq
+    # NOTHING below may raise out of here: every hook site is a
+    # failure path (a wedge about to become DispatchWedged, a breaker
+    # opening, a shed response, a worker-error handler), and an
+    # observability dump that crashes — unwritable dir, disk full on
+    # the very sick node being diagnosed — would replace the
+    # structured error the resilience machinery depends on
+    try:
+        reg = _metrics.registry()
+        doc = {
+            "traceEvents": chrome_trace(tr, spans=tr.ring_spans()),
+            "flight": {
+                "reason": reason,
+                "seq": seq,
+                "metrics_delta": reg.delta(tr.flight_baseline or {}),
+            },
+        }
+        safe = "".join(ch if ch.isalnum() or ch in "-_" else "-"
+                       for ch in reason) or "dump"
+        d = dest_dir or _flight_dir
+        path = os.path.join(d, f"flight_{safe}_{seq}.trace.json")
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+    except Exception:  # noqa: BLE001 — see above
+        _metrics.counter("obs.flight_dump_errors").inc()
+        _log.exception("flight-recorder dump failed (reason %r)",
+                       reason)
+        return None
+    _metrics.counter("obs.flight_dumps").inc()
+    return path

@@ -1,0 +1,403 @@
+"""The live ops surface: ``/metrics`` + ``/healthz`` + ``/status``
+over a stdlib HTTP daemon thread.
+
+Everything observable about a running ``jepsen serve --checker`` was
+post-hoc until now — Perfetto/JSONL exports land in store run dirs
+AFTER a run, and the only health signal was a one-shot ``jepsen
+probe`` subprocess. This module is the pull-based surface a long-lived
+service needs (the TPU-native analogue of ``jepsen.checker/perf`` +
+timeline reporting — the operator-facing output layer of the
+reference):
+
+    /metrics    Prometheus text exposition rendered live from the
+                metrics registry (counters, gauges + their high-water
+                twins, histograms with the fixed bucket ladder) — what
+                a scraper polls
+    /healthz    liveness + readiness as one JSON document; HTTP 200
+                when ready, 503 when degraded (worker dead, WAL
+                unwritable, breaker open, queue past high-water,
+                stale chip probe) — what a load balancer polls
+    /status     the per-key service table (seq, pending, frontier
+                live/evicted, last verdict, WAL bytes, resilience
+                notes, per-key accounting) — what an operator reads,
+                via ``jepsen status`` or curl
+
+Zero new dependencies by construction: ``http.server`` threads only.
+The server binds an OS-assigned port when asked for port 0 (tests,
+smoke), runs as a daemon thread, and holds NO service state of its
+own — every request renders fresh from the registry and the injected
+callbacks, so a wedged worker cannot make ``/healthz`` lie about it.
+
+``jepsen status`` (:func:`status_main`) is the curl-free client: it
+fetches ``/status`` + ``/healthz`` from a running instance and prints
+the human summary table, pre-parse forwarded from ``cli.py`` exactly
+like ``lint`` and ``probe``.
+
+Import-safe: no JAX, no engine imports — the ops surface must answer
+while the device runtime is wedged, which is precisely when an
+operator needs it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Sequence
+
+from jepsen_tpu import envflags
+from jepsen_tpu.obs import metrics as _metrics
+
+_log = logging.getLogger(__name__)
+
+PROM_PREFIX = "jepsen_"
+
+#: HTTP content type for Prometheus text exposition format 0.0.4
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def resolve_ops_port(cli_value: Optional[int] = None) -> Optional[int]:
+    """The ops-endpoint port: an explicit ``--ops-port`` wins, else
+    ``JEPSEN_TPU_OPS_PORT`` (0 = ephemeral); None when neither is set
+    (the endpoint stays off and serve behavior is byte-identical to
+    the pre-ops-surface service)."""
+    if cli_value is not None:
+        return int(cli_value)
+    return envflags.env_int("JEPSEN_TPU_OPS_PORT", default=None,
+                            min_value=0, what="ops endpoint port")
+
+
+# ------------------------------------------------ Prometheus rendering
+
+
+def prom_name(name: str) -> str:
+    """A registry name as a Prometheus metric name: the dotted scheme
+    maps 1:1 (dots and every other illegal character become ``_``),
+    under the ``jepsen_`` namespace — ``serve.pending_ops`` ->
+    ``jepsen_serve_pending_ops``. Documented as THE mapping in
+    docs/observability.md; stable once a dashboard reads it."""
+    out = "".join(ch if (ch.isascii() and ch.isalnum()) or ch == "_"
+                  else "_" for ch in name)
+    # the jepsen_ prefix already guarantees a legal leading character
+    return PROM_PREFIX + out
+
+
+def _fmt(v) -> str:
+    """A sample value in exposition format (integers stay integral)."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+def render_prometheus(snap: Optional[Dict[str, dict]] = None) -> str:
+    """The registry snapshot as Prometheus text exposition (format
+    0.0.4). Counters and gauges render as-is; a gauge's high-water
+    mark rides as a ``<name>_max`` gauge twin; histograms render the
+    full ``_bucket``/``_sum``/``_count`` triple with cumulative ``le``
+    buckets ending at ``+Inf`` — the shape ``histogram_quantile()``
+    needs for the delta-latency SLOs."""
+    if snap is None:
+        snap = _metrics.registry().snapshot()
+    lines = []
+    for name in sorted(snap):
+        m = snap[name]
+        pn = prom_name(name)
+        if m["type"] == "counter":
+            lines.append(f"# TYPE {pn} counter")
+            lines.append(f"{pn} {_fmt(m['value'])}")
+        elif m["type"] == "gauge":
+            lines.append(f"# TYPE {pn} gauge")
+            lines.append(f"{pn} {_fmt(m['value'])}")
+            if m.get("max") is not None:
+                lines.append(f"# TYPE {pn}_max gauge")
+                lines.append(f"{pn}_max {_fmt(m['max'])}")
+        else:
+            lines.append(f"# TYPE {pn} histogram")
+            for le, cum in m.get("buckets") or ():
+                lines.append(f'{pn}_bucket{{le="{_fmt(le)}"}} {cum}')
+            lines.append(f'{pn}_bucket{{le="+Inf"}} {m["count"]}')
+            lines.append(f"{pn}_sum {_fmt(m['total'])}")
+            lines.append(f"{pn}_count {m['count']}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# --------------------------------------------------------- the server
+
+
+class _OpsHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    # the ops port may be reused quickly across smoke runs
+    allow_reuse_address = True
+    ops: "OpsServer" = None  # backref, set by OpsServer
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "jepsen-ops/1.0"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: N802 — stdlib name
+        _log.debug("ops httpd: " + fmt, *args)
+
+    def _reply(self, code: int, body: bytes, ctype: str):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, code: int, doc: dict):
+        self._reply(code, (json.dumps(doc, default=str, sort_keys=True)
+                           + "\n").encode(), "application/json")
+
+    def do_GET(self):  # noqa: N802 — stdlib name
+        ops = self.server.ops
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                if ops.refresh_fn is not None:
+                    ops.refresh_fn()
+                self._reply(200, render_prometheus().encode(),
+                            PROM_CONTENT_TYPE)
+            elif path == "/healthz":
+                if ops.refresh_fn is not None:
+                    ops.refresh_fn()
+                doc = (ops.health_fn() if ops.health_fn is not None
+                       else {"ok": True, "checks": {}})
+                self._json(200 if doc.get("ok") else 503, doc)
+            elif path == "/status":
+                if ops.refresh_fn is not None:
+                    ops.refresh_fn()
+                doc = (ops.status_fn() if ops.status_fn is not None
+                       else {})
+                self._json(200, doc)
+            elif path == "/":
+                self._json(200, {"endpoints": ["/metrics", "/healthz",
+                                               "/status"]})
+            else:
+                self._json(404, {"error": f"unknown path {path!r}",
+                                 "endpoints": ["/metrics", "/healthz",
+                                               "/status"]})
+        except Exception as err:  # noqa: BLE001 — one bad render must
+            # not kill the connection handler thread loop
+            _log.exception("ops httpd: %s failed", path)
+            try:
+                self._json(500, {"error": f"{type(err).__name__}: "
+                                          f"{err}"})
+            except OSError:
+                pass
+
+
+class OpsServer:
+    """The ops endpoint as an object: construct (binds the socket —
+    port 0 gets an OS-assigned one, readable as ``.port`` before any
+    request), ``start()`` the daemon thread, ``close()`` to stop.
+    Callbacks:
+
+    health_fn   -> {"ok": bool, "checks": {...}}; non-ok answers 503
+    status_fn   -> the /status JSON document
+    refresh_fn  -> called before every render so computed gauges
+                   (queue depth, WAL lag) are point-in-time fresh
+
+    All three are optional — a bare OpsServer still serves /metrics
+    from the process registry, which is exactly what a non-serve
+    embedding (bench, a notebook) wants."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 health_fn: Optional[Callable[[], dict]] = None,
+                 status_fn: Optional[Callable[[], dict]] = None,
+                 refresh_fn: Optional[Callable[[], None]] = None):
+        self.health_fn = health_fn
+        self.status_fn = status_fn
+        self.refresh_fn = refresh_fn
+        self._httpd = _OpsHTTPServer((host, port), _Handler)
+        self._httpd.ops = self
+        self.host = self._httpd.server_address[0]
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "OpsServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True,
+                name="jepsen-ops-httpd")
+            self._thread.start()
+        return self
+
+    def url(self, path: str = "") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def start_ops_server(port: int, host: str = "127.0.0.1",
+                     **kw) -> OpsServer:
+    """Bind + start in one call (the CLI's entry point)."""
+    return OpsServer(port=port, host=host, **kw).start()
+
+
+# ------------------------------------------------ `jepsen status` CLI
+
+
+def _fetch(url: str, timeout: float = 10.0):
+    """(HTTP status, decoded body) for a GET — urllib only, and a 503
+    from /healthz is an ANSWER (degraded), not an error."""
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode()
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = int(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return (f"{n}{unit}" if unit == "B"
+                    else f"{n:.1f}{unit}")
+        n /= 1024.0
+    return str(n)
+
+
+def render_status_table(status: dict, health: dict) -> str:
+    """The human summary an operator reads: one health line, one row
+    per key, then service totals."""
+    lines = []
+    checks = health.get("checks") or {}
+    bad = sorted(k for k, v in checks.items()
+                 if isinstance(v, dict) and v.get("ok") is False)
+    lines.append(
+        ("READY" if health.get("ok") else "DEGRADED")
+        + (f" — failing checks: {', '.join(bad)}" if bad else "")
+        + f" ({len(checks)} check(s))")
+    keys = status.get("keys") or {}
+    if keys:
+        hdr = (f"{'key':<18} {'seq':>5} {'pend':>6} {'state':<9} "
+               f"{'verdict':<9} {'wal':>9} {'deltas':>7} {'sheds':>6} "
+               f"notes")
+        lines.append(hdr)
+        for k in sorted(keys, key=str):
+            row = keys[k]
+            verdict = row.get("verdict")
+            verdict = ("-" if verdict is None
+                       else str(verdict).lower())
+            acct = row.get("acct") or {}
+            note = ""
+            res = row.get("resilience")
+            if res:
+                note = (res if isinstance(res, str)
+                        else res.get("reason") or res.get("site")
+                        or "degraded")
+            if row.get("error"):
+                note = (note + " " if note else "") + "ERROR"
+            lines.append(
+                f"{str(k)[:18]:<18} {row.get('seq', 0):>5} "
+                f"{row.get('pending_ops', 0):>6} "
+                f"{row.get('state', '?'):<9} {verdict:<9} "
+                f"{_fmt_bytes(row.get('wal_bytes')):>9} "
+                f"{acct.get('deltas', 0):>7} {acct.get('sheds', 0):>6} "
+                f"{note}")
+    else:
+        lines.append("(no keys admitted yet)")
+    lines.append(
+        f"pending_ops={status.get('pending_ops', 0)} "
+        f"high_water={status.get('high_water', 0)} "
+        f"global_bound={status.get('global_bound', 0)} "
+        f"keys={len(keys)} live={status.get('keys_live', 0)}")
+    return "\n".join(lines) + "\n"
+
+
+def status_main(argv: Optional[Sequence[str]] = None) -> int:
+    """``jepsen status`` — fetch the ops surface of a running serve
+    instance and print the human table (or raw JSON / raw metrics).
+    Exit: 0 ready, 1 degraded (/healthz 503), 2 unreachable,
+    254 usage error — so shell automation reads health without
+    parsing."""
+    p = argparse.ArgumentParser(
+        prog="jepsen status",
+        description="fetch /status + /healthz from a running `jepsen "
+                    "serve --checker --ops-port N` instance and print "
+                    "the operator summary; exit 0 ready / 1 degraded "
+                    "/ 2 unreachable")
+    p.add_argument("--port", type=int, default=None,
+                   help="ops endpoint port (default: "
+                        "JEPSEN_TPU_OPS_PORT)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="per-request timeout seconds")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw {health, status} JSON instead "
+                        "of the table")
+    p.add_argument("--metrics", action="store_true",
+                   help="print the raw Prometheus /metrics text "
+                        "instead of the table")
+    try:
+        args = p.parse_args(list(argv) if argv is not None else None)
+    except SystemExit as e:
+        # same convention as `jepsen probe`: --help exits 0, misuse
+        # maps to the CLI's bad-args code instead of colliding with
+        # the health exit codes
+        return 0 if e.code in (0, None) else 254
+    port = resolve_ops_port(args.port)
+    if port is None:
+        print("jepsen status: no port — pass --port or set "
+              "JEPSEN_TPU_OPS_PORT", file=sys.stderr)
+        return 254
+    base = f"http://{args.host}:{port}"
+    try:
+        if args.metrics:
+            code, body = _fetch(base + "/metrics", args.timeout)
+            if code != 200:
+                print(f"jepsen status: {base}/metrics answered "
+                      f"{code} — not a jepsen ops endpoint?",
+                      file=sys.stderr)
+                return 2
+            sys.stdout.write(body)
+            return 0
+        hcode, hbody = _fetch(base + "/healthz", args.timeout)
+        _scode, sbody = _fetch(base + "/status", args.timeout)
+    except OSError as err:
+        print(f"jepsen status: {base} unreachable: {err}",
+              file=sys.stderr)
+        return 2
+    try:
+        health = json.loads(hbody)
+        status = json.loads(sbody)
+    except ValueError:
+        # an HTTP server that isn't the ops endpoint (e.g. the web
+        # results browser on serve's default port) answers HTML — a
+        # wrong-target mistake, not "degraded": keep the exit-code
+        # contract honest
+        print(f"jepsen status: {base} did not answer JSON — not a "
+              f"jepsen ops endpoint?", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps({"health": health, "status": status},
+                         indent=2, sort_keys=True, default=str))
+    else:
+        sys.stdout.write(render_status_table(status, health))
+    return 0 if hcode == 200 and health.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(status_main())

@@ -25,7 +25,19 @@ registry lock so two threads minting the same name get one object.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional
+from bisect import bisect_left
+from typing import Dict, List, Optional
+
+# The fixed histogram bucket ladder: log-spaced upper bounds (seconds
+# for the latency histograms, but unitless here), 100µs .. 60s, with
+# +Inf implied by ``count``. Fixed and shared so (a) Prometheus
+# exposition (obs/httpd.py) can render a proper ``histogram`` type with
+# cumulative ``le`` buckets, and (b) "p99 delta latency" SLO questions
+# are answerable from any snapshot without per-metric configuration.
+# Values outside the ladder still land in count/total/min/max — the
+# ladder only loses resolution, never observations.
+BUCKET_LADDER = (0.0001, 0.00025, 0.001, 0.0025, 0.01, 0.025,
+                 0.1, 0.25, 1.0, 2.5, 10.0, 60.0)
 
 
 class Counter:
@@ -89,10 +101,15 @@ class Gauge:
 
 class Histogram:
     """Streaming aggregate of observations (seconds, sizes):
-    count/total/min/max — enough for the summary table and the bench
-    split lines without bucket-boundary bikeshedding."""
+    count/total/min/max plus a fixed log-spaced bucket ladder
+    (:data:`BUCKET_LADDER`). The scalar fields keep their historical
+    meaning (the summary table and bench split lines read them
+    unchanged); ``buckets`` is additive — cumulative ``[le, count]``
+    pairs in the snapshot, the shape Prometheus exposition and
+    quantile estimation need."""
 
-    __slots__ = ("name", "count", "total", "vmin", "vmax", "_lock")
+    __slots__ = ("name", "count", "total", "vmin", "vmax", "_buckets",
+                 "_lock")
 
     def __init__(self, name: str):
         self.name = name
@@ -100,6 +117,7 @@ class Histogram:
         self.total = 0.0
         self.vmin = None
         self.vmax = None
+        self._buckets = [0] * len(BUCKET_LADDER)
         self._lock = threading.Lock()
 
     def observe(self, v: float):
@@ -110,13 +128,41 @@ class Histogram:
                 self.vmin = v
             if self.vmax is None or v > self.vmax:
                 self.vmax = v
+            i = bisect_left(BUCKET_LADDER, v)
+            if i < len(BUCKET_LADDER):
+                self._buckets[i] += 1
 
     def snapshot(self) -> dict:
-        return {"type": "histogram", "count": self.count,
-                "total": round(self.total, 6),
-                "min": self.vmin, "max": self.vmax,
-                "mean": round(self.total / self.count, 6)
-                if self.count else None}
+        with self._lock:
+            raw = list(self._buckets)
+            count, total = self.count, self.total
+            vmin, vmax = self.vmin, self.vmax
+        cum: List[list] = []
+        running = 0
+        for le, n in zip(BUCKET_LADDER, raw):
+            running += n
+            cum.append([le, running])
+        return {"type": "histogram", "count": count,
+                "total": round(total, 6),
+                "min": vmin, "max": vmax,
+                "mean": round(total / count, 6) if count else None,
+                "buckets": cum}
+
+
+def hist_quantile(snap: dict, q: float) -> Optional[float]:
+    """Approximate quantile from a histogram snapshot (or delta): the
+    upper bound of the first cumulative bucket covering ``q`` of the
+    observations — the Prometheus-style answer, without interpolation.
+    Observations past the ladder answer with the streaming ``max``
+    (exact only when the window owns it, i.e. ``max`` is not None)."""
+    n = snap.get("count") or 0
+    if not n:
+        return None
+    target = q * n
+    for le, cumc in snap.get("buckets") or ():
+        if cumc >= target:
+            return le
+    return snap.get("max")
 
 
 class Registry:
@@ -198,11 +244,21 @@ class Registry:
                 if dc:
                     dt = round(snap["total"]
                                - (prev["total"] if prev else 0.0), 6)
+                    # buckets subtract pairwise: the difference of two
+                    # cumulative ladders is the window's own cumulative
+                    # ladder (same fixed bounds), so a per-run delta
+                    # answers quantile questions exactly like a fresh
+                    # registry would
+                    pb = {le: c for le, c in
+                          (prev.get("buckets") or ())} if prev else {}
+                    db = [[le, c - pb.get(le, 0)]
+                          for le, c in snap.get("buckets") or ()]
                     out[name] = {"type": "histogram", "count": dc,
                                  "total": dt,
                                  "min": snap["min"] if pc == 0 else None,
                                  "max": snap["max"] if pc == 0 else None,
-                                 "mean": round(dt / dc, 6)}
+                                 "mean": round(dt / dc, 6),
+                                 "buckets": db}
         return out
 
     def reset(self):

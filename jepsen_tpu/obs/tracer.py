@@ -38,6 +38,7 @@ from __future__ import annotations
 import contextvars
 import itertools
 import threading
+from collections import deque
 from time import perf_counter, process_time
 from typing import Callable, Dict, List, Optional
 
@@ -46,16 +47,45 @@ from jepsen_tpu import envflags
 _current: contextvars.ContextVar = contextvars.ContextVar(
     "jepsen_tpu_obs_span", default=None)
 
+# default flight-recorder ring capacity (closed spans) when
+# JEPSEN_TPU_FLIGHT_RECORDER=1; N>=2 sets the capacity explicitly
+FLIGHT_DEFAULT_SPANS = 256
+
 
 class Tracer:
-    """Collects finished spans for one tracing session."""
+    """Collects finished spans for one tracing session.
 
-    def __init__(self, path: str = ""):
+    Two retention modes, combinable:
+
+    * the ordinary unbounded per-run buffer (``spans()``/``drain()``),
+      exported into store run dirs — full tracing;
+    * a bounded ring of the last ``ring`` CLOSED spans (the flight
+      recorder, ``JEPSEN_TPU_FLIGHT_RECORDER``) that survives drains —
+      what a crash dump reads. ``flight_only=True`` records into the
+      ring ALONE (no unbounded list: a long-lived serve process must
+      stay bounded-memory with tracing off), and is invisible to
+      ``enabled()``/``export_run`` so run-dir artifacts and bench trace
+      pointers keep their tracing-off behavior byte-identical.
+    """
+
+    def __init__(self, path: str = "", ring: Optional[int] = None,
+                 flight_only: bool = False):
         self.path = path            # JEPSEN_TPU_TRACE=<path> ("" = none)
         self.epoch = perf_counter()  # trace time origin (ts 0 in exports)
         self.flag_exports = 0       # export_run count, for <path> runs
+        self.flight_only = flight_only
         self._lock = threading.Lock()
         self._spans: List["Span"] = []
+        self._ring: Optional[deque] = (deque(maxlen=ring)
+                                       if ring else None)
+        self.flight_baseline: Optional[dict] = None
+        if self._ring is not None:
+            # metrics state at arm time, so a crash dump reports what
+            # moved SINCE the recorder started, not process totals
+            # (import here, not at module scope: metrics has no deps,
+            # and tracer must stay importable first)
+            from jepsen_tpu.obs import metrics as _metrics
+            self.flight_baseline = _metrics.registry().snapshot()
         self._ids = itertools.count(1)
 
     def next_id(self) -> int:
@@ -63,7 +93,17 @@ class Tracer:
 
     def record(self, span: "Span"):
         with self._lock:
-            self._spans.append(span)
+            if self._ring is not None:
+                self._ring.append(span)
+            if not self.flight_only:
+                self._spans.append(span)
+
+    def ring_spans(self) -> List["Span"]:
+        """The flight ring's retained spans, oldest first (empty when
+        no ring is configured). NOT cleared by :meth:`drain` — the
+        recorder must still answer after a per-run export."""
+        with self._lock:
+            return list(self._ring) if self._ring is not None else []
 
     def spans(self) -> List["Span"]:
         with self._lock:
@@ -196,21 +236,53 @@ _state = _UNSET
 _state_lock = threading.Lock()
 
 
+def _flight_capacity() -> int:
+    """JEPSEN_TPU_FLIGHT_RECORDER: unset/0 -> 0 (off), 1 -> the
+    default ring capacity, N>=2 -> that capacity in spans."""
+    v = envflags.env_int("JEPSEN_TPU_FLIGHT_RECORDER", default=0,
+                         min_value=0, what="flight-recorder capacity")
+    if v == 1:
+        return FLIGHT_DEFAULT_SPANS
+    return v or 0
+
+
 def _resolve():
     global _state
     with _state_lock:
         if _state is _UNSET:
             path = envflags.env_path("JEPSEN_TPU_TRACE",
                                      what="trace output path")
-            _state = None if path is None else Tracer(path)
+            ring = _flight_capacity()
+            if path is not None:
+                _state = Tracer(path, ring=ring or None)
+            elif ring:
+                # flight recorder alone: spans land in the bounded
+                # ring only, invisible to enabled()/export_run
+                _state = Tracer("", ring=ring, flight_only=True)
+            else:
+                _state = None
     return _state
 
 
 def enabled() -> bool:
+    """Full tracing on? A flight-only recorder answers False — every
+    tracing-gated consumer (run-dir export, bench trace pointers,
+    ctx_runner) must keep its tracing-off behavior when only the
+    crash ring is armed."""
     st = _state
     if st is _UNSET:
         st = _resolve()
-    return st is not None
+    return st is not None and not st.flight_only
+
+
+def flight_active() -> bool:
+    """Is a flight-recorder ring retaining spans (with or without full
+    tracing)? The hook sites (supervisor wedge, breaker open, serve
+    shed/worker-error) check this before dumping."""
+    st = _state
+    if st is _UNSET:
+        st = _resolve()
+    return st is not None and st._ring is not None
 
 
 def tracer() -> Optional[Tracer]:
@@ -246,12 +318,17 @@ def timer(name: str, **args) -> Span:
     return Span(st, name, args)
 
 
-def configure(on: bool = True, path: str = "") -> Optional[Tracer]:
+def configure(on: bool = True, path: str = "",
+              ring: Optional[int] = None,
+              flight_only: bool = False) -> Optional[Tracer]:
     """Programmatic gate (tests, embedding): force tracing on/off
-    regardless of the env flag. Returns the new tracer (or None)."""
+    regardless of the env flag. Returns the new tracer (or None).
+    ``ring``/``flight_only`` arm the flight recorder the way the
+    JEPSEN_TPU_FLIGHT_RECORDER flag would."""
     global _state
     with _state_lock:
-        _state = Tracer(path) if on else None
+        _state = (Tracer(path, ring=ring, flight_only=flight_only)
+                  if on else None)
     return _state
 
 

@@ -29,10 +29,14 @@ from __future__ import annotations
 
 import argparse
 import datetime as _dt
+import logging
 import subprocess
 import sys
+import threading
 import time
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
+
+_log = logging.getLogger(__name__)
 
 EXIT_HEALTHY = 0
 EXIT_WEDGED = 1
@@ -164,6 +168,156 @@ def probe_json(timeout: float = 100.0, retries: int = 3,
         doc["platforms"] = record[-1].get("platforms")
         doc["n_devices"] = record[-1].get("n_devices")
     return doc
+
+
+# ------------------------------------------------ continuous chip watch
+
+
+class ProbeWatch:
+    """The probe loop as a background service: re-run :func:`probe_json`
+    every ``interval`` seconds on a daemon thread and publish the
+    verdict as live gauges —
+
+        probe.chip_healthy       1 healthy / 0 wedged or no-backend
+        probe.last_ok_age_secs   seconds since the last healthy verdict
+
+    so ``/healthz`` reflects a PROBES_r05-style outage the moment the
+    watch sees it, instead of at the next dispatch wedge. Off by
+    default: armed via ``JEPSEN_TPU_PROBE_INTERVAL`` (seconds; 0/unset
+    = no watch, no thread, no gauges) through
+    :func:`start_watch_from_env`.
+
+    Staleness contract for readiness: before the first tick completes
+    the watch reports ok (a service must not fail readiness while the
+    first 100s-timeout probe is still in flight); after that, ok means
+    the last verdict was healthy AND its age is within ``max_stale``
+    (default ``2*interval + timeout`` — a stuck watch loop is itself a
+    health failure). The probe child takes any hang, never this
+    process (the module contract above).
+    """
+
+    def __init__(self, interval: float, timeout: float = 100.0,
+                 retries: int = 1, max_stale: Optional[float] = None,
+                 probe: Optional[Callable[[], dict]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.interval = float(interval)
+        self.timeout = float(timeout)
+        self.retries = int(retries)
+        self.max_stale = (float(max_stale) if max_stale is not None
+                          else 2.0 * self.interval + self.timeout)
+        self._probe = probe if probe is not None else (
+            lambda: probe_json(timeout=self.timeout,
+                               retries=self.retries))
+        self._clock = clock
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self.ticks = 0
+        self.last: Optional[dict] = None
+        self._last_ok: Optional[float] = None
+        self._started = self._clock()
+
+    # gauges live in obs (jax-free, same import contract as this
+    # module); imported lazily so `jepsen probe` stays as light as the
+    # pre-watch subcommand
+    @staticmethod
+    def _gauges():
+        from jepsen_tpu import obs
+        return obs.gauge("probe.chip_healthy"), \
+            obs.gauge("probe.last_ok_age_secs")
+
+    def _age(self, now: float) -> float:
+        with self._lock:
+            t0 = self._last_ok if self._last_ok is not None \
+                else self._started
+        return max(0.0, now - t0)
+
+    def tick(self) -> dict:
+        """One probe cycle (the loop body; callable directly in
+        tests): run the probe, record, publish gauges. A probe that
+        RAISES (spawn failure, ENOMEM) still counts as a completed
+        tick with verdict ``probe-error`` — otherwise ``ticks`` would
+        stay 0 and :meth:`status`'s first-probe-in-flight grace would
+        report ok forever while chip health is completely unknown."""
+        try:
+            doc = self._probe()
+        except Exception as err:  # noqa: BLE001 — a crashed probe is
+            # not health; it must degrade readiness, not kill the loop
+            _log.exception("probe watch tick failed")
+            doc = {"verdict": "probe-error",
+                   "error": f"{type(err).__name__}: {err}"}
+        now = self._clock()
+        healthy = doc.get("verdict") == "healthy"
+        with self._lock:
+            self.ticks += 1
+            self.last = doc
+            if healthy:
+                self._last_ok = now
+        g_h, g_age = self._gauges()
+        g_h.set(1 if healthy else 0)
+        g_age.set(round(self._age(now), 3))
+        return doc
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — tick() already
+                # absorbs probe failures as a probe-error verdict;
+                # this guards the bookkeeping itself (gauge/registry
+                # errors must not kill the watch loop)
+                _log.exception("probe watch bookkeeping failed")
+            if self._stop.wait(self.interval):
+                return
+
+    def start(self) -> "ProbeWatch":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name="jepsen-probe-watch")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            # an in-flight probe child may hold the loop for up to
+            # `timeout` seconds; the thread is a daemon, so a bounded
+            # join suffices — the child dies with the process
+            self._thread.join(timeout=1.0)
+
+    def status(self) -> dict:
+        """The watch as a /healthz check entry (and the live refresh
+        of the age gauge for /metrics scrapes)."""
+        now = self._clock()
+        age = self._age(now)
+        with self._lock:
+            ticks, last = self.ticks, self.last
+        verdict = last.get("verdict") if last else None
+        if ticks == 0:
+            ok = True        # first probe still in flight: not a failure
+        else:
+            ok = verdict == "healthy" and age <= self.max_stale
+        if ticks:
+            _g_h, g_age = self._gauges()
+            g_age.set(round(age, 3))
+        return {"ok": ok, "verdict": verdict, "ticks": ticks,
+                "last_ok_age_secs": round(age, 3),
+                "interval": self.interval,
+                "max_stale": self.max_stale}
+
+
+def start_watch_from_env() -> Optional[ProbeWatch]:
+    """Arm the continuous chip watch when
+    ``JEPSEN_TPU_PROBE_INTERVAL`` names an interval (seconds; 0/unset
+    = off — the default, so a bare serve carries no extra thread)."""
+    from jepsen_tpu import envflags
+    interval = envflags.env_float("JEPSEN_TPU_PROBE_INTERVAL",
+                                  default=0.0, min_value=0.0,
+                                  what="probe watch interval seconds")
+    if not interval:
+        return None
+    return ProbeWatch(interval).start()
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

@@ -127,7 +127,11 @@ class CircuitBreaker:
 
     def _open_locked(self):
         """The one open transition (callers hold the lock): state,
-        re-open count, backoff window, counter, gauge."""
+        re-open count, backoff window, counter, gauge. Callers dump
+        the flight recorder AFTER releasing the lock — the dump is
+        file I/O, and a hung filesystem (plausible on the same sick
+        node whose device just failed) must not wedge every dispatch
+        blocked on this breaker's lock."""
         self._state = OPEN
         self._opens += 1
         self._open_until = self.clock() + self._backoff()
@@ -135,6 +139,7 @@ class CircuitBreaker:
         self._gauge()
 
     def record_failure(self, reason: str = ""):
+        opened = False
         with self._lock:
             self._failures += 1
             self._last_reason = reason
@@ -144,10 +149,16 @@ class CircuitBreaker:
                 # threshold reached — or the probed dispatch itself
                 # failed during half-open, which re-opens immediately
                 self._open_locked()
+                opened = True
             else:
                 self._gauge()
             tripped = self._state != CLOSED
         _note_state(self.backend, tripped)
+        if opened:
+            # an open breaker is exactly the moment a postmortem wants
+            # the last spans + metric deltas; a None check when
+            # unarmed, and the dump cap bounds a flapping breaker
+            obs.flight_dump(f"breaker-open-{self.backend}")
 
     def record_success(self):
         with self._lock:
@@ -202,6 +213,7 @@ class CircuitBreaker:
         _note_state(self.backend, not healthy)
         if healthy:
             return True, ""
+        obs.flight_dump(f"breaker-open-{self.backend}")
         return False, (f"circuit breaker re-opened for backend "
                        f"{self.backend!r}: recovery probe unhealthy")
 
@@ -237,6 +249,15 @@ def breaker_for(backend: str, **kw) -> CircuitBreaker:
 def any_tripped() -> bool:
     """Cheap fast-path probe: is any backend's breaker not closed?"""
     return bool(_tripped)
+
+
+def snapshots() -> list:
+    """Every registered breaker's :meth:`CircuitBreaker.snapshot` —
+    the /healthz readiness check enumerates these (an empty list means
+    no dispatch has needed a breaker yet: healthy)."""
+    with _registry_lock:
+        brs = list(_breakers.values())
+    return [b.snapshot() for b in brs]
 
 
 def reset():

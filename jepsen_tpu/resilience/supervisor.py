@@ -149,6 +149,10 @@ def _run_watchdogged(thunk: Callable, timeout: float, site: str,
             "device dispatch at site %r exceeded the %.1fs watchdog "
             "bound — abandoning the worker thread (the r05 wedge "
             "signature; see docs/resilience.md)", site, timeout)
+        # postmortem evidence even with tracing off: the armed flight
+        # recorder (JEPSEN_TPU_FLIGHT_RECORDER) dumps its span ring +
+        # metric delta; unarmed, this is a single None check
+        obs.flight_dump(f"dispatch-wedged-{site}")
         raise DispatchWedged(site, timeout, backend)
     if "exc" in box:
         raise box["exc"]

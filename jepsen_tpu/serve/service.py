@@ -41,12 +41,13 @@ wrap the blocking calls with ``run_in_executor`` (the bounded
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from collections import deque
 from typing import Dict, Optional
 
-from jepsen_tpu import envflags, obs
+from jepsen_tpu import edn, envflags, obs
 from jepsen_tpu.history import TYPES
 from jepsen_tpu.parallel import extend as ext
 from jepsen_tpu.serve.wal import CheckpointStore, DeltaWAL
@@ -109,7 +110,8 @@ class _Key:
     __slots__ = ("key", "session", "pending", "enq_seq", "applied_seq",
                  "last_result", "last_activity", "finalized",
                  "finalize_requested", "needs_check", "pending_ops",
-                 "wal_next", "broken", "wal_dead")
+                 "wal_next", "broken", "wal_dead", "acct",
+                 "pending_times")
 
     def __init__(self, key):
         self.key = key
@@ -123,6 +125,13 @@ class _Key:
         self.finalize_requested = False
         self.needs_check = False
         self.pending_ops = 0
+        # per-key accounting for /status: admitted deltas/ops, sheds
+        # this key ate, WAL deltas replayed at recovery/thaw
+        self.acct = {"deltas": 0, "ops": 0, "sheds": 0, "replays": 0}
+        # (seq, t_submit) of admitted-but-unapplied deltas — drained
+        # whenever applied_seq advances, feeding the ingest->verdict
+        # SLO histogram; bounded by the per-key queue bound
+        self.pending_times: deque = deque()
         self.wal_next = 1   # next seq allowed to write the WAL (the
         # per-key seq-ordered handoff that keeps file order == seq
         # order without holding the service lock across an fsync)
@@ -167,6 +176,9 @@ class CheckerService:
         self._wal = DeltaWAL(wal_dir) if wal_dir else None
         self._cps = (CheckpointStore(wal_dir + "/checkpoints")
                      if wal_dir else None)
+        if wal_dir and obs.flight_active():
+            # postmortem dumps land next to the WAL they explain
+            obs.set_flight_dir(os.path.join(wal_dir, "flight"))
         self._keys: Dict = {}
         self._cond = threading.Condition()
         self._pending_ops = 0
@@ -212,7 +224,12 @@ class CheckerService:
             if t not in TYPES:
                 return {"error": f"delta op {o!r}: type must be one of "
                                  f"{TYPES}", "key": key}
-        deadline = None if timeout is None else self._clock() + timeout
+        t_in = self._clock()
+        deadline = None if timeout is None else t_in + timeout
+        shed = None   # set instead of returning inside the lock: the
+        # flight-recorder dump a shed triggers is file I/O and must
+        # run AFTER the service lock is released (the same reason the
+        # WAL fsync below runs outside it)
         with self._cond:
             ks = self._keys.get(key)
             if ks is None:
@@ -244,41 +261,61 @@ class CheckerService:
                         and self._pending_ops + len(ops) \
                         > self.high_water:
                     obs.counter("serve.sheds").inc()
-                    return {"shed": True,
+                    ks.acct["sheds"] += 1
+                    shed = {"shed": True,
                             "reason": f"pending ops past high-water "
                                       f"({self._pending_ops}+"
                                       f"{len(ops)} > "
                                       f"{self.high_water})",
                             "key": key}
+                    break
                 if len(ks.pending) < self.per_key_queue \
                         and self._pending_ops + len(ops) \
                         <= self.global_bound:
                     break   # admitted
                 if self._stop:
-                    return {"shed": True, "reason": "service stopping",
+                    obs.counter("serve.sheds").inc()
+                    ks.acct["sheds"] += 1
+                    shed = {"shed": True, "reason": "service stopping",
                             "key": key}
+                    break
                 rem = (None if deadline is None
                        else deadline - self._clock())
                 if rem is not None and rem <= 0:
                     obs.counter("serve.sheds").inc()
-                    return {"shed": True,
+                    ks.acct["sheds"] += 1
+                    shed = {"shed": True,
                             "reason": "backpressure timeout "
                                       "(queue full)", "key": key}
+                    break
                 self._cond.wait(0.5 if rem is None else min(rem, 0.5))
-            # reserve the seq + queue slot under the lock (pending
-            # stays seq-ordered because reservations are), then write
-            # the WAL OUTSIDE it — an fsync must not serialize every
-            # other key's producers and the worker on one lock
-            ks.pending.append((my_seq, ops))
-            ks.enq_seq = my_seq
-            ks.pending_ops += len(ops)
-            self._pending_ops += len(ops)
-            self.max_pending_seen = max(self.max_pending_seen,
-                                        self._pending_ops)
-            obs.counter("serve.deltas").inc()
-            obs.counter("serve.delta_ops").inc(len(ops))
-            obs.gauge("serve.pending_ops").set(self._pending_ops)
-            self._cond.notify_all()
+            if shed is None:
+                # reserve the seq + queue slot under the lock (pending
+                # stays seq-ordered because reservations are), then
+                # write the WAL OUTSIDE it — an fsync must not
+                # serialize every other key's producers and the worker
+                # on one lock
+                ks.pending.append((my_seq, ops))
+                ks.enq_seq = my_seq
+                ks.pending_ops += len(ops)
+                ks.acct["deltas"] += 1
+                ks.acct["ops"] += len(ops)
+                ks.pending_times.append((my_seq, t_in))
+                self._pending_ops += len(ops)
+                self.max_pending_seen = max(self.max_pending_seen,
+                                            self._pending_ops)
+                obs.counter("serve.deltas").inc()
+                obs.counter("serve.delta_ops").inc(len(ops))
+                obs.gauge("serve.pending_ops").set(self._pending_ops)
+                self._cond.notify_all()
+        if shed is not None:
+            # overload IS the postmortem moment: an armed flight
+            # recorder dumps here — outside the service lock, because
+            # the dump is file I/O and a sick disk must not freeze
+            # every producer and the ops surface (a None check when
+            # off; the per-process cap bounds a shed storm)
+            obs.flight_dump("serve-shed")
+            return shed
         durable = self._wal is not None
         if self._wal is not None:
             # per-key seq-ordered handoff: seq N's bytes land before
@@ -323,6 +360,10 @@ class CheckerService:
                     with self._cond:
                         ks.wal_next = my_seq + 1
                         self._cond.notify_all()
+        # ingest->ack SLO: admission (incl. backpressure wait) through
+        # WAL durability — the producer-visible accept latency
+        obs.histogram("serve.ack_secs").observe(
+            max(0.0, self._clock() - t_in))
         if wait:
             rem = None if deadline is None else deadline - self._clock()
             r = self.result(key, min_seq=my_seq, timeout=rem)
@@ -429,6 +470,115 @@ class CheckerService:
                     "pending_ops": self._pending_ops,
                     "max_pending_seen": self.max_pending_seen}
 
+    # ----------------------------------------------- the ops surface
+
+    def refresh_gauges(self) -> None:
+        """Point-in-time refresh of the computed gauges (queue depth,
+        live sessions, WAL lag) — the ops endpoint calls this before
+        every render so a scrape reads current levels, not the levels
+        as of the last submit/evict."""
+        with self._cond:
+            pending = self._pending_ops
+            live = sum(1 for k in self._keys.values()
+                       if k.session is not None)
+            wal_lag = sum(ks.enq_seq - (ks.wal_next - 1)
+                          for ks in self._keys.values()) \
+                if self._wal is not None else 0
+        obs.gauge("serve.pending_ops").set(pending)
+        obs.gauge("serve.keys_live").set(live)
+        if self._wal is not None:
+            # admitted deltas whose WAL bytes have not landed yet —
+            # nonzero is producers outrunning fsync; growing is a
+            # sick disk (the wal_dead path's precursor)
+            obs.gauge("serve.wal_lag_deltas").set(wal_lag)
+
+    def status(self) -> dict:
+        """The /status document: one row per key (seq, pending,
+        frontier live/evicted, last verdict, WAL bytes, resilience
+        notes, per-key accounting) plus service totals — everything an
+        operator needs before deciding whether to read the flight
+        recorder or the WAL."""
+        with self._cond:
+            rows = []
+            for ks in self._keys.values():
+                r = ks.last_result or {}
+                state = ("poisoned" if ks.broken
+                         else "live" if ks.session is not None
+                         else "evicted" if ks.applied_seq
+                         else "idle")   # admitted nothing yet (e.g.
+                # every delta shed): no frontier was ever built, so
+                # "evicted" would imply a checkpoint that isn't there
+                rows.append((ks.key, {
+                    "seq": ks.applied_seq,
+                    "enq_seq": ks.enq_seq,
+                    "pending_deltas": len(ks.pending),
+                    "pending_ops": ks.pending_ops,
+                    "state": state,
+                    "finalized": ks.finalized,
+                    "verdict": r.get("valid?"),
+                    "error": r.get("error"),
+                    "resilience": r.get("resilience"),
+                    "wal_dead": ks.wal_dead,
+                    "acct": dict(ks.acct),
+                }))
+            doc = {"pending_ops": self._pending_ops,
+                   "max_pending_seen": self.max_pending_seen,
+                   "high_water": self.high_water,
+                   "global_bound": self.global_bound,
+                   "keys_live": sum(1 for k in self._keys.values()
+                                    if k.session is not None),
+                   "worker_alive": self._worker is not None
+                   and self._worker.is_alive()}
+        # WAL sizes are filesystem reads — outside the service lock
+        keys = {}
+        for key, row in rows:
+            if self._wal is not None:
+                row["wal_bytes"] = self._wal.size_bytes(key)
+            keys[edn.dumps(key)] = row
+        doc["keys"] = keys
+        return doc
+
+    def health(self) -> dict:
+        """The /healthz document: ``ok`` is READINESS (serve this
+        instance traffic?), degraded by a dead worker, an unwritable/
+        dead WAL, any non-closed circuit breaker, or the queue at/past
+        the shed high-water. Liveness is the HTTP answer itself. The
+        CLI additionally merges the continuous chip watch
+        (``probe.ProbeWatch.status``) into ``checks``."""
+        with self._cond:
+            worker_ok = (self._worker is not None
+                         and self._worker.is_alive()
+                         and not self._stop)
+            pending = self._pending_ops
+            wal_dead = sum(1 for ks in self._keys.values()
+                           if ks.wal_dead)
+            poisoned = sum(1 for ks in self._keys.values()
+                           if ks.broken)
+            n_keys = len(self._keys)
+        checks = {"worker": {"ok": worker_ok}}
+        if self._wal is not None:
+            writable = os.access(self._wal.root, os.W_OK)
+            checks["wal"] = {"ok": writable and wal_dead == 0,
+                             "dir": self._wal.root,
+                             "writable": writable,
+                             "dead_keys": wal_dead}
+        queue_ok = not self.high_water or pending < self.high_water
+        checks["queue"] = {"ok": queue_ok, "pending_ops": pending,
+                           "high_water": self.high_water,
+                           "global_bound": self.global_bound}
+        # breaker states come from the resilience registry (imported
+        # here, not at module scope: serve must not pull the breaker
+        # machinery in for WAL-less in-memory embeddings)
+        from jepsen_tpu.resilience import breaker as breaker_mod
+        snaps = breaker_mod.snapshots()
+        checks["breakers"] = {
+            "ok": all(s["state"] == breaker_mod.CLOSED for s in snaps),
+            "states": {s["backend"]: s["state"] for s in snaps}}
+        checks["keys"] = {"ok": poisoned == 0, "total": n_keys,
+                          "poisoned": poisoned}
+        return {"ok": all(c["ok"] for c in checks.values()),
+                "live": True, "checks": checks}
+
     # ------------------------------------------------------ recovery
 
     def _recover(self) -> None:
@@ -463,6 +613,7 @@ class CheckerService:
             ks.pending_ops = sum(len(ops) for _, ops in rest)
             self._pending_ops += ks.pending_ops
             ks.last_activity = self._clock()
+            ks.acct["replays"] = len(deltas)
             self._keys[key] = ks
             obs.counter("serve.replayed_deltas").inc(len(deltas))
         if self._keys:
@@ -486,13 +637,15 @@ class CheckerService:
         sess = self._new_session(ks.key)
         cp, _meta = (self._cps.load(ks.key)
                      if self._cps is not None else (None, None))
-        ops = [op for seq, dops in
-               (self._wal.replay(ks.key) if self._wal else [])
-               if seq <= ks.applied_seq for op in dops]
+        deltas = self._wal.replay(ks.key) if self._wal else []
+        applied = [(seq, dops) for seq, dops in deltas
+                   if seq <= ks.applied_seq]
+        ops = [op for _seq, dops in applied for op in dops]
         if ops:
             with obs.span("serve.thaw", key=str(ks.key)):
                 sess.thaw(ops, cp)
             obs.counter("serve.thaws").inc()
+            ks.acct["replays"] += len(applied)
         ks.session = sess
         return sess
 
@@ -527,6 +680,17 @@ class CheckerService:
             # blocked producers now, not after the device work
         return batch
 
+    def _observe_verdicts_locked(self, ks: _Key) -> None:
+        """Drain the key's admitted-delta timestamps up to its applied
+        seq into the ingest->verdict SLO histogram (callers hold the
+        service condition)."""
+        now = self._clock()
+        h = obs.histogram("serve.verdict_secs")
+        while ks.pending_times and ks.pending_times[0][0] \
+                <= ks.applied_seq:
+            _seq, t_in = ks.pending_times.popleft()
+            h.observe(max(0.0, now - t_in))
+
     def _crashed_entry(self, ks: _Key, err) -> dict:
         """Per-entry failure isolation: a loud error verdict, and the
         in-memory session is DROPPED so the next delta thaw-replays
@@ -536,6 +700,9 @@ class CheckerService:
         silently rebuilt from a truncated history."""
         obs.counter("serve.worker_errors").inc()
         _log.exception("serve worker: key %r failed", ks.key)
+        # the crash's postmortem evidence, tracing on or off (a None
+        # check when the flight recorder is unarmed)
+        obs.flight_dump("serve-worker-error")
         ks.session = None
         if self._wal is None:
             ks.broken = True
@@ -597,6 +764,7 @@ class CheckerService:
                     ks.finalized = True
                 if last_seq is not None:
                     ks.applied_seq = last_seq
+                self._observe_verdicts_locked(ks)
                 ks.last_activity = self._clock()
             self._cond.notify_all()
 
@@ -658,6 +826,7 @@ class CheckerService:
                         ks.needs_check = False
                         if last_seq is not None:
                             ks.applied_seq = last_seq
+                        self._observe_verdicts_locked(ks)
                     self._cond.notify_all()
             finally:
                 with self._cond:

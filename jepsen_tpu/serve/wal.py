@@ -195,6 +195,15 @@ class DeltaWAL:
         deltas = self.replay(key)
         return deltas[-1][0] if deltas else 0
 
+    def size_bytes(self, key) -> int:
+        """The key's WAL file size (0 when none) — the /status
+        per-key durability column."""
+        path = os.path.join(self.root, _safe_name(key) + ".wal")
+        try:
+            return os.path.getsize(path)
+        except OSError:
+            return 0
+
 
 # -------------------------------------------------- checkpoint store
 

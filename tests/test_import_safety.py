@@ -23,6 +23,10 @@ ENGINE_MODULES = [
     "jepsen_tpu.models",
     "jepsen_tpu.independent",
     "jepsen_tpu.serve.service",
+    # the ops surface must ANSWER while the runtime is wedged — its
+    # import (and the probe watch's) can never touch a backend
+    "jepsen_tpu.obs.httpd",
+    "jepsen_tpu.probe",
 ]
 
 _PROBE = r"""

@@ -232,8 +232,13 @@ def test_delta_windows_gauges_and_histograms():
     d = reg.delta(before)
     assert d["depth"] == {"type": "gauge", "value": 0, "max": None,
                           "nops": 2}
-    assert d["secs"] == {"type": "histogram", "count": 1, "total": 1.0,
-                         "min": None, "max": None, "mean": 1.0}
+    secs = dict(d["secs"])
+    # the bucket ladder subtracts window-correctly: this window owns
+    # exactly its own 1.0s observation, cumulative from the 1.0 bound
+    buckets = dict(secs.pop("buckets"))
+    assert buckets[1.0] == 1 and buckets[0.25] == 0 and buckets[60.0] == 1
+    assert secs == {"type": "histogram", "count": 1, "total": 1.0,
+                    "min": None, "max": None, "mean": 1.0}
 
     g.inc(9), g.dec(9)                       # run 3 sets a new peak
     d = reg.delta(before)

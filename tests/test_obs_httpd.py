@@ -1,0 +1,525 @@
+"""Ops-surface suite (ISSUE 9 acceptance): Prometheus exposition
+round-trip, /healthz degradation (breaker open, queue past
+high-water, stale chip probe), /status per-key accounting, the
+`jepsen status` client, the continuous probe watch, and the crash
+flight recorder (dump on an injected wedge with tracing off, bounded
+ring memory, off-by-default zero overhead).
+"""
+
+import json
+import os
+import re
+import urllib.error
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import obs, resilience
+from jepsen_tpu.envflags import EnvFlagError
+from jepsen_tpu.histories import rand_register_history
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.obs import httpd as ops_httpd
+from jepsen_tpu.obs.metrics import BUCKET_LADDER, hist_quantile
+from jepsen_tpu.resilience import breaker as breaker_mod
+from jepsen_tpu.resilience import supervisor as sup
+from jepsen_tpu.serve import CheckerService
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state(monkeypatch):
+    """Every test starts with tracing/flight off, no fault plan, and
+    closed breakers; the default registry is shared process state and
+    deliberately NOT reset (metric names are cumulative by design —
+    assertions below read deltas or their own names)."""
+    for flag in ("JEPSEN_TPU_TRACE", "JEPSEN_TPU_FLIGHT_RECORDER",
+                 "JEPSEN_TPU_FAULTS", "JEPSEN_TPU_WATCHDOG",
+                 "JEPSEN_TPU_OPS_PORT"):
+        monkeypatch.delenv(flag, raising=False)
+    obs.reset()
+    obs.flight_reset()
+    resilience.reset()
+    yield
+    obs.reset()
+    obs.flight_reset()
+    resilience.reset()
+
+
+def _get(url, timeout=10.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode()
+
+
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? ([-+0-9.eE]+)$")
+
+
+def _parse_prom(text):
+    """A tiny exposition-format reader: {(name, labels): float},
+    plus the {name: type} map from # TYPE lines. Raises on any line
+    that is neither — the round-trip contract."""
+    samples, types = {}, {}
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# TYPE "):
+            _, _, name, typ = ln.split(" ")
+            types[name] = typ
+            continue
+        if ln.startswith("#"):
+            continue
+        m = _SAMPLE.match(ln)
+        assert m, f"unparseable exposition line: {ln!r}"
+        samples[(m.group(1), m.group(2) or "")] = float(m.group(3))
+    return samples, types
+
+
+# ------------------------------------------------ exposition format
+
+
+def test_prom_name_sanitization():
+    assert ops_httpd.prom_name("serve.pending_ops") \
+        == "jepsen_serve_pending_ops"
+    assert ops_httpd.prom_name("resilience.breaker.cpu:0.state") \
+        == "jepsen_resilience_breaker_cpu_0_state"
+    assert ops_httpd.prom_name("9weird") == "jepsen_9weird"
+    # every rendered name must be legal for Prometheus
+    legal = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    for raw in ("a.b", "a-b", "a b", "ä.ü", "x..y"):
+        assert legal.match(ops_httpd.prom_name(raw)), raw
+
+
+def test_render_prometheus_round_trip():
+    reg = obs.Registry()
+    reg.counter("t.count").inc(7)
+    g = reg.gauge("t.depth")
+    g.set(3)
+    g.set(2)
+    h = reg.histogram("t.secs")
+    for v in (0.0005, 0.0005, 0.02, 5.0, 120.0):
+        h.observe(v)
+    text = ops_httpd.render_prometheus(reg.snapshot())
+    samples, types = _parse_prom(text)
+    assert types["jepsen_t_count"] == "counter"
+    assert samples[("jepsen_t_count", "")] == 7
+    assert types["jepsen_t_depth"] == "gauge"
+    assert samples[("jepsen_t_depth", "")] == 2
+    assert samples[("jepsen_t_depth_max", "")] == 3
+    assert types["jepsen_t_secs"] == "histogram"
+    # bucket cumulativity: counts are non-decreasing in le and the
+    # +Inf bucket equals _count (120.0 lies past the ladder)
+    buckets = [(float(lab[5:-2]), n) for (name, lab), n
+               in samples.items()
+               if name == "jepsen_t_secs_bucket" and "+Inf" not in lab]
+    buckets.sort()
+    assert [le for le, _ in buckets] == list(BUCKET_LADDER)
+    counts = [n for _, n in buckets]
+    assert counts == sorted(counts)
+    assert counts[-1] == 4          # everything but the 120.0
+    assert samples[("jepsen_t_secs_bucket", '{le="+Inf"}')] == 5
+    assert samples[("jepsen_t_secs_count", "")] == 5
+    assert samples[("jepsen_t_secs_sum", "")] == pytest.approx(125.021)
+
+
+def test_histogram_buckets_answer_quantiles():
+    reg = obs.Registry()
+    h = reg.histogram("q.secs")
+    for _ in range(99):
+        h.observe(0.002)
+    h.observe(8.0)
+    snap = reg.snapshot()["q.secs"]
+    assert hist_quantile(snap, 0.5) == 0.0025
+    assert hist_quantile(snap, 0.99) == 0.0025
+    assert hist_quantile(snap, 0.999) == 10.0
+    assert hist_quantile(snap, 1.0) == 10.0
+    # past-the-ladder observations fall back to the streaming max
+    h2 = reg.histogram("q2.secs")
+    h2.observe(500.0)
+    assert hist_quantile(reg.snapshot()["q2.secs"], 0.99) == 500.0
+    assert hist_quantile({"count": 0, "buckets": []}, 0.5) is None
+
+
+def test_flight_recorder_flag_validation(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_FLIGHT_RECORDER", "nope")
+    obs.reset()
+    with pytest.raises(EnvFlagError):
+        obs.flight_active()
+    monkeypatch.setenv("JEPSEN_TPU_FLIGHT_RECORDER", "-1")
+    obs.reset()
+    with pytest.raises(EnvFlagError):
+        obs.flight_active()
+
+
+# ------------------------------------------------ service + healthz
+
+
+def _service(**kw):
+    kw.setdefault("capacity", 256)
+    kw.setdefault("dedupe", "sort")
+    return CheckerService(CASRegister(), **kw)
+
+
+def _ops_for(svc):
+    return ops_httpd.start_ops_server(
+        0, health_fn=svc.health, status_fn=svc.status,
+        refresh_fn=svc.refresh_gauges)
+
+
+def test_healthz_flips_on_breaker_open():
+    svc = _service()
+    ops = _ops_for(svc)
+    try:
+        code, body = _get(ops.url("/healthz"))
+        assert code == 200 and json.loads(body)["ok"] is True
+        br = breaker_mod.breaker_for("testbe", threshold=2,
+                                     probe=lambda: False)
+        br.record_failure("boom")
+        br.record_failure("boom")
+        assert br.state == breaker_mod.OPEN
+        code, body = _get(ops.url("/healthz"))
+        doc = json.loads(body)
+        assert code == 503 and doc["ok"] is False
+        assert doc["checks"]["breakers"]["ok"] is False
+        assert doc["checks"]["breakers"]["states"]["testbe"] == "open"
+        # the rest of the surface still answers while degraded
+        code, _ = _get(ops.url("/metrics"))
+        assert code == 200
+        resilience.reset()
+        code, body = _get(ops.url("/healthz"))
+        assert code == 200 and json.loads(body)["ok"] is True
+    finally:
+        ops.close()
+        svc.close()
+
+
+def test_healthz_flips_on_queue_past_high_water():
+    import threading
+    h = list(rand_register_history(n_ops=32, n_processes=4, seed=5))
+    # a STALLED worker (alive thread, never drains): admitted ops stay
+    # pending so the queue level is exact, while the worker liveness
+    # check stays green — isolating the high-water readiness flip
+    svc = _service(start_worker=False, per_key_queue=64,
+                   global_bound=64, high_water=8)
+    release = threading.Event()
+    svc._worker = threading.Thread(target=release.wait, daemon=True)
+    svc._worker.start()
+    ops = _ops_for(svc)
+    try:
+        code, body = _get(ops.url("/healthz"))
+        assert code == 200 and json.loads(body)["ok"] is True
+        r = svc.submit("k", h[:8])      # 8 ops: exactly at high-water
+        assert r.get("accepted")
+        code, body = _get(ops.url("/healthz"))
+        doc = json.loads(body)
+        assert code == 503 and doc["ok"] is False
+        assert doc["checks"]["queue"]["ok"] is False
+        assert doc["checks"]["queue"]["pending_ops"] == 8
+        # and the shed path the high-water protects is live
+        shed = svc.submit("k", h[8:16])
+        assert shed.get("shed")
+        st = json.loads(_get(ops.url("/status"))[1])
+        assert st["keys"]['"k"']["acct"]["sheds"] == 1
+    finally:
+        release.set()
+        ops.close()
+        svc.close(drain=False)
+
+
+def test_healthz_worker_death_and_probe_merge():
+    svc = _service(start_worker=False)
+    # no worker thread at all -> not ready (the liveness half of the
+    # serve CLI's composition; the probe merge is the readiness half)
+    doc = svc.health()
+    assert doc["ok"] is False and doc["checks"]["worker"]["ok"] is False
+    svc.close(drain=False)
+
+
+def test_status_per_key_accounting_and_cli(capsys):
+    h = list(rand_register_history(n_ops=24, n_processes=4, seed=11))
+    svc = _service()
+    ops = _ops_for(svc)
+    try:
+        assert svc.submit("k1", h[:12], wait=True,
+                          timeout=120).get("valid?") is not None
+        assert svc.submit(("pair", 2), h[12:], wait=True,
+                          timeout=120).get("valid?") is not None
+        code, body = _get(ops.url("/status"))
+        assert code == 200
+        doc = json.loads(body)
+        row = doc["keys"]['"k1"']
+        assert row["seq"] == 1 and row["state"] == "live"
+        assert row["acct"] == {"deltas": 1, "ops": 12, "sheds": 0,
+                               "replays": 0}
+        assert '["pair" 2]' in doc["keys"]
+        assert doc["worker_alive"] is True
+        # SLO histograms moved (ack on admit, verdict on publish)
+        snap = obs.registry().snapshot()
+        assert snap["serve.ack_secs"]["count"] >= 2
+        assert snap["serve.verdict_secs"]["count"] >= 2
+        assert snap["serve.verdict_secs"]["buckets"][-1][1] \
+            == snap["serve.verdict_secs"]["count"]
+        # the `jepsen status` client renders the same surface
+        rc = ops_httpd.status_main(["--port", str(ops.port)])
+        out = capsys.readouterr().out
+        assert rc == 0 and "READY" in out and '"k1"' in out
+        rc = ops_httpd.status_main(["--port", str(ops.port), "--json"])
+        j = json.loads(capsys.readouterr().out)
+        assert j["health"]["ok"] is True and '"k1"' in j["status"]["keys"]
+        rc = ops_httpd.status_main(["--port", str(ops.port),
+                                    "--metrics"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "jepsen_serve_deltas" in out
+    finally:
+        ops.close()
+        svc.close()
+
+
+def test_status_cli_unreachable_and_usage():
+    # unused port: connection refused -> exit 2 (not a traceback)
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    assert ops_httpd.status_main(["--port", str(port)]) == 2
+    assert ops_httpd.status_main([]) == 254          # no port anywhere
+    assert ops_httpd.status_main(["--bogus"]) == 254
+    # a server that answers but is NOT the ops endpoint (e.g. the web
+    # results browser on serve's default port): exit 2 wrong-target,
+    # not a traceback and not a phantom "degraded"
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Html(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            body = b"<html>not the ops endpoint</html>"
+            self.send_response(404)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # noqa: N802
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Html)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        hp = str(srv.server_address[1])
+        assert ops_httpd.status_main(["--port", hp]) == 2
+        assert ops_httpd.status_main(["--port", hp, "--metrics"]) == 2
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_cli_forwards_status_subcommand(monkeypatch):
+    from jepsen_tpu import cli
+    seen = {}
+
+    def fake_status_main(argv):
+        seen["argv"] = argv
+        return 0
+
+    monkeypatch.setattr(ops_httpd, "status_main", fake_status_main)
+    assert cli.run_cli(argv=["status", "--port", "1"]) == 0
+    assert seen["argv"] == ["--port", "1"]
+
+
+def test_ops_server_unknown_path_404():
+    ops = ops_httpd.start_ops_server(0)
+    try:
+        code, body = _get(ops.url("/nope"))
+        assert code == 404 and "endpoints" in json.loads(body)
+        code, _ = _get(ops.url("/metrics"))
+        assert code == 200
+    finally:
+        ops.close()
+
+
+# ------------------------------------------------------ probe watch
+
+
+def test_probe_watch_gauges_and_staleness():
+    from jepsen_tpu import probe as probe_mod
+    clock = [0.0]
+    docs = [{"verdict": "healthy"}, {"verdict": "healthy"},
+            {"verdict": "wedged"}]
+    w = probe_mod.ProbeWatch(interval=10.0, timeout=5.0,
+                             probe=lambda: docs.pop(0),
+                             clock=lambda: clock[0])
+    assert w.status()["ok"] is True      # first probe still in flight
+    w.tick()
+    assert obs.registry().snapshot()["probe.chip_healthy"]["value"] == 1
+    st = w.status()
+    assert st["ok"] is True and st["verdict"] == "healthy"
+    clock[0] = 11.0
+    w.tick()
+    assert w.status()["last_ok_age_secs"] == 0.0
+    clock[0] = 22.0
+    w.tick()                              # the outage tick
+    snap = obs.registry().snapshot()
+    assert snap["probe.chip_healthy"]["value"] == 0
+    st = w.status()
+    assert st["ok"] is False and st["verdict"] == "wedged"
+    assert st["last_ok_age_secs"] == 11.0
+    # staleness alone degrades too: healthy-but-ancient is not ok
+    w2 = probe_mod.ProbeWatch(interval=1.0, timeout=1.0,
+                              probe=lambda: {"verdict": "healthy"},
+                              clock=lambda: clock[0])
+    w2.tick()
+    clock[0] += 1000.0
+    assert w2.status()["ok"] is False
+
+
+def test_probe_watch_raising_probe_degrades_readiness():
+    """A probe that RAISES every cycle (spawn failure) must degrade
+    /healthz, not leave the first-tick ok=True grace in place
+    forever."""
+    from jepsen_tpu import probe as probe_mod
+
+    def boom():
+        raise OSError("cannot spawn probe child")
+
+    w = probe_mod.ProbeWatch(interval=1.0, timeout=1.0, probe=boom,
+                             clock=lambda: 0.0)
+    w.tick()                              # absorbed, counted
+    st = w.status()
+    assert st["ticks"] == 1 and st["verdict"] == "probe-error"
+    assert st["ok"] is False
+    assert obs.registry().snapshot()["probe.chip_healthy"]["value"] == 0
+
+
+def test_probe_watch_env_gate(monkeypatch):
+    from jepsen_tpu import probe as probe_mod
+    monkeypatch.delenv("JEPSEN_TPU_PROBE_INTERVAL", raising=False)
+    assert probe_mod.start_watch_from_env() is None
+    monkeypatch.setenv("JEPSEN_TPU_PROBE_INTERVAL", "0")
+    assert probe_mod.start_watch_from_env() is None
+    monkeypatch.setenv("JEPSEN_TPU_PROBE_INTERVAL", "soon")
+    with pytest.raises(EnvFlagError):
+        probe_mod.start_watch_from_env()
+
+
+# -------------------------------------------------- flight recorder
+
+
+def test_flight_dump_on_injected_wedge(tmp_path, monkeypatch):
+    """The acceptance pin: tracing OFF, flight recorder armed, an
+    injected wedge@dispatch leaves a Chrome-trace dump in the store
+    dir."""
+    monkeypatch.setenv("JEPSEN_TPU_FLIGHT_RECORDER", "1")
+    monkeypatch.setenv("JEPSEN_TPU_FAULTS", "wedge@dispatch:1")
+    obs.reset()
+    obs.flight_reset()
+    obs.set_flight_dir(str(tmp_path))
+    resilience.reset()
+    assert not obs.enabled() and obs.flight_active()
+    with obs.span("engine.pretend_search", key="k9"):
+        pass
+    with pytest.raises(sup.DispatchWedged):
+        sup.dispatch("dispatch", lambda: 42)
+    files = sorted(os.listdir(tmp_path))
+    assert len(files) == 1 and files[0].endswith(".trace.json")
+    assert "dispatch-wedged" in files[0]
+    doc = json.load(open(tmp_path / files[0]))
+    names = {ev.get("name") for ev in doc["traceEvents"]}
+    assert "engine.pretend_search" in names
+    fl = doc["flight"]
+    assert fl["reason"].startswith("dispatch-wedged")
+    assert fl["metrics_delta"]["resilience.watchdog_kills"]["value"] >= 1
+
+
+def test_flight_dump_on_breaker_open(tmp_path, monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_FLIGHT_RECORDER", "1")
+    obs.reset()
+    obs.flight_reset()
+    obs.set_flight_dir(str(tmp_path))
+    resilience.reset()
+    br = breaker_mod.breaker_for("flightbe", threshold=1,
+                                 probe=lambda: False)
+    br.record_failure("boom")
+    files = [f for f in os.listdir(tmp_path) if "breaker-open" in f]
+    assert len(files) == 1
+
+
+def test_flight_ring_bounded_memory(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_FLIGHT_RECORDER", "16")
+    obs.reset()
+    obs.flight_reset()
+    tr = obs.tracer()
+    assert tr is not None and tr.flight_only
+    for i in range(500):
+        with obs.span("ring.spin", i=i):
+            pass
+    ring = tr.ring_spans()
+    assert len(ring) == 16
+    assert ring[-1].args["i"] == 499     # last N closed, oldest evicted
+    assert tr.spans() == []              # the unbounded buffer NEVER
+    # fills in flight-only mode — a week-long serve stays bounded
+    # and run-dir exports stay off
+    assert obs.export_run("store/should_not_exist") is None
+    assert not os.path.exists("store/should_not_exist")
+
+
+def test_flight_dump_cap(monkeypatch, tmp_path):
+    from jepsen_tpu.obs import export as export_mod
+    monkeypatch.setenv("JEPSEN_TPU_FLIGHT_RECORDER", "4")
+    obs.reset()
+    obs.flight_reset()
+    for i in range(export_mod.FLIGHT_MAX_DUMPS + 5):
+        p = obs.flight_dump("storm", dest_dir=str(tmp_path))
+        assert (p is None) == (i >= export_mod.FLIGHT_MAX_DUMPS)
+    assert len(os.listdir(tmp_path)) == export_mod.FLIGHT_MAX_DUMPS
+
+
+def test_flight_dump_failure_never_replaces_the_fault(tmp_path,
+                                                      monkeypatch):
+    """An unwritable flight dir must not turn a handled fault into an
+    unhandled crash: the hook sites still raise their STRUCTURED
+    errors (DispatchWedged here), and the dump failure is counted."""
+    monkeypatch.setenv("JEPSEN_TPU_FLIGHT_RECORDER", "1")
+    monkeypatch.setenv("JEPSEN_TPU_FAULTS", "wedge@dispatch:1")
+    obs.reset()
+    obs.flight_reset()
+    # a FILE where the dump dir should be: makedirs raises
+    blocker = tmp_path / "flight"
+    blocker.write_text("not a directory")
+    obs.set_flight_dir(str(blocker))
+    resilience.reset()
+    before = obs.registry().snapshot().get(
+        "obs.flight_dump_errors", {"value": 0})["value"]
+    with pytest.raises(sup.DispatchWedged):   # NOT OSError
+        sup.dispatch("dispatch", lambda: 42)
+    snap = obs.registry().snapshot()
+    assert snap["obs.flight_dump_errors"]["value"] == before + 1
+
+
+def test_flight_off_is_the_historical_noop():
+    """Off by default: span() is the no-op singleton (the <2µs pin in
+    test_obs.py covers CPU), flight_dump is a None check, dispatch is
+    the passthrough, and nothing exists on disk."""
+    assert obs.tracer() is None
+    s1, s2 = obs.span("a"), obs.span("b")
+    assert s1 is s2                       # the singleton
+    assert not obs.flight_active()
+    assert obs.flight_dump("nothing") is None
+    assert sup.dispatch("dispatch", lambda: 7) == 7
+
+
+def test_flight_rides_full_tracing(monkeypatch):
+    """TRACE=1 + FLIGHT_RECORDER: the ring retains spans across the
+    per-run drain(), so a crash after N exported runs still dumps."""
+    monkeypatch.setenv("JEPSEN_TPU_TRACE", "1")
+    monkeypatch.setenv("JEPSEN_TPU_FLIGHT_RECORDER", "8")
+    obs.reset()
+    obs.flight_reset()
+    tr = obs.tracer()
+    assert obs.enabled() and obs.flight_active() and not tr.flight_only
+    with obs.span("both.modes"):
+        pass
+    assert len(tr.spans()) == 1
+    tr.drain()
+    assert tr.spans() == []
+    assert [s.name for s in tr.ring_spans()] == ["both.modes"]

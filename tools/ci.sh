@@ -11,7 +11,10 @@
 #   1c. streaming-checker smoke — tools/serve_smoke.py: the serve
 #      service in-process, two keys' deltas (one with an injected
 #      wedge), final verdicts asserted identical to the one-shot
-#      batch check, clean drain (docs/streaming.md, at smoke scale)
+#      batch check, clean drain, AND the live ops surface on an
+#      ephemeral port: /healthz ready, /metrics valid Prometheus
+#      text with the serve SLO histograms, /status listing both
+#      keys (docs/streaming.md + docs/observability.md, smoke scale)
 #   2. tier-1 tests     — the ROADMAP.md invocation verbatim: the
 #      full suite minus the slow tier on a virtual 8-device CPU mesh,
 #      under the documented 870s budget (timeout -k 10 870). The

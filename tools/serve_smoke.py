@@ -12,11 +12,17 @@ JEPSEN_TPU_FAULTS), and asserts:
   * the injected wedge degrades with a structured note instead of
     flipping a verdict or hanging the service;
   * graceful drain: zero pending ops at close, every admitted delta
-    accounted for in the final seq.
+    accounted for in the final seq;
+  * the live ops surface answers while the service checks deltas: an
+    ephemeral-port ops endpoint's /healthz is ready, /metrics parses
+    as Prometheus text exposition (incl. the serve.* SLO histograms
+    with buckets), and /status lists both smoke keys with their seqs
+    (the ISSUE 9 acceptance wiring, end to end).
 
 `tools/ci.sh` runs this right after fault_smoke. This is a wiring
-check; tests/test_serve.py carries the full matrix (families,
-evict/thaw, WAL replay, overload).
+check; tests/test_serve.py + tests/test_obs_httpd.py carry the full
+matrix (families, evict/thaw, WAL replay, overload, exposition
+format, healthz degradation, flight recorder).
 """
 
 import os
@@ -29,12 +35,55 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
+def _check_ops_surface(ops) -> int:
+    """The ops-endpoint acceptance at smoke scale: ready /healthz,
+    parseable Prometheus /metrics with the serve SLO histograms, both
+    smoke keys in /status. Returns the failure count."""
+    import json
+    import re
+
+    from jepsen_tpu.obs.httpd import _fetch as _http_get
+    failures = 0
+    code, body = _http_get(ops.url("/healthz"))
+    health = json.loads(body)
+    if code != 200 or not health.get("ok"):
+        print(f"serve-smoke: /healthz not ready after a clean run: "
+              f"{code} {health}")
+        failures += 1
+    code, body = _http_get(ops.url("/metrics"))
+    sample = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [-+0-9.eE]+$')
+    bad = [ln for ln in body.splitlines()
+           if ln and not ln.startswith("#") and not sample.match(ln)]
+    if code != 200 or bad:
+        print(f"serve-smoke: /metrics not valid Prometheus text "
+              f"(code {code}): {bad[:3]}")
+        failures += 1
+    for needed in ("jepsen_serve_ack_secs_bucket",
+                   "jepsen_serve_verdict_secs_bucket",
+                   "jepsen_serve_deltas"):
+        if needed not in body:
+            print(f"serve-smoke: /metrics missing {needed}")
+            failures += 1
+    code, body = _http_get(ops.url("/status"))
+    status = json.loads(body)
+    keys = status.get("keys") or {}
+    for k in ('"k1"', '"k2"'):
+        row = keys.get(k)
+        if row is None or row.get("seq") != 3:
+            print(f"serve-smoke: /status missing key {k} at seq 3: "
+                  f"{row}")
+            failures += 1
+    return failures
+
+
 def main() -> int:
     from jepsen_tpu import resilience
     from jepsen_tpu.histories import corrupt_history, \
         rand_register_history
     from jepsen_tpu.history import History
     from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.obs import httpd as ops_httpd
     from jepsen_tpu.parallel import encode as enc_mod, engine
     from jepsen_tpu.serve import CheckerService
 
@@ -54,6 +103,9 @@ def main() -> int:
     failures = 0
     wal = tempfile.mkdtemp(prefix="jepsen_serve_smoke_")
     svc = CheckerService(m, wal_dir=wal, capacity=256, dedupe="sort")
+    ops = ops_httpd.start_ops_server(0, health_fn=svc.health,
+                                     status_fn=svc.status,
+                                     refresh_fn=svc.refresh_gauges)
     try:
         cuts = [(0, 16), (16, 32), (32, 48)]
         for i, (a, b) in enumerate(cuts):
@@ -82,8 +134,10 @@ def main() -> int:
         if stats["pending_ops"] != 0:
             print(f"serve-smoke: pending ops after drain: {stats}")
             failures += 1
+        failures += _check_ops_surface(ops)
     finally:
         svc.close()
+        ops.close()
     for k, ref in refs.items():
         if pin(finals[k]) != pin(ref):
             print(f"serve-smoke: {k} final verdict diverged from the "
@@ -98,7 +152,8 @@ def main() -> int:
         return 1
     print(f"serve-smoke: streamed verdicts identical to batch "
           f"(k1={finals['k1']['valid?']}, k2={finals['k2']['valid?']}), "
-          f"wedge degraded cleanly, drain clean")
+          f"wedge degraded cleanly, drain clean, ops endpoint "
+          f"(/healthz /metrics /status) live")
     return 0
 
 
