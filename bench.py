@@ -65,6 +65,7 @@ import sys
 import threading
 from time import monotonic, perf_counter
 
+from jepsen_tpu import envflags
 from jepsen_tpu import obs
 
 # set in child_main when JEPSEN_TPU_TRACE is on: the repo-relative path
@@ -124,6 +125,51 @@ def emit(obj):
 
 def note(msg):
     print(f"# {msg}", file=sys.stderr, flush=True)
+
+
+def emit_search_stats(section: str, results, extra=None):
+    """The stats-gated occupancy/pad-waste advisory line: emitted ONLY
+    under JEPSEN_TPU_SEARCH_STATS=1 (results then already carry the
+    device-computed "stats" blocks — zero extra compute), so the
+    default bench schema is byte-identical (gating pinned in
+    test_bench.py). `results` is a result dict or a list of them."""
+    if not envflags.env_bool("JEPSEN_TPU_SEARCH_STATS", default=False):
+        return
+    rs = results if isinstance(results, list) else [results]
+    blocks = [r.get("stats") for r in rs if isinstance(r, dict)
+              and r.get("stats")]
+    if not blocks:
+        return
+    occ = [b["peak-occupancy"] for b in blocks
+           if b.get("peak-occupancy") is not None]
+    lf = [b["load-factor-peak"] for b in blocks
+          if b.get("load-factor-peak") is not None]
+    waste = [b["pad-waste"] for b in blocks
+             if b.get("pad-waste") is not None]
+    hist: dict = {}
+    for b in blocks:
+        for lab, n in (b.get("probe-hist") or {}).items():
+            hist[lab] = hist.get(lab, 0) + int(n)
+    line = {"metric": f"{section} search stats (advisory, "
+                      f"JEPSEN_TPU_SEARCH_STATS)",
+            "value": round(max(occ), 6) if occ else None,
+            "unit": "peak-occupancy",
+            "keys": len(blocks),
+            "engine": blocks[0].get("engine"),
+            "frontier_peak": max(b.get("frontier-peak") or 0
+                                 for b in blocks),
+            "load_factor_peak": round(max(lf), 6) if lf else None,
+            "pad_waste_max": round(max(waste), 6) if waste else None,
+            "probe_hist": hist or None,
+            "escalated_keys": sum(1 for b in blocks
+                                  if b.get("capacity-tier")),
+            "note": "device-computed occupancy/probe evidence for "
+                    "ROADMAP items 2/5 (docs/observability.md "
+                    "'Search telemetry'); absent without the flag — "
+                    "default schema unchanged"}
+    if extra:
+        line.update(extra)
+    emit(line)
 
 
 def _enable_compile_cache():
@@ -258,6 +304,7 @@ def sec_multikey(label: str = None):
                       "measured sequentially, x32 ideal scaling modeled "
                       "(per-key checks parallelize perfectly, so 32x is "
                       "the host's true ceiling)"})
+    emit_search_stats(f"multi-key {N_KEYS}x{OPS_PER_KEY}-op", rs)
 
     # -- pipelined e2e: the same batch through the pipelined executor
     # (encode/transfer overlapped with device work, parallel.pipeline),
@@ -382,6 +429,7 @@ def sec_adv(L: int, host_deadline: float, skip_host: bool,
                       "threaded — a single key cannot be "
                       "parallelized by knossos linear/wgl, so no "
                       "32x scaling applies"})
+    emit_search_stats(f"adversarial single-key {L}-op", r, {"L": L})
 
     # -- sparse-engine dedupe A/B (advisory): the frontier engine's
     # sort vs hash strategies on the same encoded history, with the
@@ -514,6 +562,7 @@ def sec_sharded(L: int, host_est: float | None,
     else:
         line["capacity_grew_to"] = cap
     emit(line)
+    emit_search_stats(f"sharded {L}-op", r, {"L": L})
 
 
 MAXLEN_RUN_BUDGET = 5 if SMOKE else 60   # the metric's "@ 60s" budget

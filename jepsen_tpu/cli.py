@@ -130,6 +130,14 @@ def base_parser(prog: str = "jepsen") -> argparse.ArgumentParser:
         help="fetch /status + /healthz from a running `jepsen serve "
              "--checker --ops-port N` and print the operator summary; "
              "exit 0 ready / 1 degraded / 2 unreachable")
+    # listed for --help discoverability only, like lint/probe/status:
+    # run_cli dispatches `report` BEFORE parsing (obs.search_report
+    # owns its flags; exit 0 written / 1 no stats / 254 usage)
+    rp = sub.add_parser(
+        "report", add_help=False,
+        help="render a stored run's telemetry reports; --search "
+             "renders the JEPSEN_TPU_SEARCH_STATS per-key table "
+             "(worst keys by load factor / escalations / pad waste)")
     ta = sub.add_parser(
         "test-all", help="run a whole suite of tests in one go")
     common(ta)
@@ -141,7 +149,7 @@ def base_parser(prog: str = "jepsen") -> argparse.ArgumentParser:
                          "single --nemesis)")
     p._jepsen_subparsers = {"test": t, "analyze": a, "serve": s,
                             "lint": li, "probe": pr, "status": st,
-                            "test-all": ta}
+                            "report": rp, "test-all": ta}
     return p
 
 
@@ -380,6 +388,12 @@ def run_cli(test_fn: Optional[Callable[[Dict], Dict]] = None,
         # against a wedged runtime
         from jepsen_tpu.obs import httpd as ops_httpd
         return ops_httpd.status_main(raw[1:])
+    if raw[:1] == ["report"]:
+        # same pre-parse forwarding: the search-telemetry report owns
+        # its flags (`--search --run-dir`), reads stored artifacts
+        # only, and never touches jax
+        from jepsen_tpu.obs import search_report
+        return search_report.report_main(raw[1:])
     parser = base_parser(prog)
     if extend_parser is not None:
         extend_parser(parser)

@@ -185,6 +185,21 @@ def env_path(name: str, what: str = "path") -> Optional[str]:
 #                            JEPSEN_TPU_PALLAS); opt-in until
 #                            tools/perf_ab.py's hash-pallas strategy
 #                            records the on-chip win
+#   JEPSEN_TPU_SEARCH_STATS  env_bool    parallel.engine — device-
+#                            resident search telemetry: when on, the
+#                            engine jits (sparse XLA + pallas,
+#                            bitdense, sharded, streaming-resumable)
+#                            additionally return a per-event stats
+#                            block computed on device (frontier-width
+#                            trajectory, closure iterations, delta
+#                            split, hash-table load factor, bucketed
+#                            probe-length histogram, pad waste),
+#                            threaded into result "stats" dicts, the
+#                            engine.search.* registry names (/metrics),
+#                            Perfetto counter tracks, and `jepsen
+#                            report --search`; default off — results,
+#                            bench schema, and trace files byte-
+#                            identical to the pre-stats engine
 #   JEPSEN_TPU_PROBE_LIMIT   env_int     parallel.engine — bounded
 #                            linear-probe length of the hash
 #                            visited-set (default 32, min 1); one

@@ -294,15 +294,20 @@ class IndependentChecker(Checker):
     JEPSEN_TPU_PIPELINE env flag — opt-in, results identical either
     way. `dedupe` likewise threads the frontier dedupe strategy to the
     sparse device buckets (None defers to JEPSEN_TPU_DEDUPE; results
-    identical either way — engine._resolve_dedupe)."""
+    identical either way — engine._resolve_dedupe). `search_stats`
+    threads the device-resident search telemetry the same way (None
+    defers to JEPSEN_TPU_SEARCH_STATS): each keyed sub-result then
+    carries its own per-event "stats" block."""
 
     def __init__(self, checker: Checker, batch_device: bool = True,
                  pipeline: Optional[bool] = None,
-                 dedupe: Optional[str] = None):
+                 dedupe: Optional[str] = None,
+                 search_stats: Optional[bool] = None):
         self.checker = checker
         self.batch_device = batch_device
         self.pipeline = pipeline
         self.dedupe = dedupe
+        self.search_stats = search_stats
 
     def check(self, test, history, opts=None):
         opts = opts or {}
@@ -424,7 +429,8 @@ class IndependentChecker(Checker):
             with obs.span("independent.device_batch", keys=len(ks)):
                 rs = engine.check_batch(model, [subs[k] for k in ks],
                                         mesh=mesh, pipeline=self.pipeline,
-                                        dedupe=self.dedupe)
+                                        dedupe=self.dedupe,
+                                        search_stats=self.search_stats)
             return {k: {**r, "analyzer": "jax"} for k, r in zip(ks, rs)}, None
         except EncodeError as err:
             # legitimately not device-encodable (a gset key past the
@@ -490,6 +496,7 @@ def _edn_pprint(x) -> str:
 
 def checker(c: Checker, batch_device: bool = True,
             pipeline: Optional[bool] = None,
-            dedupe: Optional[str] = None) -> IndependentChecker:
+            dedupe: Optional[str] = None,
+            search_stats: Optional[bool] = None) -> IndependentChecker:
     return IndependentChecker(c, batch_device, pipeline=pipeline,
-                              dedupe=dedupe)
+                              dedupe=dedupe, search_stats=search_stats)

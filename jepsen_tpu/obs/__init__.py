@@ -36,15 +36,17 @@ the ``purity-obs-in-trace`` lint rule enforces this mechanically.
 """
 
 from jepsen_tpu.obs.export import (  # noqa: F401
-    chrome_trace, export_run, flight_dump, flight_reset, jsonl_events,
-    set_flight_dir, summary, write_chrome_trace, write_jsonl,
+    chrome_trace, drain_search_stats, export_run, flight_dump,
+    flight_reset, jsonl_events, record_search_stats,
+    search_stats_records, set_flight_dir, summary, write_chrome_trace,
+    write_jsonl, write_search_stats,
 )
 from jepsen_tpu.obs.metrics import (  # noqa: F401
     BUCKET_LADDER, Registry, counter, gauge, hist_quantile, histogram,
     registry,
 )
 from jepsen_tpu.obs.tracer import (  # noqa: F401
-    Span, Tracer, configure, ctx_runner, current_span, device_annotation,
-    enabled, flight_active, jax_profile_dir, maybe_jax_profile, reset,
-    span, timer, tracer,
+    Span, Tracer, configure, counter_sample, ctx_runner, current_span,
+    device_annotation, enabled, flight_active, jax_profile_dir,
+    maybe_jax_profile, reset, span, timer, tracer,
 )

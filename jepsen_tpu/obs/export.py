@@ -91,6 +91,18 @@ def chrome_trace(tr: Optional[_tracer.Tracer] = None,
             "dur": round(max(s.t1 - s.t0, 0.0) * 1e6, 1),
             "args": args,
         })
+    # counter tracks ("C" events): gauge levels and search-stats
+    # trajectories — Perfetto renders each name as an area chart
+    # aligned with the span tracks above (docs/observability.md
+    # "Counter tracks"). Absent entirely when nothing sampled, so a
+    # run that never touched a sampled gauge keeps its old trace file.
+    for name, t, value in tr.counters():
+        events.append({
+            "ph": "C", "pid": HOST_PID, "tid": 0, "name": name,
+            "cat": name.split(".")[0],
+            "ts": round((t - tr.epoch) * 1e6, 1),
+            "args": {"value": value},
+        })
     return events
 
 
@@ -180,6 +192,59 @@ def summary(tr: Optional[_tracer.Tracer] = None,
     return "\n".join(lines) + ("\n" if lines else "")
 
 
+# ------------------------------------------- search-stats collector
+
+# Per-key device-search stats blocks (JEPSEN_TPU_SEARCH_STATS — see
+# parallel.engine), recorded by the engines as each search finishes and
+# drained into <run_dir>/search_stats.jsonl by export_run — the record
+# `jepsen report --search` renders. Bounded: a long soak must not grow
+# host memory through its own telemetry.
+SEARCH_STATS_MAX_RECORDS = 4096
+_search_stats_lock = threading.Lock()
+_search_stats: list = []
+_search_stats_dropped = 0
+
+
+def record_search_stats(rec: dict) -> None:
+    """Append one per-key search-stats record (a JSON-serializable
+    dict). Past the bound the OLDEST record is dropped (counted):
+    streamed keys re-record their lifetime block every delta and the
+    report keeps the newest per key, so the newest evidence must be
+    the side that survives."""
+    global _search_stats_dropped
+    with _search_stats_lock:
+        if len(_search_stats) >= SEARCH_STATS_MAX_RECORDS:
+            _search_stats.pop(0)
+            _search_stats_dropped += 1
+            _metrics.counter("obs.search_stats_dropped").inc()
+        _search_stats.append(dict(rec))
+
+
+def search_stats_records() -> list:
+    with _search_stats_lock:
+        return [dict(r) for r in _search_stats]
+
+
+def drain_search_stats() -> list:
+    """Hand over the collected records and clear the buffer — the same
+    per-run semantics as the span buffer."""
+    global _search_stats
+    with _search_stats_lock:
+        out = _search_stats
+        _search_stats = []
+        return out
+
+
+def write_search_stats(path: str, records: list) -> str:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as fh:
+        for rec in records:
+            fh.write(json.dumps(rec, default=str) + "\n")
+    return path
+
+
 # registry state at the last export_run, so each run's artifacts carry
 # the metrics THIS run moved (counters as deltas), not the process's
 # cumulative totals — a `--test-count 3` / test-all loop analyzes
@@ -201,10 +266,20 @@ def export_run(run_dir: str) -> Optional[dict]:
     dir describes that run alone (and span memory stays bounded)."""
     global _last_reg_snapshot
     tr = _tracer.tracer()
+    stats_records = drain_search_stats()
     if tr is None or tr.flight_only:
         # a flight-only recorder (JEPSEN_TPU_FLIGHT_RECORDER with
         # tracing off) must not grow run-dir artifacts: its output
-        # surface is the crash dump alone
+        # surface is the crash dump alone. EXCEPT search-stats
+        # records: JEPSEN_TPU_SEARCH_STATS is its own opt-in, and the
+        # `jepsen report --search` input must land whether or not
+        # tracing was also on (stats off -> no records -> still None,
+        # byte-identical run dirs).
+        if stats_records:
+            os.makedirs(run_dir, exist_ok=True)
+            return {"search_stats": write_search_stats(
+                os.path.join(run_dir, "search_stats.jsonl"),
+                stats_records)}
         return None
     os.makedirs(run_dir, exist_ok=True)
     reg = _metrics.registry()
@@ -222,6 +297,9 @@ def export_run(run_dir: str) -> Optional[dict]:
     with open(os.path.join(run_dir, "telemetry.txt"), "w") as fh:
         fh.write(summary(tr, snap=run_snap))
     out["summary"] = os.path.join(run_dir, "telemetry.txt")
+    if stats_records:
+        out["search_stats"] = write_search_stats(
+            os.path.join(run_dir, "search_stats.jsonl"), stats_records)
     if tr.path:
         # the buffer is drained per run, so one fixed destination would
         # only ever hold the LAST run's spans in a --test-count /

@@ -123,6 +123,13 @@ def render_prometheus(snap: Optional[Dict[str, dict]] = None) -> str:
             lines.append(f'{pn}_bucket{{le="+Inf"}} {m["count"]}')
             lines.append(f"{pn}_sum {_fmt(m['total'])}")
             lines.append(f"{pn}_count {m['count']}")
+            if m.get("max") is not None:
+                # streaming-max twin (the gauge-_max precedent): a
+                # quantile landing in the +Inf bucket answers with
+                # this instead of "-" — exactly the overloaded-SLO
+                # case the quantile view exists for
+                lines.append(f"# TYPE {pn}_max gauge")
+                lines.append(f"{pn}_max {_fmt(m['max'])}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -279,6 +286,104 @@ def _fmt_bytes(n) -> str:
     return str(n)
 
 
+def parse_prometheus(body: str) -> Dict[str, dict]:
+    """Parse Prometheus text exposition (our own render_prometheus
+    output) back into snapshot-shaped dicts — enough structure for
+    hist_quantile: histograms get {"count", "total", "buckets"},
+    everything else {"value"}. Tolerates unknown lines (forward
+    compatibility beats strictness in a CLI client)."""
+    import re
+
+    types: Dict[str, str] = {}
+    out: Dict[str, dict] = {}
+    sample = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{le="([^"]*)"\})? '
+        r'([-+0-9.eE]+|\+Inf)$')
+    for ln in body.splitlines():
+        if ln.startswith("# TYPE "):
+            parts = ln.split()
+            if len(parts) >= 4:
+                types[parts[2]] = parts[3]
+            continue
+        if not ln or ln.startswith("#"):
+            continue
+        m = sample.match(ln)
+        if not m:
+            continue
+        name, le, val = m.groups()
+        if name.endswith("_bucket"):
+            base = name[: -len("_bucket")]
+            h = out.setdefault(base, {"type": "histogram", "count": 0,
+                                      "total": 0.0, "buckets": [],
+                                      "min": None, "max": None})
+            if le == "+Inf":
+                h["count"] = int(float(val))
+            elif le is not None:
+                h["buckets"].append([float(le), int(float(val))])
+            continue
+        if name.endswith("_sum") and types.get(
+                name[: -len("_sum")]) == "histogram":
+            out.setdefault(name[: -len("_sum")],
+                           {"type": "histogram", "count": 0,
+                            "total": 0.0, "buckets": [], "min": None,
+                            "max": None})["total"] = float(val)
+            continue
+        if name.endswith("_count") and types.get(
+                name[: -len("_count")]) == "histogram":
+            out.setdefault(name[: -len("_count")],
+                           {"type": "histogram", "count": 0,
+                            "total": 0.0, "buckets": [], "min": None,
+                            "max": None})["count"] = int(float(val))
+            continue
+        if name.endswith("_max") and types.get(
+                name[: -len("_max")]) == "histogram":
+            # the streaming-max twin: what hist_quantile answers with
+            # for quantiles past the bucket ladder's top
+            out.setdefault(name[: -len("_max")],
+                           {"type": "histogram", "count": 0,
+                            "total": 0.0, "buckets": [], "min": None,
+                            "max": None})["max"] = float(val)
+            continue
+        out[name] = {"type": types.get(name, "untyped"),
+                     "value": float(val)}
+    return out
+
+
+def render_metrics_summary(body: str) -> str:
+    """The `jepsen status --metrics` view: histograms as
+    p50/p95/p99 quantile lines (hist_quantile over the cumulative
+    ladder — the serve.ack_secs / serve.verdict_secs SLO answer,
+    without eyeballing raw buckets), every other sample as-is. The
+    raw exposition stays available with --raw."""
+    parsed = parse_prometheus(body)
+    lines = []
+    hists = {n: m for n, m in parsed.items()
+             if m.get("type") == "histogram"}
+    if hists:
+        lines.append(f"{'histogram':<40} {'n':>8} {'mean':>10} "
+                     f"{'p50':>10} {'p95':>10} {'p99':>10}")
+        for name in sorted(hists):
+            m = hists[name]
+            n = m["count"]
+            mean = (f"{m['total'] / n:.6g}" if n else "-")
+            qs = [_metrics.hist_quantile(m, q)
+                  for q in (0.5, 0.95, 0.99)]
+            qs = ["-" if v is None else f"{v:.6g}" for v in qs]
+            lines.append(f"{name:<40} {n:>8} {mean:>10} "
+                         f"{qs[0]:>10} {qs[1]:>10} {qs[2]:>10}")
+        lines.append("")
+    others = {n: m for n, m in parsed.items()
+              if m.get("type") != "histogram"}
+    if others:
+        lines.append(f"{'metric':<48} {'type':<10} value")
+        for name in sorted(others):
+            m = others[name]
+            v = m["value"]
+            v = int(v) if float(v).is_integer() else v
+            lines.append(f"{name:<48} {m['type']:<10} {v}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 def render_status_table(status: dict, health: dict) -> str:
     """The human summary an operator reads: one health line, one row
     per key, then service totals."""
@@ -349,8 +454,14 @@ def status_main(argv: Optional[Sequence[str]] = None) -> int:
                    help="print the raw {health, status} JSON instead "
                         "of the table")
     p.add_argument("--metrics", action="store_true",
-                   help="print the raw Prometheus /metrics text "
-                        "instead of the table")
+                   help="print a /metrics summary instead of the "
+                        "table: histograms as p50/p95/p99 (the "
+                        "serve.ack_secs / serve.verdict_secs SLO "
+                        "view), counters/gauges as-is")
+    p.add_argument("--raw", action="store_true",
+                   help="with --metrics: dump the raw Prometheus "
+                        "text exposition instead of the quantile "
+                        "summary")
     try:
         args = p.parse_args(list(argv) if argv is not None else None)
     except SystemExit as e:
@@ -372,7 +483,8 @@ def status_main(argv: Optional[Sequence[str]] = None) -> int:
                       f"{code} — not a jepsen ops endpoint?",
                       file=sys.stderr)
                 return 2
-            sys.stdout.write(body)
+            sys.stdout.write(body if args.raw
+                             else render_metrics_summary(body))
             return 0
         hcode, hbody = _fetch(base + "/healthz", args.timeout)
         _scode, sbody = _fetch(base + "/status", args.timeout)

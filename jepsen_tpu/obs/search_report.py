@@ -1,0 +1,191 @@
+"""``jepsen report --search`` — render a run's device-search telemetry
+(JEPSEN_TPU_SEARCH_STATS) into the operator table that makes ROADMAP
+items 2 and 5 executable: which keys run the visited table hottest
+(load factor -> table sizing for the tiled-VMEM work), which escalate
+capacity (re-shard candidates), and which waste the most padded rows
+(bucket-policy evidence).
+
+Input: ``search_stats.jsonl`` in a store run dir — one stats block per
+line, written by ``Store.save_telemetry`` / ``obs.export_run`` from the
+records the engines emit as each search finishes. Streamed keys emit a
+record per delta with lifetime stats; the report keeps the newest
+(most-events) record per key.
+
+Output: ``search_report.txt`` next to the input (and stdout) — a
+summary header plus worst-keys tables. Pre-parse forwarded from
+``cli.py`` exactly like lint/probe/status; exit 0 report written,
+1 no stats found, 254 usage. Import-safe: no JAX.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+
+def load_records(path: str) -> List[dict]:
+    out = []
+    with open(path) as fh:
+        for ln in fh:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                out.append(json.loads(ln))
+            except ValueError:
+                continue   # a torn line loses one record, not the report
+    return out
+
+
+def dedupe_records(records: List[dict]) -> List[dict]:
+    """One record per key, newest (most events — a streamed key's
+    lifetime grows monotonically) wins; keyless records are kept
+    as-is under synthetic indices."""
+    by_key = {}
+    anon = []
+    for i, r in enumerate(records):
+        k = r.get("key")
+        if k is None:
+            anon.append(r)
+            continue
+        kk = json.dumps(k, sort_keys=True, default=str)
+        prev = by_key.get(kk)
+        if prev is None or (r.get("events") or 0) >= \
+                (prev.get("events") or 0):
+            by_key[kk] = r
+    return list(by_key.values()) + anon
+
+
+def _fmt(v, nd: int = 4) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}g}"
+    return str(v)
+
+
+def _key_of(r: dict) -> str:
+    k = r.get("key")
+    return "-" if k is None else str(k)
+
+
+def _worst_table(rows: List[dict], field: str, title: str,
+                 limit: int = 10) -> List[str]:
+    ranked = [r for r in rows if r.get(field) is not None
+              and r.get(field)]
+    ranked.sort(key=lambda r: r[field], reverse=True)
+    if not ranked:
+        return []
+    lines = [f"## {title}", ""]
+    lines.append(f"{'key':<20} {'engine':<9} {'events':>7} "
+                 f"{'peak':>8} {field:>18}")
+    for r in ranked[:limit]:
+        lines.append(
+            f"{_key_of(r)[:20]:<20} {str(r.get('engine', '-')):<9} "
+            f"{_fmt(r.get('events')):>7} "
+            f"{_fmt(r.get('frontier-peak')):>8} "
+            f"{_fmt(r.get(field)):>18}")
+    lines.append("")
+    return lines
+
+
+def render_search_report(records: List[dict]) -> str:
+    rows = dedupe_records(records)
+    lines = ["# Search telemetry report (JEPSEN_TPU_SEARCH_STATS)", ""]
+    n_events = sum(r.get("events") or 0 for r in rows)
+    engines = {}
+    for r in rows:
+        engines[r.get("engine", "?")] = \
+            engines.get(r.get("engine", "?"), 0) + 1
+    lines.append(f"keys: {len(rows)}   events: {n_events}   "
+                 f"engines: " + ", ".join(
+                     f"{k}={v}" for k, v in sorted(engines.items())))
+    peaks = [r.get("frontier-peak") or 0 for r in rows]
+    lines.append(f"frontier peak: max={max(peaks, default=0)}   "
+                 f"escalated keys: "
+                 f"{sum(1 for r in rows if r.get('capacity-tier'))}")
+    # aggregate probe histogram over every hash-dedupe key
+    agg: dict = {}
+    for r in rows:
+        for lab, n in (r.get("probe-hist") or {}).items():
+            agg[lab] = agg.get(lab, 0) + int(n)
+    if agg:
+        total = sum(agg.values()) or 1
+        lines.append("probe lengths: " + "  ".join(
+            f"{lab}:{n} ({100.0 * n / total:.1f}%)"
+            for lab, n in agg.items() if n))
+    lines.append("")
+    lines.extend(_worst_table(rows, "load-factor-peak",
+                              "Worst keys by visited-table load "
+                              "factor"))
+    lines.extend(_worst_table(rows, "capacity-tier",
+                              "Worst keys by capacity escalations"))
+    lines.extend(_worst_table(rows, "pad-waste",
+                              "Worst keys by pad-row waste"))
+    if len(lines) == 5 and not agg:   # header only: nothing ranked
+        lines.append("(no key exceeded any threshold — no hash load, "
+                     "no escalations, no pad waste)")
+        lines.append("")
+    return "\n".join(lines) + "\n"
+
+
+def report_main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="jepsen report",
+        description="render a stored run's telemetry reports; "
+                    "--search renders search_stats.jsonl "
+                    "(JEPSEN_TPU_SEARCH_STATS) into "
+                    "search_report.txt — worst keys by visited-table "
+                    "load factor, capacity escalations, and pad-row "
+                    "waste")
+    p.add_argument("--search", action="store_true",
+                   help="render the device-search telemetry report")
+    p.add_argument("--run-dir", default=None,
+                   help="store run dir holding search_stats.jsonl "
+                        "(default: the latest stored run)")
+    p.add_argument("--stdout-only", action="store_true",
+                   help="print the report without writing "
+                        "search_report.txt")
+    try:
+        args = p.parse_args(list(argv) if argv is not None else None)
+    except SystemExit as e:
+        return 0 if e.code in (0, None) else 254
+    if not args.search:
+        print("jepsen report: nothing to render — pass --search "
+              "(the only report implemented so far)", file=sys.stderr)
+        return 254
+    run_dir = args.run_dir
+    if run_dir is None:
+        from jepsen_tpu import store as jstore
+        run_dir = jstore.latest()
+        if run_dir is None:
+            print("jepsen report: no stored runs and no --run-dir",
+                  file=sys.stderr)
+            return 1
+    path = os.path.join(run_dir, "search_stats.jsonl")
+    if not os.path.exists(path):
+        print(f"jepsen report: {path} not found — run with "
+              f"JEPSEN_TPU_SEARCH_STATS=1 so the engines record "
+              f"per-key search stats (docs/observability.md)",
+              file=sys.stderr)
+        return 1
+    records = load_records(path)
+    if not records:
+        print(f"jepsen report: {path} holds no records",
+              file=sys.stderr)
+        return 1
+    text = render_search_report(records)
+    sys.stdout.write(text)
+    if not args.stdout_only:
+        out = os.path.join(run_dir, "search_report.txt")
+        with open(out, "w") as fh:
+            fh.write(text)
+        print(f"report written to {out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(report_main())

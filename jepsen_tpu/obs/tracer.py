@@ -76,6 +76,11 @@ class Tracer:
         self.flight_only = flight_only
         self._lock = threading.Lock()
         self._spans: List["Span"] = []
+        # counter-track samples: (name, t, value) triples exported as
+        # Chrome "C" events (Perfetto counter tracks) — gauge levels
+        # (pipeline.inflight, breaker state, serve queue depth) and the
+        # search-stats trajectories line up with the span tracks
+        self._counters: List[tuple] = []
         self._ring: Optional[deque] = (deque(maxlen=ring)
                                        if ring else None)
         self.flight_baseline: Optional[dict] = None
@@ -109,14 +114,29 @@ class Tracer:
         with self._lock:
             return list(self._spans)
 
+    def record_counter(self, name: str, t: float, value) -> None:
+        """Record one counter-track sample. Flight-only recorders skip
+        it: the ring retains spans alone, and counter samples must not
+        grow unbounded state in a tracing-off process."""
+        if self.flight_only:
+            return
+        with self._lock:
+            self._counters.append((name, t, value))
+
+    def counters(self) -> List[tuple]:
+        with self._lock:
+            return list(self._counters)
+
     def drain(self) -> List["Span"]:
         """Hand over the finished spans and clear the buffer — how
         export_run keeps artifacts per-run (and memory bounded) in a
         process that analyzes several runs (`--test-count`,
-        test-all)."""
+        test-all). Counter samples clear with the spans: they share
+        the per-run window."""
         with self._lock:
             out = self._spans
             self._spans = []
+            self._counters = []
             return out
 
     def add_span(self, name: str, t0: float, t1: float,
@@ -316,6 +336,23 @@ def timer(name: str, **args) -> Span:
     if st is _UNSET:
         st = _resolve()
     return Span(st, name, args)
+
+
+def counter_sample(name: str, value, t: Optional[float] = None) -> None:
+    """Record one sample on a Perfetto counter track (a Chrome "C"
+    event at export time): a gauge level, a queue depth, a breaker
+    state, a frontier width. No-op when full tracing is off — the
+    disabled path is one attribute load and a None/flight check, the
+    same hot-path standard as span(). ``t`` (a perf_counter() read)
+    backdates the sample — the search-stats exporter synthesizes a
+    time axis across a device search's span window."""
+    st = _state
+    if st is _UNSET:
+        st = _resolve()
+    if st is None or st.flight_only:
+        return
+    st.record_counter(name, t if t is not None else perf_counter(),
+                      value)
 
 
 def configure(on: bool = True, path: str = "",

@@ -169,7 +169,8 @@ def _resolve_use_pallas(use_pallas, S: int, C: int, platform: str):
 def _bitdense_impl(xs, state0, step_name: str, S: int, C: int,
                    lo: int = -1, use_pallas: bool = False,
                    pallas_interpret: bool = True,
-                   closure_mode: str = "while"):
+                   closure_mode: str = "while",
+                   search_stats: bool = False):
     step = STEPS[step_name]
     W, plan = _plan(C)
     state_codes = jnp.arange(S, dtype=jnp.int32) + lo
@@ -273,9 +274,12 @@ def _bitdense_impl(xs, state0, step_name: str, S: int, C: int,
         B, ok, fail_r, r_idx = carry
         run = ok & (ev["ev_slot"] >= 0)
         sel = compute_sel(ev)
+        iters = jnp.int32(-1)   # unknown unless a counted loop ran
         if use_pallas:
             # the entire fixpoint runs inside one VMEM-resident pallas
-            # kernel (parallel.pallas_kernels); skipped on pad events
+            # kernel (parallel.pallas_kernels); skipped on pad events.
+            # Its iteration count never leaves the kernel — the stats
+            # block reports closure-iters -1 (unknown) on this path.
             from jepsen_tpu.parallel import pallas_kernels as pk
             B2 = lax.cond(
                 run,
@@ -293,6 +297,20 @@ def _bitdense_impl(xs, state0, step_name: str, S: int, C: int,
             expand = make_expand(sel)
             B2 = lax.fori_loop(0, (C + 1) // 2,
                                lambda _, b: expand(expand(b)), B)
+            iters = jnp.int32(2 * ((C + 1) // 2))
+        elif search_stats:
+            # counted variant of the while closure: same fixpoint,
+            # plus the double-expansion count (x2 = expansions) the
+            # stats block reports
+            body = make_closure_body(sel)
+
+            def body_n(c):
+                B2, changed = body((c[0], c[1]))
+                return B2, changed, c[2] + 1
+
+            B2, _, n = lax.while_loop(lambda c: c[1], body_n,
+                                      (B, run, jnp.int32(0)))
+            iters = 2 * n
         else:
             B2, _ = lax.while_loop(closure_cond, make_closure_body(sel),
                                    (B, run))
@@ -303,12 +321,23 @@ def _bitdense_impl(xs, state0, step_name: str, S: int, C: int,
         B_o = jnp.where(run, B3, B)
         ok_o = jnp.where(run, ~failed_here, ok)
         fail_o = jnp.where(failed_here & (fail_r < 0), r_idx, fail_r)
-        return (B_o, ok_o, fail_o, r_idx + 1), jnp.uint8(0)
+        carry_o = (B_o, ok_o, fail_o, r_idx + 1)
+        if not search_stats:
+            return carry_o, jnp.uint8(0)
+        # frontier width = popcount of the post-filter reachable-set
+        # tensor — the dense engine's exact live-config count
+        width = jnp.sum(lax.population_count(B3)).astype(jnp.int32)
+        return carry_o, {
+            "width": jnp.where(run, width, -1).astype(jnp.int32),
+            "iters": jnp.where(run, iters, 0).astype(jnp.int32),
+        }
 
     B0 = jnp.zeros((S, W), U32).at[state0 - lo, 0].set(U32(1))
     carry0 = (B0, jnp.array(True), jnp.int32(-1), jnp.int32(0))
-    (B, ok, fail_r, _), _ = lax.scan(scan_step, carry0, xs)
+    (B, ok, fail_r, _), ys = lax.scan(scan_step, carry0, xs)
     valid = ok & jnp.any(B != 0)
+    if search_stats:
+        return valid, fail_r, ys
     return valid, fail_r
 
 
@@ -322,18 +351,20 @@ _check_bitdense = jax.jit(_bitdense_impl,
                           static_argnames=("step_name", "S", "C", "lo",
                                            "use_pallas",
                                            "pallas_interpret",
-                                           "closure_mode"))
+                                           "closure_mode",
+                                           "search_stats"))
 
 
 # same donation decision as _check_bitdense above
 @functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
                    static_argnames=("step_name", "S", "C", "lo",
                                     "use_pallas", "pallas_interpret",
-                                    "closure_mode"))
+                                    "closure_mode", "search_stats"))
 def _check_bitdense_batch(xs, state0, step_name: str, S: int, C: int,
                           lo: int = -1, use_pallas: bool = False,
                           pallas_interpret: bool = True,
-                          closure_mode: str = "while"):
+                          closure_mode: str = "while",
+                          search_stats: bool = False):
     # under vmap the per-event lax.cond around the pallas closure
     # becomes run-both-and-select, so pad events cost one extra kernel
     # run per key — harmless: their result is discarded by the select
@@ -341,7 +372,8 @@ def _check_bitdense_batch(xs, state0, step_name: str, S: int, C: int,
         lambda x, s0: _bitdense_impl(x, s0, step_name, S, C, lo,
                                      use_pallas=use_pallas,
                                      pallas_interpret=pallas_interpret,
-                                     closure_mode=closure_mode)
+                                     closure_mode=closure_mode,
+                                     search_stats=search_stats)
     )(xs, state0)
 
 
@@ -349,10 +381,44 @@ def n_states(e: EncodedHistory) -> int:
     return e.n_states
 
 
+def _stats_block_bitdense(ys, S: int, C: int,
+                          extra: dict = None) -> dict:
+    """The bitdense arm of the JEPSEN_TPU_SEARCH_STATS block: the
+    reachable-set tensor IS a complete visited set, so the trajectory
+    is the per-event popcount (exact live-config count) and occupancy
+    is measured against the S * 2^C config space. Hash-table fields
+    stay None — there is no table on this engine, and the uniform
+    schema keeps the sinks' consumers simple."""
+    w = np.asarray(ys["width"]).reshape(-1)
+    real = w >= 0
+    widths = [int(x) for x in w[real]]
+    iters = [int(x) for x in np.asarray(ys["iters"]).reshape(-1)[real]]
+    peak = max(widths, default=0)
+    space = S * (1 << C)
+    block = {
+        "engine": "bitdense",
+        "events": len(widths),
+        "frontier-width": widths,
+        "closure-iters": iters,
+        "frontier-peak": peak,
+        "config-space": space,
+        "peak-occupancy": round(peak / space, 9) if space else None,
+        "dedupe": "dense",
+        "delta-split-ratio": None,
+        "table-capacity": None,
+        "load-factor-peak": None,
+        "probe-hist": None,
+    }
+    if extra:
+        block.update(extra)
+    return block
+
+
 def check_encoded_bitdense(e: EncodedHistory,
                            use_pallas: bool = None,
                            closure_mode: str = None,
-                           timings: dict = None) -> dict:
+                           timings: dict = None,
+                           search_stats: bool = None) -> dict:
     """Single-key bit-packed check. `use_pallas` routes the closure
     through the VMEM-resident pallas kernel (parallel.pallas_kernels);
     default: ON for a real-TPU platform (r5 on-chip A/B verdict;
@@ -369,6 +435,9 @@ def check_encoded_bitdense(e: EncodedHistory,
     (timings=None) path is untouched."""
     if e.n_returns == 0:
         return {"valid?": True, "engine": "bitdense"}
+    from time import perf_counter
+
+    from jepsen_tpu.parallel import engine as eng_mod
     from jepsen_tpu.parallel.dense import _xs_dense
     S = n_states(e)
     C = max(5, e.n_slots)  # at least one full word
@@ -376,27 +445,32 @@ def check_encoded_bitdense(e: EncodedHistory,
     use_pallas, interpret = _resolve_use_pallas(
         use_pallas, S, C, platform)
     closure_mode = _resolve_closure_mode(closure_mode, use_pallas)
+    ss = eng_mod._resolve_search_stats(search_stats)
     xs = _xs_dense(e, C)
     if timings is not None:
-        from time import perf_counter
         t0 = perf_counter()
         xs = {k: jnp.asarray(v) for k, v in xs.items()}
         jax.block_until_ready(xs)
         timings["transfer_secs"] = perf_counter() - t0
         t0 = perf_counter()
+    ts0 = perf_counter()
     with obs.span("bitdense.check", S=S, C=C), \
             obs.device_annotation(f"bitdense single S{S} C{C}"):
         def _search():
-            valid, fail_r = _check_bitdense(xs, jnp.int32(e.state0),
-                                            e.step_name, S, C,
-                                            e.state_lo, use_pallas,
-                                            interpret, closure_mode)
+            out = _check_bitdense(xs, jnp.int32(e.state0),
+                                  e.step_name, S, C,
+                                  e.state_lo, use_pallas,
+                                  interpret, closure_mode, ss)
             # bool() materializes: async failures/hangs surface inside
             # the supervised window (the device wait ends here)
+            if ss:
+                valid, fail_r, ys = out
+                return bool(valid), fail_r, jax.tree.map(np.asarray, ys)
+            valid, fail_r = out
             return bool(valid), fail_r
 
-        valid_b, fail_r = sup.dispatch("dispatch", _search,
-                                       backend=platform)
+        res = sup.dispatch("dispatch", _search, backend=platform)
+        valid_b, fail_r = res[0], res[1]
     if timings is not None:
         timings["device_secs"] = perf_counter() - t0
     out = {"valid?": valid_b, "engine": "bitdense",
@@ -408,6 +482,9 @@ def check_encoded_bitdense(e: EncodedHistory,
            "dedupe": "dense",
            "closure": "pallas" if use_pallas
            else f"xla-{closure_mode}"}
+    if ss:
+        out["stats"] = eng_mod.finish_stats_block(
+            _stats_block_bitdense(res[2], S, C), ts0, perf_counter())
     if not out["valid?"]:
         from jepsen_tpu.parallel.encode import fail_op_fields
         out.update(fail_op_fields(e, int(fail_r)))
@@ -506,12 +583,14 @@ class PendingBitdenseBatch:
 
     def __init__(self, encs, xs, state0, S, C, up, interpret, mode,
                  n_dev, use_pallas_arg, closure_mode_arg,
-                 transfer_secs, platform=None):
+                 transfer_secs, platform=None, R=None,
+                 search_stats: bool = False):
         self.encs = encs
         self.xs = xs
         self.state0 = state0
         self.S = S
         self.C = C
+        self.R = R if R is not None else max(e.n_returns for e in encs)
         self.up = up
         self.interpret = interpret
         self.mode = mode
@@ -520,9 +599,12 @@ class PendingBitdenseBatch:
         self.closure_mode_arg = closure_mode_arg
         self.transfer_secs = transfer_secs
         self.platform = platform
+        self.search_stats = bool(search_stats)
         self.device_wait_secs = None
         self.note = None
         self._results = None
+        self._ys = None
+        self._t_issue = None
         self._issue()
 
     def _issue(self):
@@ -532,6 +614,8 @@ class PendingBitdenseBatch:
         # Built OUTSIDE the try: a telemetry/env-flag error (e.g. a
         # malformed JEPSEN_TPU_JAX_PROFILE) must surface as itself,
         # not be misdiagnosed as a pallas closure failure
+        from time import perf_counter
+        self._t_issue = perf_counter()
         ann = obs.device_annotation(
             f"bitdense K{len(self.encs)} S{self.S} C{self.C}")
         try:
@@ -540,13 +624,18 @@ class PendingBitdenseBatch:
                 # here, the breaker records the outcome; the program is
                 # ISSUED inside the window, the async wait is
                 # finalize()'s own supervised window
-                self._valid, self._fail_r = sup.dispatch(
+                out = sup.dispatch(
                     "dispatch",
                     lambda: _check_bitdense_batch(
                         self.xs, self.state0, self.encs[0].step_name,
                         self.S, self.C, self.encs[0].state_lo, self.up,
-                        self.interpret, self.mode),
+                        self.interpret, self.mode,
+                        search_stats=self.search_stats),
                     backend=self.platform)
+                if self.search_stats:
+                    self._valid, self._fail_r, self._ys = out
+                else:
+                    self._valid, self._fail_r = out
         except Exception:  # noqa: BLE001 — see _fallback_or_raise
             self._fallback_or_raise()
 
@@ -601,13 +690,17 @@ class PendingBitdenseBatch:
                      f"mesh ({type(err).__name__}); fell back to the "
                      f"xla-{self.mode} closure (multi-device Mosaic "
                      f"lowering is unmeasured)")
-        self._valid, self._fail_r = sup.dispatch(
+        out = sup.dispatch(
             "dispatch",
             lambda: _check_bitdense_batch(
                 self.xs, self.state0, self.encs[0].step_name, self.S,
                 self.C, self.encs[0].state_lo, False, self.interpret,
-                self.mode),
+                self.mode, search_stats=self.search_stats),
             backend=self.platform)
+        if self.search_stats:
+            self._valid, self._fail_r, self._ys = out
+        else:
+            self._valid, self._fail_r = out
 
     def finalize(self) -> list:
         if self._results is not None:
@@ -631,13 +724,31 @@ class PendingBitdenseBatch:
                 fail_r = np.asarray(self._fail_r)
         self.device_wait_secs = tm.wall
         closure = "pallas" if self.up else f"xla-{self.mode}"
+        ys = None
+        if self.search_stats and self._ys is not None:
+            import jax as _jax
+            ys = _jax.tree.map(np.asarray, self._ys)
         out = []
+        from time import perf_counter
+        t1 = perf_counter()
         for k, e in enumerate(self.encs):
             r = {"valid?": bool(valid[k]), "engine": "bitdense",
                  "dedupe": "dense",  # complete visited set by
                  "closure": closure}  # construction (see check_encoded)
             if self.note is not None:
                 r["closure-note"] = self.note
+            if ys is not None:
+                from jepsen_tpu.parallel import engine as eng_mod
+                waste = 1.0 - ((e.n_returns * max(5, e.n_slots))
+                               / max(1, self.R * self.C))
+                block = _stats_block_bitdense(
+                    {"width": ys["width"][k], "iters": ys["iters"][k]},
+                    self.S, self.C,
+                    extra={"pad-waste": round(waste, 6),
+                           "pad-events": int(self.R - e.n_returns),
+                           "pad-slots": int(self.C - max(5, e.n_slots))})
+                r["stats"] = eng_mod.finish_stats_block(
+                    block, self._t_issue, t1, key=k)
             if not r["valid?"]:
                 from jepsen_tpu.parallel.encode import fail_op_fields
                 r.update(fail_op_fields(e, int(fail_r[k])))
@@ -650,7 +761,9 @@ def dispatch_batch_bitdense(encs, mesh=None, use_pallas: bool = None,
                             closure_mode: str = None,
                             min_states: int = 0,
                             min_slots: int = 5,
-                            min_returns: int = 0) -> PendingBitdenseBatch:
+                            min_returns: int = 0,
+                            search_stats: bool = None
+                            ) -> PendingBitdenseBatch:
     """Pad, place, and ISSUE a batched per-key check without consuming
     the results — returns a PendingBitdenseBatch whose finalize()
     blocks and builds the per-key dicts.
@@ -691,14 +804,18 @@ def dispatch_batch_bitdense(encs, mesh=None, use_pallas: bool = None,
     # (tests/test_pallas.py).
     up, interpret = _resolve_use_pallas(use_pallas, S, C, platform)
     mode = _resolve_closure_mode(closure_mode, up)
+    from jepsen_tpu.parallel import engine as eng_mod
+    ss = eng_mod._resolve_search_stats(search_stats)
     n_dev = 1 if mesh is None else int(np.asarray(mesh.devices).size)
     return PendingBitdenseBatch(encs, xs, state0, S, C, up, interpret,
                                 mode, n_dev, use_pallas, closure_mode,
-                                transfer_secs, platform=platform)
+                                transfer_secs, platform=platform,
+                                R=R, search_stats=ss)
 
 
 def check_batch_bitdense(encs, mesh=None, use_pallas: bool = None,
-                         closure_mode: str = None) -> list:
+                         closure_mode: str = None,
+                         search_stats: bool = None) -> list:
     """Batched per-key check. Callers must ensure the COMBINED padded
     dims fit (fits_bitdense(max S, max C)) — individually-fitting keys
     can combine into an over-budget program; engine.check_batch does
@@ -711,4 +828,5 @@ def check_batch_bitdense(encs, mesh=None, use_pallas: bool = None,
     if not encs:
         return []
     return dispatch_batch_bitdense(encs, mesh=mesh, use_pallas=use_pallas,
-                                   closure_mode=closure_mode).finalize()
+                                   closure_mode=closure_mode,
+                                   search_stats=search_stats).finalize()

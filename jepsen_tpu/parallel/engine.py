@@ -121,6 +121,45 @@ def _next_pow2(n: int) -> int:
     return 1 << max(1, (int(n) - 1).bit_length())
 
 
+def _resolve_search_stats(search_stats) -> bool:
+    """JEPSEN_TPU_SEARCH_STATS: device-resident search telemetry.
+    Strict tri-state (envflags.env_bool), default OFF — the stats-off
+    results, bench schema, and trace output are byte-identical to the
+    pre-stats engine (parity-pinned). When on, every engine jit
+    additionally returns a compact per-event stats block computed ON
+    DEVICE (frontier width, closure iterations, delta split, hash
+    load, probe-length histogram — docs/observability.md "Search
+    telemetry"); an explicit argument wins over the env flag, the
+    same contract as the other perf/telemetry knobs."""
+    if search_stats is None:
+        return bool(envflags.env_bool("JEPSEN_TPU_SEARCH_STATS",
+                                      default=False))
+    return bool(search_stats)
+
+
+# Probe-length histogram buckets (final linear-probe offset per
+# attempted insert): upper-exclusive split at these edges, plus an
+# overflow bucket. Labels are the host-side vocabulary — the result
+# "stats" dict, the engine.search.probe_len.* counters, and the bench
+# line all use them, so the device bucketing and every sink agree.
+PROBE_HIST_EDGES = (1, 2, 4, 8, 16, 32)
+PROBE_HIST_LABELS = ("0", "1", "2-3", "4-7", "8-15", "16-31", "32+")
+N_PROBE_BUCKETS = len(PROBE_HIST_LABELS)
+
+
+def _probe_hist(off, attempted):
+    """Bucketed histogram [N_PROBE_BUCKETS] of final probe offsets for
+    the attempted inserts — the per-iteration increment the stats
+    closure accumulates. Scalar-comparison bucketing (not a
+    searchsorted over an edge array): this function runs INSIDE the
+    fused pallas kernel, which cannot capture non-scalar constants."""
+    idx = jnp.zeros_like(off)
+    for edge in PROBE_HIST_EDGES:
+        idx = idx + (off >= edge).astype(jnp.int32)
+    return jnp.zeros(N_PROBE_BUCKETS, jnp.int32).at[
+        jnp.where(attempted, idx, N_PROBE_BUCKETS)].add(1, mode="drop")
+
+
 def _resolve_dedupe(dedupe: Optional[str]) -> str:
     """The frontier dedupe strategy: "sort" (lexsort + adjacent-compare,
     the historical path) or "hash" (delta-frontier closure over a
@@ -171,10 +210,12 @@ def _hash_insert(c_st, c_ml, c_mh, c_live, table, probe_limit: int):
     probes (<= 2*probe_limit rounds: every pending candidate resolves
     or advances at least every second round).
 
-    Returns (table', fresh, overflow): `fresh` flags candidates that
-    claimed a slot (first sighting), `overflow` that some candidate
-    exhausted its probes — the caller escalates capacity, it never
-    silently drops a config."""
+    Returns (table', fresh, overflow, off): `fresh` flags candidates
+    that claimed a slot (first sighting), `overflow` that some
+    candidate exhausted its probes — the caller escalates capacity, it
+    never silently drops a config. `off` is each candidate's final
+    probe offset (the stats path histograms it; other callers ignore
+    it — dead code under jit)."""
     t_st, t_ml, t_mh, t_occ = table
     M = c_st.shape[0]
     T = t_st.shape[0]
@@ -210,11 +251,12 @@ def _hash_insert(c_st, c_ml, c_mh, c_live, table, probe_limit: int):
     out = lax.while_loop(cond, body, {
         "table": (t_st, t_ml, t_mh, t_occ), "pending": c_live,
         "off": jnp.zeros(M, jnp.int32), "fresh": jnp.zeros(M, bool)})
-    return out["table"], out["fresh"], jnp.any(out["pending"])
+    return out["table"], out["fresh"], jnp.any(out["pending"]), out["off"]
 
 
 def _hash_insert_append(c_st, c_ml, c_mh, c_live, st, ml, mh, count,
-                        table, probe_limit: int, N: int):
+                        table, probe_limit: int, N: int,
+                        stats: bool = False):
     """_hash_insert plus the contiguous append of the fresh rows after
     `count` — one closure iteration's whole visited-set transaction.
     Shared verbatim by the XLA hash path, the fused frontier kernel
@@ -225,20 +267,26 @@ def _hash_insert_append(c_st, c_ml, c_mh, c_live, st, ml, mh, count,
     Returns (st2, ml2, mh2, table2, count2, n_fresh, ovf): `ovf` is
     probe exhaustion OR the append running past the N-row frontier
     (rows past N scatter-drop; the flag aborts before anything
-    consumes them)."""
-    table2, fresh, p_ovf = _hash_insert(c_st, c_ml, c_mh, c_live, table,
-                                        probe_limit)
+    consumes them). With `stats` (static; JEPSEN_TPU_SEARCH_STATS), an
+    eighth element: the bucketed probe-length histogram
+    [N_PROBE_BUCKETS] of this transaction's attempted inserts."""
+    table2, fresh, p_ovf, off = _hash_insert(c_st, c_ml, c_mh, c_live,
+                                             table, probe_limit)
     n_fresh = jnp.sum(fresh)
     pos = jnp.where(fresh, count + jnp.cumsum(fresh) - 1, N)
     st2 = st.at[pos].set(c_st, mode="drop")
     ml2 = ml.at[pos].set(c_ml, mode="drop")
     mh2 = mh.at[pos].set(c_mh, mode="drop")
-    return (st2, ml2, mh2, table2, jnp.minimum(count + n_fresh, N),
-            n_fresh, p_ovf | (count + n_fresh > N))
+    out = (st2, ml2, mh2, table2, jnp.minimum(count + n_fresh, N),
+           n_fresh, p_ovf | (count + n_fresh > N))
+    if stats:
+        return out + (_probe_hist(off, c_live),)
+    return out
 
 
 def _hash_event_closure(step_cc, ev, st, ml, mh, live, run, N: int,
-                        C: int, T: int, probe_limit: int):
+                        C: int, T: int, probe_limit: int,
+                        stats: bool = False):
     """The whole per-event delta-frontier closure (dedupe="hash") on
     plain arrays: seed the fresh visited set with the live frontier
     (compacting it in the same pass — post-filter frontiers have
@@ -250,12 +298,18 @@ def _hash_event_closure(step_cc, ev, st, ml, mh, live, run, N: int,
     over VMEM-resident values), so the two cannot diverge.
 
     Returns (st2, ml2, mh2, count, ovf, iters, stepped) with `stepped`
-    the configs expanded during THIS event's closure."""
+    the configs expanded during THIS event's closure. With `stats`
+    (static), two more: `swork` — the configs a SORT closure would
+    have stepped for the same event (whole frontier per iteration; the
+    delta-split ratio's denominator) — and the probe-length histogram
+    [N_PROBE_BUCKETS] accumulated over the seed insert and every
+    iteration's transaction."""
     bit_lo, bit_hi = _slot_bits(C)
-    st0, ml0, mh0, table, m0, _, p0 = _hash_insert_append(
+    seed = _hash_insert_append(
         st, ml, mh, live, jnp.zeros(N, jnp.int32),
         jnp.zeros(N, jnp.uint32), jnp.zeros(N, jnp.uint32),
-        jnp.int32(0), _empty_table(T), probe_limit, N)
+        jnp.int32(0), _empty_table(T), probe_limit, N, stats=stats)
+    st0, ml0, mh0, table, m0, _, p0 = seed[:7]
 
     def cond(c):
         return c["changed"] & ~c["ovf"]
@@ -271,27 +325,40 @@ def _hash_event_closure(step_cc, ev, st, ml, mh, live, run, N: int,
                    | (mh[:, None] & bit_hi[None, :])) != 0
         legal = (delta[:, None] & ev["slot_occ"][None, :]
                  & ~already & cand_ok)
-        st2, ml2, mh2, table2, count2, n_fresh, ins_ovf = \
-            _hash_insert_append(
-                cand_st.reshape(-1),
-                (ml[:, None] | bit_lo[None, :]).reshape(-1),
-                (mh[:, None] | bit_hi[None, :]).reshape(-1),
-                legal.reshape(-1), st, ml, mh, count, c["table"],
-                probe_limit, N)
-        return {"st": st2, "ml": ml2, "mh": mh2,
-                "n_old": count, "count": count2, "table": table2,
-                "changed": n_fresh > 0,
-                "ovf": c["ovf"] | ins_ovf,
-                "iters": c["iters"] + 1,
-                "stepped": c["stepped"] + (count - n_old)}
+        ins = _hash_insert_append(
+            cand_st.reshape(-1),
+            (ml[:, None] | bit_lo[None, :]).reshape(-1),
+            (mh[:, None] | bit_hi[None, :]).reshape(-1),
+            legal.reshape(-1), st, ml, mh, count, c["table"],
+            probe_limit, N, stats=stats)
+        st2, ml2, mh2, table2, count2, n_fresh, ins_ovf = ins[:7]
+        out = {"st": st2, "ml": ml2, "mh": mh2,
+               "n_old": count, "count": count2, "table": table2,
+               "changed": n_fresh > 0,
+               "ovf": c["ovf"] | ins_ovf,
+               "iters": c["iters"] + 1,
+               "stepped": c["stepped"] + (count - n_old)}
+        if stats:
+            # swork: what sort would have re-stepped — the WHOLE live
+            # frontier this iteration, not just the delta
+            out["swork"] = c["swork"] + count
+            out["phist"] = c["phist"] + ins[7]
+        return out
 
-    out = lax.while_loop(cond, body, {
+    carry0 = {
         "st": st0, "ml": ml0, "mh": mh0,
         "n_old": jnp.int32(0), "count": m0, "table": table,
         "changed": run, "ovf": p0, "iters": jnp.int32(0),
-        "stepped": jnp.int32(0)})
-    return (out["st"], out["ml"], out["mh"], out["count"], out["ovf"],
+        "stepped": jnp.int32(0)}
+    if stats:
+        carry0["swork"] = jnp.int32(0)
+        carry0["phist"] = seed[7]
+    out = lax.while_loop(cond, body, carry0)
+    base = (out["st"], out["ml"], out["mh"], out["count"], out["ovf"],
             out["iters"], out["stepped"])
+    if stats:
+        return base + (out["swork"], out["phist"])
+    return base
 
 
 def _slot_bits(C: int):
@@ -344,7 +411,8 @@ def _initial_carry(state0, N: int):
 
 def _scan_step_factory(step_name: str, N: int, C: int,
                        dedupe: str = "sort", probe_limit: int = 0,
-                       sparse_pallas: str = "off"):
+                       sparse_pallas: str = "off",
+                       search_stats: bool = False):
     """The per-return-event scan step, parameterized by model step,
     frontier capacity, slot-window width, and dedupe strategy. Shared
     by the one-shot and the resumable (checkpointed) entry points.
@@ -378,7 +446,17 @@ def _scan_step_factory(step_name: str, N: int, C: int,
 
     Both strategies accumulate a configs-stepped counter (sort: the
     whole live frontier per iteration; hash: the delta) — the counter
-    that makes the delta win measurable even on CPU advisory runs."""
+    that makes the delta win measurable even on CPU advisory runs.
+
+    `search_stats` (static; JEPSEN_TPU_SEARCH_STATS) switches the
+    scan's per-event output from the bare overflow flag to a dict of
+    per-event device-computed stats: post-filter frontier width (-1 on
+    events that did not run — pads, post-failure), closure peak (the
+    pre-filter frontier, which under hash equals the visited-table
+    occupancy), iterations, per-event configs-stepped, the
+    sort-equivalent work (delta-split denominator), and the bucketed
+    probe-length histogram (zeros under sort). Verdict-carrying
+    outputs are untouched — stats-on/off parity is pinned."""
     step = STEPS[step_name]
     bit_lo, bit_hi = _slot_bits(C)
     if probe_limit <= 0:
@@ -420,30 +498,38 @@ def _scan_step_factory(step_name: str, N: int, C: int,
                     iters + 1, stepped + old_count)
         return body
 
+    zero_hist = jnp.zeros(N_PROBE_BUCKETS, jnp.int32)
+
     def run_closure(ev, st, ml, mh, live, run, stepped):
-        """-> (st2, ml2, mh2, live2, ovf, iters, stepped2)."""
+        """-> (st2, ml2, mh2, live2, ovf, iters, stepped2, extras)
+        where extras is (swork_delta, probe_hist) under search_stats
+        (sort: swork == the stepped delta, hist zeros) and None
+        otherwise."""
         if dedupe == "sort":
             st2, ml2, mh2, live2, _, ovf, iters, stepped2 = \
                 lax.while_loop(
                     closure_cond, make_closure_body(ev),
                     (st, ml, mh, live, run, jnp.array(False),
                      jnp.int32(0), stepped))
-            return st2, ml2, mh2, live2, ovf, iters, stepped2
+            extras = ((stepped2 - stepped, zero_hist)
+                      if search_stats else None)
+            return st2, ml2, mh2, live2, ovf, iters, stepped2, extras
         if sparse_pallas != "off":
             # the fused kernel: the whole per-event closure inside one
             # pallas_call, frontier + table + slot tables VMEM-resident
             from jepsen_tpu.parallel import sparse_kernels as sk
-            st2, ml2, mh2, count, ovf, iters, d = \
-                sk.frontier_closure_call(
-                    step_name, ev, st, ml, mh, live, run, N, C,
-                    probe_limit,
-                    interpret=(sparse_pallas == "interpret"))
+            out = sk.frontier_closure_call(
+                step_name, ev, st, ml, mh, live, run, N, C,
+                probe_limit, interpret=(sparse_pallas == "interpret"),
+                stats=search_stats)
         else:
-            st2, ml2, mh2, count, ovf, iters, d = _hash_event_closure(
+            out = _hash_event_closure(
                 step_cc, ev, st, ml, mh, live, run, N, C, T,
-                probe_limit)
+                probe_limit, stats=search_stats)
+        st2, ml2, mh2, count, ovf, iters, d = out[:7]
+        extras = (out[7], out[8]) if search_stats else None
         live2 = jnp.arange(N) < count
-        return st2, ml2, mh2, live2, ovf, iters, stepped + d
+        return st2, ml2, mh2, live2, ovf, iters, stepped + d, extras
 
     def scan_step(carry, ev):
         st, ml, mh, live, ok, fail_r, r_idx, maxf, steps_n, stepped = carry
@@ -452,7 +538,7 @@ def _scan_step_factory(step_name: str, N: int, C: int,
 
         # closure: expand until no new configs (skipped when run=False:
         # the initial `changed` flag is `run`)
-        st2, ml2, mh2, live2, ovf, iters, stepped2 = run_closure(
+        st2, ml2, mh2, live2, ovf, iters, stepped2, extras = run_closure(
             ev, st, ml, mh, live, run, stepped)
         # the hash prologue runs unconditionally (lax.scan cannot skip
         # an event) — a pad/settled event's probe flag must not leak
@@ -497,29 +583,51 @@ def _scan_step_factory(step_name: str, N: int, C: int,
         # batched form interleaves per-key pads) resumes at the right
         # event. Identical for the historical paths — their pads only
         # ever trail the last real event.
-        return (st_o, ml_o, mh_o, live_o, new_ok, new_fail,
-                r_idx + jnp.where(is_pad, 0, 1), maxf, steps_n,
-                stepped_o), ovf
+        carry_o = (st_o, ml_o, mh_o, live_o, new_ok, new_fail,
+                   r_idx + jnp.where(is_pad, 0, 1), maxf, steps_n,
+                   stepped_o)
+        if not search_stats:
+            return carry_o, ovf
+        # per-event device stats: width -1 marks "did not run" (pad or
+        # post-failure) — the host filters on it, so padded chunks and
+        # batch interleaving need no extra bookkeeping
+        y = {
+            "ovf": ovf,
+            "width": jnp.where(run, n_live, -1).astype(jnp.int32),
+            "peak": jnp.where(run, jnp.sum(live2), 0).astype(jnp.int32),
+            "iters": jnp.where(run, iters, 0).astype(jnp.int32),
+            "stepped": jnp.where(run, stepped2 - stepped,
+                                 0).astype(jnp.int32),
+            "swork": jnp.where(run, extras[0], 0).astype(jnp.int32),
+            "phist": jnp.where(run, extras[1], 0).astype(jnp.int32),
+        }
+        return carry_o, y
 
     return scan_step
 
 
 def _check_impl(xs, state0, step_name: str, N: int,
                 dedupe: str = "sort", probe_limit: int = 0,
-                sparse_pallas: str = "off"):
+                sparse_pallas: str = "off",
+                search_stats: bool = False):
     """Scan over all return events from scratch. xs: dict of [R, ...]
     arrays. Returns (valid, fail_event, overflow, max_frontier,
-    steps_evaluated, configs_stepped)."""
+    steps_evaluated, configs_stepped) — plus, under `search_stats`,
+    the per-event stats dict of [R]-stacked arrays."""
     C = xs["slot_f"].shape[1]
     carry0 = _initial_carry(state0, N)
-    carry, ovfs = lax.scan(
+    carry, ys = lax.scan(
         _scan_step_factory(step_name, N, C, dedupe, probe_limit,
-                           sparse_pallas),
+                           sparse_pallas, search_stats),
         carry0, xs)
     _, _, _, live, ok, fail_r, _, maxf, steps_n, stepped = carry
+    ovfs = ys["ovf"] if search_stats else ys
     overflow = jnp.any(ovfs)
     valid = ok & (jnp.sum(live) > 0) & ~overflow
-    return valid, fail_r, overflow, maxf, steps_n, stepped
+    base = (valid, fail_r, overflow, maxf, steps_n, stepped)
+    if search_stats:
+        return base + (ys,)
+    return base
 
 
 # donation decision (recompile-donate-argnums) for the three jits
@@ -532,49 +640,62 @@ def _check_impl(xs, state0, step_name: str, N: int,
 # reclaim either.
 @functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
                    static_argnames=("step_name", "N", "dedupe",
-                                    "probe_limit", "sparse_pallas"))
+                                    "probe_limit", "sparse_pallas",
+                                    "search_stats"))
 def _check_device_resumable(xs, carry0, step_name: str, N: int,
                             dedupe: str = "sort", probe_limit: int = 0,
-                            sparse_pallas: str = "off"):
+                            sparse_pallas: str = "off",
+                            search_stats: bool = False):
     """One chunk of events from an explicit carry; returns the final
     carry plus the overflow flag so the host can checkpoint between
-    chunks."""
+    chunks. Under `search_stats` a third output: the chunk's
+    per-event stats dict (pad rows carry width=-1; the stats arrays
+    are per-call scan outputs, NOT part of the carry, so checkpoints
+    and their format are untouched)."""
     C = xs["slot_f"].shape[1]
-    carry, ovfs = lax.scan(
+    carry, ys = lax.scan(
         _scan_step_factory(step_name, N, C, dedupe, probe_limit,
-                           sparse_pallas),
+                           sparse_pallas, search_stats),
         carry0, xs)
-    return carry, jnp.any(ovfs)
+    if search_stats:
+        return carry, jnp.any(ys["ovf"]), ys
+    return carry, jnp.any(ys)
 
 
 # same donation decision as _check_device_resumable above
 # jepsen-lint: disable=recompile-donate-argnums
 _check_device = jax.jit(_check_impl,
                         static_argnames=("step_name", "N", "dedupe",
-                                         "probe_limit", "sparse_pallas"))
+                                         "probe_limit", "sparse_pallas",
+                                         "search_stats"))
 
 
 # same donation decision as _check_device_resumable above
 @functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
                    static_argnames=("step_name", "N", "dedupe",
-                                    "probe_limit", "sparse_pallas"))
+                                    "probe_limit", "sparse_pallas",
+                                    "search_stats"))
 def _check_device_batch(xs, state0, step_name: str, N: int,
                         dedupe: str = "sort", probe_limit: int = 0,
-                        sparse_pallas: str = "off"):
+                        sparse_pallas: str = "off",
+                        search_stats: bool = False):
     return jax.vmap(
         lambda x, s0: _check_impl(x, s0, step_name, N, dedupe,
-                                  probe_limit, sparse_pallas)
+                                  probe_limit, sparse_pallas,
+                                  search_stats)
     )(xs, state0)
 
 
 # same donation decision as _check_device_resumable above
 @functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
                    static_argnames=("step_name", "N", "dedupe",
-                                    "probe_limit", "sparse_pallas"))
+                                    "probe_limit", "sparse_pallas",
+                                    "search_stats"))
 def _check_device_batch_resumable(xs, carry0, step_name: str, N: int,
                                   dedupe: str = "sort",
                                   probe_limit: int = 0,
-                                  sparse_pallas: str = "off"):
+                                  sparse_pallas: str = "off",
+                                  search_stats: bool = False):
     """The streaming extension's batched scan: one chunk of events per
     key from an explicit per-key carry — jepsen_tpu.parallel.extend
     stacks shape-compatible sessions' frontiers and advances them in
@@ -582,10 +703,17 @@ def _check_device_batch_resumable(xs, carry0, step_name: str, N: int,
     dispatches). Pad events (ev_slot < 0) leave a key's carry
     untouched, event index included, so per-key chunks of different
     real lengths share the padded shape. Returns (carry_batch,
-    overflow[K])."""
+    overflow[K]) — plus the per-key per-event stats dict under
+    `search_stats` (width=-1 rows are that key's pads)."""
     C = xs["slot_f"].shape[2]
     step = _scan_step_factory(step_name, N, C, dedupe, probe_limit,
-                              sparse_pallas)
+                              sparse_pallas, search_stats)
+
+    if search_stats:
+        def one_s(x, c):
+            carry, ys = lax.scan(step, c, x)
+            return carry, jnp.any(ys["ovf"]), ys
+        return jax.vmap(one_s)(xs, carry0)
 
     def one(x, c):
         carry, ovfs = lax.scan(step, c, x)
@@ -743,7 +871,8 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
                             dedupe: Optional[str] = None,
                             probe_limit: int = 0,
                             sparse_pallas: Optional[bool] = None,
-                            model=None) -> dict:
+                            model=None,
+                            search_stats: Optional[bool] = None) -> dict:
     """check_encoded with mid-search checkpointing: events are processed
     in chunks of `checkpoint_every`; after each chunk the frontier is
     pulled to host and handed to checkpoint_cb(FrontierCheckpoint) (e.g.
@@ -766,6 +895,7 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
         return {"valid?": True, "max-frontier": 0, "capacity": 0}
     dedupe = _resolve_dedupe(dedupe)
     probe_limit = _resolve_probe_limit(probe_limit)
+    ss = _resolve_search_stats(search_stats)
     platform = getattr(device, "platform", None) or jax.default_backend()
     digest = history_digest(e)
     if resume is not None:
@@ -788,6 +918,9 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
     R = e.n_returns
     mode, note = "off", None
     recovered = None
+    acc = SearchStats(dedupe) if ss else None
+    from time import perf_counter as _pc
+    t0 = _pc()
     while cp.event_index < R and cp.ok:
         lo = cp.event_index
         hi = min(R, lo + checkpoint_every)
@@ -801,16 +934,20 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
         def _chunk(lo=lo, hi=hi, cp=cp, mode=mode):
             chunk = _place({k: v[lo:hi] for k, v in xs_np.items()},
                            device)
-            carry, overflow = _check_device_resumable(
+            out = _check_device_resumable(
                 chunk, cp.carry(device), e.step_name, cp.capacity,
-                dedupe, probe_limit, mode)
+                dedupe, probe_limit, mode, ss)
             # materialize inside the supervised window: async dispatch
             # must fail (or hang) here, not at a later host read
+            if ss:
+                carry, overflow, ys = out
+                return ([np.asarray(x) for x in carry], bool(overflow),
+                        jax.tree.map(np.asarray, ys))
+            carry, overflow = out
             return ([np.asarray(x) for x in carry], bool(overflow))
 
         try:
-            carry, overflow = sup.dispatch("search", _chunk,
-                                           backend=platform)
+            res = sup.dispatch("search", _chunk, backend=platform)
         except sup.DISPATCH_FAILURES as err:
             # the checkpoint taken before this chunk is the recovery
             # point: one device retry first (a recovered runtime —
@@ -820,8 +957,8 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
                 obs.counter("resilience.retries").inc()
                 with obs.span("resilience.device_resume",
                               event=cp.event_index):
-                    carry, overflow = sup.dispatch("search", _chunk,
-                                                   backend=platform)
+                    res = sup.dispatch("search", _chunk,
+                                       backend=platform)
                 recovered = {
                     "degraded": "device-resume",
                     "site": getattr(err, "site", "search"),
@@ -839,6 +976,7 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
                         backend=platform)
                 err2.checkpoint = cp
                 raise
+        carry, overflow = res[0], res[1]
         if bool(overflow):
             if cp.capacity * 2 > max_capacity:
                 return _tag_sparse_closure(
@@ -848,7 +986,13 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
                      "capacity": cp.capacity,
                      "checkpoint": cp}, mode, note)
             cp = cp.grown(cp.capacity * 2)
+            if acc is not None:
+                acc.escalations += 1
             continue  # re-run the same chunk at doubled capacity
+        if acc is not None:
+            # only successful chunks count: a retried chunk's partial
+            # stats would double its events
+            acc.add_chunk(res[2], cp.capacity)
         st, ml, mh, live, ok, fail_r, r_idx, maxf, steps_n, stepped = \
             [np.asarray(x) for x in carry]
         cp = FrontierCheckpoint(int(r_idx), cp.capacity, e.step_name,
@@ -867,6 +1011,8 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
            "explored": cp.steps_n * cp.capacity * len(e.slot_f[0])}
     if recovered is not None:
         out["resilience"] = recovered
+    if acc is not None:
+        out["stats"] = _finish_search_stats(acc, t0, _pc())
     _tag_sparse_closure(out, mode, note)
     if not out["valid?"]:
         out.update(_fail_op(e, cp.fail_r))
@@ -889,11 +1035,224 @@ def _tag_sparse_closure(out: dict, mode: str, note) -> dict:
     return out
 
 
+# metric-name-safe spellings of PROBE_HIST_LABELS (dots/dashes would
+# sanitize ambiguously in the Prometheus mapping)
+PROBE_METRIC_LABELS = ("0", "1", "le3", "le7", "le15", "le31", "over")
+
+# counter-track sample cap per search: a 50k-event trajectory must not
+# bloat the trace file — past this the exporter strides
+STATS_TRACK_MAX_SAMPLES = 512
+
+
+# Per-key trajectory bound: a streamed key's lifetime stats (and a
+# giant one-shot search's) stop growing past this many events — the
+# serve mode's every other per-key structure is deliberately bounded,
+# and its telemetry must not be the one exception. Aggregates freeze
+# with the lists; the block says "truncated": True.
+SEARCH_STATS_MAX_EVENTS = 32768
+
+
+class SearchStats:
+    """Host-side accumulator over the device's per-event stats arrays
+    (JEPSEN_TPU_SEARCH_STATS) — one per searched key, fed one chunk at
+    a time (the one-shot paths feed a single chunk; the resumable /
+    streaming paths feed every successful chunk, so streamed keys
+    report LIFETIME stats). Rows with width < 0 (pads, post-failure)
+    are dropped here, so callers never re-derive pad bookkeeping.
+    Trajectories cap at SEARCH_STATS_MAX_EVENTS (bounded host memory
+    per live key; the block is then marked truncated)."""
+
+    def __init__(self, dedupe: str):
+        self.dedupe = dedupe
+        self.width: list = []
+        self.peak: list = []
+        self.iters: list = []
+        self.stepped: list = []
+        self.swork: list = []     # per-event sort-equivalent work
+        self.phist: list = []     # per-event [N_PROBE_BUCKETS] rows
+        self.capacity = 0
+        self.escalations = 0
+        self.truncated = False
+
+    def splice(self, resume_ev: int, leg: "SearchStats") -> None:
+        """Replace events [resume_ev, ...) with a re-scanned leg's —
+        the streaming session's accumulation: a delta re-opens the
+        tail at the stable boundary and re-scans from `resume_ev`, so
+        the stale tail rows are superseded, not appended. Events below
+        `resume_ev` are bit-identical under extension (the settled
+        certificate), which is what makes the spliced lifetime stats
+        EXACTLY a one-shot check's of the current prefix."""
+        for attr in ("width", "peak", "iters", "stepped", "swork",
+                     "phist"):
+            rows = getattr(self, attr)
+            del rows[resume_ev:]
+            keep = SEARCH_STATS_MAX_EVENTS - len(rows)
+            rows.extend(getattr(leg, attr)[:max(0, keep)])
+        if leg.truncated or len(self.width) >= SEARCH_STATS_MAX_EVENTS:
+            self.truncated = True
+        self.capacity = max(self.capacity, leg.capacity)
+        self.escalations += leg.escalations
+
+    def add_chunk(self, ys, N: int) -> None:
+        """One chunk's stats dict (np arrays, [R] / [R, B])."""
+        room = SEARCH_STATS_MAX_EVENTS - len(self.width)
+        w = np.asarray(ys["width"]).reshape(-1)
+        real = w >= 0
+        if int(real.sum()) > max(0, room):
+            # freeze at the cap: aggregates and lists stay mutually
+            # consistent (both describe the first MAX events)
+            self.truncated = True
+            keep = np.flatnonzero(real)[:max(0, room)]
+            real = np.zeros_like(real)
+            real[keep] = True
+        self.width.extend(int(x) for x in w[real])
+        self.peak.extend(int(x) for x in
+                         np.asarray(ys["peak"]).reshape(-1)[real])
+        self.iters.extend(int(x) for x in
+                          np.asarray(ys["iters"]).reshape(-1)[real])
+        self.stepped.extend(int(x) for x in
+                            np.asarray(ys["stepped"]).reshape(-1)[real])
+        self.swork.extend(int(x) for x in
+                          np.asarray(ys["swork"]).reshape(-1)[real])
+        self.phist.extend(
+            [int(v) for v in row] for row in
+            np.asarray(ys["phist"]).reshape(-1, N_PROBE_BUCKETS)[real])
+        self.capacity = max(self.capacity, int(N))
+
+    def block(self) -> dict:
+        """The result-dict ``"stats"`` block — the schema every sink
+        (result dicts, /metrics, counter tracks, `jepsen report
+        --search`) reads; pinned by tests/test_search_stats.py."""
+        peak = max(self.peak, default=0)
+        stepped = sum(self.stepped)
+        swork = sum(self.swork)
+        phist = np.asarray(self.phist, np.int64).reshape(
+            -1, N_PROBE_BUCKETS).sum(axis=0)
+        out = {
+            "events": len(self.width),
+            "frontier-width": list(self.width),
+            "closure-iters": list(self.iters),
+            "configs-stepped-per-event": list(self.stepped),
+            "closure-peak": list(self.peak),
+            "frontier-peak": peak,
+            "capacity": self.capacity,
+            "capacity-tier": self.escalations,
+            "peak-occupancy": (round(peak / self.capacity, 6)
+                               if self.capacity else None),
+            "dedupe": self.dedupe,
+            "delta-split-ratio": (round(stepped / swork, 6)
+                                  if swork else None),
+            "table-capacity": None,
+            "load-factor-peak": None,
+            "load-factor-final": None,
+            "probe-hist": None,
+            "probes": None,
+        }
+        if self.truncated:
+            # no silent caps: the block covers the FIRST
+            # SEARCH_STATS_MAX_EVENTS events only
+            out["truncated"] = True
+        if self.dedupe == "hash" and self.capacity:
+            # under hash the closure peak IS the visited-table
+            # occupancy (every config inserted exactly once per event)
+            T = _next_pow2(2 * self.capacity)
+            out["table-capacity"] = T
+            out["load-factor-peak"] = round(peak / T, 6)
+            out["load-factor-final"] = (round(self.peak[-1] / T, 6)
+                                        if self.peak else None)
+            out["probe-hist"] = {lab: int(n) for lab, n in
+                                 zip(PROBE_HIST_LABELS, phist)}
+            out["probes"] = int(phist.sum())
+        return out
+
+
+def _publish_search_stats(block: dict,
+                          prefix: str = "engine.search") -> None:
+    """Thread one search's stats block into the obs registry under
+    ``engine.search.*`` — the names /metrics serves as
+    ``jepsen_engine_search_*`` (docs/observability.md)."""
+    reg = obs.registry()
+    reg.counter(f"{prefix}.events").inc(block["events"])
+    reg.gauge(f"{prefix}.frontier_peak").set(block["frontier-peak"])
+    if block.get("peak-occupancy") is not None:
+        reg.gauge(f"{prefix}.peak_occupancy").set(
+            block["peak-occupancy"])
+    if block.get("delta-split-ratio") is not None:
+        reg.gauge(f"{prefix}.delta_split_ratio").set(
+            block["delta-split-ratio"])
+    if block.get("load-factor-peak") is not None:
+        reg.gauge(f"{prefix}.load_factor_peak").set(
+            block["load-factor-peak"])
+    if block.get("capacity-tier"):
+        reg.counter(f"{prefix}.escalations").inc(block["capacity-tier"])
+    if block.get("pad-waste") is not None:
+        reg.gauge(f"{prefix}.pad_waste").set(block["pad-waste"])
+    hist = block.get("probe-hist")
+    if hist:
+        for raw, lab in zip(PROBE_HIST_LABELS, PROBE_METRIC_LABELS):
+            if hist.get(raw):
+                reg.counter(f"{prefix}.probe_len.{lab}").inc(hist[raw])
+
+
+def _emit_stats_tracks(block: dict, t0: float, t1: float) -> None:
+    """The search's per-event trajectories as Perfetto counter tracks:
+    event index mapped linearly onto the search's wall window (the
+    device scan yields no per-event host timestamps — the x axis is
+    the EVENT axis rendered in time units, aligned with the search
+    span). No-op when tracing is off."""
+    if not obs.enabled():
+        return
+    widths = block["frontier-width"]
+    R = len(widths)
+    if not R or t1 <= t0:
+        return
+    stride = max(1, -(-R // STATS_TRACK_MAX_SAMPLES))
+    dt = (t1 - t0) / R
+    T = block.get("table-capacity")
+    for i in range(0, R, stride):
+        t = t0 + (i + 1) * dt
+        obs.counter_sample("engine.search.frontier_width", widths[i],
+                           t=t)
+        if T:
+            obs.counter_sample(
+                "engine.search.load_factor",
+                round(block["closure-peak"][i] / T, 4), t=t)
+
+
+def finish_stats_block(block: dict, t0: float, t1: float,
+                       key=None) -> dict:
+    """Fan a ready stats block into the always-on sinks (obs registry,
+    run-dir search-stats record) plus the counter tracks when tracing
+    is on — shared by the sparse, bitdense, and sharded engines so
+    every path feeds the same four sinks."""
+    _publish_search_stats(block)
+    _emit_stats_tracks(block, t0, t1)
+    rec = dict(block)
+    if key is not None:
+        rec["key"] = key
+    obs.record_search_stats(rec)
+    return block
+
+
+def _finish_search_stats(acc: "SearchStats", t0: float, t1: float,
+                         key=None, engine: str = "sparse",
+                         extra: Optional[dict] = None) -> dict:
+    """Build the stats block and fan it into the three always-on sinks
+    (result dict via the return value, obs registry, run-dir
+    search-stats record) plus the counter tracks when tracing is on."""
+    block = acc.block()
+    if extra:
+        block.update(extra)
+    block["engine"] = engine
+    return finish_stats_block(block, t0, t1, key=key)
+
+
 def check_encoded(e: EncodedHistory, capacity: int = 1024,
                   max_capacity: int = 1 << 20, device=None,
                   dedupe: Optional[str] = None,
                   probe_limit: int = 0,
-                  sparse_pallas: Optional[bool] = None) -> dict:
+                  sparse_pallas: Optional[bool] = None,
+                  search_stats: Optional[bool] = None) -> dict:
     """Check one encoded history, doubling frontier capacity on overflow
     (re-jit per capacity tier; tiers are cached by jax.jit). With
     `device` every input is explicitly placed there and the search runs
@@ -916,11 +1275,19 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
     Results are identical by construction — the kernel body is the
     same _hash_event_closure trace; the gate re-resolves per capacity
     tier, so an escalation past the kernel's VMEM budget degrades to
-    the XLA hash closure with a "closure-note" rather than erroring."""
+    the XLA hash closure with a "closure-note" rather than erroring.
+
+    `search_stats` (None = the JEPSEN_TPU_SEARCH_STATS flag) adds a
+    device-computed per-event ``"stats"`` block to the result — the
+    frontier-width trajectory, closure iterations, hash-table load,
+    probe-length histogram, capacity tier (docs/observability.md
+    "Search telemetry"). Off: the result dict is byte-identical to the
+    pre-stats schema."""
     if e.n_returns == 0:
         return {"valid?": True, "max-frontier": 0, "capacity": 0}
     dedupe = _resolve_dedupe(dedupe)
     probe_limit = _resolve_probe_limit(probe_limit)
+    ss = _resolve_search_stats(search_stats)
     platform = getattr(device, "platform", None) or jax.default_backend()
     C = e.slot_f.shape[1]
     # H2D placement and the search both run through the supervised
@@ -935,6 +1302,9 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
                  _place(np.int32(e.state0), device)),
         backend=platform)
     N = max(64, capacity)
+    n_esc = 0
+    from time import perf_counter as _pc
+    t0 = _pc()
     with obs.span("engine.search", returns=e.n_returns,
                   dedupe=dedupe) as sp:
         while True:
@@ -943,11 +1313,13 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
 
             def _search(N=N, mode=mode):
                 out = _check_device(xs, state0, e.step_name, N,
-                                    dedupe, probe_limit, mode)
-                return [np.asarray(x) for x in out]
+                                    dedupe, probe_limit, mode, ss)
+                # tree map (not a list comp): the stats output is a
+                # dict of arrays riding along under search_stats
+                return jax.tree.map(np.asarray, out)
 
-            valid, fail_r, overflow, maxf, steps_n, stepped = \
-                sup.dispatch("search", _search, backend=platform)
+            res = sup.dispatch("search", _search, backend=platform)
+            valid, fail_r, overflow, maxf, steps_n, stepped = res[:6]
             if not bool(overflow):
                 break
             if N * 2 > max_capacity:
@@ -956,6 +1328,7 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
                      "error": f"frontier overflow at capacity {N}",
                      "capacity": N, "dedupe": dedupe}, mode, note)
             N *= 2
+            n_esc += 1
             obs.counter("engine.capacity_escalations").inc()
         sp.set(capacity=N)
         if mode != "off":
@@ -975,6 +1348,11 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
         "explored": int(steps_n) * N * len(e.slot_f[0]),
     }
     _tag_sparse_closure(out, mode, note)
+    if ss:
+        acc = SearchStats(dedupe)
+        acc.escalations = n_esc
+        acc.add_chunk(res[6], N)
+        out["stats"] = _finish_search_stats(acc, t0, _pc())
     if not out["valid?"]:
         out.update(_fail_op(e, int(fail_r)))
     return out
@@ -983,7 +1361,8 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
 def analysis(model, history, capacity: int = 1024,
              max_capacity: int = 1 << 20, encode_cache=None,
              dedupe: Optional[str] = None,
-             sparse_pallas: Optional[bool] = None) -> dict:
+             sparse_pallas: Optional[bool] = None,
+             search_stats: Optional[bool] = None) -> dict:
     """knossos-style (model, history) -> result on the device engine.
 
     Falls back to the host WGL engine when the model can't pack or the
@@ -1033,11 +1412,13 @@ def analysis(model, history, capacity: int = 1024,
             # dedupe strategy has nothing to select there (its result
             # says dedupe="dense"); the flag governs the sparse
             # dispatch below
-            r = bitdense.check_encoded_bitdense(e)
+            r = bitdense.check_encoded_bitdense(
+                e, search_stats=search_stats)
         else:
             r = check_encoded(e, capacity=capacity,
                               max_capacity=max_capacity, dedupe=dedupe,
-                              sparse_pallas=sparse_pallas)
+                              sparse_pallas=sparse_pallas,
+                              search_stats=search_stats)
     except sup.DISPATCH_FAILURES as err:
         # the degradation contract (docs/resilience.md): a dead device
         # dispatch — wedged, crashed, or breaker-refused — degrades to
@@ -1384,7 +1765,8 @@ def check_batch(model, histories, capacity: int = 512,
                 pipeline: Optional[bool] = None, cache=None,
                 pipeline_stats: Optional[dict] = None,
                 dedupe: Optional[str] = None,
-                sparse_pallas: Optional[bool] = None) -> list:
+                sparse_pallas: Optional[bool] = None,
+                search_stats: Optional[bool] = None) -> list:
     """Check many per-key histories in one device program per
     slot-window bucket: vmap over the key axis; with a mesh (and K
     divisible by its size) the key axis is sharded across devices —
@@ -1432,7 +1814,7 @@ def check_batch(model, histories, capacity: int = 512,
             model, histories, capacity=capacity,
             max_capacity=max_capacity, mesh=mesh, bucket=bucket,
             cache=cache, stats=pipeline_stats, dedupe=dedupe,
-            sparse_pallas=sparse_pallas)
+            sparse_pallas=sparse_pallas, search_stats=search_stats)
     if (cache is not None and cache is not False) \
             or pipeline_stats is not None:
         # the serial path consults no cache and fills no stats —
@@ -1452,7 +1834,8 @@ def check_batch(model, histories, capacity: int = 512,
         return check_batch_encoded(model, pre, capacity=capacity,
                                    max_capacity=max_capacity, mesh=mesh,
                                    bucket=bucket, dedupe=dedupe,
-                                   sparse_pallas=sparse_pallas)
+                                   sparse_pallas=sparse_pallas,
+                                   search_stats=search_stats)
 
 
 def _resolve_bucket(bucket: Optional[str]) -> str:
@@ -1494,7 +1877,8 @@ def check_batch_encoded(model, pre, capacity: int = 512,
                         max_capacity: int = 1 << 18, mesh=None,
                         bucket: Optional[str] = None,
                         dedupe: Optional[str] = None,
-                        sparse_pallas: Optional[bool] = None) -> list:
+                        sparse_pallas: Optional[bool] = None,
+                        search_stats: Optional[bool] = None) -> list:
     """check_batch on ALREADY-ENCODED keys (the bucketing + dispatch
     half without the encode half). Public so callers that time or
     cache the encode separately — bench.sec_multikey's encode/device
@@ -1521,7 +1905,8 @@ def check_batch_encoded(model, pre, capacity: int = 512,
         C_max = max(e.n_slots for e in sub)
         if bitdense.fits_bitdense(S_max, C_max):
             try:
-                rs = bitdense.check_batch_bitdense(sub, mesh=mesh)
+                rs = bitdense.check_batch_bitdense(
+                    sub, mesh=mesh, search_stats=search_stats)
             except sup.DISPATCH_FAILURES as err:
                 # degradation contract: a dead bitdense dispatch costs
                 # this bucket the device path, not the batch the
@@ -1535,7 +1920,8 @@ def check_batch_encoded(model, pre, capacity: int = 512,
         else:
             rs = _check_batch_sparse(model, sub, capacity, max_capacity,
                                      mesh, dedupe=dedupe,
-                                     sparse_pallas=sparse_pallas)
+                                     sparse_pallas=sparse_pallas,
+                                     search_stats=search_stats)
         for i, r in zip(idxs, rs):
             out[i] = r
     return out
@@ -1544,12 +1930,15 @@ def check_batch_encoded(model, pre, capacity: int = 512,
 def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
                         mesh=None, dedupe: str = "sort",
                         probe_limit: int = 0,
-                        sparse_pallas: Optional[bool] = None) -> list:
+                        sparse_pallas: Optional[bool] = None,
+                        search_stats: Optional[bool] = None) -> list:
     """Sparse-frontier batch path with per-key capacity-tier retry."""
     step_name = pre[0].step_name
     K = len(pre)
     out: list = [None] * K
     probe_limit = _resolve_probe_limit(probe_limit)
+    ss = _resolve_search_stats(search_stats)
+    from time import perf_counter as _pc
     # the padded batch runs one program: gate the kernel on where the
     # batch actually lives (the mesh when given), like bitdense does
     platform = (np.asarray(mesh.devices).flat[0].platform
@@ -1561,10 +1950,12 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
     # re-padding and re-search at 2-512x capacity.
     pending = list(range(K))
     N = max(64, capacity)
+    n_tier = 0
     while pending:
         encs_t = [pre[i] for i in pending]
         mode, note = _resolve_sparse_pallas(sparse_pallas, N, C,
                                             platform, dedupe)
+        t0 = _pc()
         try:
             with obs.span("engine.sparse_batch", keys=len(pending),
                           capacity=N, dedupe=dedupe):
@@ -1576,12 +1967,14 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
 
                 def _search(xs=xs, state0=state0, N=N, mode=mode):
                     out = _check_device_batch(xs, state0, step_name, N,
-                                              dedupe, probe_limit, mode)
+                                              dedupe, probe_limit, mode,
+                                              ss)
                     # materialize inside the supervised window
-                    return [np.asarray(x) for x in out]
+                    return jax.tree.map(np.asarray, out)
 
+                res = sup.dispatch("search", _search, backend=platform)
                 valid, fail_r, overflow, maxf, steps_n, stepped = \
-                    sup.dispatch("search", _search, backend=platform)
+                    res[:6]
         except sup.DISPATCH_FAILURES as err:
             # degradation contract: the keys still pending at the
             # failure degrade to the host WGL path, each with a
@@ -1594,6 +1987,13 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
                     model, pre[i], getattr(err, "site", "search"),
                     reason, backend=platform)
             break
+        t1 = _pc()
+        if ss:
+            # padded program dims for this tier: the pad-waste the
+            # stats block reports is measured against what actually
+            # shipped to the device
+            R_pad = max(e.n_returns for e in encs_t)
+            C_pad = max(e.slot_f.shape[1] for e in encs_t)
         retry = []
         for j, i in enumerate(pending):
             if bool(overflow[j]):
@@ -1605,6 +2005,18 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
                  "configs-stepped": int(stepped[j])}
             _tag_sparse_closure(r, mode, note)
             obs.counter("engine.configs_stepped").inc(int(stepped[j]))
+            if ss:
+                acc = SearchStats(dedupe)
+                acc.escalations = n_tier
+                acc.add_chunk(
+                    jax.tree.map(lambda a, j=j: a[j], res[6]), N)
+                waste = 1.0 - ((e.n_returns * e.slot_f.shape[1])
+                               / max(1, R_pad * C_pad))
+                r["stats"] = _finish_search_stats(
+                    acc, t0, t1, key=i,
+                    extra={"pad-waste": round(waste, 6),
+                           "pad-events": int(R_pad - e.n_returns),
+                           "pad-slots": int(C_pad - e.slot_f.shape[1])})
             if not r["valid?"]:
                 r.update(enc_mod.fail_op_fields(e, int(fail_r[j])))
             out[i] = r
@@ -1614,19 +2026,22 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
             for i in retry:
                 out[i] = _escalate_overflow(pre[i], N, mesh,
                                             dedupe=dedupe,
-                                            sparse_pallas=sparse_pallas)
+                                            sparse_pallas=sparse_pallas,
+                                            search_stats=ss)
             break
         # keys that overflowed re-dispatch at the doubled tier — the
         # counter the capacity-retry ladder's cost is visible through
         obs.counter("engine.overflow_redispatch").inc(len(retry))
         pending = retry
         N *= 2
+        n_tier += 1
     return out
 
 
 def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh,
                        dedupe: str = "sort",
-                       sparse_pallas: Optional[bool] = None) -> dict:
+                       sparse_pallas: Optional[bool] = None,
+                       search_stats: Optional[bool] = None) -> dict:
     """A key too wide for the batch program escalates instead of dying
     as "unknown": first the single-key sparse engine at 4x the batch
     ceiling, then — with a mesh — the frontier-sharded engine, whose
@@ -1648,7 +2063,8 @@ def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh,
     dev = None if mesh is None else np.asarray(mesh.devices).flat[0]
     r = check_encoded(e, capacity=min(batch_cap * 2, ceil_single),
                       max_capacity=ceil_single, device=dev,
-                      dedupe=dedupe, sparse_pallas=sparse_pallas)
+                      dedupe=dedupe, sparse_pallas=sparse_pallas,
+                      search_stats=search_stats)
     if r["valid?"] != "unknown":
         r["escalated"] = "single"
         return r
@@ -1672,7 +2088,8 @@ def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh,
             rs = sharded.check_encoded_sharded(
                 e, mesh, capacity=min(batch_cap * 8, ceil_sharded),
                 max_capacity=ceil_sharded, dedupe=dedupe,
-                sparse_pallas=sparse_pallas)
+                sparse_pallas=sparse_pallas,
+                search_stats=search_stats)
             if rs["valid?"] != "unknown":
                 rs["escalated"] = "sharded"
                 return rs

@@ -208,7 +208,8 @@ def _cp_from_carry(carry, cp, step_name: str):
 
 def _advance_cp(e: EncodedHistory, cp, target: int, *, dedupe: str,
                 probe_limit: int, sparse_pallas, device, platform: str,
-                max_capacity: int, C_pad: Optional[int] = None):
+                max_capacity: int, C_pad: Optional[int] = None,
+                stats_acc=None):
     """Advance ``cp`` over return events [cp.event_index, target) of
     ``e``, doubling capacity on overflow. Supervised like every device
     dispatch, with the resumable path's degradation ladder: one device
@@ -225,6 +226,7 @@ def _advance_cp(e: EncodedHistory, cp, target: int, *, dedupe: str,
     retry or overflow contract must land in BOTH (test_checkpoint and
     test_serve pin each side)."""
     C = C_pad or e.slot_f.shape[1]
+    ss = stats_acc is not None
     mode, note = "off", None
     recovered = None
     while cp.event_index < target and cp.ok:
@@ -234,18 +236,23 @@ def _advance_cp(e: EncodedHistory, cp, target: int, *, dedupe: str,
             sparse_pallas, cp.capacity, C, platform, dedupe)
 
         def _chunk(lo=lo, cp=cp, mode=mode, R_pad=R_pad):
+            import jax as _jax
             xs = engine._place(_xs_slice(e, lo, target, R_pad, C),
                                device)
-            carry, overflow = engine._check_device_resumable(
+            out = engine._check_device_resumable(
                 xs, cp.carry(device), e.step_name, cp.capacity,
-                dedupe, probe_limit, mode)
+                dedupe, probe_limit, mode, ss)
             # materialize inside the supervised window (async dispatch
             # must fail or hang here, not at a later host read)
+            if ss:
+                carry, overflow, ys = out
+                return ([np.asarray(x) for x in carry], bool(overflow),
+                        _jax.tree.map(np.asarray, ys))
+            carry, overflow = out
             return ([np.asarray(x) for x in carry], bool(overflow))
 
         try:
-            carry, overflow = sup.dispatch("search", _chunk,
-                                           backend=platform)
+            res = sup.dispatch("search", _chunk, backend=platform)
         except sup.DISPATCH_FAILURES as err:
             # the checkpoint in hand is the recovery point: one device
             # retry first (a half-open breaker probe may have
@@ -254,8 +261,8 @@ def _advance_cp(e: EncodedHistory, cp, target: int, *, dedupe: str,
                 obs.counter("resilience.retries").inc()
                 with obs.span("resilience.device_resume",
                               event=cp.event_index):
-                    carry, overflow = sup.dispatch("search", _chunk,
-                                                   backend=platform)
+                    res = sup.dispatch("search", _chunk,
+                                       backend=platform)
                 recovered = {
                     "degraded": "device-resume",
                     "site": getattr(err, "site", "search"),
@@ -266,12 +273,19 @@ def _advance_cp(e: EncodedHistory, cp, target: int, *, dedupe: str,
                 # degradation contract (host resume keeps the verdict)
                 err2.checkpoint = cp
                 raise
+        carry, overflow = res[0], res[1]
         if overflow:
             if cp.capacity * 2 > max_capacity:
                 raise FrontierOverflowError(cp)
             obs.counter("engine.capacity_escalations").inc()
             cp = cp.grown(cp.capacity * 2)
+            if ss:
+                stats_acc.escalations += 1
             continue
+        if ss:
+            # only successful chunks: a re-run chunk's discarded
+            # attempt must not double its events
+            stats_acc.add_chunk(res[2], cp.capacity)
         cp = _cp_from_carry(carry, cp, e.step_name)
     return cp, mode, note, recovered
 
@@ -299,7 +313,7 @@ class HistorySession:
                  max_capacity: int = 1 << 20,
                  dedupe: Optional[str] = None, probe_limit: int = 0,
                  sparse_pallas: Optional[bool] = None, device=None,
-                 key=None):
+                 key=None, search_stats: Optional[bool] = None):
         self.model = model
         self.key = key
         self.ops: list = []
@@ -307,6 +321,15 @@ class HistorySession:
         self.dedupe = engine._resolve_dedupe(dedupe)
         self.probe_limit = engine._resolve_probe_limit(probe_limit)
         self.sparse_pallas = sparse_pallas
+        self.search_stats = engine._resolve_search_stats(search_stats)
+        # lifetime device-search stats across every delta's legs
+        # (JEPSEN_TPU_SEARCH_STATS); _leg_acc is the in-flight check's
+        # accumulator, merged in at _finish. NOT persisted by
+        # freeze/thaw — an evicted key's stats restart at thaw.
+        self._stats_acc = (engine.SearchStats(self.dedupe)
+                           if self.search_stats else None)
+        self._leg_acc = None
+        self._leg_t0 = None
         self.device = device
         self.capacity = max(64, capacity)
         self.max_capacity = max_capacity
@@ -427,6 +450,18 @@ class HistorySession:
             out.update(engine._fail_op(e, cp.fail_r))
         return out
 
+    def _leg_stats(self):
+        """The in-flight check's stats accumulator (created on first
+        use so a batched advance's earlier legs and the solo fallback
+        share one), or None with stats off."""
+        if not self.search_stats:
+            return None
+        if self._leg_acc is None:
+            from time import perf_counter
+            self._leg_acc = engine.SearchStats(self.dedupe)
+            self._leg_t0 = perf_counter()
+        return self._leg_acc
+
     def _finish(self, tcp, mode, note, resume_ev: int,
                 recovered) -> dict:
         """Bookkeeping shared by check() and advance_sessions() once
@@ -439,11 +474,38 @@ class HistorySession:
         r = self._result_from(tcp, mode, note, resume_ev)
         if recovered is not None:
             r["resilience"] = recovered
+        if self._stats_acc is not None and self._leg_acc is not None:
+            from time import perf_counter
+
+            # registry + counter tracks get THIS check's leg only (a
+            # stream republishing its lifetime totals every delta
+            # would inflate every counter); the result block and the
+            # run-dir record carry the LIFETIME stats — the leg is
+            # SPLICED in at its resume event, superseding the stale
+            # re-opened tail, so lifetime == a one-shot check of the
+            # current prefix (parity-pinned). `jepsen report --search`
+            # dedupes by key, newest record wins.
+            leg_block = self._leg_acc.block()
+            leg_block["engine"] = "stream"
+            engine._publish_search_stats(leg_block)
+            engine._emit_stats_tracks(leg_block, self._leg_t0,
+                                      perf_counter())
+            self._stats_acc.splice(resume_ev, self._leg_acc)
+            block = self._stats_acc.block()
+            block["engine"] = "stream"
+            block["resumed-from-event"] = resume_ev
+            rec = dict(block)
+            if self.key is not None:
+                rec["key"] = self.key
+            obs.record_search_stats(rec)
+            r["stats"] = block
+            self._leg_acc = None
         self._last_result = dict(r)
         self._dirty = False
         return r
 
     def _overflow_result(self, err: FrontierOverflowError) -> dict:
+        self._leg_acc = None   # no stats on an undecided check
         r = {"valid?": "unknown",
              "error": f"frontier overflow at capacity "
                       f"{err.checkpoint.capacity}",
@@ -461,6 +523,7 @@ class HistorySession:
         structured ``resilience`` note attached."""
         from jepsen_tpu.resilience import recovery
         cp_at = getattr(err, "checkpoint", None) or cp
+        self._leg_acc = None   # device stats end where the device died
         obs.counter("stream.degraded_checks").inc()
         r = recovery.host_resume(
             self.model, self.enc, cp_at, getattr(err, "site", "search"),
@@ -501,7 +564,8 @@ class HistorySession:
         stable = max(self._stable_ev, cp.event_index)
         kw = dict(dedupe=self.dedupe, probe_limit=self.probe_limit,
                   sparse_pallas=self.sparse_pallas, device=self.device,
-                  platform=platform, max_capacity=self.max_capacity)
+                  platform=platform, max_capacity=self.max_capacity,
+                  stats_acc=self._leg_stats())
         recovered = None
         mode, note = "off", None
         with obs.span("stream.check", key=self.key, returns=R,
@@ -609,11 +673,14 @@ def _stack_carries(cps, K_pad: int):
 
 def _batch_leg(pairs, N: int, C_pad: int, dedupe: str,
                probe_limit: int, sparse_pallas, device,
-               platform: str):
+               platform: str, search_stats: bool = False):
     """One batched scan leg: advance each (session, target) pair's
     in-flight cursor over its own rows in ONE device program. Returns
     (mode, note, overflowed_sessions); overflowed members keep their
-    pre-leg cursor (their capacity retry runs individually)."""
+    pre-leg cursor (their capacity retry runs individually). Under
+    `search_stats`, each successful member's per-key stats rows feed
+    its session's leg accumulator — batched legs report the same
+    per-event telemetry solo scans do."""
     R_pad = _quantize(max(t - s._scan_cp.event_index
                           for s, t in pairs))
     K = len(pairs)
@@ -631,18 +698,28 @@ def _batch_leg(pairs, N: int, C_pad: int, dedupe: str,
         carry0 = _stack_carries([s._scan_cp for s, _ in pairs], K_pad)
         xs = engine._place(xs, device)
         carry0 = engine._place(carry0, device)
-        carry, ovf = engine._check_device_batch_resumable(
-            xs, carry0, step_name, N, dedupe, probe_limit, mode)
+        out = engine._check_device_batch_resumable(
+            xs, carry0, step_name, N, dedupe, probe_limit, mode,
+            search_stats)
+        if search_stats:
+            carry, ovf, ys = out
+            return ([np.asarray(x) for x in carry], np.asarray(ovf),
+                    jax.tree.map(np.asarray, ys))
+        carry, ovf = out
         return ([np.asarray(x) for x in carry], np.asarray(ovf))
 
     with obs.span("stream.batch_scan", keys=K, events=R_pad,
                   capacity=N):
-        carry, ovf = sup.dispatch("search", _thunk, backend=platform)
+        res = sup.dispatch("search", _thunk, backend=platform)
+    carry, ovf = res[0], res[1]
     overflowed = []
     for k, (s, _t) in enumerate(pairs):
         if bool(ovf[k]):
             overflowed.append(s)
             continue
+        if search_stats:
+            s._leg_stats().add_chunk(
+                jax.tree.map(lambda a, k=k: a[k], res[2]), N)
         s._scan_cp = engine.FrontierCheckpoint(
             int(carry[6][k]), N, step_name,
             s._scan_cp.history_digest, carry[0][k], carry[1][k],
@@ -677,11 +754,12 @@ def advance_sessions(sessions, bucket: Optional[str] = None) -> list:
         s._scan_cp = cp
         gk = (s.enc.step_name, cp.capacity,
               engine.bucket_key(s.enc.n_slots, bucket), s.dedupe,
-              s.probe_limit, s.sparse_pallas, id(s.device))
+              s.probe_limit, s.sparse_pallas, s.search_stats,
+              id(s.device))
         groups.setdefault(gk, []).append(s)
 
     for (step_name, N, tier, dedupe, probe_limit, sparse_pallas,
-         _dev), members in groups.items():
+         search_stats, _dev), members in groups.items():
         if len(members) == 1:
             s = members[0]
             results[id(s)] = s.check()
@@ -712,7 +790,8 @@ def advance_sessions(sessions, bucket: Optional[str] = None) -> list:
                 if pairs:
                     mode, note, overflowed = _batch_leg(
                         pairs, N, C_pad, dedupe, probe_limit,
-                        sparse_pallas, device, platform)
+                        sparse_pallas, device, platform,
+                        search_stats=search_stats)
                     if overflowed:
                         # the capacity ladder is per key: overflowed
                         # members leave the group and re-run solo

@@ -440,7 +440,8 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
                           depth: int = 2,
                           stats: Optional[dict] = None,
                           dedupe: Optional[str] = None,
-                          sparse_pallas: Optional[bool] = None) -> list:
+                          sparse_pallas: Optional[bool] = None,
+                          search_stats: Optional[bool] = None) -> list:
     """engine.check_batch with the three host/device phases overlapped
     (module docstring). Same arguments and bit-identical results;
     extras:
@@ -462,9 +463,13 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
     sparse_pallas  route the sparse buckets' hash closure through the
                 fused VMEM frontier kernel (engine.check_encoded's
                 docstring; None = JEPSEN_TPU_SPARSE_PALLAS)
+    search_stats  per-key device-computed search telemetry in the
+                result "stats" dicts (engine._resolve_search_stats;
+                None = JEPSEN_TPU_SEARCH_STATS)
     """
     bucket = engine._resolve_bucket(bucket)
     dedupe = engine._resolve_dedupe(dedupe)
+    search_stats = engine._resolve_search_stats(search_stats)
     if stats is None:
         stats = {}
     K = len(histories)
@@ -490,7 +495,7 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
     with root, obs.maybe_jax_profile():
         out = _stream(model, histories, capacity, max_capacity, mesh,
                       bucket, cache, workers, chunk_keys, depth, stats,
-                      dedupe, bitdense, sparse_pallas)
+                      dedupe, bitdense, sparse_pallas, search_stats)
     if c0 is not None:
         c1 = cache.counters()
         stats["cache"] = {k: c1[k] - c0[k] for k in
@@ -507,7 +512,8 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
 
 def _stream(model, histories, capacity, max_capacity, mesh, bucket,
             cache, workers, chunk_keys, depth, stats, dedupe,
-            bitdense, sparse_pallas=None) -> list:
+            bitdense, sparse_pallas=None,
+            search_stats: bool = False) -> list:
     """The executor body (check_batch_pipelined's docstring), under the
     pipeline.run root span. Telemetry it feeds: pipeline.prepare /
     pipeline.encode spans on the pool threads (nested via ctx_runner),
@@ -521,6 +527,14 @@ def _stream(model, histories, capacity, max_capacity, mesh, bucket,
     reg = obs.registry()
     reg.counter("pipeline.keys").inc(K)
     inflight = reg.gauge("pipeline.inflight")
+
+    def _depth(n: int):
+        """Gauge + counter-track sample from the SAME level read: the
+        Perfetto inflight area chart and the registry gauge cannot
+        disagree (counter_sample is a no-op with tracing off)."""
+        inflight.set(n)
+        obs.counter_sample("pipeline.inflight", n)
+
     wrap = obs.ctx_runner()
 
     t_wall = perf_counter()
@@ -585,9 +599,9 @@ def _stream(model, histories, capacity, max_capacity, mesh, bucket,
                     rs = sup.dispatch("pipeline", pb.finalize)
             except sup.DISPATCH_FAILURES as err:
                 degrade_chunk(chunk_idxs, err, bstat)
-                inflight.set(len(pending))
+                _depth(len(pending))
                 return
-            inflight.set(len(pending))
+            _depth(len(pending))
             tr = obs.tracer()
             if tr is not None:
                 # the chunk's whole in-flight window on a per-bucket
@@ -643,7 +657,8 @@ def _stream(model, histories, capacity, max_capacity, mesh, bucket,
                                 dispatch_batch_bitdense(
                                     sub, mesh=mesh, min_states=S_max,
                                     min_slots=max(5, C_max),
-                                    min_returns=R_max))
+                                    min_returns=R_max,
+                                    search_stats=search_stats))
                     except sup.DISPATCH_FAILURES as err:
                         degrade_chunk(chunk, err, bstat)
                         bstat["chunks"] += 1
@@ -653,7 +668,7 @@ def _stream(model, histories, capacity, max_capacity, mesh, bucket,
                                     t_issue))
                     bstat["chunks"] += 1
                     reg.counter("pipeline.chunks").inc()
-                    inflight.set(len(pending))
+                    _depth(len(pending))
                     while len(pending) >= depth:
                         drain_one()
             else:
@@ -669,7 +684,8 @@ def _stream(model, histories, capacity, max_capacity, mesh, bucket,
                               keys=len(idxs)):
                     rs = engine._check_batch_sparse(
                         model, sub, capacity, max_capacity, mesh,
-                        dedupe=dedupe, sparse_pallas=sparse_pallas)
+                        dedupe=dedupe, sparse_pallas=sparse_pallas,
+                        search_stats=search_stats)
                 for i, r in zip(idxs, rs):
                     out[i] = r
         while pending:

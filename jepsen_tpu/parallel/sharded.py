@@ -52,10 +52,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from jepsen_tpu import obs
 from jepsen_tpu.parallel.encode import EncodedHistory
-from jepsen_tpu.parallel.engine import (_empty_table,
+from jepsen_tpu.parallel.engine import (N_PROBE_BUCKETS, _empty_table,
                                         _hash_insert_append, _next_pow2,
                                         _resolve_dedupe,
                                         _resolve_probe_limit,
+                                        _resolve_search_stats,
                                         _slot_bits, _tag_sparse_closure,
                                         _xs_from_encoded)
 from jepsen_tpu.parallel.steps import STEPS
@@ -152,7 +153,8 @@ def _route_to_owners(st, ml, mh, legal, n_dev: int, B: int):
 def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
                   my_idx, axes, route_cand, route_front,
                   dedupe: str = "sort", probe_limit: int = 0,
-                  sparse_pallas: str = "off"):
+                  sparse_pallas: str = "off",
+                  search_stats: bool = False):
     """The topology-independent event scan (runs INSIDE shard_map),
     from an explicit initial carry — shared by the fresh-start core and
     the resumable chunk runner.
@@ -195,16 +197,25 @@ def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
     def insert_append(c_st, c_ml, c_mh, c_live, st, ml, mh, count,
                       table):
         """One visited-set transaction — fused kernel when enabled and
-        the static shapes fit, the plain XLA form otherwise."""
+        the static shapes fit, the plain XLA form otherwise. Under
+        `search_stats` an extra trailing element: the probe-length
+        histogram (zeros on the fused-kernel path — the probe offsets
+        never leave the kernel; the stats block notes which
+        implementation ran via the result's closure tag)."""
         if sparse_pallas != "off":
             from jepsen_tpu.parallel import sparse_kernels as sk
             if sk.insert_supported(int(c_st.shape[0]), Nd):
-                return sk.hash_insert_call(
+                out = sk.hash_insert_call(
                     c_st, c_ml, c_mh, c_live, st, ml, mh, count, table,
                     probe_limit, Nd,
                     interpret=(sparse_pallas == "interpret"))
+                if search_stats:
+                    return out + (jnp.zeros(N_PROBE_BUCKETS,
+                                            jnp.int32),)
+                return out
         return _hash_insert_append(c_st, c_ml, c_mh, c_live, st, ml,
-                                   mh, count, table, probe_limit, Nd)
+                                   mh, count, table, probe_limit, Nd,
+                                   stats=search_stats)
 
     step_cc = jax.vmap(
         jax.vmap(step, in_axes=(None, 0, 0, 0, 0)),
@@ -212,12 +223,11 @@ def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
     )
 
     def closure_cond(c):
-        _, _, _, _, changed, overflow, _ = c
-        return changed & ~overflow
+        return c[4] & ~c[5]
 
     def make_closure_body(ev):
         def body(c):
-            st, ml, mh, live, _, _, stepped = c
+            st, ml, mh, live, _, _, stepped = c[:7]
             cand_st, cand_ok = step_cc(
                 st, ev["slot_f"], ev["slot_a0"], ev["slot_a1"],
                 ev["slot_wild"])
@@ -239,8 +249,11 @@ def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
                 all_st, all_ml, all_mh, all_live, Nd, n_dev, my_idx)
             new_n = lax.psum(cnt, axes)
             g_ovf = lax.psum((ovf | route_ovf).astype(jnp.int32), axes) > 0
-            return (st2, ml2, mh2, live2, new_n > old_n, g_ovf,
-                    stepped + old_n)
+            out = (st2, ml2, mh2, live2, new_n > old_n, g_ovf,
+                   stepped + old_n)
+            if search_stats:
+                out = out + (c[7] + 1,)   # closure iterations
+            return out
         return body
 
     def hash_closure_cond(c):
@@ -269,13 +282,13 @@ def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
             # each table (and the frontier) a partition, not a replica
             owner = _hash_config(c_st, c_ml, c_mh) % jnp.uint32(n_dev)
             c_live = c_live & (owner == my_idx)
-            st2, ml2, mh2, table, count2, n_fresh, ins_ovf = \
-                insert_append(c_st, c_ml, c_mh, c_live, st, ml, mh,
-                              count, c["table"])
+            ins = insert_append(c_st, c_ml, c_mh, c_live, st, ml, mh,
+                                count, c["table"])
+            st2, ml2, mh2, table, count2, n_fresh, ins_ovf = ins[:7]
             l_ovf = (ins_ovf | route_ovf).astype(jnp.int32)
             g_new, g_delta, g_ovf = lax.psum(
                 (n_fresh, count - n_old, l_ovf), axes)
-            return {
+            out = {
                 "st": st2,
                 "ml": ml2,
                 "mh": mh2,
@@ -286,38 +299,61 @@ def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
                 "ovf": c["ovf"] | (g_ovf > 0),
                 "stepped": c["stepped"] + g_delta,
             }
+            if search_stats:
+                out["iters"] = c["iters"] + 1
+                # the sort-equivalent work: the whole GLOBAL frontier
+                # this iteration (what sort would have re-stepped)
+                out["swork"] = c["swork"] + lax.psum(count, axes)
+                out["phist"] = c["phist"] + ins[7]
+            return out
         return body
 
     def run_closure(ev, st, ml, mh, live, run, stepped):
-        """-> (st2, ml2, mh2, live2, ovf, stepped2)."""
+        """-> (st2, ml2, mh2, live2, ovf, stepped2, extras) with
+        extras = (iters, swork, phist_local) under search_stats, else
+        None."""
         if dedupe == "sort":
-            st2, ml2, mh2, live2, _, ovf, stepped2 = lax.while_loop(
-                closure_cond, make_closure_body(ev),
-                (st, ml, mh, live, run, jnp.array(False), stepped))
-            return st2, ml2, mh2, live2, ovf, stepped2
+            carry0 = (st, ml, mh, live, run, jnp.array(False), stepped)
+            if search_stats:
+                carry0 = carry0 + (jnp.int32(0),)
+            out = lax.while_loop(closure_cond, make_closure_body(ev),
+                                 carry0)
+            st2, ml2, mh2, live2, _, ovf, stepped2 = out[:7]
+            extras = ((out[7], stepped2 - stepped,
+                       jnp.zeros(N_PROBE_BUCKETS, jnp.int32))
+                      if search_stats else None)
+            return st2, ml2, mh2, live2, ovf, stepped2, extras
         # seed the per-event visited set with the local frontier
         # (owned rows by invariant), compacting it in the same pass;
         # the append overflow arm of insert_append is unreachable here
         # (at most Nd seed rows fit an Nd frontier), so its flag is
         # the pure probe-exhaustion signal the sort of carry expects
-        st0, ml0, mh0, table, m0, _, p0 = insert_append(
+        seed = insert_append(
             st, ml, mh, live, jnp.zeros(Nd, jnp.int32),
             jnp.zeros(Nd, jnp.uint32), jnp.zeros(Nd, jnp.uint32),
             jnp.int32(0), _empty_table(Td))
+        st0, ml0, mh0, table, m0, _, p0 = seed[:7]
         g_p0 = lax.psum(p0.astype(jnp.int32), axes) > 0
+        carry0 = {
+            "st": st0, "ml": ml0, "mh": mh0,
+            "n_old": jnp.int32(0), "count": m0, "table": table,
+            "changed": run, "ovf": g_p0, "stepped": stepped}
+        if search_stats:
+            carry0["iters"] = jnp.int32(0)
+            carry0["swork"] = jnp.int32(0)
+            carry0["phist"] = seed[7]
         out = lax.while_loop(
-            hash_closure_cond, make_hash_closure_body(ev), {
-                "st": st0, "ml": ml0, "mh": mh0,
-                "n_old": jnp.int32(0), "count": m0, "table": table,
-                "changed": run, "ovf": g_p0, "stepped": stepped})
+            hash_closure_cond, make_hash_closure_body(ev), carry0)
         live2 = jnp.arange(Nd) < out["count"]
+        extras = ((out["iters"], out["swork"], out["phist"])
+                  if search_stats else None)
         return (out["st"], out["ml"], out["mh"], live2, out["ovf"],
-                out["stepped"])
+                out["stepped"], extras)
 
     def scan_step(carry, ev):
         st, ml, mh, live, ok, fail_r, r_idx, maxf, stepped = carry
         run = ok & (ev["ev_slot"] >= 0)
-        st2, ml2, mh2, live2, ovf, stepped2 = run_closure(
+        st2, ml2, mh2, live2, ovf, stepped2, extras = run_closure(
             ev, st, ml, mh, live, run, stepped)
         # the hash prologue runs unconditionally (lax.scan cannot skip
         # an event): gate its probe flag so a pad/settled event never
@@ -355,20 +391,44 @@ def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
                                            lax.psum(jnp.sum(live2), axes),
                                            0))
         stepped_o = jnp.where(run, stepped2, stepped)
-        return (st_o, ml_o, mh_o, live_o, new_ok, new_fail,
-                r_idx + 1, maxf, stepped_o), ovf
+        carry_o = (st_o, ml_o, mh_o, live_o, new_ok, new_fail,
+                   r_idx + 1, maxf, stepped_o)
+        if not search_stats:
+            return carry_o, ovf
+        # per-event stats: width/peak/phist are DEVICE-LOCAL (the
+        # per-device variants the host sums/maxes into the
+        # mesh-reduced block); iters/stepped/swork are already global
+        # (the closure's psums synchronize every device)
+        y = {
+            "ovf": ovf,
+            "width": jnp.where(run, jnp.sum(live3),
+                               -1).astype(jnp.int32),
+            "peak": jnp.where(run, jnp.sum(live2), 0).astype(jnp.int32),
+            "iters": jnp.where(run, extras[0], 0).astype(jnp.int32),
+            "stepped": jnp.where(run, stepped2 - stepped,
+                                 0).astype(jnp.int32),
+            "swork": jnp.where(run, extras[1], 0).astype(jnp.int32),
+            "phist": jnp.where(run, extras[2], 0).astype(jnp.int32),
+        }
+        return carry_o, y
 
-    carry, ovfs = lax.scan(scan_step, carry0, xs)
-    return carry, jnp.any(ovfs)
+    carry, ys = lax.scan(scan_step, carry0, xs)
+    if search_stats:
+        return carry, jnp.any(ys["ovf"]), ys
+    return carry, jnp.any(ys)
 
 
 def _sharded_core(xs, state0, step_name: str, Nd: int, n_dev: int,
                   my_idx, axes, route_cand, route_front,
                   dedupe: str = "sort", probe_limit: int = 0,
-                  sparse_pallas: str = "off"):
+                  sparse_pallas: str = "off",
+                  search_stats: bool = False):
     """Fresh-start wrapper over _sharded_scan: seed the initial config
     on its hash-owner device, scan the whole history, reduce to the
-    (valid, fail, overflow, maxf, stepped) scalars."""
+    (valid, fail, overflow, maxf, stepped) scalars — plus, under
+    `search_stats`, the per-event stats dict (width/peak/phist with a
+    leading per-device axis of 1, stacked to [n_dev, R] by the
+    shard_map out_specs; iters/stepped/swork replicated)."""
     # initial config lives on its hash-owner device
     st0v = jnp.full(Nd, state0, jnp.int32)
     owner0 = _hash_config(jnp.int32(state0), jnp.uint32(0),
@@ -377,12 +437,25 @@ def _sharded_core(xs, state0, step_name: str, Nd: int, n_dev: int,
     carry0 = (st0v, jnp.zeros(Nd, jnp.uint32), jnp.zeros(Nd, jnp.uint32),
               live0, jnp.array(True), jnp.int32(-1), jnp.int32(0),
               jnp.int32(1), jnp.int32(0))
-    carry, overflow = _sharded_scan(xs, carry0, step_name, Nd, n_dev,
-                                    my_idx, axes, route_cand, route_front,
-                                    dedupe, probe_limit, sparse_pallas)
+    out = _sharded_scan(xs, carry0, step_name, Nd, n_dev,
+                        my_idx, axes, route_cand, route_front,
+                        dedupe, probe_limit, sparse_pallas,
+                        search_stats)
+    carry, overflow = out[0], out[1]
     _, _, _, live, ok, fail_r, _, maxf, stepped = carry
     valid = ok & (lax.psum(jnp.sum(live), axes) > 0) & ~overflow
-    return valid, fail_r, overflow, maxf, stepped
+    if not search_stats:
+        return valid, fail_r, overflow, maxf, stepped
+    ys = out[2]
+    stats = {
+        "width": ys["width"][None, :],
+        "peak": ys["peak"][None, :],
+        "phist": ys["phist"][None, :, :],
+        "iters": ys["iters"],
+        "stepped": ys["stepped"],
+        "swork": ys["swork"],
+    }
+    return valid, fail_r, overflow, maxf, stepped, stats
 
 
 def _flat_routes(Nd: int, C: int, n_dev: int):
@@ -400,7 +473,8 @@ def _flat_routes(Nd: int, C: int, n_dev: int):
 
 def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int,
                   exchange: str = "route", dedupe: str = "sort",
-                  probe_limit: int = 0, sparse_pallas: str = "off"):
+                  probe_limit: int = 0, sparse_pallas: str = "off",
+                  search_stats: bool = False):
     """1-D topology adapter: flat owner routing over AXIS, or the
     all-gather broadcast (A/B measurement path)."""
     C = xs["slot_f"].shape[1]
@@ -414,7 +488,7 @@ def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int,
         route_cand = route_front = _bcast
     return _sharded_core(xs, state0, step_name, Nd, n_dev, my_idx,
                          (AXIS,), route_cand, route_front, dedupe,
-                         probe_limit, sparse_pallas)
+                         probe_limit, sparse_pallas, search_stats)
 
 
 AX_SLICE, AX_CHIP = "slice", "chip"
@@ -422,7 +496,8 @@ AX_SLICE, AX_CHIP = "slice", "chip"
 
 def _sharded2d_impl(xs, state0, step_name: str, Nd: int,
                     n_slice: int, n_chip: int, dedupe: str = "sort",
-                    probe_limit: int = 0, sparse_pallas: str = "off"):
+                    probe_limit: int = 0, sparse_pallas: str = "off",
+                    search_stats: bool = False):
     """2-D topology adapter (slice x chip): the multi-slice story.
     Owner routing is HIERARCHICAL — stage 1 delivers candidates to the
     owner's chip COLUMN over the intra-slice axis (ICI); stage 2
@@ -457,7 +532,7 @@ def _sharded2d_impl(xs, state0, step_name: str, Nd: int,
         xs, state0, step_name, Nd, D, my_idx, (AX_SLICE, AX_CHIP),
         lambda st, ml, mh, lv: route2(st, ml, mh, lv, B1c, B2c),
         lambda st, ml, mh, lv: route2(st, ml, mh, lv, B1f, B2f),
-        dedupe, probe_limit, sparse_pallas)
+        dedupe, probe_limit, sparse_pallas, search_stats)
 
 
 # donation decision (recompile-donate-argnums) for the three sharded
@@ -466,20 +541,35 @@ def _sharded2d_impl(xs, state0, step_name: str, Nd: int,
 # device arrays re-dispatch at doubled Nd), and the resumable path
 # re-runs a chunk from the same placed carry after overflow — donation
 # would invalidate the retries.
+def _stats_out_specs(dev_axes):
+    """out_specs for the per-event stats dict: width/peak/phist stack
+    their leading per-device axis over the mesh; the psum-synchronized
+    scalars stay replicated."""
+    return {"width": P(dev_axes), "peak": P(dev_axes),
+            "phist": P(dev_axes), "iters": P(), "stepped": P(),
+            "swork": P()}
+
+
 @functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
                    static_argnames=("step_name", "Nd", "n_slice",
                                     "n_chip", "mesh", "dedupe",
-                                    "probe_limit", "sparse_pallas"))
+                                    "probe_limit", "sparse_pallas",
+                                    "search_stats"))
 def _check_sharded2d(xs, state0, step_name: str, Nd: int, n_slice: int,
                      n_chip: int, mesh: Mesh, dedupe: str = "sort",
-                     probe_limit: int = 0, sparse_pallas: str = "off"):
+                     probe_limit: int = 0, sparse_pallas: str = "off",
+                     search_stats: bool = False):
+    out_specs = (P(), P(), P(), P(), P())
+    if search_stats:
+        out_specs = out_specs + (
+            _stats_out_specs((AX_SLICE, AX_CHIP)),)
     fn = _shard_map(
         lambda x, s0: _sharded2d_impl(x, s0, step_name, Nd, n_slice,
                                       n_chip, dedupe, probe_limit,
-                                      sparse_pallas),
+                                      sparse_pallas, search_stats),
         mesh=mesh,
         in_specs=(P(), P()),
-        out_specs=(P(), P(), P(), P(), P()),
+        out_specs=out_specs,
         check_vma=False,
     )
     return fn(xs, state0)
@@ -489,17 +579,23 @@ def _check_sharded2d(xs, state0, step_name: str, Nd: int, n_slice: int,
 @functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
                    static_argnames=("step_name", "Nd", "n_dev",
                                     "mesh", "exchange", "dedupe",
-                                    "probe_limit", "sparse_pallas"))
+                                    "probe_limit", "sparse_pallas",
+                                    "search_stats"))
 def _check_sharded(xs, state0, step_name: str, Nd: int, n_dev: int,
                    mesh: Mesh, exchange: str = "route",
                    dedupe: str = "sort", probe_limit: int = 0,
-                   sparse_pallas: str = "off"):
+                   sparse_pallas: str = "off",
+                   search_stats: bool = False):
+    out_specs = (P(), P(), P(), P(), P())
+    if search_stats:
+        out_specs = out_specs + (_stats_out_specs(AXIS),)
     fn = _shard_map(
         lambda x, s0: _sharded_impl(x, s0, step_name, Nd, n_dev, exchange,
-                                    dedupe, probe_limit, sparse_pallas),
+                                    dedupe, probe_limit, sparse_pallas,
+                                    search_stats),
         mesh=mesh,
         in_specs=(P(), P()),       # tables + state replicated
-        out_specs=(P(), P(), P(), P(), P()),
+        out_specs=out_specs,
         check_vma=False,
     )
     return fn(xs, state0)
@@ -742,13 +838,79 @@ def check_encoded_sharded_resumable(e: EncodedHistory, mesh: Mesh,
     return out
 
 
+def _sharded_stats_block(stats, N: int, Nd: int, n_dev: int,
+                         dedupe: str, n_esc: int) -> dict:
+    """The sharded arm of the JEPSEN_TPU_SEARCH_STATS block:
+    mesh-reduced trajectories (global width/peak per event = sum over
+    devices) plus the per-device variants skew questions need (which
+    device's table runs hottest; whether bucket skew idles part of
+    the mesh)."""
+    width = np.asarray(stats["width"])          # [n_dev, R]
+    peak = np.asarray(stats["peak"])
+    phist = np.asarray(stats["phist"])          # [n_dev, R, B]
+    iters = np.asarray(stats["iters"]).reshape(-1)
+    stepped = np.asarray(stats["stepped"]).reshape(-1)
+    swork = np.asarray(stats["swork"]).reshape(-1)
+    mask = width[0] >= 0   # run is psum-synchronized: all rows agree
+    g_width = width[:, mask].sum(axis=0)
+    g_peak = peak[:, mask].sum(axis=0)
+    frontier_peak = int(g_peak.max()) if g_peak.size else 0
+    stepped_total = int(stepped[mask].sum())
+    swork_total = int(swork[mask].sum())
+    block = {
+        "engine": "sharded",
+        "events": int(mask.sum()),
+        "frontier-width": [int(x) for x in g_width],
+        "closure-iters": [int(x) for x in iters[mask]],
+        "configs-stepped-per-event": [int(x) for x in stepped[mask]],
+        "closure-peak": [int(x) for x in g_peak],
+        "frontier-peak": frontier_peak,
+        "capacity": N,
+        "capacity-tier": n_esc,
+        "peak-occupancy": round(frontier_peak / N, 6) if N else None,
+        "dedupe": dedupe,
+        "devices": n_dev,
+        "delta-split-ratio": (round(stepped_total / swork_total, 6)
+                              if swork_total else None),
+        "table-capacity": None,
+        "load-factor-peak": None,
+        "load-factor-final": None,
+        "probe-hist": None,
+        "probes": None,
+        "per-device": {
+            "width-peak": [int(width[d, mask].max()) if mask.any()
+                           else 0 for d in range(width.shape[0])],
+        },
+    }
+    if dedupe == "hash":
+        from jepsen_tpu.parallel.engine import PROBE_HIST_LABELS
+        Td = _next_pow2(2 * Nd)
+        dev_peak = [int(peak[d, mask].max()) if mask.any() else 0
+                    for d in range(peak.shape[0])]
+        block["table-capacity"] = Td * n_dev   # union of owned tables
+        block["per-device"]["table-capacity"] = Td
+        block["per-device"]["load-factor-peak"] = [
+            round(p / Td, 6) for p in dev_peak]
+        block["load-factor-peak"] = (round(max(dev_peak) / Td, 6)
+                                     if dev_peak else None)
+        if mask.any():
+            block["load-factor-final"] = round(
+                int(peak[:, mask][:, -1].max()) / Td, 6)
+        hist = phist[:, mask].sum(axis=(0, 1)).astype(np.int64)
+        block["probe-hist"] = {lab: int(n) for lab, n in
+                               zip(PROBE_HIST_LABELS, hist)}
+        block["probes"] = int(hist.sum())
+    return block
+
+
 def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
                           capacity: int = 8192,
                           max_capacity: int = 1 << 22,
                           exchange: str = "route",
                           dedupe=None,
                           probe_limit: int = 0,
-                          sparse_pallas=None) -> dict:
+                          sparse_pallas=None,
+                          search_stats=None) -> dict:
     """Check one encoded history with the frontier sharded over `mesh`.
 
     Topology: a mesh whose device array is 2-D (both dims > 1) with
@@ -781,6 +943,7 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
         return {"valid?": True, "max-frontier": 0, "capacity": 0}
     dedupe = _resolve_dedupe(dedupe)
     probe_limit = _resolve_probe_limit(probe_limit)
+    ss = _resolve_search_stats(search_stats)
     # A 2-D device array + "route" = the multi-slice topology: axis 0
     # is the slice (DCN) axis, axis 1 the intra-slice chip (ICI) axis,
     # and the exchange goes hierarchical. Anything else flattens onto
@@ -808,6 +971,9 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
                  jax.device_put(np.int32(e.state0), rep)),
         backend=platform)
     N = max(64 * n_dev, capacity)
+    n_esc = 0
+    from time import perf_counter as _pc
+    t0 = _pc()
     with obs.span("sharded.search", devices=n_dev, dedupe=dedupe,
                   returns=e.n_returns) as sp:
         while True:
@@ -826,21 +992,22 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
                         out = _check_sharded2d(xs, state0, e.step_name,
                                                Nd, n_slice, n_chip,
                                                mesh, dedupe,
-                                               probe_limit, mode)
+                                               probe_limit, mode, ss)
                     else:
                         out = _check_sharded(xs, state0, e.step_name,
                                              Nd, n_dev, mesh, exchange,
-                                             dedupe, probe_limit, mode)
+                                             dedupe, probe_limit, mode,
+                                             ss)
                     # materialize inside the supervised window: async
                     # failures/hangs surface here, not at a host read
-                    return [np.asarray(x) for x in out]
+                    return jax.tree.map(np.asarray, out)
 
                 # supervised dispatch (resilience.supervisor): site
                 # "sharded" so the fault matrix can target the tier
                 # path; failures degrade at the callers (analysis /
                 # engine._escalate_overflow)
-                valid, fail_r, overflow, maxf, stepped = sup.dispatch(
-                    "sharded", _tier, backend=platform)
+                res = sup.dispatch("sharded", _tier, backend=platform)
+                valid, fail_r, overflow, maxf, stepped = res[:5]
                 overflow = bool(overflow)
             if not overflow:
                 break
@@ -850,6 +1017,7 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
                      "error": f"frontier overflow at capacity {N}",
                      "capacity": N, "dedupe": dedupe}, mode, note)
             N *= 2
+            n_esc += 1
             obs.counter("engine.capacity_escalations").inc()
         sp.set(capacity=N)
         if mode != "off":
@@ -860,6 +1028,11 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
     out = {"valid?": bool(valid), "max-frontier": int(maxf),
            "capacity": N, "devices": n_dev, "dedupe": dedupe,
            "configs-stepped": int(stepped)}
+    if ss:
+        from jepsen_tpu.parallel import engine as eng_mod
+        block = _sharded_stats_block(res[5], N, Nd, n_dev, dedupe,
+                                     n_esc)
+        out["stats"] = eng_mod.finish_stats_block(block, t0, _pc())
     _tag_sparse_closure(out, mode, note)
     if hier:
         out["mesh"] = f"{n_slice}x{n_chip} (hierarchical exchange)"
@@ -871,7 +1044,7 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
 
 def analysis(model, history, mesh: Mesh, capacity: int = 8192,
              max_capacity: int = 1 << 22, exchange: str = "route",
-             dedupe=None, sparse_pallas=None) -> dict:
+             dedupe=None, sparse_pallas=None, search_stats=None) -> dict:
     """knossos-style (model, history) -> result with the frontier
     sharded over `mesh`; on failure, counterexample paths come from the
     same windowed host re-search as `engine.analysis` (the seed frontier
@@ -897,7 +1070,8 @@ def analysis(model, history, mesh: Mesh, capacity: int = 8192,
         r = check_encoded_sharded(e, mesh, capacity=capacity,
                                   max_capacity=max_capacity,
                                   exchange=exchange, dedupe=dedupe,
-                                  sparse_pallas=sparse_pallas)
+                                  sparse_pallas=sparse_pallas,
+                                  search_stats=search_stats)
     except sup.DISPATCH_FAILURES as err:
         # degradation contract (docs/resilience.md): a dead sharded
         # tier degrades to the host WGL engine, verdict preserved,

@@ -77,7 +77,8 @@ def supported(N: int, C: int) -> bool:
 
 def frontier_closure_call(step_name: str, ev, st, ml, mh, live, run,
                           N: int, C: int, probe_limit: int,
-                          interpret: bool = False):
+                          interpret: bool = False,
+                          stats: bool = False):
     """Traceable (un-jitted) pallas invocation of one return event's
     whole delta-frontier closure — usable inside the engine's outer
     lax.scan, like pallas_kernels.closure_call. Inputs are the scan
@@ -85,8 +86,14 @@ def frontier_closure_call(step_name: str, ev, st, ml, mh, live, run,
     slot tables ([C] rows of xs), and the run flag; returns
     (st2, ml2, mh2, count, ovf, iters, stepped) exactly as
     engine._hash_event_closure does — because the kernel body IS that
-    function, evaluated on VMEM-resident values."""
-    from jepsen_tpu.parallel.engine import _hash_event_closure, _next_pow2
+    function, evaluated on VMEM-resident values. With `stats`
+    (static; JEPSEN_TPU_SEARCH_STATS), two more outputs exactly as
+    the shared closure returns them: the sort-equivalent work scalar
+    and the probe-length histogram — the search-telemetry trajectory
+    is computed INSIDE the kernel, not inferred from wall clocks."""
+    from jepsen_tpu.parallel.engine import (N_PROBE_BUCKETS,
+                                            _hash_event_closure,
+                                            _next_pow2)
     from jepsen_tpu.parallel.steps import STEPS
     step = STEPS[step_name]
     step_cc = jax.vmap(
@@ -94,36 +101,50 @@ def frontier_closure_call(step_name: str, ev, st, ml, mh, live, run,
         in_axes=(0, None, None, None, None),         # over configs
     )
     T = _next_pow2(2 * N)
+    n_meta = 5 if stats else 4
 
     def kernel(f_ref, a0_ref, a1_ref, w_ref, occ_ref,
                st_ref, ml_ref, mh_ref, lv_ref, run_ref,
-               ost_ref, oml_ref, omh_ref, meta_ref):
+               ost_ref, oml_ref, omh_ref, meta_ref, *phist_ref):
         # bool masks travel as int32 (i1 vectors are the shaky corner
         # of Mosaic); reconstructed at the VMEM boundary
         ev_v = {"slot_f": f_ref[:], "slot_a0": a0_ref[:],
                 "slot_a1": a1_ref[:], "slot_wild": w_ref[:] != 0,
                 "slot_occ": occ_ref[:] != 0}
-        st2, ml2, mh2, count, ovf, iters, stepped = _hash_event_closure(
+        out = _hash_event_closure(
             step_cc, ev_v, st_ref[:], ml_ref[:], mh_ref[:],
-            lv_ref[:] != 0, run_ref[0] != 0, N, C, T, probe_limit)
+            lv_ref[:] != 0, run_ref[0] != 0, N, C, T, probe_limit,
+            stats=stats)
+        st2, ml2, mh2, count, ovf, iters, stepped = out[:7]
         ost_ref[:] = st2
         oml_ref[:] = ml2
         omh_ref[:] = mh2
-        meta_ref[:] = jnp.stack([count.astype(I32), ovf.astype(I32),
-                                 iters.astype(I32), stepped.astype(I32)])
+        meta = [count.astype(I32), ovf.astype(I32),
+                iters.astype(I32), stepped.astype(I32)]
+        if stats:
+            meta.append(out[7].astype(I32))   # swork
+            phist_ref[0][:] = out[8].astype(I32)
+        meta_ref[:] = jnp.stack(meta)
 
-    st2, ml2, mh2, meta = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct((N,), I32),
+                 jax.ShapeDtypeStruct((N,), U32),
+                 jax.ShapeDtypeStruct((N,), U32),
+                 jax.ShapeDtypeStruct((n_meta,), I32)]
+    if stats:
+        out_shape.append(jax.ShapeDtypeStruct((N_PROBE_BUCKETS,), I32))
+    outs = pl.pallas_call(
         kernel,
-        out_shape=(jax.ShapeDtypeStruct((N,), I32),
-                   jax.ShapeDtypeStruct((N,), U32),
-                   jax.ShapeDtypeStruct((N,), U32),
-                   jax.ShapeDtypeStruct((4,), I32)),
+        out_shape=tuple(out_shape),
         interpret=interpret,
     )(ev["slot_f"], ev["slot_a0"], ev["slot_a1"],
       ev["slot_wild"].astype(I32), ev["slot_occ"].astype(I32),
       st, ml, mh, live.astype(I32),
       jnp.reshape(run, (1,)).astype(I32))
-    return (st2, ml2, mh2, meta[0], meta[1] != 0, meta[2], meta[3])
+    st2, ml2, mh2, meta = outs[:4]
+    base = (st2, ml2, mh2, meta[0], meta[1] != 0, meta[2], meta[3])
+    if stats:
+        return base + (meta[4], outs[4])
+    return base
 
 
 def hash_insert_call(c_st, c_ml, c_mh, c_live, st, ml, mh, count,

@@ -116,6 +116,11 @@ class CircuitBreaker:
     def _gauge(self):
         obs.gauge(f"resilience.breaker.{self.backend}.state").set(
             _STATE_GAUGE[self._state])
+        # Perfetto counter track: breaker flips render as steps on the
+        # trace timeline, aligned with the dispatch spans that caused
+        # them (no-op with tracing off)
+        obs.counter_sample(f"resilience.breaker.{self.backend}.state",
+                           _STATE_GAUGE[self._state])
 
     # -- transitions
 

@@ -307,6 +307,10 @@ class CheckerService:
                 obs.counter("serve.deltas").inc()
                 obs.counter("serve.delta_ops").inc(len(ops))
                 obs.gauge("serve.pending_ops").set(self._pending_ops)
+                # Perfetto counter track: queue depth over time lines
+                # up with the stream/dispatch spans (no-op untraced)
+                obs.counter_sample("serve.pending_ops",
+                                   self._pending_ops)
                 self._cond.notify_all()
         if shed is not None:
             # overload IS the postmortem moment: an armed flight
@@ -508,7 +512,7 @@ class CheckerService:
                          else "idle")   # admitted nothing yet (e.g.
                 # every delta shed): no frontier was ever built, so
                 # "evicted" would imply a checkpoint that isn't there
-                rows.append((ks.key, {
+                row = {
                     "seq": ks.applied_seq,
                     "enq_seq": ks.enq_seq,
                     "pending_deltas": len(ks.pending),
@@ -520,7 +524,21 @@ class CheckerService:
                     "resilience": r.get("resilience"),
                     "wal_dead": ks.wal_dead,
                     "acct": dict(ks.acct),
-                }))
+                }
+                if r.get("stats"):
+                    # JEPSEN_TPU_SEARCH_STATS: the key's lifetime
+                    # search telemetry, trajectories summarized (the
+                    # full per-event lists stay in the run-dir record
+                    # — a /status scrape must stay small)
+                    s = r["stats"]
+                    row["stats"] = {
+                        k: s.get(k) for k in
+                        ("events", "frontier-peak", "peak-occupancy",
+                         "capacity", "capacity-tier", "dedupe",
+                         "delta-split-ratio", "load-factor-peak",
+                         "probe-hist", "pad-waste")
+                        if s.get(k) is not None}
+                rows.append((ks.key, row))
             doc = {"pending_ops": self._pending_ops,
                    "max_pending_seen": self.max_pending_seen,
                    "high_water": self.high_water,
@@ -676,6 +694,7 @@ class CheckerService:
             batch.append((ks, ops, last_seq, final))
         if batch:
             obs.gauge("serve.pending_ops").set(self._pending_ops)
+            obs.counter_sample("serve.pending_ops", self._pending_ops)
             self._cond.notify_all()   # queue space freed: release
             # blocked producers now, not after the device work
         return batch

@@ -303,6 +303,50 @@ def test_bench_stream_section_contract(monkeypatch, capsys):
     assert 'os.environ.get("BENCH_STREAM") == "1"' in src
 
 
+def test_bench_search_stats_line_gated_on_flag(monkeypatch, capsys):
+    """The stats-gated occupancy/pad-waste advisory (ISSUE 10): with
+    JEPSEN_TPU_SEARCH_STATS unset, emit_search_stats is a no-op — the
+    default bench schema stays byte-identical (the sharded-section
+    single-line pin above covers the section path); with the flag on,
+    one advisory line summarizing the results' device-computed stats
+    blocks."""
+    import bench
+
+    results = [{"valid?": True,
+                "stats": {"engine": "sparse", "events": 10,
+                          "frontier-peak": 40,
+                          "peak-occupancy": 0.3125,
+                          "load-factor-peak": 0.15625,
+                          "capacity-tier": 1,
+                          "pad-waste": 0.25,
+                          "probe-hist": {"0": 90, "1": 10}}},
+               {"valid?": True, "stats": {"engine": "sparse",
+                                          "events": 4,
+                                          "frontier-peak": 8,
+                                          "peak-occupancy": 0.0625,
+                                          "capacity-tier": 0}}]
+    monkeypatch.delenv("JEPSEN_TPU_SEARCH_STATS", raising=False)
+    bench.emit_search_stats("testsec", results)
+    assert _json_lines(capsys.readouterr().out) == []
+    # results without stats blocks (flag raced off mid-run) stay quiet
+    monkeypatch.setenv("JEPSEN_TPU_SEARCH_STATS", "1")
+    bench.emit_search_stats("testsec", [{"valid?": True}])
+    assert _json_lines(capsys.readouterr().out) == []
+    bench.emit_search_stats("testsec", results, {"L": 64})
+    lines = _json_lines(capsys.readouterr().out)
+    assert len(lines) == 1, lines
+    line = lines[0]
+    assert line["unit"] == "peak-occupancy"
+    assert line["value"] == 0.3125          # max over keys
+    assert line["keys"] == 2 and line["L"] == 64
+    assert line["frontier_peak"] == 40
+    assert line["load_factor_peak"] == 0.15625
+    assert line["pad_waste_max"] == 0.25
+    assert line["probe_hist"] == {"0": 90, "1": 10}
+    assert line["escalated_keys"] == 1
+    assert "JEPSEN_TPU_SEARCH_STATS" in line["metric"]
+
+
 def test_bench_emit_trace_pointer_gated_on_tracing(monkeypatch,
                                                    capsys):
     """Sections stamp `trace=<relpath>` onto their JSON lines exactly

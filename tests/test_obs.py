@@ -41,11 +41,14 @@ def _clean_obs(monkeypatch):
 
     monkeypatch.delenv("JEPSEN_TPU_TRACE", raising=False)
     monkeypatch.delenv("JEPSEN_TPU_JAX_PROFILE", raising=False)
+    monkeypatch.delenv("JEPSEN_TPU_SEARCH_STATS", raising=False)
     obs.reset()
+    obs.drain_search_stats()
     export_mod._last_reg_snapshot = {}
     yield
     obs.reset()
     obs.registry().reset()
+    obs.drain_search_stats()
     export_mod._last_reg_snapshot = {}
 
 
@@ -317,7 +320,13 @@ def test_chrome_trace_schema(tmp_path):
     with open(path) as fh:
         events = json.load(fh)     # valid JSON document
     assert isinstance(events, list) and events
-    assert {e["ph"] for e in events} <= {"X", "M"}
+    # "C" joined the set with the counter tracks (pipeline.inflight
+    # samples ride every traced pipelined run)
+    assert {e["ph"] for e in events} <= {"X", "M", "C"}
+    cs = [e for e in events if e["ph"] == "C"]
+    assert any(e["name"] == "pipeline.inflight" for e in cs), cs
+    for e in cs:
+        assert "value" in e["args"] and e["ts"] >= 0
     procs = {e["args"]["name"] for e in events
              if e["ph"] == "M" and e["name"] == "process_name"}
     assert procs == {"host", "device"}

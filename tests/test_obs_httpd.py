@@ -33,14 +33,16 @@ def _clean_obs_state(monkeypatch):
     assertions below read deltas or their own names)."""
     for flag in ("JEPSEN_TPU_TRACE", "JEPSEN_TPU_FLIGHT_RECORDER",
                  "JEPSEN_TPU_FAULTS", "JEPSEN_TPU_WATCHDOG",
-                 "JEPSEN_TPU_OPS_PORT"):
+                 "JEPSEN_TPU_OPS_PORT", "JEPSEN_TPU_SEARCH_STATS"):
         monkeypatch.delenv(flag, raising=False)
     obs.reset()
     obs.flight_reset()
+    obs.drain_search_stats()
     resilience.reset()
     yield
     obs.reset()
     obs.flight_reset()
+    obs.drain_search_stats()
     resilience.reset()
 
 
@@ -271,6 +273,44 @@ def test_status_per_key_accounting_and_cli(capsys):
                                     "--metrics"])
         out = capsys.readouterr().out
         assert rc == 0 and "jepsen_serve_deltas" in out
+    finally:
+        ops.close()
+        svc.close()
+
+
+def test_status_search_stats_row_and_metrics_quantiles(monkeypatch,
+                                                       capsys):
+    """ISSUE 10 wiring on the ops surface: with JEPSEN_TPU_SEARCH_
+    STATS on, a served key's /status row carries its summarized
+    lifetime stats block and /metrics serves jepsen_engine_search_*;
+    `jepsen status --metrics` answers quantiles, `--raw` the
+    exposition text. Flag off (every other test here): no "stats" key
+    in any row — the schema pin rides the existing tests."""
+    monkeypatch.setenv("JEPSEN_TPU_SEARCH_STATS", "1")
+    h = list(rand_register_history(n_ops=24, n_processes=4, seed=12))
+    svc = _service(dedupe="hash")
+    ops = _ops_for(svc)
+    try:
+        assert svc.submit("k1", h, wait=True,
+                          timeout=120).get("valid?") is not None
+        code, body = _get(ops.url("/status"))
+        row = json.loads(body)["keys"]['"k1"']
+        st = row["stats"]
+        assert st["events"] > 0 and st["frontier-peak"] > 0
+        assert st["dedupe"] == "hash" and "probe-hist" in st
+        # the summarized form stays scrape-sized: no trajectories
+        assert "frontier-width" not in st
+        code, body = _get(ops.url("/metrics"))
+        assert "jepsen_engine_search_events" in body
+        assert "jepsen_engine_search_frontier_peak" in body
+        rc = ops_httpd.status_main(["--port", str(ops.port),
+                                    "--metrics"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "p99" in out and 'le="' not in out
+        rc = ops_httpd.status_main(["--port", str(ops.port),
+                                    "--metrics", "--raw"])
+        out = capsys.readouterr().out
+        assert rc == 0 and 'le="' in out
     finally:
         ops.close()
         svc.close()

@@ -632,10 +632,10 @@ def test_pallas_mesh_fallback_survives_supervision(monkeypatch):
                                              use_pallas=False)
     real = bitdense._check_bitdense_batch
 
-    def failing_on_pallas(*args):
+    def failing_on_pallas(*args, **kw):
         if args[6]:  # use_pallas
             raise RuntimeError("Mosaic lowering gap (simulated)")
-        return real(*args)
+        return real(*args, **kw)
 
     monkeypatch.setattr(bitdense, "_check_bitdense_batch",
                         failing_on_pallas)

@@ -1,0 +1,565 @@
+"""Device-resident search telemetry (JEPSEN_TPU_SEARCH_STATS).
+
+The ISSUE 10 contracts:
+
+1. PARITY — stats-on vs stats-off results are identical (verdict,
+   op/fail-event, max-frontier, configs-stepped, every key) across
+   the five packable families x sort/hash dedupe x the
+   serial/pipelined/sharded/resumable/streaming paths; stats-off
+   result dicts carry NO "stats" key (byte-identical schema).
+2. SCHEMA — the "stats" block's fields are pinned (the four sinks'
+   consumers read them); trajectories cover exactly the real events.
+3. SINKS — /metrics serves jepsen_engine_search_*; the Chrome trace
+   gains "C" counter-track events (engine.search.* only with the flag
+   on, pipeline.inflight / breaker state / serve queue depth from the
+   satellite sample sites); export_run writes search_stats.jsonl and
+   `jepsen report --search` renders it.
+4. NO-OP — with the flag unset the disabled paths meet the PR-4
+   standard: counter_sample with tracing off retains zero allocations,
+   and no engine.search series/tracks/records appear anywhere.
+"""
+
+import json
+import os
+import tracemalloc
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import envflags, obs
+from jepsen_tpu.histories import (corrupt_history, rand_fifo_history,
+                                  rand_gset_history, rand_queue_history,
+                                  rand_register_history)
+from jepsen_tpu.history import History, invoke_op, ok_op
+from jepsen_tpu.models import (CASRegister, FIFOQueue, GSet, Mutex,
+                               UnorderedQueue)
+from jepsen_tpu.parallel import encode as enc_mod
+from jepsen_tpu.parallel import engine
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs(monkeypatch):
+    import jepsen_tpu.obs.export as export_mod
+
+    monkeypatch.delenv("JEPSEN_TPU_TRACE", raising=False)
+    monkeypatch.delenv("JEPSEN_TPU_SEARCH_STATS", raising=False)
+    obs.reset()
+    obs.drain_search_stats()
+    export_mod._last_reg_snapshot = {}
+    yield
+    obs.reset()
+    obs.registry().reset()
+    obs.drain_search_stats()
+    export_mod._last_reg_snapshot = {}
+
+
+def _h(*ops):
+    return History.wrap(ops).index()
+
+
+FAMILIES = [
+    ("register", CASRegister(),
+     lambda s: rand_register_history(n_ops=28, n_processes=4,
+                                     n_values=3, crash_p=0.05,
+                                     fail_p=0.05, seed=s)),
+    ("gset", GSet(),
+     lambda s: rand_gset_history(n_ops=24, n_processes=4, n_elements=5,
+                                 crash_p=0.06, seed=s)),
+    ("uqueue", UnorderedQueue(),
+     lambda s: rand_queue_history(n_ops=24, n_processes=4, n_values=3,
+                                  crash_p=0.06, seed=s)),
+    ("fifo", FIFOQueue(),
+     lambda s: rand_fifo_history(n_ops=24, n_processes=4, n_values=3,
+                                 crash_p=0.15, seed=s)),
+]
+
+
+def _mutex_invalid():
+    return _h(invoke_op(0, "acquire", None), ok_op(0, "acquire", None),
+              invoke_op(1, "acquire", None), ok_op(1, "acquire", None))
+
+
+# ----------------------------------------------------------- parity
+
+
+@pytest.mark.parametrize("name,model,gen", FAMILIES,
+                         ids=[f[0] for f in FAMILIES])
+@pytest.mark.parametrize("dedupe", ["sort", "hash"])
+def test_parity_check_encoded_families(name, model, gen, dedupe):
+    """Stats on/off verdict parity + schema pin, clean and corrupted,
+    sparse engine."""
+    for i, h in enumerate([gen(3), corrupt_history(gen(9), seed=1,
+                                                   n_corruptions=2)]):
+        e = enc_mod.encode(model, History.wrap(h))
+        r_off = engine.check_encoded(e, capacity=128, dedupe=dedupe)
+        r_on = engine.check_encoded(e, capacity=128, dedupe=dedupe,
+                                    search_stats=True)
+        assert "stats" not in r_off
+        s = r_on.pop("stats")
+        assert r_off == r_on, (name, dedupe, i, r_off, r_on)
+        _pin_schema(s, e, dedupe)
+
+
+def _pin_schema(s, e, dedupe):
+    """The stats-block schema pin (the contract every sink reads)."""
+    assert s["events"] == len(s["frontier-width"]) \
+        == len(s["closure-iters"]) \
+        == len(s["configs-stepped-per-event"]) == len(s["closure-peak"])
+    # the trajectory stops at the failing event, never past R
+    assert 0 < s["events"] <= e.n_returns
+    assert s["frontier-peak"] == max(s["closure-peak"])
+    assert s["dedupe"] == dedupe
+    assert s["capacity"] >= 64 and s["capacity-tier"] >= 0
+    assert 0 < s["peak-occupancy"] <= 1
+    assert sum(s["configs-stepped-per-event"]) > 0
+    if dedupe == "hash":
+        assert s["table-capacity"] == engine._next_pow2(
+            2 * s["capacity"])
+        assert 0 < s["load-factor-peak"] <= 0.5 + 1e-9
+        assert set(s["probe-hist"]) == set(engine.PROBE_HIST_LABELS)
+        assert s["probes"] == sum(s["probe-hist"].values()) > 0
+        assert 0 < s["delta-split-ratio"] <= 1.0
+    else:
+        assert s["table-capacity"] is None
+        assert s["load-factor-peak"] is None
+        assert s["probe-hist"] is None
+        assert s["delta-split-ratio"] == 1.0
+
+
+def test_parity_mutex_invalid_and_sparse_pallas():
+    m = Mutex()
+    e = enc_mod.encode(m, _mutex_invalid())
+    r_off = engine.check_encoded(e, capacity=64, dedupe="hash")
+    r_on = engine.check_encoded(e, capacity=64, dedupe="hash",
+                                search_stats=True)
+    s = r_on.pop("stats")
+    assert r_off == r_on and r_off["valid?"] is False
+    # the failing event closes the trajectory with width 0
+    assert s["frontier-width"][-1] == 0
+    # the fused pallas kernel (interpret) computes the SAME stats
+    r_pk = engine.check_encoded(e, capacity=64, dedupe="hash",
+                                sparse_pallas=True, search_stats=True)
+    s_pk = r_pk.pop("stats")
+    assert r_pk["closure"] == "pallas"
+    s_pk.pop("engine"), s.pop("engine")
+    assert s_pk == s
+
+
+def test_parity_batch_and_pipelined():
+    model = CASRegister()
+    hs = [rand_register_history(n_ops=26, n_processes=4, crash_p=0.04,
+                                seed=500 + s) for s in range(5)]
+    hs[2] = corrupt_history(hs[2], seed=2, n_corruptions=2)
+    r_off = engine.check_batch(model, hs)
+    r_on = engine.check_batch(model, hs, search_stats=True)
+    for a, b in zip(r_off, r_on):
+        assert "stats" not in a
+        s = b.pop("stats")
+        assert a == b
+        # bitdense batch: dense engine block + pad-waste fields
+        assert s["engine"] == "bitdense" and s["dedupe"] == "dense"
+        assert 0 <= s["pad-waste"] < 1 and s["pad-events"] >= 0
+        assert s["events"] >= 1
+    # pipelined executor: same verdicts, same per-key trajectories
+    # (chunks pad to the bucket dims, pads filter out on device)
+    r_on2 = engine.check_batch(model, hs, search_stats=True)
+    r_pipe = engine.check_batch(model, hs, pipeline=True, cache=False,
+                                search_stats=True)
+    for a, b in zip(r_on2, r_pipe):
+        sa, sb = a.pop("stats"), b.pop("stats")
+        assert a == b
+        assert sa["frontier-width"] == sb["frontier-width"]
+
+
+def test_parity_sparse_batch_pad_waste():
+    """_check_batch_sparse: per-key stats + pad-waste measured against
+    the padded program dims."""
+    model = CASRegister()
+    pres = [enc_mod.encode(model, History.wrap(
+        rand_register_history(n_ops=18 + 8 * s, n_processes=4,
+                              seed=600 + s))) for s in range(3)]
+    r_off = engine._check_batch_sparse(model, pres, 128, 1 << 18,
+                                       dedupe="hash")
+    r_on = engine._check_batch_sparse(model, pres, 128, 1 << 18,
+                                      dedupe="hash", search_stats=True)
+    R_pad = max(e.n_returns for e in pres)
+    C_pad = max(e.slot_f.shape[1] for e in pres)
+    blocks = []
+    for e, a, b in zip(pres, r_off, r_on):
+        s = b.pop("stats")
+        blocks.append(s)
+        assert a == b
+        assert s["events"] == e.n_returns
+        want = 1.0 - (e.n_returns * e.slot_f.shape[1]) / (R_pad * C_pad)
+        assert s["pad-waste"] == pytest.approx(want, abs=1e-6)
+    # the biggest key pads nothing
+    big = max(range(3), key=lambda i: pres[i].n_returns)
+    assert blocks[big]["pad-events"] == 0
+
+
+def test_parity_bitdense_single():
+    model = CASRegister()
+    h = rand_register_history(n_ops=30, n_processes=4, seed=7)
+    e = enc_mod.encode(model, History.wrap(h))
+    r_off = engine.analysis(model, h)
+    r_on = engine.analysis(model, h, search_stats=True)
+    s = r_on.pop("stats")
+    assert r_off == r_on and r_off["engine"] == "bitdense"
+    assert s["engine"] == "bitdense"
+    assert s["events"] == e.n_returns
+    assert s["config-space"] == r_off["states"] * (1 << r_off["slots"])
+    assert s["frontier-peak"] == max(s["frontier-width"])
+    assert 0 < s["peak-occupancy"] <= 1
+
+
+def test_parity_sharded():
+    import jax
+    from jax.sharding import Mesh
+    from jepsen_tpu.parallel import sharded
+
+    model = CASRegister()
+    h = rand_register_history(n_ops=36, n_processes=4, crash_p=0.05,
+                              seed=21)
+    e = enc_mod.encode(model, History.wrap(h))
+    mesh = Mesh(np.array(jax.devices()[:4]), ("frontier",))
+    for dedupe in ("sort", "hash"):
+        r_off = sharded.check_encoded_sharded(e, mesh, capacity=256,
+                                              dedupe=dedupe)
+        r_on = sharded.check_encoded_sharded(e, mesh, capacity=256,
+                                             dedupe=dedupe,
+                                             search_stats=True)
+        s = r_on.pop("stats")
+        assert r_off == r_on
+        assert s["engine"] == "sharded" and s["devices"] == 4
+        assert s["events"] == e.n_returns
+        # mesh-reduced peak equals the result's global max-frontier
+        assert s["frontier-peak"] == r_off["max-frontier"]
+        assert len(s["per-device"]["width-peak"]) == 4
+        if dedupe == "hash":
+            assert s["probes"] > 0
+            assert len(s["per-device"]["load-factor-peak"]) == 4
+    # sharded stats agree with the single-device engine's trajectory
+    r1 = engine.check_encoded(e, capacity=256, dedupe="hash",
+                              search_stats=True)
+    assert s["frontier-width"] == r1["stats"]["frontier-width"]
+
+
+def test_parity_resumable_and_stream_lifetime():
+    model = CASRegister()
+    h = list(rand_register_history(n_ops=40, n_processes=4,
+                                   crash_p=0.05, seed=31))
+    e = enc_mod.encode(model, History.wrap(h))
+    ref = engine.check_encoded(e, capacity=128, dedupe="hash",
+                               search_stats=True)
+    r_off = engine.check_encoded_resumable(e, capacity=128,
+                                           checkpoint_every=8,
+                                           dedupe="hash")
+    r_on = engine.check_encoded_resumable(e, capacity=128,
+                                          checkpoint_every=8,
+                                          dedupe="hash",
+                                          search_stats=True)
+    s = r_on.pop("stats")
+    assert r_off == r_on
+    for k in ("frontier-width", "closure-iters", "probe-hist",
+              "configs-stepped-per-event"):
+        assert s[k] == ref["stats"][k], k
+
+    # streaming session: lifetime stats == the one-shot block of the
+    # full prefix, across deltas and the splice-at-resume re-scan
+    from jepsen_tpu.parallel.extend import HistorySession
+    n = len(h)
+    s0 = HistorySession(model, capacity=128, dedupe="hash")
+    s1 = HistorySession(model, capacity=128, dedupe="hash",
+                        search_stats=True, key="k")
+    last = None
+    for a, b in [(0, n // 3), (n // 3, 2 * n // 3), (2 * n // 3, n)]:
+        s0.extend(h[a:b]), s1.extend(h[a:b])
+        r0, r1 = s0.check(), s1.check()
+        last = r1.pop("stats")
+        assert r0 == r1
+    assert last["engine"] == "stream"
+    for k in ("frontier-width", "closure-iters", "probe-hist",
+              "delta-split-ratio"):
+        assert last[k] == ref["stats"][k], k
+
+
+def test_parity_batched_advance():
+    from jepsen_tpu.parallel.extend import HistorySession, \
+        advance_sessions
+
+    model = CASRegister()
+    hs = [list(rand_register_history(n_ops=28, n_processes=4,
+                                     seed=700 + i)) for i in range(3)]
+    ss = [HistorySession(model, capacity=128, dedupe="hash",
+                         search_stats=True, key=f"k{i}")
+          for i in range(3)]
+    refs = [HistorySession(model, capacity=128, dedupe="hash")
+            for _ in range(3)]
+    for half in (0, 1):
+        for s, sr, h in zip(ss, refs, hs):
+            k = len(h) // 2
+            d = h[:k] if half == 0 else h[k:]
+            s.extend(d), sr.extend(d)
+        rs = advance_sessions(ss)
+        rrs = [sr.check() for sr in refs]
+    for r, rr, h in zip(rs, rrs, hs):
+        st = r.pop("stats")
+        assert r == rr
+        e = enc_mod.encode(model, History.wrap(h))
+        one = engine.check_encoded(e, capacity=128, dedupe="hash",
+                                   search_stats=True)["stats"]
+        assert st["frontier-width"] == one["frontier-width"]
+
+
+def test_parity_independent_per_key_stats():
+    from jepsen_tpu import independent
+    from jepsen_tpu.checker import linearizable
+    from jepsen_tpu.history import invoke_op as inv, ok_op as ok
+    from jepsen_tpu.independent import KV
+
+    ops = [inv(0, "write", KV("x", 1)), ok(0, "write", KV("x", 1)),
+           inv(0, "read", KV("x", None)), ok(0, "read", KV("x", 1)),
+           inv(1, "write", KV("y", 2)), ok(1, "write", KV("y", 2)),
+           inv(1, "read", KV("y", None)), ok(1, "read", KV("y", 5))]
+    h = History.wrap(ops).index()
+    lin = linearizable(CASRegister(), algorithm="jax")
+    r_off = independent.checker(lin).check({}, h)
+    r_on = independent.checker(lin, search_stats=True).check({}, h)
+    assert r_off["valid?"] is r_on["valid?"] is False
+    for k in ("x", "y"):
+        s = r_on["results"][k].pop("stats")
+        assert s["events"] >= 1 and s["engine"] == "bitdense"
+        assert "stats" not in r_off["results"][k]
+    assert r_on["failures"] == r_off["failures"] == ["y"]
+
+
+# ------------------------------------------------------------- sinks
+
+
+def test_metrics_registry_and_prometheus():
+    from jepsen_tpu.obs import httpd
+
+    model = CASRegister()
+    h = rand_register_history(n_ops=30, n_processes=4, seed=41)
+    e = enc_mod.encode(model, History.wrap(h))
+    engine.check_encoded(e, capacity=128, dedupe="hash",
+                         search_stats=True)
+    snap = obs.registry().snapshot()
+    assert snap["engine.search.events"]["value"] == e.n_returns
+    assert snap["engine.search.frontier_peak"]["value"] > 0
+    assert any(k.startswith("engine.search.probe_len.") for k in snap)
+    body = httpd.render_prometheus()
+    assert "jepsen_engine_search_events" in body
+    assert "jepsen_engine_search_frontier_peak" in body
+    assert "jepsen_engine_search_probe_len_0" in body
+
+
+def test_counter_tracks_in_chrome_trace():
+    tr = obs.configure(True)
+    model = CASRegister()
+    h = rand_register_history(n_ops=30, n_processes=4, seed=42)
+    e = enc_mod.encode(model, History.wrap(h))
+    r = engine.check_encoded(e, capacity=128, dedupe="hash",
+                             search_stats=True)
+    events = obs.chrome_trace(tr)
+    cs = [ev for ev in events if ev["ph"] == "C"]
+    widths = [ev["args"]["value"] for ev in cs
+              if ev["name"] == "engine.search.frontier_width"]
+    assert widths == r["stats"]["frontier-width"]
+    lfs = [ev for ev in cs if ev["name"] == "engine.search.load_factor"]
+    assert len(lfs) == len(widths)
+    # samples live inside the trace's time base
+    assert all(ev["ts"] >= 0 for ev in cs)
+
+
+def test_counter_track_sample_cap():
+    from jepsen_tpu.parallel.engine import (STATS_TRACK_MAX_SAMPLES,
+                                            _emit_stats_tracks)
+
+    obs.configure(True)
+    n = 4 * STATS_TRACK_MAX_SAMPLES
+    block = {"frontier-width": list(range(n)),
+             "closure-peak": list(range(n)), "table-capacity": None}
+    _emit_stats_tracks(block, 0.0, 1.0)
+    cs = obs.tracer().counters()
+    assert 0 < len(cs) <= STATS_TRACK_MAX_SAMPLES + 1
+
+
+def test_breaker_and_gauge_counter_tracks():
+    from jepsen_tpu.resilience.breaker import CircuitBreaker
+
+    obs.configure(True)
+    br = CircuitBreaker("testbk", threshold=2, backoff_base=1.0,
+                        clock=lambda: 0.0, probe=lambda: True)
+    br.record_failure("x")
+    br.record_failure("x")
+    names = [c[0] for c in obs.tracer().counters()]
+    assert "resilience.breaker.testbk.state" in names
+    # the last sample carries the OPEN state (2)
+    vals = [c[2] for c in obs.tracer().counters()
+            if c[0] == "resilience.breaker.testbk.state"]
+    assert vals[-1] == 2
+
+
+def test_export_run_and_report(tmp_path):
+    from jepsen_tpu.obs import search_report
+
+    model = CASRegister()
+    hs = [rand_register_history(n_ops=24, n_processes=4, seed=800 + s)
+          for s in range(3)]
+    for h in hs:
+        e = enc_mod.encode(model, History.wrap(h))
+        engine.check_encoded(e, capacity=128, dedupe="hash",
+                             search_stats=True)
+    # tracing OFF: export still writes the search-stats artifact
+    out = obs.export_run(str(tmp_path))
+    assert out == {"search_stats": str(tmp_path / "search_stats.jsonl")}
+    recs = [json.loads(ln) for ln in
+            open(tmp_path / "search_stats.jsonl")]
+    assert len(recs) == 3 and all("frontier-width" in r for r in recs)
+    # drained: a second export with nothing new is a clean None
+    assert obs.export_run(str(tmp_path)) is None
+    rc = search_report.report_main(
+        ["--search", "--run-dir", str(tmp_path)])
+    assert rc == 0
+    txt = open(tmp_path / "search_report.txt").read()
+    assert "Search telemetry report" in txt
+    assert "load factor" in txt
+    # no stats file in an empty dir -> exit 1, not a crash
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert search_report.report_main(
+        ["--search", "--run-dir", str(empty)]) == 1
+
+
+def test_collector_keeps_newest_records():
+    """Past the bound the OLDEST record drops: a streamed key's
+    freshest lifetime block must survive a long soak (the report keeps
+    newest-per-key)."""
+    from jepsen_tpu.obs import export as export_mod
+
+    cap = export_mod.SEARCH_STATS_MAX_RECORDS
+    for i in range(cap + 7):
+        obs.record_search_stats({"key": "k", "i": i})
+    recs = obs.drain_search_stats()
+    assert len(recs) == cap
+    assert recs[-1]["i"] == cap + 6 and recs[0]["i"] == 7
+    assert obs.registry().snapshot()[
+        "obs.search_stats_dropped"]["value"] == 7
+
+
+def test_trajectory_cap_marks_truncated():
+    """Lifetime trajectories are bounded (serve keys must stay
+    bounded-memory); past the cap the block says so instead of
+    silently covering everything."""
+    n = engine.SEARCH_STATS_MAX_EVENTS
+    acc = engine.SearchStats("hash")
+    chunk = {k: np.ones(n + 10, np.int32)
+             for k in ("width", "peak", "iters", "stepped", "swork")}
+    chunk["phist"] = np.ones((n + 10, engine.N_PROBE_BUCKETS), np.int32)
+    acc.add_chunk(chunk, 64)
+    b = acc.block()
+    assert b["events"] == n and b["truncated"] is True
+    small = engine.SearchStats("hash")
+    small.add_chunk({k: v[:4] for k, v in chunk.items()}, 64)
+    assert "truncated" not in small.block()
+
+
+def test_status_metrics_quantiles():
+    from jepsen_tpu.obs import httpd
+
+    h = obs.histogram("serve.ack_secs")
+    for v in [0.0002] * 50 + [0.02] * 5 + [0.5]:
+        h.observe(v)
+    obs.histogram("serve.verdict_secs").observe(0.003)
+    obs.counter("serve.deltas").inc(2)
+    body = httpd.render_prometheus()
+    summary = httpd.render_metrics_summary(body)
+    # quantiles, not raw buckets: the SLO histograms answer p50/p95/p99
+    assert "jepsen_serve_ack_secs" in summary
+    assert "p50" in summary and "p95" in summary and "p99" in summary
+    assert 'le="' not in summary          # raw buckets stay in --raw
+    assert "jepsen_serve_deltas" in summary
+    # the parsed quantiles match hist_quantile over the live snapshot
+    snap = obs.registry().snapshot()["serve.ack_secs"]
+    parsed = httpd.parse_prometheus(body)["jepsen_serve_ack_secs"]
+    for q in (0.5, 0.95, 0.99):
+        assert obs.hist_quantile(parsed, q) == \
+            obs.hist_quantile(snap, q)
+    # past-the-ladder observations: the histogram _max twin keeps p99
+    # answerable (the overloaded-SLO case), equal to the live snapshot
+    for _ in range(20):
+        h.observe(120.0)
+    body = httpd.render_prometheus()
+    assert "jepsen_serve_ack_secs_max 120" in body
+    parsed = httpd.parse_prometheus(body)["jepsen_serve_ack_secs"]
+    snap = obs.registry().snapshot()["serve.ack_secs"]
+    assert obs.hist_quantile(parsed, 0.99) \
+        == obs.hist_quantile(snap, 0.99) == 120.0
+
+
+# ----------------------------------------------------- off = no-op
+
+
+def test_flag_validation(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_SEARCH_STATS", "yes")
+    with pytest.raises(envflags.EnvFlagError):
+        engine._resolve_search_stats(None)
+    monkeypatch.setenv("JEPSEN_TPU_SEARCH_STATS", "1")
+    assert engine._resolve_search_stats(None) is True
+    monkeypatch.setenv("JEPSEN_TPU_SEARCH_STATS", "0")
+    assert engine._resolve_search_stats(None) is False
+    # an explicit argument wins over the env flag
+    monkeypatch.setenv("JEPSEN_TPU_SEARCH_STATS", "0")
+    assert engine._resolve_search_stats(True) is True
+
+
+def test_env_flag_drives_the_result(monkeypatch):
+    model = CASRegister()
+    h = rand_register_history(n_ops=24, n_processes=4, seed=51)
+    e = enc_mod.encode(model, History.wrap(h))
+    monkeypatch.setenv("JEPSEN_TPU_SEARCH_STATS", "1")
+    assert "stats" in engine.check_encoded(e, capacity=128,
+                                           dedupe="hash")
+    monkeypatch.delenv("JEPSEN_TPU_SEARCH_STATS")
+    assert "stats" not in engine.check_encoded(e, capacity=128,
+                                               dedupe="hash")
+
+
+def test_stats_off_is_noop_everywhere():
+    """The stats-off pin: no result key, no registry series, no
+    counter-track events, no run-dir records — and counter_sample with
+    tracing off retains zero allocations (the PR-4 standard for
+    disabled telemetry)."""
+    tr = obs.configure(True)
+    model = CASRegister()
+    h = rand_register_history(n_ops=24, n_processes=4, seed=52)
+    e = enc_mod.encode(model, History.wrap(h))
+    r = engine.check_encoded(e, capacity=128, dedupe="hash")
+    assert "stats" not in r
+    assert not any(k.startswith("engine.search.")
+                   for k in obs.registry().snapshot())
+    assert not any(c[0].startswith("engine.search.")
+                   for c in tr.counters())
+    assert obs.search_stats_records() == []
+    obs.configure(False)
+    # disabled counter_sample: zero retained allocations inside the
+    # tracer module (the test_obs disabled-span guard's exact method —
+    # filter to the one file the call touches, so unrelated background
+    # threads elsewhere in obs can't flake the pin)
+    import sys
+    trmod = sys.modules["jepsen_tpu.obs.tracer"]
+    for _ in range(5000):          # warm past one-time interpreter
+        obs.counter_sample("warmup", 1)   # call-machinery allocations
+    tracemalloc.start()
+    before = tracemalloc.take_snapshot()
+    for _ in range(50_000):
+        obs.counter_sample("pipeline.inflight", 3)
+    after = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    flt = (tracemalloc.Filter(True, trmod.__file__),)
+    growth = sum(st.size_diff for st in
+                 after.filter_traces(flt).compare_to(
+                     before.filter_traces(flt), "filename"))
+    assert growth <= 0, \
+        f"tracer retained {growth} bytes over 50k disabled samples"

@@ -409,6 +409,29 @@ def main():
                 dline["hash_pallas_speedup"] = round(
                     sparse_pallas_ratios[shape_key], 2)
             emit(dline)
+            # the per-shape search-stats block (JEPSEN_TPU_SEARCH_
+            # STATS machinery, forced on for this one untimed run so
+            # the A/B JSONL ships probe/occupancy evidence alongside
+            # the timings — ROADMAP items 2/5's sizing inputs): one
+            # hash-dedupe run per shape, never timed, never part of
+            # the flip decision
+            if "hash" in dres:
+                try:
+                    rs = eng_mod.check_encoded(
+                        e, capacity=cap, max_capacity=cap * 4,
+                        dedupe="hash", search_stats=True)
+                    st = dict(rs.get("stats") or {})
+                    # trajectories are per-event lists — summarize for
+                    # the JSONL record, the run dir keeps the full form
+                    for key_ in ("frontier-width", "closure-iters",
+                                 "configs-stepped-per-event",
+                                 "closure-peak"):
+                        st.pop(key_, None)
+                    emit({"search_stats": st, "shape": shape_key})
+                except Exception as err:  # noqa: BLE001 — advisory
+                    # evidence must not kill the measurement run
+                    emit({"search_stats_error": repr(err),
+                          "shape": shape_key})
 
     # ---- multi-key batch ----
     n_keys, ops_per_key = (8, 40) if smoke else (84, 120)

@@ -61,7 +61,12 @@ def _check_ops_surface(ops) -> int:
         failures += 1
     for needed in ("jepsen_serve_ack_secs_bucket",
                    "jepsen_serve_verdict_secs_bucket",
-                   "jepsen_serve_deltas"):
+                   "jepsen_serve_deltas",
+                   # the smoke runs with JEPSEN_TPU_SEARCH_STATS=1, so
+                   # the device-search telemetry series must be live
+                   # on the ops surface (the ISSUE 10 wiring)
+                   "jepsen_engine_search_events",
+                   "jepsen_engine_search_frontier_peak"):
         if needed not in body:
             print(f"serve-smoke: /metrics missing {needed}")
             failures += 1
@@ -78,6 +83,12 @@ def _check_ops_surface(ops) -> int:
 
 
 def main() -> int:
+    # device-search telemetry on for the whole smoke: verdicts are
+    # flag-independent (parity-pinned), and the ops-surface check
+    # asserts the jepsen_engine_search_* series actually appear
+    if "JEPSEN_TPU_SEARCH_STATS" not in os.environ:
+        os.environ["JEPSEN_TPU_SEARCH_STATS"] = "1"
+
     from jepsen_tpu import resilience
     from jepsen_tpu.histories import corrupt_history, \
         rand_register_history
