@@ -463,9 +463,17 @@ def sec_adv(L: int, host_deadline: float, skip_host: bool,
             # byte-identical (the kernel is opt-in until the chip A/B;
             # tools/perf_ab.py's hash-pallas strategy owns the flip)
             strategies.append("hash-pallas")
+        if envflags.env_bool("JEPSEN_TPU_CONFIG_PACK", default=False):
+            # same opt-in gating for the packed configuration word:
+            # flag off => schema byte-identical; tools/perf_ab.py's
+            # hash-packed strategy owns the flip decision
+            strategies.append("hash-packed")
         for strat in strategies:
-            kw = ({"dedupe": "hash", "sparse_pallas": True}
-                  if strat == "hash-pallas" else {"dedupe": strat})
+            kw = {"dedupe": strat}
+            if strat == "hash-pallas":
+                kw = {"dedupe": "hash", "sparse_pallas": True}
+            elif strat == "hash-packed":
+                kw = {"dedupe": "hash", "config_pack": True}
             engine.check_encoded(e_ab, capacity=cap,
                                  max_capacity=cap * 4, **kw)  # compile
             with obs.timer("bench.adv.dedupe_ab", L=L,

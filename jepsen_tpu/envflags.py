@@ -200,6 +200,28 @@ def env_path(name: str, what: str = "path") -> Optional[str]:
 #                            report --search`; default off — results,
 #                            bench schema, and trace files byte-
 #                            identical to the pre-stats engine
+#   JEPSEN_TPU_CONFIG_PACK   env_bool    parallel.engine — pack each
+#                            configuration's (state, mask_lo, mask_hi)
+#                            triple into the minimal word the event
+#                            family needs (state field + C mask bits,
+#                            1-2 uint32 lanes instead of 3): shrinks
+#                            the frontier, the hash visited-set, the
+#                            FrontierCheckpoint carry boundary, and
+#                            the sharded all-to-all payloads, and
+#                            widens the sparse kernels' width-aware
+#                            VMEM gate; families past 64 bits run
+#                            unpacked (tagged). Verdicts and counters
+#                            are representation-independent
+#                            (parity-pinned); opt-in until the chip
+#                            A/B (tools/perf_ab.py hash-packed)
+#                            records the win
+#   JEPSEN_TPU_VMEM_BUDGET   env_int     parallel.sparse_kernels — the
+#                            probe-state VMEM budget (bytes) gating
+#                            the fused/tiled sparse kernels (default
+#                            4 MiB, min 64 KiB): the one knob that
+#                            re-gates every sparse kernel for a
+#                            different TPU generation without a code
+#                            edit
 #   JEPSEN_TPU_PROBE_LIMIT   env_int     parallel.engine — bounded
 #                            linear-probe length of the hash
 #                            visited-set (default 32, min 1); one

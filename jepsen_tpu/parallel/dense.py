@@ -133,17 +133,19 @@ def _check_dense_impl(xs, state0, step_name: str, S: int, C: int,
     return valid, fail_r
 
 
-# donation decision (recompile-donate-argnums): NOT donated — same
-# rationale as bitdense: xs tables are the only frontier-scale inputs,
-# callers (differential tests, perf A/B) re-dispatch the same arrays
-# across engine variants, and B is built in-trace.
-# jepsen-lint: disable=recompile-donate-argnums
+# donation decision (recompile-donate-argnums), DECIDED: nothing
+# donatable — donate_argnums=() records it. Same rationale as
+# bitdense: xs tables are the only frontier-scale inputs, callers
+# (differential tests, perf A/B) re-dispatch the same arrays across
+# engine variants, B is built in-trace, and the outputs are scalars.
 _check_dense = jax.jit(_check_dense_impl,
+                       donate_argnums=(),
                        static_argnames=("step_name", "S", "C", "lo"))
 
 
-# same donation decision as _check_dense above
-@functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
+# same (decided) donation as _check_dense above
+@functools.partial(jax.jit,
+                   donate_argnums=(),
                    static_argnames=("step_name", "S", "C", "lo"))
 def _check_dense_batch(xs, state0, step_name: str, S: int, C: int,
                        lo: int = -1):

@@ -68,20 +68,91 @@ def _resolve_probe_limit(probe_limit: int = 0) -> int:
                             min_value=1, what="probe limit")
 
 
+def _resolve_config_pack(config_pack) -> bool:
+    """JEPSEN_TPU_CONFIG_PACK: pack each configuration's (state,
+    mask_lo, mask_hi) triple into the minimal word the event actually
+    needs (docs/performance.md "VMEM economics"). Strict tri-state
+    (envflags.env_bool), default OFF until the chip A/B records the
+    win — the PIPELINE/DEDUPE precedent; flag off means the engine
+    runs the historical 3-lane layout byte-identically. An explicit
+    argument wins over the env flag, like every other perf knob.
+    Resolution yields only the REQUEST; whether a given event family
+    actually packs is per-encode (pack_spec_for)."""
+    if config_pack is None:
+        return bool(envflags.env_bool("JEPSEN_TPU_CONFIG_PACK",
+                                      default=False))
+    return bool(config_pack)
+
+
+def pack_layout(n_states: int, state_lo: int, C: int):
+    """The packed-word layout for an event family whose states live in
+    [state_lo, state_lo + n_states) with a C-slot open-call window, or
+    None when the family cannot pack. The word is
+    ``(state - state_lo) | mask << state_bits`` — state field in the
+    low bits, the C mask bits above it — carried as one or two uint32
+    lanes (Mosaic's native width). Packable iff the whole word fits 64
+    bits and the state field fits one lane:
+    ``state_bits + C <= 64 and state_bits <= 32``. Returns the static
+    ``(state_bits, state_lo)`` pair that keys the jit cache."""
+    if n_states <= 0 or C <= 0:
+        return None
+    state_bits = max(1, int(n_states - 1).bit_length())
+    if state_bits > 32 or state_bits + C > 64:
+        return None
+    return (state_bits, int(state_lo))
+
+
+def pack_spec_for(encs, C: Optional[int] = None):
+    """The COMMON packed layout for one or more encoded histories that
+    will share a device program (a batch pads to one slot width and
+    traces one layout), or () when any of them cannot pack. The state
+    field must cover every member's domain, so the layout uses the
+    union range [min state_lo, max state_lo + n_states)."""
+    if not isinstance(encs, (list, tuple)):
+        encs = [encs]
+    if not encs:
+        return ()
+    if any(e.n_states <= 0 for e in encs):
+        return ()
+    lo = min(e.state_lo for e in encs)
+    hi = max(e.state_lo + e.n_states for e in encs)
+    Cw = C if C is not None else max(e.slot_f.shape[1] for e in encs)
+    lay = pack_layout(hi - lo, lo, Cw)
+    return lay if lay is not None else ()
+
+
+def pack_lanes(pack, C: int) -> int:
+    """uint32 lanes one configuration row occupies under `pack` (the
+    static (state_bits, state_lo) pair, or () for the historical
+    unpacked triple). The VMEM gates price probe state per lane, so
+    this is the number the width-aware kernel gates consume."""
+    if not pack:
+        return 3
+    return 1 if pack[0] + C <= 32 else 2
+
+
 def _resolve_sparse_pallas(sparse_pallas, N: int, C: int, platform: str,
-                           dedupe: str):
+                           dedupe: str, pack=()):
     """The sparse engine's fused-frontier-kernel gate -> (mode, note)
-    with mode one of "off" / "on" / "interpret".
+    with mode one of "off" / "on" / "interpret" / "tiled" /
+    "tiled-interpret".
 
     `sparse_pallas` None defers to the strict tri-state
     JEPSEN_TPU_SPARSE_PALLAS flag (default OFF until a chip A/B
     records the win — the JEPSEN_TPU_PIPELINE / JEPSEN_TPU_DEDUPE
     precedent; "1" forces it on, in interpret mode off-TPU like
     JEPSEN_TPU_PALLAS). The kernel is the hash path's fused form, so
-    requesting it under dedupe="sort" is a contradiction and raises;
-    a shape past the kernel's VMEM budget (sparse_kernels.supported)
-    downgrades to the XLA hash closure with a note — the bitdense
-    mesh-fallback precedent: the default path degrades, never errors."""
+    requesting it under dedupe="sort" is a contradiction and raises.
+
+    The gate is WIDTH-AWARE: probe state is priced per row lane
+    (pack_lanes — 3 unpacked, 1-2 packed), so packed shapes clear it
+    at ~3x the capacity. A shape past the whole-event fusion gate no
+    longer degrades wholesale: it runs the TILED closure
+    (sparse_kernels.tiled_insert_call — the hash table streams
+    HBM<->VMEM in double-buffered tiles, mode "tiled"), and only a
+    shape past the tiled planner too falls back to the XLA hash
+    closure with a note (the bitdense mesh-fallback precedent: the
+    default path degrades, never errors)."""
     if dedupe != "hash":
         if sparse_pallas:
             raise ValueError(
@@ -105,16 +176,20 @@ def _resolve_sparse_pallas(sparse_pallas, N: int, C: int, platform: str,
     if not sparse_pallas:
         return "off", None
     from jepsen_tpu.parallel import sparse_kernels as sk
-    if not sk.supported(N, C):
-        obs.counter("engine.sparse_pallas_fallbacks").inc()
-        note = (f"sparse frontier kernel skipped at capacity {N} "
-                f"(C={C}): probe state would exceed the kernel's VMEM "
-                f"budget — fell back to the XLA hash closure for this "
-                f"tier")
-        _log.warning("%s", note)
-        return "off", note
     from jepsen_tpu.parallel.bitdense import is_tpu_platform
-    return ("on" if is_tpu_platform(platform) else "interpret"), None
+    lanes = pack_lanes(pack, C)
+    on_tpu = is_tpu_platform(platform)
+    if sk.supported(N, C, lanes):
+        return ("on" if on_tpu else "interpret"), None
+    if sk.tiled_plan(N, C, lanes) is not None:
+        return ("tiled" if on_tpu else "tiled-interpret"), None
+    obs.counter("engine.sparse_pallas_fallbacks").inc()
+    note = (f"sparse frontier kernel skipped at capacity {N} "
+            f"(C={C}, {lanes} row lanes): probe state would exceed "
+            f"the kernel's VMEM budget even tiled — fell back to the "
+            f"XLA hash closure for this tier")
+    _log.warning("%s", note)
+    return "off", note
 
 
 def _next_pow2(n: int) -> int:
@@ -176,39 +251,328 @@ def _resolve_dedupe(dedupe: Optional[str]) -> str:
     return dedupe
 
 
-def _table_hash(st, ml, mh):
-    """Slot mixing for the open-addressed visited set. Deliberately a
-    DIFFERENT mix than sharded._hash_config: the sharded engine buckets
-    ownership by that hash mod n_dev, so a device's owned configs all
-    share its low bits — reusing it for table slots would turn every
-    per-device table into one giant collision cluster."""
-    h = (st.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)) \
-        ^ (ml * jnp.uint32(0xC2B2AE35)) ^ (mh * jnp.uint32(0x27D4EB2F))
-    h ^= h >> 16
-    h = h * jnp.uint32(0x165667B1)
-    h ^= h >> 13
-    return h
+# --------------------------------------- configuration representation
+#
+# A configuration row travels the engine as a TUPLE OF LANE ARRAYS.
+# The historical layout is three lanes — (state i32, mask_lo u32,
+# mask_hi u32), 96 bits per config. Under JEPSEN_TPU_CONFIG_PACK the
+# row is the minimal word the event family actually needs:
+# (state - state_lo) in the low state_bits, the C mask bits above it,
+# carried as one or two uint32 lanes (docs/performance.md "VMEM
+# economics"). Everything that stores or moves rows — the hash
+# visited-set, sort-dedupe compaction, frontier carries, the sharded
+# owner-routed all-to-all payloads, the fused kernels — is generic
+# over the lane tuple; only the few semantic touch points (the model
+# step's state input, slot-bit tests) go through the ConfigRep below,
+# so the packed and unpacked paths share one implementation and
+# cannot diverge.
 
 
-def _empty_table(T: int):
-    return (jnp.zeros(T, jnp.int32), jnp.zeros(T, jnp.uint32),
-            jnp.zeros(T, jnp.uint32), jnp.zeros(T, bool))
+class _UnpackedRep:
+    """The historical (state, mask_lo, mask_hi) triple. Its methods
+    reproduce the pre-pack spellings verbatim — the flag-off engine is
+    bit-identical by construction, not merely by test pin."""
+
+    lanes = 3
+    pack = ()
+
+    def __init__(self, C: int):
+        self.C = C
+
+    def zeros(self, n: int):
+        return (jnp.zeros(n, jnp.int32), jnp.zeros(n, jnp.uint32),
+                jnp.zeros(n, jnp.uint32))
+
+    def initial_at0(self, state0, N: int):
+        return (jnp.zeros(N, jnp.int32).at[0].set(state0),
+                jnp.zeros(N, jnp.uint32), jnp.zeros(N, jnp.uint32))
+
+    def initial_full(self, state0, N: int):
+        return (jnp.full(N, state0, jnp.int32),
+                jnp.zeros(N, jnp.uint32), jnp.zeros(N, jnp.uint32))
+
+    def state(self, rows):
+        return rows[0]
+
+    def table_hash(self, rows):
+        """Slot mixing for the open-addressed visited set. Deliberately
+        a DIFFERENT mix than owner_hash: the sharded engine buckets
+        ownership by that hash mod n_dev, so a device's owned configs
+        all share its low bits — reusing it for table slots would turn
+        every per-device table into one giant collision cluster."""
+        st, ml, mh = rows
+        h = (st.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)) \
+            ^ (ml * jnp.uint32(0xC2B2AE35)) \
+            ^ (mh * jnp.uint32(0x27D4EB2F))
+        h ^= h >> 16
+        h = h * jnp.uint32(0x165667B1)
+        h ^= h >> 13
+        return h
+
+    def owner_hash(self, rows):
+        """sharded ownership mix (historically sharded._hash_config)."""
+        st, ml, mh = rows
+        h = (st.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)) \
+            ^ (ml * jnp.uint32(0x85EBCA77)) \
+            ^ (mh * jnp.uint32(0xC2B2AE3D))
+        h ^= h >> 15
+        h = h * jnp.uint32(0x2C1B3C6D)
+        h ^= h >> 12
+        return h
+
+    def slot_mask_bits(self):
+        """Per-slot mask-lane bit arrays ([C] u32 per mask lane)."""
+        return _slot_bits(self.C)
+
+    def mask_test(self, rows):
+        """[N, C] bool: slot j already linearized in row n."""
+        _, ml, mh = rows
+        bit_lo, bit_hi = self.slot_mask_bits()
+        return ((ml[:, None] & bit_lo[None, :])
+                | (mh[:, None] & bit_hi[None, :])) != 0
+
+    def candidates(self, rows, cand_st):
+        """Flattened [N*C] candidate rows: state from the model step,
+        mask with slot j's bit set."""
+        _, ml, mh = rows
+        bit_lo, bit_hi = self.slot_mask_bits()
+        return (cand_st.reshape(-1),
+                (ml[:, None] | bit_lo[None, :]).reshape(-1),
+                (mh[:, None] | bit_hi[None, :]).reshape(-1))
+
+    def event_bits(self, s):
+        """Per-mask-lane bit of the (traced u32 scalar) slot s."""
+        one = jnp.uint32(1)
+        blo = jnp.where(s < 32, one << jnp.minimum(s, 31),
+                        jnp.uint32(0)).astype(jnp.uint32)
+        bhi = jnp.where(s >= 32,
+                        one << jnp.minimum(jnp.where(s >= 32, s - 32, 0),
+                                           jnp.uint32(31)),
+                        jnp.uint32(0)).astype(jnp.uint32)
+        return blo, bhi
+
+    def has_event_bit(self, rows, bits):
+        _, ml, mh = rows
+        blo, bhi = bits
+        return ((ml & blo) | (mh & bhi)) != 0
+
+    def clear_event_bit(self, rows, bits, where):
+        st, ml, mh = rows
+        blo, bhi = bits
+        return (st, jnp.where(where, ml & ~blo, ml),
+                jnp.where(where, mh & ~bhi, mh))
 
 
-def _hash_insert(c_st, c_ml, c_mh, c_live, table, probe_limit: int):
-    """Parallel bounded-linear-probe insert of candidate configs into
-    the open-addressed visited set `table` ((st, ml, mh, occ) arrays of
-    one power-of-two length T).
+class _PackedRep:
+    """The packed single-word layout: state field in bits
+    [0, state_bits), mask bits at [state_bits, state_bits + C), one
+    uint32 lane when the word fits 32 bits, two lanes (lo, hi of the
+    uint64 word) otherwise."""
 
-    Each live candidate probes from _table_hash(row) & (T-1); per
-    round it drops on an equal occupant (already visited), claims an
-    empty slot (racing claimants are arbitrated by a scatter-min of the
-    candidate index; losers RE-CHECK the same slot next round, because
-    the winner there may hold an equal key — a duplicate inside this
-    same batch), or advances past an occupied different slot. The loop
-    runs until every candidate resolves or exhausts `probe_limit`
-    probes (<= 2*probe_limit rounds: every pending candidate resolves
-    or advances at least every second round).
+    def __init__(self, state_bits: int, state_lo: int, C: int):
+        self.s_bits = int(state_bits)
+        self.state_lo = int(state_lo)
+        self.C = C
+        self.width = self.s_bits + C
+        assert self.s_bits <= 32 and self.width <= 64
+        self.lanes = 1 if self.width <= 32 else 2
+        self.pack = (self.s_bits, self.state_lo)
+        self._smask = (1 << self.s_bits) - 1
+
+    @property
+    def smask(self):
+        # constructed lazily so kernel bodies create the constant
+        # INSIDE their trace — a stored jnp scalar would be a captured
+        # constant, which pallas_call rejects
+        return jnp.uint32(self._smask)
+
+    def zeros(self, n: int):
+        return tuple(jnp.zeros(n, jnp.uint32)
+                     for _ in range(self.lanes))
+
+    def _field(self, st):
+        # legal states are in [state_lo, state_lo + n_states) — the
+        # same bound bitdense's bitmap indexing relies on; the mask
+        # keeps a garbage state on a dead candidate from spilling into
+        # the mask bits (dead rows are never inserted, but their lanes
+        # must not poison scatters' defensive reads)
+        return (st - self.state_lo).astype(jnp.uint32) & self.smask
+
+    def initial_at0(self, state0, N: int):
+        lo = jnp.zeros(N, jnp.uint32).at[0].set(self._field(state0))
+        return (lo,) if self.lanes == 1 else (lo,
+                                              jnp.zeros(N, jnp.uint32))
+
+    def initial_full(self, state0, N: int):
+        lo = jnp.full(N, 1, jnp.uint32) * self._field(state0)
+        return (lo,) if self.lanes == 1 else (lo,
+                                              jnp.zeros(N, jnp.uint32))
+
+    def state(self, rows):
+        return (rows[0] & self.smask).astype(jnp.int32) + self.state_lo
+
+    def table_hash(self, rows):
+        h = rows[0] * jnp.uint32(0x85EBCA6B)
+        if self.lanes == 2:
+            h = h ^ (rows[1] * jnp.uint32(0xC2B2AE35))
+        h ^= h >> 16
+        h = h * jnp.uint32(0x165667B1)
+        h ^= h >> 13
+        return h
+
+    def owner_hash(self, rows):
+        h = rows[0] * jnp.uint32(0x9E3779B1)
+        if self.lanes == 2:
+            h = h ^ (rows[1] * jnp.uint32(0x85EBCA77))
+        h ^= h >> 15
+        h = h * jnp.uint32(0x2C1B3C6D)
+        h ^= h >> 12
+        return h
+
+    def slot_mask_bits(self):
+        js = jnp.arange(self.C, dtype=jnp.uint32) \
+            + jnp.uint32(self.s_bits)
+        one = jnp.uint32(1)
+        blo = jnp.where(js < 32, one << jnp.minimum(js, 31),
+                        jnp.uint32(0)).astype(jnp.uint32)
+        if self.lanes == 1:
+            return (blo,)
+        bhi = jnp.where(js >= 32,
+                        one << jnp.minimum(js - 32, jnp.uint32(31)),
+                        jnp.uint32(0)).astype(jnp.uint32)
+        return blo, bhi
+
+    def mask_test(self, rows):
+        bits = self.slot_mask_bits()
+        acc = (rows[0][:, None] & bits[0][None, :])
+        if self.lanes == 2:
+            acc = acc | (rows[1][:, None] & bits[1][None, :])
+        return acc != 0
+
+    def candidates(self, rows, cand_st):
+        bits = self.slot_mask_bits()
+        lo = (((rows[0][:, None] & ~self.smask)
+               | self._field(cand_st)) | bits[0][None, :]).reshape(-1)
+        if self.lanes == 1:
+            return (lo,)
+        hi = (rows[1][:, None] | bits[1][None, :]).reshape(-1)
+        return lo, hi
+
+    def event_bits(self, s):
+        p = s + jnp.uint32(self.s_bits)
+        one = jnp.uint32(1)
+        blo = jnp.where(p < 32, one << jnp.minimum(p, 31),
+                        jnp.uint32(0)).astype(jnp.uint32)
+        if self.lanes == 1:
+            return (blo,)
+        bhi = jnp.where(p >= 32,
+                        one << jnp.minimum(jnp.where(p >= 32, p - 32, 0),
+                                           jnp.uint32(31)),
+                        jnp.uint32(0)).astype(jnp.uint32)
+        return blo, bhi
+
+    def has_event_bit(self, rows, bits):
+        acc = rows[0] & bits[0]
+        if self.lanes == 2:
+            acc = acc | (rows[1] & bits[1])
+        return acc != 0
+
+    def clear_event_bit(self, rows, bits, where):
+        return tuple(jnp.where(where, r & ~b, r)
+                     for r, b in zip(rows, bits))
+
+
+def _rep(pack, C: int):
+    """The ConfigRep for a static (pack, C) pair — pack is () for the
+    historical triple, (state_bits, state_lo) for the packed word."""
+    if pack:
+        return _PackedRep(pack[0], pack[1], C)
+    return _UnpackedRep(C)
+
+
+def pack_rows_np(pack, C: int, st, ml, mh):
+    """Host-side (numpy) packing of canonical (st, ml, mh) rows into
+    the lane tuple the (pack, C) layout describes — the
+    FrontierCheckpoint boundary: checkpoints store the canonical
+    triple (so v1/v2 files, serve freeze/thaw, host_resume seeds, and
+    cross-representation resume all keep working) and the engine packs
+    at the carry build. Lane count is STATIC (1 when the word fits 32
+    bits, else 2) — it must match what the traced program expects."""
+    s_bits, s_lo = pack
+    word = ((np.asarray(st).astype(np.int64) - s_lo)
+            .astype(np.uint64) & np.uint64((1 << s_bits) - 1))
+    mask = (np.asarray(ml).astype(np.uint64)
+            | (np.asarray(mh).astype(np.uint64) << np.uint64(32)))
+    word = word | (mask << np.uint64(s_bits))
+    lo = (word & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    if s_bits + C <= 32:
+        return (lo,)
+    return lo, (word >> np.uint64(32)).astype(np.uint32)
+
+
+def unpack_rows_np(pack, C: int, rows):
+    """Inverse of pack_rows_np: lane tuple -> canonical (st, ml, mh)
+    numpy triple."""
+    s_bits, s_lo = pack
+    lo = np.asarray(rows[0]).astype(np.uint64)
+    word = lo if len(rows) == 1 else \
+        lo | (np.asarray(rows[1]).astype(np.uint64) << np.uint64(32))
+    st = (word & np.uint64((1 << s_bits) - 1)).astype(np.int64) + s_lo
+    mask = (word >> np.uint64(s_bits)) \
+        & np.uint64((1 << C) - 1 if C < 64 else 0xFFFFFFFFFFFFFFFF)
+    ml = (mask & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    mh = (mask >> np.uint64(32)).astype(np.uint32)
+    return st.astype(np.int32), ml, mh
+
+
+def _rows_eq(a_rows, b_rows):
+    acc = a_rows[0] == b_rows[0]
+    for a, b in zip(a_rows[1:], b_rows[1:]):
+        acc = acc & (a == b)
+    return acc
+
+
+def _rows_take(rows, idx):
+    return tuple(r[idx] for r in rows)
+
+
+def _rows_concat(a_rows, b_rows):
+    return tuple(jnp.concatenate([a, b])
+                 for a, b in zip(a_rows, b_rows))
+
+
+def _rows_where(cond, a_rows, b_rows):
+    return tuple(jnp.where(cond, a, b)
+                 for a, b in zip(a_rows, b_rows))
+
+
+def _rows_at_set(rows, pos, vals):
+    return tuple(r.at[pos].set(v, mode="drop")
+                 for r, v in zip(rows, vals))
+
+
+def _empty_table(T: int, rep):
+    return (rep.zeros(T), jnp.zeros(T, bool))
+
+
+def _hash_insert(c_rows, c_live, table, probe_limit: int, rep,
+                 h0=None):
+    """Parallel bounded-linear-probe insert of candidate config rows
+    (a lane tuple, `rep`'s layout) into the open-addressed visited set
+    `table` ((rows, occ) with lane arrays of one power-of-two length
+    T).
+
+    Each live candidate probes from rep.table_hash(row) & (T-1) (or
+    from the caller-supplied `h0` start slots — the tiled kernel
+    probes within a table tile); per round it drops on an equal
+    occupant (already visited), claims an empty slot (racing claimants
+    are arbitrated by a scatter-min of the candidate index; losers
+    RE-CHECK the same slot next round, because the winner there may
+    hold an equal key — a duplicate inside this same batch), or
+    advances past an occupied different slot. The loop runs until
+    every candidate resolves or exhausts `probe_limit` probes
+    (<= 2*probe_limit rounds: every pending candidate resolves or
+    advances at least every second round).
 
     Returns (table', fresh, overflow, off): `fresh` flags candidates
     that claimed a slot (first sighting), `overflow` that some
@@ -216,123 +580,129 @@ def _hash_insert(c_st, c_ml, c_mh, c_live, table, probe_limit: int):
     never silently drops a config. `off` is each candidate's final
     probe offset (the stats path histograms it; other callers ignore
     it — dead code under jit)."""
-    t_st, t_ml, t_mh, t_occ = table
-    M = c_st.shape[0]
-    T = t_st.shape[0]
+    t_rows, t_occ = table
+    M = c_rows[0].shape[0]
+    T = t_rows[0].shape[0]
     maskT = jnp.uint32(T - 1)
-    h0 = _table_hash(c_st, c_ml, c_mh)
+    if h0 is None:
+        h0 = rep.table_hash(c_rows)
     idx = jnp.arange(M, dtype=jnp.int32)
 
     def cond(s):
         return jnp.any(s["pending"] & (s["off"] < probe_limit))
 
     def body(s):
-        t_st, t_ml, t_mh, t_occ = s["table"]
+        t_rows, t_occ = s["table"]
         pending, off, fresh = s["pending"], s["off"], s["fresh"]
         act = pending & (off < probe_limit)
         slot = ((h0 + off.astype(jnp.uint32)) & maskT).astype(jnp.int32)
         occ = t_occ[slot]
-        same = occ & (t_st[slot] == c_st) & (t_ml[slot] == c_ml) \
-            & (t_mh[slot] == c_mh)
+        same = occ & _rows_eq(_rows_take(t_rows, slot), c_rows)
         try_claim = act & ~occ
         claim = jnp.full(T, M, jnp.int32).at[
             jnp.where(try_claim, slot, T)].min(idx, mode="drop")
         won = try_claim & (claim[slot] == idx)
         wslot = jnp.where(won, slot, T)
-        t_st = t_st.at[wslot].set(c_st, mode="drop")
-        t_ml = t_ml.at[wslot].set(c_ml, mode="drop")
-        t_mh = t_mh.at[wslot].set(c_mh, mode="drop")
+        t_rows = _rows_at_set(t_rows, wslot, c_rows)
         t_occ = t_occ.at[wslot].set(True, mode="drop")
-        return {"table": (t_st, t_ml, t_mh, t_occ),
+        return {"table": (t_rows, t_occ),
                 "pending": pending & ~(act & same) & ~won,
                 "off": off + (act & occ & ~same).astype(jnp.int32),
                 "fresh": fresh | won}
 
     out = lax.while_loop(cond, body, {
-        "table": (t_st, t_ml, t_mh, t_occ), "pending": c_live,
+        "table": (t_rows, t_occ), "pending": c_live,
         "off": jnp.zeros(M, jnp.int32), "fresh": jnp.zeros(M, bool)})
     return out["table"], out["fresh"], jnp.any(out["pending"]), out["off"]
 
 
-def _hash_insert_append(c_st, c_ml, c_mh, c_live, st, ml, mh, count,
-                        table, probe_limit: int, N: int,
+def _append_fresh(c_rows, fresh, f_rows, count, N: int):
+    """The append half of one visited-set transaction: fresh rows land
+    contiguously after `count` in the frontier lane arrays. Returns
+    (rows2, count2, n_fresh, append_ovf)."""
+    n_fresh = jnp.sum(fresh)
+    pos = jnp.where(fresh, count + jnp.cumsum(fresh) - 1, N)
+    rows2 = _rows_at_set(f_rows, pos, c_rows)
+    return (rows2, jnp.minimum(count + n_fresh, N), n_fresh,
+            count + n_fresh > N)
+
+
+def _hash_insert_append(c_rows, c_live, f_rows, count, table,
+                        probe_limit: int, N: int, rep,
                         stats: bool = False):
     """_hash_insert plus the contiguous append of the fresh rows after
     `count` — one closure iteration's whole visited-set transaction.
     Shared verbatim by the XLA hash path, the fused frontier kernel
     (sparse_kernels.frontier_closure_call via _hash_event_closure), and
     the sharded per-device insert kernel (sparse_kernels.
-    hash_insert_call), so the three implementations cannot diverge.
+    hash_insert_call), so the implementations cannot diverge.
 
-    Returns (st2, ml2, mh2, table2, count2, n_fresh, ovf): `ovf` is
-    probe exhaustion OR the append running past the N-row frontier
-    (rows past N scatter-drop; the flag aborts before anything
-    consumes them). With `stats` (static; JEPSEN_TPU_SEARCH_STATS), an
-    eighth element: the bucketed probe-length histogram
-    [N_PROBE_BUCKETS] of this transaction's attempted inserts."""
-    table2, fresh, p_ovf, off = _hash_insert(c_st, c_ml, c_mh, c_live,
-                                             table, probe_limit)
-    n_fresh = jnp.sum(fresh)
-    pos = jnp.where(fresh, count + jnp.cumsum(fresh) - 1, N)
-    st2 = st.at[pos].set(c_st, mode="drop")
-    ml2 = ml.at[pos].set(c_ml, mode="drop")
-    mh2 = mh.at[pos].set(c_mh, mode="drop")
-    out = (st2, ml2, mh2, table2, jnp.minimum(count + n_fresh, N),
-           n_fresh, p_ovf | (count + n_fresh > N))
+    Returns (rows2, table2, count2, n_fresh, ovf): `ovf` is probe
+    exhaustion OR the append running past the N-row frontier (rows
+    past N scatter-drop; the flag aborts before anything consumes
+    them). With `stats` (static; JEPSEN_TPU_SEARCH_STATS), a sixth
+    element: the bucketed probe-length histogram [N_PROBE_BUCKETS] of
+    this transaction's attempted inserts."""
+    table2, fresh, p_ovf, off = _hash_insert(c_rows, c_live, table,
+                                             probe_limit, rep)
+    rows2, count2, n_fresh, a_ovf = _append_fresh(c_rows, fresh,
+                                                  f_rows, count, N)
+    out = (rows2, table2, count2, n_fresh, p_ovf | a_ovf)
     if stats:
         return out + (_probe_hist(off, c_live),)
     return out
 
 
-def _hash_event_closure(step_cc, ev, st, ml, mh, live, run, N: int,
-                        C: int, T: int, probe_limit: int,
-                        stats: bool = False):
+def _hash_event_closure(rep, step_cc, ev, rows, live, run, N: int,
+                        T: int, probe_limit: int, stats: bool = False,
+                        insert=None):
     """The whole per-event delta-frontier closure (dedupe="hash") on
-    plain arrays: seed the fresh visited set with the live frontier
-    (compacting it in the same pass — post-filter frontiers have
-    holes; iteration 0's delta is the whole frontier, exactly the rows
-    the sort path would step first), then expand only the delta until
-    no fresh configs appear. Shared VERBATIM by the XLA path
-    (_scan_step_factory) and the fused pallas kernel
+    plain lane arrays: seed the fresh visited set with the live
+    frontier (compacting it in the same pass — post-filter frontiers
+    have holes; iteration 0's delta is the whole frontier, exactly the
+    rows the sort path would step first), then expand only the delta
+    until no fresh configs appear. Shared VERBATIM by the XLA path
+    (_scan_step_factory), the fused pallas kernel
     (sparse_kernels.frontier_closure_call runs exactly this function
-    over VMEM-resident values), so the two cannot diverge.
+    over VMEM-resident values), and — via the `insert` hook — the
+    tiled closure, whose per-iteration visited-set transaction streams
+    the table through sparse_kernels.tiled_insert_call while the
+    expansion stays here. The implementations cannot diverge.
 
-    Returns (st2, ml2, mh2, count, ovf, iters, stepped) with `stepped`
-    the configs expanded during THIS event's closure. With `stats`
+    Returns (rows2, count, ovf, iters, stepped) with `stepped` the
+    configs expanded during THIS event's closure. With `stats`
     (static), two more: `swork` — the configs a SORT closure would
     have stepped for the same event (whole frontier per iteration; the
     delta-split ratio's denominator) — and the probe-length histogram
     [N_PROBE_BUCKETS] accumulated over the seed insert and every
     iteration's transaction."""
-    bit_lo, bit_hi = _slot_bits(C)
-    seed = _hash_insert_append(
-        st, ml, mh, live, jnp.zeros(N, jnp.int32),
-        jnp.zeros(N, jnp.uint32), jnp.zeros(N, jnp.uint32),
-        jnp.int32(0), _empty_table(T), probe_limit, N, stats=stats)
-    st0, ml0, mh0, table, m0, _, p0 = seed[:7]
+    if insert is None:
+        def insert(c_rows, c_live, f_rows, count, table):
+            return _hash_insert_append(c_rows, c_live, f_rows, count,
+                                       table, probe_limit, N, rep,
+                                       stats=stats)
+    seed = insert(rows, live, rep.zeros(N), jnp.int32(0),
+                  _empty_table(T, rep))
+    rows0, table, m0, _, p0 = seed[:5]
 
     def cond(c):
         return c["changed"] & ~c["ovf"]
 
     def body(c):
-        st, ml, mh = c["st"], c["ml"], c["mh"]
+        rows = c["rows"]
         n_old, count = c["n_old"], c["count"]
-        cand_st, cand_ok = step_cc(st, ev["slot_f"], ev["slot_a0"],
-                                   ev["slot_a1"], ev["slot_wild"])
+        cand_st, cand_ok = step_cc(rep.state(rows), ev["slot_f"],
+                                   ev["slot_a0"], ev["slot_a1"],
+                                   ev["slot_wild"])
         row = jnp.arange(N)
         delta = (row >= n_old) & (row < count)
-        already = ((ml[:, None] & bit_lo[None, :])
-                   | (mh[:, None] & bit_hi[None, :])) != 0
+        already = rep.mask_test(rows)
         legal = (delta[:, None] & ev["slot_occ"][None, :]
                  & ~already & cand_ok)
-        ins = _hash_insert_append(
-            cand_st.reshape(-1),
-            (ml[:, None] | bit_lo[None, :]).reshape(-1),
-            (mh[:, None] | bit_hi[None, :]).reshape(-1),
-            legal.reshape(-1), st, ml, mh, count, c["table"],
-            probe_limit, N, stats=stats)
-        st2, ml2, mh2, table2, count2, n_fresh, ins_ovf = ins[:7]
-        out = {"st": st2, "ml": ml2, "mh": mh2,
+        ins = insert(rep.candidates(rows, cand_st), legal.reshape(-1),
+                     rows, count, c["table"])
+        rows2, table2, count2, n_fresh, ins_ovf = ins[:5]
+        out = {"rows": rows2,
                "n_old": count, "count": count2, "table": table2,
                "changed": n_fresh > 0,
                "ovf": c["ovf"] | ins_ovf,
@@ -342,20 +712,20 @@ def _hash_event_closure(step_cc, ev, st, ml, mh, live, run, N: int,
             # swork: what sort would have re-stepped — the WHOLE live
             # frontier this iteration, not just the delta
             out["swork"] = c["swork"] + count
-            out["phist"] = c["phist"] + ins[7]
+            out["phist"] = c["phist"] + ins[5]
         return out
 
     carry0 = {
-        "st": st0, "ml": ml0, "mh": mh0,
+        "rows": rows0,
         "n_old": jnp.int32(0), "count": m0, "table": table,
         "changed": run, "ovf": p0, "iters": jnp.int32(0),
         "stepped": jnp.int32(0)}
     if stats:
         carry0["swork"] = jnp.int32(0)
-        carry0["phist"] = seed[7]
+        carry0["phist"] = seed[5]
     out = lax.while_loop(cond, body, carry0)
-    base = (out["st"], out["ml"], out["mh"], out["count"], out["ovf"],
-            out["iters"], out["stepped"])
+    base = (out["rows"], out["count"], out["ovf"], out["iters"],
+            out["stepped"])
     if stats:
         return base + (out["swork"], out["phist"])
     return base
@@ -371,48 +741,50 @@ def _slot_bits(C: int):
     return bit_lo, bit_hi
 
 
-def _dedupe_compact(st, ml, mh, live, N):
-    """Sort rows by (dead, state, mask), flag first occurrences, compact
-    into a fresh [N] frontier. Returns (state, ml, mh, live, count,
-    overflow)."""
-    M = st.shape[0]
-    order = jnp.lexsort((mh, ml, st, (~live).astype(jnp.int8)))
-    st_s = st[order]
-    ml_s = ml[order]
-    mh_s = mh[order]
+def _rows_prev_same(rows_s):
+    acc = rows_s[0][1:] == rows_s[0][:-1]
+    for r in rows_s[1:]:
+        acc = acc & (r[1:] == r[:-1])
+    return jnp.concatenate([jnp.zeros(1, bool), acc])
+
+
+def _dedupe_compact(rows, live, N, rep):
+    """Sort rows by (dead, lanes major-to-minor), flag first
+    occurrences, compact into a fresh [N] frontier. Returns (rows,
+    live, count, overflow). Lane-generic: the unpacked triple sorts by
+    (state, mask_lo, mask_hi) exactly as before; the packed word sorts
+    by its lanes."""
+    M = rows[0].shape[0]
+    order = jnp.lexsort((*reversed(rows), (~live).astype(jnp.int8)))
+    rows_s = _rows_take(rows, order)
     live_s = live[order]
-    prev_same = jnp.concatenate([
-        jnp.zeros(1, bool),
-        (st_s[1:] == st_s[:-1]) & (ml_s[1:] == ml_s[:-1])
-        & (mh_s[1:] == mh_s[:-1]),
-    ])
-    uniq = live_s & ~prev_same
+    uniq = live_s & ~_rows_prev_same(rows_s)
     count = jnp.sum(uniq)
     pos = jnp.where(uniq, jnp.cumsum(uniq) - 1, M + N)  # OOB -> dropped
-    new_st = jnp.zeros(N, jnp.int32).at[pos].set(st_s, mode="drop")
-    new_ml = jnp.zeros(N, jnp.uint32).at[pos].set(ml_s, mode="drop")
-    new_mh = jnp.zeros(N, jnp.uint32).at[pos].set(mh_s, mode="drop")
+    new_rows = _rows_at_set(rep.zeros(N), pos, rows_s)
     new_live = jnp.arange(N) < count
-    return new_st, new_ml, new_mh, new_live, count, count > N
+    return new_rows, new_live, count, count > N
 
 
-def _initial_carry(state0, N: int):
+def _initial_carry(state0, N: int, rep):
     """The scan carry at event 0: one live config (the initial model
     state, nothing linearized). The trailing int32 is the
     configs-stepped counter (closure work actually paid, in configs
-    expanded — see _scan_step_factory)."""
-    st0 = jnp.zeros(N, jnp.int32).at[0].set(state0)
-    ml0 = jnp.zeros(N, jnp.uint32)
-    mh0 = jnp.zeros(N, jnp.uint32)
+    expanded — see _scan_step_factory). The carry is
+    (*row_lanes, live, ok, fail_r, r_idx, maxf, steps_n, stepped) —
+    lane count is the representation's (3 unpacked, 1-2 packed)."""
+    rows0 = rep.initial_at0(state0, N)
     live0 = jnp.arange(N) < 1
-    return (st0, ml0, mh0, live0, jnp.array(True), jnp.int32(-1),
-            jnp.int32(0), jnp.int32(1), jnp.int32(0), jnp.int32(0))
+    return rows0 + (live0, jnp.array(True), jnp.int32(-1),
+                    jnp.int32(0), jnp.int32(1), jnp.int32(0),
+                    jnp.int32(0))
 
 
 def _scan_step_factory(step_name: str, N: int, C: int,
                        dedupe: str = "sort", probe_limit: int = 0,
                        sparse_pallas: str = "off",
-                       search_stats: bool = False):
+                       search_stats: bool = False,
+                       pack: tuple = ()):
     """The per-return-event scan step, parameterized by model step,
     frontier capacity, slot-window width, and dedupe strategy. Shared
     by the one-shot and the resumable (checkpointed) entry points.
@@ -456,9 +828,18 @@ def _scan_step_factory(step_name: str, N: int, C: int,
     occupancy), iterations, per-event configs-stepped, the
     sort-equivalent work (delta-split denominator), and the bucketed
     probe-length histogram (zeros under sort). Verdict-carrying
-    outputs are untouched — stats-on/off parity is pinned."""
+    outputs are untouched — stats-on/off parity is pinned.
+
+    `pack` (static; JEPSEN_TPU_CONFIG_PACK via pack_spec_for) selects
+    the configuration-row layout: () is the historical (state,
+    mask_lo, mask_hi) triple; (state_bits, state_lo) the packed word
+    carried as 1-2 uint32 lanes. The scan carry is
+    (*row_lanes, live, ok, fail_r, r_idx, maxf, steps_n, stepped) —
+    every path below is lane-generic, so verdicts, counterexample
+    localization, max-frontier, and configs-stepped are identical
+    across layouts (parity-pinned)."""
     step = STEPS[step_name]
-    bit_lo, bit_hi = _slot_bits(C)
+    rep = _rep(pack, C)
     if probe_limit <= 0:
         # host entry points resolve eagerly; this is the safety net for
         # internal callers (e.g. _frontier_at's default-arg path)
@@ -472,74 +853,105 @@ def _scan_step_factory(step_name: str, N: int, C: int,
     )
 
     def closure_cond(c):
-        _, _, _, _, changed, overflow, _, _ = c
-        return changed & ~overflow
+        return c["changed"] & ~c["ovf"]
 
     def make_closure_body(ev):
         def body(c):
-            st, ml, mh, live, _, _, iters, stepped = c
+            rows, live = c["rows"], c["live"]
             cand_st, cand_ok = step_cc(
-                st, ev["slot_f"], ev["slot_a0"], ev["slot_a1"], ev["slot_wild"]
+                rep.state(rows), ev["slot_f"], ev["slot_a0"],
+                ev["slot_a1"], ev["slot_wild"]
             )
-            already = ((ml[:, None] & bit_lo[None, :])
-                       | (mh[:, None] & bit_hi[None, :])) != 0
+            already = rep.mask_test(rows)
             legal = (live[:, None] & ev["slot_occ"][None, :]
                      & ~already & cand_ok)
-            cand_ml = ml[:, None] | bit_lo[None, :]
-            cand_mh = mh[:, None] | bit_hi[None, :]
-            all_st = jnp.concatenate([st, cand_st.reshape(-1)])
-            all_ml = jnp.concatenate([ml, cand_ml.reshape(-1)])
-            all_mh = jnp.concatenate([mh, cand_mh.reshape(-1)])
+            all_rows = _rows_concat(rows, rep.candidates(rows, cand_st))
             all_live = jnp.concatenate([live, legal.reshape(-1)])
             old_count = jnp.sum(live)
-            st2, ml2, mh2, live2, count, ovf = _dedupe_compact(
-                all_st, all_ml, all_mh, all_live, N)
-            return (st2, ml2, mh2, live2, count > old_count, ovf,
-                    iters + 1, stepped + old_count)
+            rows2, live2, count, ovf = _dedupe_compact(
+                all_rows, all_live, N, rep)
+            return {"rows": rows2, "live": live2,
+                    "changed": count > old_count, "ovf": ovf,
+                    "iters": c["iters"] + 1,
+                    "stepped": c["stepped"] + old_count}
         return body
 
     zero_hist = jnp.zeros(N_PROBE_BUCKETS, jnp.int32)
+    tiled_mode = sparse_pallas in ("tiled", "tiled-interpret")
+    if tiled_mode:
+        from jepsen_tpu.parallel import sparse_kernels as sk
+        tiled_plan = sk.tiled_plan(N, C, rep.lanes)
 
-    def run_closure(ev, st, ml, mh, live, run, stepped):
-        """-> (st2, ml2, mh2, live2, ovf, iters, stepped2, extras)
-        where extras is (swork_delta, probe_hist) under search_stats
-        (sort: swork == the stepped delta, hist zeros) and None
-        otherwise."""
+    def make_tiled_insert(interpret: bool):
+        """The `insert` hook that streams the visited-set transaction
+        through the tiled kernel (probe/claim in VMEM tiles) while the
+        append stays XLA-side — the closure around it is byte-for-byte
+        _hash_event_closure."""
+        from jepsen_tpu.parallel import sparse_kernels as sk
+
+        def insert(c_rows, c_live, f_rows, count, table):
+            table2, fresh, off, p_ovf = sk.tiled_insert_call(
+                c_rows, c_live, table, probe_limit, tiled_plan, pack,
+                C, interpret=interpret)
+            rows2, count2, n_fresh, a_ovf = _append_fresh(
+                c_rows, fresh, f_rows, count, N)
+            out = (rows2, table2, count2, n_fresh, p_ovf | a_ovf)
+            if search_stats:
+                return out + (_probe_hist(off, c_live),)
+            return out
+        return insert
+
+    def run_closure(ev, rows, live, run, stepped):
+        """-> (rows2, live2, ovf, iters, stepped2, extras) where
+        extras is (swork_delta, probe_hist) under search_stats (sort:
+        swork == the stepped delta, hist zeros) and None otherwise."""
         if dedupe == "sort":
-            st2, ml2, mh2, live2, _, ovf, iters, stepped2 = \
-                lax.while_loop(
-                    closure_cond, make_closure_body(ev),
-                    (st, ml, mh, live, run, jnp.array(False),
-                     jnp.int32(0), stepped))
+            out = lax.while_loop(
+                closure_cond, make_closure_body(ev),
+                {"rows": rows, "live": live, "changed": run,
+                 "ovf": jnp.array(False), "iters": jnp.int32(0),
+                 "stepped": stepped})
+            stepped2 = out["stepped"]
             extras = ((stepped2 - stepped, zero_hist)
                       if search_stats else None)
-            return st2, ml2, mh2, live2, ovf, iters, stepped2, extras
-        if sparse_pallas != "off":
+            return (out["rows"], out["live"], out["ovf"], out["iters"],
+                    stepped2, extras)
+        if sparse_pallas in ("on", "interpret"):
             # the fused kernel: the whole per-event closure inside one
             # pallas_call, frontier + table + slot tables VMEM-resident
             from jepsen_tpu.parallel import sparse_kernels as sk
             out = sk.frontier_closure_call(
-                step_name, ev, st, ml, mh, live, run, N, C,
-                probe_limit, interpret=(sparse_pallas == "interpret"),
+                step_name, ev, rows, live, run, N, C,
+                probe_limit, pack,
+                interpret=(sparse_pallas == "interpret"),
                 stats=search_stats)
+        elif tiled_mode:
+            out = _hash_event_closure(
+                rep, step_cc, ev, rows, live, run, N, T, probe_limit,
+                stats=search_stats,
+                insert=make_tiled_insert(
+                    sparse_pallas == "tiled-interpret"))
         else:
             out = _hash_event_closure(
-                step_cc, ev, st, ml, mh, live, run, N, C, T,
+                rep, step_cc, ev, rows, live, run, N, T,
                 probe_limit, stats=search_stats)
-        st2, ml2, mh2, count, ovf, iters, d = out[:7]
-        extras = (out[7], out[8]) if search_stats else None
+        rows2, count, ovf, iters, d = out[:5]
+        extras = (out[5], out[6]) if search_stats else None
         live2 = jnp.arange(N) < count
-        return st2, ml2, mh2, live2, ovf, iters, stepped + d, extras
+        return rows2, live2, ovf, iters, stepped + d, extras
+
+    L = rep.lanes
 
     def scan_step(carry, ev):
-        st, ml, mh, live, ok, fail_r, r_idx, maxf, steps_n, stepped = carry
+        rows = carry[:L]
+        live, ok, fail_r, r_idx, maxf, steps_n, stepped = carry[L:]
         is_pad = ev["ev_slot"] < 0
         run = ok & ~is_pad
 
         # closure: expand until no new configs (skipped when run=False:
         # the initial `changed` flag is `run`)
-        st2, ml2, mh2, live2, ovf, iters, stepped2, extras = run_closure(
-            ev, st, ml, mh, live, run, stepped)
+        rows2, live2, ovf, iters, stepped2, extras = run_closure(
+            ev, rows, live, run, stepped)
         # the hash prologue runs unconditionally (lax.scan cannot skip
         # an event) — a pad/settled event's probe flag must not leak
         # into the host's capacity-escalation decision
@@ -547,25 +959,16 @@ def _scan_step_factory(step_name: str, N: int, C: int,
 
         # filter: returning call must have linearized; then free its slot
         s = jnp.maximum(ev["ev_slot"], 0).astype(jnp.uint32)
-        one = jnp.uint32(1)
-        blo = jnp.where(s < 32, one << jnp.minimum(s, 31),
-                        jnp.uint32(0)).astype(jnp.uint32)
-        bhi = jnp.where(s >= 32,
-                        one << jnp.minimum(jnp.where(s >= 32, s - 32, 0),
-                                           jnp.uint32(31)),
-                        jnp.uint32(0)).astype(jnp.uint32)
-        has = ((ml2 & blo) | (mh2 & bhi)) != 0
+        bits = rep.event_bits(s)
+        has = rep.has_event_bit(rows2, bits)
         live3 = live2 & has
-        ml3 = jnp.where(live3, ml2 & ~blo, ml2)
-        mh3 = jnp.where(live3, mh2 & ~bhi, mh2)
+        rows3 = rep.clear_event_bit(rows2, bits, live3)
         n_live = jnp.sum(live3)
         failed_here = run & (n_live == 0)
 
         new_ok = jnp.where(run, ~failed_here & ~ovf, ok)
         new_fail = jnp.where(failed_here & (fail_r < 0), r_idx, fail_r)
-        st_o = jnp.where(run, st2, st)
-        ml_o = jnp.where(run, ml3, ml)
-        mh_o = jnp.where(run, mh3, mh)
+        rows_o = _rows_where(run, rows3, rows)
         live_o = jnp.where(run, live3, live)
         maxf = jnp.maximum(maxf, jnp.where(run, jnp.sum(live2), 0))
         # count closure iterations only; the host multiplies by N*C in
@@ -583,9 +986,9 @@ def _scan_step_factory(step_name: str, N: int, C: int,
         # batched form interleaves per-key pads) resumes at the right
         # event. Identical for the historical paths — their pads only
         # ever trail the last real event.
-        carry_o = (st_o, ml_o, mh_o, live_o, new_ok, new_fail,
-                   r_idx + jnp.where(is_pad, 0, 1), maxf, steps_n,
-                   stepped_o)
+        carry_o = rows_o + (live_o, new_ok, new_fail,
+                            r_idx + jnp.where(is_pad, 0, 1), maxf,
+                            steps_n, stepped_o)
         if not search_stats:
             return carry_o, ovf
         # per-event device stats: width -1 marks "did not run" (pad or
@@ -609,18 +1012,19 @@ def _scan_step_factory(step_name: str, N: int, C: int,
 def _check_impl(xs, state0, step_name: str, N: int,
                 dedupe: str = "sort", probe_limit: int = 0,
                 sparse_pallas: str = "off",
-                search_stats: bool = False):
+                search_stats: bool = False, pack: tuple = ()):
     """Scan over all return events from scratch. xs: dict of [R, ...]
     arrays. Returns (valid, fail_event, overflow, max_frontier,
     steps_evaluated, configs_stepped) — plus, under `search_stats`,
     the per-event stats dict of [R]-stacked arrays."""
     C = xs["slot_f"].shape[1]
-    carry0 = _initial_carry(state0, N)
+    rep = _rep(pack, C)
+    carry0 = _initial_carry(state0, N, rep)
     carry, ys = lax.scan(
         _scan_step_factory(step_name, N, C, dedupe, probe_limit,
-                           sparse_pallas, search_stats),
+                           sparse_pallas, search_stats, pack),
         carry0, xs)
-    _, _, _, live, ok, fail_r, _, maxf, steps_n, stepped = carry
+    live, ok, fail_r, _, maxf, steps_n, stepped = carry[rep.lanes:]
     ovfs = ys["ovf"] if search_stats else ys
     overflow = jnp.any(ovfs)
     valid = ok & (jnp.sum(live) > 0) & ~overflow
@@ -630,22 +1034,27 @@ def _check_impl(xs, state0, step_name: str, N: int,
     return base
 
 
-# donation decision (recompile-donate-argnums) for the three jits
-# below: NOT donated. The xs event tables and state0 are reused across
-# the capacity-tier retry loops (check_encoded and _check_batch_sparse
-# re-dispatch the SAME arrays at doubled N after an overflow; the
-# resumable path re-runs a chunk after growing the checkpoint) —
-# donating them would invalidate the retry inputs. The frontier carry
-# is rebuilt per call, so there is no persistent caller buffer to
-# reclaim either.
-@functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
+# donation decision (recompile-donate-argnums), DECIDED: the resumable
+# jits DONATE their frontier carry — it is rebuilt per call from the
+# host-side FrontierCheckpoint (cp.carry / extend's _stack_carries
+# place fresh device arrays every dispatch, including the
+# overflow-retry and _frontier_at paths), the output carry aliases it
+# exactly (same shapes/dtypes), and at the top capacity tiers the
+# carry IS the peak-HBM buffer — donation halves it. xs/state0 are NOT
+# donated anywhere: the one-shot escalation loop re-dispatches the
+# SAME xs arrays at doubled N after an overflow, and no output aliases
+# the event tables (donating them would only trade the retry inputs
+# for an unusable-donation warning).
+@functools.partial(jax.jit,
+                   donate_argnames=("carry0",),
                    static_argnames=("step_name", "N", "dedupe",
                                     "probe_limit", "sparse_pallas",
-                                    "search_stats"))
+                                    "search_stats", "pack"))
 def _check_device_resumable(xs, carry0, step_name: str, N: int,
                             dedupe: str = "sort", probe_limit: int = 0,
                             sparse_pallas: str = "off",
-                            search_stats: bool = False):
+                            search_stats: bool = False,
+                            pack: tuple = ()):
     """One chunk of events from an explicit carry; returns the final
     carry plus the overflow flag so the host can checkpoint between
     chunks. Under `search_stats` a third output: the chunk's
@@ -655,47 +1064,57 @@ def _check_device_resumable(xs, carry0, step_name: str, N: int,
     C = xs["slot_f"].shape[1]
     carry, ys = lax.scan(
         _scan_step_factory(step_name, N, C, dedupe, probe_limit,
-                           sparse_pallas, search_stats),
+                           sparse_pallas, search_stats, pack),
         carry0, xs)
     if search_stats:
         return carry, jnp.any(ys["ovf"]), ys
     return carry, jnp.any(ys)
 
 
-# same donation decision as _check_device_resumable above
-# jepsen-lint: disable=recompile-donate-argnums
+# donation decision, DECIDED: nothing donatable — see the block
+# comment above _check_device_resumable (xs is reused across the
+# capacity-escalation retries; every output is a scalar)
 _check_device = jax.jit(_check_impl,
+                        donate_argnums=(),
                         static_argnames=("step_name", "N", "dedupe",
                                          "probe_limit", "sparse_pallas",
-                                         "search_stats"))
+                                         "search_stats", "pack"))
 
 
-# same donation decision as _check_device_resumable above
-@functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
+# donation decision, DECIDED: nothing donatable — the batch tier loop
+# re-dispatches pending keys from freshly placed arrays, but every
+# output is a per-key scalar, so no input buffer can alias an output
+@functools.partial(jax.jit,
+                   donate_argnums=(),
                    static_argnames=("step_name", "N", "dedupe",
                                     "probe_limit", "sparse_pallas",
-                                    "search_stats"))
+                                    "search_stats", "pack"))
 def _check_device_batch(xs, state0, step_name: str, N: int,
                         dedupe: str = "sort", probe_limit: int = 0,
                         sparse_pallas: str = "off",
-                        search_stats: bool = False):
+                        search_stats: bool = False, pack: tuple = ()):
     return jax.vmap(
         lambda x, s0: _check_impl(x, s0, step_name, N, dedupe,
                                   probe_limit, sparse_pallas,
-                                  search_stats)
+                                  search_stats, pack)
     )(xs, state0)
 
 
-# same donation decision as _check_device_resumable above
-@functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
+# donation decision, DECIDED: the stacked per-key carry donates — same
+# rationale as _check_device_resumable (extend builds it fresh per
+# dispatch; overflowed members fall back to their solo path from the
+# host-side checkpoint, never from these device arrays)
+@functools.partial(jax.jit,
+                   donate_argnames=("carry0",),
                    static_argnames=("step_name", "N", "dedupe",
                                     "probe_limit", "sparse_pallas",
-                                    "search_stats"))
+                                    "search_stats", "pack"))
 def _check_device_batch_resumable(xs, carry0, step_name: str, N: int,
                                   dedupe: str = "sort",
                                   probe_limit: int = 0,
                                   sparse_pallas: str = "off",
-                                  search_stats: bool = False):
+                                  search_stats: bool = False,
+                                  pack: tuple = ()):
     """The streaming extension's batched scan: one chunk of events per
     key from an explicit per-key carry — jepsen_tpu.parallel.extend
     stacks shape-compatible sessions' frontiers and advances them in
@@ -707,7 +1126,7 @@ def _check_device_batch_resumable(xs, carry0, step_name: str, N: int,
     `search_stats` (width=-1 rows are that key's pads)."""
     C = xs["slot_f"].shape[2]
     step = _scan_step_factory(step_name, N, C, dedupe, probe_limit,
-                              sparse_pallas, search_stats)
+                              sparse_pallas, search_stats, pack)
 
     if search_stats:
         def one_s(x, c):
@@ -735,6 +1154,19 @@ def _place(tree, device=None):
     if device is not None:
         return jax.device_put(tree, device)
     return jax.tree.map(jnp.asarray, tree)
+
+
+def _place_owned(tree, device=None):
+    """_place for buffers that will be DONATED: guarantees device-
+    OWNED allocations. jnp.asarray / device_put can be ZERO-COPY on
+    the CPU backend — the ArrayImpl then merely windows host numpy
+    memory — and donating such a view is unsound: XLA aliases its
+    output into memory it does not own (observed as
+    nondeterministically corrupt counters on resumed searches). The
+    post-placement jnp.copy runs on the placed array's OWN
+    device/sharding, so the never-the-default-backend invariant of
+    _place(device=...) is preserved."""
+    return jax.tree.map(jnp.copy, _place(tree, device))
 
 
 def _xs_from_encoded(e: EncodedHistory, device=None) -> dict:
@@ -796,15 +1228,33 @@ class FrontierCheckpoint:
         cp.st[0] = e.state0
         return cp
 
-    def carry(self, device=None):
+    def carry(self, device=None, pack=(), C: int = 0):
         """The device scan carry this checkpoint resumes from. With
         `device` every array is explicitly placed there (same
-        invariant as _xs_from_encoded: never the default backend)."""
-        return _place((self.st, self.ml, self.mh, self.live,
-                       np.bool_(self.ok), np.int32(self.fail_r),
-                       np.int32(self.event_index), np.int32(self.maxf),
-                       np.int32(self.steps_n), np.int32(self.stepped)),
-                      device)
+        invariant as _xs_from_encoded: never the default backend).
+
+        Checkpoints store the CANONICAL (st, ml, mh) triple whatever
+        layout the engine runs — the representation-independent
+        interchange format (v1/v2 files, serve freeze/thaw, host
+        resume seeds, and resuming a packed search unpacked or vice
+        versa all just work, even when a delta grows the slot window
+        and shifts the packed bit positions). With `pack` (and the
+        traced program's slot width `C`) the rows pack at this
+        boundary — cheap host numpy over N rows, once per chunk."""
+        if pack:
+            rows = pack_rows_np(pack, C, self.st, self.ml, self.mh)
+        else:
+            rows = (self.st, self.ml, self.mh)
+        # _place_owned, not _place: the resumable jits DONATE this
+        # carry, and a zero-copy placement would hand XLA a window
+        # onto memory this live checkpoint still owns
+        return _place_owned(tuple(rows) + (self.live,
+                            np.bool_(self.ok), np.int32(self.fail_r),
+                            np.int32(self.event_index),
+                            np.int32(self.maxf),
+                            np.int32(self.steps_n),
+                            np.int32(self.stepped)),
+                            device)
 
     def grown(self, new_capacity: int) -> "FrontierCheckpoint":
         """Re-embed the frontier into a larger capacity (overflow
@@ -851,6 +1301,21 @@ class FrontierCheckpoint:
                    fail_r, maxf, steps_n, stepped)
 
 
+def carry_fields_np(carry, pack=(), C: int = 0):
+    """A returned device scan carry -> the canonical numpy 10-tuple
+    (st, ml, mh, live, ok, fail_r, r_idx, maxf, steps_n, stepped) —
+    the inverse of FrontierCheckpoint.carry's packing boundary, shared
+    by the resumable entry point and the streaming extension."""
+    lanes = pack_lanes(pack, C) if pack else 3
+    rows = [np.asarray(x) for x in carry[:lanes]]
+    rest = tuple(np.asarray(x) for x in carry[lanes:])
+    if pack:
+        st, ml, mh = unpack_rows_np(pack, C, rows)
+    else:
+        st, ml, mh = rows
+    return (st, ml, mh) + rest
+
+
 def history_digest(e: EncodedHistory) -> str:
     """Stable identity of an encoded history, for checkpoint safety."""
     import hashlib
@@ -872,7 +1337,8 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
                             probe_limit: int = 0,
                             sparse_pallas: Optional[bool] = None,
                             model=None,
-                            search_stats: Optional[bool] = None) -> dict:
+                            search_stats: Optional[bool] = None,
+                            config_pack: Optional[bool] = None) -> dict:
     """check_encoded with mid-search checkpointing: events are processed
     in chunks of `checkpoint_every`; after each chunk the frontier is
     pulled to host and handed to checkpoint_cb(FrontierCheckpoint) (e.g.
@@ -896,6 +1362,9 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
     dedupe = _resolve_dedupe(dedupe)
     probe_limit = _resolve_probe_limit(probe_limit)
     ss = _resolve_search_stats(search_stats)
+    pack_req = _resolve_config_pack(config_pack)
+    C_enc = e.slot_f.shape[1]
+    pack = pack_spec_for(e) if pack_req else ()
     platform = getattr(device, "platform", None) or jax.default_backend()
     digest = history_digest(e)
     if resume is not None:
@@ -929,14 +1398,14 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
         # contract as check_encoded's tier loop)
         mode, note = _resolve_sparse_pallas(
             sparse_pallas, cp.capacity, e.slot_f.shape[1], platform,
-            dedupe)
+            dedupe, pack)
 
         def _chunk(lo=lo, hi=hi, cp=cp, mode=mode):
             chunk = _place({k: v[lo:hi] for k, v in xs_np.items()},
                            device)
             out = _check_device_resumable(
-                chunk, cp.carry(device), e.step_name, cp.capacity,
-                dedupe, probe_limit, mode, ss)
+                chunk, cp.carry(device, pack, C_enc), e.step_name,
+                cp.capacity, dedupe, probe_limit, mode, ss, pack)
             # materialize inside the supervised window: async dispatch
             # must fail (or hang) here, not at a later host read
             if ss:
@@ -994,7 +1463,7 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
             # stats would double its events
             acc.add_chunk(res[2], cp.capacity)
         st, ml, mh, live, ok, fail_r, r_idx, maxf, steps_n, stepped = \
-            [np.asarray(x) for x in carry]
+            carry_fields_np(carry, pack, C_enc)
         cp = FrontierCheckpoint(int(r_idx), cp.capacity, e.step_name,
                                 digest, st, ml, mh, live, bool(ok),
                                 int(fail_r), int(maxf), int(steps_n),
@@ -1014,6 +1483,7 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
     if acc is not None:
         out["stats"] = _finish_search_stats(acc, t0, _pc())
     _tag_sparse_closure(out, mode, note)
+    _tag_config_pack(out, pack, pack_req, C_enc)
     if not out["valid?"]:
         out.update(_fail_op(e, cp.fail_r))
     return out
@@ -1026,12 +1496,34 @@ def _tag_sparse_closure(out: dict, mode: str, note) -> dict:
     """Stamp which hash-closure implementation ran — bitdense's
     "closure"/"closure-note" vocabulary. Only when the kernel was
     REQUESTED (mode on, or a downgrade note): the flag-off result dict
-    stays byte-identical to the pre-kernel schema."""
-    if mode != "off":
+    stays byte-identical to the pre-kernel schema. "pallas-tiled" =
+    the per-iteration insert streamed the table through VMEM tiles
+    (sparse_kernels.tiled_insert_call) because the whole-event fusion
+    was past the width-aware gate."""
+    if mode in ("tiled", "tiled-interpret"):
+        out["closure"] = "pallas-tiled"
+    elif mode != "off":
         out["closure"] = "pallas"
     elif note is not None:
         out["closure"] = "xla-hash"
         out["closure-note"] = note
+    return out
+
+
+def _tag_config_pack(out: dict, pack, requested: bool, C: int) -> dict:
+    """Stamp the configuration-row layout that actually ran — only
+    when packing was REQUESTED (argument or JEPSEN_TPU_CONFIG_PACK),
+    so the flag-off result dict stays byte-identical. "unpacked" on a
+    requested run is the overflow-to-unpacked path: the event family's
+    state_bits + C exceeded 64 bits (or its state space is unknown),
+    so the engine ran the historical triple."""
+    if not requested:
+        return out
+    if pack:
+        out["config-pack"] = f"packed:{pack[0] + C}b/" \
+                             f"{pack_lanes(pack, C)}-lane"
+    else:
+        out["config-pack"] = "unpacked"
     return out
 
 
@@ -1252,7 +1744,8 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
                   dedupe: Optional[str] = None,
                   probe_limit: int = 0,
                   sparse_pallas: Optional[bool] = None,
-                  search_stats: Optional[bool] = None) -> dict:
+                  search_stats: Optional[bool] = None,
+                  config_pack: Optional[bool] = None) -> dict:
     """Check one encoded history, doubling frontier capacity on overflow
     (re-jit per capacity tier; tiers are cached by jax.jit). With
     `device` every input is explicitly placed there and the search runs
@@ -1282,12 +1775,21 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
     frontier-width trajectory, closure iterations, hash-table load,
     probe-length histogram, capacity tier (docs/observability.md
     "Search telemetry"). Off: the result dict is byte-identical to the
-    pre-stats schema."""
+    pre-stats schema.
+
+    `config_pack` (None = the JEPSEN_TPU_CONFIG_PACK flag) packs each
+    configuration row into the minimal word the event family needs
+    (docs/performance.md "VMEM economics") — verdicts,
+    counterexamples, max-frontier, and configs-stepped are identical
+    either way (parity-pinned); a family whose word exceeds 64 bits
+    runs unpacked, tagged "config-pack": "unpacked"."""
     if e.n_returns == 0:
         return {"valid?": True, "max-frontier": 0, "capacity": 0}
     dedupe = _resolve_dedupe(dedupe)
     probe_limit = _resolve_probe_limit(probe_limit)
     ss = _resolve_search_stats(search_stats)
+    pack_req = _resolve_config_pack(config_pack)
+    pack = pack_spec_for(e) if pack_req else ()
     platform = getattr(device, "platform", None) or jax.default_backend()
     C = e.slot_f.shape[1]
     # H2D placement and the search both run through the supervised
@@ -1309,11 +1811,12 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
                   dedupe=dedupe) as sp:
         while True:
             mode, note = _resolve_sparse_pallas(sparse_pallas, N, C,
-                                                platform, dedupe)
+                                                platform, dedupe, pack)
 
             def _search(N=N, mode=mode):
                 out = _check_device(xs, state0, e.step_name, N,
-                                    dedupe, probe_limit, mode, ss)
+                                    dedupe, probe_limit, mode, ss,
+                                    pack)
                 # tree map (not a list comp): the stats output is a
                 # dict of arrays riding along under search_stats
                 return jax.tree.map(np.asarray, out)
@@ -1334,7 +1837,9 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
         if mode != "off":
             # only when the kernel was requested: the flag-off trace
             # schema stays identical, like the result dict
-            sp.set(closure="pallas")
+            sp.set(closure="pallas-tiled"
+                   if mode in ("tiled", "tiled-interpret")
+                   else "pallas")
     obs.counter("engine.configs_stepped").inc(int(stepped))
     out = {
         "valid?": bool(valid),
@@ -1348,6 +1853,7 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
         "explored": int(steps_n) * N * len(e.slot_f[0]),
     }
     _tag_sparse_closure(out, mode, note)
+    _tag_config_pack(out, pack, pack_req, C)
     if ss:
         acc = SearchStats(dedupe)
         acc.escalations = n_esc
@@ -1362,7 +1868,8 @@ def analysis(model, history, capacity: int = 1024,
              max_capacity: int = 1 << 20, encode_cache=None,
              dedupe: Optional[str] = None,
              sparse_pallas: Optional[bool] = None,
-             search_stats: Optional[bool] = None) -> dict:
+             search_stats: Optional[bool] = None,
+             config_pack: Optional[bool] = None) -> dict:
     """knossos-style (model, history) -> result on the device engine.
 
     Falls back to the host WGL engine when the model can't pack or the
@@ -1418,7 +1925,8 @@ def analysis(model, history, capacity: int = 1024,
             r = check_encoded(e, capacity=capacity,
                               max_capacity=max_capacity, dedupe=dedupe,
                               sparse_pallas=sparse_pallas,
-                              search_stats=search_stats)
+                              search_stats=search_stats,
+                              config_pack=config_pack)
     except sup.DISPATCH_FAILURES as err:
         # the degradation contract (docs/resilience.md): a dead device
         # dispatch — wedged, crashed, or breaker-refused — degrades to
@@ -1639,10 +2147,14 @@ def _frontier_at(e: EncodedHistory, start_ev: int):
     N = 1024
     while True:
         def _rescan(N=N):
-            carry0 = _initial_carry(jnp.int32(e.state0), N)
+            # always the unpacked layout: this re-scan feeds host-side
+            # seed decoding (the canonical triple), and extraction
+            # correctness must never depend on a perf flag
+            carry0 = _initial_carry(jnp.int32(e.state0), N,
+                                    _rep((), e.slot_f.shape[1]))
             carry, overflow = _check_device_resumable(
                 chunk, carry0, e.step_name, N)
-            return carry, bool(overflow)
+            return ([np.asarray(x) for x in carry], bool(overflow))
 
         # supervised like every dispatch, but with no breaker backend:
         # this re-scan runs INSIDE recovery/extraction paths, and its
@@ -1766,7 +2278,8 @@ def check_batch(model, histories, capacity: int = 512,
                 pipeline_stats: Optional[dict] = None,
                 dedupe: Optional[str] = None,
                 sparse_pallas: Optional[bool] = None,
-                search_stats: Optional[bool] = None) -> list:
+                search_stats: Optional[bool] = None,
+                config_pack: Optional[bool] = None) -> list:
     """Check many per-key histories in one device program per
     slot-window bucket: vmap over the key axis; with a mesh (and K
     divisible by its size) the key axis is sharded across devices —
@@ -1814,7 +2327,8 @@ def check_batch(model, histories, capacity: int = 512,
             model, histories, capacity=capacity,
             max_capacity=max_capacity, mesh=mesh, bucket=bucket,
             cache=cache, stats=pipeline_stats, dedupe=dedupe,
-            sparse_pallas=sparse_pallas, search_stats=search_stats)
+            sparse_pallas=sparse_pallas, search_stats=search_stats,
+            config_pack=config_pack)
     if (cache is not None and cache is not False) \
             or pipeline_stats is not None:
         # the serial path consults no cache and fills no stats —
@@ -1835,7 +2349,8 @@ def check_batch(model, histories, capacity: int = 512,
                                    max_capacity=max_capacity, mesh=mesh,
                                    bucket=bucket, dedupe=dedupe,
                                    sparse_pallas=sparse_pallas,
-                                   search_stats=search_stats)
+                                   search_stats=search_stats,
+                                   config_pack=config_pack)
 
 
 def _resolve_bucket(bucket: Optional[str]) -> str:
@@ -1878,7 +2393,8 @@ def check_batch_encoded(model, pre, capacity: int = 512,
                         bucket: Optional[str] = None,
                         dedupe: Optional[str] = None,
                         sparse_pallas: Optional[bool] = None,
-                        search_stats: Optional[bool] = None) -> list:
+                        search_stats: Optional[bool] = None,
+                        config_pack: Optional[bool] = None) -> list:
     """check_batch on ALREADY-ENCODED keys (the bucketing + dispatch
     half without the encode half). Public so callers that time or
     cache the encode separately — bench.sec_multikey's encode/device
@@ -1921,7 +2437,8 @@ def check_batch_encoded(model, pre, capacity: int = 512,
             rs = _check_batch_sparse(model, sub, capacity, max_capacity,
                                      mesh, dedupe=dedupe,
                                      sparse_pallas=sparse_pallas,
-                                     search_stats=search_stats)
+                                     search_stats=search_stats,
+                                     config_pack=config_pack)
         for i, r in zip(idxs, rs):
             out[i] = r
     return out
@@ -1931,19 +2448,24 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
                         mesh=None, dedupe: str = "sort",
                         probe_limit: int = 0,
                         sparse_pallas: Optional[bool] = None,
-                        search_stats: Optional[bool] = None) -> list:
+                        search_stats: Optional[bool] = None,
+                        config_pack: Optional[bool] = None) -> list:
     """Sparse-frontier batch path with per-key capacity-tier retry."""
     step_name = pre[0].step_name
     K = len(pre)
     out: list = [None] * K
     probe_limit = _resolve_probe_limit(probe_limit)
     ss = _resolve_search_stats(search_stats)
+    pack_req = _resolve_config_pack(config_pack)
     from time import perf_counter as _pc
     # the padded batch runs one program: gate the kernel on where the
     # batch actually lives (the mesh when given), like bitdense does
     platform = (np.asarray(mesh.devices).flat[0].platform
                 if mesh is not None else jax.default_backend())
     C = max(e.slot_f.shape[1] for e in pre)
+    # one COMMON layout for the whole padded program: the state field
+    # must cover every member's domain (pack_spec_for unions them)
+    pack = pack_spec_for(pre, C) if pack_req else ()
     # Per-key capacity retry: keys are bucketed by the capacity tier
     # they need — only keys that overflowed re-run (at doubled
     # capacity), so one hot key never drags the whole batch through
@@ -1954,7 +2476,7 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
     while pending:
         encs_t = [pre[i] for i in pending]
         mode, note = _resolve_sparse_pallas(sparse_pallas, N, C,
-                                            platform, dedupe)
+                                            platform, dedupe, pack)
         t0 = _pc()
         try:
             with obs.span("engine.sparse_batch", keys=len(pending),
@@ -1968,7 +2490,7 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
                 def _search(xs=xs, state0=state0, N=N, mode=mode):
                     out = _check_device_batch(xs, state0, step_name, N,
                                               dedupe, probe_limit, mode,
-                                              ss)
+                                              ss, pack)
                     # materialize inside the supervised window
                     return jax.tree.map(np.asarray, out)
 
@@ -2004,6 +2526,7 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
                  "capacity": N, "dedupe": dedupe,
                  "configs-stepped": int(stepped[j])}
             _tag_sparse_closure(r, mode, note)
+            _tag_config_pack(r, pack, pack_req, C)
             obs.counter("engine.configs_stepped").inc(int(stepped[j]))
             if ss:
                 acc = SearchStats(dedupe)
@@ -2027,7 +2550,8 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
                 out[i] = _escalate_overflow(pre[i], N, mesh,
                                             dedupe=dedupe,
                                             sparse_pallas=sparse_pallas,
-                                            search_stats=ss)
+                                            search_stats=ss,
+                                            config_pack=pack_req)
             break
         # keys that overflowed re-dispatch at the doubled tier — the
         # counter the capacity-retry ladder's cost is visible through
@@ -2041,7 +2565,8 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
 def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh,
                        dedupe: str = "sort",
                        sparse_pallas: Optional[bool] = None,
-                       search_stats: Optional[bool] = None) -> dict:
+                       search_stats: Optional[bool] = None,
+                       config_pack: Optional[bool] = None) -> dict:
     """A key too wide for the batch program escalates instead of dying
     as "unknown": first the single-key sparse engine at 4x the batch
     ceiling, then — with a mesh — the frontier-sharded engine, whose
@@ -2064,7 +2589,8 @@ def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh,
     r = check_encoded(e, capacity=min(batch_cap * 2, ceil_single),
                       max_capacity=ceil_single, device=dev,
                       dedupe=dedupe, sparse_pallas=sparse_pallas,
-                      search_stats=search_stats)
+                      search_stats=search_stats,
+                      config_pack=config_pack)
     if r["valid?"] != "unknown":
         r["escalated"] = "single"
         return r
@@ -2089,7 +2615,8 @@ def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh,
                 e, mesh, capacity=min(batch_cap * 8, ceil_sharded),
                 max_capacity=ceil_sharded, dedupe=dedupe,
                 sparse_pallas=sparse_pallas,
-                search_stats=search_stats)
+                search_stats=search_stats,
+                config_pack=config_pack)
             if rs["valid?"] != "unknown":
                 rs["escalated"] = "sharded"
                 return rs

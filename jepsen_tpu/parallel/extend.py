@@ -198,8 +198,9 @@ def _xs_slice(e: EncodedHistory, lo: int, hi: int, R_pad: int,
     return out
 
 
-def _cp_from_carry(carry, cp, step_name: str):
-    st, ml, mh, live, ok, fail_r, r_idx, maxf, steps_n, stepped = carry
+def _cp_from_carry(carry, cp, step_name: str, pack=(), C: int = 0):
+    st, ml, mh, live, ok, fail_r, r_idx, maxf, steps_n, stepped = \
+        engine.carry_fields_np(carry, pack, C)
     return engine.FrontierCheckpoint(
         int(r_idx), cp.capacity, step_name, cp.history_digest,
         st, ml, mh, live, bool(ok), int(fail_r), int(maxf),
@@ -209,7 +210,7 @@ def _cp_from_carry(carry, cp, step_name: str):
 def _advance_cp(e: EncodedHistory, cp, target: int, *, dedupe: str,
                 probe_limit: int, sparse_pallas, device, platform: str,
                 max_capacity: int, C_pad: Optional[int] = None,
-                stats_acc=None):
+                stats_acc=None, config_pack: bool = False):
     """Advance ``cp`` over return events [cp.event_index, target) of
     ``e``, doubling capacity on overflow. Supervised like every device
     dispatch, with the resumable path's degradation ladder: one device
@@ -227,21 +228,25 @@ def _advance_cp(e: EncodedHistory, cp, target: int, *, dedupe: str,
     test_serve pin each side)."""
     C = C_pad or e.slot_f.shape[1]
     ss = stats_acc is not None
+    # the pack layout rides the CURRENT encode (a delta that grows the
+    # slot window shifts the packed bit positions — safe, because the
+    # checkpoints in hand are canonical-unpacked and re-pack here)
+    pack = engine.pack_spec_for(e, C) if config_pack else ()
     mode, note = "off", None
     recovered = None
     while cp.event_index < target and cp.ok:
         lo = cp.event_index
         R_pad = _quantize(target - lo)
         mode, note = engine._resolve_sparse_pallas(
-            sparse_pallas, cp.capacity, C, platform, dedupe)
+            sparse_pallas, cp.capacity, C, platform, dedupe, pack)
 
         def _chunk(lo=lo, cp=cp, mode=mode, R_pad=R_pad):
             import jax as _jax
             xs = engine._place(_xs_slice(e, lo, target, R_pad, C),
                                device)
             out = engine._check_device_resumable(
-                xs, cp.carry(device), e.step_name, cp.capacity,
-                dedupe, probe_limit, mode, ss)
+                xs, cp.carry(device, pack, C), e.step_name,
+                cp.capacity, dedupe, probe_limit, mode, ss, pack)
             # materialize inside the supervised window (async dispatch
             # must fail or hang here, not at a later host read)
             if ss:
@@ -286,7 +291,7 @@ def _advance_cp(e: EncodedHistory, cp, target: int, *, dedupe: str,
             # only successful chunks: a re-run chunk's discarded
             # attempt must not double its events
             stats_acc.add_chunk(res[2], cp.capacity)
-        cp = _cp_from_carry(carry, cp, e.step_name)
+        cp = _cp_from_carry(carry, cp, e.step_name, pack, C)
     return cp, mode, note, recovered
 
 
@@ -313,7 +318,8 @@ class HistorySession:
                  max_capacity: int = 1 << 20,
                  dedupe: Optional[str] = None, probe_limit: int = 0,
                  sparse_pallas: Optional[bool] = None, device=None,
-                 key=None, search_stats: Optional[bool] = None):
+                 key=None, search_stats: Optional[bool] = None,
+                 config_pack: Optional[bool] = None):
         self.model = model
         self.key = key
         self.ops: list = []
@@ -322,6 +328,10 @@ class HistorySession:
         self.probe_limit = engine._resolve_probe_limit(probe_limit)
         self.sparse_pallas = sparse_pallas
         self.search_stats = engine._resolve_search_stats(search_stats)
+        # the packed-row REQUEST (JEPSEN_TPU_CONFIG_PACK); the layout
+        # itself is re-derived per scan from the current encode, since
+        # deltas can grow the slot window (checkpoints stay canonical)
+        self.config_pack = engine._resolve_config_pack(config_pack)
         # lifetime device-search stats across every delta's legs
         # (JEPSEN_TPU_SEARCH_STATS); _leg_acc is the in-flight check's
         # accumulator, merged in at _finish. NOT persisted by
@@ -435,7 +445,8 @@ class HistorySession:
         self._dirty = False
         return r
 
-    def _result_from(self, cp, mode, note, resume_ev: int) -> dict:
+    def _result_from(self, cp, mode, note, resume_ev: int,
+                     pack=None, pack_C: Optional[int] = None) -> dict:
         e = self.enc
         out = {"valid?": cp.ok and bool(np.asarray(cp.live).any()),
                "max-frontier": cp.maxf,
@@ -446,6 +457,16 @@ class HistorySession:
                "stream": {"resumed-from-event": resume_ev,
                           "events": e.n_returns}}
         engine._tag_sparse_closure(out, mode, note)
+        # tag the layout that actually RAN: the batched path passes its
+        # group's union layout (over the group's padded width), which
+        # can differ from this session's solo layout — a group with an
+        # unpackable member runs unpacked, and the evidence trail must
+        # say so. Solo scans (pack=None) re-derive their own.
+        if pack is None:
+            pack_C = e.slot_f.shape[1]
+            pack = (engine.pack_spec_for(e, pack_C)
+                    if self.config_pack else ())
+        engine._tag_config_pack(out, pack, self.config_pack, pack_C)
         if not out["valid?"]:
             out.update(engine._fail_op(e, cp.fail_r))
         return out
@@ -463,7 +484,8 @@ class HistorySession:
         return self._leg_acc
 
     def _finish(self, tcp, mode, note, resume_ev: int,
-                recovered) -> dict:
+                recovered, pack=None,
+                pack_C: Optional[int] = None) -> dict:
         """Bookkeeping shared by check() and advance_sessions() once
         the tail leg's carry is in hand."""
         resume_stepped = self._cp.stepped if self._cp is not None else 0
@@ -471,7 +493,7 @@ class HistorySession:
             max(0, tcp.stepped - resume_stepped))
         self.capacity = max(self.capacity, tcp.capacity)
         self._cp = self._cp_stable or tcp
-        r = self._result_from(tcp, mode, note, resume_ev)
+        r = self._result_from(tcp, mode, note, resume_ev, pack, pack_C)
         if recovered is not None:
             r["resilience"] = recovered
         if self._stats_acc is not None and self._leg_acc is not None:
@@ -565,7 +587,8 @@ class HistorySession:
         kw = dict(dedupe=self.dedupe, probe_limit=self.probe_limit,
                   sparse_pallas=self.sparse_pallas, device=self.device,
                   platform=platform, max_capacity=self.max_capacity,
-                  stats_acc=self._leg_stats())
+                  stats_acc=self._leg_stats(),
+                  config_pack=self.config_pack)
         recovered = None
         mode, note = "off", None
         with obs.span("stream.check", key=self.key, returns=R,
@@ -657,36 +680,50 @@ def _next_pow2(n: int) -> int:
     return 1 << max(0, (int(n) - 1).bit_length())
 
 
-def _stack_carries(cps, K_pad: int):
-    rows = list(cps) + [cps[-1]] * (K_pad - len(cps))
-    return (np.stack([c.st for c in rows]),
-            np.stack([c.ml for c in rows]),
-            np.stack([c.mh for c in rows]),
-            np.stack([c.live for c in rows]),
-            np.array([c.ok for c in rows], bool),
-            np.array([c.fail_r for c in rows], np.int32),
-            np.array([c.event_index for c in rows], np.int32),
-            np.array([c.maxf for c in rows], np.int32),
-            np.array([c.steps_n for c in rows], np.int32),
-            np.array([c.stepped for c in rows], np.int32))
+def _stack_carries(cps, K_pad: int, pack=(), C: int = 0):
+    members = list(cps) + [cps[-1]] * (K_pad - len(cps))
+    if pack:
+        lanes = [engine.pack_rows_np(pack, C, c.st, c.ml, c.mh)
+                 for c in members]
+        row_stacks = tuple(
+            np.stack([ln[i] for ln in lanes])
+            for i in range(len(lanes[0])))
+    else:
+        row_stacks = (np.stack([c.st for c in members]),
+                      np.stack([c.ml for c in members]),
+                      np.stack([c.mh for c in members]))
+    return row_stacks + (
+        np.stack([c.live for c in members]),
+        np.array([c.ok for c in members], bool),
+        np.array([c.fail_r for c in members], np.int32),
+        np.array([c.event_index for c in members], np.int32),
+        np.array([c.maxf for c in members], np.int32),
+        np.array([c.steps_n for c in members], np.int32),
+        np.array([c.stepped for c in members], np.int32))
 
 
 def _batch_leg(pairs, N: int, C_pad: int, dedupe: str,
                probe_limit: int, sparse_pallas, device,
-               platform: str, search_stats: bool = False):
+               platform: str, search_stats: bool = False,
+               pack: tuple = ()):
     """One batched scan leg: advance each (session, target) pair's
     in-flight cursor over its own rows in ONE device program. Returns
     (mode, note, overflowed_sessions); overflowed members keep their
     pre-leg cursor (their capacity retry runs individually). Under
     `search_stats`, each successful member's per-key stats rows feed
     its session's leg accumulator — batched legs report the same
-    per-event telemetry solo scans do."""
+    per-event telemetry solo scans do. `pack` is the GROUP's common
+    layout (advance_sessions computes it once over every member, so
+    all legs trace one layout and the result tag says exactly what
+    ran); a member set that cannot share a 64-bit word runs the leg
+    unpacked — representation never changes results, so
+    solo-vs-batched parity holds either way."""
     R_pad = _quantize(max(t - s._scan_cp.event_index
                           for s, t in pairs))
     K = len(pairs)
     K_pad = _next_pow2(K)
     mode, note = engine._resolve_sparse_pallas(
-        sparse_pallas, N, C_pad, platform, dedupe)
+        sparse_pallas, N, C_pad, platform, dedupe, pack)
     step_name = pairs[0][0].enc.step_name
 
     def _thunk():
@@ -695,12 +732,14 @@ def _batch_leg(pairs, N: int, C_pad: int, dedupe: str,
         chunks += [chunks[-1]] * (K_pad - K)   # shape filler, discarded
         xs = {k: np.stack([c[k] for c in chunks])
               for k in chunks[0]}
-        carry0 = _stack_carries([s._scan_cp for s, _ in pairs], K_pad)
+        carry0 = _stack_carries([s._scan_cp for s, _ in pairs], K_pad,
+                                pack, C_pad)
         xs = engine._place(xs, device)
-        carry0 = engine._place(carry0, device)
+        # owned placement: the batched-resumable jit donates carry0
+        carry0 = engine._place_owned(carry0, device)
         out = engine._check_device_batch_resumable(
             xs, carry0, step_name, N, dedupe, probe_limit, mode,
-            search_stats)
+            search_stats, pack)
         if search_stats:
             carry, ovf, ys = out
             return ([np.asarray(x) for x in carry], np.asarray(ovf),
@@ -720,12 +759,13 @@ def _batch_leg(pairs, N: int, C_pad: int, dedupe: str,
         if search_stats:
             s._leg_stats().add_chunk(
                 jax.tree.map(lambda a, k=k: a[k], res[2]), N)
+        st, ml, mh, live, ok, fail_r, r_idx, maxf, steps_n, stepped = \
+            engine.carry_fields_np(
+                tuple(a[k] for a in carry), pack, C_pad)
         s._scan_cp = engine.FrontierCheckpoint(
-            int(carry[6][k]), N, step_name,
-            s._scan_cp.history_digest, carry[0][k], carry[1][k],
-            carry[2][k], carry[3][k], bool(carry[4][k]),
-            int(carry[5][k]), int(carry[7][k]), int(carry[8][k]),
-            int(carry[9][k]))
+            int(r_idx), N, step_name,
+            s._scan_cp.history_digest, st, ml, mh, live, bool(ok),
+            int(fail_r), int(maxf), int(steps_n), int(stepped))
     return mode, note, overflowed
 
 
@@ -755,11 +795,11 @@ def advance_sessions(sessions, bucket: Optional[str] = None) -> list:
         gk = (s.enc.step_name, cp.capacity,
               engine.bucket_key(s.enc.n_slots, bucket), s.dedupe,
               s.probe_limit, s.sparse_pallas, s.search_stats,
-              id(s.device))
+              s.config_pack, id(s.device))
         groups.setdefault(gk, []).append(s)
 
     for (step_name, N, tier, dedupe, probe_limit, sparse_pallas,
-         search_stats, _dev), members in groups.items():
+         search_stats, config_pack, _dev), members in groups.items():
         if len(members) == 1:
             s = members[0]
             results[id(s)] = s.check()
@@ -770,6 +810,11 @@ def advance_sessions(sessions, bucket: Optional[str] = None) -> list:
         C_pad = min(enc_mod.MAX_SLOTS,
                     max(tier, max(m.enc.slot_f.shape[1]
                                   for m in members)))
+        # ONE union layout for the whole group, computed before any
+        # leg: every leg traces the same representation and the
+        # per-session result tag reports exactly what ran
+        pack = (engine.pack_spec_for([m.enc for m in members], C_pad)
+                if config_pack else ())
         obs.counter("stream.batched_keys").inc(len(members))
         live = list(members)
 
@@ -791,7 +836,7 @@ def advance_sessions(sessions, bucket: Optional[str] = None) -> list:
                     mode, note, overflowed = _batch_leg(
                         pairs, N, C_pad, dedupe, probe_limit,
                         sparse_pallas, device, platform,
-                        search_stats=search_stats)
+                        search_stats=search_stats, pack=pack)
                     if overflowed:
                         # the capacity ladder is per key: overflowed
                         # members leave the group and re-run solo
@@ -807,9 +852,10 @@ def advance_sessions(sessions, bucket: Optional[str] = None) -> list:
                              if s._cp is not None else 0)
                 mode_s, note_s = engine._resolve_sparse_pallas(
                     s.sparse_pallas, s._scan_cp.capacity,
-                    s.enc.slot_f.shape[1], platform, s.dedupe)
+                    s.enc.slot_f.shape[1], platform, s.dedupe, pack)
                 results[id(s)] = s._finish(s._scan_cp, mode_s, note_s,
-                                           resume_ev, None)
+                                           resume_ev, None,
+                                           pack=pack, pack_C=C_pad)
         except sup.DISPATCH_FAILURES:
             # a dead batched dispatch costs the batch nothing but the
             # batching: each member degrades through its own

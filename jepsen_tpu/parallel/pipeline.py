@@ -441,7 +441,8 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
                           stats: Optional[dict] = None,
                           dedupe: Optional[str] = None,
                           sparse_pallas: Optional[bool] = None,
-                          search_stats: Optional[bool] = None) -> list:
+                          search_stats: Optional[bool] = None,
+                          config_pack: Optional[bool] = None) -> list:
     """engine.check_batch with the three host/device phases overlapped
     (module docstring). Same arguments and bit-identical results;
     extras:
@@ -466,6 +467,10 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
     search_stats  per-key device-computed search telemetry in the
                 result "stats" dicts (engine._resolve_search_stats;
                 None = JEPSEN_TPU_SEARCH_STATS)
+    config_pack  packed configuration rows for the sparse buckets
+                (engine.check_encoded's docstring; None =
+                JEPSEN_TPU_CONFIG_PACK) — bitdense buckets are
+                untouched (the dense bitmap has no row triple to pack)
     """
     bucket = engine._resolve_bucket(bucket)
     dedupe = engine._resolve_dedupe(dedupe)
@@ -495,7 +500,8 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
     with root, obs.maybe_jax_profile():
         out = _stream(model, histories, capacity, max_capacity, mesh,
                       bucket, cache, workers, chunk_keys, depth, stats,
-                      dedupe, bitdense, sparse_pallas, search_stats)
+                      dedupe, bitdense, sparse_pallas, search_stats,
+                      config_pack)
     if c0 is not None:
         c1 = cache.counters()
         stats["cache"] = {k: c1[k] - c0[k] for k in
@@ -513,7 +519,7 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
 def _stream(model, histories, capacity, max_capacity, mesh, bucket,
             cache, workers, chunk_keys, depth, stats, dedupe,
             bitdense, sparse_pallas=None,
-            search_stats: bool = False) -> list:
+            search_stats: bool = False, config_pack=None) -> list:
     """The executor body (check_batch_pipelined's docstring), under the
     pipeline.run root span. Telemetry it feeds: pipeline.prepare /
     pipeline.encode spans on the pool threads (nested via ctx_runner),
@@ -685,7 +691,8 @@ def _stream(model, histories, capacity, max_capacity, mesh, bucket,
                     rs = engine._check_batch_sparse(
                         model, sub, capacity, max_capacity, mesh,
                         dedupe=dedupe, sparse_pallas=sparse_pallas,
-                        search_stats=search_stats)
+                        search_stats=search_stats,
+                        config_pack=config_pack)
                 for i, r in zip(idxs, rs):
                     out[i] = r
         while pending:

@@ -54,11 +54,16 @@ from jepsen_tpu import obs
 from jepsen_tpu.parallel.encode import EncodedHistory
 from jepsen_tpu.parallel.engine import (N_PROBE_BUCKETS, _empty_table,
                                         _hash_insert_append, _next_pow2,
+                                        _rep, _resolve_config_pack,
                                         _resolve_dedupe,
                                         _resolve_probe_limit,
                                         _resolve_search_stats,
-                                        _slot_bits, _tag_sparse_closure,
-                                        _xs_from_encoded)
+                                        _rows_concat, _rows_prev_same,
+                                        _rows_take, _rows_where,
+                                        _tag_config_pack,
+                                        _tag_sparse_closure,
+                                        _xs_from_encoded, pack_lanes,
+                                        pack_rows_np, pack_spec_for)
 from jepsen_tpu.parallel.steps import STEPS
 from jepsen_tpu.resilience import supervisor as sup
 
@@ -81,38 +86,27 @@ def _shard_map(f, mesh, in_specs, out_specs, check_vma=False):
                check_rep=check_vma)
 
 
-def _hash_config(st, ml, mh):
-    h = (st.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)) \
-        ^ (ml * jnp.uint32(0x85EBCA77)) ^ (mh * jnp.uint32(0xC2B2AE3D))
-    h ^= h >> 15
-    h = h * jnp.uint32(0x2C1B3C6D)
-    h ^= h >> 12
-    return h
-
-
-def _owned_dedupe_compact(st, ml, mh, live, Nd, n_dev, my_idx):
-    """Keep rows owned by this device, sort-dedupe, compact to [Nd]."""
-    owner = _hash_config(st, ml, mh) % jnp.uint32(n_dev)
+def _owned_dedupe_compact(rows, live, Nd, n_dev, my_idx, rep):
+    """Keep rows owned by this device, sort-dedupe, compact to [Nd].
+    Lane-generic: rows is the representation's lane tuple (the
+    historical triple or the packed word) — ownership hashes, sort
+    keys, and scatters all run per lane."""
+    owner = rep.owner_hash(rows) % jnp.uint32(n_dev)
     live = live & (owner == my_idx)
-    M = st.shape[0]
-    order = jnp.lexsort((mh, ml, st, (~live).astype(jnp.int8)))
-    st_s, ml_s, mh_s, live_s = st[order], ml[order], mh[order], live[order]
-    prev_same = jnp.concatenate([
-        jnp.zeros(1, bool),
-        (st_s[1:] == st_s[:-1]) & (ml_s[1:] == ml_s[:-1])
-        & (mh_s[1:] == mh_s[:-1]),
-    ])
-    uniq = live_s & ~prev_same
+    M = rows[0].shape[0]
+    order = jnp.lexsort((*reversed(rows), (~live).astype(jnp.int8)))
+    rows_s = _rows_take(rows, order)
+    live_s = live[order]
+    uniq = live_s & ~_rows_prev_same(rows_s)
     count = jnp.sum(uniq)
     pos = jnp.where(uniq, jnp.cumsum(uniq) - 1, M + Nd)
-    new_st = jnp.zeros(Nd, jnp.int32).at[pos].set(st_s, mode="drop")
-    new_ml = jnp.zeros(Nd, jnp.uint32).at[pos].set(ml_s, mode="drop")
-    new_mh = jnp.zeros(Nd, jnp.uint32).at[pos].set(mh_s, mode="drop")
+    new_rows = tuple(z.at[pos].set(r, mode="drop")
+                     for z, r in zip(rep.zeros(Nd), rows_s))
     new_live = jnp.arange(Nd) < count
-    return new_st, new_ml, new_mh, new_live, count, count > Nd
+    return new_rows, new_live, count, count > Nd
 
 
-def _route_stage(st, ml, mh, live, dest, n_dest: int, B: int, axis: str):
+def _route_stage(rows, live, dest, n_dest: int, B: int, axis: str):
     """One segmented all-to-all stage (runs INSIDE shard_map): deliver
     each live row to position `dest` along the mesh axis `axis`.
 
@@ -121,40 +115,41 @@ def _route_stage(st, ml, mh, live, dest, n_dest: int, B: int, axis: str):
     static width B, and `lax.all_to_all(tiled)` swaps bucket d to
     device d. Returns the received rows [n_dest*B] plus a local
     overflow flag (some bucket exceeded B — the caller escalates to a
-    capacity retry)."""
-    L = st.shape[0]
+    capacity retry). Lane-generic: under JEPSEN_TPU_CONFIG_PACK the
+    exchange payload is the packed word — 1-2 lanes over the
+    ICI/DCN wire instead of 3, a proportional traffic cut."""
+    L = rows[0].shape[0]
     key = jnp.where(live, dest.astype(jnp.int32), n_dest)
     order = jnp.argsort(key)
-    st_s, ml_s, mh_s = st[order], ml[order], mh[order]
+    rows_s = _rows_take(rows, order)
     key_s = key[order]
     starts = jnp.searchsorted(key_s, jnp.arange(n_dest))
     rank = jnp.arange(L) - starts[jnp.clip(key_s, 0, n_dest - 1)]
     in_bucket = (key_s < n_dest) & (rank < B)
     ovf = jnp.any((key_s < n_dest) & (rank >= B))
     pos = jnp.where(in_bucket, key_s * B + rank, n_dest * B)  # OOB -> drop
-    buf_st = jnp.zeros(n_dest * B, jnp.int32).at[pos].set(st_s, mode="drop")
-    buf_ml = jnp.zeros(n_dest * B, jnp.uint32).at[pos].set(ml_s, mode="drop")
-    buf_mh = jnp.zeros(n_dest * B, jnp.uint32).at[pos].set(mh_s, mode="drop")
+    bufs = tuple(
+        jnp.zeros(n_dest * B, r.dtype).at[pos].set(r, mode="drop")
+        for r in rows_s)
     buf_lv = jnp.zeros(n_dest * B, jnp.uint8).at[pos].set(
         in_bucket.astype(jnp.uint8), mode="drop")
     a2a = lambda a: lax.all_to_all(a, axis, split_axis=0, concat_axis=0,
                                    tiled=True)
-    return (a2a(buf_st), a2a(buf_ml), a2a(buf_mh),
-            a2a(buf_lv).astype(bool), ovf)
+    return (tuple(a2a(b) for b in bufs), a2a(buf_lv).astype(bool), ovf)
 
 
-def _route_to_owners(st, ml, mh, legal, n_dev: int, B: int):
+def _route_to_owners(rows, legal, n_dev: int, B: int, rep):
     """Flat owner routing over the 1-D mesh: one stage, dest =
     hash(row) % n_dev."""
-    owner = _hash_config(st, ml, mh) % jnp.uint32(n_dev)
-    return _route_stage(st, ml, mh, legal, owner, n_dev, B, AXIS)
+    owner = rep.owner_hash(rows) % jnp.uint32(n_dev)
+    return _route_stage(rows, legal, owner, n_dev, B, AXIS)
 
 
 def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
                   my_idx, axes, route_cand, route_front,
                   dedupe: str = "sort", probe_limit: int = 0,
                   sparse_pallas: str = "off",
-                  search_stats: bool = False):
+                  search_stats: bool = False, pack: tuple = ()):
     """The topology-independent event scan (runs INSIDE shard_map),
     from an explicit initial carry — shared by the fresh-start core and
     the resumable chunk runner.
@@ -184,37 +179,43 @@ def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
     the whole claim loop. The expansion and the owner routing stay in
     XLA: the all-to-all collective cannot live inside a kernel. A
     call-site whose (statically known) buffer shape exceeds the VMEM
-    gate downgrades itself to the plain XLA insert."""
+    gate downgrades itself to the plain XLA insert.
+
+    `pack` (static) selects the configuration-row layout
+    (engine._rep): lane-generic throughout, so under
+    JEPSEN_TPU_CONFIG_PACK the per-device tables, the frontier
+    shards, AND the owner-routed all-to-all payloads all carry the
+    packed word — 1-2 u32 lanes over the wire instead of 3."""
     step = STEPS[step_name]
     C = xs["slot_f"].shape[1]
-    bit_lo, bit_hi = _slot_bits(C)
+    rep = _rep(pack, C)
+    L = rep.lanes
     if probe_limit <= 0:
         # host entry points resolve eagerly (the value keys the jit
         # cache); this is the safety net for default-arg callers
         probe_limit = _resolve_probe_limit(0)
     Td = _next_pow2(2 * Nd)
 
-    def insert_append(c_st, c_ml, c_mh, c_live, st, ml, mh, count,
-                      table):
+    def insert_append(c_rows, c_live, f_rows, count, table):
         """One visited-set transaction — fused kernel when enabled and
         the static shapes fit, the plain XLA form otherwise. Under
         `search_stats` an extra trailing element: the probe-length
         histogram (zeros on the fused-kernel path — the probe offsets
         never leave the kernel; the stats block notes which
         implementation ran via the result's closure tag)."""
-        if sparse_pallas != "off":
+        if sparse_pallas in ("on", "interpret"):
             from jepsen_tpu.parallel import sparse_kernels as sk
-            if sk.insert_supported(int(c_st.shape[0]), Nd):
+            if sk.insert_supported(int(c_rows[0].shape[0]), Nd, L):
                 out = sk.hash_insert_call(
-                    c_st, c_ml, c_mh, c_live, st, ml, mh, count, table,
-                    probe_limit, Nd,
+                    c_rows, c_live, f_rows, count, table,
+                    probe_limit, Nd, C, pack,
                     interpret=(sparse_pallas == "interpret"))
                 if search_stats:
                     return out + (jnp.zeros(N_PROBE_BUCKETS,
                                             jnp.int32),)
                 return out
-        return _hash_insert_append(c_st, c_ml, c_mh, c_live, st, ml,
-                                   mh, count, table, probe_limit, Nd,
+        return _hash_insert_append(c_rows, c_live, f_rows, count,
+                                   table, probe_limit, Nd, rep,
                                    stats=search_stats)
 
     step_cc = jax.vmap(
@@ -223,36 +224,31 @@ def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
     )
 
     def closure_cond(c):
-        return c[4] & ~c[5]
+        return c["changed"] & ~c["ovf"]
 
     def make_closure_body(ev):
         def body(c):
-            st, ml, mh, live, _, _, stepped = c[:7]
+            rows, live = c["rows"], c["live"]
             cand_st, cand_ok = step_cc(
-                st, ev["slot_f"], ev["slot_a0"], ev["slot_a1"],
-                ev["slot_wild"])
-            already = ((ml[:, None] & bit_lo[None, :])
-                       | (mh[:, None] & bit_hi[None, :])) != 0
+                rep.state(rows), ev["slot_f"], ev["slot_a0"],
+                ev["slot_a1"], ev["slot_wild"])
+            already = rep.mask_test(rows)
             legal = (live[:, None] & ev["slot_occ"][None, :]
                      & ~already & cand_ok)
-            c_st, c_ml, c_mh, c_live, route_ovf = route_cand(
-                cand_st.reshape(-1),
-                (ml[:, None] | bit_lo[None, :]).reshape(-1),
-                (mh[:, None] | bit_hi[None, :]).reshape(-1),
-                legal.reshape(-1))
-            all_st = jnp.concatenate([st, c_st])
-            all_ml = jnp.concatenate([ml, c_ml])
-            all_mh = jnp.concatenate([mh, c_mh])
+            c_rows, c_live, route_ovf = route_cand(
+                rep.candidates(rows, cand_st), legal.reshape(-1))
+            all_rows = _rows_concat(rows, c_rows)
             all_live = jnp.concatenate([live, c_live])
             old_n = lax.psum(jnp.sum(live), axes)
-            st2, ml2, mh2, live2, cnt, ovf = _owned_dedupe_compact(
-                all_st, all_ml, all_mh, all_live, Nd, n_dev, my_idx)
+            rows2, live2, cnt, ovf = _owned_dedupe_compact(
+                all_rows, all_live, Nd, n_dev, my_idx, rep)
             new_n = lax.psum(cnt, axes)
             g_ovf = lax.psum((ovf | route_ovf).astype(jnp.int32), axes) > 0
-            out = (st2, ml2, mh2, live2, new_n > old_n, g_ovf,
-                   stepped + old_n)
+            out = {"rows": rows2, "live": live2,
+                   "changed": new_n > old_n, "ovf": g_ovf,
+                   "stepped": c["stepped"] + old_n}
             if search_stats:
-                out = out + (c[7] + 1,)   # closure iterations
+                out["iters"] = c["iters"] + 1
             return out
         return body
 
@@ -261,37 +257,31 @@ def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
 
     def make_hash_closure_body(ev):
         def body(c):
-            st, ml, mh = c["st"], c["ml"], c["mh"]
+            rows = c["rows"]
             n_old, count = c["n_old"], c["count"]
             cand_st, cand_ok = step_cc(
-                st, ev["slot_f"], ev["slot_a0"], ev["slot_a1"],
-                ev["slot_wild"])
+                rep.state(rows), ev["slot_f"], ev["slot_a0"],
+                ev["slot_a1"], ev["slot_wild"])
             row = jnp.arange(Nd)
             delta = (row >= n_old) & (row < count)
-            already = ((ml[:, None] & bit_lo[None, :])
-                       | (mh[:, None] & bit_hi[None, :])) != 0
+            already = rep.mask_test(rows)
             legal = (delta[:, None] & ev["slot_occ"][None, :]
                      & ~already & cand_ok)
-            c_st, c_ml, c_mh, c_live, route_ovf = route_cand(
-                cand_st.reshape(-1),
-                (ml[:, None] | bit_lo[None, :]).reshape(-1),
-                (mh[:, None] | bit_hi[None, :]).reshape(-1),
-                legal.reshape(-1))
+            c_rows, c_live, route_ovf = route_cand(
+                rep.candidates(rows, cand_st), legal.reshape(-1))
             # the gather A/B exchange broadcasts EVERY candidate to
             # every device; inserting only owned rows is what keeps
             # each table (and the frontier) a partition, not a replica
-            owner = _hash_config(c_st, c_ml, c_mh) % jnp.uint32(n_dev)
+            owner = rep.owner_hash(c_rows) % jnp.uint32(n_dev)
             c_live = c_live & (owner == my_idx)
-            ins = insert_append(c_st, c_ml, c_mh, c_live, st, ml, mh,
-                                count, c["table"])
-            st2, ml2, mh2, table, count2, n_fresh, ins_ovf = ins[:7]
+            ins = insert_append(c_rows, c_live, rows, count,
+                                c["table"])
+            rows2, table, count2, n_fresh, ins_ovf = ins[:5]
             l_ovf = (ins_ovf | route_ovf).astype(jnp.int32)
             g_new, g_delta, g_ovf = lax.psum(
                 (n_fresh, count - n_old, l_ovf), axes)
             out = {
-                "st": st2,
-                "ml": ml2,
-                "mh": mh2,
+                "rows": rows2,
                 "n_old": count,
                 "count": count2,
                 "table": table,
@@ -304,95 +294,83 @@ def _sharded_scan(xs, carry0, step_name: str, Nd: int, n_dev: int,
                 # the sort-equivalent work: the whole GLOBAL frontier
                 # this iteration (what sort would have re-stepped)
                 out["swork"] = c["swork"] + lax.psum(count, axes)
-                out["phist"] = c["phist"] + ins[7]
+                out["phist"] = c["phist"] + ins[5]
             return out
         return body
 
-    def run_closure(ev, st, ml, mh, live, run, stepped):
-        """-> (st2, ml2, mh2, live2, ovf, stepped2, extras) with
-        extras = (iters, swork, phist_local) under search_stats, else
-        None."""
+    def run_closure(ev, rows, live, run, stepped):
+        """-> (rows2, live2, ovf, stepped2, extras) with extras =
+        (iters, swork, phist_local) under search_stats, else None."""
         if dedupe == "sort":
-            carry0 = (st, ml, mh, live, run, jnp.array(False), stepped)
+            carry0 = {"rows": rows, "live": live, "changed": run,
+                      "ovf": jnp.array(False), "stepped": stepped}
             if search_stats:
-                carry0 = carry0 + (jnp.int32(0),)
+                carry0["iters"] = jnp.int32(0)
             out = lax.while_loop(closure_cond, make_closure_body(ev),
                                  carry0)
-            st2, ml2, mh2, live2, _, ovf, stepped2 = out[:7]
-            extras = ((out[7], stepped2 - stepped,
+            extras = ((out["iters"], out["stepped"] - stepped,
                        jnp.zeros(N_PROBE_BUCKETS, jnp.int32))
                       if search_stats else None)
-            return st2, ml2, mh2, live2, ovf, stepped2, extras
+            return (out["rows"], out["live"], out["ovf"],
+                    out["stepped"], extras)
         # seed the per-event visited set with the local frontier
         # (owned rows by invariant), compacting it in the same pass;
         # the append overflow arm of insert_append is unreachable here
         # (at most Nd seed rows fit an Nd frontier), so its flag is
         # the pure probe-exhaustion signal the sort of carry expects
-        seed = insert_append(
-            st, ml, mh, live, jnp.zeros(Nd, jnp.int32),
-            jnp.zeros(Nd, jnp.uint32), jnp.zeros(Nd, jnp.uint32),
-            jnp.int32(0), _empty_table(Td))
-        st0, ml0, mh0, table, m0, _, p0 = seed[:7]
+        seed = insert_append(rows, live, rep.zeros(Nd), jnp.int32(0),
+                             _empty_table(Td, rep))
+        rows0, table, m0, _, p0 = seed[:5]
         g_p0 = lax.psum(p0.astype(jnp.int32), axes) > 0
         carry0 = {
-            "st": st0, "ml": ml0, "mh": mh0,
+            "rows": rows0,
             "n_old": jnp.int32(0), "count": m0, "table": table,
             "changed": run, "ovf": g_p0, "stepped": stepped}
         if search_stats:
             carry0["iters"] = jnp.int32(0)
             carry0["swork"] = jnp.int32(0)
-            carry0["phist"] = seed[7]
+            carry0["phist"] = seed[5]
         out = lax.while_loop(
             hash_closure_cond, make_hash_closure_body(ev), carry0)
         live2 = jnp.arange(Nd) < out["count"]
         extras = ((out["iters"], out["swork"], out["phist"])
                   if search_stats else None)
-        return (out["st"], out["ml"], out["mh"], live2, out["ovf"],
-                out["stepped"], extras)
+        return out["rows"], live2, out["ovf"], out["stepped"], extras
 
     def scan_step(carry, ev):
-        st, ml, mh, live, ok, fail_r, r_idx, maxf, stepped = carry
+        rows = carry[:L]
+        live, ok, fail_r, r_idx, maxf, stepped = carry[L:]
         run = ok & (ev["ev_slot"] >= 0)
-        st2, ml2, mh2, live2, ovf, stepped2, extras = run_closure(
-            ev, st, ml, mh, live, run, stepped)
+        rows2, live2, ovf, stepped2, extras = run_closure(
+            ev, rows, live, run, stepped)
         # the hash prologue runs unconditionally (lax.scan cannot skip
         # an event): gate its probe flag so a pad/settled event never
         # leaks into the capacity-escalation decision
         ovf = run & ovf
         s = jnp.maximum(ev["ev_slot"], 0).astype(jnp.uint32)
-        one = jnp.uint32(1)
-        blo = jnp.where(s < 32, one << jnp.minimum(s, 31),
-                        jnp.uint32(0)).astype(jnp.uint32)
-        bhi = jnp.where(s >= 32,
-                        one << jnp.minimum(jnp.where(s >= 32, s - 32, 0),
-                                           jnp.uint32(31)),
-                        jnp.uint32(0)).astype(jnp.uint32)
-        has = ((ml2 & blo) | (mh2 & bhi)) != 0
+        bits = rep.event_bits(s)
+        has = rep.has_event_bit(rows2, bits)
         live3 = live2 & has
-        ml3 = jnp.where(live3, ml2 & ~blo, ml2)
-        mh3 = jnp.where(live3, mh2 & ~bhi, mh2)
+        rows3 = rep.clear_event_bit(rows2, bits, live3)
         n_live = lax.psum(jnp.sum(live3), axes)
         failed_here = run & (n_live == 0)
         # clearing the slot bit changed every survivor's hash — re-route
         # each config to its new owner device before the next closure
-        r_st, r_ml, r_mh, r_live, rt_ovf = route_front(st2, ml3, mh3,
-                                                       live3)
-        st2, ml3, mh3, live3, _, r_ovf = _owned_dedupe_compact(
-            r_st, r_ml, r_mh, r_live, Nd, n_dev, my_idx)
+        r_rows, r_live, rt_ovf = route_front(rows3, live3)
+        rows3, live3, _, r_ovf = _owned_dedupe_compact(
+            r_rows, r_live, Nd, n_dev, my_idx, rep)
         ovf = ovf | (run & (lax.psum((r_ovf | rt_ovf).astype(jnp.int32),
                                      axes) > 0))
         new_ok = jnp.where(run, ~failed_here & ~ovf, ok)
         new_fail = jnp.where(failed_here & (fail_r < 0), r_idx, fail_r)
-        st_o = jnp.where(run, st2, st)
-        ml_o = jnp.where(run, ml3, ml)
-        mh_o = jnp.where(run, mh3, mh)
+        rows_o = _rows_where(run, rows3, rows)
         live_o = jnp.where(run, live3, live)
         maxf = jnp.maximum(maxf, jnp.where(run,
                                            lax.psum(jnp.sum(live2), axes),
                                            0))
         stepped_o = jnp.where(run, stepped2, stepped)
-        carry_o = (st_o, ml_o, mh_o, live_o, new_ok, new_fail,
-                   r_idx + 1, maxf, stepped_o)
+        carry_o = rows_o + (live_o, new_ok, new_fail,
+                            r_idx + 1, maxf, stepped_o)
         if not search_stats:
             return carry_o, ovf
         # per-event stats: width/peak/phist are DEVICE-LOCAL (the
@@ -422,27 +400,27 @@ def _sharded_core(xs, state0, step_name: str, Nd: int, n_dev: int,
                   my_idx, axes, route_cand, route_front,
                   dedupe: str = "sort", probe_limit: int = 0,
                   sparse_pallas: str = "off",
-                  search_stats: bool = False):
+                  search_stats: bool = False, pack: tuple = ()):
     """Fresh-start wrapper over _sharded_scan: seed the initial config
     on its hash-owner device, scan the whole history, reduce to the
     (valid, fail, overflow, maxf, stepped) scalars — plus, under
     `search_stats`, the per-event stats dict (width/peak/phist with a
     leading per-device axis of 1, stacked to [n_dev, R] by the
     shard_map out_specs; iters/stepped/swork replicated)."""
+    rep = _rep(pack, xs["slot_f"].shape[1])
     # initial config lives on its hash-owner device
-    st0v = jnp.full(Nd, state0, jnp.int32)
-    owner0 = _hash_config(jnp.int32(state0), jnp.uint32(0),
-                          jnp.uint32(0)) % jnp.uint32(n_dev)
+    rows0 = rep.initial_full(state0, Nd)
+    owner0 = rep.owner_hash(
+        tuple(r[:1] for r in rows0))[0] % jnp.uint32(n_dev)
     live0 = (jnp.arange(Nd) < 1) & (owner0 == my_idx)
-    carry0 = (st0v, jnp.zeros(Nd, jnp.uint32), jnp.zeros(Nd, jnp.uint32),
-              live0, jnp.array(True), jnp.int32(-1), jnp.int32(0),
-              jnp.int32(1), jnp.int32(0))
+    carry0 = rows0 + (live0, jnp.array(True), jnp.int32(-1),
+                      jnp.int32(0), jnp.int32(1), jnp.int32(0))
     out = _sharded_scan(xs, carry0, step_name, Nd, n_dev,
                         my_idx, axes, route_cand, route_front,
                         dedupe, probe_limit, sparse_pallas,
-                        search_stats)
+                        search_stats, pack)
     carry, overflow = out[0], out[1]
-    _, _, _, live, ok, fail_r, _, maxf, stepped = carry
+    live, ok, fail_r, _, maxf, stepped = carry[rep.lanes:]
     valid = ok & (lax.psum(jnp.sum(live), axes) > 0) & ~overflow
     if not search_stats:
         return valid, fail_r, overflow, maxf, stepped
@@ -458,37 +436,39 @@ def _sharded_core(xs, state0, step_name: str, Nd: int, n_dev: int,
     return valid, fail_r, overflow, maxf, stepped, stats
 
 
-def _flat_routes(Nd: int, C: int, n_dev: int):
+def _flat_routes(Nd: int, C: int, n_dev: int, rep):
     """(route_cand, route_front) for the flat 1-D topology.
     Owner-bucket widths: 2x the uniform share (hash-uniform slack),
     floored so tiny frontiers never trip the overflow path."""
     B_cand = max(64, -(-2 * Nd * C // n_dev))
     B_front = max(64, -(-2 * Nd // n_dev))
-    route_cand = lambda st, ml, mh, lv: _route_to_owners(
-        st, ml, mh, lv, n_dev, B_cand)
-    route_front = lambda st, ml, mh, lv: _route_to_owners(
-        st, ml, mh, lv, n_dev, B_front)
+    route_cand = lambda rows, lv: _route_to_owners(
+        rows, lv, n_dev, B_cand, rep)
+    route_front = lambda rows, lv: _route_to_owners(
+        rows, lv, n_dev, B_front, rep)
     return route_cand, route_front
 
 
 def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int,
                   exchange: str = "route", dedupe: str = "sort",
                   probe_limit: int = 0, sparse_pallas: str = "off",
-                  search_stats: bool = False):
+                  search_stats: bool = False, pack: tuple = ()):
     """1-D topology adapter: flat owner routing over AXIS, or the
     all-gather broadcast (A/B measurement path)."""
     C = xs["slot_f"].shape[1]
+    rep = _rep(pack, C)
     my_idx = lax.axis_index(AXIS).astype(jnp.uint32)
     if exchange == "route":
-        route_cand, route_front = _flat_routes(Nd, C, n_dev)
+        route_cand, route_front = _flat_routes(Nd, C, n_dev, rep)
     else:
-        def _bcast(st, ml, mh, lv):
+        def _bcast(rows, lv):
             g = lambda a: lax.all_gather(a, AXIS, tiled=True)
-            return g(st), g(ml), g(mh), g(lv), jnp.array(False)
+            return tuple(g(r) for r in rows), g(lv), jnp.array(False)
         route_cand = route_front = _bcast
     return _sharded_core(xs, state0, step_name, Nd, n_dev, my_idx,
                          (AXIS,), route_cand, route_front, dedupe,
-                         probe_limit, sparse_pallas, search_stats)
+                         probe_limit, sparse_pallas, search_stats,
+                         pack)
 
 
 AX_SLICE, AX_CHIP = "slice", "chip"
@@ -497,7 +477,7 @@ AX_SLICE, AX_CHIP = "slice", "chip"
 def _sharded2d_impl(xs, state0, step_name: str, Nd: int,
                     n_slice: int, n_chip: int, dedupe: str = "sort",
                     probe_limit: int = 0, sparse_pallas: str = "off",
-                    search_stats: bool = False):
+                    search_stats: bool = False, pack: tuple = ()):
     """2-D topology adapter (slice x chip): the multi-slice story.
     Owner routing is HIERARCHICAL — stage 1 delivers candidates to the
     owner's chip COLUMN over the intra-slice axis (ICI); stage 2
@@ -507,6 +487,7 @@ def _sharded2d_impl(xs, state0, step_name: str, Nd: int,
     of n_slice*n_chip small ones — message-count, not byte-count, is
     what DCN latency punishes."""
     C = xs["slot_f"].shape[1]
+    rep = _rep(pack, C)
     D = n_slice * n_chip
     my_idx = (lax.axis_index(AX_SLICE) * n_chip
               + lax.axis_index(AX_CHIP)).astype(jnp.uint32)
@@ -517,30 +498,24 @@ def _sharded2d_impl(xs, state0, step_name: str, Nd: int,
     B1f = max(64, -(-2 * Nd // n_chip))
     B2f = max(64, -(-2 * n_chip * B1f // n_slice))
 
-    def route2(st, ml, mh, live, B1, B2):
-        owner = _hash_config(st, ml, mh) % jnp.uint32(D)
-        st, ml, mh, live, o1 = _route_stage(
-            st, ml, mh, live, owner % jnp.uint32(n_chip), n_chip, B1,
+    def route2(rows, live, B1, B2):
+        owner = rep.owner_hash(rows) % jnp.uint32(D)
+        rows, live, o1 = _route_stage(
+            rows, live, owner % jnp.uint32(n_chip), n_chip, B1,
             AX_CHIP)
-        owner = _hash_config(st, ml, mh) % jnp.uint32(D)
-        st, ml, mh, live, o2 = _route_stage(
-            st, ml, mh, live, owner // jnp.uint32(n_chip), n_slice, B2,
+        owner = rep.owner_hash(rows) % jnp.uint32(D)
+        rows, live, o2 = _route_stage(
+            rows, live, owner // jnp.uint32(n_chip), n_slice, B2,
             AX_SLICE)
-        return st, ml, mh, live, o1 | o2
+        return rows, live, o1 | o2
 
     return _sharded_core(
         xs, state0, step_name, Nd, D, my_idx, (AX_SLICE, AX_CHIP),
-        lambda st, ml, mh, lv: route2(st, ml, mh, lv, B1c, B2c),
-        lambda st, ml, mh, lv: route2(st, ml, mh, lv, B1f, B2f),
-        dedupe, probe_limit, sparse_pallas, search_stats)
+        lambda rows, lv: route2(rows, lv, B1c, B2c),
+        lambda rows, lv: route2(rows, lv, B1f, B2f),
+        dedupe, probe_limit, sparse_pallas, search_stats, pack)
 
 
-# donation decision (recompile-donate-argnums) for the three sharded
-# jits: NOT donated. xs/state0 are replicated inputs reused across the
-# capacity-doubling retry loop in check_encoded_sharded (the SAME
-# device arrays re-dispatch at doubled Nd), and the resumable path
-# re-runs a chunk from the same placed carry after overflow — donation
-# would invalidate the retries.
 def _stats_out_specs(dev_axes):
     """out_specs for the per-event stats dict: width/peak/phist stack
     their leading per-device axis over the mesh; the psum-synchronized
@@ -550,15 +525,22 @@ def _stats_out_specs(dev_axes):
             "swork": P()}
 
 
-@functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
+# donation decision (recompile-donate-argnums) for the two tier jits
+# below, DECIDED: nothing donatable — xs/state0 are replicated inputs
+# reused across the capacity-doubling retry loop in
+# check_encoded_sharded (the SAME device arrays re-dispatch at doubled
+# Nd), and every output is a replicated scalar, so no input could
+# alias an output anyway.
+@functools.partial(jax.jit,
+                   donate_argnums=(),
                    static_argnames=("step_name", "Nd", "n_slice",
                                     "n_chip", "mesh", "dedupe",
                                     "probe_limit", "sparse_pallas",
-                                    "search_stats"))
+                                    "search_stats", "pack"))
 def _check_sharded2d(xs, state0, step_name: str, Nd: int, n_slice: int,
                      n_chip: int, mesh: Mesh, dedupe: str = "sort",
                      probe_limit: int = 0, sparse_pallas: str = "off",
-                     search_stats: bool = False):
+                     search_stats: bool = False, pack: tuple = ()):
     out_specs = (P(), P(), P(), P(), P())
     if search_stats:
         out_specs = out_specs + (
@@ -566,7 +548,8 @@ def _check_sharded2d(xs, state0, step_name: str, Nd: int, n_slice: int,
     fn = _shard_map(
         lambda x, s0: _sharded2d_impl(x, s0, step_name, Nd, n_slice,
                                       n_chip, dedupe, probe_limit,
-                                      sparse_pallas, search_stats),
+                                      sparse_pallas, search_stats,
+                                      pack),
         mesh=mesh,
         in_specs=(P(), P()),
         out_specs=out_specs,
@@ -575,24 +558,25 @@ def _check_sharded2d(xs, state0, step_name: str, Nd: int, n_slice: int,
     return fn(xs, state0)
 
 
-# same donation decision as _check_sharded2d above
-@functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
+# same (decided) donation as _check_sharded2d above
+@functools.partial(jax.jit,
+                   donate_argnums=(),
                    static_argnames=("step_name", "Nd", "n_dev",
                                     "mesh", "exchange", "dedupe",
                                     "probe_limit", "sparse_pallas",
-                                    "search_stats"))
+                                    "search_stats", "pack"))
 def _check_sharded(xs, state0, step_name: str, Nd: int, n_dev: int,
                    mesh: Mesh, exchange: str = "route",
                    dedupe: str = "sort", probe_limit: int = 0,
                    sparse_pallas: str = "off",
-                   search_stats: bool = False):
+                   search_stats: bool = False, pack: tuple = ()):
     out_specs = (P(), P(), P(), P(), P())
     if search_stats:
         out_specs = out_specs + (_stats_out_specs(AXIS),)
     fn = _shard_map(
         lambda x, s0: _sharded_impl(x, s0, step_name, Nd, n_dev, exchange,
                                     dedupe, probe_limit, sparse_pallas,
-                                    search_stats),
+                                    search_stats, pack),
         mesh=mesh,
         in_specs=(P(), P()),       # tables + state replicated
         out_specs=out_specs,
@@ -601,10 +585,11 @@ def _check_sharded(xs, state0, step_name: str, Nd: int, n_dev: int,
     return fn(xs, state0)
 
 
-def _sharded_resume_impl(xs, st, ml, mh, live, ok, fail_r, r_idx, maxf,
-                         stepped, step_name: str, Nd: int, n_dev: int,
-                         dedupe: str = "sort", probe_limit: int = 0,
-                         sparse_pallas: str = "off"):
+def _sharded_resume_impl(xs, carry, step_name: str, Nd: int,
+                         n_dev: int, dedupe: str = "sort",
+                         probe_limit: int = 0,
+                         sparse_pallas: str = "off",
+                         pack: tuple = ()):
     """Resume-from-carry adapter (runs INSIDE shard_map), 1-D topology.
 
     Restored rows arrive laid out however the host scattered them — a
@@ -614,61 +599,76 @@ def _sharded_resume_impl(xs, st, ml, mh, live, ok, fail_r, r_idx, maxf,
     live row lives on its owner device) holds. Returns the final carry
     (frontier sharded, scalars replicated) plus the overflow flag."""
     C = xs["slot_f"].shape[1]
+    rep = _rep(pack, C)
+    L = rep.lanes
     my_idx = lax.axis_index(AXIS).astype(jnp.uint32)
-    route_cand, route_front = _flat_routes(Nd, C, n_dev)
+    route_cand, route_front = _flat_routes(Nd, C, n_dev, rep)
+    rows, rest = carry[:L], carry[L:]
+    live = rest[0]
 
     # the restore route's destinations are maximally SKEWED, not
     # hash-uniform — on the same mesh every one of a device's rows goes
     # back to that one device — so it gets worst-case buckets (B = Nd)
     # rather than route_front's 2x-uniform slack; it runs once per
     # chunk, so the O(n_dev * Nd) receive buffer is fine
-    r_st, r_ml, r_mh, r_live, rt_ovf = _route_to_owners(
-        st, ml, mh, live, n_dev, Nd)
-    st2, ml2, mh2, live2, _, d_ovf = _owned_dedupe_compact(
-        r_st, r_ml, r_mh, r_live, Nd, n_dev, my_idx)
+    r_rows, r_live, rt_ovf = _route_to_owners(rows, live, n_dev, Nd,
+                                              rep)
+    rows2, live2, _, d_ovf = _owned_dedupe_compact(
+        r_rows, r_live, Nd, n_dev, my_idx, rep)
     pre_ovf = lax.psum((rt_ovf | d_ovf).astype(jnp.int32), (AXIS,)) > 0
 
-    carry0 = (st2, ml2, mh2, live2, ok, fail_r, r_idx, maxf, stepped)
+    carry0 = rows2 + (live2,) + rest[1:]
     carry, scan_ovf = _sharded_scan(xs, carry0, step_name, Nd, n_dev,
                                     my_idx, (AXIS,), route_cand,
                                     route_front, dedupe, probe_limit,
-                                    sparse_pallas)
+                                    sparse_pallas, pack=pack)
     return carry, scan_ovf | pre_ovf
 
 
-# same donation decision as _check_sharded2d above
-@functools.partial(jax.jit,  # jepsen-lint: disable=recompile-donate-argnums
+# donation decision, DECIDED: the resumable carry tuple DONATES — the
+# host places fresh device arrays from the (canonical, host-side)
+# FrontierCheckpoint on every chunk dispatch including the
+# overflow-retry, and the output carry aliases it shape-for-shape; at
+# the top capacity tiers the carry is the peak-HBM buffer. xs stays
+# undonated (replicated event tables, nothing to alias).
+@functools.partial(jax.jit,
+                   donate_argnames=("carry",),
                    static_argnames=("step_name", "Nd", "n_dev",
                                     "mesh", "dedupe", "probe_limit",
-                                    "sparse_pallas"))
-def _check_sharded_resume(xs, st, ml, mh, live, ok, fail_r, r_idx, maxf,
-                          stepped, step_name: str, Nd: int, n_dev: int,
-                          mesh: Mesh, dedupe: str = "sort",
+                                    "sparse_pallas", "pack"))
+def _check_sharded_resume(xs, carry, step_name: str, Nd: int,
+                          n_dev: int, mesh: Mesh, dedupe: str = "sort",
                           probe_limit: int = 0,
-                          sparse_pallas: str = "off"):
+                          sparse_pallas: str = "off",
+                          pack: tuple = ()):
+    L = pack_lanes(pack, xs["slot_f"].shape[1])
+    carry_specs = tuple([P(AXIS)] * L) + (P(AXIS),) \
+        + tuple([P()] * 5)
     fn = _shard_map(
-        lambda x, *c: _sharded_resume_impl(x, *c, step_name, Nd, n_dev,
-                                           dedupe, probe_limit,
-                                           sparse_pallas),
+        lambda x, c: _sharded_resume_impl(x, c, step_name, Nd, n_dev,
+                                          dedupe, probe_limit,
+                                          sparse_pallas, pack),
         mesh=mesh,
-        in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS),
-                  P(), P(), P(), P(), P()),
-        out_specs=((P(AXIS), P(AXIS), P(AXIS), P(AXIS),
-                    P(), P(), P(), P(), P()), P()),
+        in_specs=(P(), carry_specs),
+        out_specs=(carry_specs, P()),
         check_vma=False,
     )
-    return fn(xs, st, ml, mh, live, ok, fail_r, r_idx, maxf, stepped)
+    return fn(xs, carry)
 
 
 def _resolve_sparse_pallas(sparse_pallas, Nd: int, C: int, n_chip: int,
                            n_slice: int, exchange: str, platform: str,
-                           dedupe: str):
+                           dedupe: str, pack=()):
     """Sharded arm of engine._resolve_sparse_pallas — same flag, same
     tri-state, but gated on the per-device INSERT shapes: the largest
     candidate buffer a device receives from the exchange (flat route:
     n_dev buckets of the 2x-uniform width; hierarchical: the stage-2
     receive; gather: every candidate on every device) plus its own
-    Nd-row frontier tile. Returns (mode, note) like the engine's."""
+    Nd-row frontier tile. Width-aware like the engine's (packed rows
+    clear the gate at larger Nd), but with no tiled arm — the
+    received candidate buffer is transient exchange output, so a
+    past-gate tier degrades to the XLA insert with a note, as before.
+    Returns (mode, note) like the engine's."""
     from jepsen_tpu.parallel.engine import \
         _resolve_sparse_pallas as engine_resolve
     # flag / tri-state / platform / dedupe-contradiction resolution on
@@ -677,6 +677,7 @@ def _resolve_sparse_pallas(sparse_pallas, Nd: int, C: int, n_chip: int,
     mode, _ = engine_resolve(sparse_pallas, 1, 1, platform, dedupe)
     if mode == "off":
         return mode, None
+    mode = "on" if mode in ("on", "tiled") else "interpret"
     n_dev = n_chip * n_slice
     if exchange == "gather":
         M = n_dev * Nd * C
@@ -686,12 +687,14 @@ def _resolve_sparse_pallas(sparse_pallas, Nd: int, C: int, n_chip: int,
     else:
         M = n_dev * max(64, -(-2 * Nd * C // n_dev))
     from jepsen_tpu.parallel import sparse_kernels as sk
-    if not sk.insert_supported(M, Nd):
+    lanes = pack_lanes(pack, C)
+    if not sk.insert_supported(M, Nd, lanes):
         obs.counter("engine.sparse_pallas_fallbacks").inc()
         note = (f"sparse insert kernel skipped at per-device capacity "
-                f"{Nd} (C={C}, exchange buffer {M} rows): probe state "
-                f"would exceed the kernel's VMEM budget — fell back to "
-                f"the XLA hash insert for this tier")
+                f"{Nd} (C={C}, exchange buffer {M} rows, {lanes} row "
+                f"lanes): probe state would exceed the kernel's VMEM "
+                f"budget — fell back to the XLA hash insert for this "
+                f"tier")
         _log.warning("%s", note)
         return "off", note
     return mode, None
@@ -705,7 +708,8 @@ def check_encoded_sharded_resumable(e: EncodedHistory, mesh: Mesh,
                                     resume=None,
                                     dedupe=None,
                                     probe_limit: int = 0,
-                                    sparse_pallas=None) -> dict:
+                                    sparse_pallas=None,
+                                    config_pack=None) -> dict:
     """check_encoded_sharded with mid-search checkpointing — the
     sharded arm of the checker's checkpoint/resume capability
     (SURVEY.md §5.4; engine.check_encoded_resumable is the single-
@@ -740,6 +744,9 @@ def check_encoded_sharded_resumable(e: EncodedHistory, mesh: Mesh,
     n_dev = devs.size
     dedupe = _resolve_dedupe(dedupe)
     probe_limit = _resolve_probe_limit(probe_limit)
+    pack_req = _resolve_config_pack(config_pack)
+    C_enc = e.slot_f.shape[1]
+    pack = pack_spec_for(e) if pack_req else ()
     platform = devs[0].platform
     digest = history_digest(e)
     if resume is not None:
@@ -778,25 +785,33 @@ def check_encoded_sharded_resumable(e: EncodedHistory, mesh: Mesh,
         # VMEM gate mid-search (degrade-with-note, never an error)
         mode, note = _resolve_sparse_pallas(
             sparse_pallas, Nd, e.slot_f.shape[1], n_dev, 1, "route",
-            platform, dedupe)
+            platform, dedupe, pack)
         lo, hi = cp.event_index, min(R, cp.event_index + checkpoint_every)
 
         def _chunk(cp=cp, Nd=Nd, mode=mode, lo=lo, hi=hi):
             chunk = {k: jax.device_put(np.asarray(v[lo:hi]), rep)
                      for k, v in xs_np.items()}
+            # the checkpoint is canonical-unpacked; rows pack at this
+            # boundary when the engine runs the packed layout. The
+            # jnp.copy makes every buffer device-OWNED before the
+            # resume jit DONATES it — a zero-copy device_put would
+            # hand XLA a window onto memory the live checkpoint still
+            # owns (engine._place_owned documents the hazard).
+            if pack:
+                rows = pack_rows_np(pack, C_enc, cp.st, cp.ml, cp.mh)
+            else:
+                rows = (cp.st, cp.ml, cp.mh)
+            carry_in = jax.tree.map(jnp.copy, tuple(
+                jax.device_put(np.asarray(r), shard) for r in rows)
+                + (jax.device_put(cp.live, shard),
+                   jax.device_put(np.bool_(cp.ok), rep),
+                   jax.device_put(np.int32(cp.fail_r), rep),
+                   jax.device_put(np.int32(cp.event_index), rep),
+                   jax.device_put(np.int32(cp.maxf), rep),
+                   jax.device_put(np.int32(cp.stepped), rep)))
             carry, overflow = _check_sharded_resume(
-                chunk,
-                jax.device_put(cp.st, shard),
-                jax.device_put(cp.ml, shard),
-                jax.device_put(cp.mh, shard),
-                jax.device_put(cp.live, shard),
-                jax.device_put(np.bool_(cp.ok), rep),
-                jax.device_put(np.int32(cp.fail_r), rep),
-                jax.device_put(np.int32(cp.event_index), rep),
-                jax.device_put(np.int32(cp.maxf), rep),
-                jax.device_put(np.int32(cp.stepped), rep),
-                e.step_name, Nd, n_dev, mesh, dedupe, probe_limit,
-                mode)
+                chunk, carry_in, e.step_name, Nd, n_dev, mesh, dedupe,
+                probe_limit, mode, pack)
             # materialize inside the supervised window
             return [np.asarray(x) for x in carry], bool(overflow)
 
@@ -819,8 +834,9 @@ def check_encoded_sharded_resumable(e: EncodedHistory, mesh: Mesh,
                      "dedupe": dedupe, "checkpoint": cp}, mode, note)
             cp = cp.grown(N * 2)    # N extra dead rows
             continue                # re-run the same chunk
+        from jepsen_tpu.parallel.engine import carry_fields_np
         st, ml, mh, live, ok, fail_r, r_idx, maxf, stepped = \
-            [np.asarray(x) for x in carry]
+            carry_fields_np(carry, pack, C_enc)
         cp = FrontierCheckpoint(int(r_idx), N, e.step_name, digest,
                                 st, ml, mh, live, bool(ok),
                                 int(fail_r), int(maxf), cp.steps_n,
@@ -832,6 +848,7 @@ def check_encoded_sharded_resumable(e: EncodedHistory, mesh: Mesh,
            "devices": n_dev, "dedupe": dedupe,
            "configs-stepped": cp.stepped}
     _tag_sparse_closure(out, mode, note)
+    _tag_config_pack(out, pack, pack_req, C_enc)
     if not out["valid?"]:
         from jepsen_tpu.parallel.encode import fail_op_fields
         out.update(fail_op_fields(e, cp.fail_r))
@@ -910,7 +927,8 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
                           dedupe=None,
                           probe_limit: int = 0,
                           sparse_pallas=None,
-                          search_stats=None) -> dict:
+                          search_stats=None,
+                          config_pack=None) -> dict:
     """Check one encoded history with the frontier sharded over `mesh`.
 
     Topology: a mesh whose device array is 2-D (both dims > 1) with
@@ -944,6 +962,8 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
     dedupe = _resolve_dedupe(dedupe)
     probe_limit = _resolve_probe_limit(probe_limit)
     ss = _resolve_search_stats(search_stats)
+    pack_req = _resolve_config_pack(config_pack)
+    pack = pack_spec_for(e) if pack_req else ()
     # A 2-D device array + "route" = the multi-slice topology: axis 0
     # is the slice (DCN) axis, axis 1 the intra-slice chip (ICI) axis,
     # and the exchange goes hierarchical. Anything else flattens onto
@@ -981,7 +1001,7 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
             mode, note = _resolve_sparse_pallas(
                 sparse_pallas, Nd, e.slot_f.shape[1],
                 n_chip if hier else n_dev, n_slice if hier else 1,
-                exchange, platform, dedupe)
+                exchange, platform, dedupe, pack)
             # one span per capacity-tier attempt, per-device capacity
             # attached — the escalation ladder renders as widening
             # steps in the trace
@@ -992,12 +1012,13 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
                         out = _check_sharded2d(xs, state0, e.step_name,
                                                Nd, n_slice, n_chip,
                                                mesh, dedupe,
-                                               probe_limit, mode, ss)
+                                               probe_limit, mode, ss,
+                                               pack)
                     else:
                         out = _check_sharded(xs, state0, e.step_name,
                                              Nd, n_dev, mesh, exchange,
                                              dedupe, probe_limit, mode,
-                                             ss)
+                                             ss, pack)
                     # materialize inside the supervised window: async
                     # failures/hangs surface here, not at a host read
                     return jax.tree.map(np.asarray, out)
@@ -1034,6 +1055,7 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
                                      n_esc)
         out["stats"] = eng_mod.finish_stats_block(block, t0, _pc())
     _tag_sparse_closure(out, mode, note)
+    _tag_config_pack(out, pack, pack_req, e.slot_f.shape[1])
     if hier:
         out["mesh"] = f"{n_slice}x{n_chip} (hierarchical exchange)"
     if not out["valid?"]:
@@ -1044,7 +1066,8 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
 
 def analysis(model, history, mesh: Mesh, capacity: int = 8192,
              max_capacity: int = 1 << 22, exchange: str = "route",
-             dedupe=None, sparse_pallas=None, search_stats=None) -> dict:
+             dedupe=None, sparse_pallas=None, search_stats=None,
+             config_pack=None) -> dict:
     """knossos-style (model, history) -> result with the frontier
     sharded over `mesh`; on failure, counterexample paths come from the
     same windowed host re-search as `engine.analysis` (the seed frontier
@@ -1071,7 +1094,8 @@ def analysis(model, history, mesh: Mesh, capacity: int = 8192,
                                   max_capacity=max_capacity,
                                   exchange=exchange, dedupe=dedupe,
                                   sparse_pallas=sparse_pallas,
-                                  search_stats=search_stats)
+                                  search_stats=search_stats,
+                                  config_pack=config_pack)
     except sup.DISPATCH_FAILURES as err:
         # degradation contract (docs/resilience.md): a dead sharded
         # tier degrades to the host WGL engine, verdict preserved,

@@ -8,9 +8,9 @@ JEPSEN_TPU_DEDUPE=hash the sparse engine's per-event closure
 frontier rows, the open-addressed visited set, N*(C+1) candidate
 rows — and under plain XLA every closure iteration materialises the
 candidate arrays in HBM and runs the probe/claim while_loop of
-engine._hash_insert as a chain of tiny dispatches. Both kernels here
-run those loops inside a single `pallas_call`, so the probe state is
-VMEM-resident for its whole lifetime:
+engine._hash_insert as a chain of tiny dispatches. Three kernels run
+those loops inside `pallas_call`s, so the probe state is VMEM-resident
+for its whole lifetime:
 
   * `frontier_closure_call` — one call per RETURN EVENT: seed insert,
     every delta-expansion iteration, every probe round, and the
@@ -25,16 +25,43 @@ VMEM-resident for its whole lifetime:
     by the sharded engine, whose owner-routed all-to-all must run
     BETWEEN expansion and insert (a collective cannot live inside a
     pallas kernel), so only the insert side fuses there.
+  * `tiled_insert_call` — the coverage kernel for shapes past the
+    whole-event fusion gate: ONE visited-set transaction with the
+    table partitioned into hash-range tiles that stream HBM<->VMEM
+    through the pallas grid pipeline (double-buffered by construction:
+    while tile t probes, tile t+1's DMA is in flight). Candidates
+    stream in chunks against every tile; each candidate belongs to
+    exactly one tile (its hash's low bits) and probes IN-REGISTER
+    within that tile, so no probe run ever crosses a tile boundary.
+    The engine keeps the rest of the closure (expansion, append) in
+    XLA via engine._hash_event_closure's `insert` hook, so shapes past
+    the fused gate no longer degrade wholesale to the XLA hash — they
+    run `closure:"pallas-tiled"`.
 
-VMEM budget math (`supported`/`insert_supported`): the probe loop
-holds ~12 u32-sized live values per candidate row (the row triple, its
-hash, probe offset, pending/fresh flags, slot/occupancy temporaries)
-— 48 bytes per row — plus the 16-byte frontier rows and the 16*T
-(= 32N) table. Gated to 48*(M + N) <= 4 MiB against the ~16 MB VMEM,
+VMEM budget math (`supported`/`insert_supported`), WIDTH-AWARE: a
+configuration row is `lanes` uint32 lanes — 3 for the historical
+(state, mask_lo, mask_hi) triple, 1-2 for the packed word of
+JEPSEN_TPU_CONFIG_PACK (engine.pack_layout). The probe loop holds ~3
+u32-sized live values per row LANE (the lane itself, its table read,
+its claim-scatter temporary) plus ~3 lane-independent values (hash,
+probe offset, pending/fresh flags): `bytes_per_row(lanes) = 12*lanes
++ 12` — 48 B for the unpacked triple (the historical accounting), 24
+B at one packed lane, a ~2-3x gate win on top of the ~3-6x config
+storage cut. Gated to bytes_per_row*(M + N) <= the VMEM budget
+(JEPSEN_TPU_VMEM_BUDGET, default 4 MiB against the ~16 MB VMEM,
 leaving the compiler generous headroom for double-buffering and
-spills; shapes past the gate fall back to the XLA hash closure with a
-note (engine._resolve_sparse_pallas — the bitdense mesh-fallback
+spills); shapes past this gate get the tiled kernel (tiled_plan picks
+tile/chunk sizes that always fit), and only a budget too small to
+tile at all falls back to the XLA hash closure with a note
+(engine._resolve_sparse_pallas — the bitdense mesh-fallback
 precedent).
+
+Tile sizing: tiles are picked from the budget, floored well above the
+probe horizon — PR 9's JEPSEN_TPU_SEARCH_STATS probe-length
+histograms put p99 probe runs under 8 slots at the table's <=50% load
+(the `jepsen report --search` worst-keys evidence), so a >=512-row
+tile keeps per-tile load variance negligible and in-tile probe wrap
+rare; the default plan uses budget/4 per side, thousands of rows.
 
 Flag: JEPSEN_TPU_SPARSE_PALLAS, strict tri-state, default OFF until a
 chip A/B records the win (tools/perf_ab.py's `hash-pallas` strategy
@@ -54,48 +81,152 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from jepsen_tpu import envflags
+
 I32 = jnp.int32
 U32 = jnp.uint32
 
-# Probe-state budget (bytes) the gate holds the kernels to — see the
-# module docstring for the per-row accounting behind the 48.
+# Default probe-state budget (bytes) the gates hold the kernels to —
+# see the module docstring for the per-row accounting. Overridable per
+# TPU generation via JEPSEN_TPU_VMEM_BUDGET (vmem_budget()).
 VMEM_BUDGET = 4 << 20
 
+# Floor for the env override: below ~64 KiB no tile/chunk plan is
+# worth a kernel launch, and a typo'd tiny budget must fail loudly at
+# the read site, not silently degrade every shape to the XLA hash.
+VMEM_BUDGET_MIN = 1 << 16
 
-def insert_supported(M: int, N: int) -> bool:
+
+def vmem_budget() -> int:
+    """The active VMEM probe-state budget: JEPSEN_TPU_VMEM_BUDGET
+    (validated, min VMEM_BUDGET_MIN) or the 4 MiB default — the one
+    knob that re-gates every sparse kernel for a different TPU
+    generation without a code edit."""
+    return envflags.env_int("JEPSEN_TPU_VMEM_BUDGET",
+                            default=VMEM_BUDGET,
+                            min_value=VMEM_BUDGET_MIN,
+                            what="VMEM budget (bytes)")
+
+
+def bytes_per_row(lanes: int = 3) -> int:
+    """Probe-state bytes per candidate row at `lanes` uint32 row
+    lanes: ~3 live u32 per lane plus ~3 lane-independent temporaries
+    (hash, offset, flags). lanes=3 (unpacked triple) reproduces the
+    historical 48 B accounting exactly."""
+    return 12 * lanes + 12
+
+
+def insert_supported(M: int, N: int, lanes: int = 3) -> bool:
     """Can one fused insert of M candidate rows into an N-row frontier
-    (table 2N, probe temporaries ~12 u32 per candidate) stay inside
-    the VMEM budget?"""
-    return 48 * (M + N) <= VMEM_BUDGET
+    (table 2N, probe temporaries per bytes_per_row) stay inside the
+    VMEM budget?"""
+    return bytes_per_row(lanes) * (M + N) <= vmem_budget()
 
 
-def supported(N: int, C: int) -> bool:
+def supported(N: int, C: int, lanes: int = 3) -> bool:
     """Whole-event closure gate: the per-iteration candidate block is
     M = N*C rows."""
-    return insert_supported(N * C, N)
+    return insert_supported(N * C, N, lanes)
 
 
-def frontier_closure_call(step_name: str, ev, st, ml, mh, live, run,
+# ------------------------------------------------------------- tiling
+
+
+def _pow2_floor(n: int) -> int:
+    return 1 << max(0, int(n).bit_length() - 1)
+
+
+def tiled_plan(N: int, C: int, lanes: int = 3, budget: int = 0):
+    """Tile/chunk sizes for the streamed visited-set transaction at
+    frontier capacity N, or None when even tiling cannot fit the
+    budget (pathologically small JEPSEN_TPU_VMEM_BUDGET — the caller
+    then falls back to the XLA hash with a note).
+
+    The table (T = next_pow2(2N) rows) splits into `tiles` hash-range
+    sub-tables of `tile` rows; candidates stream in `chunk`-row
+    blocks. Budget split: ~1/4 to the resident table tile, ~1/4 to
+    the candidate block + its probe scratch, the rest headroom for
+    the grid pipeline's double buffering (the in-flight next tile and
+    chunk) — the same generous-headroom stance as the fused gate.
+    Tiles are floored at 512 rows: PR 9's probe-length histograms put
+    p99 probe runs under 8 slots at <=50% load, so 512+ keeps in-tile
+    wrap and per-tile load variance negligible."""
+    from jepsen_tpu.parallel.engine import _next_pow2
+    b = budget or vmem_budget()
+    T = _next_pow2(2 * N)
+    tile_bytes = 4 * lanes + 4            # lane words + occupancy
+    tile = min(T, _pow2_floor(max(1, (b // 4) // tile_bytes)))
+    chunk = _pow2_floor(max(1, (b // 4) // (bytes_per_row(lanes) + 12)))
+    if tile < 512 or chunk < 512:
+        return None
+    # only the two sizes the kernel consumes: tiled_insert_call
+    # re-derives the tile count from the RUNTIME table shape, so a
+    # plan can never disagree with the table it is applied to
+    return {"tile": tile, "chunk": chunk}
+
+
+def gate_coverage(n_states: int, state_lo: int, C: int, N: int) -> dict:
+    """HOST-ONLY per-shape gate record — what WOULD run at frontier
+    capacity N, per row layout, with no chip (and no tracing) needed:
+    the evidence record tools/perf_ab.py ships so the chip flag-flip
+    campaign inherits the sizing analysis (ISSUE 11). Schema pinned by
+    tests/test_perf_ab.py."""
+    from jepsen_tpu.parallel.engine import pack_lanes, pack_layout
+    lay = pack_layout(n_states, state_lo, C)
+    pack = lay if lay is not None else ()
+    out = {"C": C, "capacity": N, "budget": vmem_budget(),
+           "packable": bool(pack),
+           "state_bits": pack[0] if pack else None,
+           "packed_width_bits": (pack[0] + C) if pack else None,
+           "would_run": {}, "bytes_per_row": {}}
+    for name, lanes in (("unpacked", 3),
+                        ("packed", pack_lanes(pack, C) if pack else None)):
+        if lanes is None:
+            out["would_run"][name] = None
+            out["bytes_per_row"][name] = None
+            continue
+        out["bytes_per_row"][name] = bytes_per_row(lanes)
+        if supported(N, C, lanes):
+            out["would_run"][name] = "pallas"
+        elif tiled_plan(N, C, lanes) is not None:
+            out["would_run"][name] = "pallas-tiled"
+        else:
+            out["would_run"][name] = "xla-hash"
+    return out
+
+
+# ------------------------------------------------------------ kernels
+
+
+def _lane_structs(rep, n: int):
+    return [jax.ShapeDtypeStruct((n,), z.dtype) for z in rep.zeros(1)]
+
+
+def frontier_closure_call(step_name: str, ev, rows, live, run,
                           N: int, C: int, probe_limit: int,
+                          pack: tuple = (),
                           interpret: bool = False,
                           stats: bool = False):
     """Traceable (un-jitted) pallas invocation of one return event's
     whole delta-frontier closure — usable inside the engine's outer
     lax.scan, like pallas_kernels.closure_call. Inputs are the scan
-    step's frontier arrays ([N] st/ml/mh + live mask), the event's
-    slot tables ([C] rows of xs), and the run flag; returns
-    (st2, ml2, mh2, count, ovf, iters, stepped) exactly as
-    engine._hash_event_closure does — because the kernel body IS that
-    function, evaluated on VMEM-resident values. With `stats`
-    (static; JEPSEN_TPU_SEARCH_STATS), two more outputs exactly as
-    the shared closure returns them: the sort-equivalent work scalar
-    and the probe-length histogram — the search-telemetry trajectory
-    is computed INSIDE the kernel, not inferred from wall clocks."""
+    step's frontier row lanes ([N] per lane — the (pack, C) layout's
+    count — plus the live mask), the event's slot tables ([C] rows of
+    xs), and the run flag; returns (rows2, count, ovf, iters, stepped)
+    exactly as engine._hash_event_closure does — because the kernel
+    body IS that function, evaluated on VMEM-resident values. With
+    `stats` (static; JEPSEN_TPU_SEARCH_STATS), two more outputs
+    exactly as the shared closure returns them: the sort-equivalent
+    work scalar and the probe-length histogram — the search-telemetry
+    trajectory is computed INSIDE the kernel, not inferred from wall
+    clocks."""
     from jepsen_tpu.parallel.engine import (N_PROBE_BUCKETS,
                                             _hash_event_closure,
-                                            _next_pow2)
+                                            _next_pow2, _rep)
     from jepsen_tpu.parallel.steps import STEPS
     step = STEPS[step_name]
+    rep = _rep(pack, C)
+    L = rep.lanes
     step_cc = jax.vmap(
         jax.vmap(step, in_axes=(None, 0, 0, 0, 0)),  # over slots
         in_axes=(0, None, None, None, None),         # over configs
@@ -103,33 +234,33 @@ def frontier_closure_call(step_name: str, ev, st, ml, mh, live, run,
     T = _next_pow2(2 * N)
     n_meta = 5 if stats else 4
 
-    def kernel(f_ref, a0_ref, a1_ref, w_ref, occ_ref,
-               st_ref, ml_ref, mh_ref, lv_ref, run_ref,
-               ost_ref, oml_ref, omh_ref, meta_ref, *phist_ref):
+    def kernel(*refs):
+        f_ref, a0_ref, a1_ref, w_ref, occ_ref = refs[:5]
+        row_refs = refs[5:5 + L]
+        lv_ref, run_ref = refs[5 + L], refs[6 + L]
+        orow_refs = refs[7 + L:7 + 2 * L]
+        meta_ref = refs[7 + 2 * L]
         # bool masks travel as int32 (i1 vectors are the shaky corner
         # of Mosaic); reconstructed at the VMEM boundary
         ev_v = {"slot_f": f_ref[:], "slot_a0": a0_ref[:],
                 "slot_a1": a1_ref[:], "slot_wild": w_ref[:] != 0,
                 "slot_occ": occ_ref[:] != 0}
         out = _hash_event_closure(
-            step_cc, ev_v, st_ref[:], ml_ref[:], mh_ref[:],
-            lv_ref[:] != 0, run_ref[0] != 0, N, C, T, probe_limit,
+            rep, step_cc, ev_v, tuple(r[:] for r in row_refs),
+            lv_ref[:] != 0, run_ref[0] != 0, N, T, probe_limit,
             stats=stats)
-        st2, ml2, mh2, count, ovf, iters, stepped = out[:7]
-        ost_ref[:] = st2
-        oml_ref[:] = ml2
-        omh_ref[:] = mh2
+        rows2, count, ovf, iters, stepped = out[:5]
+        for oref, lane in zip(orow_refs, rows2):
+            oref[:] = lane
         meta = [count.astype(I32), ovf.astype(I32),
                 iters.astype(I32), stepped.astype(I32)]
         if stats:
-            meta.append(out[7].astype(I32))   # swork
-            phist_ref[0][:] = out[8].astype(I32)
+            meta.append(out[5].astype(I32))   # swork
+            refs[8 + 2 * L][:] = out[6].astype(I32)
         meta_ref[:] = jnp.stack(meta)
 
-    out_shape = [jax.ShapeDtypeStruct((N,), I32),
-                 jax.ShapeDtypeStruct((N,), U32),
-                 jax.ShapeDtypeStruct((N,), U32),
-                 jax.ShapeDtypeStruct((n_meta,), I32)]
+    out_shape = _lane_structs(rep, N) + [
+        jax.ShapeDtypeStruct((n_meta,), I32)]
     if stats:
         out_shape.append(jax.ShapeDtypeStruct((N_PROBE_BUCKETS,), I32))
     outs = pl.pallas_call(
@@ -138,66 +269,174 @@ def frontier_closure_call(step_name: str, ev, st, ml, mh, live, run,
         interpret=interpret,
     )(ev["slot_f"], ev["slot_a0"], ev["slot_a1"],
       ev["slot_wild"].astype(I32), ev["slot_occ"].astype(I32),
-      st, ml, mh, live.astype(I32),
+      *rows, live.astype(I32),
       jnp.reshape(run, (1,)).astype(I32))
-    st2, ml2, mh2, meta = outs[:4]
-    base = (st2, ml2, mh2, meta[0], meta[1] != 0, meta[2], meta[3])
+    rows2 = tuple(outs[:L])
+    meta = outs[L]
+    base = (rows2, meta[0], meta[1] != 0, meta[2], meta[3])
     if stats:
-        return base + (meta[4], outs[4])
+        return base + (meta[4], outs[L + 1])
     return base
 
 
-def hash_insert_call(c_st, c_ml, c_mh, c_live, st, ml, mh, count,
-                     table, probe_limit: int, N: int,
+def hash_insert_call(c_rows, c_live, f_rows, count, table,
+                     probe_limit: int, N: int, C: int,
+                     pack: tuple = (),
                      interpret: bool = False):
     """Traceable pallas invocation of one fused visited-set
     transaction: engine._hash_insert_append (bounded probe +
     scatter-min claim + loser re-check + fresh-row append) with the
     candidate rows, the frontier tile, and the table VMEM-resident for
     the whole claim loop. Used per closure iteration by the sharded
-    engine's per-device owned tables. `table` is the
-    (t_st, t_ml, t_mh, t_occ) tuple; occupancy crosses the kernel
-    boundary as int32 and comes back as bool, so the caller's
-    while-carry dtype never changes. Returns
-    (st2, ml2, mh2, table2, count2, n_fresh, ovf)."""
-    from jepsen_tpu.parallel.engine import _hash_insert_append
-    t_st, t_ml, t_mh, t_occ = table
-    T = t_st.shape[0]
+    engine's per-device owned tables. `table` is the (rows, occ)
+    pair; occupancy crosses the kernel boundary as int32 and comes
+    back as bool, so the caller's while-carry dtype never changes.
+    Returns (rows2, table2, count2, n_fresh, ovf) — the
+    _hash_insert_append order."""
+    from jepsen_tpu.parallel.engine import _hash_insert_append, _rep
+    rep = _rep(pack, C)
+    L = rep.lanes
+    t_rows, t_occ = table
+    T = t_rows[0].shape[0]
 
-    def kernel(cst_ref, cml_ref, cmh_ref, clv_ref,
-               st_ref, ml_ref, mh_ref, cnt_ref,
-               tst_ref, tml_ref, tmh_ref, tocc_ref,
-               ost_ref, oml_ref, omh_ref,
-               otst_ref, otml_ref, otmh_ref, otocc_ref, meta_ref):
-        st2, ml2, mh2, tbl2, count2, n_fresh, ovf = _hash_insert_append(
-            cst_ref[:], cml_ref[:], cmh_ref[:], clv_ref[:] != 0,
-            st_ref[:], ml_ref[:], mh_ref[:], cnt_ref[0],
-            (tst_ref[:], tml_ref[:], tmh_ref[:], tocc_ref[:] != 0),
-            probe_limit, N)
-        ost_ref[:] = st2
-        oml_ref[:] = ml2
-        omh_ref[:] = mh2
-        otst_ref[:] = tbl2[0]
-        otml_ref[:] = tbl2[1]
-        otmh_ref[:] = tbl2[2]
-        otocc_ref[:] = tbl2[3].astype(I32)
+    def kernel(*refs):
+        c_refs = refs[:L]
+        clv_ref = refs[L]
+        f_refs = refs[L + 1:2 * L + 1]
+        cnt_ref = refs[2 * L + 1]
+        tr_refs = refs[2 * L + 2:3 * L + 2]
+        tocc_ref = refs[3 * L + 2]
+        of_refs = refs[3 * L + 3:4 * L + 3]
+        otr_refs = refs[4 * L + 3:5 * L + 3]
+        otocc_ref = refs[5 * L + 3]
+        meta_ref = refs[5 * L + 4]
+        rows2, tbl2, count2, n_fresh, ovf = _hash_insert_append(
+            tuple(r[:] for r in c_refs), clv_ref[:] != 0,
+            tuple(r[:] for r in f_refs), cnt_ref[0],
+            (tuple(r[:] for r in tr_refs), tocc_ref[:] != 0),
+            probe_limit, N, rep)
+        for oref, lane in zip(of_refs, rows2):
+            oref[:] = lane
+        for oref, lane in zip(otr_refs, tbl2[0]):
+            oref[:] = lane
+        otocc_ref[:] = tbl2[1].astype(I32)
         meta_ref[:] = jnp.stack([count2.astype(I32),
                                  n_fresh.astype(I32), ovf.astype(I32)])
 
+    out_shape = tuple(
+        _lane_structs(rep, N)
+        + _lane_structs(rep, T)
+        + [jax.ShapeDtypeStruct((T,), I32),
+           jax.ShapeDtypeStruct((3,), I32)])
     outs = pl.pallas_call(
         kernel,
-        out_shape=(jax.ShapeDtypeStruct((N,), I32),
-                   jax.ShapeDtypeStruct((N,), U32),
-                   jax.ShapeDtypeStruct((N,), U32),
-                   jax.ShapeDtypeStruct((T,), I32),
-                   jax.ShapeDtypeStruct((T,), U32),
-                   jax.ShapeDtypeStruct((T,), U32),
-                   jax.ShapeDtypeStruct((T,), I32),
-                   jax.ShapeDtypeStruct((3,), I32)),
+        out_shape=out_shape,
         interpret=interpret,
-    )(c_st, c_ml, c_mh, c_live.astype(I32), st, ml, mh,
+    )(*c_rows, c_live.astype(I32), *f_rows,
       jnp.reshape(count, (1,)).astype(I32),
-      t_st, t_ml, t_mh, t_occ.astype(I32))
-    st2, ml2, mh2, tst2, tml2, tmh2, tocc2, meta = outs
-    return (st2, ml2, mh2, (tst2, tml2, tmh2, tocc2 != 0),
-            meta[0], meta[1], meta[2] != 0)
+      *t_rows, t_occ.astype(I32))
+    rows2 = tuple(outs[:L])
+    tbl_rows2 = tuple(outs[L:2 * L])
+    tocc2, meta = outs[2 * L], outs[2 * L + 1]
+    return (rows2, (tbl_rows2, tocc2 != 0), meta[0], meta[1],
+            meta[2] != 0)
+
+
+def tiled_insert_call(c_rows, c_live, table, probe_limit: int,
+                      plan: dict, pack: tuple, C: int,
+                      interpret: bool = False):
+    """One visited-set transaction with the table streamed HBM<->VMEM
+    in hash-range tiles (module docstring). The grid is (tiles,
+    chunks), tile-major: a table tile stays VMEM-resident while every
+    candidate chunk streams past it (the pallas pipeline prefetches
+    the next chunk — and, at tile boundaries, the next tile — while
+    the current one probes: the double buffering is structural, not
+    hand-rolled). A candidate's home tile is its hash's low bits; its
+    within-tile probe starts at the hash's next bits and wraps INSIDE
+    the tile, so membership stays exact (each config probes exactly
+    one sub-table) and no probe run crosses a tile boundary.
+
+    Returns (table2, fresh[M] bool, off[M] i32, probe_ovf scalar
+    bool) — the probe half of engine._hash_insert_append; the caller
+    (engine's tiled `insert` hook) runs the append in XLA."""
+    from jepsen_tpu.parallel.engine import _hash_insert, _rep
+    rep = _rep(pack, C)
+    L = rep.lanes
+    t_rows, t_occ = table
+    T = t_rows[0].shape[0]
+    n_tt = max(1, T // plan["tile"])
+    TS = T // n_tt
+    tt_bits = max(0, n_tt.bit_length() - 1)
+    M = c_rows[0].shape[0]
+    CH = min(plan["chunk"], 1 << max(0, (M - 1).bit_length()))
+    M_pad = -(-M // CH) * CH
+    n_cc = M_pad // CH
+
+    h0 = rep.table_hash(c_rows)
+    tile_of = (h0 & jnp.uint32(n_tt - 1)).astype(I32)
+    start = ((h0 >> jnp.uint32(tt_bits)) & jnp.uint32(TS - 1))
+
+    def padM(a, fill=0):
+        return jnp.pad(a, (0, M_pad - M), constant_values=fill)
+
+    c_rows_p = tuple(padM(r) for r in c_rows)
+    c_live_p = padM(c_live.astype(I32))
+    tile_p = padM(tile_of, -1)          # pads belong to no tile
+    start_p = padM(start)
+
+    def kernel(*refs):
+        c_refs = refs[:L]
+        lv_ref, tile_ref, st_ref = refs[L], refs[L + 1], refs[L + 2]
+        tr_refs = refs[L + 3:2 * L + 3]
+        tocc_ref = refs[2 * L + 3]
+        otr_refs = refs[2 * L + 4:3 * L + 4]
+        otocc_ref = refs[3 * L + 4]
+        fresh_ref, off_ref, pend_ref = refs[3 * L + 5:3 * L + 8]
+        t = pl.program_id(0)
+        c = pl.program_id(1)
+
+        # first chunk against this tile: bring the HBM tile into the
+        # output ref, which stays resident across the chunk loop
+        @pl.when(c == 0)
+        def _init():
+            for oref, iref in zip(otr_refs, tr_refs):
+                oref[:] = iref[:]
+            otocc_ref[:] = tocc_ref[:]
+
+        mine = (lv_ref[:] != 0) & (tile_ref[:] == t)
+        tile_rows = tuple(r[:] for r in otr_refs)
+        tbl, fresh, p_ovf, off = _hash_insert(
+            tuple(r[:] for r in c_refs), mine,
+            (tile_rows, otocc_ref[:] != 0), probe_limit, rep,
+            h0=st_ref[:])
+        for oref, lane in zip(otr_refs, tbl[0]):
+            oref[:] = lane
+        otocc_ref[:] = tbl[1].astype(I32)
+        fresh_ref[0, :] = fresh.astype(I32)
+        off_ref[0, :] = jnp.where(mine, off, 0)
+        pend_ref[0, :] = jnp.where(
+            mine & ~fresh & (off >= probe_limit), 1, 0).astype(I32)
+
+    lane_dt = [z.dtype for z in rep.zeros(1)]
+    grid = (n_tt, n_cc)
+    cand_spec = pl.BlockSpec((CH,), lambda t, c: (c,))
+    tile_spec = pl.BlockSpec((TS,), lambda t, c: (t,))
+    out_chunk_spec = pl.BlockSpec((1, CH), lambda t, c: (t, c))
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[cand_spec] * (L + 3) + [tile_spec] * (L + 1),
+        out_specs=[tile_spec] * (L + 1) + [out_chunk_spec] * 3,
+        out_shape=tuple(
+            [jax.ShapeDtypeStruct((T,), dt) for dt in lane_dt]
+            + [jax.ShapeDtypeStruct((T,), I32)]
+            + [jax.ShapeDtypeStruct((n_tt, M_pad), I32)] * 3),
+        interpret=interpret,
+    )(*c_rows_p, c_live_p, tile_p, start_p, *t_rows,
+      t_occ.astype(I32))
+    tbl_rows2 = tuple(outs[:L])
+    tocc2 = outs[L]
+    fresh = jnp.any(outs[L + 1] != 0, axis=0)[:M]
+    off = jnp.max(outs[L + 2], axis=0)[:M]
+    pend = jnp.any(outs[L + 3] != 0)
+    return (tbl_rows2, tocc2 != 0), fresh, off, pend

@@ -1,0 +1,380 @@
+"""Parity + property suite for the packed configuration word
+(JEPSEN_TPU_CONFIG_PACK, ISSUE 11 "VMEM economics").
+
+A configuration historically travels the engines as the (state i32,
+mask_lo u32, mask_hi u32) triple; packed, it is
+``(state - state_lo) | mask << state_bits`` carried as 1-2 uint32
+lanes. Representation must NEVER change results: verdict, failing
+op/event, max-frontier, and configs-stepped are pinned identical
+across layouts for the packable families, sort and hash dedupe,
+serial / batch / sharded / resumable / streamed — clean and
+corrupted. Width edges (31/32/33/63/64 bits, the lane boundaries) are
+covered by the host round-trip property tests; families past 64 bits
+take the overflow-to-unpacked path, tagged, never wrong."""
+
+import os
+import unittest.mock as mock
+from dataclasses import dataclass
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.histories import (corrupt_history, rand_fifo_history,
+                                  rand_gset_history, rand_queue_history,
+                                  rand_register_history)
+from jepsen_tpu.history import History, invoke_op, ok_op
+from jepsen_tpu.models import (CASRegister, FIFOQueue, GSet, Mutex,
+                               UnorderedQueue)
+from jepsen_tpu.parallel import encode as enc_mod, engine
+
+# the five packable families — same generators (and therefore the same
+# compiled reference shapes) as tests/test_dedupe.py /
+# tests/test_sparse_pallas.py, so only the packed variants compile
+# fresh here
+FAMILIES = [
+    ("cas-register", CASRegister,
+     lambda: rand_register_history(n_ops=40, n_processes=5, n_values=3,
+                                   crash_p=0.06, fail_p=0.08, seed=31)),
+    ("gset", GSet,
+     lambda: rand_gset_history(n_ops=36, n_processes=4, n_elements=9,
+                               crash_p=0.06, seed=33)),
+    ("uqueue", UnorderedQueue,
+     lambda: rand_queue_history(n_ops=26, n_processes=4, n_values=3,
+                                crash_p=0.06, seed=34)),
+    ("fifo", FIFOQueue,
+     lambda: rand_fifo_history(n_ops=24, n_processes=4, n_values=3,
+                               crash_p=0.05, seed=35)),
+]
+
+PIN = ("valid?", "op", "fail-event", "max-frontier", "configs-stepped")
+
+
+def _pin(r):
+    return {k: r.get(k) for k in PIN}
+
+
+# ------------------------------------------------------ layout + math
+
+
+def test_pack_layout_boundary_widths():
+    """Lane-boundary widths: <=32 bits is one lane, 33..64 two lanes,
+    65+ (or a state field past one lane) unpackable."""
+    # (n_states, C) -> expected (state_bits, lanes) or None
+    cases = [
+        ((2, 30), (1, 1)),       # 31-bit word
+        ((2, 31), (1, 1)),       # 32-bit word: still one lane
+        ((2, 32), (1, 2)),       # 33 bits: lane boundary crossed
+        ((1 << 32, 31), (32, 2)),   # 63 bits
+        ((1 << 32, 32), (32, 2)),   # 64 bits exactly: still packs
+        ((1 << 32, 33), None),      # 65 bits: overflow-to-unpacked
+        ((1 << 33, 16), None),      # state field past one lane
+        ((2, 64), None),            # mask alone past 64 with state
+    ]
+    for (S, C), want in cases:
+        lay = engine.pack_layout(S, -1, C)
+        if want is None:
+            assert lay is None, (S, C, lay)
+        else:
+            s_bits, lanes = want
+            assert lay == (s_bits, -1), (S, C, lay)
+            assert engine.pack_lanes(lay, C) == lanes, (S, C)
+    # unknown state space never packs
+    assert engine.pack_layout(0, -1, 8) is None
+    assert engine.pack_lanes((), 8) == 3
+
+
+def test_pack_roundtrip_property():
+    """Randomized round-trip over (state, mask) WITHIN per-event
+    bounds, across the lane-boundary widths: pack_rows_np ->
+    unpack_rows_np is the identity."""
+    rng = np.random.default_rng(0)
+    # (state_bits, C) spanning 31/32/33/63/64-bit words and both
+    # mask-lane splits
+    for s_bits, C in [(1, 30), (1, 31), (1, 32), (5, 27), (5, 28),
+                      (3, 29), (31, 1), (32, 31), (32, 32), (30, 33),
+                      (16, 47), (8, 56), (28, 36)]:
+        for state_lo in (-1, 0, 7):
+            pack = (s_bits, state_lo)
+            n = 257
+            st = (rng.integers(0, 1 << s_bits, n, dtype=np.int64)
+                  + state_lo).astype(np.int32)
+            mask = rng.integers(0, 1 << C, n,
+                                dtype=np.uint64 if C >= 63 else np.int64
+                                ).astype(np.uint64) \
+                & np.uint64((1 << C) - 1)
+            ml = (mask & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            mh = (mask >> np.uint64(32)).astype(np.uint32)
+            rows = engine.pack_rows_np(pack, C, st, ml, mh)
+            assert len(rows) == engine.pack_lanes(pack, C), (s_bits, C)
+            st2, ml2, mh2 = engine.unpack_rows_np(pack, C, rows)
+            np.testing.assert_array_equal(st, st2, err_msg=f"{s_bits},{C}")
+            np.testing.assert_array_equal(ml, ml2)
+            np.testing.assert_array_equal(mh, mh2)
+
+
+def test_packed_rep_traced_semantics():
+    """The device-side rep agrees with the host pack: states unpack
+    exactly, mask-bit tests and the event-bit clear match the
+    canonical triple's semantics — under jit, on both 1- and 2-lane
+    layouts."""
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.default_rng(1)
+    for s_bits, C in [(5, 12), (5, 40)]:
+        pack = (s_bits, -1)
+        rep = engine._rep(pack, C)
+        n = 64
+        st = (rng.integers(0, 1 << s_bits, n) - 1).astype(np.int32)
+        mask = rng.integers(0, 1 << C, n, dtype=np.int64).astype(
+            np.uint64)
+        ml = (mask & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        mh = (mask >> np.uint64(32)).astype(np.uint32)
+        rows = tuple(jnp.asarray(r)
+                     for r in engine.pack_rows_np(pack, C, st, ml, mh))
+
+        @jax.jit
+        def probe(rows, slot):
+            bits = rep.event_bits(slot.astype(jnp.uint32))
+            has = rep.has_event_bit(rows, bits)
+            cleared = rep.clear_event_bit(rows, bits, has)
+            return rep.state(rows), rep.mask_test(rows), has, cleared
+
+        slot = np.int32(C - 1)
+        st_d, test_d, has_d, cleared = probe(rows, slot)
+        np.testing.assert_array_equal(np.asarray(st_d), st)
+        want_test = np.stack(
+            [(mask >> np.uint64(j)) & np.uint64(1) != 0
+             for j in range(C)], axis=1)
+        np.testing.assert_array_equal(np.asarray(test_d), want_test)
+        np.testing.assert_array_equal(
+            np.asarray(has_d), (mask >> np.uint64(int(slot)))
+            & np.uint64(1) != 0)
+        st3, ml3, mh3 = engine.unpack_rows_np(
+            pack, C, [np.asarray(x) for x in cleared])
+        want_mask = np.where(np.asarray(has_d),
+                             mask & ~(np.uint64(1) << np.uint64(int(slot))),
+                             mask)
+        np.testing.assert_array_equal(
+            ml3.astype(np.uint64) | (mh3.astype(np.uint64) << np.uint64(32)),
+            want_mask)
+        np.testing.assert_array_equal(st3, st)
+
+
+@dataclass
+class _FakeEnc:
+    n_states: int
+    state_lo: int
+    slot_f: np.ndarray
+
+
+def test_pack_spec_for_unions_batch_domains():
+    """A batch shares ONE layout: the state field covers the union of
+    every member's domain; one unpackable member makes the whole
+    program unpacked."""
+    f = np.zeros((4, 10), np.int32)
+    a = _FakeEnc(n_states=16, state_lo=-1, slot_f=f)
+    b = _FakeEnc(n_states=100, state_lo=50, slot_f=f)
+    pack = engine.pack_spec_for([a, b], 10)
+    assert pack
+    s_bits, lo = pack
+    assert lo == -1 and (1 << s_bits) >= 151  # covers [-1, 150)
+    wide = _FakeEnc(n_states=1 << 31, state_lo=0,
+                    slot_f=np.zeros((4, 40), np.int32))
+    assert engine.pack_spec_for([a, wide], 40) == ()
+    assert engine.pack_spec_for([], 10) == ()
+
+
+# --------------------------------------------------------- env flag
+
+
+def test_config_pack_env_flag_and_tagging():
+    from jepsen_tpu.envflags import EnvFlagError
+    h = rand_register_history(n_ops=24, n_processes=3, crash_p=0.0,
+                              seed=5)
+    e = enc_mod.encode(CASRegister(), h)
+    # default off: no tag, byte-identical schema
+    r = engine.check_encoded(e, capacity=64, dedupe="hash")
+    assert "config-pack" not in r
+    with mock.patch.dict(os.environ, {"JEPSEN_TPU_CONFIG_PACK": "1"}):
+        rp = engine.check_encoded(e, capacity=64, dedupe="hash")
+    assert rp["config-pack"].startswith("packed:")
+    assert _pin(rp) == _pin(r)
+    with mock.patch.dict(os.environ,
+                         {"JEPSEN_TPU_CONFIG_PACK": "yes"}), \
+            pytest.raises(EnvFlagError, match="CONFIG_PACK"):
+        engine.check_encoded(e, capacity=64, dedupe="hash")
+
+
+def test_overflow_to_unpacked_path():
+    """A family whose word cannot pack (state_bits + C > 64) runs the
+    historical triple under config_pack=True — tagged "unpacked",
+    results identical, never an error."""
+    h = rand_register_history(n_ops=24, n_processes=3, crash_p=0.0,
+                              seed=5)
+    e = enc_mod.encode(CASRegister(), h)
+    ref = engine.check_encoded(e, capacity=64, dedupe="hash")
+    with mock.patch.object(engine, "pack_layout",
+                           lambda *a, **k: None):
+        r = engine.check_encoded(e, capacity=64, dedupe="hash",
+                                 config_pack=True)
+    assert r["config-pack"] == "unpacked"
+    assert _pin(r) == _pin(ref)
+
+
+# ------------------------------------------------------ parity matrix
+
+
+@pytest.mark.parametrize("name,Model,gen", FAMILIES,
+                         ids=[c[0] for c in FAMILIES])
+def test_packed_parity_clean_and_corrupted(name, Model, gen):
+    """Serial engine, hash dedupe: packed bit-identical to the
+    unpacked XLA hash on every packable family, clean + corrupted."""
+    h = gen()
+    for variant in (h, corrupt_history(h, seed=7, n_corruptions=2)):
+        try:
+            e = enc_mod.encode(Model(), variant)
+        except enc_mod.EncodeError:
+            continue
+        ref = engine.check_encoded(e, capacity=128, dedupe="hash")
+        r = engine.check_encoded(e, capacity=128, dedupe="hash",
+                                 config_pack=True)
+        assert _pin(r) == _pin(ref), (name, r, ref)
+        assert r["config-pack"].startswith("packed:")
+
+
+def test_packed_parity_mutex_and_sort():
+    """The fifth family (mutex, invalid) plus the sort-dedupe arm:
+    packing is representation-only under BOTH dedupe strategies."""
+    h = History.wrap([
+        invoke_op(0, "acquire", None), ok_op(0, "acquire", None),
+        invoke_op(1, "acquire", None), ok_op(1, "acquire", None),
+    ]).index()
+    e = enc_mod.encode(Mutex(), h)
+    for dedupe in ("sort", "hash"):
+        ref = engine.check_encoded(e, capacity=64, max_capacity=256,
+                                   dedupe=dedupe)
+        r = engine.check_encoded(e, capacity=64, max_capacity=256,
+                                 dedupe=dedupe, config_pack=True)
+        assert ref["valid?"] is False
+        assert _pin(r) == _pin(ref), (dedupe, r, ref)
+    reg = FAMILIES[0][2]()
+    er = enc_mod.encode(CASRegister(), reg)
+    ref = engine.check_encoded(er, capacity=128, dedupe="sort")
+    r = engine.check_encoded(er, capacity=128, dedupe="sort",
+                             config_pack=True)
+    assert _pin(r) == _pin(ref)
+
+
+def test_packed_parity_batch_resumable_streamed():
+    """Batch (common union layout), resumable (checkpoint boundary
+    pack/unpack), and streamed (HistorySession deltas) all pin the
+    same representation-independence."""
+    from jepsen_tpu.parallel.extend import HistorySession
+    fifo = rand_fifo_history(n_ops=36, n_processes=6, n_values=3,
+                             crash_p=0.15, seed=5)
+    pre = [enc_mod.encode(FIFOQueue(), fifo)]
+    ref = engine._check_batch_sparse(FIFOQueue(), pre, 128, 2048,
+                                     dedupe="hash")[0]
+    r = engine._check_batch_sparse(FIFOQueue(), pre, 128, 2048,
+                                   dedupe="hash", config_pack=True)[0]
+    assert _pin(r) == _pin(ref), (r, ref)
+    assert r["config-pack"].startswith("packed:")
+
+    h = rand_register_history(n_ops=120, n_processes=6, n_values=4,
+                              crash_p=0.01, fail_p=0.05, busy=0.7,
+                              seed=10)
+    e = enc_mod.encode(CASRegister(), h)
+    ref = engine.check_encoded(e, capacity=256, dedupe="hash")
+    res = engine.check_encoded_resumable(e, capacity=256,
+                                         checkpoint_every=16,
+                                         dedupe="hash",
+                                         config_pack=True)
+    assert _pin(res) == _pin(ref)
+
+    # cross-representation resume: an UNPACKED run's mid-search
+    # checkpoint resumes a PACKED run exactly (checkpoints are
+    # canonical; the engine packs at the carry boundary)
+    cps = []
+    engine.check_encoded_resumable(e, capacity=256,
+                                   checkpoint_every=16,
+                                   checkpoint_cb=cps.append,
+                                   dedupe="hash")
+    mid = cps[0]
+    res2 = engine.check_encoded_resumable(e, capacity=256,
+                                          checkpoint_every=16,
+                                          resume=mid, dedupe="hash",
+                                          config_pack=True)
+    assert _pin(res2) == _pin(ref)
+
+    ops = list(h)
+    s = HistorySession(CASRegister(), capacity=256, dedupe="hash",
+                       config_pack=True)
+    n = len(ops) // 3
+    for i in range(3):
+        s.extend(ops[i * n:(i + 1) * n if i < 2 else len(ops)])
+        r = s.check()
+    assert _pin(r) == _pin(ref)
+    assert r["config-pack"].startswith("packed:")
+
+
+def test_packed_parity_sharded():
+    """1-D sharded engine: packed owner routing / all-to-all payloads
+    / per-device tables land the identical verdict and counters."""
+    import jax
+    from jax.sharding import Mesh
+
+    from jepsen_tpu.parallel import sharded
+
+    h = rand_register_history(n_ops=60, n_processes=6, n_values=4,
+                              crash_p=0.02, fail_p=0.05, seed=10)
+    e = enc_mod.encode(CASRegister(), h)
+    mesh = Mesh(np.array(jax.devices()), ("frontier",))
+    ref = sharded.check_encoded_sharded(e, mesh, capacity=512,
+                                        dedupe="hash")
+    r = sharded.check_encoded_sharded(e, mesh, capacity=512,
+                                      dedupe="hash", config_pack=True)
+    assert _pin(r) == _pin(ref), (r, ref)
+    assert r["config-pack"].startswith("packed:")
+
+
+def test_packed_widens_fused_kernel_gate():
+    """The width-aware gate admits packed shapes the unpacked layout
+    tiles: at a capacity where unpacked runs pallas-tiled, the packed
+    1-lane row runs the WHOLE-EVENT fused kernel — with identical
+    results either way."""
+    from jepsen_tpu.parallel import sparse_kernels as sk
+    h = rand_register_history(n_ops=40, n_processes=5, n_values=3,
+                              crash_p=0.06, fail_p=0.08, seed=31)
+    e = enc_mod.encode(CASRegister(), h)
+    C = e.slot_f.shape[1]
+    pack = engine.pack_spec_for(e)
+    big = 16384
+    assert not sk.supported(big, C)                       # 3 lanes
+    assert sk.supported(big, C, engine.pack_lanes(pack, C))
+    ref = engine.check_encoded(e, capacity=big, dedupe="hash")
+    r = engine.check_encoded(e, capacity=big, dedupe="hash",
+                             sparse_pallas=True, config_pack=True)
+    assert r["closure"] == "pallas"          # fused, not tiled
+    assert _pin(r) == _pin(ref)
+
+
+def test_tiled_packed_probe_escalation():
+    """probe_limit=1 through the TILED closure (packed): probe
+    exhaustion rides the capacity-escalation retry to the correct
+    verdict — never a wrong verdict or a dropped config."""
+    h = rand_register_history(n_ops=50, n_processes=5, n_values=4,
+                              crash_p=0.05, fail_p=0.05, seed=11)
+    e = enc_mod.encode(CASRegister(), h)
+    ref = engine.check_encoded(e, capacity=64, dedupe="sort")
+    with mock.patch.dict(os.environ,
+                         {"JEPSEN_TPU_VMEM_BUDGET": str(1 << 17)}):
+        # a small budget forces the tiled closure at modest capacity
+        # (fused needs ~24 B * N*(C+1) — past 128 KiB at N=1024 —
+        # while the tiled planner still fits 512-row tiles/chunks)
+        r = engine.check_encoded(e, capacity=1024,
+                                 max_capacity=1 << 14, dedupe="hash",
+                                 probe_limit=1, sparse_pallas=True,
+                                 config_pack=True)
+    assert r["valid?"] == ref["valid?"]
+    assert r.get("op") == ref.get("op")
+    assert r["closure"] == "pallas-tiled"

@@ -118,7 +118,62 @@ def test_perf_ab_dedupe_unknown_strategy_raises():
     assert "unknown strategy" in r.stderr, r.stderr[-500:]
     assert "hash-palas" in r.stderr
     # the message must NAME the valid set, so the fix is in the error
-    assert "sort,hash,hash-pallas" in r.stderr, r.stderr[-500:]
+    assert "sort,hash,hash-pallas,hash-packed" in r.stderr, \
+        r.stderr[-500:]
+
+
+def _gate_coverage_for(k: int, n_ops: int = 1000):
+    """The host-only gate record for one chip-matrix adversarial
+    shape, at the capacity tier the perf_ab dedupe block uses
+    (1 << (k + 4))."""
+    from jepsen_tpu.parallel import sparse_kernels as sk
+    e = _adv_encoded(n_ops=n_ops, k=k)
+    return sk.gate_coverage(e.n_states, e.state_lo,
+                            e.slot_f.shape[1], 1 << (k + 4))
+
+
+def test_gate_coverage_schema_and_chip_matrix_coverage():
+    """Pin the gate_coverage record schema (the evidence line perf_ab
+    emits per dedupe shape) AND the ISSUE-11 acceptance claim: for
+    every shape in the chip A/B matrix ([(1000, 12), (1000, 8)]), the
+    would-run decision is "pallas" or "pallas-tiled" — NEVER a
+    wholesale "xla-hash" — and the k=12 (L=1000) headline shape that
+    previously degraded is admitted. Host-only: no chip, no tracing,
+    just the width-aware gate math."""
+    for k in (12, 8):
+        rec = _gate_coverage_for(k)
+        # schema pin: the chip campaign scripts read these fields
+        assert set(rec) == {"C", "capacity", "budget", "packable",
+                            "state_bits", "packed_width_bits",
+                            "would_run", "bytes_per_row"}, rec
+        assert set(rec["would_run"]) == {"packed", "unpacked"}
+        assert rec["packable"] is True
+        assert rec["bytes_per_row"]["unpacked"] == 48
+        assert rec["bytes_per_row"]["packed"] < 48
+        assert rec["packed_width_bits"] == rec["state_bits"] + rec["C"]
+        for layout in ("packed", "unpacked"):
+            assert rec["would_run"][layout] in ("pallas",
+                                                "pallas-tiled"), \
+                (k, layout, rec)
+    # k=8 at capacity 4096 fits the fused kernel outright
+    assert _gate_coverage_for(8)["would_run"]["packed"] == "pallas"
+    # k=12 at capacity 65536 is past whole-event fusion but covered
+    # by the tiled closure — the previously-degraded headline shape
+    r12 = _gate_coverage_for(12)
+    assert r12["would_run"]["packed"] in ("pallas", "pallas-tiled")
+    assert r12["would_run"]["packed"] != "xla-hash"
+
+
+def test_gate_coverage_unpackable_family():
+    """A family whose word exceeds 64 bits reports packable=False with
+    null packed fields — the overflow-to-unpacked evidence the record
+    must carry rather than fabricate."""
+    from jepsen_tpu.parallel import sparse_kernels as sk
+    rec = sk.gate_coverage(n_states=1 << 30, state_lo=0, C=40, N=1024)
+    assert rec["packable"] is False
+    assert rec["packed_width_bits"] is None
+    assert rec["would_run"]["packed"] is None
+    assert rec["would_run"]["unpacked"] in ("pallas", "pallas-tiled")
 
 
 @pytest.mark.slow
@@ -144,7 +199,11 @@ def test_perf_ab_emits_cost_table_on_cpu():
             assert cost[variant]["program"] == f"xla-{variant}"
         assert cost["trips"]["scan_events"] > 0, (shape, cost)
         assert cost["trips"]["fori_closure"] > 0, (shape, cost)
-    # all three variants agreed on every shape (interpret-mode pallas
-    # included): the correctness gate must stay silent on a clean run
+    # all variants agreed on every shape (interpret-mode pallas and
+    # the packed word included): the correctness gate stays silent
     assert not [l for l in lines if "correctness_mismatch" in l], lines
+    # every dedupe shape ships its host-only gate-coverage evidence
+    gc = [l for l in lines if "gate_coverage" in l]
+    assert gc and all("would_run" in l["gate_coverage"] for l in gc)
+    assert "config_pack_verdict" in lines[-1]
     assert "verdict" in lines[-1]

@@ -126,35 +126,74 @@ def test_probe_overflow_escalates_capacity_not_verdict():
     assert r1["capacity"] >= ref["capacity"]
 
 
-def test_vmem_shape_gate_falls_back_with_note():
-    """A capacity past the kernel's VMEM budget must degrade to the
-    XLA hash closure with closure="xla-hash" + a note — the bitdense
-    mesh-fallback precedent: the requested-kernel path degrades, it
-    never errors — and still produce the correct verdict."""
+def test_vmem_shape_gate_goes_tiled_not_wholesale():
+    """A capacity past the whole-event fusion gate no longer degrades
+    wholesale: the closure runs with the table streamed through VMEM
+    tiles (closure="pallas-tiled", sparse_kernels.tiled_insert_call)
+    and stays bit-identical to the XLA hash."""
     h = rand_register_history(n_ops=40, n_processes=5, n_values=3,
                               crash_p=0.06, fail_p=0.08, seed=31)
     e = enc_mod.encode(CASRegister(), h)
     big = 16384
     assert not sparse_kernels.supported(big, e.slot_f.shape[1])
+    assert sparse_kernels.tiled_plan(big, e.slot_f.shape[1]) is not None
     ref = engine.check_encoded(e, capacity=big, dedupe="hash")
     r = engine.check_encoded(e, capacity=big, dedupe="hash",
                              sparse_pallas=True)
-    assert r["closure"] == "xla-hash"
-    assert "VMEM budget" in r["closure-note"]
+    assert r["closure"] == "pallas-tiled"
     assert r["valid?"] == ref["valid?"]
+    assert r["configs-stepped"] == ref["configs-stepped"]
     # the flag-off reference is tag-free: byte-identical schema
     assert "closure" not in ref and "closure-note" not in ref
 
 
+def test_vmem_budget_too_small_falls_back_with_note():
+    """Only a budget too small even for the tiled planner degrades to
+    the XLA hash closure, with the note — the bitdense mesh-fallback
+    precedent: the requested-kernel path degrades, it never errors.
+    JEPSEN_TPU_VMEM_BUDGET is the per-generation re-gate knob."""
+    h = rand_register_history(n_ops=40, n_processes=5, n_values=3,
+                              crash_p=0.06, fail_p=0.08, seed=31)
+    e = enc_mod.encode(CASRegister(), h)
+    big = 16384
+    with mock.patch.dict(os.environ,
+                         {"JEPSEN_TPU_VMEM_BUDGET": str(1 << 16)}):
+        assert sparse_kernels.vmem_budget() == 1 << 16
+        assert sparse_kernels.tiled_plan(big, e.slot_f.shape[1]) is None
+        ref = engine.check_encoded(e, capacity=big, dedupe="hash")
+        r = engine.check_encoded(e, capacity=big, dedupe="hash",
+                                 sparse_pallas=True)
+    assert r["closure"] == "xla-hash"
+    assert "VMEM budget" in r["closure-note"]
+    assert r["valid?"] == ref["valid?"]
+    assert "closure" not in ref and "closure-note" not in ref
+
+
 def test_supported_budget_math():
-    """Pin the gate's accounting: 48 bytes of probe state per candidate
-    row (M = N*C) plus the frontier tile, against the 4 MiB budget."""
+    """Pin the WIDTH-AWARE gate accounting: bytes_per_row(lanes) =
+    12*lanes + 12 of probe state per candidate row (M = N*C) plus the
+    frontier tile, against the (env-overridable) VMEM budget — 48 B at
+    the unpacked 3-lane triple (the historical constant), 24 B at one
+    packed lane."""
+    assert sparse_kernels.bytes_per_row(3) == 48
+    assert sparse_kernels.bytes_per_row(2) == 36
+    assert sparse_kernels.bytes_per_row(1) == 24
     assert sparse_kernels.insert_supported(1024, 1024)
     assert sparse_kernels.supported(1024, 14)          # bench-ish shape
     assert not sparse_kernels.supported(16384, 7)
+    # packing admits shapes the unpacked layout cannot fit
+    assert sparse_kernels.supported(16384, 7, lanes=1)
     limit = sparse_kernels.VMEM_BUDGET // 48
     assert sparse_kernels.insert_supported(limit - 64, 64)
     assert not sparse_kernels.insert_supported(limit, 64)
+    # the env knob re-gates without a code edit; below-minimum raises
+    from jepsen_tpu.envflags import EnvFlagError
+    with mock.patch.dict(os.environ,
+                         {"JEPSEN_TPU_VMEM_BUDGET": str(8 << 20)}):
+        assert sparse_kernels.supported(16384, 7)
+    with mock.patch.dict(os.environ, {"JEPSEN_TPU_VMEM_BUDGET": "17"}), \
+            pytest.raises(EnvFlagError, match="VMEM_BUDGET"):
+        sparse_kernels.vmem_budget()
 
 
 def test_env_flag_resolution_and_validation():

@@ -56,20 +56,23 @@ if _UNKNOWN:   # a typo must not silently skip a real variant
                      f"{sorted(_UNKNOWN)}; valid: while,fori,pallas")
 _VARIANTS.add("while")
 
-# PERF_AB_DEDUPE=sort,hash,hash-pallas (default all three) selects the
-# sparse-engine frontier-dedupe strategies the advisory A/B measures
-# on the single-key adversarial shapes — the one-command measurement
-# both the JEPSEN_TPU_DEDUPE and the JEPSEN_TPU_SPARSE_PALLAS
-# flip-to-default decisions wait on ("hash-pallas" = the hash strategy
-# through the fused VMEM frontier kernel, parallel.sparse_kernels;
-# measured only on shapes inside the kernel's VMEM gate). Same
-# skip-a-crashing-variant rationale as PERF_AB_VARIANTS; empty
-# (PERF_AB_DEDUPE=) skips the block entirely. A typo raises with the
-# valid set listed — an unknown name silently skipped would read as
-# "measured and lost".
-_DEDUPE_VALID = ("sort", "hash", "hash-pallas")
+# PERF_AB_DEDUPE=sort,hash,hash-pallas,hash-packed (default all four)
+# selects the sparse-engine frontier-dedupe strategies the advisory
+# A/B measures on the single-key adversarial shapes — the one-command
+# measurement the JEPSEN_TPU_DEDUPE, JEPSEN_TPU_SPARSE_PALLAS, and
+# JEPSEN_TPU_CONFIG_PACK flip-to-default decisions wait on
+# ("hash-pallas" = the hash strategy through the VMEM frontier
+# kernels, parallel.sparse_kernels — fused inside the width-aware
+# gate, TILED past it, so no chip-matrix shape skips wholesale
+# anymore; "hash-packed" = the hash strategy over the packed
+# configuration word). Same skip-a-crashing-variant rationale as
+# PERF_AB_VARIANTS; empty (PERF_AB_DEDUPE=) skips the block entirely.
+# A typo raises with the valid set listed — an unknown name silently
+# skipped would read as "measured and lost".
+_DEDUPE_VALID = ("sort", "hash", "hash-pallas", "hash-packed")
 _DEDUPE = [v.strip() for v in os.environ.get(
-    "PERF_AB_DEDUPE", "sort,hash,hash-pallas").split(",") if v.strip()]
+    "PERF_AB_DEDUPE",
+    "sort,hash,hash-pallas,hash-packed").split(",") if v.strip()]
 _UNKNOWN_D = set(_DEDUPE) - set(_DEDUPE_VALID)
 if _UNKNOWN_D:
     raise SystemExit(f"PERF_AB_DEDUPE: unknown strategy(ies) "
@@ -336,6 +339,7 @@ def main():
     # mismatch vetoes the dedupe verdict like any correctness failure.
     dedupe_ratios = {}
     sparse_pallas_ratios = {}
+    config_pack_ratios = {}
     dedupe_bad = set()
     if _DEDUPE:
         from jepsen_tpu.parallel import engine as eng_mod
@@ -360,19 +364,36 @@ def main():
                 n_ops=L, k_crashed=k_d, seed=7))
             cap = 1 << (k_d + 4)     # peak ~10*2^k configs, one tier
             shape_key = f"single-{L}@2^{k_d}"
+            # the HOST-ONLY gate-coverage record (sparse_kernels.
+            # gate_coverage): bytes/row, packed word width, and what
+            # WOULD run (pallas / pallas-tiled / xla-hash) per layout
+            # at this shape's capacity — computable with no chip, so
+            # the flag-flip campaign inherits the sizing evidence
+            # before a single on-chip measurement lands. Schema pinned
+            # by tests/test_perf_ab.py.
+            emit({"gate_coverage": sk.gate_coverage(
+                      e.n_states, e.state_lo, e.slot_f.shape[1], cap),
+                  "shape": shape_key})
             dres = {}
             dline = {"shape": f"single-key {L}-op adversarial "
                               f"sparse-dedupe (2^{k_d} open configs)"}
             for strat in _DEDUPE:
                 if strat == "hash-pallas":
-                    if not sk.supported(cap, e.slot_f.shape[1]):
-                        # measuring the note-and-fallback path would
-                        # time the XLA closure under the kernel's name
+                    if not sk.supported(cap, e.slot_f.shape[1]) \
+                            and sk.tiled_plan(
+                                cap, e.slot_f.shape[1]) is None:
+                        # only a shape even the TILED closure cannot
+                        # cover skips — measuring the note-and-fallback
+                        # path would time the XLA closure under the
+                        # kernel's name. (The k=12 headline shape now
+                        # runs: fused inside the gate, tiled past it.)
                         dline["hash-pallas_skipped"] = (
                             f"capacity {cap} past the kernel's VMEM "
-                            f"gate")
+                            f"gate even tiled")
                         continue
                     kw = {"dedupe": "hash", "sparse_pallas": True}
+                elif strat == "hash-packed":
+                    kw = {"dedupe": "hash", "config_pack": True}
                 else:
                     kw = {"dedupe": strat}
                 t = _timed(dres, strat,
@@ -408,6 +429,12 @@ def main():
                     / max(dline["hash-pallas_secs"], 1e-9))
                 dline["hash_pallas_speedup"] = round(
                     sparse_pallas_ratios[shape_key], 2)
+            if "hash" in dres and "hash-packed" in dres:
+                config_pack_ratios[shape_key] = (
+                    dline["hash_secs"]
+                    / max(dline["hash-packed_secs"], 1e-9))
+                dline["hash_packed_speedup"] = round(
+                    config_pack_ratios[shape_key], 2)
             emit(dline)
             # the per-shape search-stats block (JEPSEN_TPU_SEARCH_
             # STATS machinery, forced on for this one untimed run so
@@ -579,6 +606,10 @@ def main():
         sparse_pallas_verdict = ("no-verdict (non-tpu backend: "
                                  "interpret-mode kernel timings "
                                  "measure the interpreter)")
+        config_pack_verdict = ("no-verdict (non-tpu backend: cpu "
+                               "timings don't flip defaults; the "
+                               "gate_coverage records stand on any "
+                               "backend)")
     else:
         # a variant filtered out by PERF_AB_VARIANTS was not measured —
         # its verdict line must say so, never a definitive keep/flip
@@ -631,10 +662,24 @@ def main():
                 if sparse_pallas_ratios
                 and min(sparse_pallas_ratios.values()) >= 1.1
                 else "keep-opt-in")
+        if not ({"hash", "hash-packed"} <= set(_DEDUPE)):
+            config_pack_verdict = ("not-measured (a strategy skipped "
+                                   "by PERF_AB_DEDUPE)")
+        elif dedupe_bad & {"hash", "hash-packed"}:
+            config_pack_verdict = ("keep-opt-in (STRATEGY VETOED — "
+                                   "see the *_mismatch keys on the "
+                                   "sparse-dedupe lines)")
+        else:
+            config_pack_verdict = (
+                "default-on"
+                if config_pack_ratios
+                and min(config_pack_ratios.values()) >= 1.1
+                else "keep-opt-in")
     emit({"backend": backend, "verdict": verdict,
           "fori_verdict": fori_verdict,
           "dedupe_verdict": dedupe_verdict,
           "sparse_pallas_verdict": sparse_pallas_verdict,
+          "config_pack_verdict": config_pack_verdict,
           "variants_measured": sorted(_VARIANTS),
           "dedupe_measured": sorted(_DEDUPE),
           "ratios": {k: round(v, 2) for k, v in ratios.items()},
@@ -643,6 +688,9 @@ def main():
           "sparse_pallas_ratios": {k: round(v, 2)
                                    for k, v in
                                    sparse_pallas_ratios.items()},
+          "config_pack_ratios": {k: round(v, 2)
+                                 for k, v in
+                                 config_pack_ratios.items()},
           "fori_ratios": {k: round(v, 2) for k, v in fori_ratios.items()},
           "rule": "pallas default-on iff it wins >=1.1x on EVERY "
                   "measured shape on the tpu backend AND never "
@@ -654,11 +702,18 @@ def main():
                   "default (engine._resolve_dedupe) under the same "
                   ">=1.1x-on-every-shape + never-disagreed rule, "
                   "measured on the sparse engine's sparse-dedupe "
-                  "lines above; hash-pallas (the fused VMEM frontier "
-                  "kernel, vs the XLA hash strategy, on the shapes "
-                  "inside the kernel's VMEM gate) flips "
+                  "lines above; hash-pallas (the VMEM frontier "
+                  "kernels vs the XLA hash strategy — fused inside "
+                  "the width-aware gate, TILED past it, so every "
+                  "chip-matrix shape measures) flips "
                   "JEPSEN_TPU_SPARSE_PALLAS's default "
-                  "(engine._resolve_sparse_pallas) under the same rule"})
+                  "(engine._resolve_sparse_pallas) under the same "
+                  "rule; hash-packed (the packed configuration word "
+                  "vs the unpacked triple) flips "
+                  "JEPSEN_TPU_CONFIG_PACK's default "
+                  "(engine._resolve_config_pack) likewise — the "
+                  "gate_coverage lines record, per shape and layout, "
+                  "bytes/row and what would run, chip-free"})
 
 
 if __name__ == "__main__":
