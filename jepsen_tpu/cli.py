@@ -105,6 +105,15 @@ def base_parser(prog: str = "jepsen") -> argparse.ArgumentParser:
                         "(0 = OS-assigned; default: "
                         "JEPSEN_TPU_OPS_PORT, unset = no ops "
                         "endpoint — docs/observability.md)")
+    s.add_argument("--ingress-port", type=int, default=None,
+                   help="with --checker: accept streamed-JSONL delta "
+                        "requests over HTTP on this port "
+                        "(POST /v1/deltas, GET /v1/result, "
+                        "POST /v1/finalize; per-tenant bearer-token "
+                        "auth when JEPSEN_TPU_TENANTS is set; 0 = "
+                        "OS-assigned; default: "
+                        "JEPSEN_TPU_INGRESS_PORT, unset = stdio "
+                        "only — docs/streaming.md)")
     # listed for --help discoverability only: run_cli dispatches `lint`
     # to jepsen_tpu.analysis.main BEFORE parsing (its own parser is the
     # single source of truth for lint flags and the 0/1/2 contract;
@@ -339,9 +348,25 @@ def run_serve_cmd(args) -> int:
             print(f"ops endpoint: http://{args.host}:{ops.port} "
                   f"(/metrics /healthz /status — `jepsen status "
                   f"--port {ops.port}`)", file=sys.stderr)
+        # the HTTP delta ingress (docs/streaming.md "HTTP ingress"):
+        # off unless --ingress-port / JEPSEN_TPU_INGRESS_PORT names a
+        # port; stdio keeps running either way — both transports feed
+        # the same admission layer (tenancy, quotas, backpressure)
+        from jepsen_tpu.serve import ingress as ingress_mod
+        iport = ingress_mod.resolve_ingress_port(
+            getattr(args, "ingress_port", None))
+        ing = None
+        if iport is not None:
+            ing = ingress_mod.start_ingress(svc, iport,
+                                            host=args.host)
+            print(f"delta ingress: http://{args.host}:{ing.port} "
+                  f"(POST /v1/deltas — streamed JSONL)",
+                  file=sys.stderr)
         try:
             return run_stdio(svc)
         finally:
+            if ing is not None:
+                ing.close()
             if ops is not None:
                 ops.close()
             if watch is not None:

@@ -300,6 +300,50 @@ def env_path(name: str, what: str = "path") -> Optional[str]:
 #                            "1" store/serve_wal, <path> there; every
 #                            ADMITTED delta is fsynced here before the
 #                            producer sees {"accepted"}
+#   JEPSEN_TPU_SERVE_WAL_SEGMENT_BYTES env_int serve.wal — auto-rotate
+#                            a key's active WAL segment once it grows
+#                            past this many bytes (0/unset = no auto
+#                            rotation; DeltaWAL.rotate() always
+#                            available): segmented files are what
+#                            per-tenant WAL quotas meter and replica
+#                            handoff ships
+#   JEPSEN_TPU_TENANTS       env_raw     serve.tenancy — the tenant
+#                            table: comma-separated
+#                            `<name>[:token=T][:weight=W][:ops=N]
+#                            [:keys=N][:wal=BYTES]` declarations,
+#                            strictly validated (TenantSpecError, an
+#                            EnvFlagError, on any malformed field —
+#                            a typo'd tenant plan must never silently
+#                            run un-isolated); unset = single-tenant
+#                            mode, byte-identical to the PR 7/8
+#                            service
+#   JEPSEN_TPU_TENANT_OPS    env_int     serve.tenancy — default
+#                            per-tenant pending-ops quota when a
+#                            tenant declares no `ops=` (0/unset =
+#                            derive each tenant's bound as its weight
+#                            share of the shed high-water)
+#   JEPSEN_TPU_TENANT_KEYS   env_int     serve.tenancy — default
+#                            per-tenant concurrent-key quota when a
+#                            tenant declares no `keys=` (0/unset =
+#                            unlimited)
+#   JEPSEN_TPU_TENANT_WAL_BYTES env_int  serve.tenancy — default
+#                            per-tenant WAL-bytes quota when a tenant
+#                            declares no `wal=` (0/unset = unlimited);
+#                            a tenant past it sheds new deltas until
+#                            the operator rotates/archives its keys
+#   JEPSEN_TPU_TENANT_QUANTUM env_int    serve.tenancy — deficit-
+#                            round-robin quantum: ops of service
+#                            credit one weight unit banks per worker
+#                            cycle (default 512, min 1); smaller =
+#                            finer-grained fairness, larger = bigger
+#                            batched device programs
+#   JEPSEN_TPU_INGRESS_PORT  env_int     serve.ingress — the HTTP
+#                            delta-ingress port for `jepsen serve
+#                            --checker` (streamed-JSONL POST
+#                            /v1/deltas + /v1/result + /v1/finalize,
+#                            per-tenant bearer-token auth; 0 =
+#                            OS-assigned; unset = stdio only);
+#                            `--ingress-port` overrides
 #   JEPSEN_TPU_OPS_PORT      env_int     obs.httpd — the live ops
 #                            endpoint port for `jepsen serve
 #                            --checker` (/metrics Prometheus text,

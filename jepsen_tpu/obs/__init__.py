@@ -43,7 +43,7 @@ from jepsen_tpu.obs.export import (  # noqa: F401
 )
 from jepsen_tpu.obs.metrics import (  # noqa: F401
     BUCKET_LADDER, Registry, counter, gauge, hist_quantile, histogram,
-    registry,
+    labeled, registry, split_labels,
 )
 from jepsen_tpu.obs.tracer import (  # noqa: F401
     Span, Tracer, configure, counter_sample, ctx_runner, current_span,

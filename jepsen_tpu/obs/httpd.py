@@ -94,42 +94,85 @@ def _fmt(v) -> str:
     return repr(float(v))
 
 
+def _esc_label(v: str) -> str:
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _label_str(labels: Dict[str, str], extra: str = "") -> str:
+    """A ``{k="v",...}`` block from a label dict (label NAMES are
+    sanitized like metric names, values escaped); ``extra`` appends a
+    pre-rendered pair (the histogram ``le``). Empty in, empty out."""
+    pairs = [f'{prom_name(k)[len(PROM_PREFIX):]}="{_esc_label(v)}"'
+             for k, v in sorted(labels.items())]
+    if extra:
+        pairs.append(extra)
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
 def render_prometheus(snap: Optional[Dict[str, dict]] = None) -> str:
     """The registry snapshot as Prometheus text exposition (format
     0.0.4). Counters and gauges render as-is; a gauge's high-water
     mark rides as a ``<name>_max`` gauge twin; histograms render the
     full ``_bucket``/``_sum``/``_count`` triple with cumulative ``le``
     buckets ending at ``+Inf`` — the shape ``histogram_quantile()``
-    needs for the delta-latency SLOs."""
+    needs for the delta-latency SLOs.
+
+    Registry names of the form ``base[k=v,...]`` (``obs.labeled``)
+    render as REAL exposition labels on the base metric —
+    ``serve.ack_secs[tenant=a]`` becomes
+    ``jepsen_serve_ack_secs_bucket{tenant="a",le=...}`` — so the
+    per-tenant SLO series share one metric name with the unlabeled
+    aggregate and ``histogram_quantile()`` can group by tenant. All
+    series of one name render contiguously under one ``# TYPE`` line
+    (the exposition grouping rule)."""
     if snap is None:
         snap = _metrics.registry().snapshot()
-    lines = []
+    # group by rendered metric name so labeled series and the
+    # unlabeled aggregate share one contiguous TYPE block
+    by_base: Dict[str, list] = {}
     for name in sorted(snap):
-        m = snap[name]
-        pn = prom_name(name)
-        if m["type"] == "counter":
-            lines.append(f"# TYPE {pn} counter")
-            lines.append(f"{pn} {_fmt(m['value'])}")
-        elif m["type"] == "gauge":
-            lines.append(f"# TYPE {pn} gauge")
-            lines.append(f"{pn} {_fmt(m['value'])}")
-            if m.get("max") is not None:
-                lines.append(f"# TYPE {pn}_max gauge")
-                lines.append(f"{pn}_max {_fmt(m['max'])}")
-        else:
-            lines.append(f"# TYPE {pn} histogram")
-            for le, cum in m.get("buckets") or ():
-                lines.append(f'{pn}_bucket{{le="{_fmt(le)}"}} {cum}')
-            lines.append(f'{pn}_bucket{{le="+Inf"}} {m["count"]}')
-            lines.append(f"{pn}_sum {_fmt(m['total'])}")
-            lines.append(f"{pn}_count {m['count']}")
-            if m.get("max") is not None:
-                # streaming-max twin (the gauge-_max precedent): a
-                # quantile landing in the +Inf bucket answers with
-                # this instead of "-" — exactly the overloaded-SLO
-                # case the quantile view exists for
-                lines.append(f"# TYPE {pn}_max gauge")
-                lines.append(f"{pn}_max {_fmt(m['max'])}")
+        base, labels = _metrics.split_labels(name)
+        by_base.setdefault(prom_name(base), []).append(
+            (labels, snap[name]))
+    lines = []
+    for pn in sorted(by_base):
+        series = by_base[pn]
+        typ = series[0][1]["type"]
+        lines.append(f"# TYPE {pn} "
+                     f"{'histogram' if typ == 'histogram' else typ}")
+        max_twins = []
+        for labels, m in series:
+            lab = _label_str(labels)
+            if m["type"] == "counter":
+                lines.append(f"{pn}{lab} {_fmt(m['value'])}")
+            elif m["type"] == "gauge":
+                lines.append(f"{pn}{lab} {_fmt(m['value'])}")
+                if m.get("max") is not None:
+                    max_twins.append(f"{pn}_max{lab} "
+                                     f"{_fmt(m['max'])}")
+            else:
+                for le, cum in m.get("buckets") or ():
+                    le_pair = f'le="{_fmt(le)}"'
+                    lines.append(
+                        f"{pn}_bucket{_label_str(labels, le_pair)} "
+                        f"{cum}")
+                inf_pair = 'le="+Inf"'
+                lines.append(
+                    f"{pn}_bucket{_label_str(labels, inf_pair)} "
+                    f"{m['count']}")
+                lines.append(f"{pn}_sum{lab} {_fmt(m['total'])}")
+                lines.append(f"{pn}_count{lab} {m['count']}")
+                if m.get("max") is not None:
+                    # streaming-max twin (the gauge-_max precedent): a
+                    # quantile landing in the +Inf bucket answers with
+                    # this instead of "-" — exactly the overloaded-SLO
+                    # case the quantile view exists for
+                    max_twins.append(f"{pn}_max{lab} "
+                                     f"{_fmt(m['max'])}")
+        if max_twins:
+            lines.append(f"# TYPE {pn}_max gauge")
+            lines.extend(max_twins)
     return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -291,14 +334,28 @@ def parse_prometheus(body: str) -> Dict[str, dict]:
     output) back into snapshot-shaped dicts — enough structure for
     hist_quantile: histograms get {"count", "total", "buckets"},
     everything else {"value"}. Tolerates unknown lines (forward
-    compatibility beats strictness in a CLI client)."""
+    compatibility beats strictness in a CLI client).
+
+    Labeled series key their entries ``name[k=v,...]`` (the registry's
+    ``obs.labeled`` convention, labels sorted) — so the per-tenant SLO
+    histograms parse back as distinct quantile-answerable entries
+    while unlabeled names keep their historical plain-string keys."""
     import re
 
     types: Dict[str, str] = {}
     out: Dict[str, dict] = {}
     sample = re.compile(
-        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{le="([^"]*)"\})? '
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{([^}]*)\})? '
         r'([-+0-9.eE]+|\+Inf)$')
+    pair = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+    def _fresh():
+        return {"type": "histogram", "count": 0, "total": 0.0,
+                "buckets": [], "min": None, "max": None}
+
+    def _key(name, labels):
+        return _metrics.labeled(name, **labels) if labels else name
+
     for ln in body.splitlines():
         if ln.startswith("# TYPE "):
             parts = ln.split()
@@ -310,12 +367,14 @@ def parse_prometheus(body: str) -> Dict[str, dict]:
         m = sample.match(ln)
         if not m:
             continue
-        name, le, val = m.groups()
+        name, lab, val = m.groups()
+        labels = {k: v.replace(r'\"', '"').replace(r"\n", "\n")
+                  .replace(r"\\", "\\")
+                  for k, v in pair.findall(lab or "")}
+        le = labels.pop("le", None)
         if name.endswith("_bucket"):
             base = name[: -len("_bucket")]
-            h = out.setdefault(base, {"type": "histogram", "count": 0,
-                                      "total": 0.0, "buckets": [],
-                                      "min": None, "max": None})
+            h = out.setdefault(_key(base, labels), _fresh())
             if le == "+Inf":
                 h["count"] = int(float(val))
             elif le is not None:
@@ -323,29 +382,23 @@ def parse_prometheus(body: str) -> Dict[str, dict]:
             continue
         if name.endswith("_sum") and types.get(
                 name[: -len("_sum")]) == "histogram":
-            out.setdefault(name[: -len("_sum")],
-                           {"type": "histogram", "count": 0,
-                            "total": 0.0, "buckets": [], "min": None,
-                            "max": None})["total"] = float(val)
+            out.setdefault(_key(name[: -len("_sum")], labels),
+                           _fresh())["total"] = float(val)
             continue
         if name.endswith("_count") and types.get(
                 name[: -len("_count")]) == "histogram":
-            out.setdefault(name[: -len("_count")],
-                           {"type": "histogram", "count": 0,
-                            "total": 0.0, "buckets": [], "min": None,
-                            "max": None})["count"] = int(float(val))
+            out.setdefault(_key(name[: -len("_count")], labels),
+                           _fresh())["count"] = int(float(val))
             continue
         if name.endswith("_max") and types.get(
                 name[: -len("_max")]) == "histogram":
             # the streaming-max twin: what hist_quantile answers with
             # for quantiles past the bucket ladder's top
-            out.setdefault(name[: -len("_max")],
-                           {"type": "histogram", "count": 0,
-                            "total": 0.0, "buckets": [], "min": None,
-                            "max": None})["max"] = float(val)
+            out.setdefault(_key(name[: -len("_max")], labels),
+                           _fresh())["max"] = float(val)
             continue
-        out[name] = {"type": types.get(name, "untyped"),
-                     "value": float(val)}
+        out[_key(name, labels)] = {"type": types.get(name, "untyped"),
+                                   "value": float(val)}
     return out
 
 
@@ -424,12 +477,76 @@ def render_status_table(status: dict, health: dict) -> str:
                 f"{note}")
     else:
         lines.append("(no keys admitted yet)")
+    tenants = status.get("tenants") or {}
+    if tenants:
+        lines.append(
+            f"{'tenant':<14} {'w':>3} {'pend':>6} {'bound':>7} "
+            f"{'keys':>5} {'sheds':>6} {'wal':>9} {'ack_p99':>9} "
+            f"{'verd_p99':>9}")
+        for name in sorted(tenants):
+            t = tenants[name]
+            acct = t.get("acct") or {}
+            fmt_q = lambda v: "-" if v is None else f"{v:.4g}"  # noqa: E731
+            lines.append(
+                f"{name[:14]:<14} {t.get('weight', 1):>3} "
+                f"{t.get('pending_ops', 0):>6} "
+                f"{t.get('pending_bound', 0):>7} "
+                f"{t.get('keys', 0):>5} {acct.get('sheds', 0):>6} "
+                f"{_fmt_bytes(t.get('wal_bytes')):>9} "
+                f"{fmt_q(t.get('ack_p99')):>9} "
+                f"{fmt_q(t.get('verdict_p99')):>9}")
     lines.append(
         f"pending_ops={status.get('pending_ops', 0)} "
         f"high_water={status.get('high_water', 0)} "
         f"global_bound={status.get('global_bound', 0)} "
         f"keys={len(keys)} live={status.get('keys_live', 0)}")
     return "\n".join(lines) + "\n"
+
+
+def _fleet_status(args) -> int:
+    """The multi-replica view: one section per --addr, then a fleet
+    summary. Exit: 2 if any replica is unreachable, else 1 if any is
+    degraded, else 0 — worst-of, so a load balancer script reads one
+    code for the whole fleet."""
+    ready, degraded, unreachable = [], [], []
+    docs = {}
+    for addr in args.addr:
+        host, _, port = addr.rpartition(":")
+        if not host or not port.isdigit():
+            print(f"jepsen status: bad --addr {addr!r} (expected "
+                  f"HOST:PORT)", file=sys.stderr)
+            return 254
+        base = f"http://{addr}"
+        try:
+            hcode, hbody = _fetch(base + "/healthz", args.timeout)
+            _scode, sbody = _fetch(base + "/status", args.timeout)
+            health = json.loads(hbody)
+            status = json.loads(sbody)
+        except (OSError, ValueError) as err:
+            unreachable.append(addr)
+            docs[addr] = {"error": str(err)}
+            continue
+        docs[addr] = {"health": health, "status": status}
+        (ready if hcode == 200 and health.get("ok")
+         else degraded).append(addr)
+    if args.json:
+        print(json.dumps(docs, indent=2, sort_keys=True, default=str))
+    else:
+        for addr in args.addr:
+            doc = docs[addr]
+            print(f"== replica {addr} ==")
+            if "error" in doc:
+                print(f"UNREACHABLE: {doc['error']}\n")
+                continue
+            sys.stdout.write(render_status_table(doc["status"],
+                                                 doc["health"]))
+            print()
+        print(f"fleet: {len(ready)} ready, {len(degraded)} degraded, "
+              f"{len(unreachable)} unreachable "
+              f"of {len(args.addr)} replica(s)")
+    if unreachable:
+        return 2
+    return 1 if degraded else 0
 
 
 def status_main(argv: Optional[Sequence[str]] = None) -> int:
@@ -448,6 +565,15 @@ def status_main(argv: Optional[Sequence[str]] = None) -> int:
                    help="ops endpoint port (default: "
                         "JEPSEN_TPU_OPS_PORT)")
     p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--addr", action="append", default=None,
+                   metavar="HOST:PORT",
+                   help="a replica's ops endpoint (repeatable): with "
+                        ">= 1 --addr the command renders one table "
+                        "per replica plus a fleet summary — the "
+                        "multi-replica serve view (docs/streaming.md "
+                        "'Replica scale-out'); exit 2 if any replica "
+                        "is unreachable, else 1 if any degraded, "
+                        "else 0")
     p.add_argument("--timeout", type=float, default=5.0,
                    help="per-request timeout seconds")
     p.add_argument("--json", action="store_true",
@@ -469,9 +595,11 @@ def status_main(argv: Optional[Sequence[str]] = None) -> int:
         # maps to the CLI's bad-args code instead of colliding with
         # the health exit codes
         return 0 if e.code in (0, None) else 254
+    if args.addr:
+        return _fleet_status(args)
     port = resolve_ops_port(args.port)
     if port is None:
-        print("jepsen status: no port — pass --port or set "
+        print("jepsen status: no port — pass --port, --addr, or set "
               "JEPSEN_TPU_OPS_PORT", file=sys.stderr)
         return 254
     base = f"http://{args.host}:{port}"

@@ -149,6 +149,37 @@ class Histogram:
                 "buckets": cum}
 
 
+def labeled(name: str, **labels) -> str:
+    """A registry name carrying label pairs: ``base[k=v,...]``. The
+    registry itself treats the whole string as one opaque name (every
+    label set is its own metric object); the Prometheus renderer
+    (``obs.httpd``) splits the suffix back into real exposition labels
+    — ``serve.ack_secs[tenant=alice]`` renders as
+    ``jepsen_serve_ack_secs_bucket{tenant="alice",le=...}``. Keep
+    label VALUES inside ``[A-Za-z0-9_.:-]`` (tenant names, backend
+    ids); the renderer escapes anything else but dashboards read
+    cleaner without the escapes."""
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}[{inner}]"
+
+
+def split_labels(name: str):
+    """``base[k=v,...]`` -> (base, {k: v}); a plain name -> (name, {})."""
+    if not name.endswith("]"):
+        return name, {}
+    i = name.find("[")
+    if i < 0:
+        return name, {}
+    out = {}
+    for pair in name[i + 1:-1].split(","):
+        k, eq, v = pair.partition("=")
+        if eq:
+            out[k] = v
+    return name[:i], out
+
+
 def hist_quantile(snap: dict, q: float) -> Optional[float]:
     """Approximate quantile from a histogram snapshot (or delta): the
     upper bound of the first cumulative bucket covering ``q`` of the

@@ -20,6 +20,14 @@ Spec grammar (comma-separated rules)::
                    callers' degradation paths take over
            flaky   a transient failure (TransientFault); the
                    supervisor retries it within the breaker budget
+           slow    deterministic latency: the dispatch sleeps the
+                   rule's milliseconds FIRST, then runs normally —
+                   the slow-device scenario fairness and soak tests
+                   need (a degraded chip that still answers); its
+                   count arg is the delay (``slow@search:50`` = 50 ms,
+                   ``ms=50`` likewise; default 25), and a configured
+                   watchdog below the delay still fires (a slow
+                   dispatch past the bound IS a wedge, by definition)
     site   dispatch   bitdense single/batch device program
            transfer   host->device placement (pad/place)
            search     sparse-engine device search
@@ -53,9 +61,13 @@ from typing import Dict, List, Optional, Tuple
 
 from jepsen_tpu import envflags
 
-KINDS = ("wedge", "raise", "flaky")
+KINDS = ("wedge", "raise", "flaky", "slow")
 SITES = ("dispatch", "transfer", "search", "sharded", "pipeline",
          "child")
+
+#: slow@<site> with no [:ms] — small enough for a fast test matrix,
+#: large enough to register on the SLO histograms
+DEFAULT_SLOW_MS = 25
 
 
 class FaultSpecError(envflags.EnvFlagError):
@@ -87,6 +99,7 @@ class FaultRule:
     n: Optional[int] = None       # fire on the first n invocations
     every: Optional[int] = None   # fire on every k-th invocation
     spec: str = ""                # the raw rule text, for messages
+    ms: int = DEFAULT_SLOW_MS     # slow-kind delay (milliseconds)
 
     def fires(self, count: int) -> bool:
         """Whether this rule fires on the count-th (1-based)
@@ -123,33 +136,45 @@ def parse_spec(raw: str) -> List[FaultRule]:
         if site == "child" and kind != "wedge":
             # the bench child consults the seam once at startup and
             # only implements the wedge (the r05 signature); accepting
-            # raise/flaky here would be a spec that silently tests
-            # nothing — the exact failure validation exists to prevent
+            # raise/flaky/slow here would be a spec that silently
+            # tests nothing — the exact failure validation exists to
+            # prevent
             raise FaultSpecError(
                 f"JEPSEN_TPU_FAULTS rule {part!r}: site 'child' only "
                 f"supports kind 'wedge' (the bench child-startup "
                 f"seam)")
         n = every = None
+        ms = DEFAULT_SLOW_MS
         if sep:
             arg = arg.strip()
             key, eq, val = arg.partition("=")
             if not eq:
-                key, val = "n", arg
+                # a bare integer is the kind's natural argument:
+                # milliseconds for slow, first-N for everything else
+                key, val = ("ms" if kind == "slow" else "n"), arg
             key = key.strip()
             try:
                 ival = int(val.strip())
             except ValueError:
                 ival = -1
-            if key not in ("n", "every") or ival < 1:
+            if kind == "slow":
+                if key != "ms" or ival < 1:
+                    raise FaultSpecError(
+                        f"JEPSEN_TPU_FAULTS rule {part!r}: bad slow "
+                        f"delay {arg!r} (expected MS or ms=MS with a "
+                        f"positive integer — slow fires on every "
+                        f"invocation; n=/every= do not apply)")
+                ms = ival
+            elif key not in ("n", "every") or ival < 1:
                 raise FaultSpecError(
                     f"JEPSEN_TPU_FAULTS rule {part!r}: bad count "
                     f"{arg!r} (expected N, n=N, or every=K with a "
                     f"positive integer)")
-            if key == "n":
+            elif key == "n":
                 n = ival
             else:
                 every = ival
-        rules.append(FaultRule(kind, site, n, every, part))
+        rules.append(FaultRule(kind, site, n, every, part, ms))
     return rules
 
 

@@ -48,6 +48,7 @@ from __future__ import annotations
 import logging
 import sys
 import threading
+import time
 from typing import Callable, Optional
 
 from jepsen_tpu import envflags
@@ -284,6 +285,14 @@ def _one_attempt(site: str, thunk: Callable, backend: Optional[str],
                 site, wd or _INJECTED_WEDGE_TIMEOUT, backend)
         elif rule.kind == "raise":
             raise faults.InjectedCrash(site, rule)
+        elif rule.kind == "slow":
+            # deterministic latency: the dispatch still runs and still
+            # answers correctly — it just takes rule.ms longer. The
+            # sleep rides INSIDE the watchdogged window, so a watchdog
+            # bound below the injected delay fires exactly as it would
+            # on a real slow device (a too-slow dispatch IS a wedge).
+            delay, inner = rule.ms / 1000.0, thunk
+            thunk = lambda: (time.sleep(delay), inner())[1]  # noqa: E731
         else:
             raise faults.TransientFault(site, rule)
     r = (_run_watchdogged(thunk, wd, site, backend) if wd

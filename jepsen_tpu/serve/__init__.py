@@ -2,11 +2,19 @@
 crash-safe front over the incremental extension engine
 (``jepsen_tpu.parallel.extend``) — per-key history deltas in, online
 verdicts out, with backpressure, load shedding, idle-frontier
-eviction, and WAL replay. ``jepsen serve --checker`` is the CLI
-ingress (``serve.stdio``)."""
+eviction, WAL replay, tenant-isolated weighted-fair admission
+(``serve.tenancy``), an asyncio HTTP delta ingress
+(``serve.ingress``), and consistent-hash replica scale-out with
+freeze/thaw + WAL-segment key migration (``serve.ring``). ``jepsen
+serve --checker`` drives the stdio transport (``serve.stdio``) and,
+with ``--ingress-port``, the HTTP one."""
 
 from jepsen_tpu.serve.service import (  # noqa: F401
     CheckerService, default_wal_dir,
+)
+from jepsen_tpu.serve.tenancy import (  # noqa: F401
+    DEFAULT_TENANT, Tenant, TenantSpecError, TenantTable,
+    parse_tenants, resolve_tenants,
 )
 from jepsen_tpu.serve.wal import (  # noqa: F401
     CheckpointStore, DeltaWAL, WALError,

@@ -1,0 +1,243 @@
+"""Replica scale-out for the streaming checker: a consistent-hash
+ring over serve replicas, and key migration built from the primitives
+PR 7 already cut — ``CheckpointStore`` freeze/thaw as the frontier
+handoff and WAL **segment transfer** as the durable-op handoff.
+
+The fleet model is shared-nothing: each replica is one
+``CheckerService`` (own WAL dir, own device, own ops endpoint), and
+:class:`HashRing` assigns every key an owner by hashing its EDN text
+onto a vnode ring — adding or removing a replica moves only the keys
+that hash into the changed arcs, never reshuffles the fleet.
+
+Migration is recovery, deliberately. A key is re-homed by copying its
+WAL segments (``DeltaWAL.segments``) and its frozen checkpoint pair
+into the new owner's WAL dir, then calling
+:meth:`CheckerService.adopt_keys` — the same deterministic replay a
+restart runs, so a migrated key's verdict is **bit-identical** to an
+unmigrated one-shot check (the PR 7 recovery contract, now
+cross-process; pinned by tests/test_ring.py incl. a real kill -9).
+Two flavors share the code path:
+
+* **crash re-home** (:func:`rehome_dead_replica`): the dead replica
+  can't flush anything — survivors take whatever its WAL fsynced
+  (exactly the set of acknowledged deltas; unacknowledged ones were
+  never promised) plus any checkpoint eviction already froze.
+* **graceful drain** (:meth:`Router.migrate_key`): the source
+  freezes the key's live frontier first (``session.freeze`` via the
+  checkpoint store), so the new owner thaws instead of re-scanning.
+
+``jepsen status --addr host:port`` (repeatable) renders the fleet
+view — one table per replica plus a summary line (``obs.httpd``).
+
+Import-safe: no JAX at module scope (routing and file transfer must
+work from a coordinator that never touches a device).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import logging
+import os
+import shutil
+from typing import Dict, List, Optional
+
+from jepsen_tpu import edn, obs
+from jepsen_tpu.serve.wal import DeltaWAL, _safe_name
+
+_log = logging.getLogger(__name__)
+
+DEFAULT_VNODES = 64
+
+
+def _point(s: str) -> int:
+    return int.from_bytes(hashlib.sha1(s.encode()).digest()[:8],
+                          "big")
+
+
+class HashRing:
+    """Consistent hashing over replica names: each node owns
+    ``vnodes`` points on a 64-bit ring; a key belongs to the first
+    point clockwise of its own hash. Deterministic across processes
+    (sha1 of strings — no Python hash randomization), so a router, a
+    survivor, and a test all compute the same owner."""
+
+    def __init__(self, nodes: Optional[List[str]] = None,
+                 vnodes: int = DEFAULT_VNODES):
+        if vnodes < 1:
+            raise ValueError("vnodes must be >= 1")
+        self.vnodes = vnodes
+        self._points: List[int] = []
+        self._owners: Dict[int, str] = {}
+        self._nodes: set = set()
+        for n in nodes or ():
+            self.add(n)
+
+    @property
+    def nodes(self) -> List[str]:
+        return sorted(self._nodes)
+
+    def add(self, node: str) -> None:
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        for i in range(self.vnodes):
+            p = _point(f"{node}#{i}")
+            if p in self._owners:
+                # a 64-bit collision between two nodes' vnodes: skip
+                # the later point (the earlier owner keeps the arc)
+                continue
+            bisect.insort(self._points, p)
+            self._owners[p] = node
+
+    def remove(self, node: str) -> None:
+        if node not in self._nodes:
+            return
+        self._nodes.discard(node)
+        self._points = [p for p in self._points
+                        if self._owners[p] != node]
+        self._owners = {p: o for p, o in self._owners.items()
+                        if o != node}
+
+    def owner(self, key) -> str:
+        """The replica that owns ``key`` (hashed by its EDN text, the
+        same identity the WAL files use)."""
+        if not self._points:
+            raise ValueError("ring has no nodes")
+        p = _point(edn.dumps(key))
+        i = bisect.bisect_right(self._points, p)
+        if i == len(self._points):
+            i = 0
+        return self._owners[self._points[i]]
+
+    def assignments(self, keys) -> Dict[str, list]:
+        """node -> [key, ...] for a key set (the rebalance plan)."""
+        out: Dict[str, list] = {}
+        for k in keys:
+            out.setdefault(self.owner(k), []).append(k)
+        return out
+
+
+# ------------------------------------------------------ file handoff
+
+
+def transfer_key(src_wal_dir: str, dst_wal_dir: str, key) -> dict:
+    """Copy one key's durable state — WAL segments + frozen
+    checkpoint pair — from a (dead or draining) replica's WAL dir into
+    the new owner's. Pure file copy: the source is never mutated (a
+    crashed replica's dir is evidence; the operator removes it after
+    the fleet is green), and the destination files land under the
+    same deterministic names ``adopt_keys``'s recovery scan reads.
+    Returns ``{"segments": n, "checkpoint": bool}``."""
+    os.makedirs(dst_wal_dir, exist_ok=True)
+    segs = DeltaWAL(src_wal_dir).segments(key)
+    for path in segs:
+        shutil.copy2(path, os.path.join(dst_wal_dir,
+                                        os.path.basename(path)))
+    stem = _safe_name(key)
+    has_cp = False
+    src_cps = os.path.join(src_wal_dir, "checkpoints")
+    for ext in (".json", ".npz"):
+        p = os.path.join(src_cps, stem + ext)
+        if os.path.exists(p):
+            dst_cps = os.path.join(dst_wal_dir, "checkpoints")
+            os.makedirs(dst_cps, exist_ok=True)
+            shutil.copy2(p, os.path.join(dst_cps, stem + ext))
+            has_cp = True
+    obs.counter("serve.ring.keys_transferred").inc()
+    return {"segments": len(segs), "checkpoint": has_cp}
+
+
+def rehome_dead_replica(dead_wal_dir: str, ring: HashRing,
+                        dead_node: str,
+                        wal_dirs: Dict[str, str],
+                        services: Optional[Dict[str, object]] = None) \
+        -> Dict[str, list]:
+    """Re-home every key a dead replica's WAL holds onto the
+    survivors: drop the node from the ring, transfer each key's
+    segments + checkpoint to its new owner's WAL dir, and (when the
+    survivor services are in hand) ``adopt_keys`` so they go live
+    immediately. Returns the new node -> [key, ...] assignment.
+
+    The WAL is the ground truth by construction: everything the dead
+    replica ever ACKNOWLEDGED is in it (WAL-before-ack), so the
+    survivors' replay reaches exactly the acknowledged stream — a
+    kill -9 loses only never-promised work, and re-submitted
+    in-flight deltas dedupe by seq."""
+    ring.remove(dead_node)
+    keys = DeltaWAL(dead_wal_dir).keys()
+    plan = ring.assignments(keys)
+    for node, node_keys in plan.items():
+        dst = wal_dirs[node]
+        for key in node_keys:
+            transfer_key(dead_wal_dir, dst, key)
+        _log.info("rehome: %d key(s) from dead %r -> %r",
+                  len(node_keys), dead_node, node)
+    if services:
+        for node in plan:
+            svc = services.get(node)
+            if svc is not None:
+                svc.adopt_keys()
+    obs.counter("serve.ring.rehomes").inc()
+    return plan
+
+
+# ------------------------------------------------------------ router
+
+
+class Router:
+    """A thin fleet front for in-process replica sets (the soak
+    harness and tests; a network deployment routes in the client or a
+    proxy with the same :class:`HashRing` math): submit/result/
+    finalize forward to the owning replica, ``kill`` + ``rehome``
+    replay a crash, ``migrate_key`` is the graceful freeze-first
+    move."""
+
+    def __init__(self, services: Dict[str, object],
+                 wal_dirs: Dict[str, str],
+                 vnodes: int = DEFAULT_VNODES):
+        if set(services) != set(wal_dirs):
+            raise ValueError("services and wal_dirs must name the "
+                             "same replicas")
+        self.services = dict(services)
+        self.wal_dirs = dict(wal_dirs)
+        self.ring = HashRing(sorted(services), vnodes=vnodes)
+
+    def owner(self, key) -> str:
+        return self.ring.owner(key)
+
+    def submit(self, key, ops, **kw):
+        return self.services[self.ring.owner(key)].submit(key, ops,
+                                                          **kw)
+
+    def result(self, key, **kw):
+        return self.services[self.ring.owner(key)].result(key, **kw)
+
+    def finalize(self, key, **kw):
+        return self.services[self.ring.owner(key)].finalize(key, **kw)
+
+    def rehome(self, dead_node: str) -> Dict[str, list]:
+        """Crash path: the node is gone (already killed/closed);
+        survivors adopt its WAL."""
+        dead_dir = self.wal_dirs.pop(dead_node)
+        self.services.pop(dead_node, None)
+        return rehome_dead_replica(dead_dir, self.ring, dead_node,
+                                   self.wal_dirs, self.services)
+
+    def migrate_key(self, key, dst_node: str) -> dict:
+        """Graceful path: freeze the key's live frontier on its
+        current owner (drain first — the source must not be applying),
+        transfer, adopt on the destination. The ring is NOT changed —
+        this is an operator move (drain-for-maintenance), and the
+        caller re-points producers."""
+        src_node = self.ring.owner(key)
+        if src_node == dst_node:
+            return {"noop": True, "node": src_node}
+        src = self.services[src_node]
+        src.drain(timeout=60)
+        src.freeze_key(key)
+        r = transfer_key(self.wal_dirs[src_node],
+                         self.wal_dirs[dst_node], key)
+        self.services[dst_node].adopt_keys()
+        r["from"], r["to"] = src_node, dst_node
+        return r

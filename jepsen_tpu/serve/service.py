@@ -32,10 +32,23 @@ Robustness is the headline property, by construction:
   engine with the structured ``resilience`` note — verdicts never
   flip (docs/resilience.md).
 
+* **Tenant isolation** (``serve.tenancy``; off when no tenants are
+  configured — then everything below is byte-identical to the
+  single-tenant service). Every submit resolves to a tenant (token or
+  name); keys are owned by the tenant that admitted them; per-tenant
+  pending-ops / key-count / WAL-bytes quotas shed a flooding tenant
+  *immediately* with ``{"shed": ..., "tenant": ...}`` while other
+  tenants keep admitting; the worker drains tenants by deficit
+  round-robin so device time follows weights, not arrival order; and
+  the ``serve.ack_secs``/``verdict_secs`` SLO histograms grow
+  per-tenant labeled twins so /metrics answers "which tenant is slow
+  and who caused it".
+
 Threading: producers call ``submit``/``result`` from any thread; one
 worker thread owns every session and the device. ``asyncio`` fronts
 wrap the blocking calls with ``run_in_executor`` (the bounded
-``submit`` IS the backpressure; see docs/streaming.md).
+``submit`` IS the backpressure; ``serve.ingress`` is that front —
+see docs/streaming.md).
 """
 
 from __future__ import annotations
@@ -50,6 +63,7 @@ from typing import Dict, Optional
 from jepsen_tpu import edn, envflags, obs
 from jepsen_tpu.history import TYPES
 from jepsen_tpu.parallel import extend as ext
+from jepsen_tpu.serve import tenancy
 from jepsen_tpu.serve.wal import CheckpointStore, DeltaWAL
 
 _log = logging.getLogger(__name__)
@@ -111,10 +125,13 @@ class _Key:
                  "last_result", "last_activity", "finalized",
                  "finalize_requested", "needs_check", "pending_ops",
                  "wal_next", "broken", "wal_dead", "acct",
-                 "pending_times")
+                 "pending_times", "tenant")
 
-    def __init__(self, key):
+    def __init__(self, key, tenant: str = tenancy.DEFAULT_TENANT):
         self.key = key
+        self.tenant = tenant   # the admitting tenant owns the key for
+        # life: cross-tenant submits are refused (isolation), and the
+        # name is the WAL-header stamp recovery re-homes by
         self.session = None
         self.pending: deque = deque()     # (seq, [Op, ...])
         self.enq_seq = 0
@@ -143,10 +160,41 @@ class _Key:
         # acknowledged delta) — producers get durable=False answers
 
 
+class _TenantState:
+    """Per-tenant admission accounting (multi-tenant mode only);
+    every field is guarded by the service condition. ``bound`` is the
+    tenant's effective pending-ops quota (0 = unlimited), ``deficit``
+    its deficit-round-robin credit in ops — refilled ``weight x
+    quantum`` per worker cycle with backlog, spent as the batch takes
+    deltas (debt allowed so an oversized delta still drains), reset
+    when the tenant's queues empty (no banking while idle)."""
+
+    __slots__ = ("name", "weight", "bound", "max_keys",
+                 "max_wal_bytes", "pending_ops", "keys", "wal_bytes",
+                 "deficit", "acct")
+
+    def __init__(self, tenant: tenancy.Tenant, bound: int):
+        self.name = tenant.name
+        self.weight = tenant.weight
+        self.bound = bound
+        self.max_keys = tenant.max_keys
+        self.max_wal_bytes = tenant.max_wal_bytes
+        self.pending_ops = 0
+        self.keys = 0
+        self.wal_bytes = 0
+        self.deficit = 0
+        self.acct = {"deltas": 0, "ops": 0, "sheds": 0}
+
+
 class CheckerService:
     """The streaming checker (module docstring). Construct, submit
     deltas, read results; ``close(drain=True)`` is the graceful
-    shutdown. Usable as a context manager."""
+    shutdown. Usable as a context manager.
+
+    ``tenants`` opts into multi-tenant mode: a ``tenancy.TenantTable``,
+    a list of ``tenancy.Tenant``, or None to read
+    ``JEPSEN_TPU_TENANTS`` (unset = single-tenant, the historical
+    behavior, byte-identical)."""
 
     def __init__(self, model, wal_dir: Optional[str] = None, *,
                  capacity: int = 1024, max_capacity: int = 1 << 20,
@@ -157,6 +205,7 @@ class CheckerService:
                  global_bound: Optional[int] = None,
                  high_water: Optional[int] = None,
                  evict_idle_secs: Optional[float] = None,
+                 tenants=None, drr_quantum: Optional[int] = None,
                  recover: bool = True, start_worker: bool = True,
                  clock=time.monotonic):
         self.model = model
@@ -172,6 +221,22 @@ class CheckerService:
         self.high_water = _resolve_high_water(high_water,
                                               self.global_bound)
         self.evict_idle_secs = _resolve_evict_secs(evict_idle_secs)
+        if tenants is None:
+            tenants = tenancy.resolve_tenants()
+        elif isinstance(tenants, (list, tuple)):
+            tenants = tenancy.TenantTable(list(tenants))
+        self._tenants: Optional[tenancy.TenantTable] = tenants
+        self._tstate: Dict[str, _TenantState] = {}
+        self._drr_idx = 0
+        self._drr_quantum = (int(drr_quantum) if drr_quantum
+                             else tenancy.resolve_quantum()
+                             if tenants is not None else 0)
+        if tenants is not None:
+            budget = self.high_water or self.global_bound
+            for name in tenants.names():
+                self._tstate[name] = _TenantState(
+                    tenants.get(name),
+                    tenants.pending_bound(name, budget))
         self._clock = clock
         self._wal = DeltaWAL(wal_dir) if wal_dir else None
         self._cps = (CheckpointStore(wal_dir + "/checkpoints")
@@ -204,9 +269,67 @@ class CheckerService:
 
     # ------------------------------------------------- producer API
 
+    def _resolve_tenant(self, tenant: Optional[str],
+                        token: Optional[str]):
+        """(tenant name, None) or (None, error dict). Single-tenant
+        mode maps everything onto the implicit default tenant; with a
+        table configured a token wins over a name (the transports
+        authenticate by token; a bare name is the trusted in-process
+        path), and an unidentified producer is refused — tenancy on
+        means auth on."""
+        if self._tenants is None:
+            return tenancy.DEFAULT_TENANT, None
+        if token is not None:
+            t = self._tenants.by_token(token)
+            if t is None:
+                return None, {"error": "unauthorized: unknown tenant "
+                                       "token"}
+            return t.name, None
+        if tenant is not None:
+            if self._tenants.get(tenant) is None \
+                    and tenant not in self._tstate:
+                return None, {"error": f"unknown tenant {tenant!r}"}
+            return tenant, None
+        return None, {"error": "tenant required: the service is "
+                               "multi-tenant — authenticate with a "
+                               "tenant token (or name, in-process)"}
+
+    def _tenant_state_locked(self, name: str) -> \
+            Optional[_TenantState]:
+        """The tenant's admission state (multi-tenant mode), minted
+        ad hoc for a recovered key whose tenant left today's table —
+        acknowledged data is never orphaned by a config change."""
+        if self._tenants is None:
+            return None
+        ts = self._tstate.get(name)
+        if ts is None:
+            budget = self.high_water or self.global_bound
+            bound = max(1, budget
+                        // max(1, self._tenants.total_weight + 1))
+            ts = self._tstate[name] = _TenantState(
+                tenancy.Tenant(name=name), bound)
+        return ts
+
+    def _shed_locked(self, ks: Optional["_Key"],
+                     ts: Optional[_TenantState], reason: str,
+                     key) -> dict:
+        """Build one structured shed answer + its accounting (callers
+        hold the service condition and return/dump outside it)."""
+        obs.counter("serve.sheds").inc()
+        if ks is not None:
+            ks.acct["sheds"] += 1
+        out = {"shed": True, "reason": reason, "key": key}
+        if ts is not None:
+            ts.acct["sheds"] += 1
+            obs.counter(obs.labeled("serve.sheds",
+                                    tenant=ts.name)).inc()
+            out["tenant"] = ts.name
+        return out
+
     def submit(self, key, ops, seq: Optional[int] = None,
                timeout: Optional[float] = None,
-               wait: bool = False) -> dict:
+               wait: bool = False, tenant: Optional[str] = None,
+               token: Optional[str] = None) -> dict:
         """Admit one delta for ``key``. Returns one of::
 
             {"accepted": True, "seq": n, "key": k}
@@ -217,13 +340,25 @@ class CheckerService:
         Blocks (backpressure) while the key's queue or the global
         backlog is full, up to ``timeout`` seconds (then sheds). With
         ``wait=True``, additionally blocks until this delta's verdict
-        is computed and returns it (the smoke-test convenience)."""
+        is computed and returns it (the smoke-test convenience).
+
+        Multi-tenant mode (``tenants`` configured): ``token`` (or the
+        in-process ``tenant`` name) identifies the producer; shed and
+        accepted answers carry ``"tenant"``; a tenant past its
+        pending-ops / key-count / WAL-bytes quota is shed IMMEDIATELY
+        (no blocking — a flooding tenant must not camp on the queue
+        other tenants feed), while the global-bound backpressure
+        below still blocks fairly."""
         ops = list(ops)
         for o in ops:
             t = o.get("type") if hasattr(o, "get") else None
             if t not in TYPES:
                 return {"error": f"delta op {o!r}: type must be one of "
                                  f"{TYPES}", "key": key}
+        tname, auth_err = self._resolve_tenant(tenant, token)
+        if auth_err is not None:
+            obs.counter("serve.unauthorized").inc()
+            return {**auth_err, "key": key}
         t_in = self._clock()
         deadline = None if timeout is None else t_in + timeout
         shed = None   # set instead of returning inside the lock: the
@@ -231,15 +366,34 @@ class CheckerService:
         # run AFTER the service lock is released (the same reason the
         # WAL fsync below runs outside it)
         with self._cond:
+            ts = self._tenant_state_locked(tname)
             ks = self._keys.get(key)
             if ks is None:
-                ks = self._keys[key] = _Key(key)
-                obs.counter("serve.keys_admitted").inc()
+                if ts is not None and ts.max_keys \
+                        and ts.keys >= ts.max_keys:
+                    # refused BEFORE minting the key: a quota'd tenant
+                    # must not grow the key table it is over-budget on
+                    shed = self._shed_locked(
+                        None, ts,
+                        f"tenant {tname!r} key quota ({ts.keys} >= "
+                        f"{ts.max_keys})", key)
+                else:
+                    ks = self._keys[key] = _Key(key, tenant=tname)
+                    if ts is not None:
+                        ts.keys += 1
+                    obs.counter("serve.keys_admitted").inc()
+            if shed is None and ks.tenant != tname:
+                # tenant isolation: a key belongs to the tenant that
+                # admitted it — no cross-tenant appends, no
+                # cross-tenant seq probing
+                return {"error": f"key is owned by another tenant "
+                                 f"(not {tname!r})", "key": key,
+                        "tenant": tname}
             # validate-then-wait-then-REVALIDATE: every check runs
             # again after a cond.wait released the lock — a concurrent
             # producer may have taken the seq or finalized the key
             # while this one slept
-            while True:
+            while shed is None:
                 if ks.broken:
                     return {"error": "key state was lost to a worker "
                                      "crash and no WAL is configured "
@@ -257,36 +411,63 @@ class CheckerService:
                     return {"error": f"sequence gap: expected "
                                      f"{ks.enq_seq + 1}, got {my_seq}",
                             "key": key}
+                if ts is not None and ts.max_wal_bytes \
+                        and ts.wal_bytes > ts.max_wal_bytes:
+                    # before shedding, re-sync the meter from disk:
+                    # the in-memory count only ever grows, but the
+                    # documented operator relief is archiving/deleting
+                    # rotated segments — stat() the tenant's files so
+                    # that relief actually lifts the quota without a
+                    # process restart (one sweep per over-quota
+                    # attempt, bounded by the tenant's key count)
+                    if self._wal is not None:
+                        ts.wal_bytes = sum(
+                            self._wal.size_bytes(k.key)
+                            for k in self._keys.values()
+                            if k.tenant == tname)
+                    if ts.wal_bytes > ts.max_wal_bytes:
+                        shed = self._shed_locked(
+                            ks, ts,
+                            f"tenant {tname!r} WAL-bytes quota "
+                            f"({ts.wal_bytes} > {ts.max_wal_bytes})",
+                            key)
+                        break
+                if ts is not None and ts.bound \
+                        and ts.pending_ops + len(ops) > ts.bound:
+                    # the weighted-fair line: this tenant is past its
+                    # share, so it sheds NOW — the global queue keeps
+                    # room for every other tenant's deltas, which is
+                    # exactly why the quiet tenant's ack SLO holds
+                    # under someone else's flood
+                    shed = self._shed_locked(
+                        ks, ts,
+                        f"tenant {tname!r} pending-ops quota "
+                        f"({ts.pending_ops}+{len(ops)} > {ts.bound})",
+                        key)
+                    break
                 if self.high_water \
                         and self._pending_ops + len(ops) \
                         > self.high_water:
-                    obs.counter("serve.sheds").inc()
-                    ks.acct["sheds"] += 1
-                    shed = {"shed": True,
-                            "reason": f"pending ops past high-water "
-                                      f"({self._pending_ops}+"
-                                      f"{len(ops)} > "
-                                      f"{self.high_water})",
-                            "key": key}
+                    shed = self._shed_locked(
+                        ks, ts,
+                        f"pending ops past high-water "
+                        f"({self._pending_ops}+{len(ops)} > "
+                        f"{self.high_water})", key)
                     break
                 if len(ks.pending) < self.per_key_queue \
                         and self._pending_ops + len(ops) \
                         <= self.global_bound:
                     break   # admitted
                 if self._stop:
-                    obs.counter("serve.sheds").inc()
-                    ks.acct["sheds"] += 1
-                    shed = {"shed": True, "reason": "service stopping",
-                            "key": key}
+                    shed = self._shed_locked(ks, ts,
+                                             "service stopping", key)
                     break
                 rem = (None if deadline is None
                        else deadline - self._clock())
                 if rem is not None and rem <= 0:
-                    obs.counter("serve.sheds").inc()
-                    ks.acct["sheds"] += 1
-                    shed = {"shed": True,
-                            "reason": "backpressure timeout "
-                                      "(queue full)", "key": key}
+                    shed = self._shed_locked(
+                        ks, ts, "backpressure timeout (queue full)",
+                        key)
                     break
                 self._cond.wait(0.5 if rem is None else min(rem, 0.5))
             if shed is None:
@@ -307,6 +488,15 @@ class CheckerService:
                 obs.counter("serve.deltas").inc()
                 obs.counter("serve.delta_ops").inc(len(ops))
                 obs.gauge("serve.pending_ops").set(self._pending_ops)
+                if ts is not None:
+                    ts.pending_ops += len(ops)
+                    ts.acct["deltas"] += 1
+                    ts.acct["ops"] += len(ops)
+                    obs.counter(obs.labeled(
+                        "serve.deltas", tenant=tname)).inc()
+                    obs.gauge(obs.labeled(
+                        "serve.pending_ops",
+                        tenant=tname)).set(ts.pending_ops)
                 # Perfetto counter track: queue depth over time lines
                 # up with the stream/dispatch spans (no-op untraced)
                 obs.counter_sample("serve.pending_ops",
@@ -348,7 +538,9 @@ class CheckerService:
                     self._cond.notify_all()
             if durable:
                 try:
-                    self._wal.append(key, my_seq, ops)
+                    nbytes = self._wal.append(
+                        key, my_seq, ops,
+                        tenant=(tname if ts is not None else None))
                 except Exception as err:  # noqa: BLE001 — a failed
                     # append must not hold the handoff or hide the
                     # durability loss from the producer
@@ -363,33 +555,72 @@ class CheckerService:
                 else:
                     with self._cond:
                         ks.wal_next = my_seq + 1
+                        if ts is not None:
+                            # the WAL-bytes quota meter: the tenant
+                            # pays for every byte its keys fsync
+                            ts.wal_bytes += nbytes
                         self._cond.notify_all()
         # ingest->ack SLO: admission (incl. backpressure wait) through
         # WAL durability — the producer-visible accept latency
-        obs.histogram("serve.ack_secs").observe(
-            max(0.0, self._clock() - t_in))
+        ack = max(0.0, self._clock() - t_in)
+        obs.histogram("serve.ack_secs").observe(ack)
+        if ts is not None:
+            # the per-tenant SLO twin (/metrics renders it as a real
+            # {tenant="..."} label on the same histogram name)
+            obs.histogram(obs.labeled("serve.ack_secs",
+                                      tenant=tname)).observe(ack)
         if wait:
             rem = None if deadline is None else deadline - self._clock()
-            r = self.result(key, min_seq=my_seq, timeout=rem)
+            r = self.result(key, min_seq=my_seq, timeout=rem,
+                            tenant=tname)
             if not durable and self._wal is not None:
                 r["durable"] = False
             return r
         out = {"accepted": True, "seq": my_seq, "key": key}
+        if ts is not None:
+            out["tenant"] = tname
         if not durable and self._wal is not None:
             obs.counter("serve.nondurable_acks").inc()
             out["durable"] = False
         return out
 
+    def _own_key_locked(self, key, tenant: Optional[str],
+                        token: Optional[str]):
+        """(ks, None) or (None, error dict): lookup + tenant ownership
+        for the read paths. With tenants configured EVERY caller must
+        identify itself (token from the transports, tenant name from
+        trusted in-process code) and only sees its own keys —
+        result/finalize are not a side door around the auth submit
+        enforces (a tokenless stdio line could otherwise read or SEAL
+        another tenant's key). Single-tenant mode keeps the
+        historical unauthenticated view."""
+        ks = self._keys.get(key)
+        if ks is None:
+            return None, {"error": "unknown key", "key": key}
+        if self._tenants is None:
+            return ks, None
+        tname, err = self._resolve_tenant(tenant, token)
+        if err is not None:
+            obs.counter("serve.unauthorized").inc()
+            return None, {**err, "key": key}
+        if ks.tenant != tname:
+            return None, {"error": f"key is owned by another tenant "
+                                   f"(not {tname!r})", "key": key,
+                          "tenant": tname}
+        return ks, None
+
     def result(self, key, min_seq: Optional[int] = None,
-               timeout: Optional[float] = None) -> dict:
+               timeout: Optional[float] = None,
+               tenant: Optional[str] = None,
+               token: Optional[str] = None) -> dict:
         """The verdict covering the key's applied deltas; blocks until
         at least ``min_seq`` (default: everything enqueued so far) has
         been applied."""
         deadline = None if timeout is None else self._clock() + timeout
         with self._cond:
-            ks = self._keys.get(key)
-            if ks is None:
-                return {"error": "unknown key", "key": key}
+            ks, err = self._own_key_locked(key, tenant, token)
+            if err is not None:
+                return err
             target = ks.enq_seq if min_seq is None else int(min_seq)
             while ks.applied_seq < target or ks.last_result is None \
                     or ks.needs_check:
@@ -404,15 +635,17 @@ class CheckerService:
             r["key"] = key
             return r
 
-    def finalize(self, key, timeout: Optional[float] = None) -> dict:
+    def finalize(self, key, timeout: Optional[float] = None,
+                 tenant: Optional[str] = None,
+                 token: Optional[str] = None) -> dict:
         """Drain the key's pending deltas, run the final check
         (counterexample extraction included), and seal the key —
         further deltas get ``{"error": "key is finalized"}``."""
         deadline = None if timeout is None else self._clock() + timeout
         with self._cond:
-            ks = self._keys.get(key)
-            if ks is None:
-                return {"error": "unknown key", "key": key}
+            ks, err = self._own_key_locked(key, tenant, token)
+            if err is not None:
+                return err
             ks.finalize_requested = True
             self._cond.notify_all()
             while not ks.finalized:
@@ -488,8 +721,14 @@ class CheckerService:
             wal_lag = sum(ks.enq_seq - (ks.wal_next - 1)
                           for ks in self._keys.values()) \
                 if self._wal is not None else 0
+            tpending = {name: ts.pending_ops
+                        for name, ts in self._tstate.items()} \
+                if self._tenants is not None else {}
         obs.gauge("serve.pending_ops").set(pending)
         obs.gauge("serve.keys_live").set(live)
+        for name, v in tpending.items():
+            obs.gauge(obs.labeled("serve.pending_ops",
+                                  tenant=name)).set(v)
         if self._wal is not None:
             # admitted deltas whose WAL bytes have not landed yet —
             # nonzero is producers outrunning fsync; growing is a
@@ -525,6 +764,8 @@ class CheckerService:
                     "wal_dead": ks.wal_dead,
                     "acct": dict(ks.acct),
                 }
+                if self._tenants is not None:
+                    row["tenant"] = ks.tenant
                 if r.get("stats"):
                     # JEPSEN_TPU_SEARCH_STATS: the key's lifetime
                     # search telemetry, trajectories summarized (the
@@ -547,6 +788,16 @@ class CheckerService:
                                     if k.session is not None),
                    "worker_alive": self._worker is not None
                    and self._worker.is_alive()}
+            trows = {name: {"weight": ts.weight,
+                            "pending_ops": ts.pending_ops,
+                            "pending_bound": ts.bound,
+                            "keys": ts.keys,
+                            "max_keys": ts.max_keys,
+                            "wal_bytes": ts.wal_bytes,
+                            "max_wal_bytes": ts.max_wal_bytes,
+                            "acct": dict(ts.acct)}
+                     for name, ts in self._tstate.items()} \
+                if self._tenants is not None else None
         # WAL sizes are filesystem reads — outside the service lock
         keys = {}
         for key, row in rows:
@@ -554,6 +805,19 @@ class CheckerService:
                 row["wal_bytes"] = self._wal.size_bytes(key)
             keys[edn.dumps(key)] = row
         doc["keys"] = keys
+        if trows is not None:
+            # the per-tenant SLO answer, readable without a /metrics
+            # scrape: quantiles straight from the labeled histograms
+            snap = obs.registry().snapshot()
+            for name, t in trows.items():
+                for which in ("ack", "verdict"):
+                    h = snap.get(obs.labeled(f"serve.{which}_secs",
+                                             tenant=name))
+                    t[f"{which}_p50"] = (obs.hist_quantile(h, 0.5)
+                                         if h else None)
+                    t[f"{which}_p99"] = (obs.hist_quantile(h, 0.99)
+                                         if h else None)
+            doc["tenants"] = trows
         return doc
 
     def health(self) -> dict:
@@ -599,6 +863,53 @@ class CheckerService:
 
     # ------------------------------------------------------ recovery
 
+    def _recover_key(self, key):
+        """Build one key's state from its WAL segments + evicted
+        checkpoint (no shared-state mutation — the caller installs
+        under the condition). Returns (ks, wal_bytes) or None."""
+        deltas = self._wal.replay(key)
+        if not deltas:
+            return None
+        head = self._wal.header(key) or {}
+        tname = (head.get("tenant") or tenancy.DEFAULT_TENANT) \
+            if self._tenants is not None else tenancy.DEFAULT_TENANT
+        cp, meta = (self._cps.load(key) if self._cps is not None
+                    else (None, None))
+        applied = int(meta.get("applied_seq", 0)) if meta else 0
+        base = [op for seq, ops in deltas if seq <= applied
+                for op in ops]
+        rest = [(seq, ops) for seq, ops in deltas if seq > applied]
+        ks = _Key(key, tenant=tname)
+        sess = self._new_session(key)
+        if base:
+            with obs.span("serve.thaw", key=str(key)):
+                sess.thaw(base, cp)
+            ks.applied_seq = applied
+            ks.needs_check = True
+        ks.session = sess
+        if meta and meta.get("finalized"):
+            ks.finalize_requested = True
+        ks.enq_seq = deltas[-1][0]
+        ks.wal_next = deltas[-1][0] + 1
+        ks.pending.extend(rest)
+        ks.pending_ops = sum(len(ops) for _, ops in rest)
+        ks.last_activity = self._clock()
+        ks.acct["replays"] = len(deltas)
+        return ks, self._wal.size_bytes(key)
+
+    def _install_recovered_locked(self, ks: _Key,
+                                  wal_bytes: int) -> None:
+        """Admit a rebuilt key into the live tables (callers hold the
+        condition, or run pre-worker where no one else can)."""
+        self._keys[ks.key] = ks
+        self._pending_ops += ks.pending_ops
+        ts = self._tenant_state_locked(ks.tenant)
+        if ts is not None:
+            ts.keys += 1
+            ts.pending_ops += ks.pending_ops
+            ts.wal_bytes += wal_bytes
+        obs.counter("serve.replayed_deltas").inc(ks.acct["replays"])
+
     def _recover(self) -> None:
         """Rebuild every key from its WAL (synchronously, before the
         worker starts): replay is deterministic, so the recomputed
@@ -606,37 +917,50 @@ class CheckerService:
         checkpoint, when present and digest-matched, spares the replay
         its device re-scan of the settled prefix."""
         for key in self._wal.keys():
-            deltas = self._wal.replay(key)
-            if not deltas:
+            built = self._recover_key(key)
+            if built is None:
                 continue
-            cp, meta = (self._cps.load(key) if self._cps is not None
-                        else (None, None))
-            applied = int(meta.get("applied_seq", 0)) if meta else 0
-            base = [op for seq, ops in deltas if seq <= applied
-                    for op in ops]
-            rest = [(seq, ops) for seq, ops in deltas if seq > applied]
-            ks = _Key(key)
-            sess = self._new_session(key)
-            if base:
-                with obs.span("serve.thaw", key=str(key)):
-                    sess.thaw(base, cp)
-                ks.applied_seq = applied
-                ks.needs_check = True
-            ks.session = sess
-            if meta and meta.get("finalized"):
-                ks.finalize_requested = True
-            ks.enq_seq = deltas[-1][0]
-            ks.wal_next = deltas[-1][0] + 1
-            ks.pending.extend(rest)
-            ks.pending_ops = sum(len(ops) for _, ops in rest)
-            self._pending_ops += ks.pending_ops
-            ks.last_activity = self._clock()
-            ks.acct["replays"] = len(deltas)
-            self._keys[key] = ks
-            obs.counter("serve.replayed_deltas").inc(len(deltas))
+            self._install_recovered_locked(*built)
         if self._keys:
             _log.info("serve: recovered %d key(s) from the WAL",
                       len(self._keys))
+
+    def adopt_keys(self) -> list:
+        """Recover any WAL keys not yet admitted, LIVE — the replica
+        handoff entry point. ``serve.ring.transfer_key`` copies a dead
+        (or draining) replica's WAL segments and frozen checkpoint
+        pair into this service's wal_dir; this call replays them into
+        running sessions exactly like a restart would, so the migrated
+        keys' verdicts stay bit-identical to an unmigrated check
+        (the PR 7 recovery contract, cross-process). Returns the
+        adopted keys."""
+        if self._wal is None:
+            raise RuntimeError("adopt_keys needs a WAL-backed service")
+        adopted = []
+        for key in self._wal.keys():
+            with self._cond:
+                if key in self._keys:
+                    continue
+            built = self._recover_key(key)   # heavy (replay + thaw):
+            # outside the lock so live producers keep admitting
+            if built is None:
+                continue
+            with self._cond:
+                if key in self._keys:
+                    # a producer raced the handoff and minted the key
+                    # fresh — keep the live one; the operator re-runs
+                    # adopt after quiescing that producer
+                    _log.warning("adopt_keys: key %r appeared during "
+                                 "replay — keeping the live key", key)
+                    continue
+                self._install_recovered_locked(*built)
+                self._cond.notify_all()
+            adopted.append(key)
+            obs.counter("serve.adopted_keys").inc()
+        if adopted:
+            _log.info("serve: adopted %d key(s) from transferred WAL "
+                      "segments", len(adopted))
+        return adopted
 
     # -------------------------------------------------- worker side
 
@@ -673,30 +997,102 @@ class CheckerService:
                    for ks in self._keys.values())
 
     def _take_work_locked(self) -> list:
-        """Pop every key's pending deltas (coalesced, seq order) and
-        settle the backpressure accounting HERE — ops leave the queue
-        exactly once, so no later error path can double-decrement.
-        In-flight work is bounded by what the queue admitted."""
+        """Pop pending deltas (coalesced, seq order) and settle the
+        backpressure accounting HERE — ops leave the queue exactly
+        once, so no later error path can double-decrement. In-flight
+        work is bounded by what the queue admitted.
+
+        Single-tenant mode takes everything (the historical FIFO
+        drain). Multi-tenant mode is deficit round-robin: every
+        backlogged tenant banks ``weight x quantum`` ops of credit per
+        cycle and the batch takes whole deltas while credit lasts
+        (debt allowed so an oversized delta still drains — the tenant
+        then skips cycles until refills repay it), so device time
+        tracks weights even when one tenant's queues are always
+        full."""
+        if self._tenants is None:
+            batch = []
+            for ks in self._keys.values():
+                if not (ks.pending or ks.needs_check
+                        or (ks.finalize_requested
+                            and not ks.finalized)):
+                    continue
+                ops = []
+                last_seq = None
+                while ks.pending:
+                    seq, dops = ks.pending.popleft()
+                    ops.extend(dops)
+                    last_seq = seq
+                ks.pending_ops -= len(ops)
+                self._pending_ops -= len(ops)
+                final = ks.finalize_requested and not ks.finalized
+                batch.append((ks, ops, last_seq, final))
+            if batch:
+                obs.gauge("serve.pending_ops").set(self._pending_ops)
+                obs.counter_sample("serve.pending_ops",
+                                   self._pending_ops)
+                self._cond.notify_all()   # queue space freed: release
+                # blocked producers now, not after the device work
+            return batch
+        return self._take_drr_locked()
+
+    def _take_drr_locked(self) -> list:
         batch = []
+        by_tenant: Dict[str, list] = {}
         for ks in self._keys.values():
-            if not (ks.pending or ks.needs_check
-                    or (ks.finalize_requested and not ks.finalized)):
+            if ks.pending or ks.needs_check \
+                    or (ks.finalize_requested and not ks.finalized):
+                by_tenant.setdefault(ks.tenant, []).append(ks)
+        names = sorted(self._tstate)
+        if names:
+            # rotate the starting tenant each cycle so ties don't
+            # always break for the alphabetically first name
+            start = self._drr_idx % len(names)
+            self._drr_idx += 1
+            names = names[start:] + names[:start]
+        took_ops = 0
+        for tname in names:
+            ts = self._tstate[tname]
+            keys = by_tenant.get(tname, ())
+            if not keys:
+                ts.deficit = 0   # classic DRR: no banking while idle
                 continue
-            ops = []
-            last_seq = None
-            while ks.pending:
-                seq, dops = ks.pending.popleft()
-                ops.extend(dops)
-                last_seq = seq
-            ks.pending_ops -= len(ops)
-            self._pending_ops -= len(ops)
-            final = ks.finalize_requested and not ks.finalized
-            batch.append((ks, ops, last_seq, final))
-        if batch:
+            if any(ks.pending for ks in keys):
+                ts.deficit += ts.weight * self._drr_quantum
+            for ks in keys:
+                ops = []
+                last_seq = None
+                while ks.pending and ts.deficit > 0:
+                    seq, dops = ks.pending.popleft()
+                    ops.extend(dops)
+                    last_seq = seq
+                    ts.deficit -= len(dops)
+                # finalize only once the key's queue is EMPTY: a
+                # deficit that ran out mid-drain must not seal the
+                # key over acknowledged-but-unapplied deltas (the
+                # final verdict is bit-identical to one-shot only if
+                # it covers everything admitted) — the leftover
+                # drains next cycle and the finalize fires then
+                final = ks.finalize_requested and not ks.finalized \
+                    and not ks.pending
+                if not (ops or ks.needs_check or final):
+                    continue
+                if ops:
+                    ks.pending_ops -= len(ops)
+                    self._pending_ops -= len(ops)
+                    ts.pending_ops -= len(ops)
+                    took_ops += len(ops)
+                    obs.gauge(obs.labeled(
+                        "serve.pending_ops",
+                        tenant=tname)).set(ts.pending_ops)
+                batch.append((ks, ops, last_seq, final))
+            if not any(ks.pending for ks in keys):
+                ts.deficit = 0
+        if took_ops:
             obs.gauge("serve.pending_ops").set(self._pending_ops)
             obs.counter_sample("serve.pending_ops", self._pending_ops)
-            self._cond.notify_all()   # queue space freed: release
-            # blocked producers now, not after the device work
+        if batch:
+            self._cond.notify_all()
         return batch
 
     def _observe_verdicts_locked(self, ks: _Key) -> None:
@@ -705,10 +1101,16 @@ class CheckerService:
         service condition)."""
         now = self._clock()
         h = obs.histogram("serve.verdict_secs")
+        ht = (obs.histogram(obs.labeled("serve.verdict_secs",
+                                        tenant=ks.tenant))
+              if self._tenants is not None else None)
         while ks.pending_times and ks.pending_times[0][0] \
                 <= ks.applied_seq:
             _seq, t_in = ks.pending_times.popleft()
-            h.observe(max(0.0, now - t_in))
+            v = max(0.0, now - t_in)
+            h.observe(v)
+            if ht is not None:
+                ht.observe(v)
 
     def _crashed_entry(self, ks: _Key, err) -> dict:
         """Per-entry failure isolation: a loud error verdict, and the
@@ -787,6 +1189,47 @@ class CheckerService:
                 ks.last_activity = self._clock()
             self._cond.notify_all()
 
+    def _freeze_session(self, ks: _Key, locked: bool = False) -> None:
+        """Freeze one key's live frontier to the checkpoint store and
+        drop the in-memory session. Eviction (worker thread — the
+        only session toucher, so it freezes lock-free) and graceful
+        migration (any thread — the caller HOLDS the condition for
+        the whole freeze so the worker cannot pick the key up
+        mid-write) share this."""
+        with obs.span("serve.evict", key=str(ks.key)):
+            meta = ks.session.freeze(
+                self._cps.checkpoint_path(ks.key))
+        meta["applied_seq"] = ks.applied_seq
+        meta["finalized"] = ks.finalized
+        self._cps.save(ks.key, meta)
+        if locked:
+            ks.session = None
+        else:
+            with self._cond:
+                ks.session = None
+        obs.counter("serve.evictions").inc()
+
+    def freeze_key(self, key) -> bool:
+        """Freeze one key NOW (the graceful-migration primitive —
+        ``serve.ring`` transfers the checkpoint pair + WAL segments
+        and the new owner thaws instead of re-scanning). False when
+        there is nothing to freeze: no checkpoint store, no live
+        session, or the key still has unapplied work (drain first).
+        The whole freeze runs UNDER the service condition: a producer
+        racing the migration (not yet re-pointed) must not land a
+        delta the worker extends the session with while ``freeze()``
+        is serializing it — producers block for one checkpoint write,
+        an explicit operator move's acceptable cost."""
+        if self._cps is None:
+            return False
+        with self._cond:
+            ks = self._keys.get(key)
+            if ks is None or ks.session is None or ks.pending \
+                    or ks.needs_check:
+                return False
+            self._freeze_session(ks, locked=True)
+        return True
+
     def _maybe_evict(self) -> None:
         if self._cps is None or self.evict_idle_secs <= 0:
             return
@@ -800,15 +1243,7 @@ class CheckerService:
                        and now - ks.last_activity
                        > self.evict_idle_secs]
         for ks in victims:
-            with obs.span("serve.evict", key=str(ks.key)):
-                meta = ks.session.freeze(
-                    self._cps.checkpoint_path(ks.key))
-            meta["applied_seq"] = ks.applied_seq
-            meta["finalized"] = ks.finalized
-            self._cps.save(ks.key, meta)
-            with self._cond:
-                ks.session = None
-            obs.counter("serve.evictions").inc()
+            self._freeze_session(ks)
         if victims:
             with self._cond:
                 live = sum(1 for k in self._keys.values()

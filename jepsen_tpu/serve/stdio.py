@@ -13,9 +13,17 @@ One JSON request per input line, one JSON response per output line
 Op maps are the history schema ({"type", "process", "f", "value",
 ...}); responses are the service's structured dicts (``accepted`` /
 ``shed`` / ``duplicate`` / verdicts) with non-JSON values stringified.
-An HTTP or asyncio ingress wraps the same :class:`CheckerService`
-calls; this transport exists so the service is drivable from CI and a
-shell with zero extra dependencies.
+The HTTP ingress (``serve.ingress``) wraps the same
+:class:`CheckerService` calls; this transport exists so the service
+is drivable from CI and a shell with zero extra dependencies.
+
+Multi-tenant mode sits BELOW the transport (the service's admission
+layer), so stdio producers authenticate exactly like HTTP ones: each
+submit/result/finalize line may carry ``"token": "<tenant token>"``
+(forwarded verbatim to the service, which resolves and enforces it);
+with tenants configured and no token, the request is refused with the
+service's structured error — stdio is not a side door around
+tenancy.
 """
 
 from __future__ import annotations
@@ -26,14 +34,24 @@ import sys
 from jepsen_tpu.history import Op, _hashable
 
 
-def _jsonable(obj):
+def jsonable(obj):
+    """A response dict with non-JSON values stringified — the wire
+    form BOTH transports (stdio here, ``serve.ingress`` over HTTP)
+    emit, shared so they cannot drift."""
     return json.loads(json.dumps(obj, default=str))
 
 
-def _key(req):
-    # JSON list keys (jepsen.independent [k sub] tuples) arrive as
-    # lists — canonicalize to the hashable form the service keys on
+def wire_key(req):
+    """A request's key, canonicalized: JSON list keys
+    (jepsen.independent [k sub] tuples) arrive as lists — map to the
+    hashable form the service keys on. Shared with the HTTP
+    ingress."""
     return _hashable(req.get("key"))
+
+
+# the transports' historical private spellings
+_jsonable = jsonable
+_key = wire_key
 
 
 def run_stdio(service, lines_in=None, out=None) -> int:
@@ -65,16 +83,19 @@ def run_stdio(service, lines_in=None, out=None) -> int:
                     timeout=req.get("timeout"))})
             elif op == "result":
                 emit(service.result(_key(req),
-                                    timeout=req.get("timeout")))
+                                    timeout=req.get("timeout"),
+                                    token=req.get("token")))
             elif op == "finalize":
                 emit(service.finalize(_key(req),
-                                      timeout=req.get("timeout")))
+                                      timeout=req.get("timeout"),
+                                      token=req.get("token")))
             elif "ops" in req:
                 emit(service.submit(_key(req),
                                     [Op(o) for o in req["ops"]],
                                     seq=req.get("seq"),
                                     timeout=req.get("timeout"),
-                                    wait=bool(req.get("wait"))))
+                                    wait=bool(req.get("wait")),
+                                    token=req.get("token")))
             else:
                 emit({"error": f"unknown request {req!r}"})
     finally:

@@ -3,11 +3,17 @@
 The robustness contract (docs/streaming.md): a delta the service has
 ADMITTED is durable before the producer sees ``{"accepted": ...}`` —
 a kill-and-restart replays the WAL through the deterministic encode +
-scan and lands bit-identical verdicts. Format: one append-only JSONL
-file per key under the WAL root,
+scan and lands bit-identical verdicts. Format: append-only JSONL
+**segments** per key under the WAL root,
 
-    {"key": "<edn of the key>"}                 header, first line
-    {"seq": 1, "ops": ["<edn op>", ...]}        one line per delta
+    <stem>.wal          segment 0 (always first)
+    <stem>.wal.1        segment 1 (after the first rotation)
+    <stem>.wal.N        ...
+
+    {"key": "<edn>", "segment": N, "tenant": "..."?}   header, first
+                                                       line of EVERY
+                                                       segment
+    {"seq": 1, "ops": ["<edn op>", ...]}               one per delta
 
 Ops are EDN-serialized individually (``history.op_to_edn_str`` — the
 store's exact round-trip format), so replay reconstructs the op
@@ -16,12 +22,25 @@ stream byte-for-byte. Sequence numbers are the idempotence key:
 a crash (the client can't know whether the pre-crash submit landed)
 is a no-op, never a double-apply.
 
+Segmentation exists for two consumers (neither changes replay
+semantics): per-tenant WAL-bytes quotas meter ``size_bytes`` (the sum
+over segments), and replica handoff (``serve.ring.transfer_key``)
+ships a key as a list of sealed files instead of one unbounded one.
+``rotate`` seals the active segment; ``JEPSEN_TPU_SERVE_WAL_SEGMENT_
+BYTES`` (0 = off, the default) rotates automatically past a size.
+Each segment repeats the header so a transferred file set is
+self-describing.
+
 Crash tolerance: every append is flushed + fsynced before returning;
 a torn final line (the process died mid-write — that delta was never
 acknowledged) is detected on replay, logged, counted
-(``serve.wal_torn``), and ignored. Undecodable lines BEFORE the tail
-mean real corruption and raise :class:`WALError` rather than silently
-replaying a hole in an acknowledged stream.
+(``serve.wal_torn``), and ignored. Because a torn line was the tail
+of its file when written, the tolerance is per SEGMENT: one torn
+trailing line in any segment is an unacknowledged kill (possibly
+followed by a post-restart rotation), while an undecodable line
+before a segment's tail means real corruption and raises
+:class:`WALError` rather than silently replaying a hole in an
+acknowledged stream.
 """
 
 from __future__ import annotations
@@ -30,13 +49,16 @@ import hashlib
 import json
 import logging
 import os
+import re
 import threading
 from typing import Dict, List, Optional, Tuple
 
-from jepsen_tpu import edn, obs
+from jepsen_tpu import edn, envflags, obs
 from jepsen_tpu.history import _hashable, op_from_edn, op_to_edn_str
 
 _log = logging.getLogger(__name__)
+
+_SEG_RE = re.compile(r"\.wal(?:\.(\d+))?$")
 
 
 class WALError(RuntimeError):
@@ -54,20 +76,57 @@ def _safe_name(key) -> str:
     return f"{prefix or 'key'}_{digest}"
 
 
+def _resolve_segment_bytes(v: Optional[int]) -> int:
+    if v is not None:
+        return int(v)
+    return envflags.env_int("JEPSEN_TPU_SERVE_WAL_SEGMENT_BYTES",
+                            default=0, min_value=0,
+                            what="WAL segment size (bytes)") or 0
+
+
 class DeltaWAL:
     """Append-only per-key delta log under ``root`` (module docstring).
     Thread-safe; the service appends from producer threads and replays
-    from the worker."""
+    from the worker. ``segment_bytes`` (or the env flag) > 0 rotates
+    the active segment automatically once it grows past that size."""
 
-    def __init__(self, root: str):
+    def __init__(self, root: str, segment_bytes: Optional[int] = None):
         self.root = root
+        self.segment_bytes = _resolve_segment_bytes(segment_bytes)
         os.makedirs(root, exist_ok=True)
         self._lock = threading.Lock()          # handle/lock creation
         self._files: Dict[str, object] = {}    # stem -> open handle
+        self._seg: Dict[str, int] = {}         # stem -> active index
         # per-stem write locks: independent keys fsync CONCURRENTLY —
         # one global lock here would re-serialize exactly what the
         # service's seq-ordered handoff exists to avoid
         self._stem_locks: Dict[str, threading.Lock] = {}
+
+    # -- segment naming
+
+    def _seg_path(self, stem: str, i: int) -> str:
+        base = os.path.join(self.root, stem + ".wal")
+        return base if i == 0 else f"{base}.{i}"
+
+    def _segment_indices(self, stem: str) -> List[int]:
+        """Existing segment indices for a stem, ascending."""
+        out = []
+        prefix = stem + ".wal"
+        for name in os.listdir(self.root):
+            if not name.startswith(prefix):
+                continue
+            rest = name[len(stem):]
+            m = _SEG_RE.fullmatch(rest)
+            if m:
+                out.append(int(m.group(1)) if m.group(1) else 0)
+        return sorted(out)
+
+    def segments(self, key) -> List[str]:
+        """The key's segment paths in replay order — the unit replica
+        handoff copies (``serve.ring.transfer_key``)."""
+        stem = _safe_name(key)
+        return [self._seg_path(stem, i)
+                for i in self._segment_indices(stem)]
 
     # -- write path
 
@@ -99,28 +158,83 @@ class DeltaWAL:
             _log.warning("WAL %s: could not repair tail (%r)", path,
                          err)
 
-    def append(self, key, seq: int, ops) -> None:
+    def _open_active(self, stem: str, key, tenant: Optional[str]):
+        """The active (highest-index) segment's handle, opened —
+        with tail repair — on first touch; callers hold the stem
+        lock."""
+        with self._lock:
+            fh = self._files.get(stem)
+        if fh is not None:
+            return fh
+        idx = self._seg.get(stem)
+        if idx is None:
+            existing = self._segment_indices(stem)
+            idx = existing[-1] if existing else 0
+        path = self._seg_path(stem, idx)
+        fresh = not os.path.exists(path)
+        if not fresh:
+            self._repair_tail(path)
+        fh = open(path, "a")
+        if fresh:
+            head = {"key": edn.dumps(key), "segment": idx}
+            if tenant is not None:
+                head["tenant"] = tenant
+            fh.write(json.dumps(head) + "\n")
+        with self._lock:
+            self._files[stem] = fh
+            self._seg[stem] = idx
+        return fh
+
+    def append(self, key, seq: int, ops,
+               tenant: Optional[str] = None) -> int:
+        """Durably append one delta; returns the bytes written (the
+        per-tenant WAL-quota meter). ``tenant`` stamps the segment
+        header so recovery re-homes the key to its owner."""
         stem = _safe_name(key)
         line = json.dumps({"seq": int(seq),
                            "ops": [op_to_edn_str(o) for o in ops]})
         with self._lock:
             slock = self._stem_locks.setdefault(stem, threading.Lock())
         with slock:
-            with self._lock:
-                fh = self._files.get(stem)
-            if fh is None:
-                path = os.path.join(self.root, stem + ".wal")
-                fresh = not os.path.exists(path)
-                if not fresh:
-                    self._repair_tail(path)
-                fh = open(path, "a")
-                if fresh:
-                    fh.write(json.dumps({"key": edn.dumps(key)}) + "\n")
-                with self._lock:
-                    self._files[stem] = fh
+            fh = self._open_active(stem, key, tenant)
             fh.write(line + "\n")
             fh.flush()
             os.fsync(fh.fileno())
+            n = len(line) + 1
+            if self.segment_bytes and fh.tell() >= self.segment_bytes:
+                self._rotate_locked(stem)
+            return n
+
+    def _rotate_locked(self, stem: str) -> None:
+        """Seal the active segment (callers hold the stem lock); the
+        next append opens ``<stem>.wal.<n+1>`` with a fresh header."""
+        with self._lock:
+            fh = self._files.pop(stem, None)
+            idx = self._seg.get(stem)
+        if fh is not None:
+            try:
+                fh.close()
+            except OSError:
+                pass
+        if idx is None:
+            existing = self._segment_indices(stem)
+            if not existing:
+                return   # nothing written yet: rotating would orphan
+                # segment 0 (keys() reads its header) — a no-op is the
+                # only sound answer
+            idx = existing[-1]
+        with self._lock:
+            self._seg[stem] = idx + 1
+        obs.counter("serve.wal_rotations").inc()
+
+    def rotate(self, key) -> None:
+        """Seal the key's active segment now (replica handoff wants
+        sealed files; quota tests want deterministic boundaries)."""
+        stem = _safe_name(key)
+        with self._lock:
+            slock = self._stem_locks.setdefault(stem, threading.Lock())
+        with slock:
+            self._rotate_locked(stem)
 
     def close(self) -> None:
         with self._lock:
@@ -130,12 +244,14 @@ class DeltaWAL:
                 except OSError:
                     pass
             self._files.clear()
+            self._seg.clear()
             self._stem_locks.clear()
 
     # -- replay path
 
     def keys(self) -> list:
-        """Every key with a WAL file (decoded from the headers)."""
+        """Every key with a WAL file (decoded from the segment-0
+        headers; rotation never drops segment 0, so one row per key)."""
         out = []
         for name in sorted(os.listdir(self.root)):
             if not name.endswith(".wal"):
@@ -156,38 +272,53 @@ class DeltaWAL:
                     f"unreadable WAL header in {path}: {err!r}") from err
         return out
 
+    def header(self, key) -> Optional[dict]:
+        """The key's segment-0 header record ({"key", "segment",
+        "tenant"?}), or None when the key has no WAL — how recovery
+        learns which tenant owns a replayed key."""
+        segs = self.segments(key)
+        if not segs:
+            return None
+        try:
+            with open(segs[0]) as fh:
+                return json.loads(fh.readline())
+        except Exception as err:  # noqa: BLE001 — same posture as keys()
+            raise WALError(
+                f"unreadable WAL header in {segs[0]}: {err!r}") from err
+
     def replay(self, key) -> List[Tuple[int, list]]:
         """The key's admitted deltas as ``[(seq, [Op, ...]), ...]`` in
-        ascending seq order, duplicates dropped. Tolerates exactly one
-        torn TRAILING line (an unacknowledged mid-write kill)."""
-        path = os.path.join(self.root, _safe_name(key) + ".wal")
-        if not os.path.exists(path):
-            return []
-        with open(path) as fh:
-            lines = fh.read().splitlines()
+        ascending seq order, across every segment, duplicates dropped.
+        Tolerates one torn TRAILING line per segment (an
+        unacknowledged mid-write kill — it was the tail of its file
+        when written, segment boundary or not)."""
         out: List[Tuple[int, list]] = []
         seen = set()
-        for i, line in enumerate(lines[1:], start=2):
-            if not line.strip():
-                continue
-            try:
-                rec = json.loads(line)
-                seq = int(rec["seq"])
-                ops = [op_from_edn(edn.loads(s)) for s in rec["ops"]]
-            except Exception as err:  # noqa: BLE001 — decode failure
-                if i == len(lines):
-                    obs.counter("serve.wal_torn").inc()
-                    _log.warning(
-                        "WAL %s: torn trailing line ignored (the "
-                        "delta was never acknowledged): %r", path, err)
-                    break
-                raise WALError(
-                    f"corrupt WAL line {i} in {path} (not the tail — "
-                    f"acknowledged data): {err!r}") from err
-            if seq in seen:
-                continue
-            seen.add(seq)
-            out.append((seq, ops))
+        for path in self.segments(key):
+            with open(path) as fh:
+                lines = fh.read().splitlines()
+            for i, line in enumerate(lines[1:], start=2):
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                    seq = int(rec["seq"])
+                    ops = [op_from_edn(edn.loads(s)) for s in rec["ops"]]
+                except Exception as err:  # noqa: BLE001 — decode failure
+                    if i == len(lines):
+                        obs.counter("serve.wal_torn").inc()
+                        _log.warning(
+                            "WAL %s: torn trailing line ignored (the "
+                            "delta was never acknowledged): %r", path,
+                            err)
+                        break
+                    raise WALError(
+                        f"corrupt WAL line {i} in {path} (not the "
+                        f"tail — acknowledged data): {err!r}") from err
+                if seq in seen:
+                    continue
+                seen.add(seq)
+                out.append((seq, ops))
         out.sort(key=lambda t: t[0])
         return out
 
@@ -196,13 +327,15 @@ class DeltaWAL:
         return deltas[-1][0] if deltas else 0
 
     def size_bytes(self, key) -> int:
-        """The key's WAL file size (0 when none) — the /status
-        per-key durability column."""
-        path = os.path.join(self.root, _safe_name(key) + ".wal")
-        try:
-            return os.path.getsize(path)
-        except OSError:
-            return 0
+        """The key's WAL size summed across segments (0 when none) —
+        the /status durability column and the tenant WAL-quota meter."""
+        total = 0
+        for path in self.segments(key):
+            try:
+                total += os.path.getsize(path)
+            except OSError:
+                pass
+        return total
 
 
 # -------------------------------------------------- checkpoint store

@@ -23,6 +23,11 @@ ENGINE_MODULES = [
     "jepsen_tpu.models",
     "jepsen_tpu.independent",
     "jepsen_tpu.serve.service",
+    # the multi-tenant admission/transport/routing layers must stand
+    # up (and refuse/route traffic) while the runtime is wedged
+    "jepsen_tpu.serve.tenancy",
+    "jepsen_tpu.serve.ingress",
+    "jepsen_tpu.serve.ring",
     # the ops surface must ANSWER while the runtime is wedged — its
     # import (and the probe watch's) can never touch a backend
     "jepsen_tpu.obs.httpd",

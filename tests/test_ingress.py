@@ -1,0 +1,224 @@
+"""HTTP delta-ingress suite (ISSUE 12): streamed JSONL request/
+response bodies over the asyncio stdlib server, bearer-token tenant
+auth, structured sheds with tenant attribution, and the read
+endpoints — all answering through the same admission layer as stdio.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from jepsen_tpu.envflags import EnvFlagError
+from jepsen_tpu.histories import rand_register_history
+from jepsen_tpu.history import History
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.parallel import encode as enc_mod, engine
+from jepsen_tpu.serve import CheckerService, Tenant
+from jepsen_tpu.serve import ingress as ingress_mod
+
+
+def _post(url, body, token=None, timeout=120):
+    headers = {}
+    if token is not None:
+        headers["Authorization"] = f"Bearer {token}"
+    req = urllib.request.Request(url, data=body.encode(),
+                                 headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+def _lines(body):
+    return [json.loads(ln) for ln in body.splitlines()]
+
+
+def _history(seed=1):
+    return list(rand_register_history(n_ops=16, n_processes=3,
+                                      n_values=3, seed=seed))
+
+
+def test_ingress_streamed_jsonl_end_to_end():
+    """The whole grammar over one connection: submits (wait and not),
+    an interleaved result, a finalize — responses stream back one
+    JSONL line per request line, in order, and the final verdict
+    matches the one-shot check."""
+    h = _history()
+    ref = engine.check_encoded(
+        enc_mod.encode(CASRegister(), History.wrap(h)), capacity=128)
+    svc = CheckerService(CASRegister(), capacity=128)
+    with ingress_mod.DeltaIngress(svc, port=0) as ing:
+        try:
+            reqs = [
+                {"key": "k", "ops": [dict(o) for o in h[:8]],
+                 "wait": True, "timeout": 120},
+                {"key": "k", "ops": [dict(o) for o in h[8:]],
+                 "timeout": 60},
+                {"op": "result", "key": "k", "timeout": 120},
+                {"op": "finalize", "key": "k", "timeout": 120},
+                {"bogus": 1},
+                "not json at all",
+            ]
+            body = "\n".join(r if isinstance(r, str)
+                             else json.dumps(r) for r in reqs) + "\n"
+            code, text = _post(ing.url("/v1/deltas"), body)
+            outs = _lines(text)
+            assert code == 200 and len(outs) == 6
+            assert outs[0]["valid?"] is not None and outs[0]["seq"] == 1
+            assert outs[1]["accepted"] and outs[1]["seq"] == 2
+            assert outs[2]["seq"] == 2
+            assert outs[3]["valid?"] == ref["valid?"]
+            assert "unknown request" in outs[4]["error"]
+            assert "bad request line" in outs[5]["error"]
+            # GET /v1/result answers the sealed verdict too
+            with urllib.request.urlopen(
+                    ing.url('/v1/result?key="k"'), timeout=60) as resp:
+                r = json.loads(resp.read())
+            assert r["valid?"] == ref["valid?"]
+        finally:
+            svc.close()
+
+
+def test_ingress_auth_required_with_tenants():
+    h = _history(seed=2)
+    svc = CheckerService(
+        CASRegister(), capacity=128,
+        tenants=[Tenant("ia", token="tok-ia"),
+                 Tenant("ib", token="tok-ib")])
+    with ingress_mod.DeltaIngress(svc, port=0) as ing:
+        try:
+            delta = json.dumps({"key": "k", "ops": [dict(o)
+                                                    for o in h[:8]],
+                                "timeout": 60}) + "\n"
+            # no token / unknown token -> 401 before the service runs
+            for token in (None, "wrong"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    _post(ing.url("/v1/deltas"), delta, token=token)
+                assert ei.value.code == 401
+                assert "unauthorized" in json.loads(
+                    ei.value.read())["error"]
+            # the right token admits and the answer names the tenant
+            code, text = _post(ing.url("/v1/deltas"), delta,
+                               token="tok-ia")
+            out = _lines(text)[0]
+            assert out["accepted"] and out["tenant"] == "ia"
+            # another tenant's token cannot read the key
+            code, text = _post(ing.url("/v1/finalize"),
+                               json.dumps({"key": "k",
+                                           "timeout": 60}),
+                               token="tok-ib")
+            assert "another tenant" in json.loads(text)["error"]
+        finally:
+            svc.close()
+
+
+def test_ingress_shed_carries_tenant_and_reason():
+    h = _history(seed=3)
+    svc = CheckerService(
+        CASRegister(), capacity=128,
+        tenants=[Tenant("iq", token="tq", max_pending_ops=8)],
+        start_worker=False)
+    with ingress_mod.DeltaIngress(svc, port=0) as ing:
+        try:
+            reqs = [{"key": "k", "ops": [dict(o) for o in h[:8]],
+                     "timeout": 30},
+                    {"key": "k", "ops": [dict(o) for o in h[8:16]],
+                     "timeout": 30}]
+            body = "".join(json.dumps(r) + "\n" for r in reqs)
+            _code, text = _post(ing.url("/v1/deltas"), body,
+                                token="tq", timeout=60)
+            outs = _lines(text)
+            assert outs[0]["accepted"]
+            assert outs[1]["shed"] is True
+            assert outs[1]["tenant"] == "iq"
+            assert "pending-ops quota" in outs[1]["reason"]
+        finally:
+            svc.close(drain=False)
+
+
+def test_ingress_unknown_endpoint_and_bad_key():
+    svc = CheckerService(CASRegister(), capacity=128)
+    with ingress_mod.DeltaIngress(svc, port=0) as ing:
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(ing.url("/nope"), timeout=30)
+            assert ei.value.code == 404
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    ing.url("/v1/result?key=notjson"), timeout=30)
+            assert ei.value.code == 400
+            with urllib.request.urlopen(ing.url("/"),
+                                        timeout=30) as resp:
+                doc = json.loads(resp.read())
+            assert "/v1/deltas" in doc["endpoints"]
+        finally:
+            svc.close()
+
+
+def test_ingress_port_flag_and_cli_parse(monkeypatch):
+    from jepsen_tpu import cli
+    args = cli.base_parser().parse_args(
+        ["serve", "--checker", "--ingress-port", "0"])
+    assert args.ingress_port == 0
+    monkeypatch.delenv("JEPSEN_TPU_INGRESS_PORT", raising=False)
+    assert ingress_mod.resolve_ingress_port(None) is None
+    assert ingress_mod.resolve_ingress_port(8181) == 8181
+    monkeypatch.setenv("JEPSEN_TPU_INGRESS_PORT", "7171")
+    assert ingress_mod.resolve_ingress_port(None) == 7171
+    monkeypatch.setenv("JEPSEN_TPU_INGRESS_PORT", "nope")
+    with pytest.raises(EnvFlagError):
+        ingress_mod.resolve_ingress_port(None)
+
+
+def test_stdio_token_passthrough():
+    """stdio is behind the same admission layer: a line's token
+    resolves the tenant; with tenants configured and no token the
+    request is refused."""
+    from io import StringIO
+
+    from jepsen_tpu.serve.stdio import run_stdio
+    h = _history(seed=4)
+    svc = CheckerService(CASRegister(), capacity=128,
+                         tenants=[Tenant("st", token="ts")])
+    reqs = [json.dumps({"key": "k", "ops": [dict(o) for o in h[:8]],
+                        "token": "ts", "wait": True, "timeout": 120}),
+            json.dumps({"key": "k", "ops": [dict(o) for o in h[8:]],
+                        "timeout": 30}),   # no token -> refused
+            json.dumps({"op": "result", "key": "k", "token": "ts",
+                        "timeout": 60}),
+            json.dumps({"op": "stop"})]
+    out = StringIO()
+    rc = run_stdio(svc, StringIO("\n".join(reqs) + "\n"), out)
+    assert rc == 0
+    lines = [json.loads(ln) for ln in out.getvalue().splitlines()]
+    assert lines[0]["valid?"] is not None
+    assert "tenant required" in lines[1]["error"]
+    assert lines[2]["seq"] == 1
+
+
+def test_ingress_bad_timeout_and_missing_content_length():
+    """Review pins: a malformed query param answers 400 (never a
+    dropped connection), and POST /v1/deltas without Content-Length
+    answers 400 instead of silently acking nothing."""
+    import http.client
+    svc = CheckerService(CASRegister(), capacity=128)
+    with ingress_mod.DeltaIngress(svc, port=0) as ing:
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    ing.url('/v1/result?key="k"&timeout=abc'),
+                    timeout=30)
+            assert ei.value.code == 400
+            assert "timeout" in json.loads(ei.value.read())["error"]
+            conn = http.client.HTTPConnection("127.0.0.1", ing.port,
+                                              timeout=30)
+            conn.putrequest("POST", "/v1/deltas",
+                            skip_accept_encoding=True)
+            conn.endheaders()   # no Content-Length, no body
+            resp = conn.getresponse()
+            assert resp.status == 400
+            assert "Content-Length" in json.loads(
+                resp.read())["error"]
+            conn.close()
+        finally:
+            svc.close()

@@ -563,3 +563,123 @@ def test_flight_rides_full_tracing(monkeypatch):
     tr.drain()
     assert tr.spans() == []
     assert [s.name for s in tr.ring_spans()] == ["both.modes"]
+
+
+# ------------------------------------------- labeled (tenant) series
+
+
+def test_render_prometheus_labels_round_trip():
+    """Registry names of the obs.labeled form render as REAL
+    exposition labels sharing the base metric name, and
+    parse_prometheus recovers each labeled series as its own
+    quantile-answerable entry — the per-tenant SLO contract."""
+    reg = obs.Registry()
+    reg.counter("l.count").inc(7)
+    reg.counter(obs.labeled("l.count", tenant="alice")).inc(2)
+    reg.counter(obs.labeled("l.count", tenant="bob")).inc(3)
+    h = reg.histogram(obs.labeled("l.secs", tenant="a-1"))
+    for v in (0.0005, 0.02, 120.0):
+        h.observe(v)
+    reg.histogram("l.secs").observe(0.01)
+    text = ops_httpd.render_prometheus(reg.snapshot())
+    samples, types = _parse_prom(text)
+    assert types["jepsen_l_count"] == "counter"
+    assert samples[("jepsen_l_count", "")] == 7
+    assert samples[("jepsen_l_count", '{tenant="alice"}')] == 2
+    assert samples[("jepsen_l_count", '{tenant="bob"}')] == 3
+    # exactly ONE TYPE line per metric name (the exposition grouping
+    # rule), labeled and unlabeled series under it
+    assert text.count("# TYPE jepsen_l_count ") == 1
+    assert text.count("# TYPE jepsen_l_secs ") == 1
+    assert samples[("jepsen_l_secs_bucket",
+                    '{tenant="a-1",le="+Inf"}')] == 3
+    assert samples[("jepsen_l_secs_count", '{tenant="a-1"}')] == 3
+    assert samples[("jepsen_l_secs_max", '{tenant="a-1"}')] == 120.0
+    parsed = ops_httpd.parse_prometheus(text)
+    hh = parsed[obs.labeled("jepsen_l_secs", tenant="a-1")]
+    assert hh["count"] == 3 and hh["max"] == 120.0
+    from jepsen_tpu.obs.metrics import hist_quantile as hq
+    assert hq(hh, 0.99) == 120.0   # past-ladder falls to the max twin
+    # the unlabeled aggregate keeps its historical plain key
+    assert parsed["jepsen_l_secs"]["count"] == 1
+    # label values with quotes/backslashes survive the round trip
+    reg.counter(obs.labeled("l.count", tenant='we"ird\\')).inc(1)
+    parsed2 = ops_httpd.parse_prometheus(
+        ops_httpd.render_prometheus(reg.snapshot()))
+    assert parsed2[obs.labeled("jepsen_l_count",
+                               tenant='we"ird\\')]["value"] == 1
+
+
+def test_labeled_split_labels_helpers():
+    assert obs.labeled("a.b") == "a.b"
+    assert obs.labeled("a.b", tenant="x") == "a.b[tenant=x]"
+    assert obs.split_labels("a.b[tenant=x]") == ("a.b",
+                                                 {"tenant": "x"})
+    assert obs.split_labels("a.b") == ("a.b", {})
+    base, labs = obs.split_labels(obs.labeled("n", a="1", b="2"))
+    assert base == "n" and labs == {"a": "1", "b": "2"}
+
+
+# --------------------------------------------- fleet (multi-replica)
+
+
+def test_status_fleet_multi_addr(capsys):
+    """`jepsen status --addr` (repeatable): one table per replica, a
+    fleet summary, worst-of exit codes (unreachable beats degraded
+    beats ready)."""
+    import socket
+    ok_srv = ops_httpd.OpsServer(
+        port=0, health_fn=lambda: {"ok": True, "checks": {}},
+        status_fn=lambda: {"keys": {}, "pending_ops": 0}).start()
+    bad_srv = ops_httpd.OpsServer(
+        port=0, health_fn=lambda: {"ok": False, "checks": {
+            "worker": {"ok": False}}},
+        status_fn=lambda: {"keys": {}, "pending_ops": 0}).start()
+    # a port with nothing listening (bind-then-close reserves one)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    try:
+        a_ok = f"127.0.0.1:{ok_srv.port}"
+        a_bad = f"127.0.0.1:{bad_srv.port}"
+        a_dead = f"127.0.0.1:{dead_port}"
+        rc = ops_httpd.status_main(["--addr", a_ok, "--addr", a_bad,
+                                    "--timeout", "5"])
+        out = capsys.readouterr().out
+        assert rc == 1   # one degraded, none unreachable
+        assert f"== replica {a_ok} ==" in out
+        assert "DEGRADED — failing checks: worker" in out
+        assert "fleet: 1 ready, 1 degraded, 0 unreachable" in out
+        rc = ops_httpd.status_main(["--addr", a_ok, "--addr", a_dead,
+                                    "--timeout", "2"])
+        out = capsys.readouterr().out
+        assert rc == 2 and "UNREACHABLE" in out
+        assert "fleet: 1 ready, 0 degraded, 1 unreachable" in out
+        rc = ops_httpd.status_main(["--addr", a_ok, "--timeout", "5"])
+        capsys.readouterr()
+        assert rc == 0
+        # --json renders the raw doc map
+        rc = ops_httpd.status_main(["--addr", a_ok, "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert doc[a_ok]["health"]["ok"] is True
+        # malformed address is a usage error, not a crash
+        assert ops_httpd.status_main(["--addr", "nope"]) == 254
+        capsys.readouterr()
+    finally:
+        ok_srv.close()
+        bad_srv.close()
+
+
+def test_status_table_renders_tenants_section():
+    status = {"keys": {}, "pending_ops": 0, "high_water": 10,
+              "global_bound": 20, "keys_live": 0,
+              "tenants": {"alice": {
+                  "weight": 3, "pending_ops": 4, "pending_bound": 8,
+                  "keys": 1, "wal_bytes": 2048,
+                  "acct": {"sheds": 2, "deltas": 5, "ops": 20},
+                  "ack_p99": 0.0025, "verdict_p99": None}}}
+    health = {"ok": True, "checks": {}}
+    out = ops_httpd.render_status_table(status, health)
+    assert "tenant" in out and "alice" in out
+    assert "0.0025" in out and "2.0KiB" in out

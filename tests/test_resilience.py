@@ -722,3 +722,77 @@ def test_independent_breaker_aware_fallback(monkeypatch):
     # per-key results came from the host-forced checker
     assert all(res["analyzer"] in ("packed", "wgl")
                for res in r["results"].values())
+
+
+# --------------------------------------------------- slow fault kind
+
+
+def test_fault_slow_spec_grammar():
+    rs = faults.parse_spec("slow@search:50, slow@dispatch:ms=10,"
+                           "slow@pipeline")
+    assert [(r.kind, r.site, r.ms) for r in rs] == [
+        ("slow", "search", 50), ("slow", "dispatch", 10),
+        ("slow", "pipeline", faults.DEFAULT_SLOW_MS)]
+    # slow fires on every invocation (no n/every arg slot)
+    assert rs[0].fires(1) and rs[0].fires(99)
+
+
+@pytest.mark.parametrize("bad", [
+    "slow@child:5",        # child seam only implements wedge
+    "slow@search:banana",  # non-integer delay
+    "slow@search:n=3",     # counts do not apply to slow
+    "slow@search:every=2",
+    "slow@search:0",       # non-positive delay
+    "slow@search:ms=0",
+])
+def test_fault_slow_bad_specs_raise(bad):
+    with pytest.raises(faults.FaultSpecError):
+        faults.parse_spec(bad)
+
+
+def test_fault_slow_injects_latency_preserves_result(monkeypatch):
+    """slow@<site> delays the dispatch deterministically and the call
+    still runs and answers — the degraded-but-alive device the
+    fairness/soak scenarios need (not wedge, not crash)."""
+    import time as _time
+    monkeypatch.setenv("JEPSEN_TPU_FAULTS", "slow@search:ms=60")
+    resilience.reset()
+    before = _cval("resilience.faults_injected.search")
+    t0 = _time.perf_counter()
+    assert sup.dispatch("search", lambda: 42) == 42
+    elapsed = _time.perf_counter() - t0
+    assert elapsed >= 0.055, elapsed
+    assert _cval("resilience.faults_injected.search") == before + 1
+    # other sites are untouched (and fast)
+    t0 = _time.perf_counter()
+    assert sup.dispatch("dispatch", lambda: 7) == 7
+    assert _time.perf_counter() - t0 < 0.05
+
+
+def test_fault_slow_watchdog_below_delay_wedges(monkeypatch):
+    """The sleep rides inside the watchdogged window: a watchdog
+    bound below the injected delay fires DispatchWedged — a too-slow
+    dispatch IS the r05 wedge, by definition."""
+    monkeypatch.setenv("JEPSEN_TPU_FAULTS", "slow@search:ms=300")
+    resilience.reset()
+    with pytest.raises(sup.DispatchWedged):
+        sup.dispatch("search", lambda: 42, watchdog=0.05)
+    # a bound ABOVE the delay lets the slow dispatch finish
+    resilience.reset()
+    assert sup.dispatch("search", lambda: 42, watchdog=2.0) == 42
+
+
+def test_fault_slow_verdict_identical_to_clean(monkeypatch,
+                                               reg_histories,
+                                               clean_results):
+    """A slow device changes latency, never verdicts: the register
+    sweep under slow@search matches the clean run exactly."""
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.parallel import engine as eng
+    monkeypatch.setenv("JEPSEN_TPU_FAULTS", "slow@search:ms=1")
+    resilience.reset()
+    for i, h in enumerate(reg_histories):
+        r = eng.analysis(CASRegister(), h)
+        ref = clean_results[i]
+        assert r["valid?"] == ref["valid?"], i
+        assert r.get("op") == ref.get("op"), i

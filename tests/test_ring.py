@@ -1,0 +1,244 @@
+"""Replica scale-out suite (ISSUE 12 acceptance): consistent-hash
+ring math, WAL-segment + freeze/thaw key migration, crash re-homing
+with bit-identical verdicts — including a REAL kill -9 of a replica
+subprocess mid-stream.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from jepsen_tpu.histories import corrupt_history, rand_register_history
+from jepsen_tpu.history import History
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.parallel import encode as enc_mod, engine
+from jepsen_tpu.serve import CheckerService, DeltaWAL
+from jepsen_tpu.serve import ring as ring_mod
+
+PIN = ("valid?", "op", "fail-event", "max-frontier", "configs-stepped")
+
+
+def _pin(r):
+    return {k: r.get(k) for k in PIN}
+
+
+def _oneshot(ops, capacity=128):
+    e = enc_mod.encode(CASRegister(), History.wrap(list(ops)))
+    return engine.check_encoded(e, capacity=capacity, dedupe="sort")
+
+
+def _history(seed=2, corrupt=True):
+    h = rand_register_history(n_ops=24, n_processes=4, n_values=3,
+                              crash_p=0.05, seed=seed)
+    if corrupt:
+        h = corrupt_history(h, seed=1, n_corruptions=2)
+    return list(h)
+
+
+# ------------------------------------------------------------- ring
+
+
+def test_hash_ring_deterministic_and_stable():
+    r1 = ring_mod.HashRing(["a", "b", "c"])
+    r2 = ring_mod.HashRing(["c", "a", "b"])   # order-independent
+    keys = [("reg", i) for i in range(200)]
+    owners = {k: r1.owner(k) for k in keys}
+    assert {r2.owner(k) for k in keys} == set(owners.values())
+    assert all(r2.owner(k) == o for k, o in owners.items())
+    # every node owns a nontrivial share (vnodes spread the arcs)
+    counts = {n: sum(1 for o in owners.values() if o == n)
+              for n in "abc"}
+    assert all(c > 20 for c in counts.values()), counts
+    # consistency: removing b moves ONLY b's keys
+    r1.remove("b")
+    for k, o in owners.items():
+        if o != "b":
+            assert r1.owner(k) == o
+        else:
+            assert r1.owner(k) in ("a", "c")
+    # adding b back restores the original assignment exactly
+    r1.add("b")
+    assert all(r1.owner(k) == o for k, o in owners.items())
+
+
+def test_hash_ring_assignments_and_empty():
+    r = ring_mod.HashRing(["x", "y"])
+    plan = r.assignments([("reg", i) for i in range(20)])
+    assert sum(len(v) for v in plan.values()) == 20
+    with pytest.raises(ValueError, match="no nodes"):
+        ring_mod.HashRing([]).owner("k")
+
+
+# ----------------------------------------------- in-process rehoming
+
+
+def test_router_crash_rehome_bit_identical(tmp_path):
+    """Crash path: one replica dies (close without drain — the
+    in-process stand-in for a kill; the subprocess test below does it
+    with a real SIGKILL), survivors adopt its WAL segments +
+    checkpoint, and every migrated key's verdict is bit-identical to
+    an unmigrated one-shot check."""
+    m = CASRegister()
+    h = _history()
+    ref = _oneshot(h)
+    dirs = {n: str(tmp_path / n) for n in ("r1", "r2")}
+    svcs = {n: CheckerService(m, wal_dir=d, capacity=128)
+            for n, d in dirs.items()}
+    router = ring_mod.Router(svcs, dirs)
+    key = "mig-key"
+    dead = router.owner(key)
+    survivor = next(n for n in dirs if n != dead)
+    try:
+        r = router.submit(key, h[:12], wait=True, timeout=120)
+        assert "valid?" in r
+        # second delta ACKED but possibly unapplied at the crash: the
+        # WAL has it, so the survivor must land it too
+        assert router.submit(key, h[12:], timeout=60)["accepted"]
+        svcs[dead].close(drain=False)
+        plan = router.rehome(dead)
+        assert plan == {survivor: [key]}
+        rr = router.result(key, timeout=120)
+        assert _pin(rr) == _pin(ref) and rr["seq"] == 2
+        # the re-homed key keeps serving: a replayed delta dedupes by
+        # seq exactly like it would on the original replica
+        assert router.submit(key, h[12:], seq=2)["duplicate"]
+        f = router.finalize(key, timeout=120)
+        assert _pin(f) == _pin(ref)
+    finally:
+        for s in router.services.values():
+            s.close()
+
+
+def test_router_graceful_migration_freeze_thaw(tmp_path):
+    """Graceful path: freeze_key persists the live frontier, the
+    transfer ships checkpoint + WAL segments, and the destination
+    thaws instead of re-scanning (pinned via the checkpoint meta
+    landing on the destination and verdict parity)."""
+    m = CASRegister()
+    h = _history(seed=5, corrupt=False)
+    ref = _oneshot(h)
+    dirs = {n: str(tmp_path / n) for n in ("ra", "rb")}
+    svcs = {n: CheckerService(m, wal_dir=d, capacity=128)
+            for n, d in dirs.items()}
+    router = ring_mod.Router(svcs, dirs)
+    key = "gkey"
+    src = router.owner(key)
+    dst = next(n for n in dirs if n != src)
+    try:
+        router.submit(key, h, wait=True, timeout=120)
+        r = router.migrate_key(key, dst)
+        assert r["from"] == src and r["to"] == dst
+        assert r["segments"] >= 1 and r["checkpoint"] is True
+        # the frozen checkpoint pair really landed on the destination
+        cps = os.listdir(os.path.join(dirs[dst], "checkpoints"))
+        assert any(n.endswith(".json") for n in cps)
+        rr = svcs[dst].result(key, timeout=120)
+        assert _pin(rr) == _pin(ref) and rr["seq"] == 1
+    finally:
+        for s in svcs.values():
+            s.close()
+
+
+# ------------------------------------------------ cross-process kill
+
+
+_CHILD = r"""
+import json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.serve import CheckerService
+from jepsen_tpu.serve.ingress import DeltaIngress
+svc = CheckerService(CASRegister(), wal_dir=sys.argv[1], capacity=128,
+                     evict_idle_secs=0.2)
+ing = DeltaIngress(svc, port=0).start()
+print(json.dumps({"port": ing.port}), flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+def _http_deltas(port, reqs, timeout=180):
+    import urllib.request
+    body = "".join(json.dumps(r) + "\n" for r in reqs).encode()
+    req = urllib.request.Request(f"http://127.0.0.1:{port}/v1/deltas",
+                                 data=body)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return [json.loads(ln) for ln in
+                resp.read().decode().splitlines()]
+
+
+def test_kill9_replica_rehomes_keys_bit_identical(tmp_path):
+    """THE acceptance pin: kill -9 a replica process mid-stream; its
+    keys re-home onto a survivor via WAL-segment transfer + the
+    frozen checkpoint (eviction froze the key before the kill, so the
+    handoff exercises freeze/thaw, not just replay), and the migrated
+    key's final verdict is bit-identical to an unmigrated one-shot
+    check of the same ops."""
+    m = CASRegister()
+    h = _history(seed=7)
+    ref = _oneshot(h)
+    dead_dir = str(tmp_path / "dead")
+    live_dir = str(tmp_path / "live")
+    script = tmp_path / "replica.py"
+    script.write_text(_CHILD)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    env.pop("JEPSEN_TPU_FAULTS", None)
+    proc = subprocess.Popen([sys.executable, str(script), dead_dir],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, env=env,
+                            cwd=repo)
+    survivor = None
+    try:
+        line = proc.stdout.readline().decode()
+        assert line, "replica subprocess produced no port line"
+        port = json.loads(line)["port"]
+        key = "k9"
+        outs = _http_deltas(port, [{"key": key,
+                                    "ops": [dict(o) for o in h[:12]],
+                                    "wait": True, "timeout": 150}])
+        assert outs[0].get("valid?") is not None
+        # let the idle key evict: the frontier freezes to the
+        # checkpoint store, which is exactly what the handoff ships
+        deadline = time.time() + 20
+        cps_dir = os.path.join(dead_dir, "checkpoints")
+        while time.time() < deadline:
+            if os.path.isdir(cps_dir) and any(
+                    n.endswith(".json") for n in os.listdir(cps_dir)):
+                break
+            time.sleep(0.05)
+        assert any(n.endswith(".json") for n in os.listdir(cps_dir)), \
+            "replica never froze the idle key"
+        # second delta ACKED (WAL-durable), then SIGKILL mid-stream —
+        # the replica never gets to apply or drain it
+        outs = _http_deltas(port, [{"key": key,
+                                    "ops": [dict(o) for o in h[12:]],
+                                    "timeout": 60}])
+        assert outs[0].get("accepted"), outs
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+        # survivors adopt: ring drops the dead node, WAL segments +
+        # checkpoint pair transfer, recovery replays
+        survivor = CheckerService(m, wal_dir=live_dir, capacity=128)
+        ring = ring_mod.HashRing(["dead-node", "live-node"])
+        plan = ring_mod.rehome_dead_replica(
+            dead_dir, ring, "dead-node", {"live-node": live_dir},
+            {"live-node": survivor})
+        assert plan == {"live-node": [key]}
+        rr = survivor.result(key, timeout=150)
+        assert _pin(rr) == _pin(ref), "migrated verdict diverged"
+        assert rr["seq"] == 2   # the acked-but-unapplied delta landed
+        f = survivor.finalize(key, timeout=150)
+        assert _pin(f) == _pin(ref)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        if survivor is not None:
+            survivor.close()

@@ -627,3 +627,423 @@ def test_cli_serve_checker_flags_parse():
     assert args.checker and args.model == "fifo"
     assert set(cli.SERVE_MODELS) >= {"cas-register", "gset", "fifo",
                                      "uqueue", "mutex", "register"}
+
+
+# ----------------------------------------------- tenancy + fairness
+
+
+def _tenant_svc(**kw):
+    from jepsen_tpu.serve import Tenant
+    m = CASRegister()
+    tenants = kw.pop("tenants", None) or [
+        Tenant("ten-a", token="tok-a", weight=1),
+        Tenant("ten-b", token="tok-b", weight=1)]
+    return CheckerService(m, capacity=128, tenants=tenants, **kw)
+
+
+def test_tenant_spec_grammar_validated(monkeypatch):
+    from jepsen_tpu.serve import TenantSpecError, parse_tenants, \
+        resolve_tenants
+    ts = parse_tenants("alice:token=aa:weight=3:ops=100:keys=2:wal=64,"
+                       "bob:token=bb")
+    assert ts[0].weight == 3 and ts[0].max_pending_ops == 100
+    assert ts[0].max_keys == 2 and ts[0].max_wal_bytes == 64
+    assert ts[1].weight == 1 and ts[1].token == "bb"
+    for bad in ("al ice:token=t", "a:bogus=1", "a:weight=0",
+                "a:ops=x", "a:token=", "a,a", "a:token=t,b:token=t"):
+        with pytest.raises(TenantSpecError):
+            parse_tenants(bad)
+    # env resolution: unset -> None (single-tenant), malformed raises
+    monkeypatch.delenv("JEPSEN_TPU_TENANTS", raising=False)
+    assert resolve_tenants() is None
+    monkeypatch.setenv("JEPSEN_TPU_TENANTS", "x:nope=1")
+    with pytest.raises(EnvFlagError):
+        resolve_tenants()
+    monkeypatch.setenv("JEPSEN_TPU_TENANTS", "x:token=t:weight=2,y")
+    tt = resolve_tenants()
+    assert tt.names() == ["x", "y"] and tt.by_token("t").name == "x"
+    # derived pending bound: weight share of the budget
+    assert tt.pending_bound("x", 90) == 60
+    assert tt.pending_bound("y", 90) == 30
+
+
+def test_service_tenant_auth_and_isolation():
+    h1, _ = _register_streams()
+    svc = _tenant_svc()
+    try:
+        r = svc.submit("ka", h1[:8], token="tok-a")
+        assert r["accepted"] and r["tenant"] == "ten-a"
+        # unknown token / missing identity / wrong tenant name
+        assert "unauthorized" in svc.submit("ka", h1[8:],
+                                            token="zz")["error"]
+        assert "tenant required" in svc.submit("ka", h1[8:])["error"]
+        assert "unknown tenant" in svc.submit(
+            "ka", h1[8:], tenant="nobody")["error"]
+        # tenant isolation: ten-b cannot touch (or even probe) ka
+        assert "another tenant" in svc.submit("ka", h1[8:],
+                                              token="tok-b")["error"]
+        assert "another tenant" in svc.result("ka",
+                                              token="tok-b")["error"]
+        assert "another tenant" in svc.finalize("ka",
+                                                token="tok-b")["error"]
+        # the owner still can; an UNIDENTIFIED read is refused too —
+        # result/finalize are not a side door around the auth submit
+        # enforces (a tokenless stdio line must not read, let alone
+        # seal, another tenant's key)
+        assert svc.result("ka", timeout=60,
+                          token="tok-a").get("valid?") is not None
+        assert "tenant required" in svc.result("ka")["error"]
+        assert "tenant required" in svc.finalize("ka")["error"]
+    finally:
+        svc.close()
+
+
+def test_service_tenant_quota_sheds_immediately():
+    from jepsen_tpu.serve import Tenant
+    h = list(rand_register_history(n_ops=40, n_processes=4,
+                                   n_values=3, seed=22))
+    svc = _tenant_svc(
+        tenants=[Tenant("ten-q", token="tq", max_pending_ops=8,
+                        max_keys=1)],
+        start_worker=False)
+    try:
+        t0 = time.monotonic()
+        assert svc.submit("q1", h[:8], token="tq")["accepted"]
+        # pending-ops quota: IMMEDIATE shed (no backpressure wait),
+        # structured reason + tenant
+        r = svc.submit("q1", h[8:16], token="tq", timeout=30)
+        assert r["shed"] is True and r["tenant"] == "ten-q"
+        assert "pending-ops quota" in r["reason"]
+        assert time.monotonic() - t0 < 5   # never sat out the timeout
+        # key quota: a second key is refused before it is minted
+        r2 = svc.submit("q2", h[:4], token="tq")
+        assert r2["shed"] is True and "key quota" in r2["reason"]
+        assert '"q2"' not in svc.status()["keys"]
+        st = svc.status()["tenants"]["ten-q"]
+        assert st["acct"]["sheds"] == 2 and st["pending_ops"] == 8
+    finally:
+        svc.close(drain=False)   # the worker never ran, by design
+
+
+def test_service_tenant_wal_quota(tmp_path):
+    from jepsen_tpu.serve import Tenant
+    h = list(rand_register_history(n_ops=24, n_processes=3, seed=23))
+    svc = _tenant_svc(
+        tenants=[Tenant("ten-w", token="tw", max_wal_bytes=64)],
+        wal_dir=str(tmp_path / "wal"))
+    try:
+        assert svc.submit("w1", h[:8], token="tw",
+                          timeout=60)["accepted"]
+        svc.drain(timeout=60)
+        # the first delta's bytes blew the 64-byte quota: next sheds
+        r = svc.submit("w1", h[8:16], token="tw", timeout=30)
+        assert r["shed"] is True and "WAL-bytes quota" in r["reason"]
+        assert svc.status()["tenants"]["ten-w"]["wal_bytes"] > 64
+    finally:
+        svc.close()
+
+
+def test_tenant_fairness_flood_never_sheds_quiet_pin():
+    """THE fairness acceptance pin: one tenant flooding past its
+    quota, the other's deltas are NEVER shed, its ack p99 stays
+    within SLO, and /metrics shows both per tenant."""
+    from jepsen_tpu import obs
+    from jepsen_tpu.obs import httpd as ops_httpd
+    h = list(rand_register_history(n_ops=200, n_processes=4,
+                                   n_values=3, seed=24))
+    from jepsen_tpu.serve import Tenant
+    svc = _tenant_svc(
+        tenants=[Tenant("fp-flood", token="tf"),
+                 Tenant("fp-quiet", token="tq2")],
+        global_bound=200, high_water=100, start_worker=False)
+    try:
+        # flood: fp-flood's derived bound is 50 ops (weight share of
+        # the high-water); everything past it sheds immediately
+        flood_sheds = 0
+        for i in range(0, 160, 4):
+            r = svc.submit("fkey", h[i:i + 4], token="tf",
+                           timeout=0.05)
+            if r.get("shed"):
+                flood_sheds += 1
+                assert r["tenant"] == "fp-flood"
+        assert flood_sheds > 0, "the flood never hit its quota"
+        # quiet tenant: every delta admits, acks fast, zero sheds
+        for i in range(0, 40, 4):
+            r = svc.submit("qkey", h[i:i + 4], token="tq2",
+                           timeout=5)
+            assert r.get("accepted"), r
+        st = svc.status()["tenants"]
+        assert st["fp-quiet"]["acct"]["sheds"] == 0
+        assert st["fp-flood"]["acct"]["sheds"] == flood_sheds
+        # global queue never hit the shed line: the flood was capped
+        # at ITS share, which is why the quiet tenant admits at all
+        assert svc.stats()["pending_ops"] <= 100
+        svc.start_worker()
+        assert svc.drain(timeout=120)
+        # nothing admitted was lost, per tenant
+        assert svc.result("qkey", timeout=60,
+                          token="tq2")["seq"] == 10
+        # SLO: the quiet tenant's ack p99 from its LABELED histogram
+        snap = obs.registry().snapshot()
+        hq = snap[obs.labeled("serve.ack_secs", tenant="fp-quiet")]
+        assert hq["count"] >= 10
+        assert obs.hist_quantile(hq, 0.99) <= 2.5, hq
+        # and the per-tenant series are visible on /metrics, labeled
+        text = ops_httpd.render_prometheus()
+        assert 'jepsen_serve_ack_secs_bucket{tenant="fp-quiet"' \
+            in text
+        assert 'jepsen_serve_sheds{tenant="fp-flood"}' in text
+        parsed = ops_httpd.parse_prometheus(text)
+        assert parsed[obs.labeled("jepsen_serve_ack_secs",
+                                  tenant="fp-quiet")]["count"] >= 10
+    finally:
+        svc.close()
+
+
+def test_tenant_drr_take_order_respects_weights():
+    """White-box DRR pin: with equal backlogs, one worker cycle takes
+    ops proportional to tenant weights (3:1 here), and leftover
+    backlog stays queued for later cycles."""
+    from jepsen_tpu.serve import Tenant
+    h = list(rand_register_history(n_ops=96, n_processes=4, seed=25))
+    svc = _tenant_svc(
+        tenants=[Tenant("drr-big", token="b3", weight=3),
+                 Tenant("drr-small", token="s1", weight=1)],
+        global_bound=4096, high_water=0, drr_quantum=4,
+        start_worker=False)
+    try:
+        for i in range(0, 48, 4):
+            assert svc.submit("bk", h[i:i + 4], token="b3")["accepted"]
+            assert svc.submit("sk", h[i:i + 4], token="s1")["accepted"]
+        with svc._cond:
+            batch = svc._take_work_locked()
+        took = {ks.tenant: len(ops) for ks, ops, _seq, _f in batch}
+        assert took == {"drr-big": 12, "drr-small": 4}
+        # the rest stayed queued, accounted per tenant
+        st = svc.status()["tenants"]
+        assert st["drr-big"]["pending_ops"] == 36
+        assert st["drr-small"]["pending_ops"] == 44
+    finally:
+        svc.close(drain=False)
+
+
+def test_tenant_hammer_never_reorders_a_key(tmp_path):
+    """Threaded multi-tenant hammer (the satellite pin): two tenants'
+    producers interleave deltas on one service concurrently; every
+    key's seq stream applies in order and the final verdicts are
+    bit-identical to one-shot checks of each key's full stream."""
+    import threading as th
+    from jepsen_tpu.serve import Tenant
+    streams = {}
+    for i, key in enumerate(("h-a1", "h-a2", "h-b1", "h-b2")):
+        streams[key] = list(rand_register_history(
+            n_ops=20, n_processes=3, n_values=3, seed=40 + i))
+    svc = _tenant_svc(
+        tenants=[Tenant("hm-a", token="ha"), Tenant("hm-b",
+                                                    token="hb")],
+        wal_dir=str(tmp_path / "wal"), global_bound=4096,
+        high_water=0)
+    errs = []
+
+    def producer(key, token):
+        ops = streams[key]
+        step = -(-len(ops) // 10)
+        for seq in range(1, 11):
+            lo = (seq - 1) * step
+            r = svc.submit(key, ops[lo:lo + step], seq=seq,
+                           token=token, timeout=120)
+            if not r.get("accepted"):
+                errs.append((key, seq, r))
+                return
+
+    try:
+        threads = [th.Thread(target=producer, args=(k, t))
+                   for k, t in (("h-a1", "ha"), ("h-a2", "ha"),
+                                ("h-b1", "hb"), ("h-b2", "hb"))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errs, errs
+        assert svc.drain(timeout=120)
+        for key, ops in streams.items():
+            r = svc.result(key, timeout=60,
+                           token="ha" if "-a" in key else "hb")
+            assert r["seq"] == 10, (key, r)
+            assert _pin(r) == _pin(_oneshot(CASRegister, ops)), key
+    finally:
+        svc.close()
+
+
+def test_service_tenant_recovery_rehomes_ownership(tmp_path):
+    """Kill-and-restart keeps tenancy: the WAL header's tenant stamp
+    re-homes each key to its owner, WAL-bytes accounting is restored,
+    and cross-tenant access stays refused after the restart."""
+    from jepsen_tpu.serve import Tenant
+    h1, _ = _register_streams()
+    wal = str(tmp_path / "wal")
+    tenants = [Tenant("rc-a", token="ra"), Tenant("rc-b", token="rb")]
+    svc = _tenant_svc(tenants=list(tenants), wal_dir=wal)
+    try:
+        svc.submit("rka", h1[:24], token="ra", wait=True, timeout=120)
+        ref = svc.result("rka", timeout=60, token="ra")
+    finally:
+        svc.close()
+    svc2 = _tenant_svc(tenants=list(tenants), wal_dir=wal)
+    try:
+        st = svc2.status()
+        assert st["keys"]['"rka"']["tenant"] == "rc-a"
+        assert st["tenants"]["rc-a"]["wal_bytes"] > 0
+        assert "another tenant" in svc2.submit(
+            "rka", h1[24:], token="rb")["error"]
+        q = svc2.result("rka", timeout=120, token="ra")
+        assert _pin(q) == _pin(ref)
+    finally:
+        svc2.close()
+
+
+# ------------------------------------------------- WAL segmentation
+
+
+def test_wal_rotate_segments_replay_and_sizes(tmp_path):
+    ops = [invoke_op(0, "write", 1), ok_op(0, "write", 1)]
+    w = DeltaWAL(str(tmp_path))
+    w.append("k", 1, ops)
+    w.rotate("k")
+    w.append("k", 2, ops)
+    w.append("k", 3, ops)
+    w.close()
+    w2 = DeltaWAL(str(tmp_path))
+    segs = w2.segments("k")
+    assert len(segs) == 2 and segs[0].endswith(".wal") \
+        and segs[1].endswith(".wal.1")
+    assert [s for s, _ in w2.replay("k")] == [1, 2, 3]
+    assert w2.keys() == ["k"]
+    assert w2.size_bytes("k") == sum(os.path.getsize(p) for p in segs)
+    # appends continue into the newest segment, never a sealed one
+    w2.append("k", 4, ops)
+    assert [s for s, _ in w2.replay("k")] == [1, 2, 3, 4]
+    assert os.path.getsize(segs[0]) == w2.size_bytes("k") \
+        - os.path.getsize(segs[1])
+    w2.close()
+    # rotating a never-written key is a no-op, not an orphaned file
+    w3 = DeltaWAL(str(tmp_path / "fresh"))
+    w3.rotate("nope")
+    w3.append("nope", 1, ops)
+    assert len(w3.segments("nope")) == 1
+    w3.close()
+
+
+def test_wal_auto_rotation_by_size(tmp_path, monkeypatch):
+    ops = [invoke_op(0, "write", 1), ok_op(0, "write", 1)]
+    w = DeltaWAL(str(tmp_path), segment_bytes=150)
+    for seq in range(1, 6):
+        w.append("k", seq, ops)
+    w.close()
+    assert len(DeltaWAL(str(tmp_path)).segments("k")) >= 2
+    assert [s for s, _ in DeltaWAL(str(tmp_path)).replay("k")] \
+        == [1, 2, 3, 4, 5]
+    # the env knob is validated like every other flag
+    monkeypatch.setenv("JEPSEN_TPU_SERVE_WAL_SEGMENT_BYTES", "nope")
+    with pytest.raises(EnvFlagError):
+        DeltaWAL(str(tmp_path / "x"))
+    monkeypatch.setenv("JEPSEN_TPU_SERVE_WAL_SEGMENT_BYTES", "-1")
+    with pytest.raises(EnvFlagError):
+        DeltaWAL(str(tmp_path / "x"))
+
+
+def test_wal_torn_tail_tolerated_across_segment_boundary(tmp_path):
+    """The re-pinned torn-tail contract: a torn trailing line in a
+    NON-final segment (crash mid-write, restart rotated) is an
+    unacknowledged kill — tolerated and counted — while a corrupt
+    line BEFORE any segment's tail stays a loud WALError."""
+    import json as _json
+    ops = [invoke_op(0, "write", 1), ok_op(0, "write", 1)]
+    w = DeltaWAL(str(tmp_path))
+    w.append("k", 1, ops)
+    w.close()
+    base = DeltaWAL(str(tmp_path)).segments("k")[0]
+    with open(base, "a") as fh:
+        fh.write('{"seq": 2, "ops": ["torn')   # mid-write kill
+    # the restart rotated before appending: segment 1 exists with its
+    # own header + an acknowledged delta
+    with open(base + ".1", "w") as fh:
+        fh.write(_json.dumps({"key": '"k"', "segment": 1}) + "\n")
+        from jepsen_tpu.history import op_to_edn_str
+        fh.write(_json.dumps(
+            {"seq": 3, "ops": [op_to_edn_str(o) for o in ops]}) + "\n")
+    deltas = DeltaWAL(str(tmp_path)).replay("k")
+    assert [s for s, _ in deltas] == [1, 3]
+    # but corruption BEFORE a segment's tail is acknowledged data
+    with open(base + ".1", "a") as fh:
+        fh.write(_json.dumps(
+            {"seq": 4, "ops": [op_to_edn_str(o) for o in ops]}) + "\n")
+    lines = open(base + ".1").read().splitlines()
+    lines[1] = '{"seq": 3, "ops": ["broken'
+    with open(base + ".1", "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    from jepsen_tpu.serve import WALError
+    with pytest.raises(WALError, match="not the tail"):
+        DeltaWAL(str(tmp_path)).replay("k")
+
+
+def test_tenant_drr_finalize_waits_for_queue_drain():
+    """Review pin: a finalize requested while the tenant's deficit
+    ran out mid-drain must NOT seal the key over acknowledged-but-
+    unapplied deltas — the final verdict covers every admitted delta
+    (bit-identical to one-shot), however many DRR cycles that takes."""
+    import threading as th
+    from jepsen_tpu.serve import Tenant
+    h = list(rand_register_history(n_ops=48, n_processes=4, seed=26))
+    svc = _tenant_svc(
+        tenants=[Tenant("fin-t", token="ft")],
+        global_bound=4096, high_water=0, drr_quantum=4,
+        start_worker=False)
+    try:
+        n = 0
+        for i in range(0, len(h), 8):
+            assert svc.submit("fk", h[i:i + 8], token="ft",
+                              timeout=30)["accepted"]
+            n += 1
+        out = {}
+
+        def fin():
+            out["r"] = svc.finalize("fk", timeout=120, token="ft")
+
+        t = th.Thread(target=fin)
+        t.start()
+        time.sleep(0.1)
+        svc.start_worker()   # quantum 4 vs 8-op deltas: many cycles
+        t.join(timeout=120)
+        r = out["r"]
+        assert r["seq"] == n, r
+        assert _pin(r) == _pin(_oneshot(CASRegister, h))
+    finally:
+        svc.close()
+
+
+def test_tenant_wal_quota_lifts_after_archiving(tmp_path):
+    """Review pin: the WAL-bytes meter re-syncs from disk when the
+    quota trips, so the documented operator relief — archiving the
+    key's segments — actually lifts the quota without a restart."""
+    from jepsen_tpu.serve import Tenant
+    h = list(rand_register_history(n_ops=24, n_processes=3, seed=27))
+    wal = str(tmp_path / "wal")
+    svc = _tenant_svc(
+        tenants=[Tenant("ar-w", token="aw", max_wal_bytes=64)],
+        wal_dir=wal)
+    try:
+        assert svc.submit("wk", h[:8], token="aw",
+                          timeout=60)["accepted"]
+        svc.drain(timeout=60)
+        r = svc.submit("wk", h[8:16], token="aw", timeout=30)
+        assert r["shed"] is True and "WAL-bytes quota" in r["reason"]
+        # the operator archives the key's segments (the WAL is the
+        # durability record, so this is a deliberate, loud act)
+        for name in os.listdir(wal):
+            if name.endswith(".wal"):
+                os.remove(os.path.join(wal, name))
+        r2 = svc.submit("wk", h[8:16], token="aw", timeout=60)
+        assert r2.get("accepted"), r2
+    finally:
+        svc.close()

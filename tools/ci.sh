@@ -14,7 +14,14 @@
 #      batch check, clean drain, AND the live ops surface on an
 #      ephemeral port: /healthz ready, /metrics valid Prometheus
 #      text with the serve SLO histograms, /status listing both
-#      keys (docs/streaming.md + docs/observability.md, smoke scale)
+#      keys (docs/streaming.md + docs/observability.md, smoke scale),
+#      plus the two-tenant HTTP-ingress fairness wiring (flood shed
+#      with tenant attribution, quiet tenant fully acked)
+#   1d. multi-tenant soak smoke — tools/soak.py --smoke (~10 s):
+#      sustained multi-tenant load over the HTTP ingress with
+#      JEPSEN_TPU_FAULTS armed mid-run (wedge/crash/flaky/slow);
+#      asserts zero verdict flips, bounded memory, flood-tenant
+#      sheds, quiet-tenant SLOs populated per tenant on /metrics
 #   2. tier-1 tests     — the ROADMAP.md invocation verbatim: the
 #      full suite minus the slow tier on a virtual 8-device CPU mesh,
 #      under the documented 870s budget (timeout -k 10 870). The
@@ -34,6 +41,9 @@ env JAX_PLATFORMS=cpu python tools/fault_smoke.py || exit 1
 
 echo "== streaming-checker smoke =="
 env JAX_PLATFORMS=cpu python tools/serve_smoke.py || exit 1
+
+echo "== multi-tenant soak smoke =="
+env JAX_PLATFORMS=cpu python tools/soak.py --smoke || exit 1
 
 echo "== tier-1 tests (870s budget) =="
 set -o pipefail

@@ -19,10 +19,19 @@ JEPSEN_TPU_FAULTS), and asserts:
     with buckets), and /status lists both smoke keys with their seqs
     (the ISSUE 9 acceptance wiring, end to end).
 
-`tools/ci.sh` runs this right after fault_smoke. This is a wiring
-check; tests/test_serve.py + tests/test_obs_httpd.py carry the full
-matrix (families, evict/thaw, WAL replay, overload, exposition
-format, healthz degradation, flight recorder).
+  * the HTTP ingress admits through the same tenant layer: a second
+    service with two tenants (one FLOODING past its quota over
+    POST /v1/deltas) still acks every quiet-tenant delta, sheds the
+    flood with structured {shed, reason, tenant} answers, and shows
+    both on the per-tenant /metrics labels (the ISSUE 12 fairness
+    wiring, end to end).
+
+`tools/ci.sh` runs this right after fault_smoke (and tools/soak.py
+--smoke right after it). This is a wiring check; tests/test_serve.py
++ tests/test_ingress.py + tests/test_ring.py + tests/test_obs_httpd.py
+carry the full matrix (families, evict/thaw, WAL replay, overload,
+tenancy quotas, ring handoff, exposition format, healthz degradation,
+flight recorder).
 """
 
 import os
@@ -79,6 +88,82 @@ def _check_ops_surface(ops) -> int:
             print(f"serve-smoke: /status missing key {k} at seq 3: "
                   f"{row}")
             failures += 1
+    return failures
+
+
+def _check_ingress_two_tenants() -> int:
+    """The fairness wiring at smoke scale: over the HTTP ingress, one
+    tenant floods past its quota (sheds, with tenant attribution)
+    while the other tenant's deltas all ack. The worker starts
+    STOPPED so 'flooding' is deterministic. Returns failures."""
+    import json
+    import urllib.request
+
+    from jepsen_tpu import obs
+    from jepsen_tpu.histories import rand_register_history
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.obs import httpd as ops_httpd
+    from jepsen_tpu.serve import CheckerService, Tenant
+    from jepsen_tpu.serve.ingress import DeltaIngress
+
+    failures = 0
+    h = list(rand_register_history(n_ops=80, n_processes=4,
+                                   n_values=3, seed=77))
+    svc = CheckerService(
+        CASRegister(), capacity=128,
+        tenants=[Tenant("smoke-flood", token="tf"),
+                 Tenant("smoke-quiet", token="tq")],
+        global_bound=400, high_water=100, start_worker=False)
+    ing = DeltaIngress(svc, port=0).start()
+
+    def post(reqs, token):
+        body = "".join(json.dumps(r) + "\n" for r in reqs).encode()
+        rq = urllib.request.Request(
+            ing.url("/v1/deltas"), data=body,
+            headers={"Authorization": f"Bearer {token}"})
+        with urllib.request.urlopen(rq, timeout=60) as resp:
+            return [json.loads(ln) for ln in
+                    resp.read().decode().splitlines()]
+
+    try:
+        # flood: each tenant's derived bound is 50 ops; 20 deltas of
+        # 8 ops = 160 ops attempted, so most MUST shed — immediately,
+        # with the tenant named
+        outs = post([{"key": "fk", "ops": [dict(o) for o in
+                                           h[i:i + 8]],
+                      "timeout": 0.05} for i in range(0, 160, 8)],
+                    "tf")
+        sheds = [o for o in outs if o.get("shed")]
+        if not sheds or any(o.get("tenant") != "smoke-flood"
+                            for o in sheds):
+            print(f"serve-smoke: flood tenant never shed (or shed "
+                  f"without tenant attribution): {outs[-1]}")
+            failures += 1
+        # quiet tenant: every delta acks despite the flood
+        outs = post([{"key": "qk", "ops": [dict(o) for o in
+                                           h[i:i + 8]],
+                      "timeout": 5} for i in range(0, 40, 8)], "tq")
+        if not all(o.get("accepted") for o in outs):
+            print(f"serve-smoke: quiet tenant delta not acked under "
+                  f"flood: {outs}")
+            failures += 1
+        st = svc.status()["tenants"]
+        if st["smoke-quiet"]["acct"]["sheds"] != 0:
+            print(f"serve-smoke: quiet tenant was shed: "
+                  f"{st['smoke-quiet']}")
+            failures += 1
+        # the per-tenant series are on /metrics, labeled
+        text = ops_httpd.render_prometheus()
+        for needed in ('jepsen_serve_sheds{tenant="smoke-flood"}',
+                       'jepsen_serve_ack_secs_bucket'
+                       '{tenant="smoke-quiet"'):
+            if needed not in text:
+                print(f"serve-smoke: /metrics missing {needed}")
+                failures += 1
+        _ = obs  # imported for parity with the soak's checks
+    finally:
+        ing.close()
+        svc.close(drain=False)   # the worker never ran, by design
     return failures
 
 
@@ -149,6 +234,7 @@ def main() -> int:
     finally:
         svc.close()
         ops.close()
+    failures += _check_ingress_two_tenants()
     for k, ref in refs.items():
         if pin(finals[k]) != pin(ref):
             print(f"serve-smoke: {k} final verdict diverged from the "
@@ -164,7 +250,8 @@ def main() -> int:
     print(f"serve-smoke: streamed verdicts identical to batch "
           f"(k1={finals['k1']['valid?']}, k2={finals['k2']['valid?']}), "
           f"wedge degraded cleanly, drain clean, ops endpoint "
-          f"(/healthz /metrics /status) live")
+          f"(/healthz /metrics /status) live, two-tenant HTTP "
+          f"ingress fair (flood shed, quiet acked)")
     return 0
 
 

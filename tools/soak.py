@@ -1,0 +1,302 @@
+#!/usr/bin/env python
+"""Multi-tenant serve soak harness (ISSUE 12 acceptance): sustained
+load through the HTTP ingress with faults armed mid-soak, verified
+against /metrics.
+
+What it drives:
+
+  * one multi-tenant CheckerService (WAL-backed, ops endpoint, HTTP
+    ingress) — the full `jepsen serve --checker --ingress-port N`
+    stack, in-process;
+  * one FLOODING tenant hammering past its quota with tiny timeouts
+    (it must shed, structurally, and hurt nobody else);
+  * N quiet tenants streaming real histories as deltas over
+    POST /v1/deltas, finalizing each key when its stream ends;
+  * a mid-soak fault window arming JEPSEN_TPU_FAULTS with a wedge, a
+    crash, a transient, AND the new deterministic latency fault
+    (``slow@search``) — the degradation paths run under load, not in
+    isolation.
+
+What it asserts (each failure printed and counted; exit 1 on any):
+
+  * ZERO verdict flips: a flip monitor polls every key's verdict
+    through the soak — a decided-invalid verdict never flips back
+    (prefix closure), and every finalized key's verdict+counterexample
+    is bit-identical to a one-shot check of exactly the ops the
+    service accepted;
+  * bounded memory: pending ops never exceeded the global bound
+    (max_pending_seen), and the drain ends at zero;
+  * fairness: the flooding tenant shed (it outran its quota) while
+    every quiet tenant shed NOTHING and acked within the SLO;
+  * /metrics tells the story per tenant: the labeled
+    ``serve.ack_secs``/``verdict_secs`` histograms are populated for
+    every tenant, the flood tenant's labeled shed counter moved, and
+    the quiet tenants' ack p99 (computed from the scraped exposition,
+    not in-process state) is within the SLO.
+
+``--smoke`` is the CI shape (~10 s; tools/ci.sh runs it after
+serve_smoke); the default is a ~60 s soak and ``--secs`` scales it up
+to the multi-hour shape the ROADMAP names.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ACK_SLO_SECS = 5.0       # quiet-tenant ack p99 budget (CPU CI box)
+FAULT_SPEC = ("wedge@search:n=1,flaky@dispatch:n=2,"
+              "raise@pipeline:n=1,slow@search:ms=10")
+
+
+def _post_lines(url, reqs, token, timeout=180):
+    body = "".join(json.dumps(r) + "\n" for r in reqs).encode()
+    req = urllib.request.Request(
+        url, data=body, headers={"Authorization": f"Bearer {token}"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return [json.loads(ln) for ln in
+                resp.read().decode().splitlines()]
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--secs", type=float, default=60.0,
+                   help="soak duration (the producers stop extending "
+                        "at the deadline and finalize)")
+    p.add_argument("--smoke", action="store_true",
+                   help="CI shape: ~10 s, small histories")
+    p.add_argument("--quiet-tenants", type=int, default=2)
+    p.add_argument("--keys-per-tenant", type=int, default=2)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+    if args.smoke:
+        args.secs = min(args.secs, 10.0)
+
+    from jepsen_tpu import obs, resilience
+    from jepsen_tpu.histories import corrupt_history, \
+        rand_register_history
+    from jepsen_tpu.history import History
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.obs import httpd as ops_httpd
+    from jepsen_tpu.parallel import encode as enc_mod, engine
+    from jepsen_tpu.serve import CheckerService, Tenant
+    from jepsen_tpu.serve.ingress import DeltaIngress
+
+    failures = []
+
+    def fail(msg):
+        print(f"soak: FAIL {msg}")
+        failures.append(msg)
+
+    # --- the fleet-shaped single instance
+    quiet = [f"soak-q{i}" for i in range(args.quiet_tenants)]
+    tenants = [Tenant("soak-flood", token="tok-flood", weight=1)] + [
+        Tenant(name, token=f"tok-{name}", weight=2) for name in quiet]
+    wal = tempfile.mkdtemp(prefix="jepsen_soak_wal_")
+    svc = CheckerService(CASRegister(), wal_dir=wal, capacity=256,
+                         tenants=tenants, global_bound=4096,
+                         high_water=512)
+    ops_srv = ops_httpd.start_ops_server(
+        0, health_fn=svc.health, status_fn=svc.status,
+        refresh_fn=svc.refresh_gauges)
+    ing = DeltaIngress(svc, port=0).start()
+    deltas_url = ing.url("/v1/deltas")
+
+    # --- per-key histories, chopped into deltas
+    n_ops = 48 if args.smoke else 96
+    cut = 8
+    streams = {}   # (tenant, key) -> [delta ops...]
+    for ti, tname in enumerate(quiet):
+        for ki in range(args.keys_per_tenant):
+            h = rand_register_history(
+                n_ops=n_ops, n_processes=4, n_values=3, crash_p=0.04,
+                seed=args.seed + 100 * ti + ki)
+            if ki % 2:
+                h = corrupt_history(h, seed=ki, n_corruptions=2)
+            ops = list(h)
+            streams[(tname, f"{tname}-k{ki}")] = [
+                ops[i:i + cut] for i in range(0, len(ops), cut)]
+
+    accepted = {k: [] for k in streams}   # ops the service admitted
+    finals = {}
+    stop_flood = threading.Event()
+    flip_stop = threading.Event()
+    flips = []
+
+    def flood():
+        """The misbehaving tenant: floods until told to stop; its
+        sheds are EXPECTED (and asserted)."""
+        h = list(rand_register_history(n_ops=400, n_processes=4,
+                                       n_values=3, seed=9999))
+        i = 0
+        while not stop_flood.is_set():
+            lo = (i * 32) % (len(h) - 32)
+            try:
+                # no explicit seq: the service assigns enq_seq+1, so
+                # a shed delta does not leave a gap behind it
+                _post_lines(deltas_url,
+                            [{"key": "flood-k", "ops":
+                              [dict(o) for o in h[lo:lo + 32]],
+                              "timeout": 0.05}],
+                            "tok-flood", timeout=60)
+            except OSError as err:
+                fail(f"flood producer transport error: {err}")
+                return
+            i += 1
+
+    def producer(tname, key):
+        pieces = streams[(tname, key)]
+        deadline = time.monotonic() + args.secs
+        for seq, piece in enumerate(pieces, start=1):
+            if time.monotonic() > deadline:
+                break
+            outs = _post_lines(
+                deltas_url,
+                [{"key": key, "ops": [dict(o) for o in piece],
+                  "seq": seq, "timeout": 120}],
+                f"tok-{tname}", timeout=180)
+            r = outs[0]
+            if r.get("shed"):
+                fail(f"quiet tenant {tname} delta shed: {r}")
+                break
+            if not r.get("accepted"):
+                fail(f"quiet tenant {tname} submit error: {r}")
+                break
+            accepted[(tname, key)].append(piece)
+        outs = _post_lines(deltas_url,
+                           [{"op": "finalize", "key": key,
+                             "timeout": 180}],
+                           f"tok-{tname}", timeout=240)
+        finals[(tname, key)] = outs[0]
+
+    def flip_monitor():
+        """Polls every quiet key's verdict; a False that later reads
+        True (at any seq) is a verdict flip — the one thing the whole
+        stack promises can never happen."""
+        seen_invalid = set()
+        while not flip_stop.is_set():
+            for (tname, key) in streams:
+                r = svc.result(key, min_seq=0, timeout=0.01,
+                               tenant=tname)
+                v = r.get("valid?")
+                if v is False:
+                    seen_invalid.add(key)
+                elif v is True and key in seen_invalid:
+                    flips.append(key)
+            time.sleep(0.25)
+
+    threads = [threading.Thread(target=producer, args=k, daemon=True)
+               for k in streams]
+    fthread = threading.Thread(target=flood, daemon=True)
+    mthread = threading.Thread(target=flip_monitor, daemon=True)
+    t0 = time.monotonic()
+    mthread.start()
+    fthread.start()
+    for t in threads:
+        t.start()
+
+    # --- the fault window: a third in, arm the full matrix; disarm
+    # two thirds in — recovery has to finish under remaining load
+    time.sleep(args.secs / 3)
+    print(f"soak: arming faults ({FAULT_SPEC})")
+    os.environ["JEPSEN_TPU_FAULTS"] = FAULT_SPEC
+    resilience.reset()
+    time.sleep(args.secs / 3)
+    del os.environ["JEPSEN_TPU_FAULTS"]
+    resilience.reset()
+    print("soak: faults disarmed")
+
+    for t in threads:
+        t.join(timeout=600)
+    stop_flood.set()
+    fthread.join(timeout=120)
+    if not svc.drain(timeout=300):
+        fail("drain did not complete")
+    flip_stop.set()
+    mthread.join(timeout=30)
+
+    # --- zero verdict flips + bit-identical finals
+    if flips:
+        fail(f"verdict flips observed on {sorted(set(flips))}")
+    for (tname, key), pieces in accepted.items():
+        f = finals.get((tname, key)) or {}
+        ops = [op for piece in pieces for op in piece]
+        if f.get("seq") != len(pieces):
+            fail(f"{key}: final seq {f.get('seq')} != accepted "
+                 f"{len(pieces)} — an admitted delta went missing")
+        if not ops:
+            continue
+        ref = engine.check_encoded(
+            enc_mod.encode(CASRegister(), History.wrap(ops)),
+            capacity=256)
+        pin = lambda r: {k: r.get(k) for k in  # noqa: E731
+                         ("valid?", "op", "fail-event")}
+        if pin(f) != pin(ref):
+            fail(f"{key}: final verdict diverged from one-shot: "
+                 f"{pin(f)} != {pin(ref)}")
+
+    # --- bounded memory
+    stats = svc.stats()
+    if stats["max_pending_seen"] > 4096:
+        fail(f"pending ops exceeded the global bound: {stats}")
+    if stats["pending_ops"] != 0:
+        fail(f"pending ops after drain: {stats}")
+
+    # --- fairness + per-tenant SLO, verified from the SCRAPE
+    status = svc.status()
+    trows = status["tenants"]
+    if trows["soak-flood"]["acct"]["sheds"] == 0:
+        fail("the flooding tenant never shed — the quota never bit")
+    for name in quiet:
+        if trows[name]["acct"]["sheds"] != 0:
+            fail(f"quiet tenant {name} was shed "
+                 f"({trows[name]['acct']})")
+    with urllib.request.urlopen(ops_srv.url("/metrics"),
+                                timeout=30) as resp:
+        exposition = resp.read().decode()
+    parsed = ops_httpd.parse_prometheus(exposition)
+    flood_sheds = parsed.get(
+        obs.labeled("jepsen_serve_sheds", tenant="soak-flood"))
+    if not flood_sheds or flood_sheds["value"] <= 0:
+        fail("/metrics shows no labeled sheds for the flood tenant")
+    for name in quiet:
+        for which in ("ack", "verdict"):
+            h = parsed.get(obs.labeled(
+                f"jepsen_serve_{which}_secs", tenant=name))
+            if not h or not h.get("count"):
+                fail(f"/metrics missing populated "
+                     f"serve.{which}_secs{{tenant={name}}}")
+        h = parsed.get(obs.labeled("jepsen_serve_ack_secs",
+                                   tenant=name))
+        p99 = obs.hist_quantile(h, 0.99) if h else None
+        if p99 is None or p99 > ACK_SLO_SECS:
+            fail(f"quiet tenant {name} ack p99 {p99} past the "
+                 f"{ACK_SLO_SECS}s SLO")
+
+    ing.close()
+    ops_srv.close()
+    svc.close()
+    dur = time.monotonic() - t0
+    n_deltas = sum(len(p) for p in accepted.values())
+    if failures:
+        print(f"soak: {len(failures)} failure(s) in {dur:.1f}s")
+        return 1
+    print(f"soak: OK in {dur:.1f}s — {n_deltas} quiet deltas across "
+          f"{len(streams)} keys / {len(quiet)} tenants, flood shed "
+          f"{trows['soak-flood']['acct']['sheds']}x, faults armed "
+          f"mid-soak, zero flips, bounded memory, per-tenant SLOs "
+          f"populated")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
