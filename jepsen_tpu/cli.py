@@ -105,6 +105,13 @@ def base_parser(prog: str = "jepsen") -> argparse.ArgumentParser:
                         "(0 = OS-assigned; default: "
                         "JEPSEN_TPU_OPS_PORT, unset = no ops "
                         "endpoint — docs/observability.md)")
+    s.add_argument("--repl-dir", default=None,
+                   help="with --checker: WAL segment replication "
+                        "target — the successor replica's repl/ "
+                        "mirror (e.g. a shared mount); required when "
+                        "JEPSEN_TPU_SERVE_REPL is async/sync, "
+                        "rejected when it is off (docs/streaming.md "
+                        "'Fleet self-healing')")
     s.add_argument("--ingress-port", type=int, default=None,
                    help="with --checker: accept streamed-JSONL delta "
                         "requests over HTTP on this port "
@@ -319,9 +326,45 @@ def run_serve_cmd(args) -> int:
         from jepsen_tpu.serve import CheckerService, default_wal_dir
         from jepsen_tpu.serve.stdio import run_stdio
         model = getattr(model_ns, SERVE_MODELS[args.model])()
-        svc = CheckerService(model,
-                             wal_dir=args.wal_dir or default_wal_dir(),
-                             dedupe=args.dedupe)
+        # WAL segment replication (docs/streaming.md "Fleet
+        # self-healing"): --repl-dir names the successor's repl/
+        # mirror; the mode comes from JEPSEN_TPU_SERVE_REPL. The
+        # service itself rejects a mode with no target; reject the
+        # inverse here too — a --repl-dir under mode "off" would be
+        # an operator believing replication is on when it is not.
+        from jepsen_tpu.serve import fleet as fleet_mod
+        wal_dir = args.wal_dir or default_wal_dir()
+        repl_mode = fleet_mod.resolve_repl_mode()
+        replicator = None
+        if repl_mode != "off" and not getattr(args, "repl_dir", None):
+            # the service would raise the same complaint — answer it
+            # here as a usage error, not a traceback
+            print(f"jepsen serve: JEPSEN_TPU_SERVE_REPL={repl_mode!r}"
+                  f" but no --repl-dir names the successor's mirror "
+                  f"— add --repl-dir PATH or unset the flag "
+                  f"(docs/streaming.md 'Fleet self-healing')",
+                  file=sys.stderr)
+            return 2
+        if getattr(args, "repl_dir", None):
+            if repl_mode == "off":
+                print("jepsen serve: --repl-dir given but "
+                      "JEPSEN_TPU_SERVE_REPL is off/unset — set the "
+                      "mode (async|sync) or drop the flag",
+                      file=sys.stderr)
+                return 2
+            if not wal_dir:
+                print("jepsen serve: --repl-dir needs a WAL-backed "
+                      "service (--wal-dir / JEPSEN_TPU_SERVE_WAL)",
+                      file=sys.stderr)
+                return 2
+            from jepsen_tpu.serve.wal import DeltaWAL
+            replicator = fleet_mod.SegmentReplicator(
+                DeltaWAL(wal_dir),
+                fleet_mod.constant_dst(args.repl_dir),
+                mode=repl_mode)
+        svc = CheckerService(model, wal_dir=wal_dir,
+                             dedupe=args.dedupe,
+                             replicator=replicator)
         # the live ops surface (docs/observability.md "Ops endpoint"):
         # off unless --ops-port / JEPSEN_TPU_OPS_PORT names a port, so
         # a bare serve is byte-identical to the pre-ops service. The
@@ -344,7 +387,11 @@ def run_serve_cmd(args) -> int:
 
             ops = ops_httpd.start_ops_server(
                 port, host=args.host, health_fn=_health,
-                status_fn=svc.status, refresh_fn=svc.refresh_gauges)
+                status_fn=svc.status, refresh_fn=svc.refresh_gauges,
+                # POST /adopt: the fleet supervisor's live handoff
+                # trigger (WAL-backed services only — adopt_keys
+                # raises without one)
+                adopt_fn=(svc.adopt_keys if wal_dir else None))
             print(f"ops endpoint: http://{args.host}:{ops.port} "
                   f"(/metrics /healthz /status — `jepsen status "
                   f"--port {ops.port}`)", file=sys.stderr)

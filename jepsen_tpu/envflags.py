@@ -307,6 +307,34 @@ def env_path(name: str, what: str = "path") -> Optional[str]:
 #                            available): segmented files are what
 #                            per-tenant WAL quotas meter and replica
 #                            handoff ships
+#   JEPSEN_TPU_SERVE_REPL    env_choice  serve.fleet — WAL segment
+#                            replication mode: "off" (default) |
+#                            "async" (segments ship to the key's ring
+#                            successor from a background thread;
+#                            serve.repl_lag_keys gauges the lag, which
+#                            is also the loss window if the primary's
+#                            DISK dies) | "sync" (the producer's ack
+#                            waits for successor durability — a dead
+#                            node with a dead disk then loses nothing
+#                            acknowledged); a non-off mode with no
+#                            replication target wired (replicator= /
+#                            --repl-dir) raises at service
+#                            construction instead of silently
+#                            protecting nothing
+#   JEPSEN_TPU_FLEET_INTERVAL env_float  serve.fleet — supervisor
+#                            heartbeat interval seconds (default 2.0,
+#                            min 0.01): how often every replica's
+#                            /healthz is polled and breakers advance
+#   JEPSEN_TPU_FLEET_THRESHOLD env_int   serve.fleet — consecutive
+#                            /healthz misses before a replica is
+#                            declared dead and its keys rehomed
+#                            (default 3, min 1; the PR-6 breaker
+#                            threshold, per replica)
+#   JEPSEN_TPU_FLEET_REHOME_RETRIES env_int serve.fleet — bounded
+#                            rehome attempts per supervision tick
+#                            (default 3, min 1; exponential backoff
+#                            between attempts, then the next tick
+#                            retries — a rehome is idempotent)
 #   JEPSEN_TPU_TENANTS       env_raw     serve.tenancy — the tenant
 #                            table: comma-separated
 #                            `<name>[:token=T][:weight=W][:ops=N]

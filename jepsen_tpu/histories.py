@@ -34,7 +34,19 @@ def _simulate(
     Crashed (:info) ops are applied-or-not by `crash_op` and their
     process id is retired for a fresh one; `busy` biases toward opening
     new calls before completing pending ones (higher -> more
-    concurrency -> wider search windows)."""
+    concurrency -> wider search windows).
+
+    **The n_ops contract** (pinned by tests/test_generator.py):
+    ``n_ops`` counts INVOCATIONS — operation attempts — exactly like
+    the reference's generators count :invoke entries. Every invocation
+    also emits exactly one completion row (``ok``/``fail``/``info``),
+    so ``len(history) == 2 * n_ops``, with the two rows of one call
+    interleaved arbitrarily far apart. Do NOT slice a generated
+    history by ``n_ops`` expecting "the whole thing" — that truncates
+    mid-stream, leaves calls dangling open, and reads like a phantom
+    parity bug when two differently-sliced views are compared. Slice
+    by ``len(ops)`` (or not at all); a prefix slice is still a VALID
+    history (open calls are legal), just not the full one."""
     rng = random.Random(seed)
     h = History()
     pending: dict = {}      # process -> (f, invoke value)
@@ -82,8 +94,9 @@ def rand_register_history(
     seed: int = 45100,
 ) -> History:
     """A random, linearizable-by-construction cas-register history
-    (see `_simulate` for the driver semantics). Failed ops never apply.
-    Default seed 45100 is the reference's test seed
+    (see `_simulate` for the driver semantics — NOTE ``n_ops`` counts
+    invocations, so the history has ``2 * n_ops`` rows). Failed ops
+    never apply. Default seed 45100 is the reference's test seed
     (jepsen/src/jepsen/generator/test.clj:30-47).
     """
     state = {"value": None}
@@ -135,7 +148,9 @@ def rand_gset_history(
     seed: int = 45100,
 ) -> History:
     """A random, linearizable-by-construction grow-only-set history:
-    adds of distinct elements and full-set reads (see `_simulate`)."""
+    adds of distinct elements and full-set reads (see `_simulate` —
+    ``n_ops`` counts invocations; the history has ``2 * n_ops``
+    rows)."""
     true_set: set = set()
     counter = iter(range(n_elements))
 
@@ -171,7 +186,8 @@ def rand_queue_history(
 ) -> History:
     """A random, linearizable-by-construction unordered-queue history:
     enqueues of a small value domain and dequeues returning any pending
-    element (see `_simulate`). Dequeues finding the queue empty
+    element (see `_simulate` — ``n_ops`` counts invocations; the
+    history has ``2 * n_ops`` rows). Dequeues finding the queue empty
     complete as :fail (dropped by the checkers, like a client-side
     retryable empty-queue error)."""
     from collections import Counter
@@ -217,7 +233,8 @@ def rand_fifo_history(
     seed: int = 45100,
 ) -> History:
     """A random, linearizable-by-construction strict-FIFO history (see
-    `_simulate`): dequeues return the true head; empty-queue dequeues
+    `_simulate` — ``n_ops`` counts invocations; the history has
+    ``2 * n_ops`` rows): dequeues return the true head; empty-queue dequeues
     complete as :fail (dropped by the checkers). Dequeue-biased once
     the queue runs deep, so the packed device tier's depth bound stays
     inside its 31-bit budget."""

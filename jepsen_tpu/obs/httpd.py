@@ -241,6 +241,37 @@ class _Handler(BaseHTTPRequestHandler):
             except OSError:
                 pass
 
+    def do_POST(self):  # noqa: N802 — stdlib name
+        ops = self.server.ops
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        # drain the (unused) body so keep-alive framing survives
+        clen = int(self.headers.get("Content-Length", 0) or 0)
+        if clen:
+            self.rfile.read(clen)
+        try:
+            if path == "/adopt" and ops.adopt_fn is not None:
+                # the replica-handoff trigger (serve.fleet.
+                # HttpReplica): replay any WAL keys transferred into
+                # this replica's WAL dir into live sessions — the
+                # operator action `rehome` needs on a survivor it
+                # cannot call in-process
+                adopted = ops.adopt_fn()
+                self._json(200, {"adopted": [str(k)
+                                             for k in adopted]})
+            else:
+                self._json(404, {"error": f"unknown POST {path!r}",
+                                 "endpoints": (["/adopt"]
+                                               if ops.adopt_fn
+                                               else [])})
+        except Exception as err:  # noqa: BLE001 — same posture as
+            # do_GET: one bad adoption answers 500, the server lives
+            _log.exception("ops httpd: POST %s failed", path)
+            try:
+                self._json(500, {"error": f"{type(err).__name__}: "
+                                          f"{err}"})
+            except OSError:
+                pass
+
 
 class OpsServer:
     """The ops endpoint as an object: construct (binds the socket —
@@ -252,18 +283,23 @@ class OpsServer:
     status_fn   -> the /status JSON document
     refresh_fn  -> called before every render so computed gauges
                    (queue depth, WAL lag) are point-in-time fresh
+    adopt_fn    -> POST /adopt handler: CheckerService.adopt_keys —
+                   the live replica-handoff trigger the fleet
+                   supervisor drives on survivors (serve.fleet)
 
-    All three are optional — a bare OpsServer still serves /metrics
-    from the process registry, which is exactly what a non-serve
+    All are optional — a bare OpsServer still serves /metrics from
+    the process registry, which is exactly what a non-serve
     embedding (bench, a notebook) wants."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  health_fn: Optional[Callable[[], dict]] = None,
                  status_fn: Optional[Callable[[], dict]] = None,
-                 refresh_fn: Optional[Callable[[], None]] = None):
+                 refresh_fn: Optional[Callable[[], None]] = None,
+                 adopt_fn: Optional[Callable[[], list]] = None):
         self.health_fn = health_fn
         self.status_fn = status_fn
         self.refresh_fn = refresh_fn
+        self.adopt_fn = adopt_fn
         self._httpd = _OpsHTTPServer((host, port), _Handler)
         self._httpd.ops = self
         self.host = self._httpd.server_address[0]
@@ -503,50 +539,83 @@ def render_status_table(status: dict, health: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+def fetch_replica(addr: str, timeout: float = 5.0) -> dict:
+    """One replica's ops view over HTTP — THE fetch path both
+    ``jepsen status --addr`` and the fleet supervisor
+    (``serve.fleet.FleetSupervisor``) consume, so the operator table
+    and the automation read one surface::
+
+        {"addr": ..., "state": "ready" | "degraded" | "unreachable",
+         "health": {...}?, "status": {...}?, "error": ...?}
+
+    ``degraded`` is an ANSWERED /healthz that says not-ok (the
+    replica lives — its WAL still acks); ``unreachable`` is no
+    answer at all (the supervisor's miss signal)."""
+    base = f"http://{addr}"
+    try:
+        hcode, hbody = _fetch(base + "/healthz", timeout)
+        _scode, sbody = _fetch(base + "/status", timeout)
+        health = json.loads(hbody)
+        status = json.loads(sbody)
+    except (OSError, ValueError) as err:
+        return {"addr": addr, "state": "unreachable",
+                "error": str(err)}
+    state = ("ready" if hcode == 200 and health.get("ok")
+             else "degraded")
+    return {"addr": addr, "state": state, "health": health,
+            "status": status}
+
+
+#: worst-of exit codes for a fleet view (also the JSON "exit" field)
+_FLEET_EXIT = {"ready": 0, "degraded": 1, "unreachable": 2}
+
+
 def _fleet_status(args) -> int:
     """The multi-replica view: one section per --addr, then a fleet
     summary. Exit: 2 if any replica is unreachable, else 1 if any is
     degraded, else 0 — worst-of, so a load balancer script reads one
-    code for the whole fleet."""
-    ready, degraded, unreachable = [], [], []
-    docs = {}
+    code for the whole fleet. ``--json`` emits the machine-readable
+    document ``{"replicas": {addr: fetch_replica(addr)},
+    "fleet": {"ready": n, "degraded": n, "unreachable": n,
+    "exit": worst}}`` — the same surface the fleet supervisor and CI
+    consume."""
     for addr in args.addr:
         host, _, port = addr.rpartition(":")
         if not host or not port.isdigit():
             print(f"jepsen status: bad --addr {addr!r} (expected "
                   f"HOST:PORT)", file=sys.stderr)
             return 254
-        base = f"http://{addr}"
-        try:
-            hcode, hbody = _fetch(base + "/healthz", args.timeout)
-            _scode, sbody = _fetch(base + "/status", args.timeout)
-            health = json.loads(hbody)
-            status = json.loads(sbody)
-        except (OSError, ValueError) as err:
-            unreachable.append(addr)
-            docs[addr] = {"error": str(err)}
-            continue
-        docs[addr] = {"health": health, "status": status}
-        (ready if hcode == 200 and health.get("ok")
-         else degraded).append(addr)
+    docs = {addr: fetch_replica(addr, args.timeout)
+            for addr in args.addr}
+    by_state = {"ready": [], "degraded": [], "unreachable": []}
+    for addr in args.addr:
+        by_state[docs[addr]["state"]].append(addr)
+    exit_code = max((_FLEET_EXIT[d["state"]] for d in docs.values()),
+                    default=0)
     if args.json:
-        print(json.dumps(docs, indent=2, sort_keys=True, default=str))
-    else:
-        for addr in args.addr:
-            doc = docs[addr]
-            print(f"== replica {addr} ==")
-            if "error" in doc:
-                print(f"UNREACHABLE: {doc['error']}\n")
-                continue
-            sys.stdout.write(render_status_table(doc["status"],
-                                                 doc["health"]))
-            print()
-        print(f"fleet: {len(ready)} ready, {len(degraded)} degraded, "
-              f"{len(unreachable)} unreachable "
-              f"of {len(args.addr)} replica(s)")
-    if unreachable:
-        return 2
-    return 1 if degraded else 0
+        print(json.dumps(
+            {"replicas": docs,
+             "fleet": {"ready": len(by_state["ready"]),
+                       "degraded": len(by_state["degraded"]),
+                       "unreachable": len(by_state["unreachable"]),
+                       "replicas": len(args.addr),
+                       "exit": exit_code}},
+            indent=2, sort_keys=True, default=str))
+        return exit_code
+    for addr in args.addr:
+        doc = docs[addr]
+        print(f"== replica {addr} ==")
+        if doc["state"] == "unreachable":
+            print(f"UNREACHABLE: {doc.get('error')}\n")
+            continue
+        sys.stdout.write(render_status_table(doc["status"],
+                                             doc["health"]))
+        print()
+    print(f"fleet: {len(by_state['ready'])} ready, "
+          f"{len(by_state['degraded'])} degraded, "
+          f"{len(by_state['unreachable'])} unreachable "
+          f"of {len(args.addr)} replica(s)")
+    return exit_code
 
 
 def status_main(argv: Optional[Sequence[str]] = None) -> int:

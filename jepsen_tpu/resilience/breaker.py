@@ -76,8 +76,14 @@ class CircuitBreaker:
                  backoff_cap: float = BACKOFF_CAP_SECS,
                  clock: Callable[[], float] = time.monotonic,
                  probe: Optional[Callable[[], bool]] = None,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 track_global: bool = True):
         self.backend = backend
+        # track_global=False keeps this breaker out of the module's
+        # _tripped fast-path set: a FLEET breaker watching a PEER
+        # replica's health must not push this process's own device
+        # dispatches onto the slow supervised path (serve.fleet)
+        self._track_global = track_global
         self.threshold = (threshold if threshold is not None
                           else _resolve_threshold())
         self.backoff_base = (backoff_base if backoff_base is not None
@@ -143,6 +149,10 @@ class CircuitBreaker:
         obs.counter("resilience.breaker.opens").inc()
         self._gauge()
 
+    def _note(self, tripped: bool) -> None:
+        if self._track_global:
+            _note_state(self.backend, tripped)
+
     def record_failure(self, reason: str = ""):
         opened = False
         with self._lock:
@@ -158,7 +168,7 @@ class CircuitBreaker:
             else:
                 self._gauge()
             tripped = self._state != CLOSED
-        _note_state(self.backend, tripped)
+        self._note(tripped)
         if opened:
             # an open breaker is exactly the moment a postmortem wants
             # the last spans + metric deltas; a None check when
@@ -172,7 +182,7 @@ class CircuitBreaker:
             self._state = CLOSED   # the base backoff, not an escalated one
             self._last_reason = ""
             self._gauge()
-        _note_state(self.backend, False)
+        self._note(False)
 
     def allow(self) -> Tuple[bool, str]:
         """Whether a dispatch may proceed now. Closed -> yes. Open ->
@@ -202,7 +212,7 @@ class CircuitBreaker:
             self._state = HALF_OPEN
             self._gauge()
             probe = self.probe
-        _note_state(self.backend, True)
+        self._note(True)
         try:
             healthy = bool(probe())
         except Exception:  # noqa: BLE001 — a crashed probe is not health
@@ -215,7 +225,7 @@ class CircuitBreaker:
                 self._gauge()     # backoff escalation must not leak into
             else:                 # the NEXT, unrelated incident
                 self._open_locked()
-        _note_state(self.backend, not healthy)
+        self._note(not healthy)
         if healthy:
             return True, ""
         obs.flight_dump(f"breaker-open-{self.backend}")

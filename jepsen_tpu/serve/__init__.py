@@ -4,11 +4,16 @@ crash-safe front over the incremental extension engine
 verdicts out, with backpressure, load shedding, idle-frontier
 eviction, WAL replay, tenant-isolated weighted-fair admission
 (``serve.tenancy``), an asyncio HTTP delta ingress
-(``serve.ingress``), and consistent-hash replica scale-out with
-freeze/thaw + WAL-segment key migration (``serve.ring``). ``jepsen
-serve --checker`` drives the stdio transport (``serve.stdio``) and,
-with ``--ingress-port``, the HTTP one."""
+(``serve.ingress``), consistent-hash replica scale-out with
+freeze/thaw + WAL-segment key migration (``serve.ring``), and a
+self-healing fleet layer — failure detection + auto-rehome +
+epoch-fenced ownership + WAL segment replication (``serve.fleet``).
+``jepsen serve --checker`` drives the stdio transport
+(``serve.stdio``) and, with ``--ingress-port``, the HTTP one."""
 
+from jepsen_tpu.serve.fleet import (  # noqa: F401
+    FleetSupervisor, HttpReplica, SegmentReplicator,
+)
 from jepsen_tpu.serve.service import (  # noqa: F401
     CheckerService, default_wal_dir,
 )

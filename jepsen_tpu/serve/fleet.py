@@ -1,0 +1,618 @@
+"""Self-healing for the serve fleet: failure detection, automatic
+re-homing, ownership fencing, and WAL segment replication.
+
+PAPER.md's own subject — Jepsen — exists to prove that systems
+survive nemeses; PR 11's fleet only had *manual* recovery
+(``ring.rehome_dead_replica`` invoked by an operator, ``transfer_key``
+needing the dead disk to still be readable, and nothing stopping a
+paused replica from waking up as a second writer). This module closes
+that loop with three cooperating pieces (docs/streaming.md "Fleet
+self-healing"):
+
+:class:`FleetSupervisor`
+    Polls every replica's ``/healthz`` (the same fetch path ``jepsen
+    status --addr`` reads) and runs the PR-6 circuit-breaker state
+    machine PER REPLICA: ``threshold`` consecutive misses open the
+    breaker — the replica is declared dead and its keys are re-homed
+    onto the survivors via :func:`serve.ring.rehome_dead_replica`
+    with bounded retry/backoff, a ``fleet.*`` metric trail, and a
+    flight-recorder dump per rehome. A dead replica that answers
+    again is admitted back through the breaker's half-open probe
+    (``fleet.rejoins``) — for NEW keys only; the keys it lost stay
+    PINNED to their adopters (``pins``), and the epoch fence refuses
+    it the old ones regardless.
+
+:class:`SegmentReplicator`
+    Ships a key's WAL segments to its ring successor's ``repl/``
+    mirror on every durable append (and therefore across rotations —
+    shipping is a size-compared re-copy, so a sealed segment ships
+    once and the active one converges). ``JEPSEN_TPU_SERVE_REPL``
+    picks the mode: ``sync`` acks only after the successor copy is
+    durable (fsynced) — a dead node WITH a dead disk then loses
+    nothing acknowledged; ``async`` ships from a background thread
+    (``serve.repl_lag_keys`` is the lag gauge, and the documented
+    loss window is exactly that lag). A mid-copy kill can leave a
+    torn trailing line on the mirror — the WAL replay already
+    tolerates one torn tail per segment, re-pinned on this path by
+    tests/test_fleet.py.
+
+Epoch fence (the split-brain guard, implemented across ``serve.wal``
+and ``serve.service``; this module drives it): every WAL segment
+header carries an ownership epoch; ``adopt_keys`` bumps it; the
+rehome path writes a fence marker in the dead replica's dir BEFORE
+copying segments. A SIGSTOP'd replica that resumes after its keys
+were rehomed re-checks the fence after its fsync and answers a
+structured refusal on submit/result/finalize instead of acking
+deltas the new owner will never replay.
+
+``tools/chaos.py`` drives all of this under a Jepsen-style nemesis
+schedule (SIGKILL, SIGSTOP/SIGCONT, injected device faults, rolling
+restarts) against a real multi-replica, multi-tenant ingress soak —
+``--smoke`` rides tools/ci.sh.
+
+Import-safe: no JAX at module scope — the supervisor is a
+coordinator that must run (and rehome) while device runtimes are
+wedged, which is precisely when it is needed.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from jepsen_tpu import envflags, obs
+from jepsen_tpu.resilience import breaker as breaker_mod
+from jepsen_tpu.serve import ring as ring_mod
+from jepsen_tpu.serve.wal import DeltaWAL
+
+_log = logging.getLogger(__name__)
+
+REPL_MODES = ("off", "async", "sync")
+
+#: cap on the supervisor's per-attempt rehome backoff
+REHOME_BACKOFF_CAP_SECS = 30.0
+
+
+def resolve_repl_mode(v: Optional[str] = None) -> str:
+    """The WAL segment replication mode: ``off`` (default) | ``async``
+    | ``sync`` (JEPSEN_TPU_SERVE_REPL; strictly validated)."""
+    if v is not None:
+        if v not in REPL_MODES:
+            raise envflags.EnvFlagError(
+                f"replication mode {v!r}: expected one of "
+                f"{REPL_MODES}")
+        return v
+    return envflags.env_choice("JEPSEN_TPU_SERVE_REPL", REPL_MODES,
+                               default="off",
+                               what="WAL replication mode")
+
+
+def resolve_fleet_interval(v: Optional[float] = None) -> float:
+    if v is not None:
+        return float(v)
+    return envflags.env_float("JEPSEN_TPU_FLEET_INTERVAL", default=2.0,
+                              min_value=0.01,
+                              what="fleet heartbeat interval")
+
+
+def resolve_fleet_threshold(v: Optional[int] = None) -> int:
+    if v is not None:
+        return int(v)
+    return envflags.env_int("JEPSEN_TPU_FLEET_THRESHOLD", default=3,
+                            min_value=1,
+                            what="fleet consecutive-miss threshold")
+
+
+def resolve_rehome_retries(v: Optional[int] = None) -> int:
+    if v is not None:
+        return int(v)
+    return envflags.env_int("JEPSEN_TPU_FLEET_REHOME_RETRIES",
+                            default=3, min_value=1,
+                            what="rehome retry budget")
+
+
+# ------------------------------------------------ segment replication
+
+
+def constant_dst(path: str) -> Callable:
+    """A fixed replication destination (``jepsen serve --checker
+    --repl-dir PATH`` — e.g. the successor's mounted ``repl/`` dir)."""
+    return lambda _key: path
+
+
+def ring_successor_dst(ring: ring_mod.HashRing,
+                       wal_dirs: Dict[str, str],
+                       self_node: str) -> Callable:
+    """Per-key replication destination: the key's ring successor's
+    ``repl/`` mirror — the dir :func:`serve.ring.rehome_dead_replica`
+    falls back to when the dead node's own disk is gone."""
+    def dst(key) -> Optional[str]:
+        succ = ring.successor(key)
+        if succ is None or succ == self_node:
+            return None
+        d = wal_dirs.get(succ)
+        return (os.path.join(d, ring_mod.REPL_SUBDIR)
+                if d is not None else None)
+    return dst
+
+
+class SegmentReplicator:
+    """Ships one service's WAL segments to per-key destinations
+    (module docstring). ``after_append(key)`` is the service hook:
+    ``sync`` ships inline and returns False when the successor copy
+    did not land (the ack then carries ``replicated: False``);
+    ``async`` enqueues for the shipper thread and returns None;
+    ``off`` is a no-op.
+
+    Copies are size-compared and INCREMENTAL (append-only files: size
+    IS the version, so the destination size is the resume offset): a
+    first ship lands the whole file via tmp + ``os.replace`` (a
+    reader never sees a partial first copy), and every later ship
+    appends only the suffix — one delta's bytes per ack, not the
+    whole segment re-copied (an unbounded active segment would
+    otherwise make sync acks O(stream) each). A mid-append kill
+    leaves at most a torn final line on the mirror — exactly the
+    per-segment tail the WAL replay already tolerates. ``sync`` mode
+    fsyncs the data AND (for new files) the mirror directory before
+    acking — successor durability means surviving the successor's
+    own power cut."""
+
+    def __init__(self, wal: DeltaWAL, dst_for_key: Callable,
+                 mode: Optional[str] = None):
+        self.wal = wal
+        self.dst_for_key = dst_for_key
+        self.mode = resolve_repl_mode(v=mode)
+        self._cond = threading.Condition()
+        self._pending: Dict[object, bool] = {}   # insertion-ordered
+        self._inflight = 0
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        # per-key ship serialization: two producers acking different
+        # seqs of one key (the handoff releases seq N's writer before
+        # N+1's replication hook runs) must not interleave suffix
+        # appends into the same mirror file
+        self._ship_locks: Dict[object, threading.Lock] = {}
+
+    # -- the copy itself
+
+    def _fsync_path(self, path: str) -> None:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def ship(self, key) -> int:
+        """Copy the key's out-of-date segment bytes to its
+        destination now; returns the number of files touched. Raises
+        OSError on an unreachable destination (callers count +
+        degrade)."""
+        dst = self.dst_for_key(key)
+        if dst is None:
+            return 0
+        with self._cond:
+            lock = self._ship_locks.setdefault(key, threading.Lock())
+        with lock:
+            return self._ship_locked(key, dst)
+
+    def _ship_locked(self, key, dst: str) -> int:
+        os.makedirs(dst, exist_ok=True)
+        shipped = 0
+        for src in self.wal.segments(key):
+            dpath = os.path.join(dst, os.path.basename(src))
+            try:
+                ssize = os.path.getsize(src)
+            except OSError:
+                continue   # rotated away mid-scan
+            try:
+                dsize = os.path.getsize(dpath)
+            except OSError:
+                dsize = -1
+            if dsize == ssize:
+                continue   # already current
+            if 0 <= dsize < ssize:
+                # incremental: append the suffix (the destination
+                # size is the shipped offset)
+                with open(src, "rb") as sf, open(dpath, "ab") as df:
+                    sf.seek(dsize)
+                    shutil.copyfileobj(sf, df)
+                    df.flush()
+                    if self.mode == "sync":
+                        os.fsync(df.fileno())
+                new_bytes = ssize - dsize
+            else:
+                # first copy (or a shrunk source — repair): land the
+                # whole file atomically
+                tmp = dpath + ".tmp"
+                shutil.copyfile(src, tmp)
+                if self.mode == "sync":
+                    self._fsync_path(tmp)
+                os.replace(tmp, dpath)
+                if self.mode == "sync":
+                    # the directory entry must survive the
+                    # successor's power cut too
+                    self._fsync_path(dst)
+                new_bytes = ssize
+            shipped += 1
+            obs.counter("serve.repl_segments_shipped").inc()
+            obs.counter("serve.repl_bytes").inc(new_bytes)
+        return shipped
+
+    # -- the service hook
+
+    def after_append(self, key) -> Optional[bool]:
+        if self.mode == "off":
+            return None
+        if self.mode == "sync":
+            if self.dst_for_key(key) is None:
+                # a sync ack must not imply successor durability when
+                # there is no successor (single-node ring, every peer
+                # dead): mark it primary-durable only
+                obs.counter("serve.repl_no_destination").inc()
+                return False
+            try:
+                self.ship(key)
+                return True
+            except OSError as err:
+                obs.counter("serve.repl_errors").inc()
+                _log.warning("sync replication of key %r failed (%r) "
+                             "— ack is primary-durable only", key, err)
+                return False
+        self.notify(key)
+        return None
+
+    # -- the async shipper
+
+    def notify(self, key) -> None:
+        with self._cond:
+            self._pending[key] = True
+            obs.gauge("serve.repl_lag_keys").set(len(self._pending)
+                                                 + self._inflight)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True,
+                    name="jepsen-repl-shipper")
+                self._thread.start()
+            self._cond.notify_all()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._stop:
+                    self._cond.wait(timeout=0.5)
+                if self._stop and not self._pending:
+                    return
+                key = next(iter(self._pending))
+                del self._pending[key]
+                self._inflight = 1
+                obs.gauge("serve.repl_lag_keys").set(
+                    len(self._pending) + self._inflight)
+            try:
+                self.ship(key)
+            except Exception as err:  # noqa: BLE001 — the shipper
+                # thread must survive a sick destination; the lag
+                # gauge and error counter are the operator's signal
+                obs.counter("serve.repl_errors").inc()
+                _log.warning("async replication of key %r failed "
+                             "(%r)", key, err)
+            finally:
+                with self._cond:
+                    self._inflight = 0
+                    obs.gauge("serve.repl_lag_keys").set(
+                        len(self._pending))
+                    self._cond.notify_all()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until the async queue is empty (True) or the timeout
+        passes (False)."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._cond:
+            while self._pending or self._inflight:
+                rem = (None if deadline is None
+                       else deadline - time.monotonic())
+                if rem is not None and rem <= 0:
+                    return False
+                self._cond.wait(timeout=0.5 if rem is None
+                                else min(rem, 0.5))
+            return True
+
+    def close(self, drain: bool = True) -> None:
+        if drain:
+            self.drain(timeout=30)
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+
+# --------------------------------------------------- remote adoption
+
+
+class HttpReplica:
+    """A survivor handle for a replica in another process: exposes
+    the one method the rehome path needs (``adopt_keys``), served by
+    the replica's ops endpoint (``POST /adopt``, ``obs.httpd``) — so
+    a coordinator can drive live adoption without importing the
+    engine or touching the survivor's device."""
+
+    def __init__(self, addr: str, timeout: float = 60.0):
+        self.addr = addr
+        self.timeout = timeout
+
+    def adopt_keys(self) -> list:
+        import urllib.request
+        req = urllib.request.Request(
+            f"http://{self.addr}/adopt", data=b"", method="POST")
+        with urllib.request.urlopen(req,
+                                    timeout=self.timeout) as resp:
+            return json.loads(resp.read().decode()).get("adopted", [])
+
+
+def _default_fetch(addr: str, timeout: float) -> bool:
+    """Liveness via the ops endpoint — the SAME fetch path `jepsen
+    status --addr` renders (``obs.httpd.fetch_replica``), so the
+    supervisor's dead/alive verdict and the operator's table cannot
+    disagree. ANY HTTP answer counts as alive (a "degraded" replica —
+    breaker open, queue past high-water — still acks into its WAL, so
+    rehoming it would fork the stream); only "unreachable" is a
+    miss."""
+    from jepsen_tpu.obs import httpd as ops_httpd
+    return ops_httpd.fetch_replica(
+        addr, timeout=timeout)["state"] != "unreachable"
+
+
+class _Replica:
+    __slots__ = ("name", "addr", "breaker", "dead", "rehomed")
+
+    def __init__(self, name, addr, breaker):
+        self.name = name
+        self.addr = addr
+        self.breaker = breaker
+        self.dead = False
+        self.rehomed = False
+
+
+class FleetSupervisor:
+    """Automatic failure detection + re-homing for a serve fleet
+    (module docstring).
+
+    ``replicas`` maps name -> ops-endpoint address (``host:port``) —
+    or to None with an injected ``fetch`` (in-process tests).
+    ``services`` maps name -> an object with ``adopt_keys()`` (a
+    local :class:`CheckerService` or an :class:`HttpReplica`).
+    ``wal_dirs`` maps name -> that replica's WAL dir (the transfer
+    source/destination — a shared filesystem or local dirs).
+
+    Drive it with ``start()`` (daemon loop every ``interval``
+    seconds) or deterministic ``tick()`` calls (tests use an
+    injected clock + fetch). All knobs fall back to the validated
+    ``JEPSEN_TPU_FLEET_*`` flags."""
+
+    def __init__(self, replicas: Dict[str, Optional[str]],
+                 wal_dirs: Dict[str, str],
+                 services: Optional[Dict[str, object]] = None,
+                 interval: Optional[float] = None,
+                 threshold: Optional[int] = None,
+                 rehome_retries: Optional[int] = None,
+                 fetch: Optional[Callable] = None,
+                 fetch_timeout: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 vnodes: int = ring_mod.DEFAULT_VNODES,
+                 on_rehome: Optional[Callable] = None,
+                 on_rejoin: Optional[Callable] = None):
+        if set(replicas) != set(wal_dirs):
+            raise ValueError("replicas and wal_dirs must name the "
+                             "same fleet")
+        self.interval = resolve_fleet_interval(interval)
+        self.threshold = resolve_fleet_threshold(threshold)
+        self.rehome_retries = resolve_rehome_retries(rehome_retries)
+        self.wal_dirs = dict(wal_dirs)
+        self.services = dict(services or {})
+        self.ring = ring_mod.HashRing(sorted(replicas), vnodes=vnodes)
+        self.pins: Dict[object, str] = {}
+        self._fetch = fetch if fetch is not None else _default_fetch
+        self._fetch_timeout = fetch_timeout
+        self._clock = clock
+        self._sleep = sleep
+        self._on_rehome = on_rehome
+        self._on_rejoin = on_rejoin
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._reps: Dict[str, _Replica] = {}
+        for name in sorted(replicas):
+            # one PR-6 breaker per replica: consecutive-miss
+            # threshold -> open (dead), half-open probe -> rejoin.
+            # Standalone instances (NOT breaker_for) with
+            # track_global=False: a PEER replica's health must not
+            # show up in this process's own /healthz breaker check,
+            # nor push its own device dispatches onto the slow
+            # supervised path via the module _tripped fast-path set.
+            br = breaker_mod.CircuitBreaker(
+                f"fleet:{name}", threshold=self.threshold,
+                backoff_base=max(self.interval, 0.05), clock=clock,
+                probe=self._make_probe(name, replicas[name]),
+                track_global=False)
+            self._reps[name] = _Replica(name, replicas[name], br)
+            obs.gauge(f"fleet.replica.{name}.alive").set(1)
+        self._gauges()
+
+    # -- health checks
+
+    def _make_probe(self, name: str, addr: Optional[str]):
+        def probe() -> bool:
+            return self._alive(name, addr)
+        return probe
+
+    def _alive(self, name: str, addr: Optional[str]) -> bool:
+        try:
+            return bool(self._fetch(addr if addr is not None
+                                    else name, self._fetch_timeout))
+        except Exception:  # noqa: BLE001 — unreachable IS the signal
+            return False
+
+    # -- the heartbeat round
+
+    def tick(self) -> None:
+        """One supervision round: heartbeat the live replicas, drive
+        the breakers, rehome the newly dead, re-admit the recovered.
+        ``start()`` calls this every ``interval``; tests call it
+        directly with a fake clock."""
+        for r in list(self._reps.values()):
+            if r.dead:
+                if not r.rehomed:
+                    # an earlier rehome attempt exhausted its budget
+                    # (e.g. a survivor's disk hiccup): keep trying,
+                    # one bounded burst per tick
+                    self._try_rehome(r)
+                ok, _why = r.breaker.allow()
+                if ok:
+                    self._rejoin(r)
+                continue
+            obs.counter("fleet.heartbeats").inc()
+            if self._alive(r.name, r.addr):
+                r.breaker.record_success()
+            else:
+                obs.counter("fleet.misses").inc()
+                r.breaker.record_failure("healthz miss")
+                if r.breaker.state == breaker_mod.OPEN:
+                    self._declare_dead(r)
+        self._gauges()
+
+    def _declare_dead(self, r: _Replica) -> None:
+        r.dead = True
+        obs.counter("fleet.deaths").inc()
+        obs.gauge(f"fleet.replica.{r.name}.alive").set(0)
+        _log.warning("fleet: replica %r declared dead after %d "
+                     "consecutive healthz misses — rehoming its keys",
+                     r.name, self.threshold)
+        self._try_rehome(r)
+
+    def _survivors(self) -> Dict[str, str]:
+        return {n: d for n, d in self.wal_dirs.items()
+                if not self._reps[n].dead}
+
+    def _try_rehome(self, r: _Replica) -> Optional[Dict[str, list]]:
+        """Bounded-retry rehome with exponential backoff; on success
+        pins the moved keys, counts ``fleet.rehomes``, and dumps the
+        flight recorder (the postmortem moment an armed ring
+        exists for)."""
+        survivors = self._survivors()
+        if not survivors:
+            _log.error("fleet: no survivors to rehome %r onto",
+                       r.name)
+            obs.counter("fleet.rehome_failures").inc()
+            return None
+        for attempt in range(self.rehome_retries):
+            if attempt:
+                self._sleep(min(self.interval * (2 ** (attempt - 1)),
+                                REHOME_BACKOFF_CAP_SECS))
+            try:
+                plan = ring_mod.rehome_dead_replica(
+                    self.wal_dirs[r.name], self.ring, r.name,
+                    survivors,
+                    {n: s for n, s in self.services.items()
+                     if n in survivors})
+            except Exception as err:  # noqa: BLE001 — a failed
+                # attempt is retried; a failed BUDGET stays pending
+                # and retries next tick
+                obs.counter("fleet.rehome_failures").inc()
+                _log.warning("fleet: rehome of %r failed (attempt "
+                             "%d/%d): %r", r.name, attempt + 1,
+                             self.rehome_retries, err)
+                continue
+            with self._lock:
+                for node, keys in plan.items():
+                    for k in keys:
+                        self.pins[k] = node
+            r.rehomed = True
+            obs.counter("fleet.rehomes").inc()
+            obs.flight_dump(f"fleet-rehome-{r.name}")
+            _log.info("fleet: rehomed %d key(s) from %r: %s",
+                      sum(len(v) for v in plan.values()), r.name,
+                      {n: len(v) for n, v in plan.items()})
+            if self._on_rehome is not None:
+                self._on_rehome(r.name, plan)
+            return plan
+        return None
+
+    def _rejoin(self, r: _Replica) -> None:
+        """A dead replica's half-open probe answered: admit it back
+        for NEW keys. Its old keys stay pinned to their adopters —
+        and the epoch fence refuses it those even if a stale producer
+        asks it directly."""
+        r.dead = False
+        r.rehomed = False
+        self.ring.add(r.name)
+        obs.counter("fleet.rejoins").inc()
+        obs.gauge(f"fleet.replica.{r.name}.alive").set(1)
+        _log.info("fleet: replica %r rejoined (new keys only; old "
+                  "keys stay with their adopters)", r.name)
+        if self._on_rejoin is not None:
+            self._on_rejoin(r.name)
+
+    def _gauges(self) -> None:
+        obs.gauge("fleet.replicas_alive").set(
+            sum(1 for r in self._reps.values() if not r.dead))
+
+    # -- routing + introspection
+
+    def owner(self, key) -> str:
+        """Where producers should send the key now: its pinned
+        adopter after a rehome, else the ring owner."""
+        with self._lock:
+            pinned = self.pins.get(key)
+        if pinned is not None:
+            return pinned
+        return self.ring.owner(key)
+
+    def status(self) -> dict:
+        return {"replicas": {r.name: {"dead": r.dead,
+                                      "rehomed": r.rehomed,
+                                      "addr": r.addr,
+                                      "breaker":
+                                          r.breaker.snapshot()}
+                             for r in self._reps.values()},
+                "pins": {str(k): v for k, v in self.pins.items()}}
+
+    # -- the loop
+
+    def start(self) -> "FleetSupervisor":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name="jepsen-fleet-supervisor")
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the supervisor must
+                # outlive one bad round; the next tick re-reads truth
+                _log.exception("fleet: supervision tick failed")
+            self._stop.wait(self.interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

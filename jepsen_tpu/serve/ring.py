@@ -26,6 +26,15 @@ Two flavors share the code path:
   freezes the key's live frontier first (``session.freeze`` via the
   checkpoint store), so the new owner thaws instead of re-scanning.
 
+Both flavors FENCE the old owner (``DeltaWAL.write_fence`` before the
+transfer, epoch bumped by ``adopt_keys``) so a paused-not-dead
+replica that resurfaces is refused instead of becoming a second
+writer, and both PIN the moved keys to their adopter in the routing
+layer (``Router.pins`` / ``FleetSupervisor.pins``). When the dead
+node's disk is gone, ``rehome_dead_replica`` reads the survivors'
+``repl/`` segment mirrors instead (``serve.fleet.SegmentReplicator``,
+``JEPSEN_TPU_SERVE_REPL``) — docs/streaming.md "Fleet self-healing".
+
 ``jepsen status --addr host:port`` (repeatable) renders the fleet
 view — one table per replica plus a summary line (``obs.httpd``).
 
@@ -43,11 +52,16 @@ import shutil
 from typing import Dict, List, Optional
 
 from jepsen_tpu import edn, obs
-from jepsen_tpu.serve.wal import DeltaWAL, _safe_name
+from jepsen_tpu.serve.wal import DeltaWAL, WALError, _safe_name
 
 _log = logging.getLogger(__name__)
 
 DEFAULT_VNODES = 64
+
+#: where replicated WAL segments land under a successor's WAL dir
+#: (``serve.fleet.SegmentReplicator``) — the rehome fallback source
+#: when the dead replica's own disk is gone
+REPL_SUBDIR = "repl"
 
 
 def _point(s: str) -> int:
@@ -110,6 +124,26 @@ class HashRing:
             i = 0
         return self._owners[self._points[i]]
 
+    def successor(self, key) -> Optional[str]:
+        """The first DISTINCT node clockwise after the key's owner —
+        the WAL-segment replication target (``serve.fleet``). None on
+        a ring with fewer than two nodes. Deterministic like
+        ``owner``, so the replica that ships and the coordinator that
+        rehomes compute the same successor."""
+        if len(self._nodes) < 2:
+            return None
+        p = _point(edn.dumps(key))
+        i = bisect.bisect_right(self._points, p)
+        owner = None
+        for k in range(len(self._points)):
+            node = self._owners[self._points[(i + k)
+                                             % len(self._points)]]
+            if owner is None:
+                owner = node
+            elif node != owner:
+                return node
+        return None
+
     def assignments(self, keys) -> Dict[str, list]:
         """node -> [key, ...] for a key set (the rebalance plan)."""
         out: Dict[str, list] = {}
@@ -148,29 +182,108 @@ def transfer_key(src_wal_dir: str, dst_wal_dir: str, key) -> dict:
     return {"segments": len(segs), "checkpoint": has_cp}
 
 
+def _key_sources(dead_wal_dir: str,
+                 wal_dirs: Dict[str, str]) -> Dict[object, str]:
+    """key -> source dir to transfer from. The dead replica's own WAL
+    dir when it is still readable (it holds everything acknowledged);
+    otherwise — the disk went with the node — every survivor's
+    ``repl/`` mirror (``serve.fleet.SegmentReplicator`` ships segments
+    there), preferring the copy with the most bytes when a key appears
+    in several mirrors (ring changes can leave older copies behind)."""
+    out: Dict[object, str] = {}
+    if os.path.isdir(dead_wal_dir):
+        try:
+            for key in DeltaWAL(dead_wal_dir).keys():
+                out[key] = dead_wal_dir
+        except (OSError, WALError) as err:
+            _log.warning("rehome: dead WAL dir %s unreadable (%r) — "
+                         "falling back to replicated segments",
+                         dead_wal_dir, err)
+            out.clear()
+    if out:
+        return out
+    # the mirrors hold EVERY replica's shipped keys, not just the
+    # dead one's — a key a survivor holds in its OWN WAL dir is live
+    # there and must not be "rehomed" (the transfer would overwrite a
+    # live replica's segments with a possibly-lagging mirror copy)
+    held_live: set = set()
+    for d in wal_dirs.values():
+        if os.path.isdir(d):
+            try:
+                held_live.update(DeltaWAL(d).keys())
+            except (OSError, WALError):
+                pass   # an unreadable survivor claims nothing; its
+                # keys then transfer from the freshest mirror, which
+                # is the best copy left
+    best_bytes: Dict[object, int] = {}
+    for d in wal_dirs.values():
+        rd = os.path.join(d, REPL_SUBDIR)
+        if not os.path.isdir(rd):
+            continue
+        rwal = DeltaWAL(rd)
+        for key in rwal.keys():
+            if key in held_live:
+                continue
+            n = rwal.size_bytes(key)
+            if key not in out or n > best_bytes[key]:
+                out[key] = rd
+                best_bytes[key] = n
+    if out:
+        obs.counter("serve.ring.rehomes_from_replica").inc()
+    return out
+
+
 def rehome_dead_replica(dead_wal_dir: str, ring: HashRing,
                         dead_node: str,
                         wal_dirs: Dict[str, str],
                         services: Optional[Dict[str, object]] = None) \
         -> Dict[str, list]:
     """Re-home every key a dead replica's WAL holds onto the
-    survivors: drop the node from the ring, transfer each key's
-    segments + checkpoint to its new owner's WAL dir, and (when the
-    survivor services are in hand) ``adopt_keys`` so they go live
-    immediately. Returns the new node -> [key, ...] assignment.
+    survivors: drop the node from the ring, FENCE each key in the dead
+    replica's WAL dir, transfer each key's segments + checkpoint to
+    its new owner's WAL dir, and (when the survivor services are in
+    hand) ``adopt_keys`` so they go live immediately. Returns the new
+    node -> [key, ...] assignment.
 
     The WAL is the ground truth by construction: everything the dead
     replica ever ACKNOWLEDGED is in it (WAL-before-ack), so the
     survivors' replay reaches exactly the acknowledged stream — a
     kill -9 loses only never-promised work, and re-submitted
-    in-flight deltas dedupe by seq."""
+    in-flight deltas dedupe by seq. When the dead node's DISK is gone
+    too, the replicated segment mirrors on the survivors
+    (``JEPSEN_TPU_SERVE_REPL``) are the source instead — with
+    ``sync`` replication that is still exactly the acknowledged
+    stream; with ``async`` it may trail by the replication lag
+    (docs/streaming.md spells out the contract).
+
+    Fencing comes FIRST, deliberately: the fence marker lands in the
+    dead dir before any segment is copied, so a paused-not-dead
+    replica that wakes mid-rehome re-checks the fence after its fsync
+    and refuses — it can never acknowledge a delta the transfer
+    already missed (the split-brain ordering argument, pinned in
+    tests/test_fleet.py)."""
     ring.remove(dead_node)
-    keys = DeltaWAL(dead_wal_dir).keys()
-    plan = ring.assignments(keys)
+    sources = _key_sources(dead_wal_dir, wal_dirs)
+    plan = ring.assignments(sources)
+    # fence only where a stale writer could still live: a missing
+    # dead dir (disk went with the node) has nobody left to fence,
+    # and recreating it would manufacture a directory the operator
+    # deleted
+    can_fence = os.path.isdir(dead_wal_dir)
     for node, node_keys in plan.items():
         dst = wal_dirs[node]
         for key in node_keys:
-            transfer_key(dead_wal_dir, dst, key)
+            src = sources[key]
+            if can_fence:
+                # fence before transfer (see docstring); best-effort
+                try:
+                    new_epoch = DeltaWAL(src).epoch(key) + 1
+                    DeltaWAL(dead_wal_dir).write_fence(
+                        key, new_epoch, owner=node)
+                except OSError as err:
+                    _log.warning("rehome: could not fence key %r in "
+                                 "%s (%r)", key, dead_wal_dir, err)
+            transfer_key(src, dst, key)
         _log.info("rehome: %d key(s) from dead %r -> %r",
                   len(node_keys), dead_node, node)
     if services:
@@ -202,35 +315,50 @@ class Router:
         self.services = dict(services)
         self.wal_dirs = dict(wal_dirs)
         self.ring = HashRing(sorted(services), vnodes=vnodes)
+        # key -> node overrides: a rehomed or migrated key stays with
+        # its adopter even if the hash arcs later say otherwise (a
+        # rejoining node gets NEW keys back, never the ones it lost —
+        # the epoch fence refuses it those anyway)
+        self.pins: Dict[object, str] = {}
 
     def owner(self, key) -> str:
+        pinned = self.pins.get(key)
+        if pinned is not None and pinned in self.services:
+            return pinned
         return self.ring.owner(key)
 
     def submit(self, key, ops, **kw):
-        return self.services[self.ring.owner(key)].submit(key, ops,
-                                                          **kw)
+        return self.services[self.owner(key)].submit(key, ops, **kw)
 
     def result(self, key, **kw):
-        return self.services[self.ring.owner(key)].result(key, **kw)
+        return self.services[self.owner(key)].result(key, **kw)
 
     def finalize(self, key, **kw):
-        return self.services[self.ring.owner(key)].finalize(key, **kw)
+        return self.services[self.owner(key)].finalize(key, **kw)
 
     def rehome(self, dead_node: str) -> Dict[str, list]:
         """Crash path: the node is gone (already killed/closed);
-        survivors adopt its WAL."""
+        survivors adopt its WAL, and the adopted keys PIN to their
+        adopter so a later rejoin of the node (for new keys) cannot
+        route the old keys back to a fenced owner."""
         dead_dir = self.wal_dirs.pop(dead_node)
         self.services.pop(dead_node, None)
-        return rehome_dead_replica(dead_dir, self.ring, dead_node,
+        plan = rehome_dead_replica(dead_dir, self.ring, dead_node,
                                    self.wal_dirs, self.services)
+        for node, node_keys in plan.items():
+            for key in node_keys:
+                self.pins[key] = node
+        return plan
 
     def migrate_key(self, key, dst_node: str) -> dict:
         """Graceful path: freeze the key's live frontier on its
         current owner (drain first — the source must not be applying),
-        transfer, adopt on the destination. The ring is NOT changed —
-        this is an operator move (drain-for-maintenance), and the
-        caller re-points producers."""
-        src_node = self.ring.owner(key)
+        transfer, adopt on the destination, fence + pin. The ring is
+        NOT changed — this is an operator move (drain-for-
+        maintenance); the pin re-points this router's producers, and
+        the fence refuses any producer still talking to the source
+        directly."""
+        src_node = self.owner(key)
         if src_node == dst_node:
             return {"noop": True, "node": src_node}
         src = self.services[src_node]
@@ -239,5 +367,7 @@ class Router:
         r = transfer_key(self.wal_dirs[src_node],
                          self.wal_dirs[dst_node], key)
         self.services[dst_node].adopt_keys()
+        src.fence_key_ownership(key, owner=dst_node)
+        self.pins[key] = dst_node
         r["from"], r["to"] = src_node, dst_node
         return r

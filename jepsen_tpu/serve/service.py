@@ -125,7 +125,7 @@ class _Key:
                  "last_result", "last_activity", "finalized",
                  "finalize_requested", "needs_check", "pending_ops",
                  "wal_next", "broken", "wal_dead", "acct",
-                 "pending_times", "tenant")
+                 "pending_times", "tenant", "epoch", "fenced")
 
     def __init__(self, key, tenant: str = tenancy.DEFAULT_TENANT):
         self.key = key
@@ -158,6 +158,13 @@ class _Key:
         self.wal_dead = False   # a WAL append for this key stalled or
         # failed: later seqs must not write (no holes below an
         # acknowledged delta) — producers get durable=False answers
+        self.epoch = 1      # ownership epoch, stamped into every WAL
+        # segment header this service opens; bumped by adopt_keys so
+        # the fence below can tell a stale owner from the current one
+        self.fenced = None  # the key's fence marker once observed:
+        # ownership moved to another replica (rehome/migration) —
+        # submit/result/finalize answer a structured refusal instead
+        # of letting this replica become a second writer
 
 
 class _TenantState:
@@ -206,6 +213,7 @@ class CheckerService:
                  high_water: Optional[int] = None,
                  evict_idle_secs: Optional[float] = None,
                  tenants=None, drr_quantum: Optional[int] = None,
+                 replicator=None,
                  recover: bool = True, start_worker: bool = True,
                  clock=time.monotonic):
         self.model = model
@@ -239,6 +247,25 @@ class CheckerService:
                     tenants.pending_bound(name, budget))
         self._clock = clock
         self._wal = DeltaWAL(wal_dir) if wal_dir else None
+        # WAL segment replication (docs/streaming.md "Fleet
+        # self-healing"): a configured JEPSEN_TPU_SERVE_REPL with no
+        # target to ship to is a fault-tolerance plan that silently
+        # protects nothing — fail loudly at construction instead
+        from jepsen_tpu.serve.fleet import resolve_repl_mode
+        mode = resolve_repl_mode()
+        if replicator is not None and getattr(replicator, "mode",
+                                              None) == "off":
+            replicator = None
+        if mode != "off" and replicator is None:
+            raise ValueError(
+                f"JEPSEN_TPU_SERVE_REPL={mode!r} but no replication "
+                f"target is wired — pass replicator= (a "
+                f"serve.fleet.SegmentReplicator) or `jepsen serve "
+                f"--checker --repl-dir PATH`, or unset the flag")
+        if replicator is not None and self._wal is None:
+            raise ValueError("WAL segment replication needs a "
+                             "WAL-backed service (wal_dir)")
+        self._repl = replicator
         self._cps = (CheckpointStore(wal_dir + "/checkpoints")
                      if wal_dir else None)
         if wal_dir and obs.flight_active():
@@ -326,6 +353,68 @@ class CheckerService:
             out["tenant"] = ts.name
         return out
 
+    # ------------------------------------------ epoch fence (serve
+    # ring/fleet ownership: docs/streaming.md "Fleet self-healing")
+
+    def _read_fence(self, key):
+        """The key's on-disk fence marker (one stat; None when
+        unfenced or WAL-less). Callers run this OUTSIDE the service
+        condition — it is file I/O."""
+        return self._wal.fence(key) if self._wal is not None else None
+
+    def _fence_locked(self, key, ks, fence):
+        """Fold a freshly-read fence marker into the key's state and
+        return the active fence (callers hold the condition). A fence
+        at OR ABOVE this replica's epoch wins: adoption persists its
+        bump immediately (set_epoch + rotate + touch), but a fence
+        computed against a header the bump has not reached yet can
+        legitimately TIE the stale owner's in-memory epoch — and a tie
+        still means someone else took the key (an owner's own WAL dir
+        never carries a fence for a key it currently holds)."""
+        if ks is not None and ks.fenced is not None:
+            return ks.fenced
+        if fence is not None \
+                and (ks is None or fence.get("epoch", 0) >= ks.epoch):
+            if ks is not None:
+                ks.fenced = fence
+            return fence
+        return None
+
+    def _fence_refusal(self, key, fence) -> dict:
+        """The structured split-brain refusal: this replica's
+        ownership epoch is over — the producer must re-route to the
+        owner the fence names (``jepsen status --addr`` shows the
+        fleet; the supervisor's pins already route new traffic
+        there)."""
+        obs.counter("serve.fenced_refusals").inc()
+        return {"error": "key ownership fenced: this replica's epoch "
+                         "is over (it was rehomed while this replica "
+                         "was presumed dead) — re-route to the "
+                         "current owner",
+                "fenced": True, "epoch": fence.get("epoch"),
+                "owner": fence.get("owner"), "key": key}
+
+    def fence_key_ownership(self, key, owner: Optional[str] = None) \
+            -> dict:
+        """Fence THIS service's ownership of ``key`` now (the
+        graceful-migration finisher — ``serve.ring.Router.
+        migrate_key`` calls it after the destination adopts): writes
+        the durable fence marker at epoch+1 and marks the in-memory
+        key, so producers still pointed here get the structured
+        refusal instead of a second writer."""
+        if self._wal is None:
+            raise RuntimeError("fencing needs a WAL-backed service")
+        with self._cond:
+            ks = self._keys.get(key)
+            epoch = (ks.epoch if ks is not None
+                     else self._wal.epoch(key)) + 1
+        doc = self._wal.write_fence(key, epoch, owner=owner)
+        with self._cond:
+            if ks is not None:
+                ks.fenced = doc
+            self._cond.notify_all()
+        return doc
+
     def submit(self, key, ops, seq: Optional[int] = None,
                timeout: Optional[float] = None,
                wait: bool = False, tenant: Optional[str] = None,
@@ -359,6 +448,10 @@ class CheckerService:
         if auth_err is not None:
             obs.counter("serve.unauthorized").inc()
             return {**auth_err, "key": key}
+        # epoch fence, first look (one stat, outside the lock): a
+        # replica whose key was rehomed away while it was paused must
+        # refuse — and must not even MINT the key fresh at epoch 1
+        fence = self._read_fence(key)
         t_in = self._clock()
         deadline = None if timeout is None else t_in + timeout
         shed = None   # set instead of returning inside the lock: the
@@ -368,6 +461,9 @@ class CheckerService:
         with self._cond:
             ts = self._tenant_state_locked(tname)
             ks = self._keys.get(key)
+            f = self._fence_locked(key, ks, fence)
+            if f is not None:
+                return self._fence_refusal(key, f)
             if ks is None:
                 if ts is not None and ts.max_keys \
                         and ts.keys >= ts.max_keys:
@@ -394,6 +490,10 @@ class CheckerService:
             # producer may have taken the seq or finalized the key
             # while this one slept
             while shed is None:
+                if ks.fenced is not None:
+                    # a concurrent submit's post-fsync recheck (or an
+                    # operator fence) landed while this one waited
+                    return self._fence_refusal(key, ks.fenced)
                 if ks.broken:
                     return {"error": "key state was lost to a worker "
                                      "crash and no WAL is configured "
@@ -511,6 +611,8 @@ class CheckerService:
             obs.flight_dump("serve-shed")
             return shed
         durable = self._wal is not None
+        durable_replica = None   # sync replication verdict (None =
+        # not in sync mode / nothing shipped this ack)
         if self._wal is not None:
             # per-key seq-ordered handoff: seq N's bytes land before
             # N+1's, so a crash can truncate the WAL only at the tail,
@@ -521,7 +623,8 @@ class CheckerService:
             # seq writes (no holes), and answers carry durable=False.
             give_up = False
             with self._cond:
-                while ks.wal_next != my_seq and not ks.wal_dead:
+                while ks.wal_next != my_seq and not ks.wal_dead \
+                        and ks.fenced is None:
                     if self._stop:
                         give_up = True
                         break
@@ -532,6 +635,10 @@ class CheckerService:
                         break
                     self._cond.wait(0.5 if rem is None
                                     else min(rem, 0.5))
+                if ks.fenced is not None:
+                    # fenced while parked in the handoff: nothing may
+                    # write below a fence — refuse instead of ack
+                    return self._fence_refusal(key, ks.fenced)
                 if give_up or ks.wal_dead:
                     ks.wal_dead = True
                     durable = False
@@ -560,6 +667,30 @@ class CheckerService:
                             # pays for every byte its keys fsync
                             ts.wal_bytes += nbytes
                         self._cond.notify_all()
+                    # fence recheck AFTER the fsync, before the ack:
+                    # the rehome path writes its fence BEFORE copying
+                    # segments, so either this delta's bytes made the
+                    # transfer (consistent) or this stat sees the
+                    # fence and the producer never gets the ack — a
+                    # paused replica cannot acknowledge a delta the
+                    # new owner will not replay (pinned in
+                    # tests/test_fleet.py)
+                    fence2 = self._read_fence(key)
+                    if fence2 is not None \
+                            and fence2.get("epoch", 0) >= ks.epoch:
+                        with self._cond:
+                            if ks.fenced is None:
+                                ks.fenced = fence2
+                            self._cond.notify_all()
+                        return self._fence_refusal(key, ks.fenced)
+                    if self._repl is not None:
+                        # ship the key's segments to its ring
+                        # successor; sync mode returns False when the
+                        # successor copy did NOT land (the ack below
+                        # then says so instead of implying fleet-wide
+                        # durability)
+                        durable_replica = \
+                            self._repl.after_append(key) is not False
         # ingest->ack SLO: admission (incl. backpressure wait) through
         # WAL durability — the producer-visible accept latency
         ack = max(0.0, self._clock() - t_in)
@@ -575,6 +706,8 @@ class CheckerService:
                             tenant=tname)
             if not durable and self._wal is not None:
                 r["durable"] = False
+            if durable_replica is False:
+                r["replicated"] = False
             return r
         out = {"accepted": True, "seq": my_seq, "key": key}
         if ts is not None:
@@ -582,6 +715,10 @@ class CheckerService:
         if not durable and self._wal is not None:
             obs.counter("serve.nondurable_acks").inc()
             out["durable"] = False
+        if durable_replica is False:
+            # sync-mode promise not met this ack: primary-durable
+            # only (serve.repl_errors counted by the replicator)
+            out["replicated"] = False
         return out
 
     def _own_key_locked(self, key, tenant: Optional[str],
@@ -617,10 +754,16 @@ class CheckerService:
         at least ``min_seq`` (default: everything enqueued so far) has
         been applied."""
         deadline = None if timeout is None else self._clock() + timeout
+        fence = self._read_fence(key)
         with self._cond:
             ks, err = self._own_key_locked(key, tenant, token)
             if err is not None:
                 return err
+            f = self._fence_locked(key, ks, fence)
+            if f is not None:
+                # the verdict moved with the ownership: the current
+                # owner serves it (replayed from the transferred WAL)
+                return self._fence_refusal(key, f)
             target = ks.enq_seq if min_seq is None else int(min_seq)
             while ks.applied_seq < target or ks.last_result is None \
                     or ks.needs_check:
@@ -642,10 +785,17 @@ class CheckerService:
         (counterexample extraction included), and seal the key —
         further deltas get ``{"error": "key is finalized"}``."""
         deadline = None if timeout is None else self._clock() + timeout
+        fence = self._read_fence(key)
         with self._cond:
             ks, err = self._own_key_locked(key, tenant, token)
             if err is not None:
                 return err
+            f = self._fence_locked(key, ks, fence)
+            if f is not None:
+                # sealing is the owner's right; a fenced replica
+                # sealing the key would shadow deltas the new owner
+                # is still admitting
+                return self._fence_refusal(key, f)
             ks.finalize_requested = True
             self._cond.notify_all()
             while not ks.finalized:
@@ -689,6 +839,12 @@ class CheckerService:
             self._cond.notify_all()
         if self._worker is not None:
             self._worker.join(timeout=30)
+        if self._repl is not None:
+            # flush the async replication queue before the WAL goes
+            # away — a graceful shutdown leaves the successor mirror
+            # current (a kill, of course, does not: that lag is the
+            # documented async-mode loss window)
+            self._repl.close(drain=drain)
         if self._wal is not None:
             self._wal.close()
 
@@ -745,7 +901,8 @@ class CheckerService:
             rows = []
             for ks in self._keys.values():
                 r = ks.last_result or {}
-                state = ("poisoned" if ks.broken
+                state = ("fenced" if ks.fenced is not None
+                         else "poisoned" if ks.broken
                          else "live" if ks.session is not None
                          else "evicted" if ks.applied_seq
                          else "idle")   # admitted nothing yet (e.g.
@@ -762,6 +919,8 @@ class CheckerService:
                     "error": r.get("error"),
                     "resilience": r.get("resilience"),
                     "wal_dead": ks.wal_dead,
+                    "epoch": ks.epoch,
+                    "fenced": ks.fenced,
                     "acct": dict(ks.acct),
                 }
                 if self._tenants is not None:
@@ -863,10 +1022,17 @@ class CheckerService:
 
     # ------------------------------------------------------ recovery
 
-    def _recover_key(self, key):
+    def _recover_key(self, key, bump_epoch: bool = False):
         """Build one key's state from its WAL segments + evicted
         checkpoint (no shared-state mutation — the caller installs
-        under the condition). Returns (ks, wal_bytes) or None."""
+        under the condition). Returns (ks, wal_bytes) or None.
+
+        ``bump_epoch`` is the ADOPTION path (``adopt_keys``): the new
+        owner takes the key at epoch+1 and seals the transferred
+        segments so its first append opens a fresh segment whose
+        header carries the bump durably — the fence the rehome wrote
+        in the old owner's dir names exactly this epoch. A plain
+        restart keeps the stored epoch (same owner, same epoch)."""
         deltas = self._wal.replay(key)
         if not deltas:
             return None
@@ -880,6 +1046,32 @@ class CheckerService:
                 for op in ops]
         rest = [(seq, ops) for seq, ops in deltas if seq > applied]
         ks = _Key(key, tenant=tname)
+        # adoption bases its bump on the transferred segment HEADERS
+        # (header_epoch), never on a pending in-process stamp a
+        # previous ownership generation of this key left behind — the
+        # migrate-away-and-back case would otherwise tie its own
+        # fence forever
+        ks.epoch = self._wal.header_epoch(key) \
+            + (1 if bump_epoch else 0)
+        self._wal.set_epoch(key, ks.epoch)
+        if bump_epoch:
+            # persist the bump NOW (fresh segment + fsynced header):
+            # a fence computed from this dir's headers must already
+            # out-rank the previous owner, even if this adopter never
+            # sees another append
+            self._wal.rotate(key)
+            self._wal.touch(key, tenant=(tname if self._tenants
+                                         is not None else None))
+        fence = self._wal.fence(key)
+        if fence is not None and fence.get("epoch", 0) >= ks.epoch:
+            # this key was rehomed away while the replica was down:
+            # recover it for forensics, refuse its producers
+            ks.fenced = fence
+        elif fence is not None and bump_epoch:
+            # a stale fence from an earlier ownership generation (the
+            # key migrated back here): our bumped epoch out-ranks it,
+            # so it no longer binds — drop it
+            self._wal.clear_fence(key)
         sess = self._new_session(key)
         if base:
             with obs.span("serve.thaw", key=str(key)):
@@ -937,22 +1129,54 @@ class CheckerService:
         if self._wal is None:
             raise RuntimeError("adopt_keys needs a WAL-backed service")
         adopted = []
+
+        def _replaceable(cur) -> bool:
+            # two kinds of key object adoption may replace: an empty
+            # SHELL a producer's early retry minted while the handoff
+            # was in flight (nothing admitted, nothing applied — its
+            # submits all answered "sequence gap"), and a FENCED key
+            # whose local state is forensics-only — ownership
+            # returning (migrate-away-and-back, on a LIVE service) is
+            # exactly what adoption is. Real live state is an
+            # unfenced key with admitted or applied deltas.
+            if cur.fenced is not None:
+                return True
+            return not (cur.enq_seq or cur.applied_seq or cur.pending
+                        or cur.needs_check)
+
         for key in self._wal.keys():
             with self._cond:
-                if key in self._keys:
+                cur = self._keys.get(key)
+                if cur is not None and not _replaceable(cur):
                     continue
-            built = self._recover_key(key)   # heavy (replay + thaw):
-            # outside the lock so live producers keep admitting
+            built = self._recover_key(key, bump_epoch=True)   # heavy
+            # (replay + thaw): outside the lock so live producers
+            # keep admitting. The epoch bump is what the fence in the
+            # dead replica's dir names — adoption IS the ownership
+            # transition.
             if built is None:
                 continue
             with self._cond:
-                if key in self._keys:
-                    # a producer raced the handoff and minted the key
-                    # fresh — keep the live one; the operator re-runs
-                    # adopt after quiescing that producer
-                    _log.warning("adopt_keys: key %r appeared during "
-                                 "replay — keeping the live key", key)
-                    continue
+                cur = self._keys.get(key)
+                if cur is not None:
+                    if not _replaceable(cur):
+                        # a producer landed REAL deltas mid-replay —
+                        # keep the live key; the operator re-runs
+                        # adopt after quiescing that producer
+                        _log.warning("adopt_keys: key %r gained live "
+                                     "state during replay — keeping "
+                                     "the live key", key)
+                        continue
+                    # replace the empty shell with the recovered
+                    # state: release its quota slot, and fence the
+                    # orphaned object so any waiter still holding it
+                    # gets a structured answer that re-routes (its
+                    # retry then finds the recovered key)
+                    ts = self._tenant_state_locked(cur.tenant)
+                    if ts is not None:
+                        ts.keys -= 1
+                    cur.fenced = {"epoch": built[0].epoch,
+                                  "owner": None}
                 self._install_recovered_locked(*built)
                 self._cond.notify_all()
             adopted.append(key)

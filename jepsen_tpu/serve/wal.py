@@ -10,7 +10,8 @@ scan and lands bit-identical verdicts. Format: append-only JSONL
     <stem>.wal.1        segment 1 (after the first rotation)
     <stem>.wal.N        ...
 
-    {"key": "<edn>", "segment": N, "tenant": "..."?}   header, first
+    {"key": "<edn>", "segment": N, "tenant": "..."?,
+     "epoch": E}                                       header, first
                                                        line of EVERY
                                                        segment
     {"seq": 1, "ops": ["<edn op>", ...]}               one per delta
@@ -30,6 +31,19 @@ ships a key as a list of sealed files instead of one unbounded one.
 BYTES`` (0 = off, the default) rotates automatically past a size.
 Each segment repeats the header so a transferred file set is
 self-describing.
+
+Ownership epochs + fences (docs/streaming.md "Fleet self-healing"):
+every segment header carries the key's ownership **epoch** — bumped by
+:meth:`CheckerService.adopt_keys` when a survivor takes the key over,
+so the WAL itself records who owned which stretch of the stream. A
+**fence marker** (``<stem>.fence``, written atomically by
+``serve.ring.rehome_dead_replica`` / ``CheckerService.fence_key``
+BEFORE the segments are transferred) tells a stale replica that
+resurfaces — the SIGSTOP/paused-not-dead case — that its epoch is
+over: the service refuses its producers with a structured answer
+instead of becoming a second writer. An unreadable fence file fails
+SAFE (treated as fenced): for a split-brain guard, refusing work
+beats serving it on corrupt evidence.
 
 Crash tolerance: every append is flushed + fsynced before returning;
 a torn final line (the process died mid-write — that delta was never
@@ -97,6 +111,9 @@ class DeltaWAL:
         self._lock = threading.Lock()          # handle/lock creation
         self._files: Dict[str, object] = {}    # stem -> open handle
         self._seg: Dict[str, int] = {}         # stem -> active index
+        self._epochs: Dict[str, int] = {}      # stem -> epoch to stamp
+        # on newly-opened segment headers (set_epoch; default: inherit
+        # from the newest existing segment, else 1)
         # per-stem write locks: independent keys fsync CONCURRENTLY —
         # one global lock here would re-serialize exactly what the
         # service's seq-ordered handoff exists to avoid
@@ -176,7 +193,13 @@ class DeltaWAL:
             self._repair_tail(path)
         fh = open(path, "a")
         if fresh:
-            head = {"key": edn.dumps(key), "segment": idx}
+            with self._lock:
+                ep = self._epochs.get(stem)
+            if ep is None:
+                # inherit from the newest lower segment so a rotation
+                # never silently resets an ownership epoch
+                ep = self._header_epoch(stem, below=idx)
+            head = {"key": edn.dumps(key), "segment": idx, "epoch": ep}
             if tenant is not None:
                 head["tenant"] = tenant
             fh.write(json.dumps(head) + "\n")
@@ -236,6 +259,20 @@ class DeltaWAL:
         with slock:
             self._rotate_locked(stem)
 
+    def touch(self, key, tenant: Optional[str] = None) -> None:
+        """Open the key's active segment NOW, writing its header if
+        the file is fresh — adoption calls set_epoch + rotate + touch
+        so the bumped ownership epoch is durable immediately, not at
+        the next append (a fence computed from this dir's headers
+        must already out-rank the previous owner)."""
+        stem = _safe_name(key)
+        with self._lock:
+            slock = self._stem_locks.setdefault(stem, threading.Lock())
+        with slock:
+            fh = self._open_active(stem, key, tenant)
+            fh.flush()
+            os.fsync(fh.fileno())
+
     def close(self) -> None:
         with self._lock:
             for fh in self._files.values():
@@ -246,6 +283,7 @@ class DeltaWAL:
             self._files.clear()
             self._seg.clear()
             self._stem_locks.clear()
+            self._epochs.clear()
 
     # -- replay path
 
@@ -285,6 +323,106 @@ class DeltaWAL:
         except Exception as err:  # noqa: BLE001 — same posture as keys()
             raise WALError(
                 f"unreadable WAL header in {segs[0]}: {err!r}") from err
+
+    # -- ownership epoch + fence
+
+    def _header_epoch(self, stem: str, below: Optional[int] = None) \
+            -> int:
+        """The newest existing segment header's epoch (optionally only
+        segments with index < ``below``), default 1 — pre-epoch WAL
+        files read as epoch 1, so old fleets replay unchanged."""
+        indices = [i for i in self._segment_indices(stem)
+                   if below is None or i < below]
+        for i in reversed(indices):
+            path = self._seg_path(stem, i)
+            try:
+                with open(path) as fh:
+                    return int(json.loads(fh.readline()).get(
+                        "epoch", 1))
+            except Exception as err:  # noqa: BLE001 — same posture as
+                # keys(): an unreadable header is acknowledged data
+                raise WALError(
+                    f"unreadable WAL header in {path}: {err!r}") \
+                    from err
+        return 1
+
+    def epoch(self, key) -> int:
+        """The key's current ownership epoch: the pending stamp when
+        one was set this process, else the newest segment header's,
+        else 1 (no WAL yet)."""
+        stem = _safe_name(key)
+        with self._lock:
+            e = self._epochs.get(stem)
+        if e is not None:
+            return e
+        return self._header_epoch(stem)
+
+    def header_epoch(self, key) -> int:
+        """The newest segment HEADER's epoch, ignoring any pending
+        in-process stamp — the adoption base: a key transferred back
+        into this dir carries its truth in the transferred headers,
+        and a stamp left by an earlier ownership generation of this
+        process must not shadow it."""
+        return self._header_epoch(_safe_name(key))
+
+    def set_epoch(self, key, epoch: int) -> None:
+        """Stamp ``epoch`` on every segment header this process opens
+        for the key from now on (``adopt_keys`` bumps + rotates, so
+        the bump lands in the next segment's header durably)."""
+        with self._lock:
+            self._epochs[_safe_name(key)] = int(epoch)
+
+    def _fence_path(self, stem: str) -> str:
+        return os.path.join(self.root, stem + ".fence")
+
+    def write_fence(self, key, epoch: int,
+                    owner: Optional[str] = None) -> dict:
+        """Atomically drop the key's fence marker: any service over
+        this WAL root whose key epoch is below ``epoch`` must refuse
+        producers (it is no longer the owner). Written BEFORE segment
+        transfer by the rehome path, so a stale writer that re-checks
+        the fence after its fsync can never hand out an ack the new
+        owner will not replay."""
+        doc = {"key": edn.dumps(key), "epoch": int(epoch)}
+        if owner is not None:
+            doc["owner"] = owner
+        path = self._fence_path(_safe_name(key))
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(json.dumps(doc) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        obs.counter("serve.fences_written").inc()
+        return doc
+
+    def fence(self, key) -> Optional[dict]:
+        """The key's fence marker, or None. An unreadable fence fails
+        SAFE — it reads as a fence at an unbeatable epoch, because a
+        split-brain guard must refuse on corrupt evidence, never
+        write through it."""
+        path = self._fence_path(_safe_name(key))
+        try:
+            with open(path) as fh:
+                doc = json.loads(fh.read())
+            doc["epoch"] = int(doc["epoch"])
+            return doc
+        except FileNotFoundError:
+            return None
+        except Exception as err:  # noqa: BLE001 — corrupt marker
+            _log.warning("WAL fence %s unreadable (%r) — treating the "
+                         "key as fenced (fail-safe)", path, err)
+            return {"epoch": 1 << 62, "error": f"unreadable fence: "
+                                               f"{err!r}"}
+
+    def clear_fence(self, key) -> None:
+        """Drop a stale fence marker (adoption clears one an earlier
+        ownership generation left behind, once its own epoch
+        out-ranks it)."""
+        try:
+            os.remove(self._fence_path(_safe_name(key)))
+        except OSError:
+            pass
 
     def replay(self, key) -> List[Tuple[int, list]]:
         """The key's admitted deltas as ``[(seq, [Op, ...]), ...]`` in

@@ -1,0 +1,659 @@
+"""Self-healing fleet suite (ISSUE 13): WAL segment replication
+(sync/async shipping, replica-copy rehome with the primary's disk
+gone, torn tails on the mirror), the ownership epoch fence
+(split-brain refusals, fence-before-transfer ordering, adoption
+bumps), and the FleetSupervisor's detect → rehome → rejoin loop
+driven deterministically with an injected fetch + clock.
+"""
+
+import os
+import shutil
+
+import pytest
+
+from jepsen_tpu import envflags, obs
+from jepsen_tpu.histories import corrupt_history, rand_register_history
+from jepsen_tpu.history import History
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.parallel import encode as enc_mod, engine
+from jepsen_tpu.serve import (
+    CheckerService, DeltaWAL, FleetSupervisor, SegmentReplicator,
+)
+from jepsen_tpu.serve import fleet as fleet_mod
+from jepsen_tpu.serve import ring as ring_mod
+
+PIN = ("valid?", "op", "fail-event", "max-frontier", "configs-stepped")
+
+
+def _pin(r):
+    return {k: r.get(k) for k in PIN}
+
+
+def _oneshot(ops, capacity=128):
+    e = enc_mod.encode(CASRegister(), History.wrap(list(ops)))
+    return engine.check_encoded(e, capacity=capacity, dedupe="sort")
+
+
+def _history(seed=2, corrupt=True):
+    h = rand_register_history(n_ops=20, n_processes=4, n_values=3,
+                              crash_p=0.05, seed=seed)
+    if corrupt:
+        h = corrupt_history(h, seed=1, n_corruptions=2)
+    return list(h)
+
+
+# ------------------------------------------------- knob validation
+
+
+def test_repl_mode_validation(monkeypatch):
+    assert fleet_mod.resolve_repl_mode() == "off"
+    for v in ("async", "sync"):
+        monkeypatch.setenv("JEPSEN_TPU_SERVE_REPL", v)
+        assert fleet_mod.resolve_repl_mode() == v
+    monkeypatch.setenv("JEPSEN_TPU_SERVE_REPL", "on")
+    with pytest.raises(envflags.EnvFlagError, match="SERVE_REPL"):
+        fleet_mod.resolve_repl_mode()
+    with pytest.raises(envflags.EnvFlagError, match="replication"):
+        fleet_mod.resolve_repl_mode(v="mirror")
+
+
+def test_fleet_knob_validation(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_FLEET_INTERVAL", "0")
+    with pytest.raises(envflags.EnvFlagError):
+        fleet_mod.resolve_fleet_interval()
+    monkeypatch.setenv("JEPSEN_TPU_FLEET_THRESHOLD", "0")
+    with pytest.raises(envflags.EnvFlagError):
+        fleet_mod.resolve_fleet_threshold()
+    monkeypatch.setenv("JEPSEN_TPU_FLEET_REHOME_RETRIES", "nope")
+    with pytest.raises(envflags.EnvFlagError):
+        fleet_mod.resolve_rehome_retries()
+
+
+def test_service_rejects_armed_repl_without_target(tmp_path,
+                                                   monkeypatch):
+    """A configured replication mode with nothing wired to ship to is
+    a fault-tolerance plan that protects nothing — loud, at
+    construction."""
+    monkeypatch.setenv("JEPSEN_TPU_SERVE_REPL", "sync")
+    with pytest.raises(ValueError, match="SERVE_REPL"):
+        CheckerService(CASRegister(), wal_dir=str(tmp_path / "w"))
+    monkeypatch.delenv("JEPSEN_TPU_SERVE_REPL")
+    repl = SegmentReplicator(DeltaWAL(str(tmp_path / "w")),
+                             fleet_mod.constant_dst(
+                                 str(tmp_path / "m")), mode="sync")
+    with pytest.raises(ValueError, match="WAL-backed"):
+        CheckerService(CASRegister(), replicator=repl)
+
+
+# --------------------------------------------- ring successor math
+
+
+def test_ring_successor_distinct_and_deterministic():
+    r = ring_mod.HashRing(["a", "b", "c"])
+    for i in range(50):
+        k = ("reg", i)
+        succ = r.successor(k)
+        assert succ is not None and succ != r.owner(k)
+        assert succ == ring_mod.HashRing(["c", "b", "a"]).successor(k)
+    assert ring_mod.HashRing(["solo"]).successor("k") is None
+
+
+# ------------------------------------------------- segment shipping
+
+
+def _mk_service(tmp_path, name, mode=None, dst=None, **kw):
+    wal_dir = str(tmp_path / name)
+    repl = None
+    if mode is not None:
+        repl = SegmentReplicator(DeltaWAL(wal_dir),
+                                 fleet_mod.constant_dst(dst),
+                                 mode=mode)
+    return CheckerService(CASRegister(), wal_dir=wal_dir,
+                          capacity=128, replicator=repl, **kw), wal_dir
+
+
+def test_sync_replication_rehome_from_replica_bit_identical(tmp_path):
+    """THE acceptance pin: a replica killed mid-stream WITH ITS WAL
+    DIR DELETED is rehomed from the sync-shipped segment mirror on the
+    survivor, and the adopted key's verdict is bit-identical to an
+    unmigrated one-shot check — including the delta acked after the
+    last rotation."""
+    h = _history()
+    ref = _oneshot(h)
+    surv_dir = str(tmp_path / "surv")
+    mirror = os.path.join(surv_dir, ring_mod.REPL_SUBDIR)
+    svc, dead_dir = _mk_service(tmp_path, "dead", mode="sync",
+                                dst=mirror)
+    key = "repl-key"
+    assert svc.submit(key, h[:14], timeout=60)["accepted"]
+    svc._wal.rotate(key)
+    r = svc.submit(key, h[14:], timeout=60)
+    assert r["accepted"] and "replicated" not in r  # sync promise met
+    svc.close(drain=False)
+    shutil.rmtree(dead_dir)   # the disk went with the node
+    surv = CheckerService(CASRegister(), wal_dir=surv_dir,
+                          capacity=128)
+    try:
+        ring = ring_mod.HashRing(["dead", "surv"])
+        plan = ring_mod.rehome_dead_replica(
+            dead_dir, ring, "dead", {"surv": surv_dir},
+            {"surv": surv})
+        assert plan == {"surv": [key]}
+        assert obs.registry().snapshot()[
+            "serve.ring.rehomes_from_replica"]["value"] >= 1
+        rr = surv.result(key, timeout=120)
+        assert _pin(rr) == _pin(ref) and rr["seq"] == 2
+        f = surv.finalize(key, timeout=120)
+        assert _pin(f) == _pin(ref)
+    finally:
+        surv.close()
+
+
+def test_async_replication_lag_drain_and_off(tmp_path):
+    h = _history(corrupt=False)
+    mirror = str(tmp_path / "mirror")
+    svc, _d = _mk_service(tmp_path, "src", mode="async", dst=mirror)
+    try:
+        assert svc.submit("ak", h, timeout=60)["accepted"]
+        assert svc._repl.drain(timeout=30)
+        assert obs.registry().snapshot()[
+            "serve.repl_lag_keys"]["value"] == 0
+        mwal = DeltaWAL(mirror)
+        assert mwal.replay("ak") == svc._wal.replay("ak")
+    finally:
+        svc.close()
+    # off mode: the hook is a no-op and ships nothing
+    repl = SegmentReplicator(DeltaWAL(str(tmp_path / "o")),
+                             fleet_mod.constant_dst(
+                                 str(tmp_path / "om")), mode="off")
+    assert repl.after_append("k") is None
+    assert not os.path.exists(str(tmp_path / "om"))
+
+
+def test_sync_replication_failure_degrades_ack(tmp_path,
+                                               monkeypatch):
+    """An unreachable successor must not block the primary ack — it
+    degrades it: the answer carries ``replicated: False`` and
+    serve.repl_errors moves."""
+    h = _history(corrupt=False)
+    mirror = str(tmp_path / "m2")
+    svc, _d = _mk_service(tmp_path, "src2", mode="sync", dst=mirror)
+    try:
+        monkeypatch.setattr(svc._repl, "ship",
+                            lambda key: (_ for _ in ()).throw(
+                                OSError("mirror disk gone")))
+        before = obs.registry().snapshot().get(
+            "serve.repl_errors", {}).get("value", 0)
+        r = svc.submit("fk", h[:10], timeout=60)
+        assert r["accepted"] and r["replicated"] is False
+        assert obs.registry().snapshot()[
+            "serve.repl_errors"]["value"] == before + 1
+    finally:
+        svc.close()
+
+
+def test_rehome_from_replica_with_torn_mirror_tail(tmp_path):
+    """Satellite pin: the WAL's one-torn-tail-per-segment tolerance,
+    re-pinned on the REPLICATION path — a mid-copy kill (or a torn
+    primary tail shipped verbatim) leaves a torn final line on the
+    mirror; rehome + adoption replay the acknowledged prefix and the
+    verdict matches a one-shot of exactly that prefix."""
+    h = _history(corrupt=False)
+    surv_dir = str(tmp_path / "tsurv")
+    mirror = os.path.join(surv_dir, ring_mod.REPL_SUBDIR)
+    svc, dead_dir = _mk_service(tmp_path, "tdead", mode="sync",
+                                dst=mirror)
+    key = "torn-key"
+    assert svc.submit(key, h[:10], timeout=60)["accepted"]
+    assert svc.submit(key, h[10:], timeout=60)["accepted"]
+    svc.close(drain=False)
+    shutil.rmtree(dead_dir)
+    # tear the mirror copy's final segment mid-line: the seq-2 delta
+    # becomes the never-promised tail
+    segs = DeltaWAL(mirror).segments(key)
+    with open(segs[-1]) as fh:
+        lines = fh.read().splitlines(keepends=True)
+    assert len(lines) >= 3   # header + 2 deltas
+    with open(segs[-1], "w") as fh:
+        fh.writelines(lines[:-1])
+        fh.write(lines[-1][:len(lines[-1]) // 2])
+    ref = _oneshot(h[:10])
+    surv = CheckerService(CASRegister(), wal_dir=surv_dir,
+                          capacity=128)
+    try:
+        ring = ring_mod.HashRing(["tdead", "tsurv"])
+        plan = ring_mod.rehome_dead_replica(
+            dead_dir, ring, "tdead", {"tsurv": surv_dir},
+            {"tsurv": surv})
+        assert plan == {"tsurv": [key]}
+        rr = surv.result(key, timeout=120)
+        assert _pin(rr) == _pin(ref) and rr["seq"] == 1
+        # the stream RESUMES past the torn tail: the producer's seq-2
+        # retry (never acked with mirror durability... the tear) lands
+        assert surv.submit(key, h[10:], seq=2,
+                           timeout=60)["accepted"]
+        f = surv.finalize(key, timeout=120)
+        assert _pin(f) == _pin(_oneshot(h))
+    finally:
+        surv.close()
+
+
+# ---------------------------------------------------- epoch fencing
+
+
+def test_epoch_stamped_and_bumped_by_adoption(tmp_path):
+    h = _history(corrupt=False)
+    dirs = {n: str(tmp_path / n) for n in ("ea", "eb")}
+    svcs = {n: CheckerService(CASRegister(), wal_dir=d, capacity=128)
+            for n, d in dirs.items()}
+    try:
+        key = "ekey"
+        assert svcs["ea"].submit(key, h, timeout=60)["accepted"]
+        assert svcs["ea"]._wal.epoch(key) == 1
+        svcs["ea"].result(key, timeout=120)
+        ring_mod.transfer_key(dirs["ea"], dirs["eb"], key)
+        assert svcs["eb"].adopt_keys() == [key]
+        # the bump is DURABLE immediately (fresh fsynced header), not
+        # at the next append
+        assert svcs["eb"]._wal.epoch(key) == 2
+        assert DeltaWAL(dirs["eb"]).epoch(key) == 2
+        rr = svcs["eb"].result(key, timeout=120)
+        assert rr["seq"] == 1
+        st = svcs["eb"].status()
+        krow = next(v for k, v in st["keys"].items() if "ekey" in k)
+        assert krow["epoch"] == 2 and krow["state"] == "live"
+    finally:
+        for s in svcs.values():
+            s.close()
+
+
+def test_fence_refuses_stale_owner_split_brain_pin(tmp_path):
+    """THE split-brain pin: a paused replica whose key was rehomed
+    away resumes and keeps talking — submit, result, and finalize all
+    answer the structured epoch-fence refusal, and the refusal metric
+    moves. The fresh delta it tried to ack is NOT in its WAL."""
+    h = _history()
+    dirs = {n: str(tmp_path / n) for n in ("fa", "fb")}
+    svcs = {n: CheckerService(CASRegister(), wal_dir=d, capacity=128)
+            for n, d in dirs.items()}
+    try:
+        key = "fkey"
+        assert svcs["fa"].submit(key, h[:12], timeout=60)["accepted"]
+        svcs["fa"].result(key, timeout=120)
+        # the rehome path fences THEN transfers ("fa" is paused, not
+        # dead — exactly the case the ordering argument covers)
+        ring = ring_mod.HashRing(["fa", "fb"])
+        plan = ring_mod.rehome_dead_replica(
+            dirs["fa"], ring, "fa", {"fb": dirs["fb"]},
+            {"fb": svcs["fb"]})
+        assert plan == {"fb": [key]}
+        fence_doc = DeltaWAL(dirs["fa"]).fence(key)
+        assert fence_doc is not None and fence_doc["epoch"] == 2
+        assert fence_doc["owner"] == "fb"
+        before = obs.registry().snapshot().get(
+            "serve.fenced_refusals", {}).get("value", 0)
+        # the resumed stale owner: all three surfaces refuse
+        r = svcs["fa"].submit(key, h[12:], seq=2, timeout=10)
+        assert r["fenced"] is True and r["epoch"] == 2
+        assert r["owner"] == "fb" and "error" in r
+        assert svcs["fa"].result(key, timeout=10)["fenced"] is True
+        assert svcs["fa"].finalize(key, timeout=10)["fenced"] is True
+        assert obs.registry().snapshot()[
+            "serve.fenced_refusals"]["value"] >= before + 3
+        # nothing below the fence was written: the refused delta is
+        # not in the stale WAL
+        assert [s for s, _ in DeltaWAL(dirs["fa"]).replay(key)] == [1]
+        # /status shows the key fenced
+        st = svcs["fa"].status()
+        krow = next(v for k, v in st["keys"].items() if "fkey" in k)
+        assert krow["state"] == "fenced"
+        # ... while the new owner serves the stream: the producer
+        # re-routes and the verdict covers everything
+        assert svcs["fb"].submit(key, h[12:], seq=2,
+                                 timeout=60)["accepted"]
+        f = svcs["fb"].finalize(key, timeout=120)
+        assert _pin(f) == _pin(_oneshot(h))
+    finally:
+        for s in svcs.values():
+            s.close()
+
+
+def test_fenced_restart_recovers_for_forensics_only(tmp_path):
+    """A fenced replica that RESTARTS (the rolling-restart case)
+    recovers the key from its WAL but keeps refusing producers — the
+    fence outlives the process that observed it."""
+    h = _history(corrupt=False)
+    d = str(tmp_path / "fr")
+    svc = CheckerService(CASRegister(), wal_dir=d, capacity=128)
+    key = "frkey"
+    assert svc.submit(key, h, timeout=60)["accepted"]
+    svc.result(key, timeout=120)
+    svc.close()
+    DeltaWAL(d).write_fence(key, 2, owner="elsewhere")
+    svc2 = CheckerService(CASRegister(), wal_dir=d, capacity=128)
+    try:
+        r = svc2.submit(key, h, seq=2, timeout=10)
+        assert r["fenced"] is True and r["owner"] == "elsewhere"
+    finally:
+        svc2.close()
+
+
+def test_adoption_outranks_stale_fence_on_migrate_back(tmp_path):
+    """A key migrated AWAY and later BACK: the old fence (epoch 2)
+    must not bind the re-adopter whose bump (epoch 3) out-ranks it —
+    adoption clears it and the key serves."""
+    h = _history(corrupt=False)
+    dirs = {n: str(tmp_path / n) for n in ("ma", "mb")}
+    svcs = {n: CheckerService(CASRegister(), wal_dir=d, capacity=128)
+            for n, d in dirs.items()}
+    try:
+        key = "mkey"
+        assert svcs["ma"].submit(key, h, timeout=60)["accepted"]
+        svcs["ma"].result(key, timeout=120)
+        ring_mod.transfer_key(dirs["ma"], dirs["mb"], key)
+        svcs["mb"].adopt_keys()                      # epoch 2 on mb
+        DeltaWAL(dirs["ma"]).write_fence(key, 2, owner="mb")
+        svcs["mb"].result(key, timeout=120)
+        # migrate back: transfer mb -> ma, re-adopt on a fresh ma
+        svcs["ma"].close()
+        ring_mod.transfer_key(dirs["mb"], dirs["ma"], key)
+        svc_a2 = CheckerService(CASRegister(), wal_dir=dirs["ma"],
+                                capacity=128, recover=False)
+        svcs["ma"] = svc_a2
+        assert svc_a2.adopt_keys() == [key]          # epoch 3: clears
+        assert DeltaWAL(dirs["ma"]).fence(key) is None
+        rr = svc_a2.result(key, timeout=120)
+        assert _pin(rr) == _pin(_oneshot(h))
+    finally:
+        for s in svcs.values():
+            s.close()
+
+
+def test_unreadable_fence_fails_safe(tmp_path):
+    from jepsen_tpu.serve.wal import _safe_name
+    wal = DeltaWAL(str(tmp_path / "uf"))
+    wal.append("k", 1, [])
+    path = wal._fence_path(_safe_name("k"))   # no marker yet
+    with open(path + ".tmp", "w") as fh:
+        fh.write("{corrupt json")
+    os.replace(path + ".tmp", path)
+    doc = wal.fence("k")
+    assert doc is not None and doc["epoch"] > 1 << 60
+    assert "error" in doc
+
+
+# ------------------------------------------------- fleet supervisor
+
+
+class _Script:
+    """Deterministic fetch: per-replica liveness flips on command."""
+
+    def __init__(self, names):
+        self.alive = {n: True for n in names}
+
+    def __call__(self, addr, _timeout):
+        return self.alive[addr]
+
+
+def _mk_fleet(tmp_path, h, n=3):
+    dirs = {f"n{i}": str(tmp_path / f"n{i}") for i in range(n)}
+    svcs = {name: CheckerService(CASRegister(), wal_dir=d,
+                                 capacity=128)
+            for name, d in dirs.items()}
+    return dirs, svcs
+
+
+def test_supervisor_detects_rehomes_pins_and_rejoins(tmp_path):
+    h = _history(corrupt=False)
+    ref = _oneshot(h)
+    dirs, svcs = _mk_fleet(tmp_path, h)
+    script = _Script(dirs)
+    clk = [0.0]
+    sleeps = []
+    sup = FleetSupervisor(
+        {n: None for n in dirs}, dirs, services=svcs,
+        interval=1.0, threshold=2, rehome_retries=2,
+        fetch=script, clock=lambda: clk[0],
+        sleep=sleeps.append)
+    try:
+        key = "supkey"
+        owner = sup.owner(key)
+        victim = sup.ring.owner(key)
+        assert owner == victim
+        assert svcs[victim].submit(key, h, timeout=60)["accepted"]
+        svcs[victim].result(key, timeout=120)
+        base = obs.registry().snapshot()
+        # two misses -> dead -> rehome, all in deterministic ticks
+        script.alive[victim] = False
+        sup.tick()
+        assert not sup._reps[victim].dead
+        sup.tick()
+        assert sup._reps[victim].dead and sup._reps[victim].rehomed
+        snap = obs.registry().snapshot()
+        assert snap["fleet.deaths"]["value"] \
+            == base.get("fleet.deaths", {}).get("value", 0) + 1
+        assert snap["fleet.rehomes"]["value"] \
+            == base.get("fleet.rehomes", {}).get("value", 0) + 1
+        adopter = sup.owner(key)
+        assert adopter != victim and sup.pins[key] == adopter
+        rr = svcs[adopter].result(key, timeout=120)
+        assert _pin(rr) == _pin(ref)
+        # the victim's fence landed before the transfer
+        assert DeltaWAL(dirs[victim]).fence(key)["epoch"] == 2
+        st = sup.status()
+        assert st["replicas"][victim]["dead"] is True
+        assert st["pins"] == {str(key): adopter}
+        # recovery: the breaker's half-open probe re-admits it — for
+        # NEW keys only; the moved key stays pinned to its adopter
+        script.alive[victim] = True
+        clk[0] += 3600.0
+        sup.tick()
+        assert not sup._reps[victim].dead
+        assert obs.registry().snapshot()["fleet.rejoins"]["value"] \
+            == base.get("fleet.rejoins", {}).get("value", 0) + 1
+        assert sup.owner(key) == adopter   # pinned forever
+        assert victim in {sup.owner(("newkey", i))
+                          for i in range(200)}   # back for new keys
+    finally:
+        sup.stop()
+        for s in svcs.values():
+            s.close()
+
+
+def test_supervisor_rehome_retry_backoff_and_next_tick(tmp_path):
+    """A rehome whose adopter hiccups retries with bounded backoff
+    inside the tick; a whole exhausted budget stays pending and the
+    NEXT tick tries again (the supervisor never gives up on a dead
+    replica's keys)."""
+    h = _history(corrupt=False)
+    dirs, svcs = _mk_fleet(tmp_path, h, n=2)
+    script = _Script(dirs)
+    clk = [0.0]
+    sleeps = []
+    sup = FleetSupervisor(
+        {n: None for n in dirs}, dirs, services=svcs,
+        interval=1.0, threshold=1, rehome_retries=2,
+        fetch=script, clock=lambda: clk[0], sleep=sleeps.append)
+    try:
+        key = "rbkey"
+        victim = sup.ring.owner(key)
+        surv = next(n for n in dirs if n != victim)
+        assert svcs[victim].submit(key, h, timeout=60)["accepted"]
+        svcs[victim].result(key, timeout=120)
+        calls = []
+        real_adopt = svcs[surv].adopt_keys
+
+        def flaky_adopt():
+            calls.append(1)
+            if len(calls) < 4:
+                raise OSError("adopter disk hiccup")
+            return real_adopt()
+
+        svcs[surv].adopt_keys = flaky_adopt
+        base = obs.registry().snapshot().get(
+            "fleet.rehome_failures", {}).get("value", 0)
+        script.alive[victim] = False
+        sup.tick()   # dead + 2 failed attempts (budget exhausted)
+        assert sup._reps[victim].dead
+        assert not sup._reps[victim].rehomed
+        assert len(calls) == 2 and sleeps  # backoff between attempts
+        assert obs.registry().snapshot()[
+            "fleet.rehome_failures"]["value"] == base + 2
+        sup.tick()   # next tick retries: attempts 3 (fail) + 4 (ok)
+        assert sup._reps[victim].rehomed
+        assert sup.owner(key) == surv
+    finally:
+        sup.stop()
+        for s in svcs.values():
+            s.close()
+
+
+def test_supervisor_validates_fleet_shape(tmp_path):
+    with pytest.raises(ValueError, match="same fleet"):
+        FleetSupervisor({"a": None}, {"b": str(tmp_path)})
+
+
+def test_fleet_breakers_stay_out_of_global_trip_set(tmp_path):
+    """A dead PEER must not push this process's own device
+    dispatches onto the slow supervised path: the fleet's per-replica
+    breakers opt out of the module _tripped fast-path set."""
+    from jepsen_tpu.resilience import breaker as breaker_mod
+    dirs, svcs = _mk_fleet(tmp_path, None, n=2)
+    script = _Script(dirs)
+    sup = FleetSupervisor({n: None for n in dirs}, dirs,
+                          services=svcs, interval=1.0, threshold=1,
+                          fetch=script, clock=lambda: 0.0,
+                          sleep=lambda _s: None)
+    try:
+        victim = sorted(dirs)[0]
+        script.alive[victim] = False
+        sup.tick()
+        assert sup._reps[victim].dead
+        assert not breaker_mod.any_tripped()
+    finally:
+        sup.stop()
+        for s in svcs.values():
+            s.close()
+
+
+# ------------------------------------------------ review regressions
+
+
+def test_mirror_fallback_never_rehomes_live_survivors_keys(tmp_path):
+    """The survivors' repl/ mirrors hold EVERY replica's shipped keys
+    — the rehome fallback must move only the dead node's (a key a
+    survivor holds in its OWN WAL dir is live there; 'transferring'
+    it would overwrite live segments with a possibly-lagging mirror
+    copy)."""
+    h = _history(corrupt=False)
+    dirs = {n: str(tmp_path / n) for n in ("la", "lb", "lc")}
+    for d in dirs.values():
+        os.makedirs(d)
+    # lb holds a LIVE key, async-mirrored (lagging) into lc's repl/
+    svc_b = CheckerService(CASRegister(), wal_dir=dirs["lb"],
+                           capacity=128)
+    assert svc_b.submit("live-key", h[:10], timeout=60)["accepted"]
+    lagging = os.path.join(dirs["lc"], ring_mod.REPL_SUBDIR)
+    ring_mod.transfer_key(dirs["lb"], lagging, "live-key")
+    # ... and then appends MORE (the mirror now lags)
+    assert svc_b.submit("live-key", h[10:], timeout=60)["accepted"]
+    svc_b.result("live-key", timeout=120)
+    live_replay = DeltaWAL(dirs["lb"]).replay("live-key")
+    assert len(live_replay) == 2
+    # the dead node's key lives only in mirrors
+    dead_wal = DeltaWAL(str(tmp_path / "stage"))
+    dead_wal.append("dead-key", 1, h[:10])
+    dead_mirror = os.path.join(dirs["la"], ring_mod.REPL_SUBDIR)
+    ring_mod.transfer_key(str(tmp_path / "stage"), dead_mirror,
+                          "dead-key")
+    ring = ring_mod.HashRing(["la", "lb", "lc", "dead"])
+    sources = ring_mod._key_sources(str(tmp_path / "gone"), dirs)
+    assert "dead-key" in sources and "live-key" not in sources
+    plan = ring_mod.rehome_dead_replica(
+        str(tmp_path / "gone"), ring, "dead", dirs)
+    assert [k for ks in plan.values() for k in ks] == ["dead-key"]
+    # the live survivor's WAL was not touched
+    assert DeltaWAL(dirs["lb"]).replay("live-key") == live_replay
+    svc_b.close()
+
+
+def test_live_migrate_back_unfences_and_serves(tmp_path):
+    """Migrate a key away and BACK between two LIVE services (no
+    restart): the returning adoption must replace the fenced local
+    state, out-rank + clear the stale fence, and serve — not leave
+    the key refusing producers on every replica."""
+    h = _history(corrupt=False)
+    ref = _oneshot(h)
+    dirs = {n: str(tmp_path / n) for n in ("wa", "wb")}
+    svcs = {n: CheckerService(CASRegister(), wal_dir=d, capacity=128)
+            for n, d in dirs.items()}
+    router = ring_mod.Router(svcs, dirs)
+    try:
+        key = "bounce"
+        src = router.owner(key)
+        dst = next(n for n in dirs if n != src)
+        assert router.submit(key, h, wait=True,
+                             timeout=120)["valid?"] is not None
+        assert router.migrate_key(key, dst)["to"] == dst
+        assert svcs[src].submit(key, h, seq=2,
+                                timeout=10)["fenced"] is True
+        svcs[dst].result(key, timeout=120)
+        # ... and back, both services LIVE the whole time
+        assert router.migrate_key(key, src)["to"] == src
+        assert router.owner(key) == src
+        rr = svcs[src].result(key, timeout=120)
+        assert _pin(rr) == _pin(ref)
+        # the old owner is fenced, the returning one is not
+        assert svcs[dst].submit(key, h, seq=2,
+                                timeout=10)["fenced"] is True
+        assert svcs[src].submit(key, h[:4], seq=2,
+                                timeout=60)["accepted"]
+    finally:
+        for s in svcs.values():
+            s.close()
+
+
+def test_sync_no_destination_degrades_ack(tmp_path):
+    """A sync ack must not imply successor durability when there is
+    no successor to ship to (single-node ring): the answer carries
+    ``replicated: False``."""
+    h = _history(corrupt=False)
+    repl = SegmentReplicator(
+        DeltaWAL(str(tmp_path / "solo")),
+        fleet_mod.ring_successor_dst(ring_mod.HashRing(["solo"]),
+                                     {"solo": str(tmp_path / "solo")},
+                                     "solo"),
+        mode="sync")
+    svc = CheckerService(CASRegister(), wal_dir=str(tmp_path / "solo"),
+                         capacity=128, replicator=repl)
+    try:
+        r = svc.submit("nk", h[:6], timeout=60)
+        assert r["accepted"] and r["replicated"] is False
+        assert obs.registry().snapshot()[
+            "serve.repl_no_destination"]["value"] >= 1
+    finally:
+        svc.close()
+
+
+def test_ship_is_incremental_suffix_copy(tmp_path):
+    """Later ships append only the suffix (destination size = resume
+    offset): the mirror converges byte-identical and serve.repl_bytes
+    grows by the delta, not the whole segment re-copied."""
+    wal = DeltaWAL(str(tmp_path / "inc"))
+    mirror = str(tmp_path / "inc-mirror")
+    repl = SegmentReplicator(wal, fleet_mod.constant_dst(mirror),
+                             mode="sync")
+    h = _history(corrupt=False)
+    n1 = wal.append("ik", 1, h[:10])
+    assert repl.ship("ik") == 1
+    base = obs.registry().snapshot()["serve.repl_bytes"]["value"]
+    n2 = wal.append("ik", 2, h[10:])
+    assert repl.ship("ik") == 1
+    grew = obs.registry().snapshot()["serve.repl_bytes"]["value"] \
+        - base
+    assert grew == n2, (grew, n1, n2)   # suffix only, not n1+n2
+    src = wal.segments("ik")[0]
+    dst = os.path.join(mirror, os.path.basename(src))
+    with open(src, "rb") as a, open(dst, "rb") as b:
+        assert a.read() == b.read()
+    assert repl.ship("ik") == 0   # already current

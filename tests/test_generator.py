@@ -353,3 +353,41 @@ def test_pure_generator_rate_beats_reference_claim():
         best = max(best, len(h) / (time.perf_counter() - t0))
     assert best > 20_000, f"generation rate {best:.0f} ops/s below " \
                           f"the reference's documented 20k floor"
+
+
+# ------------------------- rand_*_history invocation-vs-op contract
+
+
+def test_rand_history_n_ops_counts_invocations_not_rows():
+    """THE n_ops contract (docs + the phantom-parity-bug regression):
+    ``rand_*_history(n_ops=N)`` generates N INVOCATIONS — like the
+    reference's generators count :invoke entries — and every
+    invocation gets exactly one completion row (ok/fail/info), so the
+    returned history has exactly 2N rows. A caller slicing the result
+    by ``n_ops`` gets HALF the stream with calls dangling open — a
+    valid prefix (so nothing crashes), which is exactly why the
+    mistake reads like a checker parity bug instead of what it is.
+    Pinned here so the contract can never drift silently."""
+    from jepsen_tpu.histories import (
+        rand_fifo_history, rand_gset_history, rand_queue_history,
+        rand_register_history,
+    )
+    for make in (rand_register_history, rand_gset_history,
+                 rand_queue_history, rand_fifo_history):
+        for n in (1, 10, 37):
+            ops = list(make(n_ops=n, n_processes=4, seed=11))
+            invokes = [o for o in ops if o["type"] == "invoke"]
+            completions = [o for o in ops
+                           if o["type"] in ("ok", "fail", "info")]
+            assert len(invokes) == n, (make.__name__, n, len(invokes))
+            assert len(completions) == n, (make.__name__, n)
+            assert len(ops) == 2 * n, (make.__name__, n, len(ops))
+        # the hazard itself: an n_ops slice truncates mid-stream —
+        # strictly fewer completions than calls, i.e. NOT the history
+        # the caller thinks it compared
+        ops = list(make(n_ops=20, n_processes=4, seed=11))
+        sliced = ops[:20]
+        n_inv = sum(1 for o in sliced if o["type"] == "invoke")
+        n_done = len(sliced) - n_inv
+        assert n_done < n_inv, \
+            f"{make.__name__}: an n_ops slice should leave calls open"

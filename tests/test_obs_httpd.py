@@ -659,10 +659,23 @@ def test_status_fleet_multi_addr(capsys):
         rc = ops_httpd.status_main(["--addr", a_ok, "--timeout", "5"])
         capsys.readouterr()
         assert rc == 0
-        # --json renders the raw doc map
+        # --json emits the machine-readable fleet view the
+        # supervisor and CI consume: per-replica state + worst-of
+        # exit, same fetch path as the human table (fetch_replica)
+        rc = ops_httpd.status_main(["--addr", a_ok, "--addr", a_dead,
+                                    "--json", "--timeout", "2"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 2
+        assert doc["replicas"][a_ok]["health"]["ok"] is True
+        assert doc["replicas"][a_ok]["state"] == "ready"
+        assert doc["replicas"][a_dead]["state"] == "unreachable"
+        assert doc["fleet"] == {"ready": 1, "degraded": 0,
+                                "unreachable": 1, "replicas": 2,
+                                "exit": 2}
         rc = ops_httpd.status_main(["--addr", a_ok, "--json"])
         doc = json.loads(capsys.readouterr().out)
-        assert doc[a_ok]["health"]["ok"] is True
+        assert rc == 0 and doc["fleet"]["exit"] == 0
+        assert doc["replicas"][a_ok]["health"]["ok"] is True
         # malformed address is a usage error, not a crash
         assert ops_httpd.status_main(["--addr", "nope"]) == 254
         capsys.readouterr()

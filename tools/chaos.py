@@ -1,0 +1,686 @@
+#!/usr/bin/env python
+"""Fleet chaos soak (ISSUE 13 acceptance): a Jepsen-style nemesis
+schedule driven against a REAL multi-replica, multi-tenant serve
+fleet — subprocess replicas, HTTP ingress, sync WAL-segment
+replication, and the FleetSupervisor doing the healing, with every
+claim verified from the parsed /metrics scrape.
+
+The fleet: N replica subprocesses (each a CheckerService + HTTP
+ingress + ops endpoint + sync SegmentReplicator shipping segments to
+its ring successor), one flooding tenant and two quiet tenants
+streaming real histories through parent-side routing
+(FleetSupervisor.owner: ring + rehome pins). One replica spawns with
+JEPSEN_TPU_FAULTS armed (wedge + flaky + slow at the device seams),
+so degradation paths run under load.
+
+The nemesis schedule (--smoke: one SIGKILL + one SIGSTOP cycle,
+~15 s; full mode adds rolling kill/restart cycles until --secs):
+
+  * SIGKILL a replica mid-stream AND delete its WAL dir — the
+    supervisor must detect the death from /healthz misses and rehome
+    its keys FROM THE REPLICATED SEGMENTS on the survivors;
+  * SIGSTOP a replica (paused, not dead) — the supervisor declares
+    it dead and rehomes; SIGCONT resumes it, and a delta posted
+    straight to the resumed replica must get the structured epoch-
+    fence refusal (the split-brain pin);
+  * rolling restart (full mode): a killed replica respawns with the
+    same identity + ports, recovers its WAL, finds its keys fenced,
+    and rejoins the ring for new keys via the half-open probe.
+
+Asserted (exit 1 on any failure):
+
+  * ZERO verdict flips — a decided-invalid verdict never flips back,
+    and every finalized key's verdict is bit-identical to a one-shot
+    check of exactly the accepted ops;
+  * ZERO lost keys — every key's final seq equals the count of
+    acknowledged deltas, across kills, rehomes, and re-routes;
+  * the epoch fence ENGAGED: the resumed replica answered
+    {"fenced": true} and its scraped jepsen_serve_fenced_refusals
+    moved;
+  * quiet-tenant SLOs held: no quiet shed on any replica, ack p99
+    within budget — from the scraped per-tenant histograms;
+  * the supervisor's own trail: jepsen_fleet_deaths / rehomes (and
+    rejoins, full mode) moved on the parent's registry.
+
+docs/streaming.md "Fleet self-healing" is the runbook this script
+rehearses; tools/ci.sh runs --smoke after soak --smoke.
+"""
+
+import argparse
+import http.client
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+#: a replica dying mid-response surfaces as OSError OR an
+#: http.client framing error — both mean "re-route and retry"
+RETRY_ERRS = (OSError, http.client.HTTPException)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ACK_SLO_SECS = 10.0       # quiet-tenant ack p99 budget (CPU CI box,
+# heartbeat pauses + re-routes included)
+FAULT_SPEC = ("wedge@search:n=1,flaky@dispatch:n=2,"
+              "slow@search:ms=5")
+#: the flood tenant gets an explicit small pending-ops quota so the
+#: fairness line trips deterministically against a HEALTHY worker
+#: (the derived weight-share bound only bites when the queue backs up)
+TENANTS = ("chaos-flood:token=tok-chaos-flood:weight=1:ops=24,"
+           "chaos-q0:token=tok-chaos-q0:weight=2,"
+           "chaos-q1:token=tok-chaos-q1:weight=2")
+
+_CHILD = r"""
+import faulthandler, json, os, signal, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# SIGUSR1 -> all-thread stack dump on stderr (lands in the replica's
+# stderr log): the postmortem lever for a wedged worker
+faulthandler.register(signal.SIGUSR1)
+with open(sys.argv[1]) as fh:
+    cfg = json.load(fh)
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.obs import httpd as ops_httpd
+from jepsen_tpu.serve import CheckerService
+from jepsen_tpu.serve import fleet as fleet_mod, ring as ring_mod
+from jepsen_tpu.serve.ingress import DeltaIngress
+from jepsen_tpu.serve.wal import DeltaWAL
+
+name = cfg["name"]
+wal_dir = cfg["wal_dirs"][name]
+# static replication ring: every replica computes the same per-key
+# successor the coordinator's rehome fallback will scan
+ring = ring_mod.HashRing(sorted(cfg["wal_dirs"]))
+repl = fleet_mod.SegmentReplicator(
+    DeltaWAL(wal_dir),
+    fleet_mod.ring_successor_dst(ring, cfg["wal_dirs"], name))
+if repl.mode == "off":
+    repl = None
+svc = CheckerService(CASRegister(), wal_dir=wal_dir,
+                     capacity=cfg.get("capacity", 256),
+                     replicator=repl)
+ing = DeltaIngress(svc, port=cfg["ingress_port"]).start()
+ops = ops_httpd.start_ops_server(
+    cfg["ops_port"], health_fn=svc.health, status_fn=svc.status,
+    refresh_fn=svc.refresh_gauges, adopt_fn=svc.adopt_keys)
+print(json.dumps({"ready": True, "ops": ops.port,
+                  "ingress": ing.port}), flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+def _pick_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _post_lines(addr, reqs, token, timeout=30):
+    body = "".join(json.dumps(r) + "\n" for r in reqs).encode()
+    req = urllib.request.Request(
+        f"http://{addr}/v1/deltas", data=body,
+        headers={"Authorization": f"Bearer {token}"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return [json.loads(ln) for ln in
+                resp.read().decode().splitlines()]
+
+
+def _scrape(ops_addr, timeout=10):
+    with urllib.request.urlopen(f"http://{ops_addr}/metrics",
+                                timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+class Fleet:
+    """The subprocess replica set: spawn/kill/stop/respawn, with the
+    parent-picked fixed ports that make restart-in-place possible."""
+
+    def __init__(self, names, base_env, root):
+        self.names = list(names)
+        self.base_env = base_env
+        self.root = root
+        self.wal_dirs = {n: os.path.join(root, n) for n in names}
+        self.ops_port = {n: _pick_port() for n in names}
+        self.ing_port = {n: _pick_port() for n in names}
+        self.procs = {}
+        self.cfg_paths = {}
+        script = os.path.join(root, "replica.py")
+        with open(script, "w") as fh:
+            fh.write(_CHILD)
+        self.script = script
+        for n in names:
+            cfg = {"name": n, "wal_dirs": self.wal_dirs,
+                   "ops_port": self.ops_port[n],
+                   "ingress_port": self.ing_port[n]}
+            path = os.path.join(root, f"{n}.json")
+            with open(path, "w") as fh:
+                json.dump(cfg, fh)
+            self.cfg_paths[n] = path
+
+    def ops_addr(self, n):
+        return f"127.0.0.1:{self.ops_port[n]}"
+
+    def ing_addr(self, n):
+        return f"127.0.0.1:{self.ing_port[n]}"
+
+    def spawn(self, name, extra_env=None):
+        env = dict(self.base_env)
+        if extra_env:
+            env.update(extra_env)
+        # replica stderr -> a per-replica log (append across
+        # respawns): the postmortem evidence when an assertion fails
+        errlog = open(os.path.join(self.root, f"{name}.stderr.log"),
+                      "ab")
+        proc = subprocess.Popen(
+            [sys.executable, self.script, self.cfg_paths[name]],
+            stdout=subprocess.PIPE, stderr=errlog,
+            env=env)
+        errlog.close()
+        line = proc.stdout.readline().decode()
+        if not line:
+            raise RuntimeError(f"replica {name} produced no ready "
+                               f"line (exit {proc.poll()})")
+        doc = json.loads(line)
+        assert doc.get("ready"), doc
+        self.procs[name] = proc
+        return proc
+
+    def kill(self, name):
+        self.procs[name].send_signal(signal.SIGKILL)
+        self.procs[name].wait(timeout=30)
+
+    def pause(self, name):
+        self.procs[name].send_signal(signal.SIGSTOP)
+
+    def resume(self, name):
+        self.procs[name].send_signal(signal.SIGCONT)
+
+    def close(self):
+        for p in self.procs.values():
+            if p.poll() is None:
+                p.kill()
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--secs", type=float, default=60.0,
+                   help="full-mode soak duration (rolling nemesis "
+                        "cycles until the deadline)")
+    p.add_argument("--smoke", action="store_true",
+                   help="CI shape (~15 s): one SIGKILL(+WAL-dir "
+                        "delete) cycle + one SIGSTOP/SIGCONT fence "
+                        "cycle")
+    p.add_argument("--replicas", type=int, default=3)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    from jepsen_tpu import obs
+    from jepsen_tpu.histories import corrupt_history, \
+        rand_register_history
+    from jepsen_tpu.history import History
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.obs import httpd as ops_httpd
+    from jepsen_tpu.parallel import encode as enc_mod, engine
+    from jepsen_tpu.serve import fleet as fleet_mod
+
+    failures = []
+
+    def fail(msg):
+        print(f"chaos: FAIL {msg}")
+        failures.append(msg)
+
+    t0 = time.monotonic()
+    root = tempfile.mkdtemp(prefix="jepsen_chaos_")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base_env = {k: v for k, v in os.environ.items()
+                if not k.startswith("JEPSEN_TPU_")}
+    base_env.update(
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH",
+                                                      ""),
+        JEPSEN_TPU_TENANTS=TENANTS,
+        JEPSEN_TPU_SERVE_REPL="sync")
+    names = [f"r{i}" for i in range(max(2, args.replicas))]
+    fleet = Fleet(names, base_env, root)
+    # one replica runs with the device-fault matrix armed: wedge +
+    # flaky + slow at the supervised dispatch seams, under real load
+    fault_replica = names[-1]
+    for n in names:
+        fleet.spawn(n, extra_env=(
+            {"JEPSEN_TPU_FAULTS": FAULT_SPEC}
+            if n == fault_replica else None))
+    print(f"chaos: fleet up — {len(names)} replicas, faults armed "
+          f"on {fault_replica} ({FAULT_SPEC})")
+
+    rehome_events = []
+    rehomed = threading.Event()
+
+    def on_rehome(name, plan):
+        rehome_events.append((name, {k: len(v)
+                                     for k, v in plan.items()}))
+        rehomed.set()
+
+    sup = fleet_mod.FleetSupervisor(
+        {n: fleet.ops_addr(n) for n in names}, fleet.wal_dirs,
+        services={n: fleet_mod.HttpReplica(fleet.ops_addr(n))
+                  for n in names},
+        interval=0.25, threshold=2, fetch_timeout=1.0,
+        on_rehome=on_rehome).start()
+
+    # --- tenants, keys, streams
+    quiet = ["chaos-q0", "chaos-q1"]
+    n_ops = 24 if args.smoke else 48
+    cut = 6
+    streams = {}
+    for ti, tname in enumerate(quiet):
+        h = rand_register_history(
+            n_ops=n_ops, n_processes=4, n_values=3, crash_p=0.04,
+            seed=args.seed + 10 * ti)
+        if ti % 2:
+            h = corrupt_history(h, seed=ti, n_corruptions=2)
+        ops = list(h)
+        streams[(tname, f"{tname}-k")] = [
+            ops[i:i + cut] for i in range(0, len(ops), cut)]
+
+    accepted = {k: [] for k in streams}
+    finals = {}
+    first_acked = {k: threading.Event() for k in streams}
+    stop_flood = threading.Event()
+    flip_stop = threading.Event()
+    flips = []
+    def route(key):
+        return fleet.ing_addr(sup.owner(key))
+
+    def submit_routed(tname, key, piece, seq, deadline):
+        """Retry-until-landed: re-resolves the owner every attempt,
+        so a rehome mid-stream re-routes the producer; an ack lost to
+        a kill is resubmitted and dedupes by seq."""
+        while time.monotonic() < deadline:
+            try:
+                outs = _post_lines(
+                    route(key),
+                    [{"key": key, "ops": [dict(o) for o in piece],
+                      "seq": seq, "timeout": 10}],
+                    f"tok-{tname}", timeout=20)
+            except RETRY_ERRS:
+                time.sleep(0.25)   # owner mid-death or mid-rehome
+                continue
+            r = outs[0]
+            if r.get("accepted") or r.get("duplicate"):
+                return r
+            if r.get("fenced"):
+                time.sleep(0.25)   # pins not updated yet — re-route
+                continue
+            if r.get("error", "").startswith("sequence gap"):
+                time.sleep(0.25)   # adopter still replaying
+                continue
+            if r.get("shed"):
+                fail(f"quiet tenant {tname} shed: {r}")
+                return r
+            fail(f"quiet tenant {tname} submit error: {r}")
+            return r
+        fail(f"quiet tenant {tname} timed out landing seq {seq} of "
+             f"{key}")
+        return None
+
+    def producer(tname, key):
+        pieces = streams[(tname, key)]
+        deadline = time.monotonic() + (120 if args.smoke
+                                       else args.secs + 120)
+        for seq, piece in enumerate(pieces, start=1):
+            r = submit_routed(tname, key, piece, seq, deadline)
+            if r is None or r.get("shed") or "error" in r:
+                return
+            accepted[(tname, key)].append(piece)
+            first_acked[(tname, key)].set()
+        # finalize on the (current) owner, with re-route retries
+        while time.monotonic() < deadline:
+            try:
+                outs = _post_lines(route(key),
+                                   [{"op": "finalize", "key": key,
+                                     "timeout": 60}],
+                                   f"tok-{tname}", timeout=90)
+            except RETRY_ERRS:
+                time.sleep(0.25)
+                continue
+            if outs[0].get("fenced"):
+                time.sleep(0.25)
+                continue
+            if "error" in outs[0]:
+                own = sup.owner(key)
+                print(f"chaos: DEBUG finalize {key} on {own}: "
+                      f"{outs[0]}")
+                try:
+                    from jepsen_tpu.obs.httpd import fetch_replica
+                    doc = fetch_replica(fleet.ops_addr(own),
+                                        timeout=5)
+                    st = (doc.get("status") or {})
+                    print(f"chaos: DEBUG {own} worker_alive="
+                          f"{st.get('worker_alive')} pending="
+                          f"{st.get('pending_ops')} keys="
+                          f"{ {k: (v.get('state'), v.get('seq'), v.get('pending_ops'), v.get('error')) for k, v in (st.get('keys') or {}).items()} }")
+                except Exception as err:
+                    print(f"chaos: DEBUG status fetch failed {err}")
+                time.sleep(0.5)
+                continue
+            finals[(tname, key)] = outs[0]
+            return
+        fail(f"{key}: finalize never landed")
+
+    def flood():
+        # every piece is a SELF-CONTAINED complete history (every
+        # call closes inside it, crash_p=0 so no call stays open as a
+        # crashed wildcard), so the accepted subsequence — quota
+        # sheds drop arbitrary pieces — still stitches into a stream
+        # whose open-call/slot window stays ~n_processes wide.
+        # Arbitrary h[lo:lo+k] slices here once stitched into an
+        # 18-slot wildcard-riddled monster whose frontier search
+        # wedged the adopter's worker for minutes — an accidental
+        # adversarial-history DoS, not the fairness load this tenant
+        # exists to apply.
+        pieces = [list(rand_register_history(
+            n_ops=8, n_processes=4, n_values=3, crash_p=0.0,
+            seed=5000 + i))
+            for i in range(8)]
+        i = 0
+        while not stop_flood.is_set():
+            piece = pieces[i % len(pieces)]
+            try:
+                _post_lines(route("chaos-flood-k"),
+                            [{"key": "chaos-flood-k",
+                              "ops": [dict(o) for o in piece],
+                              "timeout": 0.05}],
+                            "tok-chaos-flood", timeout=8)
+            except RETRY_ERRS:
+                time.sleep(0.2)
+            i += 1
+
+    def flip_monitor():
+        seen_invalid = set()
+        while not flip_stop.is_set():
+            for (tname, key) in streams:
+                try:
+                    outs = _post_lines(route(key),
+                                       [{"op": "result", "key": key,
+                                         "timeout": 0.05}],
+                                       f"tok-{tname}", timeout=5)
+                except RETRY_ERRS:
+                    continue
+                v = outs[0].get("valid?")
+                if v is False:
+                    seen_invalid.add(key)
+                elif v is True and key in seen_invalid:
+                    flips.append(key)
+            time.sleep(0.25)
+
+    threads = [threading.Thread(target=producer, args=k, daemon=True)
+               for k in streams]
+    fthread = threading.Thread(target=flood, daemon=True)
+    mthread = threading.Thread(target=flip_monitor, daemon=True)
+    mthread.start()
+    fthread.start()
+    for t in threads:
+        t.start()
+
+    # --- nemesis -----------------------------------------------------
+
+    def await_rehome(what, timeout=30):
+        if not rehomed.wait(timeout=timeout):
+            fail(f"supervisor never rehomed after {what}")
+            return False
+        rehomed.clear()
+        return True
+
+    fence_engaged = False
+    fenced_replica = None
+
+    def sigkill_cycle():
+        """SIGKILL + WAL-dir delete: rehome must come from the
+        replicated segments."""
+        for ev in first_acked.values():
+            ev.wait(timeout=60)
+        victim = sup.owner(next(iter(streams))[1])
+        print(f"chaos: SIGKILL {victim} + deleting its WAL dir")
+        fleet.kill(victim)
+        shutil.rmtree(fleet.wal_dirs[victim], ignore_errors=True)
+        return await_rehome(f"SIGKILL {victim}")
+
+    def sigstop_cycle():
+        """SIGSTOP -> rehome -> SIGCONT -> the resumed replica must
+        answer the epoch-fence refusal to a directly-addressed
+        delta."""
+        nonlocal fence_engaged, fenced_replica
+        live_keys = [k for (_t, k) in streams
+                     if not sup._reps[sup.owner(k)].dead]
+        if not live_keys:
+            fail("no live key to SIGSTOP")
+            return False
+        key = live_keys[0]
+        tname = next(t for (t, k) in streams if k == key)
+        victim = sup.owner(key)
+        print(f"chaos: SIGSTOP {victim} (owner of {key})")
+        fleet.pause(victim)
+        if not await_rehome(f"SIGSTOP {victim}"):
+            fleet.resume(victim)
+            return False
+        print(f"chaos: SIGCONT {victim} — probing the fence")
+        fleet.resume(victim)
+        fenced_replica = victim
+        # a stale producer that never heard about the rehome talks to
+        # the resumed replica DIRECTLY: the epoch fence must refuse
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                outs = _post_lines(
+                    fleet.ing_addr(victim),
+                    [{"key": key, "ops": [], "seq": 999,
+                      "timeout": 5}],
+                    f"tok-{tname}", timeout=8)
+            except RETRY_ERRS:
+                time.sleep(0.25)   # still waking up
+                continue
+            r = outs[0]
+            if r.get("fenced") is True:
+                fence_engaged = True
+                print(f"chaos: fence engaged on {victim}: "
+                      f"epoch {r.get('epoch')} owner {r.get('owner')}")
+                return True
+            time.sleep(0.25)   # transport up but fence not yet
+            # observed (shouldn't happen — the fence landed before
+            # the rehome that gated this probe — but stay patient)
+        fail(f"resumed replica {victim} never answered a fence "
+             f"refusal")
+        return False
+
+    ok = sigkill_cycle()
+    # the flood made its fairness point during the kill window; stop
+    # it BEFORE the pause cycle so the SIGSTOP victim's WAL (which
+    # the next rehome replays on the adopter) stays modest — an
+    # unbounded flood backlog would turn adoption into minutes of
+    # replay and read as a hang
+    stop_flood.set()
+    fthread.join(timeout=60)
+    if ok:
+        sigstop_cycle()
+
+    # --- the scrape tells the story ----------------------------------
+    # The quiet streams drain DURING the nemesis cycles, so the
+    # fairness/SLO/fence evidence lives in the replicas that served
+    # them — scrape it NOW, before full mode's rolling restarts
+    # replace those processes (a respawned replica starts a fresh
+    # in-process registry).
+    for t in threads:
+        t.join(timeout=300)
+    live = [n for n in names if fleet.procs[n].poll() is None
+            and not sup._reps[n].dead]
+    # the resumed (fenced) replica answers /metrics even though the
+    # supervisor may have re-admitted it — scrape it explicitly
+    scrape_set = set(live)
+    if fenced_replica is not None \
+            and fleet.procs[fenced_replica].poll() is None:
+        scrape_set.add(fenced_replica)
+    parsed = {}
+    for n in sorted(scrape_set):
+        try:
+            parsed[n] = ops_httpd.parse_prometheus(
+                _scrape(fleet.ops_addr(n)))
+        except OSError as err:
+            fail(f"could not scrape {n}: {err}")
+
+    def total(metric, tenant=None):
+        key = (obs.labeled(metric, tenant=tenant) if tenant
+               else metric)
+        return sum(p[key]["value"] for p in parsed.values()
+                   if key in p)
+
+    if not fence_engaged:
+        fail("the epoch fence never engaged (no fenced response)")
+    if total("jepsen_serve_fenced_refusals") < 1:
+        fail("scrape shows no jepsen_serve_fenced_refusals anywhere")
+    flood_sheds = int(total("jepsen_serve_sheds",
+                            tenant="chaos-flood"))
+    if flood_sheds < 1:
+        fail("the flooding tenant never shed — the quota never bit")
+    for tname in quiet:
+        if total("jepsen_serve_sheds", tenant=tname) > 0:
+            fail(f"quiet tenant {tname} was shed")
+        # merged per-tenant ack histogram across the fleet
+        merged = {"count": 0, "total": 0.0, "buckets": {},
+                  "max": None, "min": None, "type": "histogram"}
+        for p in parsed.values():
+            h = p.get(obs.labeled("jepsen_serve_ack_secs",
+                                  tenant=tname))
+            if not h:
+                continue
+            merged["count"] += h["count"]
+            merged["total"] += h["total"]
+            for le, cum in h.get("buckets") or ():
+                merged["buckets"][le] = merged["buckets"].get(
+                    le, 0) + cum
+            if h.get("max") is not None:
+                merged["max"] = max(merged["max"] or 0.0, h["max"])
+        merged["buckets"] = sorted(merged["buckets"].items())
+        if not merged["count"]:
+            fail(f"/metrics missing populated "
+                 f"serve.ack_secs{{tenant={tname}}}")
+            continue
+        p99 = obs.hist_quantile(merged, 0.99)
+        if p99 is None or p99 > ACK_SLO_SECS:
+            fail(f"quiet tenant {tname} ack p99 {p99} past the "
+                 f"{ACK_SLO_SECS}s SLO")
+
+    if not args.smoke:
+        # rolling restarts: respawn the killed replicas in place
+        # (same identity + ports), let them rejoin via the half-open
+        # probe, and keep the nemesis rolling until the deadline —
+        # detect/rehome/rejoin under churn, with the flip monitor
+        # still polling every key's verdict across each move
+        deadline = t0 + args.secs
+        cycle = 0
+        while time.monotonic() < deadline:
+            dead = [n for n in names if sup._reps[n].dead
+                    and fleet.procs[n].poll() is not None]
+            for n in dead:
+                print(f"chaos: rolling restart of {n}")
+                os.makedirs(fleet.wal_dirs[n], exist_ok=True)
+                fleet.spawn(n, extra_env=(
+                    {"JEPSEN_TPU_FAULTS": FAULT_SPEC}
+                    if n == fault_replica else None))
+            # wait for a rejoin before the next kill
+            t_end = time.monotonic() + 20
+            while time.monotonic() < t_end and any(
+                    sup._reps[n].dead for n in dead):
+                time.sleep(0.25)
+            alive = [n for n in names if not sup._reps[n].dead]
+            if len(alive) > 2 and time.monotonic() < deadline - 15:
+                victim = alive[cycle % len(alive)]
+                print(f"chaos: rolling SIGKILL {victim}")
+                fleet.kill(victim)
+                rehomed.clear()
+                await_rehome(f"rolling kill {victim}")
+            cycle += 1
+            time.sleep(1)
+        snap = obs.registry().snapshot()
+        if (snap.get("fleet.rejoins") or {}).get("value", 0) < 1:
+            fail("full mode: no replica ever rejoined through the "
+                 "half-open probe")
+
+    # --- drain + verify ---------------------------------------------
+    flip_stop.set()
+    mthread.join(timeout=30)
+
+    if flips:
+        fail(f"verdict flips observed on {sorted(set(flips))}")
+    for (tname, key), pieces in accepted.items():
+        f = finals.get((tname, key)) or {}
+        ops = [op for piece in pieces for op in piece]
+        if f.get("seq") != len(pieces):
+            fail(f"{key}: final seq {f.get('seq')} != accepted "
+                 f"{len(pieces)} — an admitted delta went missing "
+                 f"(final answer: {f})")
+        if not ops:
+            continue
+        ref = engine.check_encoded(
+            enc_mod.encode(CASRegister(), History.wrap(ops)),
+            capacity=256)
+        pin = lambda r: {k: r.get(k) for k in  # noqa: E731
+                         ("valid?", "op", "fail-event")}
+        if pin(f) != pin(ref):
+            fail(f"{key}: final verdict diverged from one-shot: "
+                 f"{pin(f)} != {pin(ref)}")
+
+    # the supervisor's own trail (parent-process registry)
+    snap = obs.registry().snapshot()
+    if (snap.get("fleet.deaths") or {}).get("value", 0) < 1:
+        fail("fleet.deaths never moved — the supervisor missed the "
+             "nemesis")
+    if (snap.get("fleet.rehomes") or {}).get("value", 0) \
+            < len(rehome_events):
+        fail("fleet.rehomes under-counts the observed rehomes")
+    # post-drain bounded state on the live replicas (recomputed —
+    # full mode's rolling phase changed who is alive)
+    live = [n for n in names if fleet.procs[n].poll() is None
+            and not sup._reps[n].dead]
+    for n in live:
+        try:
+            doc = ops_httpd.fetch_replica(fleet.ops_addr(n),
+                                          timeout=10)
+        except OSError:
+            continue
+        pend = (doc.get("status") or {}).get("pending_ops")
+        if pend:
+            fail(f"{n}: pending_ops {pend} after drain")
+
+    sup.stop()
+    fleet.close()
+    dur = time.monotonic() - t0
+    n_deltas = sum(len(p) for p in accepted.values())
+    if failures:
+        # keep the scratch dir: WAL segments + per-replica stderr
+        # logs are the postmortem
+        print(f"chaos: {len(failures)} failure(s) in {dur:.1f}s — "
+              f"evidence kept in {root}")
+        return 1
+    shutil.rmtree(root, ignore_errors=True)
+    print(f"chaos: OK in {dur:.1f}s — {n_deltas} quiet deltas / "
+          f"{len(streams)} keys across {len(names)} replicas, "
+          f"{len(rehome_events)} rehome(s) {rehome_events}, fence "
+          f"engaged, flood shed {flood_sheds}x, zero flips, zero "
+          f"lost keys")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

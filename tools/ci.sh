@@ -22,6 +22,14 @@
 #      JEPSEN_TPU_FAULTS armed mid-run (wedge/crash/flaky/slow);
 #      asserts zero verdict flips, bounded memory, flood-tenant
 #      sheds, quiet-tenant SLOs populated per tenant on /metrics
+#   1e. fleet chaos smoke — tools/chaos.py --smoke (~15 s): a real
+#      subprocess fleet under a nemesis schedule — one SIGKILL with
+#      the victim's WAL dir deleted (rehome must come from the
+#      replicated segments) and one SIGSTOP/SIGCONT cycle (the
+#      resumed replica must answer the epoch-fence refusal);
+#      asserts zero verdict flips, zero lost keys, fence engaged,
+#      quiet-tenant SLOs from the parsed /metrics scrape
+#      (docs/streaming.md "Fleet self-healing")
 #   2. tier-1 tests     — the ROADMAP.md invocation verbatim: the
 #      full suite minus the slow tier on a virtual 8-device CPU mesh,
 #      under the documented 870s budget (timeout -k 10 870). The
@@ -44,6 +52,9 @@ env JAX_PLATFORMS=cpu python tools/serve_smoke.py || exit 1
 
 echo "== multi-tenant soak smoke =="
 env JAX_PLATFORMS=cpu python tools/soak.py --smoke || exit 1
+
+echo "== fleet chaos smoke =="
+env JAX_PLATFORMS=cpu python tools/chaos.py --smoke || exit 1
 
 echo "== tier-1 tests (870s budget) =="
 set -o pipefail
