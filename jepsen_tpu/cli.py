@@ -153,7 +153,18 @@ def base_parser(prog: str = "jepsen") -> argparse.ArgumentParser:
         "report", add_help=False,
         help="render a stored run's telemetry reports; --search "
              "renders the JEPSEN_TPU_SEARCH_STATS per-key table "
-             "(worst keys by load factor / escalations / pad waste)")
+             "(worst keys by load factor / escalations / pad waste); "
+             "--slow renders the slow-delta forensics table "
+             "(JEPSEN_TPU_SLOW_DELTA_SECS stage breakdowns)")
+    # listed for --help discoverability only, like lint/probe/status:
+    # run_cli dispatches `trace` BEFORE parsing (obs.trace_merge owns
+    # its flags and the 0/1/2 merged/invalid/unreachable contract)
+    tr = sub.add_parser(
+        "trace", add_help=False,
+        help="merge a fleet's per-replica trace exports (live /trace "
+             "endpoints, run dirs, flight dumps) into one Perfetto "
+             "file — one process track per replica, wall-clock "
+             "aligned; --validate schema-checks exports")
     ta = sub.add_parser(
         "test-all", help="run a whole suite of tests in one go")
     common(ta)
@@ -165,7 +176,8 @@ def base_parser(prog: str = "jepsen") -> argparse.ArgumentParser:
                          "single --nemesis)")
     p._jepsen_subparsers = {"test": t, "analyze": a, "serve": s,
                             "lint": li, "probe": pr, "status": st,
-                            "report": rp, "test-all": ta}
+                            "report": rp, "trace": tr,
+                            "test-all": ta}
     return p
 
 
@@ -461,11 +473,18 @@ def run_cli(test_fn: Optional[Callable[[Dict], Dict]] = None,
         from jepsen_tpu.obs import httpd as ops_httpd
         return ops_httpd.status_main(raw[1:])
     if raw[:1] == ["report"]:
-        # same pre-parse forwarding: the search-telemetry report owns
-        # its flags (`--search --run-dir`), reads stored artifacts
-        # only, and never touches jax
+        # same pre-parse forwarding: the telemetry reports own their
+        # flags (`--search` / `--slow`, `--run-dir`), read stored
+        # artifacts only, and never touch jax
         from jepsen_tpu.obs import search_report
         return search_report.report_main(raw[1:])
+    if raw[:1] == ["trace"]:
+        # same pre-parse forwarding: the fleet trace merge owns its
+        # flags, talks only to ops endpoints / trace files, and never
+        # touches jax — it must run from a coordinator while the
+        # fleet's device runtimes are busy or wedged
+        from jepsen_tpu.obs import trace_merge
+        return trace_merge.trace_main(raw[1:])
     parser = base_parser(prog)
     if extend_parser is not None:
         extend_parser(parser)

@@ -386,6 +386,24 @@ def env_path(name: str, what: str = "path") -> Optional[str]:
 #                            probe.last_ok_age_secs gauges (feeding
 #                            /healthz + flight dumps); unset/0 = off
 #                            (no thread)
+#   JEPSEN_TPU_SLOW_DELTA_SECS env_float serve.service — slow-delta
+#                            forensics threshold: a delta whose
+#                            ingest->verdict latency crosses this many
+#                            seconds lands a structured record (stage
+#                            breakdown admission/backpressure/wal/
+#                            queue/device/publish, verdict, resilience
+#                            note, search-stats block when armed) in a
+#                            bounded newest-wins ring — surfaced on
+#                            /status, drained into slow_deltas.jsonl
+#                            by export_run, rendered by `jepsen report
+#                            --slow`, and flight-dumped on the worst
+#                            offender. Also arms per-delta trace
+#                            identity (delta_id minting + WAL id
+#                            stamping) like JEPSEN_TPU_TRACE /
+#                            JEPSEN_TPU_FLIGHT_RECORDER do. Unset/0 =
+#                            off — serve results, WAL bytes, /status
+#                            and /metrics schema byte-identical to the
+#                            pre-forensics service
 #   JEPSEN_TPU_FLIGHT_RECORDER env_int   obs.tracer — crash flight
 #                            recorder: retain the last N closed spans
 #                            in a bounded ring EVEN WITH TRACING OFF

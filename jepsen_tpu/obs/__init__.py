@@ -36,10 +36,11 @@ the ``purity-obs-in-trace`` lint rule enforces this mechanically.
 """
 
 from jepsen_tpu.obs.export import (  # noqa: F401
-    chrome_trace, drain_search_stats, export_run, flight_dump,
-    flight_reset, jsonl_events, record_search_stats,
-    search_stats_records, set_flight_dir, summary, write_chrome_trace,
-    write_jsonl, write_search_stats,
+    chrome_trace, drain_search_stats, drain_slow_deltas, export_run,
+    flight_dump, flight_reset, jsonl_events, record_search_stats,
+    record_slow_delta, search_stats_records, set_flight_dir,
+    slow_delta_records, summary, write_chrome_trace, write_jsonl,
+    write_search_stats, write_slow_deltas,
 )
 from jepsen_tpu.obs.metrics import (  # noqa: F401
     BUCKET_LADDER, Registry, counter, gauge, hist_quantile, histogram,

@@ -29,6 +29,7 @@ import json
 import logging
 import os
 import threading
+from collections import deque
 from typing import Dict, List, Optional
 
 from jepsen_tpu.obs import metrics as _metrics
@@ -56,6 +57,12 @@ def chrome_trace(tr: Optional[_tracer.Tracer] = None,
          "args": {"name": "host"}},
         {"ph": "M", "pid": DEVICE_PID, "name": "process_name",
          "args": {"name": "device"}},
+        # the wall-clock epoch stamp: ts 0 of this trace on the unix
+        # clock, so `jepsen trace` can merge several replicas' exports
+        # onto one aligned time axis (Perfetto ignores unknown "M"
+        # records)
+        {"ph": "M", "pid": HOST_PID, "name": "trace_epoch",
+         "args": {"unix": round(tr.epoch_unix, 6)}},
     ]
     # stable synthetic tids for device-bucket tracks, in first-seen
     # order; host tracks use the real thread idents
@@ -235,7 +242,9 @@ def drain_search_stats() -> list:
         return out
 
 
-def write_search_stats(path: str, records: list) -> str:
+def _write_jsonl_records(path: str, records: list) -> str:
+    """One record per line — the shared shape of every drained-ring
+    run artifact (search stats, slow deltas)."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -243,6 +252,76 @@ def write_search_stats(path: str, records: list) -> str:
         for rec in records:
             fh.write(json.dumps(rec, default=str) + "\n")
     return path
+
+
+def write_search_stats(path: str, records: list) -> str:
+    return _write_jsonl_records(path, records)
+
+
+# ------------------------------------------- slow-delta forensics
+
+# Bounded newest-wins ring of slow-delta records (deltas whose
+# ingest->verdict latency crossed JEPSEN_TPU_SLOW_DELTA_SECS — see
+# serve.service): each record is the delta's stage-by-stage timing
+# breakdown plus its verdict/resilience/search-stats context. A deque
+# with maxlen drops the OLDEST record past the bound — in a sustained
+# slowdown the newest evidence is the side that must survive.
+SLOW_DELTA_MAX_RECORDS = 256
+_slow_lock = threading.Lock()
+#: ring entries are ``(scope, record)`` — the collector is process-
+#: global (like every obs sink) but the DATA is per CheckerService:
+#: two services in one process (serve_smoke) must not read each
+#: other's forensics on /status, and one service's huge offender must
+#: not suppress another's flight dump. ``scope`` is the service's
+#: opaque identity (None = unscoped callers, e.g. tests).
+_slow_deltas: deque = deque(maxlen=SLOW_DELTA_MAX_RECORDS)
+_slow_worst: Dict = {}        # scope -> worst total since arm/reset
+
+
+def _slow_ring() -> deque:
+    return _slow_deltas
+
+
+def record_slow_delta(rec: dict, scope=None) -> bool:
+    """Append one slow-delta record; returns True when this record is
+    the WORST offender so far WITHIN ITS SCOPE (largest total) — the
+    caller's cue to flight-dump it (``serve.service`` does, outside
+    its lock)."""
+    total = float(rec.get("total_secs") or 0.0)
+    with _slow_lock:
+        ring = _slow_ring()
+        if len(ring) >= SLOW_DELTA_MAX_RECORDS:
+            _metrics.counter("obs.slow_deltas_dropped").inc()
+        ring.append((scope, dict(rec)))
+        worst = total > _slow_worst.get(scope, 0.0)
+        if worst:
+            _slow_worst[scope] = total
+    _metrics.counter("serve.slow_deltas").inc()
+    return worst
+
+
+def slow_delta_records(scope=None) -> list:
+    """The retained slow-delta records, oldest first (the /status
+    surface reads this without draining). ``scope`` filters to one
+    recorder's records; None returns everything."""
+    with _slow_lock:
+        return [dict(r) for s, r in _slow_ring()
+                if scope is None or s == scope]
+
+
+def drain_slow_deltas() -> list:
+    """Hand over ALL scopes' records and clear the ring (and every
+    worst-offender high-water) — per-run semantics like the span
+    buffer; the run artifact is process-wide like the trace."""
+    with _slow_lock:
+        out = [r for _s, r in _slow_ring()]
+        _slow_ring().clear()
+        _slow_worst.clear()
+        return out
+
+
+def write_slow_deltas(path: str, records: list) -> str:
+    return _write_jsonl_records(path, records)
 
 
 # registry state at the last export_run, so each run's artifacts carry
@@ -267,20 +346,26 @@ def export_run(run_dir: str) -> Optional[dict]:
     global _last_reg_snapshot
     tr = _tracer.tracer()
     stats_records = drain_search_stats()
+    slow_records = drain_slow_deltas()
     if tr is None or tr.flight_only:
         # a flight-only recorder (JEPSEN_TPU_FLIGHT_RECORDER with
         # tracing off) must not grow run-dir artifacts: its output
-        # surface is the crash dump alone. EXCEPT search-stats
-        # records: JEPSEN_TPU_SEARCH_STATS is its own opt-in, and the
-        # `jepsen report --search` input must land whether or not
-        # tracing was also on (stats off -> no records -> still None,
-        # byte-identical run dirs).
+        # surface is the crash dump alone. EXCEPT search-stats and
+        # slow-delta records: JEPSEN_TPU_SEARCH_STATS and
+        # JEPSEN_TPU_SLOW_DELTA_SECS are their own opt-ins, and the
+        # `jepsen report --search` / `--slow` inputs must land whether
+        # or not tracing was also on (flags off -> no records -> still
+        # None, byte-identical run dirs).
+        arts = {}
         if stats_records:
-            os.makedirs(run_dir, exist_ok=True)
-            return {"search_stats": write_search_stats(
+            arts["search_stats"] = write_search_stats(
                 os.path.join(run_dir, "search_stats.jsonl"),
-                stats_records)}
-        return None
+                stats_records)
+        if slow_records:
+            arts["slow_deltas"] = write_slow_deltas(
+                os.path.join(run_dir, "slow_deltas.jsonl"),
+                slow_records)
+        return arts or None
     os.makedirs(run_dir, exist_ok=True)
     reg = _metrics.registry()
     # ONE snapshot serves both the per-run delta and the next
@@ -300,6 +385,9 @@ def export_run(run_dir: str) -> Optional[dict]:
     if stats_records:
         out["search_stats"] = write_search_stats(
             os.path.join(run_dir, "search_stats.jsonl"), stats_records)
+    if slow_records:
+        out["slow_deltas"] = write_slow_deltas(
+            os.path.join(run_dir, "slow_deltas.jsonl"), slow_records)
     if tr.path:
         # the buffer is drained per run, so one fixed destination would
         # only ever hold the LAST run's spans in a --test-count /
@@ -345,7 +433,8 @@ def flight_reset() -> None:
 
 
 def flight_dump(reason: str,
-                dest_dir: Optional[str] = None) -> Optional[str]:
+                dest_dir: Optional[str] = None,
+                context: Optional[dict] = None) -> Optional[str]:
     """Dump the flight ring as a Chrome-trace file — the postmortem
     artifact for a crashed or degraded service when nobody had tracing
     on. Returns the path written, or None when no recorder is armed
@@ -358,6 +447,10 @@ def flight_dump(reason: str,
     trigger reason and the registry delta since the recorder was
     armed — spans show WHERE the time went, the delta shows WHAT
     moved (sheds, watchdog kills, breaker opens) before the trigger.
+    ``context`` (JSON-serializable) rides the ``flight`` block as
+    ``trigger`` — the serve hook sites pass the triggering
+    ``delta_id``/``key``/``tenant`` so a ``flight_*.trace.json``
+    cross-references the slow-delta or shed record that explains it.
     """
     global _flight_seq
     tr = _tracer.tracer()
@@ -385,6 +478,11 @@ def flight_dump(reason: str,
                 "metrics_delta": reg.delta(tr.flight_baseline or {}),
             },
         }
+        if context:
+            # JSON-proof the trigger context defensively: a dump must
+            # never die on an exotic key object in the context dict
+            doc["flight"]["trigger"] = json.loads(
+                json.dumps(context, default=str))
         safe = "".join(ch if ch.isalnum() or ch in "-_" else "-"
                        for ch in reason) or "dump"
         d = dest_dir or _flight_dir

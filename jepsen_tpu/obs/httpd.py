@@ -225,13 +225,20 @@ class _Handler(BaseHTTPRequestHandler):
                 doc = (ops.status_fn() if ops.status_fn is not None
                        else {})
                 self._json(200, doc)
+            elif path == "/trace":
+                # this replica's span export, live — what `jepsen
+                # trace --addr` fetches and merges into ONE fleet
+                # Perfetto file (obs.trace_merge). Tracing off
+                # answers an empty (still valid) document; a
+                # flight-only ring exports its retained spans.
+                self._json(200, ops.trace_doc())
             elif path == "/":
                 self._json(200, {"endpoints": ["/metrics", "/healthz",
-                                               "/status"]})
+                                               "/status", "/trace"]})
             else:
                 self._json(404, {"error": f"unknown path {path!r}",
                                  "endpoints": ["/metrics", "/healthz",
-                                               "/status"]})
+                                               "/status", "/trace"]})
         except Exception as err:  # noqa: BLE001 — one bad render must
             # not kill the connection handler thread loop
             _log.exception("ops httpd: %s failed", path)
@@ -295,7 +302,8 @@ class OpsServer:
                  health_fn: Optional[Callable[[], dict]] = None,
                  status_fn: Optional[Callable[[], dict]] = None,
                  refresh_fn: Optional[Callable[[], None]] = None,
-                 adopt_fn: Optional[Callable[[], list]] = None):
+                 adopt_fn: Optional[Callable[[], list]] = None,
+                 name: Optional[str] = None):
         self.health_fn = health_fn
         self.status_fn = status_fn
         self.refresh_fn = refresh_fn
@@ -304,7 +312,30 @@ class OpsServer:
         self._httpd.ops = self
         self.host = self._httpd.server_address[0]
         self.port = self._httpd.server_address[1]
+        # how this replica names its process track in a merged fleet
+        # trace (`jepsen trace`); defaults to the bound address
+        self.name = name or f"{self.host}:{self.port}"
         self._thread: Optional[threading.Thread] = None
+
+    def trace_doc(self) -> dict:
+        """The /trace document: this process's Chrome-trace events
+        (flight-only rings export their retained spans) plus the
+        wall-clock epoch the fleet merge aligns replicas by. Tracing
+        fully off answers ``{"traceEvents": []}`` — a valid, empty
+        trace either way."""
+        # functions imported from their defining modules (the obs
+        # package attribute `tracer` is the accessor FUNCTION, which
+        # shadows the submodule of the same name)
+        from jepsen_tpu.obs.export import chrome_trace as _chrome
+        from jepsen_tpu.obs.tracer import tracer as _get_tracer
+        tr = _get_tracer()
+        if tr is None:
+            return {"traceEvents": [],
+                    "trace": {"enabled": False, "replica": self.name}}
+        spans = tr.ring_spans() if tr.flight_only else tr.spans()
+        return {"traceEvents": _chrome(tr, spans=spans),
+                "trace": {"enabled": True, "replica": self.name,
+                          "epoch_unix": round(tr.epoch_unix, 6)}}
 
     def start(self) -> "OpsServer":
         if self._thread is None:
@@ -384,6 +415,15 @@ def parse_prometheus(body: str) -> Dict[str, dict]:
         r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{([^}]*)\})? '
         r'([-+0-9.eE]+|\+Inf)$')
     pair = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+    esc = re.compile(r'\\(.)')
+
+    def _unescape(v: str) -> str:
+        # single-pass, so escapes cannot cascade: sequential
+        # str.replace turned the two-character value `\` + `n` (
+        # rendered `\\n`) into a literal newline — exactly the
+        # round-trip corruption the escaping tests pin against
+        return esc.sub(
+            lambda m: {"n": "\n"}.get(m.group(1), m.group(1)), v)
 
     def _fresh():
         return {"type": "histogram", "count": 0, "total": 0.0,
@@ -404,8 +444,7 @@ def parse_prometheus(body: str) -> Dict[str, dict]:
         if not m:
             continue
         name, lab, val = m.groups()
-        labels = {k: v.replace(r'\"', '"').replace(r"\n", "\n")
-                  .replace(r"\\", "\\")
+        labels = {k: _unescape(v)
                   for k, v in pair.findall(lab or "")}
         le = labels.pop("le", None)
         if name.endswith("_bucket"):

@@ -132,31 +132,115 @@ def render_search_report(records: List[dict]) -> str:
     return "\n".join(lines) + "\n"
 
 
+# --------------------------------------------- slow-delta forensics
+
+
+def _fmt_secs(v) -> str:
+    if v is None:
+        return "-"
+    return f"{float(v):.4g}"
+
+
+def render_slow_report(records: List[dict]) -> str:
+    """The ``jepsen report --slow`` table
+    (JEPSEN_TPU_SLOW_DELTA_SECS): every retained slow-delta record,
+    worst first — which delta, on which key, how long, and WHERE the
+    time went (the stage-by-stage breakdown) — plus the worst
+    offender's full context (resilience notes, search-stats block).
+    One read replaces the PR-12-style manual diagnosis of a wedged
+    worker."""
+    rows = sorted(records,
+                  key=lambda r: r.get("total_secs") or 0.0,
+                  reverse=True)
+    lines = ["# Slow-delta forensics (JEPSEN_TPU_SLOW_DELTA_SECS)",
+             ""]
+    lines.append(f"records: {len(rows)}   worst: "
+                 f"{_fmt_secs(rows[0].get('total_secs')) if rows else '-'}s")
+    by_stage: dict = {}
+    for r in rows:
+        s = r.get("slowest_stage") or "?"
+        by_stage[s] = by_stage.get(s, 0) + 1
+    if by_stage:
+        lines.append("dominant stages: " + "  ".join(
+            f"{k}:{v}" for k, v in sorted(by_stage.items())))
+    lines.append("")
+    lines.append(f"{'delta_id':<18} {'key':<16} {'tenant':<10} "
+                 f"{'seq':>5} {'total_s':>9} {'slowest':<12} "
+                 f"bp/wal/queue/device/pub")
+    for r in rows:
+        st = r.get("stages") or {}
+        breakdown = "/".join(
+            _fmt_secs(st.get(k)) for k in
+            ("backpressure", "wal", "queue", "device", "publish"))
+        lines.append(
+            f"{str(r.get('delta_id', '-'))[:18]:<18} "
+            f"{str(r.get('key', '-'))[:16]:<16} "
+            f"{str(r.get('tenant') or '-')[:10]:<10} "
+            f"{r.get('seq', 0) or 0:>5} "
+            f"{_fmt_secs(r.get('total_secs')):>9} "
+            f"{str(r.get('slowest_stage', '-')):<12} {breakdown}")
+    if rows:
+        worst = rows[0]
+        lines.append("")
+        lines.append(f"## Worst offender: {worst.get('delta_id')} "
+                     f"(key {worst.get('key')})")
+        for field in ("verdict", "error", "resilience", "stats"):
+            if worst.get(field) is not None:
+                lines.append(f"{field}: {worst[field]}")
+    lines.append("")
+    return "\n".join(lines) + "\n"
+
+
+def _load_report_input(run_dir: str, fname: str,
+                       hint: str) -> Optional[List[dict]]:
+    """Read ``fname``'s records from the run dir (``report_main``
+    resolved it already) and report the usual failure modes (shared
+    by --search and --slow)."""
+    path = os.path.join(run_dir, fname)
+    if not os.path.exists(path):
+        print(f"jepsen report: {path} not found — {hint}",
+              file=sys.stderr)
+        return None
+    records = load_records(path)
+    if not records:
+        print(f"jepsen report: {path} holds no records",
+              file=sys.stderr)
+        return None
+    return records
+
+
 def report_main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="jepsen report",
-        description="render a stored run's telemetry reports; "
+        description="render a stored run's telemetry reports: "
                     "--search renders search_stats.jsonl "
-                    "(JEPSEN_TPU_SEARCH_STATS) into "
-                    "search_report.txt — worst keys by visited-table "
-                    "load factor, capacity escalations, and pad-row "
-                    "waste")
+                    "(JEPSEN_TPU_SEARCH_STATS) into search_report.txt "
+                    "— worst keys by visited-table load factor, "
+                    "capacity escalations, and pad-row waste; --slow "
+                    "renders slow_deltas.jsonl "
+                    "(JEPSEN_TPU_SLOW_DELTA_SECS) into "
+                    "slow_report.txt — every slow delta's stage "
+                    "breakdown, worst first")
     p.add_argument("--search", action="store_true",
                    help="render the device-search telemetry report")
+    p.add_argument("--slow", action="store_true",
+                   help="render the slow-delta forensics report")
     p.add_argument("--run-dir", default=None,
-                   help="store run dir holding search_stats.jsonl "
+                   help="store run dir holding the report input "
                         "(default: the latest stored run)")
     p.add_argument("--stdout-only", action="store_true",
-                   help="print the report without writing "
-                        "search_report.txt")
+                   help="print the report without writing the "
+                        ".txt artifact")
     try:
         args = p.parse_args(list(argv) if argv is not None else None)
     except SystemExit as e:
         return 0 if e.code in (0, None) else 254
-    if not args.search:
+    if not (args.search or args.slow):
         print("jepsen report: nothing to render — pass --search "
-              "(the only report implemented so far)", file=sys.stderr)
+              "and/or --slow", file=sys.stderr)
         return 254
+    # resolve the run dir ONCE so --search + --slow in one call read
+    # the same run even if a new run lands mid-render
     run_dir = args.run_dir
     if run_dir is None:
         from jepsen_tpu import store as jstore
@@ -165,26 +249,39 @@ def report_main(argv: Optional[Sequence[str]] = None) -> int:
             print("jepsen report: no stored runs and no --run-dir",
                   file=sys.stderr)
             return 1
-    path = os.path.join(run_dir, "search_stats.jsonl")
-    if not os.path.exists(path):
-        print(f"jepsen report: {path} not found — run with "
-              f"JEPSEN_TPU_SEARCH_STATS=1 so the engines record "
-              f"per-key search stats (docs/observability.md)",
-              file=sys.stderr)
-        return 1
-    records = load_records(path)
-    if not records:
-        print(f"jepsen report: {path} holds no records",
-              file=sys.stderr)
-        return 1
-    text = render_search_report(records)
-    sys.stdout.write(text)
-    if not args.stdout_only:
-        out = os.path.join(run_dir, "search_report.txt")
-        with open(out, "w") as fh:
-            fh.write(text)
-        print(f"report written to {out}", file=sys.stderr)
-    return 0
+    rc = 0
+    if args.search:
+        records = _load_report_input(
+            run_dir, "search_stats.jsonl",
+            "run with JEPSEN_TPU_SEARCH_STATS=1 so the engines "
+            "record per-key search stats (docs/observability.md)")
+        if records is None:
+            rc = 1
+        else:
+            text = render_search_report(records)
+            sys.stdout.write(text)
+            if not args.stdout_only:
+                out = os.path.join(run_dir, "search_report.txt")
+                with open(out, "w") as fh:
+                    fh.write(text)
+                print(f"report written to {out}", file=sys.stderr)
+    if args.slow:
+        records = _load_report_input(
+            run_dir, "slow_deltas.jsonl",
+            "run with JEPSEN_TPU_SLOW_DELTA_SECS=<secs> so the serve "
+            "worker records slow-delta forensics "
+            "(docs/observability.md)")
+        if records is None:
+            rc = 1
+        else:
+            text = render_slow_report(records)
+            sys.stdout.write(text)
+            if not args.stdout_only:
+                out = os.path.join(run_dir, "slow_report.txt")
+                with open(out, "w") as fh:
+                    fh.write(text)
+                print(f"report written to {out}", file=sys.stderr)
+    return rc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,346 @@
+"""``jepsen trace`` — merge a serve fleet's per-replica trace exports
+into ONE Perfetto-openable Chrome-trace file, with one process track
+per replica, aligned on the wall clock.
+
+Why it exists (docs/observability.md "End-to-end delta tracing"): a
+delta's causal chain can cross a replica boundary — the old owner
+admits and fsyncs it, a rehome/adoption moves the key, and the new
+owner thaws/applies it. Each replica's own export is a valid trace,
+but the chain is only *readable* when both sides share one time axis
+and distinct process tracks. Every export stamps its wall-clock epoch
+(the ``trace_epoch`` metadata event / the ``/trace`` document's
+``epoch_unix``); the merge shifts each replica's microsecond
+timestamps by its epoch offset from the earliest one and re-homes its
+``host``/``device`` pids onto a per-replica pid block, so Perfetto
+renders ``<replica>/host`` and ``<replica>/device`` tracks side by
+side and a migrated delta's ``delta_id``-tagged spans line up across
+them.
+
+Inputs, mixable:
+
+* ``--addr HOST:PORT`` (repeatable) — a live replica's ops endpoint;
+  fetches ``GET /trace`` (``obs.httpd.OpsServer.trace_doc``).
+* ``--dir PATH`` (repeatable) — a scratch/WAL directory; scans for
+  ``trace.json`` exports and ``flight_*.trace.json`` dumps (the chaos
+  harness's postmortem evidence), one input per file.
+* positional ``FILE`` arguments — individual trace files.
+
+``--validate`` alone checks files against the trace schema (the same
+invariants tests/test_obs.py pins on single-process exports) without
+fetching or merging — the CI hook ``tools/ci.sh`` runs over
+serve_smoke's export.
+
+Import-safe: no JAX, stdlib only — the merge runs on a coordinator
+or an operator laptop that never touches a device.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Sequence
+
+#: pid block size per replica in the merged file: original pids
+#: (1 = host, 2 = device) land at base + pid, so every replica's two
+#: tracks stay distinct and recoverable (base // PID_STRIDE = replica)
+PID_STRIDE = 10
+
+_VALID_PH = {"X", "M", "C"}
+
+
+def load_trace_doc(path: str) -> dict:
+    """Normalize one trace file — the bare event array
+    (``write_chrome_trace``), the flight-dump object form, or a
+    ``/trace`` fetch — into ``{"traceEvents": [...], "trace": {...}}``
+    with ``epoch_unix`` recovered from the ``trace_epoch`` metadata
+    event when the wrapper does not carry it."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if isinstance(doc, list):
+        doc = {"traceEvents": doc, "trace": {}}
+    doc.setdefault("trace", {})
+    if doc["trace"].get("epoch_unix") is None:
+        for e in doc.get("traceEvents") or ():
+            if e.get("ph") == "M" and e.get("name") == "trace_epoch":
+                doc["trace"]["epoch_unix"] = (e.get("args")
+                                              or {}).get("unix")
+                break
+    return doc
+
+
+def fetch_trace(addr: str, timeout: float = 10.0) -> dict:
+    """One replica's live span export: ``GET http://addr/trace``."""
+    import urllib.request
+    with urllib.request.urlopen(f"http://{addr}/trace",
+                                timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def merge_traces(docs: Sequence[dict],
+                 names: Optional[Sequence[str]] = None) -> dict:
+    """Merge per-replica trace documents into one. Each input's pids
+    move to a per-replica block (``PID_STRIDE``), its process names
+    become ``<replica>/host`` etc., its X-event args gain
+    ``"replica"`` (the chain queries key on it), and — when every
+    input carries ``epoch_unix`` — its timestamps shift onto the
+    earliest replica's axis. ``trace_epoch`` metadata events are
+    dropped (the merged wrapper carries the base epoch instead)."""
+    names = list(names) if names is not None else [
+        (d.get("trace") or {}).get("replica") or f"replica-{i}"
+        for i, d in enumerate(docs)]
+    epochs = [(d.get("trace") or {}).get("epoch_unix") for d in docs]
+    aligned = all(e is not None for e in epochs) and epochs
+    base = min(epochs) if aligned else None
+    out: List[dict] = []
+    for i, d in enumerate(docs):
+        pid_base = PID_STRIDE * (i + 1)
+        shift_us = ((epochs[i] - base) * 1e6) if aligned else 0.0
+        for e in d.get("traceEvents") or ():
+            if e.get("ph") == "M" and e.get("name") == "trace_epoch":
+                continue
+            e2 = dict(e)
+            e2["pid"] = pid_base + int(e2.get("pid", 1))
+            if "ts" in e2:
+                e2["ts"] = round(e2["ts"] + shift_us, 1)
+            if e2.get("ph") == "M" \
+                    and e2.get("name") == "process_name":
+                e2["args"] = {"name": f"{names[i]}/"
+                                      f"{(e.get('args') or {}).get('name', '?')}"}
+            elif e2.get("ph") == "X":
+                e2["args"] = dict(e2.get("args") or {})
+                e2["args"]["replica"] = names[i]
+            out.append(e2)
+    return {"traceEvents": out,
+            "trace": {"replicas": list(names),
+                      "epoch_unix": base, "aligned": bool(aligned)}}
+
+
+def delta_id_tracks(doc: dict) -> Dict[str, set]:
+    """delta_id -> the set of replica tracks its spans appear on
+    (replica names in a merged doc, pids otherwise). Both the
+    single-delta ``delta_id`` tag (admit/wal/ingress legs) and the
+    batched ``delta_ids`` list tag (apply/thaw legs) count — together
+    they ARE the delta's causal chain."""
+    out: Dict[str, set] = {}
+    for e in doc.get("traceEvents") or ():
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        track = args.get("replica", e.get("pid"))
+        ids = []
+        if args.get("delta_id"):
+            ids.append(args["delta_id"])
+        ids.extend(args.get("delta_ids") or ())
+        for did in ids:
+            out.setdefault(str(did), set()).add(track)
+    return out
+
+
+def cross_replica_ids(doc: dict) -> List[str]:
+    """The delta ids whose chains span more than one replica track —
+    the migrated deltas a merged fleet trace exists to make
+    readable."""
+    return sorted(did for did, tracks in delta_id_tracks(doc).items()
+                  if len(tracks) > 1)
+
+
+def validate_trace(doc) -> List[str]:
+    """Schema-check one trace document (array or object form);
+    returns the list of violations (empty = valid). The invariants
+    are the ones tests/test_obs.py pins on exports: known phase
+    codes, named processes, non-negative clamped timestamps, span
+    ids present, and parent ids that resolve within their own
+    replica's span-id space."""
+    errors: List[str] = []
+    events = doc.get("traceEvents") if isinstance(doc, dict) else doc
+    if not isinstance(events, list):
+        return ["traceEvents is not a list"]
+    sids: Dict[object, set] = {}
+    parents: List[tuple] = []
+    procs = 0
+    for i, e in enumerate(events):
+        if not isinstance(e, dict):
+            errors.append(f"event {i}: not an object")
+            continue
+        ph = e.get("ph")
+        if ph not in _VALID_PH:
+            errors.append(f"event {i}: unknown phase {ph!r}")
+            continue
+        if ph == "M":
+            if e.get("name") == "process_name":
+                procs += 1
+            continue
+        if "pid" not in e or "tid" not in e:
+            errors.append(f"event {i} ({e.get('name')!r}): missing "
+                          f"pid/tid")
+        args = e.get("args") or {}
+        # group parent resolution by replica (merged docs) or pid
+        # block — span ids are only unique per source tracer
+        group = args.get("replica",
+                         int(e.get("pid", 0)) // PID_STRIDE)
+        if ph == "C":
+            if "value" not in args:
+                errors.append(f"event {i} ({e.get('name')!r}): "
+                              f"counter sample without value")
+            continue
+        ts, dur = e.get("ts"), e.get("dur")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            errors.append(f"event {i} ({e.get('name')!r}): bad ts "
+                          f"{ts!r}")
+        if not isinstance(dur, (int, float)) or dur < 0:
+            errors.append(f"event {i} ({e.get('name')!r}): bad dur "
+                          f"{dur!r}")
+        if "span_id" not in args:
+            errors.append(f"event {i} ({e.get('name')!r}): span "
+                          f"without span_id")
+        else:
+            sids.setdefault(group, set()).add(args["span_id"])
+        if args.get("parent_id") is not None:
+            parents.append((i, e.get("name"), group,
+                            args["parent_id"]))
+    if not procs:
+        errors.append("no process_name metadata events")
+    for i, name, group, pid_ in parents:
+        if pid_ not in sids.get(group, ()):
+            errors.append(f"event {i} ({name!r}): parent_id {pid_} "
+                          f"does not resolve")
+    return errors
+
+
+def _scan_dir(d: str) -> List[str]:
+    """Trace files under a scratch/WAL/run directory, recursively:
+    run-dir exports, flag-path exports, and flight dumps."""
+    pats = ("trace.json", "*.trace.json", "flight_*.trace.json")
+    out: List[str] = []
+    for root, _dirs, _files in os.walk(d):
+        for p in pats:
+            out.extend(glob.glob(os.path.join(root, p)))
+    return sorted(set(out))
+
+
+def trace_main(argv: Optional[Sequence[str]] = None) -> int:
+    """``jepsen trace`` — exit 0 merged/valid, 1 nothing to merge or
+    validation failed, 2 a replica was unreachable, 254 usage."""
+    p = argparse.ArgumentParser(
+        prog="jepsen trace",
+        description="merge per-replica trace exports (live /trace "
+                    "endpoints, run dirs, flight dumps) into one "
+                    "Perfetto file with a process track per replica, "
+                    "wall-clock aligned; or --validate trace files "
+                    "against the export schema")
+    p.add_argument("files", nargs="*", help="trace files to merge")
+    p.add_argument("--addr", action="append", default=[],
+                   metavar="HOST:PORT",
+                   help="a live replica's ops endpoint (repeatable): "
+                        "fetch its GET /trace export")
+    p.add_argument("--dir", action="append", default=[],
+                   help="scan a directory (chaos scratch dir, WAL "
+                        "dir, store run dir) for trace.json / "
+                        "flight_*.trace.json inputs (repeatable)")
+    p.add_argument("--out", default="merged_trace.json",
+                   help="merged output path (default "
+                        "merged_trace.json)")
+    p.add_argument("--validate", action="store_true",
+                   help="validate-only: check every input against "
+                        "the trace schema and write nothing (the CI "
+                        "hook); plain merges validate the merged "
+                        "output regardless")
+    p.add_argument("--timeout", type=float, default=10.0)
+    try:
+        args = p.parse_args(list(argv) if argv is not None else None)
+    except SystemExit as e:
+        return 0 if e.code in (0, None) else 254
+    inputs: List[tuple] = []   # (name, doc)
+
+    def _named(doc: dict, fallback: str) -> tuple:
+        # a /trace-shaped wrapper knows its own replica name; path-
+        # derived fallbacks are uniquified below
+        return ((doc.get("trace") or {}).get("replica") or fallback,
+                doc)
+
+    for path in args.files:
+        try:
+            inputs.append(_named(load_trace_doc(path),
+                                 os.path.basename(path)))
+        except (OSError, ValueError) as err:
+            print(f"jepsen trace: cannot read {path}: {err}",
+                  file=sys.stderr)
+            return 1
+    for d in args.dir:
+        for path in _scan_dir(d):
+            try:
+                inputs.append(_named(load_trace_doc(path),
+                                     os.path.relpath(path, d)))
+            except (OSError, ValueError) as err:
+                print(f"jepsen trace: skipping unreadable {path}: "
+                      f"{err}", file=sys.stderr)
+    for addr in args.addr:
+        try:
+            doc = fetch_trace(addr, timeout=args.timeout)
+        except (OSError, ValueError) as err:
+            print(f"jepsen trace: {addr} unreachable: {err}",
+                  file=sys.stderr)
+            return 2
+        inputs.append(_named(doc, addr))
+    # two inputs may legally carry the same derived name (two chaos
+    # scratch dirs each holding 'r0/trace.json', two files with one
+    # basename): collapsing them onto one process track would merge
+    # distinct span-id spaces (a dangling parent could falsely resolve
+    # against the OTHER replica's ids) and hide genuinely cross-
+    # replica chains — suffix repeats deterministically instead
+    seen_names: Dict[str, int] = {}
+    uniq: List[tuple] = []
+    for name, doc in inputs:
+        n = seen_names.get(name, 0)
+        seen_names[name] = n + 1
+        uniq.append((name if n == 0 else f"{name}#{n + 1}", doc))
+    inputs = uniq
+    if not inputs:
+        print("jepsen trace: nothing to merge — pass FILEs, --addr, "
+              "or --dir", file=sys.stderr)
+        return 1
+    if args.validate:
+        bad = 0
+        for name, doc in inputs:
+            errs = validate_trace(doc)
+            for e in errs[:20]:
+                print(f"jepsen trace: {name}: {e}", file=sys.stderr)
+            bad += len(errs)
+        if bad:
+            print(f"jepsen trace: {bad} schema violation(s) across "
+                  f"{len(inputs)} input(s)", file=sys.stderr)
+            return 1
+        print(f"jepsen trace: {len(inputs)} input(s) valid")
+        return 0
+    merged = merge_traces([doc for _n, doc in inputs],
+                          [n for n, _d in inputs])
+    errs = validate_trace(merged)
+    if errs:
+        for e in errs[:20]:
+            print(f"jepsen trace: merged: {e}", file=sys.stderr)
+        print(f"jepsen trace: merged document failed its own schema "
+              f"({len(errs)} violation(s)) — not writing {args.out}",
+              file=sys.stderr)
+        return 1
+    d = os.path.dirname(args.out)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(merged, fh)
+    cross = cross_replica_ids(merged)
+    spans = sum(1 for e in merged["traceEvents"]
+                if e.get("ph") == "X")
+    print(f"jepsen trace: merged {len(inputs)} replica trace(s) -> "
+          f"{args.out} ({spans} spans, "
+          f"{'wall-clock aligned' if merged['trace']['aligned'] else 'UNALIGNED (an input lacks epoch_unix)'}"
+          f"); {len(cross)} cross-replica delta chain(s)"
+          + (f": {', '.join(cross[:5])}"
+             + ("..." if len(cross) > 5 else "") if cross else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(trace_main())

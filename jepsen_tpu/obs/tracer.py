@@ -40,6 +40,7 @@ import itertools
 import threading
 from collections import deque
 from time import perf_counter, process_time
+from time import time as wall_time
 from typing import Callable, Dict, List, Optional
 
 from jepsen_tpu import envflags
@@ -72,6 +73,11 @@ class Tracer:
                  flight_only: bool = False):
         self.path = path            # JEPSEN_TPU_TRACE=<path> ("" = none)
         self.epoch = perf_counter()  # trace time origin (ts 0 in exports)
+        # the same origin on the WALL clock: exports stamp it so the
+        # fleet trace merge (`jepsen trace`) can align several
+        # replicas' traces on one time axis — perf_counter epochs are
+        # per-process and incomparable across machines/restarts
+        self.epoch_unix = wall_time()
         self.flag_exports = 0       # export_run count, for <path> runs
         self.flight_only = flight_only
         self._lock = threading.Lock()

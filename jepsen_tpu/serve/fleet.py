@@ -201,6 +201,13 @@ class SegmentReplicator:
             return self._ship_locked(key, dst)
 
     def _ship_locked(self, key, dst: str) -> int:
+        # the replication leg of the causal chain: sync mode runs this
+        # inside the producer's ack window (nested under serve.wal via
+        # the contextvar), async mode on the shipper thread
+        with obs.span("serve.repl_ship", key=str(key)):
+            return self._ship_files(key, dst)
+
+    def _ship_files(self, key, dst: str) -> int:
         os.makedirs(dst, exist_ok=True)
         shipped = 0
         for src in self.wal.segments(key):
@@ -536,7 +543,10 @@ class FleetSupervisor:
                         self.pins[k] = node
             r.rehomed = True
             obs.counter("fleet.rehomes").inc()
-            obs.flight_dump(f"fleet-rehome-{r.name}")
+            obs.flight_dump(f"fleet-rehome-{r.name}", context={
+                "replica": r.name,
+                "plan": {n: [str(k) for k in ks]
+                         for n, ks in plan.items()}})
             _log.info("fleet: rehomed %d key(s) from %r: %s",
                       sum(len(v) for v in plan.values()), r.name,
                       {n: len(v) for n, v in plan.items()})

@@ -155,10 +155,18 @@ class DeltaIngress:
 
     async def _call(self, fn, *args, **kw):
         """The blocking service call on an executor thread — the
-        backpressure parks HERE while the loop serves everyone else."""
+        backpressure parks HERE while the loop serves everyone else.
+
+        Span-context propagation: executor threads do not inherit the
+        request task's contextvars, so without the per-call
+        ``Context.copy()`` (``obs.ctx_runner``, the same fix the
+        pipeline pool uses) the service's spans would start an orphan
+        chain instead of nesting under the ingress request span.
+        ``ctx_runner`` is the identity wrap when tracing is off."""
         loop = asyncio.get_running_loop()
+        wrap = obs.ctx_runner()
         return await loop.run_in_executor(
-            self._pool, lambda: fn(*args, **kw))
+            self._pool, wrap(lambda: fn(*args, **kw)))
 
     @staticmethod
     def _response(writer, code: int, body: bytes,
@@ -365,10 +373,21 @@ class DeltaIngress:
         except Exception as err:  # noqa: BLE001 — a malformed op map
             # is the producer's bug and must answer, not disconnect
             return {"error": f"bad ops: {type(err).__name__}: {err}"}
-        return await self._call(
-            self.service.submit, _key_of(req), ops,
-            seq=req.get("seq"), timeout=req.get("timeout"),
-            wait=bool(req.get("wait")), token=token)
+        # the ingress leg of the delta's causal chain: the service's
+        # serve.admit/serve.wal spans parent under this one (the
+        # Context.copy in _call carries it across the executor hop);
+        # a producer-supplied "delta_id" rides through, otherwise the
+        # service mints one at admission and the ack reports it
+        with obs.span("serve.ingress.request",
+                      key=str(req.get("key"))) as sp:
+            r = await self._call(
+                self.service.submit, _key_of(req), ops,
+                seq=req.get("seq"), timeout=req.get("timeout"),
+                wait=bool(req.get("wait")), token=token,
+                delta_id=req.get("delta_id"))
+            if isinstance(r, dict) and r.get("delta_id"):
+                sp.set(delta_id=r["delta_id"], seq=r.get("seq"))
+            return r
 
 
 def start_ingress(service, port: int, host: str = "127.0.0.1",

@@ -162,22 +162,28 @@ def transfer_key(src_wal_dir: str, dst_wal_dir: str, key) -> dict:
     crashed replica's dir is evidence; the operator removes it after
     the fleet is green), and the destination files land under the
     same deterministic names ``adopt_keys``'s recovery scan reads.
-    Returns ``{"segments": n, "checkpoint": bool}``."""
+    Returns ``{"segments": n, "checkpoint": bool}``. The copied
+    segments carry any per-delta trace ids the old owner stamped
+    (``DeltaWAL.append(delta_id=...)``) — which is how a migrated
+    delta's causal chain survives the replica boundary: the adopter's
+    thaw/apply spans re-tag the same ids, and the merged fleet trace
+    (``jepsen trace``) reads one chain across both process tracks."""
     os.makedirs(dst_wal_dir, exist_ok=True)
-    segs = DeltaWAL(src_wal_dir).segments(key)
-    for path in segs:
-        shutil.copy2(path, os.path.join(dst_wal_dir,
-                                        os.path.basename(path)))
-    stem = _safe_name(key)
-    has_cp = False
-    src_cps = os.path.join(src_wal_dir, "checkpoints")
-    for ext in (".json", ".npz"):
-        p = os.path.join(src_cps, stem + ext)
-        if os.path.exists(p):
-            dst_cps = os.path.join(dst_wal_dir, "checkpoints")
-            os.makedirs(dst_cps, exist_ok=True)
-            shutil.copy2(p, os.path.join(dst_cps, stem + ext))
-            has_cp = True
+    with obs.span("serve.ring.transfer", key=str(key)):
+        segs = DeltaWAL(src_wal_dir).segments(key)
+        for path in segs:
+            shutil.copy2(path, os.path.join(dst_wal_dir,
+                                            os.path.basename(path)))
+        stem = _safe_name(key)
+        has_cp = False
+        src_cps = os.path.join(src_wal_dir, "checkpoints")
+        for ext in (".json", ".npz"):
+            p = os.path.join(src_cps, stem + ext)
+            if os.path.exists(p):
+                dst_cps = os.path.join(dst_wal_dir, "checkpoints")
+                os.makedirs(dst_cps, exist_ok=True)
+                shutil.copy2(p, os.path.join(dst_cps, stem + ext))
+                has_cp = True
     obs.counter("serve.ring.keys_transferred").inc()
     return {"segments": len(segs), "checkpoint": has_cp}
 

@@ -57,6 +57,7 @@ import logging
 import os
 import threading
 import time
+import uuid
 from collections import deque
 from typing import Dict, Optional
 
@@ -107,6 +108,23 @@ def _resolve_evict_secs(v: Optional[float]) -> float:
                               what="eviction idle seconds")
 
 
+def _resolve_slow_delta(v: Optional[float]) -> float:
+    if v is not None:
+        return float(v)
+    return envflags.env_float("JEPSEN_TPU_SLOW_DELTA_SECS",
+                              default=0.0, min_value=0.0,
+                              what="slow-delta threshold seconds") \
+        or 0.0
+
+
+def _mint_delta_id() -> str:
+    """A fleet-unique trace identity for one admitted delta — minted
+    at admission (whichever transport carried it), persisted in the
+    WAL record, and tagged on every span leg of the delta's causal
+    chain (docs/observability.md "End-to-end delta tracing")."""
+    return uuid.uuid4().hex[:16]
+
+
 def default_wal_dir() -> Optional[str]:
     """The JEPSEN_TPU_SERVE_WAL flag: unset/0 -> no WAL (in-memory
     service), 1 -> ``store/serve_wal``, path -> that directory."""
@@ -125,7 +143,8 @@ class _Key:
                  "last_result", "last_activity", "finalized",
                  "finalize_requested", "needs_check", "pending_ops",
                  "wal_next", "broken", "wal_dead", "acct",
-                 "pending_times", "tenant", "epoch", "fenced")
+                 "pending_times", "tenant", "epoch", "fenced",
+                 "delta_recs")
 
     def __init__(self, key, tenant: str = tenancy.DEFAULT_TENANT):
         self.key = key
@@ -149,6 +168,12 @@ class _Key:
         # whenever applied_seq advances, feeding the ingest->verdict
         # SLO histogram; bounded by the per-key queue bound
         self.pending_times: deque = deque()
+        # per-delta trace records (delta tracing armed only — empty
+        # otherwise): {"id", "seq", "tenant", "ops", "t_in", ...stage
+        # stamps...}, seq-ordered because admission is; popped by the
+        # worker at take time, closed out at verdict publish (the
+        # slow-delta breakdown). Bounded by the per-key queue bound.
+        self.delta_recs: deque = deque()
         self.wal_next = 1   # next seq allowed to write the WAL (the
         # per-key seq-ordered handoff that keeps file order == seq
         # order without holding the service lock across an fsync)
@@ -214,6 +239,7 @@ class CheckerService:
                  evict_idle_secs: Optional[float] = None,
                  tenants=None, drr_quantum: Optional[int] = None,
                  replicator=None,
+                 slow_delta_secs: Optional[float] = None,
                  recover: bool = True, start_worker: bool = True,
                  clock=time.monotonic):
         self.model = model
@@ -229,6 +255,21 @@ class CheckerService:
         self.high_water = _resolve_high_water(high_water,
                                               self.global_bound)
         self.evict_idle_secs = _resolve_evict_secs(evict_idle_secs)
+        self.slow_delta_secs = _resolve_slow_delta(slow_delta_secs)
+        # delta trace identity armed? Tracing on, a flight ring
+        # retaining spans, or the slow-delta threshold — each is a
+        # consumer of per-delta ids/stage records. Unarmed (the
+        # default) keeps acks, WAL bytes-on-disk, and the /status
+        # schema byte-identical to the pre-tracing service (the PR-4/
+        # 8/9 parity standard).
+        self._delta_obs = bool(self.slow_delta_secs) \
+            or obs.enabled() or obs.flight_active()
+        # this service's identity in the process-global slow-delta
+        # ring: two services in one process must not read each
+        # other's forensics on /status or suppress each other's
+        # worst-offender flight dumps (a sentinel, not self — ring
+        # entries must not pin the service's sessions alive)
+        self._slow_scope = object()
         if tenants is None:
             tenants = tenancy.resolve_tenants()
         elif isinstance(tenants, (list, tuple)):
@@ -418,13 +459,23 @@ class CheckerService:
     def submit(self, key, ops, seq: Optional[int] = None,
                timeout: Optional[float] = None,
                wait: bool = False, tenant: Optional[str] = None,
-               token: Optional[str] = None) -> dict:
+               token: Optional[str] = None,
+               delta_id: Optional[str] = None) -> dict:
         """Admit one delta for ``key``. Returns one of::
 
             {"accepted": True, "seq": n, "key": k}
             {"duplicate": True, "seq": n, "key": k}   idempotent replay
             {"shed": True, "reason": ..., "key": k}   overload
             {"error": ..., "key": k}                  malformed request
+
+        With delta tracing armed (``JEPSEN_TPU_TRACE`` /
+        ``JEPSEN_TPU_FLIGHT_RECORDER`` / ``JEPSEN_TPU_SLOW_DELTA_
+        SECS``), every admitted delta gets a trace identity —
+        ``delta_id`` (caller-supplied or minted here), returned on the
+        ack, stamped into the WAL record, and tagged on each span leg
+        of the delta's causal chain. Unarmed, ``delta_id`` is ignored
+        and every answer/byte is identical to the pre-tracing
+        service.
 
         Blocks (backpressure) while the key's queue or the global
         backlog is full, up to ``timeout`` seconds (then sheds). With
@@ -454,11 +505,17 @@ class CheckerService:
         fence = self._read_fence(key)
         t_in = self._clock()
         deadline = None if timeout is None else t_in + timeout
+        # the delta's trace identity (armed only): filled in and
+        # queued on the key at admission, closed out by the worker at
+        # verdict publish (the slow-delta stage breakdown)
+        rec = ({"id": str(delta_id) if delta_id else _mint_delta_id()}
+               if self._delta_obs else None)
         shed = None   # set instead of returning inside the lock: the
         # flight-recorder dump a shed triggers is file I/O and must
         # run AFTER the service lock is released (the same reason the
         # WAL fsync below runs outside it)
-        with self._cond:
+        with self._cond, \
+                obs.span("serve.admit", key=str(key)) as adm_sp:
             ts = self._tenant_state_locked(tname)
             ks = self._keys.get(key)
             f = self._fence_locked(key, ks, fence)
@@ -582,6 +639,13 @@ class CheckerService:
                 ks.acct["deltas"] += 1
                 ks.acct["ops"] += len(ops)
                 ks.pending_times.append((my_seq, t_in))
+                if rec is not None:
+                    rec.update(seq=my_seq, tenant=tname,
+                               ops=len(ops), t_in=t_in,
+                               t_admit=self._clock())
+                    ks.delta_recs.append(rec)
+                    adm_sp.set(delta_id=rec["id"], seq=my_seq,
+                               tenant=tname)
                 self._pending_ops += len(ops)
                 self.max_pending_seen = max(self.max_pending_seen,
                                             self._pending_ops)
@@ -607,8 +671,11 @@ class CheckerService:
             # recorder dumps here — outside the service lock, because
             # the dump is file I/O and a sick disk must not freeze
             # every producer and the ops surface (a None check when
-            # off; the per-process cap bounds a shed storm)
-            obs.flight_dump("serve-shed")
+            # off; the per-process cap bounds a shed storm). The
+            # trigger context cross-references the shed answer.
+            obs.flight_dump("serve-shed", context={
+                "key": str(key), "reason": shed.get("reason"),
+                "tenant": shed.get("tenant")})
             return shed
         durable = self._wal is not None
         durable_replica = None   # sync replication verdict (None =
@@ -643,11 +710,26 @@ class CheckerService:
                     ks.wal_dead = True
                     durable = False
                     self._cond.notify_all()
+                elif rec is not None:
+                    # WAL stage start stamp. The stage is measured as
+                    # a start/end DURATION, not a timeline split: the
+                    # fsync below runs outside the lock, CONCURRENTLY
+                    # with the queue/device stages — the worker may
+                    # take (and even publish) this delta while its
+                    # fsync is still in flight, so a t_take-relative
+                    # split would mis-attribute a slow disk to the
+                    # queue stage.
+                    rec["t_wal_start"] = self._clock()
             if durable:
                 try:
-                    nbytes = self._wal.append(
-                        key, my_seq, ops,
-                        tenant=(tname if ts is not None else None))
+                    with obs.span("serve.wal", key=str(key),
+                                  seq=my_seq,
+                                  delta_id=(rec or {}).get("id")):
+                        nbytes = self._wal.append(
+                            key, my_seq, ops,
+                            tenant=(tname if ts is not None
+                                    else None),
+                            delta_id=(rec or {}).get("id"))
                 except Exception as err:  # noqa: BLE001 — a failed
                     # append must not hold the handoff or hide the
                     # durability loss from the producer
@@ -658,10 +740,19 @@ class CheckerService:
                                  "only", key, my_seq, err)
                     with self._cond:
                         ks.wal_dead = True
+                        if rec is not None:
+                            rec["t_wal_end"] = self._clock()
                         self._cond.notify_all()
                 else:
                     with self._cond:
                         ks.wal_next = my_seq + 1
+                        if rec is not None:
+                            # the WAL-duration end stamp (under the
+                            # condition — _finish_recs_locked holds it
+                            # too, so the read/write pair cannot tear;
+                            # a rec the worker ALREADY published keeps
+                            # its in-flight attribution, see there)
+                            rec["t_wal_end"] = self._clock()
                         if ts is not None:
                             # the WAL-bytes quota meter: the tenant
                             # pays for every byte its keys fsync
@@ -704,12 +795,19 @@ class CheckerService:
             rem = None if deadline is None else deadline - self._clock()
             r = self.result(key, min_seq=my_seq, timeout=rem,
                             tenant=tname)
+            if rec is not None and isinstance(r, dict):
+                r.setdefault("delta_id", rec["id"])
             if not durable and self._wal is not None:
                 r["durable"] = False
             if durable_replica is False:
                 r["replicated"] = False
             return r
         out = {"accepted": True, "seq": my_seq, "key": key}
+        if rec is not None:
+            # the producer learns its delta's trace identity: the
+            # handle that cross-references spans, slow-delta records,
+            # and flight dumps fleet-wide
+            out["delta_id"] = rec["id"]
         if ts is not None:
             out["tenant"] = tname
         if not durable and self._wal is not None:
@@ -964,6 +1062,14 @@ class CheckerService:
                 row["wal_bytes"] = self._wal.size_bytes(key)
             keys[edn.dumps(key)] = row
         doc["keys"] = keys
+        if self.slow_delta_secs:
+            # slow-delta forensics (armed only — the key is absent,
+            # not empty, when the threshold is off: /status schema
+            # parity): the retained ring, oldest first, each record a
+            # stage breakdown + verdict/resilience/stats context
+            doc["slow_delta_secs"] = self.slow_delta_secs
+            doc["slow_deltas"] = obs.slow_delta_records(
+                self._slow_scope)
         if trows is not None:
             # the per-tenant SLO answer, readable without a /metrics
             # scrape: quantiles straight from the labeled histograms
@@ -1033,7 +1139,7 @@ class CheckerService:
         header carries the bump durably — the fence the rehome wrote
         in the old owner's dir names exactly this epoch. A plain
         restart keeps the stored epoch (same owner, same epoch)."""
-        deltas = self._wal.replay(key)
+        deltas, wal_ids = self._wal.replay_with_ids(key)
         if not deltas:
             return None
         head = self._wal.header(key) or {}
@@ -1072,9 +1178,22 @@ class CheckerService:
             # key migrated back here): our bumped epoch out-ranks it,
             # so it no longer binds — drop it
             self._wal.clear_fence(key)
+        # delta trace identity rides the transferred segments: the ids
+        # the previous owner stamped (or synthesized stand-ins for
+        # pre-tracing records) re-tag this replica's thaw/apply spans,
+        # so a migrated delta's chain reads across the replica
+        # boundary in the merged fleet trace. replay_with_ids above
+        # collected them in the same segment scan — recovery must not
+        # read + decode every segment twice.
+        ids = wal_ids if self._delta_obs else {}
         sess = self._new_session(key)
         if base:
-            with obs.span("serve.thaw", key=str(key)):
+            sp_kw = {"key": str(key), "ops": len(base)}
+            if ids:
+                bids = [ids[seq] for seq, _ops in deltas
+                        if seq <= applied and seq in ids]
+                sp_kw["delta_ids"] = bids[-32:]
+            with obs.span("serve.thaw", **sp_kw):
                 sess.thaw(base, cp)
             ks.applied_seq = applied
             ks.needs_check = True
@@ -1084,6 +1203,13 @@ class CheckerService:
         ks.enq_seq = deltas[-1][0]
         ks.wal_next = deltas[-1][0] + 1
         ks.pending.extend(rest)
+        if self._delta_obs:
+            now = self._clock()
+            for seq, dops in rest:
+                ks.delta_recs.append(
+                    {"id": ids.get(seq) or _mint_delta_id(),
+                     "seq": seq, "tenant": tname, "ops": len(dops),
+                     "t_in": now})
         ks.pending_ops = sum(len(ops) for _, ops in rest)
         ks.last_activity = self._clock()
         ks.acct["replays"] = len(deltas)
@@ -1203,12 +1329,17 @@ class CheckerService:
         sess = self._new_session(ks.key)
         cp, _meta = (self._cps.load(ks.key)
                      if self._cps is not None else (None, None))
-        deltas = self._wal.replay(ks.key) if self._wal else []
+        deltas, ids = (self._wal.replay_with_ids(ks.key)
+                       if self._wal else ([], {}))
         applied = [(seq, dops) for seq, dops in deltas
                    if seq <= ks.applied_seq]
         ops = [op for _seq, dops in applied for op in dops]
         if ops:
-            with obs.span("serve.thaw", key=str(ks.key)):
+            sp_kw = {"key": str(ks.key)}
+            if self._delta_obs:
+                sp_kw["delta_ids"] = [ids[seq] for seq, _d in applied
+                                      if seq in ids][-32:]
+            with obs.span("serve.thaw", **sp_kw):
                 sess.thaw(ops, cp)
             obs.counter("serve.thaws").inc()
             ks.acct["replays"] += len(applied)
@@ -1219,6 +1350,22 @@ class CheckerService:
         return any(ks.pending or ks.needs_check
                    or (ks.finalize_requested and not ks.finalized)
                    for ks in self._keys.values())
+
+    def _take_recs_locked(self, ks: _Key, last_seq) -> tuple:
+        """Pop the per-delta trace records this batch covers (callers
+        hold the condition) and stamp the queue->worker handoff time.
+        Ownership moves with the batch: the records are closed out at
+        verdict publish, whichever path publishes. Empty when delta
+        tracing is unarmed (``delta_recs`` never fills)."""
+        if last_seq is None or not ks.delta_recs:
+            return ()
+        now = self._clock()
+        out = []
+        while ks.delta_recs and ks.delta_recs[0]["seq"] <= last_seq:
+            r = ks.delta_recs.popleft()
+            r["t_take"] = now
+            out.append(r)
+        return tuple(out)
 
     def _take_work_locked(self) -> list:
         """Pop pending deltas (coalesced, seq order) and settle the
@@ -1250,7 +1397,8 @@ class CheckerService:
                 ks.pending_ops -= len(ops)
                 self._pending_ops -= len(ops)
                 final = ks.finalize_requested and not ks.finalized
-                batch.append((ks, ops, last_seq, final))
+                batch.append((ks, ops, last_seq, final,
+                              self._take_recs_locked(ks, last_seq)))
             if batch:
                 obs.gauge("serve.pending_ops").set(self._pending_ops)
                 obs.counter_sample("serve.pending_ops",
@@ -1309,7 +1457,8 @@ class CheckerService:
                     obs.gauge(obs.labeled(
                         "serve.pending_ops",
                         tenant=tname)).set(ts.pending_ops)
-                batch.append((ks, ops, last_seq, final))
+                batch.append((ks, ops, last_seq, final,
+                              self._take_recs_locked(ks, last_seq)))
             if not any(ks.pending for ks in keys):
                 ts.deficit = 0
         if took_ops:
@@ -1346,8 +1495,12 @@ class CheckerService:
         obs.counter("serve.worker_errors").inc()
         _log.exception("serve worker: key %r failed", ks.key)
         # the crash's postmortem evidence, tracing on or off (a None
-        # check when the flight recorder is unarmed)
-        obs.flight_dump("serve-worker-error")
+        # check when the flight recorder is unarmed); the trigger
+        # context names the key so the dump cross-references the
+        # error verdict and any slow-delta record
+        obs.flight_dump("serve-worker-error", context={
+            "key": str(ks.key), "tenant": ks.tenant,
+            "error": f"{type(err).__name__}: {err}"})
         ks.session = None
         if self._wal is None:
             ks.broken = True
@@ -1358,7 +1511,7 @@ class CheckerService:
     def _process(self, batch: list) -> None:
         # phase 1 (no lock): apply deltas; a crash costs ONE key
         entries = []
-        for ks, ops, last_seq, final in batch:
+        for ks, ops, last_seq, final, recs in batch:
             sess = err_r = None
             if ks.broken:
                 # poisoned (worker crash, no WAL): keep serving the
@@ -1366,17 +1519,22 @@ class CheckerService:
                 entries.append((ks, None, last_seq, final,
                                 dict(ks.last_result or {
                                     "valid?": "unknown",
-                                    "error": "key poisoned"})))
+                                    "error": "key poisoned"}), recs))
                 continue
             try:
                 sess = self._session_for(ks)
                 if ops:
-                    with obs.span("serve.apply", key=str(ks.key),
-                                  ops=len(ops)):
+                    sp_kw = {"key": str(ks.key), "ops": len(ops)}
+                    if recs:
+                        # the delta ids this apply advances — the
+                        # worker-side link of each delta's chain
+                        sp_kw["delta_ids"] = [r["id"] for r in recs]
+                        sp_kw["tenant"] = ks.tenant
+                    with obs.span("serve.apply", **sp_kw):
                         sess.extend(ops)
             except Exception as err:  # noqa: BLE001 — isolate per key
                 err_r = self._crashed_entry(ks, err)
-            entries.append((ks, sess, last_seq, final, err_r))
+            entries.append((ks, sess, last_seq, final, err_r, recs))
         # phase 2 (no lock): one batched advance over the live ones
         live = [e for e in entries if e[4] is None]
         try:
@@ -1392,26 +1550,112 @@ class CheckerService:
         # phase 3 (no lock): finalization — counterexample extraction
         # is a real device dispatch and must not stall every other
         # key's submit/result behind the service lock
-        for ks, sess, _last_seq, final, err_r in entries:
+        for ks, sess, _last_seq, final, err_r, _recs in entries:
             if final and err_r is None and id(ks) in results \
                     and sess is not None:
                 try:
                     results[id(ks)] = sess.finalize()
                 except Exception as err:  # noqa: BLE001
                     results[id(ks)] = self._crashed_entry(ks, err)
-        # phase 4: publish under the lock
+        # phase 4: publish under the lock. t_dev_end splits each
+        # delta's device stage (apply/advance/finalize above) from its
+        # publish stage (this lock acquisition + bookkeeping).
+        t_dev_end = self._clock()
+        dump_ctx = None
         with self._cond:
-            for ks, sess, last_seq, final, err_r in entries:
-                ks.last_result = (err_r if err_r is not None
-                                  else results[id(ks)])
-                ks.needs_check = False
-                if final:
-                    ks.finalized = True
-                if last_seq is not None:
-                    ks.applied_seq = last_seq
-                self._observe_verdicts_locked(ks)
-                ks.last_activity = self._clock()
+            with obs.span("serve.publish", keys=len(entries)):
+                for ks, sess, last_seq, final, err_r, recs in entries:
+                    ks.last_result = (err_r if err_r is not None
+                                      else results[id(ks)])
+                    ks.needs_check = False
+                    if final:
+                        ks.finalized = True
+                    if last_seq is not None:
+                        ks.applied_seq = last_seq
+                    self._observe_verdicts_locked(ks)
+                    ctx = self._finish_recs_locked(ks, recs,
+                                                   t_dev_end)
+                    if ctx is not None:
+                        dump_ctx = ctx
+                    ks.last_activity = self._clock()
             self._cond.notify_all()
+        if dump_ctx is not None:
+            # the worst slow delta so far gets the flight ring dumped
+            # with it — outside the service lock (file I/O)
+            obs.flight_dump("slow-delta", context=dump_ctx)
+
+    def _finish_recs_locked(self, ks: _Key, recs,
+                            t_dev_end: float) -> Optional[dict]:
+        """Close out a batch's per-delta trace records at verdict
+        publish (callers hold the condition): compute each delta's
+        stage breakdown, and when ``JEPSEN_TPU_SLOW_DELTA_SECS`` is
+        armed and crossed, land the structured forensics record in the
+        bounded newest-wins ring (``obs.record_slow_delta``). Returns
+        the record to flight-dump when one is the new worst offender
+        (the CALLER dumps, outside the lock — a dump is file I/O)."""
+        if not recs:
+            return None
+        now = self._clock()
+        worst_ctx = None
+        r0 = ks.last_result or {}
+        for r in recs:
+            t_in = r["t_in"]
+            t_admit = r.get("t_admit", t_in)
+            t_take = r.get("t_take", t_admit)
+            total = max(0.0, now - t_in)
+            if not self.slow_delta_secs \
+                    or total < self.slow_delta_secs:
+                continue
+            # the WAL stage is a measured fsync DURATION, concurrent
+            # with queue/device (the worker takes a delta without
+            # waiting for its fsync — the handoff only orders WRITES
+            # per key), so queue is the full admission->take wait and
+            # the stages need not sum to total. A verdict published
+            # while the fsync is still in flight attributes the
+            # elapsed window so far (end stamp missing) — the sick-
+            # disk evidence must not read wal=0.
+            ws = r.get("t_wal_start")
+            we = r.get("t_wal_end")
+            # None-checks, not truthiness: an injectable clock may
+            # legally stamp 0.0 (the fake-clock test pattern)
+            wal_secs = (max(0.0, we - ws)
+                        if ws is not None and we is not None
+                        else max(0.0, now - ws) if ws is not None
+                        else 0.0)
+            stages = {
+                "backpressure": max(0.0, t_admit - t_in),
+                "wal": wal_secs,
+                "queue": max(0.0, t_take - t_admit),
+                "device": max(0.0, t_dev_end - t_take),
+                "publish": max(0.0, now - t_dev_end),
+            }
+            doc = {"delta_id": r["id"], "key": str(ks.key),
+                   "tenant": r.get("tenant"), "seq": r.get("seq"),
+                   "ops": r.get("ops"),
+                   "total_secs": round(total, 6),
+                   "stages": {k: round(v, 6)
+                              for k, v in stages.items()},
+                   "slowest_stage": max(stages, key=stages.get),
+                   "verdict": r0.get("valid?")}
+            if r0.get("error"):
+                doc["error"] = r0["error"]
+            if r0.get("resilience"):
+                # the degradation notes: WHY the device stage was
+                # slow reads straight off the record
+                doc["resilience"] = r0["resilience"]
+            if r0.get("stats"):
+                # the JEPSEN_TPU_SEARCH_STATS block (armed only):
+                # which device program the delta was running, sized
+                s = r0["stats"]
+                doc["stats"] = {k: s.get(k) for k in
+                                ("events", "frontier-peak",
+                                 "capacity", "capacity-tier",
+                                 "dedupe", "load-factor-peak",
+                                 "probe-hist", "pad-waste")
+                                if s.get(k) is not None}
+            if obs.record_slow_delta(doc, scope=self._slow_scope):
+                worst_ctx = doc
+        return worst_ctx
 
     def _freeze_session(self, ks: _Key, locked: bool = False) -> None:
         """Freeze one key's live frontier to the checkpoint store and
@@ -1498,14 +1742,27 @@ class CheckerService:
                 # survive it: publish loud error verdicts (accounting
                 # was settled at take time) and drop the sessions so
                 # the WAL replay recovers the truth on the next delta.
+                t_dev_end = self._clock()
+                dump_ctx = None
                 with self._cond:
-                    for ks, _ops, last_seq, _final in batch:
+                    for ks, _ops, last_seq, _final, recs in batch:
                         ks.last_result = self._crashed_entry(ks, err)
                         ks.needs_check = False
                         if last_seq is not None:
                             ks.applied_seq = last_seq
                         self._observe_verdicts_locked(ks)
+                        ctx = self._finish_recs_locked(ks, recs,
+                                                       t_dev_end)
+                        if ctx is not None:
+                            dump_ctx = ctx
                     self._cond.notify_all()
+                if dump_ctx is not None:
+                    # same contract as the _process publish path: a
+                    # crashed batch's worst offender still raised the
+                    # ring's high-water, so dropping its dump here
+                    # would suppress every later (smaller) offender's
+                    # dump too. File I/O outside the lock.
+                    obs.flight_dump("slow-delta", context=dump_ctx)
             finally:
                 with self._cond:
                     self._inflight = 0

@@ -31,6 +31,7 @@ from __future__ import annotations
 import json
 import sys
 
+from jepsen_tpu import obs
 from jepsen_tpu.history import Op, _hashable
 
 
@@ -90,12 +91,24 @@ def run_stdio(service, lines_in=None, out=None) -> int:
                                       timeout=req.get("timeout"),
                                       token=req.get("token")))
             elif "ops" in req:
-                emit(service.submit(_key(req),
-                                    [Op(o) for o in req["ops"]],
-                                    seq=req.get("seq"),
-                                    timeout=req.get("timeout"),
-                                    wait=bool(req.get("wait")),
-                                    token=req.get("token")))
+                # the stdio leg of the delta's causal chain — same
+                # shape as the HTTP ingress span, so a trace reads
+                # identically whichever transport carried the delta;
+                # a line-supplied "delta_id" rides through, else the
+                # service mints one at admission (armed only)
+                with obs.span("serve.stdio.request",
+                              key=str(req.get("key"))) as sp:
+                    r = service.submit(_key(req),
+                                       [Op(o) for o in req["ops"]],
+                                       seq=req.get("seq"),
+                                       timeout=req.get("timeout"),
+                                       wait=bool(req.get("wait")),
+                                       token=req.get("token"),
+                                       delta_id=req.get("delta_id"))
+                    if isinstance(r, dict) and r.get("delta_id"):
+                        sp.set(delta_id=r["delta_id"],
+                               seq=r.get("seq"))
+                emit(r)
             else:
                 emit({"error": f"unknown request {req!r}"})
     finally:

@@ -209,13 +209,24 @@ class DeltaWAL:
         return fh
 
     def append(self, key, seq: int, ops,
-               tenant: Optional[str] = None) -> int:
+               tenant: Optional[str] = None,
+               delta_id: Optional[str] = None) -> int:
         """Durably append one delta; returns the bytes written (the
         per-tenant WAL-quota meter). ``tenant`` stamps the segment
-        header so recovery re-homes the key to its owner."""
+        header so recovery re-homes the key to its owner.
+
+        ``delta_id`` (when the service has delta tracing armed) rides
+        the record as ``"id"`` so the delta's trace identity survives
+        recovery, replica handoff, and adoption — the id travels with
+        the transferred segment files. None keeps the record bytes
+        identical to the pre-tracing format (the default-off parity
+        contract); ``replay`` ignores the field either way."""
         stem = _safe_name(key)
-        line = json.dumps({"seq": int(seq),
-                           "ops": [op_to_edn_str(o) for o in ops]})
+        rec = {"seq": int(seq),
+               "ops": [op_to_edn_str(o) for o in ops]}
+        if delta_id is not None:
+            rec["id"] = str(delta_id)
+        line = json.dumps(rec)
         with self._lock:
             slock = self._stem_locks.setdefault(stem, threading.Lock())
         with slock:
@@ -430,8 +441,19 @@ class DeltaWAL:
         Tolerates one torn TRAILING line per segment (an
         unacknowledged mid-write kill — it was the tail of its file
         when written, segment boundary or not)."""
+        return self.replay_with_ids(key)[0]
+
+    def replay_with_ids(self, key):
+        """One-scan ``(replay(key), seq -> delta_id)`` — the
+        recovery/adoption/re-thaw path needs both, and with delta
+        tracing armed must not pay the segment read + json decode
+        twice per key. Same torn-tail/corruption posture as
+        ``replay``; ids synthesized like ``delta_ids`` for records
+        written without one."""
         out: List[Tuple[int, list]] = []
         seen = set()
+        ids: Dict[int, str] = {}
+        digest = self._id_digest(key)
         for path in self.segments(key):
             with open(path) as fh:
                 lines = fh.read().splitlines()
@@ -457,7 +479,50 @@ class DeltaWAL:
                     continue
                 seen.add(seq)
                 out.append((seq, ops))
+                ids[seq] = self._record_id(digest, rec, seq)
         out.sort(key=lambda t: t[0])
+        return out, ids
+
+    @staticmethod
+    def _id_digest(key) -> str:
+        return _safe_name(key).rsplit("_", 1)[-1]
+
+    @staticmethod
+    def _record_id(digest: str, rec: dict, seq: int) -> str:
+        """One record's trace id: the stamped ``"id"``, or the
+        SYNTHESIZED stable stand-in (``wal-<stem digest>-<seq>``) for
+        records written before delta tracing existed (or unarmed) —
+        deterministic per (key, seq), so the same synthetic id
+        reappears on every replay/adoption of the same record. ONE
+        definition, shared by the strict (``replay_with_ids``) and
+        lenient (``delta_ids``) scans: the two paths must never mint
+        different ids for the same bytes."""
+        return str(rec.get("id") or f"wal-{digest}-{seq}")
+
+    def delta_ids(self, key) -> Dict[int, str]:
+        """seq -> trace ``delta_id`` for every replayable delta of the
+        key (ids per ``_record_id`` — stamped or synthesized). Decode
+        failures are skipped (the torn-tail / corruption posture
+        belongs to ``replay``; this is a telemetry read and must
+        never out-strict it)."""
+        digest = self._id_digest(key)
+        out: Dict[int, str] = {}
+        for path in self.segments(key):
+            try:
+                with open(path) as fh:
+                    lines = fh.read().splitlines()
+            except OSError:
+                continue
+            for line in lines[1:]:
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                    seq = int(rec["seq"])
+                except Exception:  # noqa: BLE001 — torn tail etc.
+                    continue
+                if seq not in out:
+                    out[seq] = self._record_id(digest, rec, seq)
         return out
 
     def last_seq(self, key) -> int:

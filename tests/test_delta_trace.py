@@ -1,0 +1,492 @@
+"""End-to-end delta causality suite (ISSUE 14 acceptance): per-delta
+trace identity through the serve stack, slow-delta forensics, the
+fleet trace merge, and the default-off parity pins.
+
+The load-bearing invariants:
+
+* armed (JEPSEN_TPU_TRACE / _FLIGHT_RECORDER / _SLOW_DELTA_SECS), one
+  admitted delta is ONE linked span chain tagged {delta_id, key,
+  tenant, seq} — transport leg through WAL fsync through worker apply
+  through verdict publish — and the id survives WAL replay, replica
+  migration, and adoption (it rides the transferred segments);
+* the slow-delta ring captures a stage-by-stage breakdown whose
+  shape (`backpressure/wal/queue/device/publish`) is what `jepsen
+  report --slow` renders and /status surfaces;
+* UNARMED, everything is byte-identical to the pre-tracing service:
+  acks carry no delta_id, WAL records gain no field, /status gains
+  no key.
+"""
+
+import json
+import os
+
+import pytest
+
+from jepsen_tpu import obs
+from jepsen_tpu.envflags import EnvFlagError
+from jepsen_tpu.histories import rand_register_history
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.serve import CheckerService, DeltaWAL
+from jepsen_tpu.serve.ring import Router
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state(monkeypatch):
+    for flag in ("JEPSEN_TPU_TRACE", "JEPSEN_TPU_FLIGHT_RECORDER",
+                 "JEPSEN_TPU_SLOW_DELTA_SECS", "JEPSEN_TPU_FAULTS"):
+        monkeypatch.delenv(flag, raising=False)
+    obs.reset()
+    obs.flight_reset()
+    obs.drain_slow_deltas()
+    yield
+    obs.reset()
+    obs.flight_reset()
+    obs.drain_slow_deltas()
+
+
+def _hist(seed=11, n=16):
+    return list(rand_register_history(n_ops=n, n_processes=3,
+                                      n_values=3, seed=seed))
+
+
+# ------------------------------------------------ id lifecycle
+
+
+def test_unarmed_service_is_byte_identical(tmp_path):
+    """Parity pin: with every tracing flag unset, acks carry no
+    delta_id, the WAL record bytes carry no "id" field, and /status
+    has no slow-delta keys — the pre-tracing service, exactly."""
+    h = _hist()
+    svc = CheckerService(CASRegister(), wal_dir=str(tmp_path),
+                         capacity=128)
+    try:
+        a = svc.submit("k", h[:8], timeout=30)
+        assert a["accepted"] and "delta_id" not in a
+        # an explicitly supplied delta_id is IGNORED while unarmed
+        a2 = svc.submit("k", h[8:], timeout=30,
+                        delta_id="should-vanish")
+        assert a2["accepted"] and "delta_id" not in a2
+        svc.drain(timeout=60)
+        st = svc.status()
+        assert "slow_deltas" not in st \
+            and "slow_delta_secs" not in st
+        seg = DeltaWAL(str(tmp_path)).segments("k")[0]
+        for line in open(seg).read().splitlines()[1:]:
+            assert '"id"' not in line
+            # the record spells exactly the historical fields
+            assert sorted(json.loads(line)) == ["ops", "seq"]
+    finally:
+        svc.close(drain=False)
+
+
+def test_armed_ack_wal_and_span_chain(tmp_path, monkeypatch):
+    """Tracing on: the ack returns the minted delta_id, the WAL
+    record persists it, and the span chain carries it on the
+    admit/wal legs and as delta_ids on the worker apply leg."""
+    monkeypatch.setenv("JEPSEN_TPU_TRACE", "1")
+    obs.reset()
+    h = _hist()
+    svc = CheckerService(CASRegister(), wal_dir=str(tmp_path),
+                         capacity=128)
+    try:
+        r = svc.submit("k", h[:8], wait=True, timeout=120)
+        assert r.get("delta_id")
+        did = r["delta_id"]
+        # producer-supplied ids ride through
+        r2 = svc.submit("k", h[8:], wait=True, timeout=120,
+                        delta_id="my-own-id-1")
+        assert r2["delta_id"] == "my-own-id-1"
+        ids = DeltaWAL(str(tmp_path)).delta_ids("k")
+        assert ids == {1: did, 2: "my-own-id-1"}
+        spans = obs.tracer().spans()
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s.name, []).append(s)
+        admits = [s for s in by_name.get("serve.admit", ())
+                  if s.args.get("delta_id") == did]
+        assert admits and admits[0].args["seq"] == 1
+        wals = [s for s in by_name.get("serve.wal", ())
+                if s.args.get("delta_id") == did]
+        assert wals
+        applies = [s for s in by_name.get("serve.apply", ())
+                   if did in (s.args.get("delta_ids") or ())]
+        assert applies
+        assert "serve.publish" in by_name
+    finally:
+        svc.close(drain=False)
+
+
+def test_id_survives_restart_and_old_wal_synthesizes(tmp_path,
+                                                     monkeypatch):
+    """WAL replay keeps the stamped ids; records written WITHOUT ids
+    (the pre-tracing on-disk format) replay with a synthesized stable
+    id — back-compat, pinned on actual old-format bytes."""
+    h = _hist()
+    # write an OLD-format WAL (unarmed service)
+    svc = CheckerService(CASRegister(), wal_dir=str(tmp_path),
+                         capacity=128)
+    svc.submit("old-k", h[:8], timeout=30)
+    svc.drain(timeout=60)
+    svc.close()
+    wal = DeltaWAL(str(tmp_path))
+    ids = wal.delta_ids("old-k")
+    assert list(ids) == [1] and ids[1].startswith("wal-")
+    assert wal.delta_ids("old-k") == ids      # deterministic
+    # an armed restart replays it and continues the stream with
+    # minted ids; the old delta's synthetic id tags the thaw replay
+    monkeypatch.setenv("JEPSEN_TPU_TRACE", "1")
+    obs.reset()
+    svc2 = CheckerService(CASRegister(), wal_dir=str(tmp_path),
+                          capacity=128)
+    try:
+        r = svc2.submit("old-k", h[8:], wait=True, timeout=120)
+        assert r.get("delta_id")
+        ids2 = DeltaWAL(str(tmp_path)).delta_ids("old-k")
+        assert ids2[1] == ids[1] and ids2[2] == r["delta_id"]
+    finally:
+        svc2.close(drain=False)
+
+
+def test_migrated_delta_chain_reads_across_replicas(tmp_path,
+                                                    monkeypatch):
+    """The cross-replica acceptance at unit scale: a key admitted on
+    one replica and migrated to another leaves delta_id-tagged spans
+    on BOTH sides — the source's admit/wal legs and the destination's
+    thaw/apply legs share the id (it rode the transferred WAL
+    segments)."""
+    monkeypatch.setenv("JEPSEN_TPU_TRACE", "1")
+    obs.reset()
+    h = _hist()
+    dirs = {n: str(tmp_path / n) for n in ("ra", "rb")}
+    svcs = {n: CheckerService(CASRegister(), wal_dir=d, capacity=128)
+            for n, d in dirs.items()}
+    router = Router(svcs, dirs)
+    try:
+        key = "mig-k"
+        src = router.owner(key)
+        dst = [n for n in svcs if n != src][0]
+        r = router.submit(key, h[:8], wait=True, timeout=120)
+        did = r["delta_id"]
+        router.migrate_key(key, dst)
+        r2 = router.submit(key, h[8:], wait=True, timeout=120)
+        assert "valid?" in r2
+        # both replicas share this process's tracer; the chain still
+        # proves the id crossed the boundary: a thaw/apply span
+        # tagged with the ORIGINAL id exists beyond the source's own
+        # admit/apply legs (the destination replayed it)
+        tagged = [s for s in obs.tracer().spans()
+                  if s.name in ("serve.thaw", "serve.apply")
+                  and did in (s.args.get("delta_ids") or ())]
+        assert len(tagged) >= 2, [(s.name, s.args) for s in tagged]
+    finally:
+        for s in svcs.values():
+            s.close(drain=False)
+
+
+# ------------------------------------------- slow-delta forensics
+
+
+def test_slow_delta_ring_status_export_report(tmp_path, monkeypatch):
+    """The forensics pipeline end to end: a tiny threshold makes
+    every delta slow; the record carries the full stage breakdown;
+    /status surfaces the ring; export_run drains it to
+    slow_deltas.jsonl even with tracing OFF; `jepsen report --slow`
+    renders it."""
+    monkeypatch.setenv("JEPSEN_TPU_SLOW_DELTA_SECS", "0.00001")
+    h = _hist()
+    svc = CheckerService(CASRegister(), wal_dir=str(tmp_path / "w"),
+                         capacity=128)
+    try:
+        r = svc.submit("slow-k", h[:8], wait=True, timeout=120)
+        assert r.get("delta_id")   # the threshold alone arms ids
+        svc.drain(timeout=60)
+        st = svc.status()
+        assert st["slow_delta_secs"] == pytest.approx(0.00001)
+        recs = st["slow_deltas"]
+        assert recs
+        rec = recs[0]
+        assert rec["delta_id"] == r["delta_id"]
+        assert rec["key"] == "slow-k" and rec["seq"] == 1
+        assert set(rec["stages"]) == {"backpressure", "wal", "queue",
+                                      "device", "publish"}
+        assert rec["slowest_stage"] in rec["stages"]
+        # the wal stage is a measured fsync duration CONCURRENT with
+        # queue/device (the worker never waits on the fsync), so the
+        # stages may over-count total by at most the wal stage
+        assert rec["total_secs"] >= (sum(rec["stages"].values())
+                                     - rec["stages"]["wal"] - 1e-3)
+        assert rec["verdict"] is not None
+    finally:
+        svc.close(drain=False)
+    run_dir = tmp_path / "run"
+    arts = obs.export_run(str(run_dir))
+    assert arts and "slow_deltas" in arts
+    lines = [json.loads(ln) for ln in
+             open(os.path.join(str(run_dir), "slow_deltas.jsonl"))]
+    assert lines and lines[0]["delta_id"]
+    # drained: a second export writes nothing
+    assert obs.export_run(str(tmp_path / "run2")) is None
+    from jepsen_tpu.obs.search_report import report_main
+    assert report_main(["--slow", "--run-dir", str(run_dir)]) == 0
+    txt = open(os.path.join(str(run_dir), "slow_report.txt")).read()
+    assert lines[0]["delta_id"] in txt and "device" in txt
+    # no input -> exit 1, usage without a mode -> 254
+    assert report_main(["--slow",
+                        "--run-dir", str(tmp_path / "run2")]) == 1
+    assert report_main(["--run-dir", str(run_dir)]) == 254
+
+
+def test_slow_delta_worst_offender_flight_dump(tmp_path, monkeypatch):
+    """The worst offender triggers a flight dump whose trigger block
+    cross-references the slow-delta record (satellite: dumps embed
+    the triggering delta_id/key/tenant)."""
+    monkeypatch.setenv("JEPSEN_TPU_SLOW_DELTA_SECS", "0.00001")
+    monkeypatch.setenv("JEPSEN_TPU_FLIGHT_RECORDER", "1")
+    obs.reset()
+    obs.set_flight_dir(str(tmp_path / "flight"))
+    h = _hist()
+    svc = CheckerService(CASRegister(), capacity=128)
+    try:
+        svc.submit("fk", h[:8], wait=True, timeout=120)
+    finally:
+        svc.close(drain=False)
+    dumps = [f for f in os.listdir(tmp_path / "flight")
+             if f.startswith("flight_slow-delta")]
+    assert dumps
+    doc = json.load(open(tmp_path / "flight" / dumps[0]))
+    trig = doc["flight"]["trigger"]
+    assert trig["key"] == "fk" and trig["delta_id"] \
+        and trig["stages"]["device"] >= 0
+
+
+def test_slow_delta_ring_is_bounded_newest_wins():
+    from jepsen_tpu.obs import export as export_mod
+    for i in range(export_mod.SLOW_DELTA_MAX_RECORDS + 10):
+        obs.record_slow_delta({"delta_id": f"d{i}",
+                               "total_secs": 0.001})
+    recs = obs.slow_delta_records()
+    assert len(recs) == export_mod.SLOW_DELTA_MAX_RECORDS
+    assert recs[-1]["delta_id"] == \
+        f"d{export_mod.SLOW_DELTA_MAX_RECORDS + 9}"   # newest kept
+    assert recs[0]["delta_id"] == "d10"               # oldest gone
+    assert obs.drain_slow_deltas() and not obs.slow_delta_records()
+
+
+def test_slow_delta_ring_scoped_per_service():
+    """Two services in one process (the serve_smoke shape) must not
+    read each other's forensics on /status, and one service's huge
+    offender must not suppress the other's worst-offender flight
+    dump — records are scoped, the drain stays process-wide."""
+    obs.reset()
+    obs.drain_slow_deltas()
+    s1, s2 = object(), object()
+    big = {"delta_id": "d-big", "key": "k1", "total_secs": 10.0}
+    small = {"delta_id": "d-small", "key": "k2", "total_secs": 8.0}
+    assert obs.record_slow_delta(big, scope=s1) is True
+    # s2's FIRST offender is its own worst — s1's 10s must not mute it
+    assert obs.record_slow_delta(small, scope=s2) is True
+    assert [r["key"] for r in obs.slow_delta_records(s1)] == ["k1"]
+    assert [r["key"] for r in obs.slow_delta_records(s2)] == ["k2"]
+    # unscoped read and the run-artifact drain stay process-wide
+    assert len(obs.slow_delta_records()) == 2
+    assert [r["key"] for r in obs.drain_slow_deltas()] == ["k1", "k2"]
+    assert obs.slow_delta_records() == []
+
+
+def test_slow_delta_flag_is_validated(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_SLOW_DELTA_SECS", "quick")
+    with pytest.raises(EnvFlagError):
+        CheckerService(CASRegister(), capacity=128,
+                       start_worker=False)
+    monkeypatch.setenv("JEPSEN_TPU_SLOW_DELTA_SECS", "-1")
+    with pytest.raises(EnvFlagError):
+        CheckerService(CASRegister(), capacity=128,
+                       start_worker=False)
+
+
+# ------------------------------------------------ ingress parenting
+
+
+def test_ingress_span_parents_service_chain(monkeypatch):
+    """Satellite pin: the per-request Context.copy across the
+    ingress's run_in_executor hop makes the service's serve.admit
+    span a DESCENDANT of serve.ingress.request instead of an orphan
+    root."""
+    from jepsen_tpu.serve.ingress import DeltaIngress
+    import urllib.request
+    monkeypatch.setenv("JEPSEN_TPU_TRACE", "1")
+    obs.reset()
+    h = _hist()
+    svc = CheckerService(CASRegister(), capacity=128)
+    ing = DeltaIngress(svc, port=0).start()
+    try:
+        body = (json.dumps({"key": "ik", "ops": [dict(o)
+                                                 for o in h[:8]],
+                            "wait": True, "timeout": 120})
+                + "\n").encode()
+        rq = urllib.request.Request(ing.url("/v1/deltas"), data=body)
+        with urllib.request.urlopen(rq, timeout=120) as resp:
+            out = json.loads(resp.read().decode().splitlines()[0])
+        assert out.get("delta_id")
+        spans = {s.sid: s for s in obs.tracer().spans()}
+        req = [s for s in spans.values()
+               if s.name == "serve.ingress.request"]
+        assert req and req[0].args.get("delta_id") == out["delta_id"]
+        admit = [s for s in spans.values() if s.name == "serve.admit"
+                 and s.args.get("delta_id") == out["delta_id"]]
+        assert admit
+        # walk the admit span's ancestry to the ingress request span
+        cur, seen = admit[0], set()
+        while cur.parent is not None and cur.parent not in seen:
+            seen.add(cur.parent)
+            cur = spans.get(cur.parent)
+            assert cur is not None, "parent id did not resolve"
+            if cur.name == "serve.ingress.request":
+                break
+        assert cur.name == "serve.ingress.request", \
+            [(s.name, s.sid, s.parent) for s in spans.values()]
+    finally:
+        ing.close()
+        svc.close(drain=False)
+
+
+# ------------------------------------------------ fleet trace merge
+
+
+def _mini_doc(replica, epoch, sid_base=0, delta_id=None):
+    args = {"span_id": sid_base + 1}
+    if delta_id:
+        args["delta_id"] = delta_id
+    return {"traceEvents": [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "host"}},
+        {"ph": "M", "pid": 1, "name": "trace_epoch",
+         "args": {"unix": epoch}},
+        {"ph": "X", "pid": 1, "tid": 7, "name": "serve.admit",
+         "cat": "serve", "ts": 100.0, "dur": 5.0, "args": args},
+    ], "trace": {"replica": replica, "epoch_unix": epoch}}
+
+
+def test_merge_aligns_and_finds_cross_replica_chains():
+    from jepsen_tpu.obs import trace_merge as tm
+    a = _mini_doc("ra", 100.0, delta_id="xyz")
+    b = _mini_doc("rb", 100.5, sid_base=10, delta_id="xyz")
+    c = _mini_doc("rc", 101.0, sid_base=20, delta_id="only-c")
+    merged = tm.merge_traces([a, b, c])
+    assert tm.validate_trace(merged) == []
+    assert merged["trace"]["aligned"] is True
+    assert merged["trace"]["replicas"] == ["ra", "rb", "rc"]
+    xs = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+    by_rep = {e["args"]["replica"]: e for e in xs}
+    # pids re-homed per replica; timestamps shifted by epoch offset
+    assert by_rep["ra"]["pid"] != by_rep["rb"]["pid"]
+    assert by_rep["ra"]["ts"] == 100.0
+    assert by_rep["rb"]["ts"] == pytest.approx(100.0 + 0.5e6)
+    assert by_rep["rc"]["ts"] == pytest.approx(100.0 + 1.0e6)
+    # process tracks renamed per replica
+    names = {e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert "ra/host" in names and "rb/host" in names
+    # the cross-replica chain query
+    assert tm.cross_replica_ids(merged) == ["xyz"]
+    # no trace_epoch events survive into the merged doc
+    assert not any(e.get("name") == "trace_epoch"
+                   for e in merged["traceEvents"])
+
+
+def test_validator_catches_schema_violations():
+    from jepsen_tpu.obs import trace_merge as tm
+    doc = _mini_doc("ra", 100.0)
+    assert tm.validate_trace(doc) == []
+    bad = json.loads(json.dumps(doc))
+    bad["traceEvents"][2]["args"].pop("span_id")
+    bad["traceEvents"][2]["dur"] = -1
+    bad["traceEvents"].append({"ph": "Z"})
+    errs = tm.validate_trace(bad)
+    assert len(errs) >= 3
+    # dangling parent ids are violations too
+    dangling = json.loads(json.dumps(doc))
+    dangling["traceEvents"][2]["args"]["parent_id"] = 999
+    assert any("parent_id" in e
+               for e in tm.validate_trace(dangling))
+
+
+def test_trace_endpoint_and_cli_merge(tmp_path, monkeypatch):
+    """GET /trace on the ops endpoint exports the live span buffer;
+    `jepsen trace` merges two exports and validates them (the
+    fleet-merge path chaos drives over real subprocess replicas)."""
+    import urllib.request
+    from jepsen_tpu.obs import httpd as ops_httpd
+    from jepsen_tpu.obs.trace_merge import trace_main
+    monkeypatch.setenv("JEPSEN_TPU_TRACE", "1")
+    obs.reset()
+    with obs.span("serve.admit", key="k", delta_id="tid-1"):
+        pass
+    ops = ops_httpd.start_ops_server(0, name="rep-a")
+    try:
+        with urllib.request.urlopen(ops.url("/trace"),
+                                    timeout=10) as resp:
+            doc = json.loads(resp.read().decode())
+    finally:
+        ops.close()
+    assert doc["trace"]["replica"] == "rep-a" \
+        and doc["trace"]["epoch_unix"] > 0
+    assert any(e.get("args", {}).get("delta_id") == "tid-1"
+               for e in doc["traceEvents"] if e["ph"] == "X")
+    p1 = tmp_path / "a.trace.json"
+    p2 = tmp_path / "b.trace.json"
+    json.dump(doc, open(p1, "w"))
+    doc2 = json.loads(json.dumps(doc))
+    doc2["trace"]["replica"] = "rep-b"
+    json.dump(doc2, open(p2, "w"))
+    assert trace_main(["--validate", str(p1), str(p2)]) == 0
+    out = tmp_path / "merged.json"
+    assert trace_main([str(p1), str(p2), "--out", str(out)]) == 0
+    merged = json.load(open(out))
+    assert merged["trace"]["aligned"] is True
+    from jepsen_tpu.obs.trace_merge import cross_replica_ids
+    assert cross_replica_ids(merged) == ["tid-1"]
+    # the CLI front door forwards pre-parse like lint/probe/status
+    from jepsen_tpu.cli import main as cli_main
+    assert cli_main(["trace", "--validate", str(out)]) == 0
+
+
+def test_cli_merge_uniquifies_colliding_input_names(tmp_path):
+    """Two scratch dirs each holding a 'trace.json' with NO embedded
+    replica name must land on two DISTINCT process tracks — collapsing
+    them onto one name would merge two span-id spaces (dangling
+    parents could falsely resolve across replicas) and hide genuinely
+    cross-replica chains."""
+    from jepsen_tpu.obs.trace_merge import trace_main
+    a = _mini_doc("ra", 100.0, delta_id="mig")
+    b = _mini_doc("rb", 100.5, sid_base=10, delta_id="mig")
+    for d, doc in (("d1", a), ("d2", b)):
+        (tmp_path / d).mkdir()
+        doc["trace"].pop("replica")        # path-derived name only
+        json.dump(doc, open(tmp_path / d / "trace.json", "w"))
+    out = tmp_path / "merged.json"
+    assert trace_main(["--dir", str(tmp_path / "d1"),
+                       "--dir", str(tmp_path / "d2"),
+                       "--out", str(out)]) == 0
+    merged = json.load(open(out))
+    assert len(merged["trace"]["replicas"]) == 2
+    assert len(set(merged["trace"]["replicas"])) == 2
+    from jepsen_tpu.obs.trace_merge import cross_replica_ids
+    assert cross_replica_ids(merged) == ["mig"]
+
+
+def test_flight_dump_context_rides_the_flight_block(tmp_path,
+                                                    monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_FLIGHT_RECORDER", "1")
+    obs.reset()
+    with obs.span("some.work"):
+        pass
+    path = obs.flight_dump("unit-test", dest_dir=str(tmp_path),
+                           context={"delta_id": "d1", "key": "k1",
+                                    "tenant": "t1"})
+    doc = json.load(open(path))
+    assert doc["flight"]["trigger"] == {"delta_id": "d1",
+                                        "key": "k1", "tenant": "t1"}
+    # context stays optional: no trigger block without one
+    path2 = obs.flight_dump("unit-test-2", dest_dir=str(tmp_path))
+    assert "trigger" not in json.load(open(path2))["flight"]

@@ -610,6 +610,42 @@ def test_render_prometheus_labels_round_trip():
                                tenant='we"ird\\')]["value"] == 1
 
 
+def test_prometheus_label_value_escaping_round_trip():
+    """Hostile label values — quotes, backslashes, newlines, and the
+    cascade-prone backslash-then-n pair — must survive a
+    render_prometheus -> parse_prometheus round trip byte-for-byte.
+    (Sequential str.replace unescaping turned the two-character value
+    `\\` + `n` into a literal newline; the single-pass unescaper this
+    pins was the fix.)"""
+    hostile = [
+        'plain',
+        'has"quote',
+        'has\\backslash',
+        'has\nnewline',
+        'back\\nslash-n',          # the cascade case: `\` then `n`
+        'mix"of\\all\nthree\\n',
+        'trailing\\',
+    ]
+    reg = obs.Registry()
+    for i, t in enumerate(hostile):
+        reg.counter(obs.labeled("esc.count", tenant=t)).inc(i + 1)
+        reg.histogram(obs.labeled("esc.secs",
+                                  tenant=t)).observe(0.01 * (i + 1))
+    text = ops_httpd.render_prometheus(reg.snapshot())
+    # every sample stays a single exposition line (newlines escaped)
+    for ln in text.splitlines():
+        assert ln.startswith("#") or ln.count('{') <= 1
+    parsed = ops_httpd.parse_prometheus(text)
+    for i, t in enumerate(hostile):
+        c = parsed[obs.labeled("jepsen_esc_count", tenant=t)]
+        assert c["value"] == i + 1, (t, c)
+        h = parsed[obs.labeled("jepsen_esc_secs", tenant=t)]
+        assert h["count"] == 1, (t, h)
+    # and the round trip is stable: render(parse(render)) keys match
+    assert len([k for k in parsed if k.startswith("jepsen_esc_")]) \
+        == 2 * len(hostile)
+
+
 def test_labeled_split_labels_helpers():
     assert obs.labeled("a.b") == "a.b"
     assert obs.labeled("a.b", tenant="x") == "a.b[tenant=x]"

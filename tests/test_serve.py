@@ -817,7 +817,8 @@ def test_tenant_drr_take_order_respects_weights():
             assert svc.submit("sk", h[i:i + 4], token="s1")["accepted"]
         with svc._cond:
             batch = svc._take_work_locked()
-        took = {ks.tenant: len(ops) for ks, ops, _seq, _f in batch}
+        took = {ks.tenant: len(ops)
+                for ks, ops, _seq, _f, _recs in batch}
         assert took == {"drr-big": 12, "drr-small": 4}
         # the rest stayed queued, accounted per tenant
         st = svc.status()["tenants"]

@@ -73,6 +73,13 @@ ACK_SLO_SECS = 10.0       # quiet-tenant ack p99 budget (CPU CI box,
 # heartbeat pauses + re-routes included)
 FAULT_SPEC = ("wedge@search:n=1,flaky@dispatch:n=2,"
               "slow@search:ms=5")
+#: a SECOND replica runs with only a slow fault armed at the device
+#: seams: the slow-delta probe posts it one key and asserts the
+#: JEPSEN_TPU_SLOW_DELTA_SECS forensics record shows a device-
+#: dominated stage breakdown (dispatch covers the bitdense seam,
+#: search the serve/extend seam)
+SLOW_SPEC = "slow@dispatch:ms=120,slow@search:ms=120"
+SLOW_DELTA_SECS = "0.05"  # armed fleet-wide; every replica records
 #: the flood tenant gets an explicit small pending-ops quota so the
 #: fairness line trips deterministically against a HEALTHY worker
 #: (the derived weight-share bound only bites when the queue backs up)
@@ -251,18 +258,30 @@ def main() -> int:
         PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH",
                                                       ""),
         JEPSEN_TPU_TENANTS=TENANTS,
-        JEPSEN_TPU_SERVE_REPL="sync")
+        JEPSEN_TPU_SERVE_REPL="sync",
+        # end-to-end delta tracing, fleet-wide: every replica keeps
+        # its span buffer (fetched via GET /trace and merged below)
+        # and records slow-delta forensics past the threshold
+        JEPSEN_TPU_TRACE="1",
+        JEPSEN_TPU_SLOW_DELTA_SECS=SLOW_DELTA_SECS)
     names = [f"r{i}" for i in range(max(2, args.replicas))]
     fleet = Fleet(names, base_env, root)
     # one replica runs with the device-fault matrix armed: wedge +
-    # flaky + slow at the supervised dispatch seams, under real load
+    # flaky + slow at the supervised dispatch seams, under real load;
+    # a DIFFERENT replica runs slow-only (the slow-delta probe's
+    # target — the wedge must not eat its first dispatch)
     fault_replica = names[-1]
+    slow_replica = names[0]
     for n in names:
-        fleet.spawn(n, extra_env=(
-            {"JEPSEN_TPU_FAULTS": FAULT_SPEC}
-            if n == fault_replica else None))
+        extra = None
+        if n == fault_replica:
+            extra = {"JEPSEN_TPU_FAULTS": FAULT_SPEC}
+        elif n == slow_replica:
+            extra = {"JEPSEN_TPU_FAULTS": SLOW_SPEC}
+        fleet.spawn(n, extra_env=extra)
     print(f"chaos: fleet up — {len(names)} replicas, faults armed "
-          f"on {fault_replica} ({FAULT_SPEC})")
+          f"on {fault_replica} ({FAULT_SPEC}), slow armed on "
+          f"{slow_replica} ({SLOW_SPEC})")
 
     rehome_events = []
     rehomed = threading.Event()
@@ -278,6 +297,45 @@ def main() -> int:
                   for n in names},
         interval=0.25, threshold=2, fetch_timeout=1.0,
         on_rehome=on_rehome).start()
+
+    # --- slow-delta forensics probe (before the nemesis: the slow
+    # replica must be alive and undisturbed). One key posted straight
+    # to the slow@dispatch replica; its /status must carry a
+    # slow-delta record whose stage breakdown is device-dominated —
+    # the PR-12 wedge diagnosis, now one structured read.
+    slow_key = "chaos-slow-k"
+    slow_piece = [dict(o) for o in rand_register_history(
+        n_ops=8, n_processes=3, n_values=3, crash_p=0.0, seed=9000)]
+    try:
+        outs = _post_lines(fleet.ing_addr(slow_replica),
+                           [{"key": slow_key, "ops": slow_piece,
+                             "wait": True, "timeout": 90}],
+                           "tok-chaos-q0", timeout=120)
+        r = outs[0]
+        if "valid?" not in r:
+            fail(f"slow-delta probe got no verdict: {r}")
+        if not r.get("delta_id"):
+            fail(f"armed serve ack carried no delta_id: {r}")
+        sdoc = ops_httpd.fetch_replica(fleet.ops_addr(slow_replica),
+                                       timeout=10)
+        slows = (sdoc.get("status") or {}).get("slow_deltas") or []
+        mine = [s for s in slows if s.get("key") == slow_key]
+        if not mine:
+            fail(f"no slow-delta record for {slow_key} on "
+                 f"{slow_replica}: {slows}")
+        else:
+            stages = mine[-1].get("stages") or {}
+            if mine[-1].get("slowest_stage") != "device":
+                fail(f"slow-delta breakdown not device-dominated: "
+                     f"{mine[-1]}")
+            print(f"chaos: slow-delta forensics OK on "
+                  f"{slow_replica} — device stage "
+                  f"{stages.get('device')}s of "
+                  f"{mine[-1].get('total_secs')}s total "
+                  f"(delta {mine[-1].get('delta_id')})")
+    except RETRY_ERRS as err:
+        fail(f"slow-delta probe could not reach {slow_replica}: "
+             f"{err}")
 
     # --- tenants, keys, streams
     quiet = ["chaos-q0", "chaos-q1"]
@@ -538,6 +596,38 @@ def main() -> int:
                 _scrape(fleet.ops_addr(n)))
         except OSError as err:
             fail(f"could not scrape {n}: {err}")
+
+    # --- the merged fleet trace: one delta, one chain, two replicas.
+    # The SIGSTOP victim admitted the rehomed key's deltas (its spans
+    # carry their delta_ids) and survives resumed; the adopter
+    # re-applied the same ids from the transferred WAL segments — the
+    # merged Perfetto file must show at least one id on BOTH process
+    # tracks (the readable-across-the-boundary acceptance).
+    from jepsen_tpu.obs import trace_merge as tmerge
+    tdocs, tnames = [], []
+    for n in sorted(scrape_set):
+        try:
+            tdocs.append(tmerge.fetch_trace(fleet.ops_addr(n)))
+            tnames.append(n)
+        except (OSError, ValueError) as err:
+            fail(f"could not fetch /trace from {n}: {err}")
+    if tdocs:
+        merged = tmerge.merge_traces(tdocs, tnames)
+        terrs = tmerge.validate_trace(merged)
+        if terrs:
+            fail(f"merged fleet trace failed its schema: "
+                 f"{terrs[:3]}")
+        mpath = os.path.join(root, "fleet_trace.json")
+        with open(mpath, "w") as fh:
+            json.dump(merged, fh)
+        cross = tmerge.cross_replica_ids(merged)
+        if fence_engaged and not cross:
+            fail("merged fleet trace shows no cross-replica delta "
+                 "chain for the rehomed key")
+        else:
+            print(f"chaos: merged fleet trace ({len(tnames)} "
+                  f"replicas) -> {mpath}: {len(cross)} "
+                  f"cross-replica chain(s)")
 
     def total(metric, tenant=None):
         key = (obs.labeled(metric, tenant=tenant) if tenant

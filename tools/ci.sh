@@ -16,7 +16,12 @@
 #      text with the serve SLO histograms, /status listing both
 #      keys (docs/streaming.md + docs/observability.md, smoke scale),
 #      plus the two-tenant HTTP-ingress fairness wiring (flood shed
-#      with tenant attribution, quiet tenant fully acked)
+#      with tenant attribution, quiet tenant fully acked); runs with
+#      JEPSEN_TPU_TRACE armed so the next stage can schema-validate
+#      the delta-tagged span export
+#   1c'. trace-schema validator — `jepsen trace --validate` over the
+#      smoke's Chrome-trace export (phase codes, pid/tid, span ids,
+#      parent resolution — the docs/observability.md export contract)
 #   1d. multi-tenant soak smoke — tools/soak.py --smoke (~10 s):
 #      sustained multi-tenant load over the HTTP ingress with
 #      JEPSEN_TPU_FAULTS armed mid-run (wedge/crash/flaky/slow);
@@ -29,7 +34,11 @@
 #      resumed replica must answer the epoch-fence refusal);
 #      asserts zero verdict flips, zero lost keys, fence engaged,
 #      quiet-tenant SLOs from the parsed /metrics scrape
-#      (docs/streaming.md "Fleet self-healing")
+#      (docs/streaming.md "Fleet self-healing"); also arms
+#      JEPSEN_TPU_TRACE + JEPSEN_TPU_SLOW_DELTA_SECS fleet-wide and
+#      asserts a device-dominated slow-delta record on the slow@
+#      replica and a cross-replica delta chain in the merged fleet
+#      trace (docs/observability.md "End-to-end delta tracing")
 #   2. tier-1 tests     — the ROADMAP.md invocation verbatim: the
 #      full suite minus the slow tier on a virtual 8-device CPU mesh,
 #      under the documented 870s budget (timeout -k 10 870). The
@@ -48,7 +57,17 @@ echo "== fault-injection smoke =="
 env JAX_PLATFORMS=cpu python tools/fault_smoke.py || exit 1
 
 echo "== streaming-checker smoke =="
-env JAX_PLATFORMS=cpu python tools/serve_smoke.py || exit 1
+# a mktemp path, not a fixed /tmp name: concurrent CI runs on one box
+# must not clobber each other's export (or follow a pre-planted
+# symlink at a predictable name)
+SMOKE_TRACE="$(mktemp -t jepsen_smoke_trace.XXXXXX.json)" || exit 2
+trap 'rm -f "$SMOKE_TRACE"' EXIT
+env JAX_PLATFORMS=cpu JEPSEN_TPU_TRACE="$SMOKE_TRACE" \
+    python tools/serve_smoke.py || exit 1
+
+echo "== trace-schema validator (serve_smoke export) =="
+env JAX_PLATFORMS=cpu python -m jepsen_tpu.obs.trace_merge \
+    --validate "$SMOKE_TRACE" || exit 1
 
 echo "== multi-tenant soak smoke =="
 env JAX_PLATFORMS=cpu python tools/soak.py --smoke || exit 1

@@ -244,6 +244,23 @@ def main() -> int:
             print(f"serve-smoke: {k} final seq {finals[k]['seq']} != 3 "
                   f"— an admitted delta went missing")
             failures += 1
+    # with JEPSEN_TPU_TRACE=<path> (tools/ci.sh arms it), export the
+    # smoke's span chain there — the trace-schema validator
+    # (`python -m jepsen_tpu.obs.trace_merge --validate`) runs over
+    # this file as the next CI stage
+    from jepsen_tpu import obs
+    tr = obs.tracer()
+    if obs.enabled() and tr.path:
+        out = obs.write_chrome_trace(tr.path)
+        n_tagged = sum(1 for s in tr.spans()
+                       if s.args.get("delta_id")
+                       or s.args.get("delta_ids"))
+        if not n_tagged:
+            print("serve-smoke: traced run produced no "
+                  "delta_id-tagged spans")
+            failures += 1
+        print(f"serve-smoke: trace exported to {out} "
+              f"({n_tagged} delta-tagged spans)")
     if failures:
         print(f"serve-smoke: {failures} failure(s)")
         return 1
