@@ -172,6 +172,93 @@ def emit_search_stats(section: str, results, extra=None):
     emit(line)
 
 
+def emit_steal_advisory(section: str):
+    """The flag-gated elastic-scheduling advisory line: emitted ONLY
+    under JEPSEN_TPU_STEAL=1, so the default bench schema is
+    byte-identical (gating pinned in test_bench.py). Runs the
+    recorded forced-skew shape (parallel.elastic.forced_skew_histories
+    — heavy ladder-climbing keys statically pinned onto the first
+    devices) through the SAME round executor with stealing off then
+    on, and reports the wall-clock win plus the per-device busy/idle
+    accounting both arms observed — the chip-evidence row the
+    JEPSEN_TPU_STEAL flag flip needs."""
+    if not envflags.env_bool("JEPSEN_TPU_STEAL", default=False):
+        return
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    from jepsen_tpu.parallel import elastic, encode as enc_mod
+    model, hs = elastic.forced_skew_histories()
+    pre = [enc_mod.encode(model, h) for h in hs]
+    mesh = Mesh(np.array(jax.devices()), ("key",))
+    with obs.timer("bench.steal_ab", keys=len(pre)):
+        ab = elastic.steal_ab(model, pre, mesh)
+    b_steal = ab["steal"][0]
+    b_static = ab["static"][0]
+    emit({"metric": f"{section} elastic steal A/B (advisory, "
+                    f"JEPSEN_TPU_STEAL)",
+          "value": ab["steal_speedup"], "unit": "x speedup",
+          "static_secs": ab["static_secs"],
+          "steal_secs": ab["steal_secs"],
+          "verdicts_identical": ab["verdicts_identical"],
+          "keys": len(pre), "rounds": b_steal.get("rounds"),
+          "keys_stolen": b_steal.get("steals"),
+          "busy_frac_static": b_static.get("busy_frac"),
+          "busy_frac_steal": b_steal.get("busy_frac"),
+          "per_device_busy_static": b_static.get("per_device_busy"),
+          "per_device_busy_steal": b_steal.get("per_device_busy"),
+          "note": "forced-skew shape: heavy capacity-ladder keys "
+                  "pinned on the first devices by the static "
+                  "placement; stealing migrates the pending backlog "
+                  "wide (docs/performance.md 'Elastic scheduling'); "
+                  "absent without the flag — default schema "
+                  "unchanged"})
+
+
+def emit_reshard_advisory(e, mesh, cap0: int, max_cap: int,
+                          static_r: dict, static_secs: float):
+    """The flag-gated re-shard ladder advisory (JEPSEN_TPU_RESHARD=1
+    only — default schema byte-identical, pinned in test_bench.py):
+    the sharded section's shape re-run through
+    check_encoded_sharded_elastic, which answers capacity overflow by
+    recruiting devices at flat per-device capacity instead of growing
+    tables. Reports the rung trail plus per-device skew evidence from
+    the static run's stats block when JEPSEN_TPU_SEARCH_STATS is also
+    armed."""
+    if not envflags.env_bool("JEPSEN_TPU_RESHARD", default=False):
+        return
+    from jepsen_tpu.parallel import sharded
+    sharded.check_encoded_sharded_elastic(e, mesh, capacity=cap0,
+                                          max_capacity=max_cap)  # warm
+    with obs.timer("bench.sharded.reshard") as tm:
+        r = sharded.check_encoded_sharded_elastic(
+            e, mesh, capacity=cap0, max_capacity=max_cap)
+    assert r["valid?"] == static_r["valid?"], (r, static_r)
+    st = static_r.get("stats") or {}
+    pd = (st.get("per-device") or {}).get("load-factor-peak")
+    skew = None
+    if pd and any(v is not None for v in pd):
+        vals = [v for v in pd if v is not None]
+        mean = sum(vals) / len(vals)
+        skew = round(max(vals) / mean, 4) if mean else None
+    emit({"metric": "sharded re-shard ladder (advisory, "
+                    "JEPSEN_TPU_RESHARD)",
+          "value": round(tm.wall, 3), "unit": "secs",
+          "static_secs": round(static_secs, 3),
+          "reshard_speedup": round(static_secs / max(tm.wall, 1e-9),
+                                   2),
+          "devices_final": r.get("devices"),
+          "capacity_final": r.get("capacity"),
+          "reshard_events": (r.get("reshard") or {}).get("events"),
+          "per_device_load_factor_static": pd,
+          "device_skew_static": skew,
+          "verdict_match": r["valid?"] == static_r["valid?"],
+          "note": "escalation recruits devices at flat per-device "
+                  "capacity (1-D -> wider 1-D -> 2-D promotion) "
+                  "before growing tables; absent without the flag — "
+                  "default schema unchanged"})
+
+
 def _enable_compile_cache():
     """Persistent compilation cache: lets a child reuse a sibling's
     compile for the same shape (e.g. maxlen re-probing the 10k shape).
@@ -352,6 +439,7 @@ def sec_multikey(label: str = None):
                   "second pass over the same histories, zero "
                   "re-encodes; buckets carry the per-bucket "
                   "encode/transfer/device split"})
+    emit_steal_advisory(f"multi-key {N_KEYS}x{OPS_PER_KEY}-op")
 
 
 def sec_adv(L: int, host_deadline: float, skip_host: bool,
@@ -532,18 +620,24 @@ def sec_sharded(L: int, host_est: float | None,
     else:
         cap0, max_cap = ((1 << 12) if SMOKE else (1 << 17)), 1 << 20
     t0 = perf_counter()
+    # reshard pinned OFF: the section's main line measures the static
+    # engine even when JEPSEN_TPU_RESHARD=1 arms the advisory below —
+    # the A/B needs a static arm to compare against
     r = sharded.check_encoded_sharded(e, mesh, capacity=cap0,
-                                      max_capacity=max_cap)
+                                      max_capacity=max_cap,
+                                      reshard=False)
     warm = perf_counter() - t0
     cap = r.get("capacity", cap0)
     if cap != cap0:
         # capacity grew during the warm run: compile the final tier
         # before measuring, so the steady number holds no compile
         sharded.check_encoded_sharded(e, mesh, capacity=cap,
-                                      max_capacity=max_cap)
+                                      max_capacity=max_cap,
+                                      reshard=False)
     with obs.timer("bench.sharded.steady", L=L, capacity=cap) as tm:
         r = sharded.check_encoded_sharded(e, mesh, capacity=cap,
-                                          max_capacity=max_cap)
+                                          max_capacity=max_cap,
+                                          reshard=False)
     dev_secs = tm.wall
     line = {"metric": f"adversarial {L}-op via frontier-sharded engine",
             "value": round(L / dev_secs, 1), "unit": "ops/sec",
@@ -571,6 +665,7 @@ def sec_sharded(L: int, host_est: float | None,
         line["capacity_grew_to"] = cap
     emit(line)
     emit_search_stats(f"sharded {L}-op", r, {"L": L})
+    emit_reshard_advisory(e, mesh, cap0, max_cap, r, dev_secs)
 
 
 MAXLEN_RUN_BUDGET = 5 if SMOKE else 60   # the metric's "@ 60s" budget

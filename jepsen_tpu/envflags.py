@@ -232,6 +232,49 @@ def env_path(name: str, what: str = "path") -> Optional[str]:
 #                            check_batch through the pipelined
 #                            executor (parallel.pipeline); opt-in
 #                            until bench records a win
+#   JEPSEN_TPU_STEAL         env_bool    parallel.engine — skew-driven
+#                            key work-stealing in the multi-key
+#                            executors (parallel.elastic): buckets
+#                            dispatch in device-aligned rounds and a
+#                            scheduler migrates pending keys between
+#                            per-device queues from observed
+#                            search-stats/cost signals; results
+#                            bit-identical to the static placement
+#                            (parity-pinned); opt-in until
+#                            tools/perf_ab.py's steal arm records the
+#                            win
+#   JEPSEN_TPU_STEAL_ROUND   env_int     parallel.elastic — keys per
+#                            device per dispatch round of the stealing
+#                            executor (default 1, min 1): smaller =
+#                            more observation/rebalance points, larger
+#                            = fewer, bigger device programs
+#   JEPSEN_TPU_RESHARD       env_bool    parallel.engine/sharded —
+#                            re-shard-on-escalation: a sharded search
+#                            (incl. the batch overflow escalation
+#                            tier) starts on a narrow device slice and
+#                            capacity overflow RECRUITS devices along
+#                            MeshPlan.ladder's rungs (wider 1-D, then
+#                            2-D slice promotion) at flat per-device
+#                            capacity before growing tables; overflow
+#                            semantics and ceilings unchanged; opt-in
+#                            until the perf_ab reshard arm records the
+#                            win
+#   JEPSEN_TPU_DIST          env_bool    parallel.meshplan — arm the
+#                            jax.distributed multi-host handshake
+#                            (meshplan.distributed_init): off/unset =
+#                            single-host, no initialize call ever;
+#                            "1" REQUIRES the three companion flags
+#                            below (a half-configured pod plan raises
+#                            at the read site instead of hanging in a
+#                            collective)
+#   JEPSEN_TPU_DIST_COORD    env_raw     parallel.meshplan — the
+#                            jax.distributed coordinator address
+#                            (host:port), validated for the colon
+#   JEPSEN_TPU_DIST_NPROC    env_int     parallel.meshplan — total
+#                            process count of the distributed run
+#                            (min 1)
+#   JEPSEN_TPU_DIST_PROC     env_int     parallel.meshplan — this
+#                            process's id (0-based, < NPROC)
 #   JEPSEN_TPU_ENCODE_CACHE  env_int     parallel.pipeline — encode
 #                            cache capacity in entries (0 disables)
 #   JEPSEN_TPU_TEST_WEDGE    env_bool    resilience.faults — legacy

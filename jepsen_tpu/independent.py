@@ -302,12 +302,21 @@ class IndependentChecker(Checker):
     def __init__(self, checker: Checker, batch_device: bool = True,
                  pipeline: Optional[bool] = None,
                  dedupe: Optional[str] = None,
-                 search_stats: Optional[bool] = None):
+                 search_stats: Optional[bool] = None,
+                 steal: Optional[bool] = None,
+                 reshard: Optional[bool] = None):
         self.checker = checker
         self.batch_device = batch_device
         self.pipeline = pipeline
         self.dedupe = dedupe
         self.search_stats = search_stats
+        # elastic scheduling knobs (None = the JEPSEN_TPU_STEAL /
+        # JEPSEN_TPU_RESHARD flags): skew-driven key work-stealing in
+        # the batched dispatch, device-recruiting escalation for
+        # overflow keys — results identical either way
+        # (docs/performance.md "Elastic scheduling")
+        self.steal = steal
+        self.reshard = reshard
 
     def check(self, test, history, opts=None):
         opts = opts or {}
@@ -430,7 +439,9 @@ class IndependentChecker(Checker):
                 rs = engine.check_batch(model, [subs[k] for k in ks],
                                         mesh=mesh, pipeline=self.pipeline,
                                         dedupe=self.dedupe,
-                                        search_stats=self.search_stats)
+                                        search_stats=self.search_stats,
+                                        steal=self.steal,
+                                        reshard=self.reshard)
             return {k: {**r, "analyzer": "jax"} for k, r in zip(ks, rs)}, None
         except EncodeError as err:
             # legitimately not device-encodable (a gset key past the
@@ -497,6 +508,9 @@ def _edn_pprint(x) -> str:
 def checker(c: Checker, batch_device: bool = True,
             pipeline: Optional[bool] = None,
             dedupe: Optional[str] = None,
-            search_stats: Optional[bool] = None) -> IndependentChecker:
+            search_stats: Optional[bool] = None,
+            steal: Optional[bool] = None,
+            reshard: Optional[bool] = None) -> IndependentChecker:
     return IndependentChecker(c, batch_device, pipeline=pipeline,
-                              dedupe=dedupe, search_stats=search_stats)
+                              dedupe=dedupe, search_stats=search_stats,
+                              steal=steal, reshard=reshard)

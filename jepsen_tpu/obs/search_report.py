@@ -72,6 +72,24 @@ def _key_of(r: dict) -> str:
     return "-" if k is None else str(k)
 
 
+def device_skew(r: dict):
+    """max/mean of the per-device load-factor peaks across the mesh
+    (sharded records; falls back to the per-device frontier-width
+    peaks) — 1.0 means perfectly balanced, higher means part of the
+    mesh idles while one device's table runs hot: stealable skew the
+    elastic scheduler (JEPSEN_TPU_STEAL) attacks. None for
+    single-device records."""
+    pd = r.get("per-device") or {}
+    vals = pd.get("load-factor-peak") or pd.get("width-peak")
+    if not vals or len(vals) < 2:
+        return None
+    vals = [float(v) for v in vals if v is not None]
+    if not vals:
+        return None
+    mean = sum(vals) / len(vals)
+    return round(max(vals) / mean, 4) if mean else None
+
+
 def _worst_table(rows: List[dict], field: str, title: str,
                  limit: int = 10) -> List[str]:
     ranked = [r for r in rows if r.get(field) is not None
@@ -81,19 +99,22 @@ def _worst_table(rows: List[dict], field: str, title: str,
         return []
     lines = [f"## {title}", ""]
     lines.append(f"{'key':<20} {'engine':<9} {'events':>7} "
-                 f"{'peak':>8} {field:>18}")
+                 f"{'peak':>8} {'dev-skew':>9} {field:>18}")
     for r in ranked[:limit]:
         lines.append(
             f"{_key_of(r)[:20]:<20} {str(r.get('engine', '-')):<9} "
             f"{_fmt(r.get('events')):>7} "
             f"{_fmt(r.get('frontier-peak')):>8} "
+            f"{_fmt(r.get('device-skew')):>9} "
             f"{_fmt(r.get(field)):>18}")
     lines.append("")
     return lines
 
 
 def render_search_report(records: List[dict]) -> str:
-    rows = dedupe_records(records)
+    rows = [dict(r) for r in dedupe_records(records)]
+    for r in rows:
+        r["device-skew"] = device_skew(r)
     lines = ["# Search telemetry report (JEPSEN_TPU_SEARCH_STATS)", ""]
     n_events = sum(r.get("events") or 0 for r in rows)
     engines = {}
@@ -125,6 +146,9 @@ def render_search_report(records: List[dict]) -> str:
                               "Worst keys by capacity escalations"))
     lines.extend(_worst_table(rows, "pad-waste",
                               "Worst keys by pad-row waste"))
+    lines.extend(_worst_table(rows, "device-skew",
+                              "Worst keys by per-device skew "
+                              "(stealable imbalance)"))
     if len(lines) == 5 and not agg:   # header only: nothing ranked
         lines.append("(no key exceeded any threshold — no hash load, "
                      "no escalations, no pad waste)")

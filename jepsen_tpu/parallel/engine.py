@@ -2279,7 +2279,10 @@ def check_batch(model, histories, capacity: int = 512,
                 dedupe: Optional[str] = None,
                 sparse_pallas: Optional[bool] = None,
                 search_stats: Optional[bool] = None,
-                config_pack: Optional[bool] = None) -> list:
+                config_pack: Optional[bool] = None,
+                steal: Optional[bool] = None,
+                reshard: Optional[bool] = None,
+                steal_stats: Optional[dict] = None) -> list:
     """Check many per-key histories in one device program per
     slot-window bucket: vmap over the key axis; with a mesh (and K
     divisible by its size) the key axis is sharded across devices —
@@ -2318,7 +2321,19 @@ def check_batch(model, histories, capacity: int = 512,
 
     `sparse_pallas` routes the sparse buckets' hash closure through the
     fused VMEM frontier kernel (check_encoded's docstring; None = the
-    JEPSEN_TPU_SPARSE_PALLAS flag)."""
+    JEPSEN_TPU_SPARSE_PALLAS flag).
+
+    `steal` (None = JEPSEN_TPU_STEAL) routes the batch through the
+    elastic round-based executor (parallel.elastic): keys dispatch in
+    device-aligned rounds and a skew-aware placement loop migrates
+    pending keys between per-device queues from the observed
+    search-stats/cost signal of completed rounds — results
+    bit-identical to the static path (verdict, op/fail-event,
+    max-frontier, capacity, configs-stepped; docs/performance.md
+    "Elastic scheduling"). `steal_stats`, when a dict, receives the
+    scheduler's per-device cost/steal accounting. `reshard` (None =
+    JEPSEN_TPU_RESHARD) makes capacity escalation recruit mesh devices
+    (sharded elastic ladder) instead of only growing tables."""
     bucket = _resolve_bucket(bucket)   # fail-fast: before the encode
     dedupe = _resolve_dedupe(dedupe)   # likewise
     if _resolve_pipeline(pipeline):
@@ -2328,7 +2343,27 @@ def check_batch(model, histories, capacity: int = 512,
             max_capacity=max_capacity, mesh=mesh, bucket=bucket,
             cache=cache, stats=pipeline_stats, dedupe=dedupe,
             sparse_pallas=sparse_pallas, search_stats=search_stats,
-            config_pack=config_pack)
+            config_pack=config_pack, steal=steal, reshard=reshard,
+            steal_stats=steal_stats)
+    if _resolve_steal(steal):
+        from jepsen_tpu.parallel import elastic
+        with obs.span("engine.check_batch", keys=len(histories),
+                      bucket=bucket), obs.maybe_jax_profile():
+            with obs.span("engine.encode_batch", keys=len(histories)):
+                pre = [enc_mod.encode(model, h) for h in histories]
+            return elastic.check_batch_stealing(
+                model, pre, capacity=capacity,
+                max_capacity=max_capacity, mesh=mesh, bucket=bucket,
+                dedupe=dedupe, sparse_pallas=sparse_pallas,
+                search_stats=search_stats, config_pack=config_pack,
+                reshard=reshard, stats=steal_stats)
+    if steal_stats is not None:
+        # same loud contract as cache/pipeline_stats below: the static
+        # path runs no scheduler and would silently leave the dict
+        # empty while the caller believes stealing was measured
+        raise ValueError(
+            "check_batch: steal_stats is an elastic-executor argument "
+            "— pass steal=True (or set JEPSEN_TPU_STEAL=1) to use it")
     if (cache is not None and cache is not False) \
             or pipeline_stats is not None:
         # the serial path consults no cache and fills no stats —
@@ -2350,7 +2385,8 @@ def check_batch(model, histories, capacity: int = 512,
                                    bucket=bucket, dedupe=dedupe,
                                    sparse_pallas=sparse_pallas,
                                    search_stats=search_stats,
-                                   config_pack=config_pack)
+                                   config_pack=config_pack,
+                                   reshard=reshard)
 
 
 def _resolve_bucket(bucket: Optional[str]) -> str:
@@ -2371,6 +2407,26 @@ def _resolve_pipeline(pipeline: Optional[bool]) -> bool:
         pipeline = envflags.env_bool("JEPSEN_TPU_PIPELINE",
                                      default=False)
     return bool(pipeline)
+
+
+def _resolve_steal(steal: Optional[bool]) -> bool:
+    """JEPSEN_TPU_STEAL: skew-driven key work-stealing in the
+    multi-key executors (parallel.elastic). Opt-in until the recorded
+    A/B (tools/perf_ab.py steal arm) flips it — flags do not get to
+    claim speedups."""
+    if steal is None:
+        steal = envflags.env_bool("JEPSEN_TPU_STEAL", default=False)
+    return bool(steal)
+
+
+def _resolve_reshard(reshard: Optional[bool]) -> bool:
+    """JEPSEN_TPU_RESHARD: capacity escalation recruits devices
+    (parallel.sharded.check_encoded_sharded_elastic) instead of only
+    growing per-device tables. Opt-in, same contract as STEAL."""
+    if reshard is None:
+        reshard = envflags.env_bool("JEPSEN_TPU_RESHARD",
+                                    default=False)
+    return bool(reshard)
 
 
 def bucket_key(n_slots: int, bucket: str) -> int:
@@ -2394,7 +2450,8 @@ def check_batch_encoded(model, pre, capacity: int = 512,
                         dedupe: Optional[str] = None,
                         sparse_pallas: Optional[bool] = None,
                         search_stats: Optional[bool] = None,
-                        config_pack: Optional[bool] = None) -> list:
+                        config_pack: Optional[bool] = None,
+                        reshard: Optional[bool] = None) -> list:
     """check_batch on ALREADY-ENCODED keys (the bucketing + dispatch
     half without the encode half). Public so callers that time or
     cache the encode separately — bench.sec_multikey's encode/device
@@ -2438,7 +2495,8 @@ def check_batch_encoded(model, pre, capacity: int = 512,
                                      mesh, dedupe=dedupe,
                                      sparse_pallas=sparse_pallas,
                                      search_stats=search_stats,
-                                     config_pack=config_pack)
+                                     config_pack=config_pack,
+                                     reshard=reshard)
         for i, r in zip(idxs, rs):
             out[i] = r
     return out
@@ -2449,7 +2507,8 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
                         probe_limit: int = 0,
                         sparse_pallas: Optional[bool] = None,
                         search_stats: Optional[bool] = None,
-                        config_pack: Optional[bool] = None) -> list:
+                        config_pack: Optional[bool] = None,
+                        reshard: Optional[bool] = None) -> list:
     """Sparse-frontier batch path with per-key capacity-tier retry."""
     step_name = pre[0].step_name
     K = len(pre)
@@ -2551,7 +2610,8 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
                                             dedupe=dedupe,
                                             sparse_pallas=sparse_pallas,
                                             search_stats=ss,
-                                            config_pack=pack_req)
+                                            config_pack=pack_req,
+                                            reshard=reshard)
             break
         # keys that overflowed re-dispatch at the doubled tier — the
         # counter the capacity-retry ladder's cost is visible through
@@ -2566,7 +2626,8 @@ def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh,
                        dedupe: str = "sort",
                        sparse_pallas: Optional[bool] = None,
                        search_stats: Optional[bool] = None,
-                       config_pack: Optional[bool] = None) -> dict:
+                       config_pack: Optional[bool] = None,
+                       reshard: Optional[bool] = None) -> dict:
     """A key too wide for the batch program escalates instead of dying
     as "unknown": first the single-key sparse engine at 4x the batch
     ceiling, then — with a mesh — the frontier-sharded engine, whose
@@ -2577,7 +2638,14 @@ def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh,
     x4 on one device, a further xD across the mesh — so a tight bound
     set for latency/memory reasons stays meaningful. Reports which
     tier decided via "escalated". The first batch run already proved
-    batch_cap overflows, so every tier starts at 2x."""
+    batch_cap overflows, so every tier starts at 2x.
+
+    Under `reshard` (None = JEPSEN_TPU_RESHARD) the sharded tier runs
+    the elastic device ladder (sharded.check_encoded_sharded_elastic
+    via check_encoded_sharded's delegation): the retry recruits a
+    widening slice of the mesh at flat per-device capacity — idle
+    devices, not bigger tables, absorb the overflow — with the same
+    ceilings and the same overflow->unknown semantics."""
     obs.counter("engine.capacity_escalations").inc()
     ceil_single = min(batch_cap * 4, 1 << 21)
     # pin the single tier to the caller's mesh: check_encoded on the
@@ -2616,7 +2684,7 @@ def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh,
                 max_capacity=ceil_sharded, dedupe=dedupe,
                 sparse_pallas=sparse_pallas,
                 search_stats=search_stats,
-                config_pack=config_pack)
+                config_pack=config_pack, reshard=reshard)
             if rs["valid?"] != "unknown":
                 rs["escalated"] = "sharded"
                 return rs

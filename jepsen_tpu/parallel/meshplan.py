@@ -1,0 +1,254 @@
+"""Mesh planning: one place that decides how a device set becomes a
+sharded-engine topology.
+
+Before this module, ``sharded.py`` made the topology call inline at
+every entry point (2-D device array + the owner-routed exchange =
+hierarchical DCN-aware routing; everything else flattens onto one
+axis), and the mesh stopped at whatever ``jax.devices()`` returned —
+a single host. :class:`MeshPlan` factors those decisions behind one
+object so three consumers share them:
+
+  * ``sharded.check_encoded_sharded`` (+ the resumable and elastic
+    arms) — the flatten-vs-hierarchical decision, byte-identical to
+    the historical inline logic;
+  * the elastic re-shard ladder (``JEPSEN_TPU_RESHARD``) — the
+    :meth:`ladder` rungs name which device slice each escalation
+    recruits (wider 1-D within a slice first, then whole extra
+    slices via the hierarchical exchange);
+  * the multi-host seam — :meth:`host_slices` / :meth:`key_partition`
+    describe how a pod-scale run splits devices and ``independent``
+    keys across processes, and :func:`distributed_init` wires
+    ``jax.distributed`` behind strict ``JEPSEN_TPU_DIST*`` flags so
+    the DCN path has a tested seam (the two-process localhost CPU
+    smoke in tests/test_meshplan.py) before a pod exists.
+
+Import-safe: importing this module must not touch a JAX backend.
+``jax.distributed.initialize`` runs only inside :func:`distributed_init`
+and only when ``JEPSEN_TPU_DIST=1``.
+"""
+
+from __future__ import annotations
+
+import logging
+import zlib
+from typing import Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from jepsen_tpu import envflags
+
+_log = logging.getLogger(__name__)
+
+# the axis names the sharded engine's shard_map regions use; sharded.py
+# re-exports them so existing imports keep working
+AXIS = "frontier"
+AX_SLICE, AX_CHIP = "slice", "chip"
+
+
+class MeshPlan:
+    """A device set plus the topology decision the sharded engine will
+    run it with. ``devices`` is kept 2-D exactly when the plan is
+    hierarchical (axis 0 = slices / DCN, axis 1 = chips / ICI); every
+    other shape is flattened at construction, matching the historical
+    inline logic in ``check_encoded_sharded``."""
+
+    def __init__(self, devices, hierarchical: bool = False):
+        devices = np.asarray(devices)
+        if hierarchical:
+            if devices.ndim != 2 or devices.shape[0] < 2 \
+                    or devices.shape[1] < 2:
+                raise ValueError(
+                    "hierarchical MeshPlan needs a 2-D device array "
+                    "with both dims > 1")
+        else:
+            devices = devices.reshape(-1)
+        self.devices = devices
+        self.hierarchical = bool(hierarchical)
+
+    # -- construction -------------------------------------------------
+
+    @classmethod
+    def from_mesh(cls, mesh, exchange: str = "route") -> "MeshPlan":
+        """The topology decision ``check_encoded_sharded`` historically
+        made inline: a 2-D device array (both dims > 1) under the
+        owner-routed exchange goes hierarchical; anything else — and
+        always the all-gather A/B path — flattens."""
+        devs = np.asarray(mesh.devices if isinstance(mesh, Mesh)
+                          else mesh)
+        hier = (exchange == "route" and devs.ndim == 2
+                and devs.shape[0] > 1 and devs.shape[1] > 1)
+        return cls(devs if hier else devs.reshape(-1), hier)
+
+    @classmethod
+    def auto(cls) -> "MeshPlan":
+        """The process-global plan: every device jax can see — after
+        the gated ``jax.distributed`` handshake, that is the whole
+        pod's device set, not just this host's."""
+        distributed_init()
+        return cls(np.array(jax.devices()))
+
+    # -- shape --------------------------------------------------------
+
+    @property
+    def n_dev(self) -> int:
+        return int(self.devices.size)
+
+    @property
+    def n_slice(self) -> int:
+        return int(self.devices.shape[0]) if self.hierarchical else 1
+
+    @property
+    def n_chip(self) -> int:
+        return int(self.devices.shape[1] if self.hierarchical
+                   else self.devices.size)
+
+    @property
+    def platform(self) -> str:
+        return self.devices.flat[0].platform
+
+    def mesh(self) -> Mesh:
+        if self.hierarchical:
+            return Mesh(self.devices, (AX_SLICE, AX_CHIP))
+        return Mesh(self.devices.reshape(-1), (AXIS,))
+
+    def __repr__(self) -> str:  # debugging/report aid
+        shape = (f"{self.n_slice}x{self.n_chip}" if self.hierarchical
+                 else str(self.n_dev))
+        return (f"MeshPlan({shape} {self.platform}"
+                f"{', hierarchical' if self.hierarchical else ''})")
+
+    # -- multi-host seam ----------------------------------------------
+
+    def host_slices(self) -> dict:
+        """``{process_index: [device, ...]}`` — the per-host slice of
+        the global device set. On a single host this is one entry;
+        after ``distributed_init`` it is the pod layout the DCN path
+        schedules over."""
+        out: dict = {}
+        for d in self.devices.flat:
+            out.setdefault(int(getattr(d, "process_index", 0)),
+                           []).append(d)
+        return out
+
+    def local_devices(self) -> list:
+        """This process's slice of :meth:`host_slices`."""
+        try:
+            me = jax.process_index()
+        except Exception:  # noqa: BLE001 — no backend yet: host 0
+            me = 0
+        return self.host_slices().get(int(me), [])
+
+    @property
+    def n_processes(self) -> int:
+        return len(self.host_slices())
+
+    @staticmethod
+    def key_home(key, n_parts: int) -> int:
+        """Deterministic key -> partition assignment (stable across
+        processes and runs: crc32 of the key's repr — the same
+        content-keyed posture as the encode cache)."""
+        return zlib.crc32(repr(key).encode()) % max(1, int(n_parts))
+
+    def key_partition(self, keys, n_parts: Optional[int] = None) -> dict:
+        """Partition ``independent`` keys across hosts: every process
+        computes the same ``{part: [key, ...]}`` map from the same key
+        list, so a pod run needs no coordinator round to agree who
+        checks what (jepsen.independent keys are independent — the
+        partition is pure bucketing)."""
+        n = self.n_processes if n_parts is None else int(n_parts)
+        out = {p: [] for p in range(max(1, n))}
+        for k in keys:
+            out[self.key_home(k, max(1, n))].append(k)
+        return out
+
+    # -- the elastic re-shard ladder ----------------------------------
+
+    def slice_plan(self, n: int) -> "MeshPlan":
+        """A flat plan over the first ``n`` devices (row-major) — the
+        1-D rungs of the re-shard ladder."""
+        n = max(1, min(int(n), self.n_dev))
+        return MeshPlan(self.devices.reshape(-1)[:n])
+
+    def promoted(self, n_slice: int) -> "MeshPlan":
+        """A hierarchical plan over the first ``n_slice`` slices of a
+        2-D device array — the 2-D rungs of the ladder."""
+        if not self.hierarchical:
+            raise ValueError("promoted() needs a hierarchical plan")
+        n_slice = max(2, min(int(n_slice), self.n_slice))
+        return MeshPlan(self.devices[:n_slice, :], hierarchical=True)
+
+    def ladder(self, start_devices: int = 1) -> list:
+        """The re-shard escalation rungs, narrowest first: widen 1-D
+        within the first slice (ICI) by doubling, then — when the plan
+        is hierarchical — recruit whole extra slices via the DCN-aware
+        2-D exchange (1-D -> wider 1-D, or promote to 2-D). The last
+        rung is always the full plan; capacity growth past it falls
+        back to table growth (the historical ladder)."""
+        rungs = []
+        n = max(1, min(int(start_devices), self.n_chip))
+        while n < self.n_chip:
+            rungs.append(self.slice_plan(n))
+            n *= 2
+        if self.hierarchical:
+            rungs.append(self.slice_plan(self.n_chip))
+            s = 2
+            while s < self.n_slice:
+                rungs.append(self.promoted(s))
+                s *= 2
+            rungs.append(self)
+        else:
+            rungs.append(self.slice_plan(self.n_chip))
+        return rungs
+
+
+# ---------------------------------------------------------------- DCN
+
+
+_initialized = False
+
+
+def distributed_enabled() -> bool:
+    return bool(envflags.env_bool("JEPSEN_TPU_DIST", default=False))
+
+
+def distributed_init() -> bool:
+    """The gated ``jax.distributed`` handshake: a no-op (False) unless
+    ``JEPSEN_TPU_DIST=1``; with the flag set, the three companion
+    flags are REQUIRED and strictly validated — a half-configured pod
+    plan must fail at the read site, not hang in a collective.
+    Idempotent: the second call in a process is a no-op (True)."""
+    global _initialized
+    if not distributed_enabled():
+        return False
+    if _initialized:
+        return True
+    coord = envflags.env_raw("JEPSEN_TPU_DIST_COORD")
+    nproc = envflags.env_int("JEPSEN_TPU_DIST_NPROC", min_value=1,
+                             what="process count")
+    proc = envflags.env_int("JEPSEN_TPU_DIST_PROC", min_value=0,
+                            what="process id")
+    missing = [n for n, v in (("JEPSEN_TPU_DIST_COORD", coord),
+                              ("JEPSEN_TPU_DIST_NPROC", nproc),
+                              ("JEPSEN_TPU_DIST_PROC", proc))
+               if v is None]
+    if missing:
+        raise envflags.EnvFlagError(
+            f"JEPSEN_TPU_DIST=1 needs {', '.join(missing)} set — a "
+            f"half-configured distributed plan must not fall back to "
+            f"a silent single-host run")
+    if ":" not in coord or not coord.strip():
+        raise envflags.EnvFlagError(
+            f"JEPSEN_TPU_DIST_COORD={coord!r}: must be host:port")
+    if proc >= nproc:
+        raise envflags.EnvFlagError(
+            f"JEPSEN_TPU_DIST_PROC={proc} out of range for "
+            f"JEPSEN_TPU_DIST_NPROC={nproc}")
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=nproc, process_id=proc)
+    _initialized = True
+    _log.info("jax.distributed initialized: process %d/%d via %s",
+              proc, nproc, coord)
+    return True

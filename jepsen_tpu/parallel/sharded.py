@@ -57,6 +57,7 @@ from jepsen_tpu.parallel.engine import (N_PROBE_BUCKETS, _empty_table,
                                         _rep, _resolve_config_pack,
                                         _resolve_dedupe,
                                         _resolve_probe_limit,
+                                        _resolve_reshard,
                                         _resolve_search_stats,
                                         _rows_concat, _rows_prev_same,
                                         _rows_take, _rows_where,
@@ -65,11 +66,11 @@ from jepsen_tpu.parallel.engine import (N_PROBE_BUCKETS, _empty_table,
                                         _xs_from_encoded, pack_lanes,
                                         pack_rows_np, pack_spec_for)
 from jepsen_tpu.parallel.steps import STEPS
+from jepsen_tpu.parallel.meshplan import (AXIS, AX_CHIP, AX_SLICE,
+                                          MeshPlan)
 from jepsen_tpu.resilience import supervisor as sup
 
 _log = logging.getLogger(__name__)
-
-AXIS = "frontier"
 
 
 def _shard_map(f, mesh, in_specs, out_specs, check_vma=False):
@@ -471,9 +472,6 @@ def _sharded_impl(xs, state0, step_name: str, Nd: int, n_dev: int,
                          pack)
 
 
-AX_SLICE, AX_CHIP = "slice", "chip"
-
-
 def _sharded2d_impl(xs, state0, step_name: str, Nd: int,
                     n_slice: int, n_chip: int, dedupe: str = "sort",
                     probe_limit: int = 0, sparse_pallas: str = "off",
@@ -654,6 +652,279 @@ def _check_sharded_resume(xs, carry, step_name: str, Nd: int,
         check_vma=False,
     )
     return fn(xs, carry)
+
+
+def _sharded_resume2d_impl(xs, carry, step_name: str, Nd: int,
+                           n_slice: int, n_chip: int,
+                           dedupe: str = "sort", probe_limit: int = 0,
+                           sparse_pallas: str = "off",
+                           pack: tuple = ()):
+    """Resume-from-carry adapter for the HIERARCHICAL 2-D topology —
+    the 2-D twin of _sharded_resume_impl, built so the elastic ladder
+    can promote a mid-search frontier from a 1-D slice onto extra
+    slices (the DCN axis) without restarting the scan.
+
+    The restore route gets worst-case buckets at both stages (the
+    rows arrive laid out however the previous — possibly narrower,
+    possibly flat — topology left them): stage 1 may send all of a
+    device's Nd rows to one chip column, stage 2 all of the received
+    n_chip*Nd rows to one slice. It runs once per chunk, so the
+    O(N)-row receive buffer is the same posture as the 1-D restore."""
+    C = xs["slot_f"].shape[1]
+    rep = _rep(pack, C)
+    L = rep.lanes
+    D = n_slice * n_chip
+    my_idx = (lax.axis_index(AX_SLICE) * n_chip
+              + lax.axis_index(AX_CHIP)).astype(jnp.uint32)
+    B1c = max(64, -(-2 * Nd * C // n_chip))
+    B2c = max(64, -(-2 * n_chip * B1c // n_slice))
+    B1f = max(64, -(-2 * Nd // n_chip))
+    B2f = max(64, -(-2 * n_chip * B1f // n_slice))
+
+    def route2(rows, live, B1, B2):
+        owner = rep.owner_hash(rows) % jnp.uint32(D)
+        rows, live, o1 = _route_stage(
+            rows, live, owner % jnp.uint32(n_chip), n_chip, B1,
+            AX_CHIP)
+        owner = rep.owner_hash(rows) % jnp.uint32(D)
+        rows, live, o2 = _route_stage(
+            rows, live, owner // jnp.uint32(n_chip), n_slice, B2,
+            AX_SLICE)
+        return rows, live, o1 | o2
+
+    rows, rest = carry[:L], carry[L:]
+    live = rest[0]
+    r_rows, r_live, pre1 = _route_stage(
+        rows, live,
+        (rep.owner_hash(rows) % jnp.uint32(D)) % jnp.uint32(n_chip),
+        n_chip, Nd, AX_CHIP)
+    owner2 = rep.owner_hash(r_rows) % jnp.uint32(D)
+    r_rows, r_live, pre2 = _route_stage(
+        r_rows, r_live, owner2 // jnp.uint32(n_chip), n_slice,
+        n_chip * Nd, AX_SLICE)
+    rows2, live2, _, d_ovf = _owned_dedupe_compact(
+        r_rows, r_live, Nd, D, my_idx, rep)
+    pre_ovf = lax.psum((pre1 | pre2 | d_ovf).astype(jnp.int32),
+                       (AX_SLICE, AX_CHIP)) > 0
+
+    carry0 = rows2 + (live2,) + rest[1:]
+    carry, scan_ovf = _sharded_scan(
+        xs, carry0, step_name, Nd, D, my_idx, (AX_SLICE, AX_CHIP),
+        lambda r, lv: route2(r, lv, B1c, B2c),
+        lambda r, lv: route2(r, lv, B1f, B2f),
+        dedupe, probe_limit, sparse_pallas, pack=pack)
+    return carry, scan_ovf | pre_ovf
+
+
+# donation decision, DECIDED: same as _check_sharded_resume — the
+# carry tuple donates (rebuilt per chunk from the host checkpoint,
+# output aliases it), xs stays undonated (replicated event tables).
+@functools.partial(jax.jit,
+                   donate_argnames=("carry",),
+                   static_argnames=("step_name", "Nd", "n_slice",
+                                    "n_chip", "mesh", "dedupe",
+                                    "probe_limit", "sparse_pallas",
+                                    "pack"))
+def _check_sharded_resume2d(xs, carry, step_name: str, Nd: int,
+                            n_slice: int, n_chip: int, mesh: Mesh,
+                            dedupe: str = "sort",
+                            probe_limit: int = 0,
+                            sparse_pallas: str = "off",
+                            pack: tuple = ()):
+    L = pack_lanes(pack, xs["slot_f"].shape[1])
+    dev_axes = (AX_SLICE, AX_CHIP)
+    carry_specs = tuple([P(dev_axes)] * L) + (P(dev_axes),) \
+        + tuple([P()] * 5)
+    fn = _shard_map(
+        lambda x, c: _sharded_resume2d_impl(x, c, step_name, Nd,
+                                            n_slice, n_chip, dedupe,
+                                            probe_limit, sparse_pallas,
+                                            pack),
+        mesh=mesh,
+        in_specs=(P(), carry_specs),
+        out_specs=(carry_specs, P()),
+        check_vma=False,
+    )
+    return fn(xs, carry)
+
+
+def check_encoded_sharded_elastic(e: EncodedHistory, mesh: Mesh,
+                                  capacity: int = 8192,
+                                  max_capacity: int = 1 << 22,
+                                  start_devices: int = 0,
+                                  checkpoint_every: int = 256,
+                                  dedupe=None, probe_limit: int = 0,
+                                  sparse_pallas=None,
+                                  search_stats=None,
+                                  config_pack=None) -> dict:
+    """Re-shard-on-escalation (JEPSEN_TPU_RESHARD): the sharded search
+    with the elastic capacity ladder. Where check_encoded_sharded
+    answers every overflow by doubling per-device tables on a FIXED
+    device set, this arm starts on a narrow slice of the mesh
+    (``start_devices``, default 2) and each overflow first RECRUITS
+    devices along MeshPlan.ladder's rungs — wider 1-D within the first
+    slice, then whole extra slices via the hierarchical 2-D exchange —
+    holding per-device capacity flat, so escalation costs ICI/DCN
+    fan-out instead of per-device HBM. Only once the full mesh is
+    recruited does capacity growth fall back to the historical
+    table-doubling; ``max_capacity`` and the overflow->unknown
+    semantics are unchanged.
+
+    The scan runs in checkpointed chunks (the resumable machinery —
+    CONTRACT TWIN of check_encoded_sharded_resumable's loop: same
+    supervised dispatch, same overflow re-run-the-chunk rule). A
+    re-shard re-dispatches the current chunk on the wider rung; the
+    restore route's owner-routed all-to-all is what redistributes the
+    checkpointed visited set onto the new device slice. Results carry
+    the verdict fields of check_encoded_sharded plus a ``"reshard"``
+    block ({start-devices, events: [{event, devices, capacity}, ...]})
+    — the key exists only on this arm, so flag-off results stay
+    byte-identical. Per-event search-stats blocks are not produced on
+    the resumable jits (the resumable-arm precedent); ``search_stats``
+    is accepted for signature compatibility and ignored."""
+    from jepsen_tpu.parallel.engine import (FrontierCheckpoint,
+                                            carry_fields_np,
+                                            history_digest)
+    if e.n_returns == 0:
+        return {"valid?": True, "max-frontier": 0, "capacity": 0}
+    del search_stats   # no stats outputs on the resumable jits
+    dedupe = _resolve_dedupe(dedupe)
+    probe_limit = _resolve_probe_limit(probe_limit)
+    pack_req = _resolve_config_pack(config_pack)
+    C_enc = e.slot_f.shape[1]
+    pack = pack_spec_for(e) if pack_req else ()
+    plan_full = MeshPlan.from_mesh(mesh, "route")
+    if start_devices <= 0:
+        start_devices = min(2, plan_full.n_dev)
+    rungs = plan_full.ladder(start_devices)
+    rung = 0
+    n_dev = rungs[0].n_dev
+    # per-device capacity held flat across the recruiting rungs: the
+    # global capacity of rung r is Nd0 * n_dev(r)
+    Nd0 = -(-max(64, capacity) // n_dev)
+    N = Nd0 * n_dev
+    platform = plan_full.platform
+    digest = history_digest(e)
+    cp = FrontierCheckpoint(
+        0, N, e.step_name, digest,
+        np.full(N, e.state0, np.int32), np.zeros(N, np.uint32),
+        np.zeros(N, np.uint32), np.arange(N) < 1, True, -1, 1, 0)
+    reshard_events: list = []
+    xs_np = {
+        "slot_f": e.slot_f, "slot_a0": e.slot_a0, "slot_a1": e.slot_a1,
+        "slot_wild": e.slot_wild, "slot_occ": e.slot_occ,
+        "ev_slot": e.ev_slot,
+    }
+    R = e.n_returns
+    mode, note = "off", None
+    with obs.span("sharded.elastic", devices=plan_full.n_dev,
+                  dedupe=dedupe, returns=R) as sp:
+        while cp.event_index < R and cp.ok:
+            plan = rungs[rung]
+            n_dev = plan.n_dev
+            sub_mesh = plan.mesh()
+            Nd = N // n_dev
+            mode, note = _resolve_sparse_pallas(
+                sparse_pallas, Nd, C_enc, plan.n_chip, plan.n_slice,
+                "route", platform, dedupe, pack)
+            lo = cp.event_index
+            hi = min(R, lo + checkpoint_every)
+            rep_sh = NamedSharding(sub_mesh, P())
+            shard = NamedSharding(
+                sub_mesh, P((AX_SLICE, AX_CHIP) if plan.hierarchical
+                            else AXIS))
+
+            def _chunk(cp=cp, Nd=Nd, plan=plan, mode=mode, lo=lo,
+                       hi=hi, sub_mesh=sub_mesh, rep_sh=rep_sh,
+                       shard=shard):
+                chunk = {k: jax.device_put(np.asarray(v[lo:hi]),
+                                           rep_sh)
+                         for k, v in xs_np.items()}
+                if pack:
+                    rows = pack_rows_np(pack, C_enc, cp.st, cp.ml,
+                                        cp.mh)
+                else:
+                    rows = (cp.st, cp.ml, cp.mh)
+                # owned placement before the resume jit donates the
+                # carry (engine._place_owned documents the hazard)
+                carry_in = jax.tree.map(jnp.copy, tuple(
+                    jax.device_put(np.asarray(r), shard)
+                    for r in rows)
+                    + (jax.device_put(cp.live, shard),
+                       jax.device_put(np.bool_(cp.ok), rep_sh),
+                       jax.device_put(np.int32(cp.fail_r), rep_sh),
+                       jax.device_put(np.int32(cp.event_index),
+                                      rep_sh),
+                       jax.device_put(np.int32(cp.maxf), rep_sh),
+                       jax.device_put(np.int32(cp.stepped), rep_sh)))
+                if plan.hierarchical:
+                    carry, overflow = _check_sharded_resume2d(
+                        chunk, carry_in, e.step_name, Nd,
+                        plan.n_slice, plan.n_chip, sub_mesh, dedupe,
+                        probe_limit, mode, pack)
+                else:
+                    carry, overflow = _check_sharded_resume(
+                        chunk, carry_in, e.step_name, Nd, n_dev,
+                        sub_mesh, dedupe, probe_limit, mode, pack)
+                return [np.asarray(x) for x in carry], bool(overflow)
+
+            try:
+                carry, overflow = sup.dispatch("sharded", _chunk,
+                                               backend=platform)
+            except sup.DISPATCH_FAILURES as err:
+                err.checkpoint = cp
+                raise
+            if bool(overflow):
+                if rung + 1 < len(rungs):
+                    # recruit devices: per-device capacity stays Nd0,
+                    # the wider rung's restore route redistributes the
+                    # checkpointed visited set over the new slice
+                    rung += 1
+                    new_n = rungs[rung].n_dev
+                    N = Nd0 * new_n
+                    reshard_events.append(
+                        {"event": cp.event_index,
+                         "devices": [n_dev, new_n], "capacity": N})
+                    obs.counter("engine.reshard_escalations").inc()
+                    if N > cp.capacity:
+                        cp = cp.grown(N)
+                    continue
+                # full mesh recruited: the historical table-doubling
+                if N * 2 > max_capacity:
+                    out = _tag_sparse_closure(
+                        {"valid?": "unknown",
+                         "error": f"frontier overflow at capacity {N}",
+                         "capacity": N, "devices": n_dev,
+                         "dedupe": dedupe, "checkpoint": cp}, mode,
+                        note)
+                    out["reshard"] = {"start-devices": start_devices,
+                                      "events": reshard_events}
+                    return out
+                Nd0 *= 2
+                N *= 2
+                obs.counter("engine.capacity_escalations").inc()
+                cp = cp.grown(N)
+                continue
+            st, ml, mh, live, ok, fail_r, r_idx, maxf, stepped = \
+                carry_fields_np(carry, pack, C_enc)
+            cp = FrontierCheckpoint(int(r_idx), N, e.step_name, digest,
+                                    st, ml, mh, live, bool(ok),
+                                    int(fail_r), int(maxf), cp.steps_n,
+                                    int(stepped))
+        sp.set(capacity=N, devices=n_dev)
+    obs.counter("engine.configs_stepped").inc(int(cp.stepped))
+    out = {"valid?": cp.ok and bool(cp.live.any()),
+           "max-frontier": cp.maxf, "capacity": cp.capacity,
+           "devices": n_dev, "dedupe": dedupe,
+           "configs-stepped": cp.stepped,
+           "reshard": {"start-devices": start_devices,
+                       "events": reshard_events}}
+    _tag_sparse_closure(out, mode, note)
+    _tag_config_pack(out, pack, pack_req, C_enc)
+    if not out["valid?"]:
+        from jepsen_tpu.parallel.encode import fail_op_fields
+        out.update(fail_op_fields(e, cp.fail_r))
+    return out
 
 
 def _resolve_sparse_pallas(sparse_pallas, Nd: int, C: int, n_chip: int,
@@ -928,7 +1199,8 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
                           probe_limit: int = 0,
                           sparse_pallas=None,
                           search_stats=None,
-                          config_pack=None) -> dict:
+                          config_pack=None,
+                          reshard=None) -> dict:
     """Check one encoded history with the frontier sharded over `mesh`.
 
     Topology: a mesh whose device array is 2-D (both dims > 1) with
@@ -956,9 +1228,23 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
     pallas kernel (sparse_kernels.hash_insert_call) — probe, claim
     arbitration, and fresh-row append run VMEM-resident; the
     owner-routing collectives stay in XLA. `probe_limit` as in
-    engine.check_encoded (one knob for every hash path)."""
+    engine.check_encoded (one knob for every hash path).
+
+    `reshard` (None = JEPSEN_TPU_RESHARD) replaces the grow-the-table
+    escalation with the elastic device ladder: the search starts on a
+    NARROW slice of the mesh and each overflow recruits more devices
+    (per-device capacity held flat) before it ever grows per-device
+    tables — check_encoded_sharded_elastic's docstring has the
+    contract. Flag off = the historical ladder, byte-identical."""
     if e.n_returns == 0:
         return {"valid?": True, "max-frontier": 0, "capacity": 0}
+    if _resolve_reshard(reshard) and exchange == "route" \
+            and np.asarray(mesh.devices).size > 1:
+        return check_encoded_sharded_elastic(
+            e, mesh, capacity=capacity, max_capacity=max_capacity,
+            dedupe=dedupe, probe_limit=probe_limit,
+            sparse_pallas=sparse_pallas, search_stats=search_stats,
+            config_pack=config_pack)
     dedupe = _resolve_dedupe(dedupe)
     probe_limit = _resolve_probe_limit(probe_limit)
     ss = _resolve_search_stats(search_stats)
@@ -967,17 +1253,14 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
     # A 2-D device array + "route" = the multi-slice topology: axis 0
     # is the slice (DCN) axis, axis 1 the intra-slice chip (ICI) axis,
     # and the exchange goes hierarchical. Anything else flattens onto
-    # a 1-D mesh named AXIS.
-    devs = np.asarray(mesh.devices)
-    hier = exchange == "route" and devs.ndim == 2 and devs.shape[0] > 1 \
-        and devs.shape[1] > 1
+    # a 1-D mesh named AXIS. MeshPlan owns that decision (the elastic
+    # ladder and the multi-host seam read the same one).
+    plan = MeshPlan.from_mesh(mesh, exchange)
+    hier = plan.hierarchical
+    mesh = plan.mesh()
+    n_dev = plan.n_dev
     if hier:
-        n_slice, n_chip = devs.shape
-        mesh = Mesh(devs, (AX_SLICE, AX_CHIP))
-        n_dev = n_slice * n_chip
-    else:
-        mesh = Mesh(devs.reshape(-1), (AXIS,))
-        n_dev = mesh.shape[AXIS]
+        n_slice, n_chip = plan.n_slice, plan.n_chip
     # replicate inputs onto the mesh explicitly: nothing may be created
     # on the default backend (it can be a broken TPU runtime while we
     # deliberately run on a CPU mesh — the MULTICHIP_r01 crash mode)

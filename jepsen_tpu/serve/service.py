@@ -144,7 +144,7 @@ class _Key:
                  "finalize_requested", "needs_check", "pending_ops",
                  "wal_next", "broken", "wal_dead", "acct",
                  "pending_times", "tenant", "epoch", "fenced",
-                 "delta_recs")
+                 "delta_recs", "device")
 
     def __init__(self, key, tenant: str = tenancy.DEFAULT_TENANT):
         self.key = key
@@ -190,6 +190,10 @@ class _Key:
         # ownership moved to another replica (rehome/migration) —
         # submit/result/finalize answer a structured refusal instead
         # of letting this replica become a second writer
+        self.device = None  # elastic device pin (steal_key): when
+        # set, this key's session places its scans here instead of
+        # the service-wide device — the in-process half of key
+        # work-stealing (JEPSEN_TPU_STEAL)
 
 
 class _TenantState:
@@ -1314,19 +1318,21 @@ class CheckerService:
 
     # -------------------------------------------------- worker side
 
-    def _new_session(self, key) -> ext.HistorySession:
+    def _new_session(self, key, device=None) -> ext.HistorySession:
         return ext.HistorySession(
             self.model, capacity=self.capacity,
             max_capacity=self.max_capacity, dedupe=self.dedupe,
             probe_limit=self.probe_limit,
-            sparse_pallas=self.sparse_pallas, device=self.device,
+            sparse_pallas=self.sparse_pallas,
+            device=device if device is not None else self.device,
             key=key)
 
     def _session_for(self, ks: _Key) -> ext.HistorySession:
         if ks.session is not None:
             return ks.session
-        # evicted: thaw transparently from checkpoint store + WAL
-        sess = self._new_session(ks.key)
+        # evicted: thaw transparently from checkpoint store + WAL —
+        # onto the key's stolen device pin when one is set
+        sess = self._new_session(ks.key, device=ks.device)
         cp, _meta = (self._cps.load(ks.key)
                      if self._cps is not None else (None, None))
         deltas, ids = (self._wal.replay_with_ids(ks.key)
@@ -1696,6 +1702,37 @@ class CheckerService:
                     or ks.needs_check:
                 return False
             self._freeze_session(ks, locked=True)
+        return True
+
+    def steal_key(self, key, device=None) -> bool:
+        """Migrate a mid-stream key's device placement — the serve
+        half of elastic key work-stealing (JEPSEN_TPU_STEAL /
+        docs/performance.md "Elastic scheduling"): an external
+        scheduler that sees one device running hot (the per-key
+        ``engine.search.*`` stats / ``serve.apply`` spans are the
+        signal) moves whole KEYS, never mid-search state. With a
+        checkpoint store the live frontier freezes through the
+        eviction path and the next delta thaws it onto ``device`` —
+        the FrontierCheckpoint freeze/thaw IS the migration primitive,
+        bit-identical resume guaranteed by the eviction contract.
+        Without one, an idle live session re-places in memory
+        (HistorySession.migrate — checkpoints are host-side numpy
+        either way). False when the key does not exist or still has
+        unapplied work (drain first — stealing is best-effort and
+        never interrupts a running scan)."""
+        with self._cond:
+            ks = self._keys.get(key)
+            if ks is None:
+                return False
+            if ks.pending or ks.needs_check:
+                return False
+            if ks.session is not None:
+                if self._cps is not None:
+                    self._freeze_session(ks, locked=True)
+                else:
+                    ks.session.migrate(device)
+            ks.device = device
+        obs.counter("serve.keys_stolen").inc()
         return True
 
     def _maybe_evict(self) -> None:

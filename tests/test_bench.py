@@ -459,3 +459,27 @@ def test_prior_onchip_headline_orders_by_round_number(tmp_path,
     os.utime(results / "bench_alpha_onchip.jsonl", (now - 50, now - 50))
     assert bench._prior_onchip_headline()["value"] == 555.0
     importlib.reload(bench)
+
+
+def test_bench_elastic_advisory_lines_gated_on_flags(monkeypatch, capsys):
+    """The ISSUE 15 elastic advisories: with JEPSEN_TPU_STEAL /
+    JEPSEN_TPU_RESHARD unset, emit_steal_advisory and
+    emit_reshard_advisory are no-ops BEFORE touching any argument or
+    backend — the default bench schema stays byte-identical (the
+    emit_search_stats gating precedent above)."""
+    import bench
+
+    monkeypatch.delenv("JEPSEN_TPU_STEAL", raising=False)
+    bench.emit_steal_advisory("testsec")
+    assert _json_lines(capsys.readouterr().out) == []
+    monkeypatch.delenv("JEPSEN_TPU_RESHARD", raising=False)
+    # args deliberately unusable: the gate must return first
+    bench.emit_reshard_advisory(None, None, 0, 0, {}, 0.0)
+    assert _json_lines(capsys.readouterr().out) == []
+    # a malformed flag value raises (the envflags contract), never a
+    # silent skip
+    monkeypatch.setenv("JEPSEN_TPU_STEAL", "maybe")
+    import pytest as _pytest
+    from jepsen_tpu.envflags import EnvFlagError
+    with _pytest.raises(EnvFlagError):
+        bench.emit_steal_advisory("testsec")

@@ -1,0 +1,162 @@
+"""MeshPlan (parallel.meshplan): the factored topology decision, the
+re-shard ladder rungs, the multi-host key partition, and the gated
+jax.distributed seam (ISSUE 15). The two-process localhost smoke is
+slow-marked (it boots two fresh JAX processes)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from jepsen_tpu import envflags
+from jepsen_tpu.parallel import meshplan
+from jepsen_tpu.parallel.meshplan import MeshPlan
+
+
+def test_topology_decision_matches_sharded_inline_logic():
+    devs = np.array(jax.devices())
+    # 1-D -> flat
+    p = MeshPlan.from_mesh(Mesh(devs, ("frontier",)))
+    assert not p.hierarchical and p.n_dev == devs.size
+    assert p.mesh().axis_names == (meshplan.AXIS,)
+    # 2-D both dims > 1 under the owner-routed exchange -> hierarchical
+    p2 = MeshPlan.from_mesh(Mesh(devs.reshape(4, 2), ("a", "b")))
+    assert p2.hierarchical and (p2.n_slice, p2.n_chip) == (4, 2)
+    assert p2.mesh().axis_names == (meshplan.AX_SLICE,
+                                    meshplan.AX_CHIP)
+    # the all-gather A/B path always flattens (the historical rule)
+    p3 = MeshPlan.from_mesh(Mesh(devs.reshape(4, 2), ("a", "b")),
+                            exchange="gather")
+    assert not p3.hierarchical
+    # a degenerate 2-D (one dim = 1) flattens too
+    p4 = MeshPlan.from_mesh(Mesh(devs.reshape(1, -1), ("a", "b")))
+    assert not p4.hierarchical
+
+
+def test_ladder_rungs_flat_and_hierarchical():
+    devs = np.array(jax.devices())
+    flat = MeshPlan(devs)
+    assert [p.n_dev for p in flat.ladder(1)] == [1, 2, 4, 8]
+    assert [p.n_dev for p in flat.ladder(2)] == [2, 4, 8]
+    hier = MeshPlan(devs.reshape(4, 2), hierarchical=True)
+    rungs = hier.ladder(1)
+    assert [(p.n_dev, p.hierarchical) for p in rungs] \
+        == [(1, False), (2, False), (4, True), (8, True)]
+    # the last rung is always the full plan
+    assert rungs[-1].n_dev == 8 and rungs[-1].hierarchical
+
+
+def test_key_partition_deterministic_and_complete():
+    p = MeshPlan(np.array(jax.devices()))
+    keys = [f"k{i}" for i in range(40)] + [7, ("a", 1)]
+    parts = p.key_partition(keys, n_parts=4)
+    assert sorted((k for ks in parts.values() for k in ks),
+                  key=repr) == sorted(keys, key=repr)
+    # stable across calls and independent instances
+    assert parts == MeshPlan(np.array(jax.devices())).key_partition(
+        keys, n_parts=4)
+    assert all(MeshPlan.key_home(k, 4) in range(4) for k in keys)
+
+
+def test_host_slices_single_host():
+    p = MeshPlan(np.array(jax.devices()))
+    hs = p.host_slices()
+    assert list(hs) == [0] and len(hs[0]) == p.n_dev
+    assert p.local_devices() == hs[0]
+    assert p.n_processes == 1
+
+
+def test_distributed_init_gating(monkeypatch):
+    # off/unset: a no-op, never touches jax.distributed
+    monkeypatch.delenv("JEPSEN_TPU_DIST", raising=False)
+    assert meshplan.distributed_init() is False
+    # armed but half-configured: raise at the read site
+    monkeypatch.setenv("JEPSEN_TPU_DIST", "1")
+    for k in ("JEPSEN_TPU_DIST_COORD", "JEPSEN_TPU_DIST_NPROC",
+              "JEPSEN_TPU_DIST_PROC"):
+        monkeypatch.delenv(k, raising=False)
+    with pytest.raises(envflags.EnvFlagError, match="DIST_COORD"):
+        meshplan.distributed_init()
+    monkeypatch.setenv("JEPSEN_TPU_DIST_COORD", "nocolon")
+    monkeypatch.setenv("JEPSEN_TPU_DIST_NPROC", "2")
+    monkeypatch.setenv("JEPSEN_TPU_DIST_PROC", "0")
+    with pytest.raises(envflags.EnvFlagError, match="host:port"):
+        meshplan.distributed_init()
+    monkeypatch.setenv("JEPSEN_TPU_DIST_COORD", "127.0.0.1:0")
+    monkeypatch.setenv("JEPSEN_TPU_DIST_PROC", "2")
+    with pytest.raises(envflags.EnvFlagError, match="out of range"):
+        meshplan.distributed_init()
+    # bad flag value fails loudly, like every other knob
+    monkeypatch.setenv("JEPSEN_TPU_DIST", "yes")
+    with pytest.raises(envflags.EnvFlagError):
+        meshplan.distributed_init()
+
+
+_CHILD = r"""
+import json, os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from jepsen_tpu.parallel import meshplan
+assert meshplan.distributed_init() is True
+plan = meshplan.MeshPlan.auto()
+parts = plan.key_partition([f"k{i}" for i in range(16)],
+                           n_parts=plan.n_processes)
+print(json.dumps({
+    "proc": jax.process_index(),
+    "n_proc": jax.process_count(),
+    "global_devices": plan.n_dev,
+    "local_devices": len(plan.local_devices()),
+    "hosts": sorted(plan.host_slices()),
+    "partition": {str(k): sorted(map(str, v))
+                  for k, v in parts.items()},
+}))
+"""
+
+
+@pytest.mark.slow
+def test_distributed_two_process_localhost_smoke(tmp_path):
+    """The DCN seam's smoke (ISSUE 15): two real processes complete
+    the gated jax.distributed handshake over localhost CPU, see the
+    union device set (2 hosts x 2 local devices), and compute the
+    SAME independent-key partition without any coordination round —
+    the property a pod-scale run relies on. Slow tier: boots two
+    fresh JAX processes."""
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "JEPSEN_TPU_DIST": "1",
+        "JEPSEN_TPU_DIST_COORD": f"127.0.0.1:{port}",
+        "JEPSEN_TPU_DIST_NPROC": "2",
+    })
+    procs = []
+    for pid in range(2):
+        e = dict(env)
+        e["JEPSEN_TPU_DIST_PROC"] = str(pid)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _CHILD], env=e,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True))
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=180)
+        assert p.returncode == 0, (out, err)
+        import json
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    assert sorted(o["proc"] for o in outs) == [0, 1]
+    for o in outs:
+        assert o["n_proc"] == 2
+        assert o["global_devices"] == 4 and o["local_devices"] == 2
+        assert o["hosts"] == [0, 1]
+    # both processes computed the identical key partition — no
+    # coordinator round needed to agree who checks what
+    assert outs[0]["partition"] == outs[1]["partition"]

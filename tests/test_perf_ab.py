@@ -207,3 +207,20 @@ def test_perf_ab_emits_cost_table_on_cpu():
     assert gc and all("would_run" in l["gate_coverage"] for l in gc)
     assert "config_pack_verdict" in lines[-1]
     assert "verdict" in lines[-1]
+
+
+def test_perf_ab_elastic_unknown_arm_raises():
+    """PERF_AB_ELASTIC gets the same typo-protection as the other
+    selector envs: an unknown arm aborts at import with the valid set
+    named, never a silent skip that reads as measured-and-lost."""
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env.update({"PERF_AB_ELASTIC": "steal,reshards",
+                "JAX_PLATFORMS": "cpu"})
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_ab.py")],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode != 0, r.stdout[-500:]
+    assert "unknown arm" in r.stderr, r.stderr[-500:]
+    assert "reshards" in r.stderr
+    assert "steal,reshard" in r.stderr, r.stderr[-500:]
