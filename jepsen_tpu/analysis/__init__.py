@@ -8,13 +8,23 @@ invariants. Three rule families:
 
   purity       host effects / numpy / tracer branches inside traced code
   recompile    jit-cache defeats and undecided buffer donation
-  concurrency  unlocked cross-thread writes; JEPSEN_TPU_* env reads
-               outside the validated accessor (jepsen_tpu.envflags)
+  concurrency  unlocked cross-thread writes; lock-discipline pass
+               (lock-order cycles, blocking ops under a held lock,
+               guarded-field inference — see analysis/locks.py);
+               JEPSEN_TPU_* env reads outside the validated accessor
+               (jepsen_tpu.envflags)
+
+plus repo-sweep-only gates: stale-suppression detection (a disable
+comment whose rule no longer fires is itself a finding), the
+cross-module lock-order pairs (service<->wal, fleet<->breaker), and
+the doc-drift gates (envflags registry vs docs flag rows; minted obs
+metric names vs docs/observability.md — see analysis/drift.py).
 
 Pure `ast` work: no JAX import, no device init — safe and fast on
 CPU-only CI even with a wedged PJRT runtime. Entry points:
 
     python -m jepsen_tpu.analysis --check      # CI gate, exit 0/1
+    python -m jepsen_tpu.analysis --changed    # pre-commit fast mode
     jepsen lint [paths...] [--json]            # CLI subcommand
     run_lint(paths=None, root=None)            # library API
 
@@ -33,7 +43,8 @@ from typing import List, Optional, Sequence
 
 from jepsen_tpu.analysis import concurrency, purity, recompile
 from jepsen_tpu.analysis.core import (  # noqa: F401  (public API)
-    RULES, Finding, SourceFile, default_targets, expand_targets,
+    DEFAULT_DIRS, DEFAULT_TOP_FILES, RULES, Finding, SourceFile,
+    default_targets, expand_targets,
 )
 from jepsen_tpu.analysis.report import (  # noqa: F401
     format_json, format_text, save_to_store, summarize,
@@ -59,6 +70,9 @@ def lint_file(path: str, root: Optional[str] = None) -> List[Finding]:
     for line, msg in sf.suppressions.bad:
         findings.append(Finding("bad-suppression", sf.relpath, line, 0,
                                 msg))
+    # a directive that suppressed nothing is itself a finding — and
+    # deliberately not suppressible: the inventory only ever shrinks
+    findings.extend(sf.stale_suppression_findings())
     # deterministic order regardless of reachability-set iteration
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return findings
@@ -76,9 +90,66 @@ def run_lint(paths: Optional[Sequence[str]] = None,
     findings: List[Finding] = []
     for path in files:
         findings.extend(lint_file(path, root))
+    if paths is None:
+        # repo-sweep-only gates: cross-module lock-order pairs and
+        # the doc-drift checks (an explicit-path lint of one file must
+        # not fail on an unrelated doc)
+        findings.extend(_pair_sweep(root, files))
+        from jepsen_tpu.analysis import drift
+        findings.extend(drift.check_repo(root, files))
     if rules:
         findings = [f for f in findings if f.rule in set(rules)]
     return findings
+
+
+def _pair_sweep(root: str, files: Sequence[str]) -> List[Finding]:
+    """Cross-module lock-order cycles over the known pairs."""
+    from jepsen_tpu.analysis import locks
+    present = {os.path.relpath(f, root).replace(os.sep, "/"): f
+               for f in files}
+    out: List[Finding] = []
+    for rel_a, rel_b, hint_b, hint_a in locks.CROSS_MODULE_PAIRS:
+        if rel_a not in present or rel_b not in present:
+            continue
+        sf_a = SourceFile(present[rel_a], root)
+        sf_b = SourceFile(present[rel_b], root)
+        for f in locks.pair_findings(sf_a, sf_b, hint_b, hint_a):
+            sf = sf_a if f.path == sf_a.relpath else sf_b
+            out.extend(sf.apply_suppressions([f]))
+    return out
+
+
+def changed_files(base: str = "HEAD",
+                  root: Optional[str] = None) -> List[str]:
+    """Lintable .py files changed vs `base` (plus untracked ones),
+    restricted to the default sweep's tree — the `--changed` fast
+    mode's work list. Raises on git failure (caller maps to exit 2)."""
+    import subprocess
+    root = root or repo_root()
+
+    def git(*argv2: str) -> List[str]:
+        res = subprocess.run(["git", *argv2], cwd=root,
+                             capture_output=True, text=True)
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"git {' '.join(argv2)} failed: "
+                f"{res.stderr.strip() or res.returncode}")
+        return [ln.strip() for ln in res.stdout.splitlines()
+                if ln.strip()]
+
+    rels = set(git("diff", "--name-only", base))
+    rels |= set(git("ls-files", "--others", "--exclude-standard"))
+    out: List[str] = []
+    for rel in sorted(rels):
+        if not rel.endswith(".py"):
+            continue
+        top = rel.replace("\\", "/").split("/", 1)[0]
+        if not (top in DEFAULT_DIRS or rel in DEFAULT_TOP_FILES):
+            continue
+        path = os.path.join(root, rel)
+        if os.path.isfile(path):        # deleted files drop out
+            out.append(path)
+    return out
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -94,6 +165,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="files/dirs to lint (default: the repo tree)")
     p.add_argument("--check", action="store_true",
                    help="CI gate mode: print active findings only")
+    p.add_argument("--changed", nargs="?", const="HEAD", metavar="BASE",
+                   help="fast mode: lint only files changed vs BASE "
+                        "(git diff --name-only, default HEAD) plus "
+                        "untracked ones — the sub-second pre-commit "
+                        "loop; the full sweep stays the CI gate")
     p.add_argument("--json", action="store_true",
                    help="emit the JSON report")
     p.add_argument("--rules", help="comma-separated rule subset")
@@ -115,8 +191,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
              if args.rules else None)
+    lint_paths: Optional[Sequence[str]] = args.paths or None
+    if args.changed is not None:
+        import sys
+        if args.paths:
+            print("lint: --changed and explicit paths are mutually "
+                  "exclusive", file=sys.stderr)
+            return 2
+        try:
+            lint_paths = changed_files(args.changed)
+        except Exception as e:
+            print(f"lint: --changed: {e}", file=sys.stderr)
+            return 2
+        if not lint_paths:
+            print("lint: no changed python files", file=sys.stderr)
+            return 0
     try:
-        findings = run_lint(args.paths or None, rules=rules)
+        findings = run_lint(lint_paths, rules=rules)
     except (OSError, SyntaxError, ValueError) as e:
         # a missing/unreadable/undecodable/unparseable target is a
         # USAGE error (2), not "findings found" (1) — CI must not

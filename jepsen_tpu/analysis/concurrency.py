@@ -18,6 +18,14 @@ env-flag-accessor
     os.environ/os.getenv read reintroduces the round-5 failure mode:
     a malformed value silently flipping a measured default.
 
+concurrency-lock-order / concurrency-blocking-under-lock /
+concurrency-unguarded-field
+    The lock-discipline pass (jepsen_tpu.analysis.locks) runs as part
+    of this family: static lock-order-cycle detection, blocking
+    operations inside held-lock regions, and guarded-field inference
+    over `threading.Lock/RLock/Condition` attributes. See locks.py
+    for the held-set model and the interprocedural bound.
+
 concurrency-unsupervised-dispatch
     Every call to a device-dispatch entry point (the jitted
     _check_device*/_check_bitdense*/_check_sharded* functions) must
@@ -254,5 +262,6 @@ def _env_findings(sf: SourceFile) -> List[Finding]:
 
 
 def check(sf: SourceFile) -> List[Finding]:
+    from jepsen_tpu.analysis import locks
     return (_race_findings(sf) + _dispatch_findings(sf)
-            + _env_findings(sf))
+            + _env_findings(sf) + locks.check(sf))

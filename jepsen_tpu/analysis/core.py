@@ -56,6 +56,20 @@ RULES: Dict[str, str] = {
     "concurrency-unlocked-shared-write":
         "attribute/global write to an object shared across threads "
         "with no lock in scope",
+    "concurrency-lock-order":
+        "lock-order cycle: two locks acquired in opposite orders on "
+        "different paths — a potential deadlock the moment both paths "
+        "run concurrently",
+    "concurrency-blocking-under-lock":
+        "blocking operation (file I/O / socket / subprocess / sleep / "
+        "foreign Condition.wait / supervised dispatch / flight dump) "
+        "inside a held-lock region — every other thread needing that "
+        "lock stalls behind the I/O; audited sites carry a named "
+        "suppression with the reason",
+    "concurrency-unguarded-field":
+        "write to a self.<field> outside the lock that guards it "
+        "(inferred: >=90% of the field's writes hold one specific "
+        "lock) — the unguarded write races every guarded one",
     "concurrency-unsupervised-dispatch":
         "direct call to a device-dispatch entry point outside the "
         "resilience.supervisor seam — faults, watchdog, and breaker "
@@ -66,6 +80,19 @@ RULES: Dict[str, str] = {
         "accessor",
     "bad-suppression":
         "jepsen-lint suppression without a (known) rule name",
+    "lint-stale-suppression":
+        "a disable comment whose rule no longer fires on the code it "
+        "covers — dead suppressions must be dropped so the inventory "
+        "only ever shrinks",
+    "hygiene-flag-doc-drift":
+        "the envflags.py registration table and the docs' flag rows "
+        "disagree: a registered JEPSEN_TPU_* flag is undocumented, or "
+        "a documented flag is unregistered",
+    "hygiene-metric-doc-drift":
+        "the statically-minted obs metric names and the "
+        "docs/observability.md naming-scheme rows disagree: a minted "
+        "name is undocumented, or a documented metric is never "
+        "emitted",
 }
 
 # the one module allowed to touch JEPSEN_TPU_* env vars directly
@@ -105,6 +132,16 @@ class Suppressions:
         self.line_rules: Dict[int, Set[str]] = {}
         self.device_lines: Set[int] = set()
         self.bad: List[Tuple[int, str]] = []
+        # where each directive physically lives (directive-comment
+        # line -> target line it covers), so stale reporting anchors
+        # at the COMMENT the reader would delete
+        self.directive_lines: Dict[Tuple[int, str], int] = {}
+        self.file_directive_lines: Dict[str, int] = {}
+        # filled by SourceFile.apply_suppressions: which (target line,
+        # rule) / file-level rules actually suppressed a finding —
+        # everything else is a stale directive
+        self.used_line: Set[Tuple[int, str]] = set()
+        self.used_file: Set[str] = set()
 
     @classmethod
     def parse(cls, text: str) -> "Suppressions":
@@ -167,8 +204,12 @@ class Suppressions:
             known = [r for r in names if r in RULES]
             if verb == "disable-file":
                 sup.file_rules.update(known)
+                for r in known:
+                    sup.file_directive_lines.setdefault(r, i)
             else:
                 sup.line_rules.setdefault(target, set()).update(known)
+                for r in known:
+                    sup.directive_lines.setdefault((target, r), i)
         return sup
 
 
@@ -244,19 +285,47 @@ class SourceFile:
                      if not isinstance(f.node, ast.Lambda)]
         out = []
         for fd in findings:
-            rules_at = set()
+            covering: List[int] = []
             # exact line + any line of the enclosing statement span
             span = self._span_at(fd.line)
-            for ln in range(span[0], span[1] + 1):
-                rules_at |= sup.line_rules.get(ln, set())
+            covering.extend(range(span[0], span[1] + 1))
             # a def-line (or decorator-line) comment covers the body
             for heads, hi in def_spans:
                 if min(heads) <= fd.line <= hi:
-                    for ln in heads:
-                        rules_at |= sup.line_rules.get(ln, set())
-            if fd.rule in rules_at or fd.rule in sup.file_rules:
+                    covering.extend(heads)
+            for ln in covering:
+                if fd.rule in sup.line_rules.get(ln, set()):
+                    fd.suppressed = True
+                    sup.used_line.add((ln, fd.rule))
+            if fd.rule in sup.file_rules:
                 fd.suppressed = True
+                sup.used_file.add(fd.rule)
             out.append(fd)
+        return out
+
+    def stale_suppression_findings(self) -> List[Finding]:
+        """Directives that suppressed NOTHING — call strictly after
+        apply_suppressions has run over every family's findings. Each
+        stale directive anchors at its comment line (the thing to
+        delete), so the suppression inventory can only shrink."""
+        sup = self.suppressions
+        out: List[Finding] = []
+        for (target, rule), cline in sorted(sup.directive_lines.items()):
+            if (target, rule) in sup.used_line:
+                continue
+            out.append(Finding(
+                "lint-stale-suppression", self.relpath, cline, 0,
+                f"suppression for `{rule}` no longer matches any "
+                f"finding on line {target} — delete the dead "
+                f"directive"))
+        for rule, cline in sorted(sup.file_directive_lines.items()):
+            if rule in sup.used_file:
+                continue
+            out.append(Finding(
+                "lint-stale-suppression", self.relpath, cline, 0,
+                f"file-level suppression for `{rule}` no longer "
+                f"matches any finding in this file — delete the dead "
+                f"directive"))
         return out
 
     def _span_at(self, line: int) -> Tuple[int, int]:

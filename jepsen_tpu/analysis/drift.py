@@ -1,0 +1,280 @@
+"""Doc-drift gates: env-flag and metric-name cross-checks.
+
+Docs rot silently: a flag lands in envflags.py and never reaches the
+docs table, a metric is renamed and the observability page keeps the
+old spelling. Both gates are pure text/AST work over the live tree —
+no imports of the checked modules — and run only on the DEFAULT
+repo-wide sweep (``run_lint(paths=None)``); explicit-path invocations
+stay a plain lint so `jepsen lint some_file.py` never fails on an
+unrelated doc.
+
+hygiene-flag-doc-drift
+    The envflags.py registration table (the ``JEPSEN_TPU_<NAME>
+    env_<kind> <module>`` comment rows) is cross-checked against every
+    ``JEPSEN_TPU_*`` mention in docs/performance.md, observability.md,
+    streaming.md, and resilience.md — both directions. A registered
+    flag no doc mentions anchors at its registry row; a documented
+    flag the registry does not know anchors at the doc line.
+
+hygiene-metric-doc-drift
+    Metric names are collected statically: every
+    ``counter/gauge/histogram("dotted.name")`` call resolving to the
+    obs registry (f-strings become wildcard patterns; a
+    ``labeled("base", ...)`` argument contributes its base name).
+    The docs side parses the "Naming scheme" table rows of
+    docs/observability.md whose kind column says counter/gauge/
+    histogram, expanding the table's shorthands: leading-dot rows
+    (`.key` continues the previous name's prefix), ``{a,b,c}``
+    alternation, and ``<placeholder>`` wildcards. A minted name no doc
+    row matches anchors at the mint; a documented row no mint matches
+    anchors at the doc line.
+
+Drift findings are deliberately NOT suppressible: the acceptance
+contract is that drift gets FIXED in the same change, not waved off.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from jepsen_tpu.analysis.core import Finding, SourceFile
+
+ENVFLAGS_REL = "jepsen_tpu/envflags.py"
+FLAG_DOC_RELS = ("docs/performance.md", "docs/observability.md",
+                 "docs/streaming.md", "docs/resilience.md")
+OBS_DOC_REL = "docs/observability.md"
+
+# a registry row: "#   JEPSEN_TPU_FOO  env_int  module — description"
+_REGISTRY_ROW = re.compile(
+    r"^#\s{1,3}(JEPSEN_TPU_[A-Z0-9_]+)\s+(env_\w+)")
+_FLAG_MENTION = re.compile(r"JEPSEN_TPU_[A-Z0-9_]+")
+
+_MINT_LEAVES = {"counter", "gauge", "histogram"}
+
+# wildcard sentinel inside collected/expanded names (never a valid
+# metric character)
+WILD = "\x00"
+
+
+def _read_lines(root: str, rel: str) -> List[str]:
+    with open(os.path.join(root, rel), encoding="utf-8") as fh:
+        return fh.read().splitlines()
+
+
+# ------------------------------------------------------------- flags
+
+def registered_flags(root: str,
+                     envflags_rel: str = ENVFLAGS_REL
+                     ) -> Dict[str, int]:
+    """Flag name -> registry-table line number."""
+    out: Dict[str, int] = {}
+    for i, line in enumerate(_read_lines(root, envflags_rel), 1):
+        m = _REGISTRY_ROW.match(line)
+        if m:
+            out.setdefault(m.group(1), i)
+    return out
+
+
+def documented_flags(root: str,
+                     doc_rels: Sequence[str] = FLAG_DOC_RELS
+                     ) -> Dict[str, Tuple[str, int]]:
+    """Flag name -> first (doc relpath, line) mentioning it."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for rel in doc_rels:
+        if not os.path.isfile(os.path.join(root, rel)):
+            continue
+        for i, line in enumerate(_read_lines(root, rel), 1):
+            for m in _FLAG_MENTION.finditer(line):
+                out.setdefault(m.group(0), (rel, i))
+    return out
+
+
+def flag_findings(root: str,
+                  envflags_rel: str = ENVFLAGS_REL,
+                  doc_rels: Sequence[str] = FLAG_DOC_RELS
+                  ) -> List[Finding]:
+    reg = registered_flags(root, envflags_rel)
+    doc = documented_flags(root, doc_rels)
+    findings: List[Finding] = []
+    for name in sorted(set(reg) - set(doc)):
+        findings.append(Finding(
+            "hygiene-flag-doc-drift", envflags_rel, reg[name], 0,
+            f"`{name}` is registered here but documented in none of "
+            f"{', '.join(doc_rels)} — add its doc row"))
+    for name in sorted(set(doc) - set(reg)):
+        rel, line = doc[name]
+        findings.append(Finding(
+            "hygiene-flag-doc-drift", rel, line, 0,
+            f"`{name}` is documented here but not registered in "
+            f"{envflags_rel} — fix the doc (or register the flag)"))
+    return findings
+
+
+# ------------------------------------------------------------ metrics
+
+def _mint_name(sf: SourceFile, call: ast.Call) -> Optional[str]:
+    """The (possibly wildcarded) metric name a mint call emits, or
+    None if the call is not a registry mint / the name is dynamic."""
+    dotted = sf.dotted(call.func) or ""
+    leaf = dotted.split(".")[-1]
+    if leaf not in _MINT_LEAVES:
+        return None
+    prefix = dotted[: -len(leaf)].rstrip(".")
+    base = prefix.split(".")[-1]
+    if not ("obs" in prefix or "metrics" in prefix
+            or base in ("reg", "registry")):
+        return None     # some other counter()-shaped callable
+    if not call.args:
+        return None
+    return _name_expr(sf, call.args[0])
+
+
+def _name_expr(sf: SourceFile, node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts: List[str] = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append(WILD)
+        return "".join(parts)
+    if isinstance(node, ast.Call):
+        # labeled("base", k=v) emits under `base[...]` — the base name
+        # is what the docs table documents
+        dotted = sf.dotted(node.func) or ""
+        if dotted.split(".")[-1] == "labeled" and node.args:
+            return _name_expr(sf, node.args[0])
+    return None
+
+
+def minted_metrics(root: str, files: Sequence[str]
+                   ) -> Dict[str, Tuple[str, int]]:
+    """Metric name/pattern -> first (relpath, line) minting it."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for path in files:
+        if not path.endswith(".py"):
+            continue
+        sf = SourceFile(path, root)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _mint_name(sf, node)
+            if name:
+                out.setdefault(name, (sf.relpath, node.lineno))
+    return out
+
+
+_ROW = re.compile(r"^\s*\|(?P<name>[^|]*)\|(?P<kind>[^|]*)\|")
+_TICKED = re.compile(r"`([^`]+)`")
+_KINDED = re.compile(r"\b(counter|gauge|histogram)\b")
+_BRACES = re.compile(r"\{([^{}]*)\}")
+
+
+def _expand(fragment: str, prev_full: Optional[str]) -> List[str]:
+    """One backticked doc fragment -> concrete name patterns.
+    Handles `.suffix` shorthand (continue the previous name's prefix),
+    `{a,b,c}` alternation, `<placeholder>` wildcards, and `name[...]`
+    label rows (the base name is what gets minted)."""
+    name = fragment.strip()
+    if not name or " " in name:
+        return []
+    name = name.split("[", 1)[0]            # label row -> base name
+    if name.startswith("."):
+        if prev_full is None:
+            return []
+        name = prev_full.rsplit(".", 1)[0] + name
+    name = re.sub(r"<[^<>]*>", WILD, name)
+    out = [name]
+    while True:
+        expanded: List[str] = []
+        changed = False
+        for n in out:
+            m = _BRACES.search(n)
+            if m is None:
+                expanded.append(n)
+                continue
+            changed = True
+            for alt in m.group(1).split(","):
+                expanded.append(n[:m.start()] + alt.strip()
+                                + n[m.end():])
+        out = expanded
+        if not changed:
+            return [n for n in out if n.strip(".")]
+
+
+def documented_metrics(root: str, doc_rel: str = OBS_DOC_REL
+                       ) -> Dict[str, int]:
+    """Documented metric name/pattern -> doc line. Only the "Naming
+    scheme" section's counter/gauge/histogram rows count; span rows
+    are tracing, not metrics, and other tables (the stats-field
+    glossary) merely talk ABOUT counters."""
+    out: Dict[str, int] = {}
+    prev_full: Optional[str] = None
+    in_section = False
+    for i, line in enumerate(_read_lines(root, doc_rel), 1):
+        if line.startswith("## "):
+            in_section = line.lower().startswith("## naming scheme")
+            continue
+        if not in_section:
+            continue
+        m = _ROW.match(line)
+        if m is None:
+            continue
+        fragments = _TICKED.findall(m.group("name"))
+        is_metric = bool(_KINDED.search(m.group("kind")))
+        for frag in fragments:
+            for name in _expand(frag, prev_full):
+                if not name.startswith(WILD):
+                    prev_full = name
+                if is_metric:
+                    out.setdefault(name, i)
+    return out
+
+
+def _pat(name: str) -> "re.Pattern[str]":
+    return re.compile(
+        ".+".join(re.escape(p) for p in name.split(WILD)) + "$")
+
+
+def names_match(a: str, b: str) -> bool:
+    """Wildcard-tolerant equality: `a` covers `b` or `b` covers `a`
+    (either side may carry WILD segments)."""
+    return bool(_pat(a).match(b.replace(WILD, "x"))
+                or _pat(b).match(a.replace(WILD, "x")))
+
+
+def metric_findings(root: str, files: Sequence[str],
+                    doc_rel: str = OBS_DOC_REL) -> List[Finding]:
+    if not os.path.isfile(os.path.join(root, doc_rel)):
+        return []
+    minted = minted_metrics(root, files)
+    documented = documented_metrics(root, doc_rel)
+    findings: List[Finding] = []
+    for name in sorted(minted):
+        if any(names_match(name, d) for d in documented):
+            continue
+        rel, line = minted[name]
+        shown = name.replace(WILD, "<...>")
+        findings.append(Finding(
+            "hygiene-metric-doc-drift", rel, line, 0,
+            f"metric `{shown}` is minted here but has no row in the "
+            f"{doc_rel} naming-scheme table — document it"))
+    for name in sorted(documented):
+        if any(names_match(name, m) for m in minted):
+            continue
+        shown = name.replace(WILD, "<...>")
+        findings.append(Finding(
+            "hygiene-metric-doc-drift", doc_rel, documented[name], 0,
+            f"metric `{shown}` is documented here but never minted "
+            f"anywhere in the tree — fix the doc (or emit it)"))
+    return findings
+
+
+def check_repo(root: str, files: Sequence[str]) -> List[Finding]:
+    """Both drift gates over the default sweep's file list."""
+    return flag_findings(root) + metric_findings(root, files)

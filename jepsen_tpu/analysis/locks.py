@@ -1,0 +1,744 @@
+"""Lock-discipline rules: deadlock order, blocking-under-lock, and
+guarded-field races over the serve/fleet/resilience threading stack.
+
+The review-hardening logs of PRs 8, 11, 12, and 13 caught the same
+defect classes by hand every round: flight-dump file I/O moved outside
+the serve condition (PR 8), `freeze_key` races fixed by serializing
+under the condition (PR 11), fence writes ordered before copies
+(PR 12), WAL-stage stamps taken under the cond (PR 13). This module
+machine-checks those shapes.
+
+The model
+---------
+Lock *identities* are discovered statically:
+
+  * ``self._x = threading.Lock()/RLock()/Condition()/Semaphore()``
+    inside class ``C``  ->  lock ``C._x`` (kind remembered: a
+    Condition's own ``wait()`` releases it, so waiting on the
+    condition you hold is NOT blocking);
+  * ``name = threading.Lock()`` at module scope  ->  ``<mod>.name``;
+  * ``self._locks.setdefault(k, threading.Lock())`` or
+    ``self._locks[k] = threading.Lock()``  ->  the per-key lock
+    *family* ``C._locks[*]`` (one identity for the whole dict — the
+    per-key instances are interchangeable for ordering purposes);
+  * a local bound from a family (``slock = self._stem_locks
+    .setdefault(...)``) aliases to the family's identity.
+
+The *held set* is tracked through ``with`` statements (multi-item,
+left to right), explicit ``acquire()``/``release()`` pairs in straight
+-line code, and ONE level of direct same-class ``self.method()``
+inlining (recursion cut at depth 1 — the documented interprocedural
+bound; deeper call chains need their own audit). A helper that is
+self-called anywhere is judged in its callers' lock contexts, so
+``_rotate_locked``-style helpers are seen under the locks their
+callers actually hold.
+
+Rules
+-----
+concurrency-lock-order
+    Acquiring lock B while holding lock A adds edge A->B to the
+    static lock-order graph. A cycle is a potential deadlock the
+    moment both paths run concurrently. Checked per module, and (via
+    ``pair_findings``) across the known cross-module pairs
+    (service<->wal, fleet<->breaker), where a call made under a held
+    lock to a partner-module method is charged with every lock that
+    method acquires (receiver names are matched against the pair's
+    hint regex so ``list.append`` never aliases ``DeltaWAL.append``).
+
+concurrency-blocking-under-lock
+    A blocking operation inside a held-lock region: file I/O
+    (``open``/``.write``/``.flush``/``os.fsync``/``os.replace``/
+    ``shutil.*``), sockets/HTTP, ``subprocess``, ``time.sleep``, a
+    ``wait()`` on a condition/event you do NOT hold, a supervised
+    ``dispatch(...)`` (a device program under a host lock), and
+    ``obs.flight_dump`` (the PR-8 shape). Audited sites — the WAL
+    fsync under the per-key handoff lock is the canonical one —
+    carry a rule-named suppression WITH the reason.
+
+concurrency-unguarded-field
+    Guarded-field inference: a ``self.x`` whose (non-``__init__``)
+    writes hold one specific lock at >=90% of the write sites is
+    inferred guarded by it; the remaining write sites flag. A 100%-
+    consistent field is silent; a field with no dominant lock is
+    undecidable and also silent (the thread-root race rule still
+    covers the closure/global cases).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from jepsen_tpu.analysis.core import Finding, FuncInfo, SourceFile
+
+# threading constructors that mint a lock identity
+_LOCK_CTORS = {"Lock": "lock", "RLock": "lock", "Condition": "condition",
+               "Semaphore": "lock", "BoundedSemaphore": "lock"}
+
+# os-level / shutil-level calls that hit the filesystem
+_FS_CALLS = {"os.fsync", "os.replace", "os.rename", "os.remove",
+             "os.unlink", "os.makedirs", "os.rmdir", "os.link",
+             "os.symlink", "os.truncate"}
+
+# dotted leaves that mean "socket / HTTP round trip"
+_NET_LEAVES = {"urlopen", "create_connection", "sendall", "recv",
+               "connect", "getresponse"}
+
+_SUBPROCESS_LEAVES = {"run", "Popen", "call", "check_call",
+                      "check_output"}
+
+# attribute leaves that write a file handle
+_HANDLE_WRITE_LEAVES = {"write", "flush"}
+
+# the fraction of write sites that must hold one lock before the
+# field is inferred guarded by it
+GUARD_THRESHOLD = 0.9
+
+# cross-module lock-order pairs: (file A, file B, regex a receiver in
+# A must match to count as a call INTO B, and vice versa)
+CROSS_MODULE_PAIRS = (
+    ("jepsen_tpu/serve/service.py", "jepsen_tpu/serve/wal.py",
+     r"wal", r"service|_svc"),
+    ("jepsen_tpu/serve/fleet.py", "jepsen_tpu/resilience/breaker.py",
+     r"breaker|_br\b", r"fleet|replica"),
+)
+
+
+@dataclasses.dataclass
+class _Write:
+    cls: str
+    attr: str
+    node: ast.AST
+    held: frozenset
+    inlined: bool          # observed via a caller's inline scan
+    func: str
+    rmw: bool
+
+
+@dataclasses.dataclass
+class _Block:
+    node: ast.AST
+    held: Tuple[str, ...]
+    what: str
+    func: str
+    via: Optional[str]
+
+
+@dataclasses.dataclass
+class _ExtCall:
+    leaf: str
+    recv_src: str
+    held: Tuple[str, ...]
+    node: ast.AST
+    func: str
+
+
+class ModuleLockFacts:
+    """Everything the lock pass learned about one file."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.modname = os.path.splitext(
+            os.path.basename(sf.relpath))[0]
+        self.class_locks: Dict[Tuple[str, str], str] = {}   # (C,attr)->kind
+        self.families: Set[Tuple[str, str]] = set()
+        self.module_locks: Dict[str, str] = {}
+        self.acquired: Set[str] = set()     # every acquisition event
+        self.edges: Dict[Tuple[str, str], ast.AST] = {}
+        self.blocks: List[_Block] = []
+        self.writes: List[_Write] = []
+        self.ext_calls: List[_ExtCall] = []
+        # method name -> union of lock ids its body acquires (the
+        # summary the cross-module pass charges callers with)
+        self.method_locks: Dict[str, Set[str]] = {}
+        self._kinds: Dict[str, str] = {}
+
+
+def _is_lock_ctor(sf: SourceFile, node: ast.AST) -> Optional[str]:
+    """'threading.Lock()' (or a from-import of it) -> its kind."""
+    if not isinstance(node, ast.Call):
+        return None
+    dotted = sf.dotted(node.func) or ""
+    leaf = dotted.split(".")[-1]
+    if leaf not in _LOCK_CTORS:
+        return None
+    if "threading" in dotted or dotted == leaf:
+        return _LOCK_CTORS[leaf]
+    return None
+
+
+def _class_of(sf: SourceFile, node: ast.AST) -> Optional[str]:
+    """Name of the innermost enclosing class, through any nesting
+    (nested worker defs inside a method still see self's class)."""
+    cur = sf.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur.name
+        cur = sf.parents.get(cur)
+    return None
+
+
+def collect_facts(sf: SourceFile) -> ModuleLockFacts:
+    facts = ModuleLockFacts(sf)
+    kinds: Dict[str, str] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            kind = _is_lock_ctor(sf, node.value) \
+                if node.value is not None else None
+            ann = getattr(node, "annotation", None)
+            for t in targets:
+                if kind and isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    cls = _class_of(sf, node)
+                    if cls:
+                        facts.class_locks[(cls, t.attr)] = kind
+                        kinds[f"{cls}.{t.attr}"] = kind
+                elif kind and isinstance(t, ast.Name) \
+                        and sf.func_of(node) is None:
+                    facts.module_locks[t.id] = kind
+                    kinds[f"{facts.modname}.{t.id}"] = kind
+                elif kind and isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Attribute) \
+                        and isinstance(t.value.value, ast.Name) \
+                        and t.value.value.id == "self":
+                    cls = _class_of(sf, node)
+                    if cls:
+                        facts.families.add((cls, t.value.attr))
+                        kinds[f"{cls}.{t.value.attr}[*]"] = "lock"
+                elif ann is not None and isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    # self._stem_locks: Dict[str, threading.Lock] = {}
+                    try:
+                        ann_src = ast.unparse(ann)
+                    except Exception:  # pragma: no cover
+                        ann_src = ""
+                    if any(c in ann_src for c in _LOCK_CTORS):
+                        cls = _class_of(sf, node)
+                        if cls:
+                            facts.families.add((cls, t.attr))
+                            kinds[f"{cls}.{t.attr}[*]"] = "lock"
+        elif isinstance(node, ast.Call):
+            # self._locks.setdefault(k, threading.Lock())
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "setdefault" \
+                    and len(node.args) >= 2 \
+                    and _is_lock_ctor(sf, node.args[1]) \
+                    and isinstance(f.value, ast.Attribute) \
+                    and isinstance(f.value.value, ast.Name) \
+                    and f.value.value.id == "self":
+                cls = _class_of(sf, node)
+                if cls:
+                    facts.families.add((cls, f.value.attr))
+                    kinds[f"{cls}.{f.value.attr}[*]"] = "lock"
+    facts._kinds = kinds
+    return facts
+
+
+class _FuncScanner:
+    """Held-set tracking through one function body (plus one level of
+    same-class self.method() inlining)."""
+
+    def __init__(self, facts: ModuleLockFacts,
+                 methods: Dict[Tuple[str, str], FuncInfo]):
+        self.facts = facts
+        self.sf = facts.sf
+        self.methods = methods
+
+    # ---------------------------------------------------- identities
+    def lock_id(self, expr: ast.AST, cls: Optional[str],
+                aliases: Dict[str, str]) -> Optional[str]:
+        facts = self.facts
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and cls:
+            if (cls, expr.attr) in facts.class_locks:
+                return f"{cls}.{expr.attr}"
+            if (cls, expr.attr) in facts.families:
+                return f"{cls}.{expr.attr}[*]"
+        elif isinstance(expr, ast.Subscript):
+            inner = self.lock_id(expr.value, cls, aliases)
+            if inner and inner.endswith("[*]"):
+                return inner
+        elif isinstance(expr, ast.Name):
+            if expr.id in aliases:
+                return aliases[expr.id]
+            if expr.id in facts.module_locks:
+                return f"{facts.modname}.{expr.id}"
+        elif isinstance(expr, ast.Call):
+            # slock = self._stem_locks.setdefault(stem, Lock()) — the
+            # call itself evaluates to a family member
+            f = expr.func
+            if isinstance(f, ast.Attribute) and f.attr in (
+                    "setdefault", "get"):
+                inner = self.lock_id(f.value, cls, aliases)
+                if inner and inner.endswith("[*]"):
+                    return inner
+        return None
+
+    def _alias_from(self, value: ast.AST, cls: Optional[str],
+                    aliases: Dict[str, str]) -> Optional[str]:
+        lid = self.lock_id(value, cls, aliases)
+        if lid is not None:
+            return lid
+        return None
+
+    # -------------------------------------------------------- driver
+    def scan(self, fi: FuncInfo, held: Tuple[str, ...],
+             depth: int, via: Optional[str]):
+        cls = _class_of(self.sf, fi.node)
+        aliases: Dict[str, str] = {}
+        body = (fi.node.body if isinstance(fi.node.body, list)
+                else [fi.node.body])
+        self._scan_stmts(body, list(held), fi, cls, aliases, depth, via)
+
+    def _scan_stmts(self, stmts, held: List[str], fi: FuncInfo,
+                    cls: Optional[str], aliases: Dict[str, str],
+                    depth: int, via: Optional[str]):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue            # runs later, not here
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = list(held)
+                for item in stmt.items:
+                    self._scan_expr(item.context_expr, inner, fi, cls,
+                                    aliases, depth, via)
+                    lid = self.lock_id(item.context_expr, cls, aliases)
+                    if lid is not None:
+                        self._acquire(lid, inner, item.context_expr)
+                        inner = inner + [lid]
+                    if item.optional_vars is not None and lid is not None \
+                            and isinstance(item.optional_vars, ast.Name):
+                        aliases[item.optional_vars.id] = lid
+                self._scan_stmts(stmt.body, inner, fi, cls, aliases,
+                                 depth, via)
+                continue
+            # straight-line acquire()/release() on a known lock
+            if isinstance(stmt, ast.Expr) \
+                    and isinstance(stmt.value, ast.Call) \
+                    and isinstance(stmt.value.func, ast.Attribute):
+                f = stmt.value.func
+                lid = self.lock_id(f.value, cls, aliases)
+                if lid is not None and f.attr == "acquire":
+                    self._acquire(lid, held, stmt.value)
+                    held.append(lid)
+                    continue
+                if lid is not None and f.attr == "release":
+                    if lid in held:
+                        held.remove(lid)
+                    continue
+            if isinstance(stmt, ast.Assign) \
+                    and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                alias = self._alias_from(stmt.value, cls, aliases)
+                if alias is not None:
+                    aliases[stmt.targets[0].id] = alias
+            # expressions anywhere in the statement
+            for expr in self._stmt_exprs(stmt):
+                self._scan_expr(expr, held, fi, cls, aliases, depth, via)
+            # attribute writes
+            wtargets: List[ast.AST] = []
+            rmw = False
+            if isinstance(stmt, ast.Assign):
+                wtargets = stmt.targets
+                rmw = self._self_referencing(stmt)
+            elif isinstance(stmt, ast.AugAssign):
+                wtargets = [stmt.target]
+                rmw = True
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                wtargets = [stmt.target]
+            for t in wtargets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self" and cls \
+                        and fi.name != "__init__":
+                    self.facts.writes.append(_Write(
+                        cls, t.attr, t, frozenset(held),
+                        inlined=depth > 0, func=fi.name, rmw=rmw))
+            # compound statements: recurse into their bodies with the
+            # same held set (control flow does not release locks)
+            for sub in self._stmt_bodies(stmt):
+                self._scan_stmts(sub, held, fi, cls, aliases, depth, via)
+
+    @staticmethod
+    def _self_referencing(stmt: ast.Assign) -> bool:
+        """self.x = f(self.x): a read-modify-write in assignment form."""
+        reads = {(n.value.id, n.attr) for n in ast.walk(stmt.value)
+                 if isinstance(n, ast.Attribute)
+                 and isinstance(n.value, ast.Name)
+                 and isinstance(n.ctx, ast.Load)}
+        for t in stmt.targets:
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and (t.value.id, t.attr) in reads:
+                return True
+        return False
+
+    @staticmethod
+    def _stmt_bodies(stmt: ast.stmt) -> Iterable[list]:
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if isinstance(sub, list) and sub \
+                    and isinstance(sub[0], ast.stmt):
+                yield sub
+        for h in getattr(stmt, "handlers", []) or []:
+            yield h.body
+
+    @staticmethod
+    def _stmt_exprs(stmt: ast.stmt) -> Iterable[ast.AST]:
+        """The statement's own expressions (not nested statement
+        bodies, not nested defs/lambdas)."""
+        nested = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        for field, value in ast.iter_fields(stmt):
+            if field in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            vals = value if isinstance(value, list) else [value]
+            for v in vals:
+                if isinstance(v, ast.expr) and not isinstance(v, nested):
+                    yield v
+
+    # ---------------------------------------------------- observers
+    def _acquire(self, lid: str, held: List[str], node: ast.AST):
+        self.facts.acquired.add(lid)
+        for h in held:
+            if h != lid and (h, lid) not in self.facts.edges:
+                self.facts.edges[(h, lid)] = node
+
+    def _scan_expr(self, expr: ast.AST, held: List[str], fi: FuncInfo,
+                   cls: Optional[str], aliases: Dict[str, str],
+                   depth: int, via: Optional[str]):
+        nested = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, nested):
+                continue
+            stack.extend(c for c in ast.iter_child_nodes(node)
+                         if not isinstance(c, nested))
+            if not isinstance(node, ast.Call):
+                continue
+            self._check_call(node, held, fi, cls, aliases, depth, via)
+
+    def _check_call(self, call: ast.Call, held: List[str],
+                    fi: FuncInfo, cls: Optional[str],
+                    aliases: Dict[str, str], depth: int,
+                    via: Optional[str]):
+        facts = self.facts
+        dotted = self.sf.dotted(call.func) or ""
+        leaf = dotted.split(".")[-1] if dotted else (
+            call.func.attr if isinstance(call.func, ast.Attribute)
+            else "")
+        # one-level interprocedural: a direct self.method() call runs
+        # the callee's body under the caller's held set (depth 1 cut)
+        if isinstance(call.func, ast.Attribute) \
+                and isinstance(call.func.value, ast.Name) \
+                and call.func.value.id == "self" and cls \
+                and depth == 0:
+            callee = self.methods.get((cls, call.func.attr))
+            if callee is not None and callee.node is not fi.node:
+                self.scan(callee, tuple(held), 1,
+                          via=f"{cls}.{fi.name}")
+        if not held:
+            return
+        # a held-lock call that might enter a partner module (the
+        # cross-module pass filters by receiver hint)
+        if isinstance(call.func, ast.Attribute) \
+                and not (isinstance(call.func.value, ast.Name)
+                         and call.func.value.id == "self"):
+            try:
+                recv = ast.unparse(call.func.value)
+            except Exception:  # pragma: no cover
+                recv = ""
+            facts.ext_calls.append(_ExtCall(
+                call.func.attr, recv, tuple(held), call, fi.name))
+        what = self._blocking_kind(call, dotted, leaf, held, cls,
+                                   aliases)
+        if what is not None:
+            facts.blocks.append(_Block(call, tuple(held), what,
+                                       fi.name, via))
+
+    def _blocking_kind(self, call: ast.Call, dotted: str, leaf: str,
+                       held: List[str], cls: Optional[str],
+                       aliases: Dict[str, str]) -> Optional[str]:
+        if dotted == "open":
+            return "file I/O (`open`)"
+        if dotted in _FS_CALLS or dotted.startswith("shutil."):
+            return f"file I/O (`{dotted}`)"
+        if dotted == "time.sleep":
+            return "`time.sleep`"
+        if dotted.startswith("subprocess.") \
+                and leaf in _SUBPROCESS_LEAVES:
+            return f"subprocess (`{dotted}`)"
+        if leaf in _NET_LEAVES or dotted.startswith("urllib.") \
+                or dotted.startswith("socket."):
+            return f"network round trip (`{dotted or leaf}`)"
+        if leaf == "flight_dump":
+            return "`obs.flight_dump` (flight-recorder file dump)"
+        if leaf == "dispatch" and not dotted.startswith("self."):
+            return "supervised device dispatch"
+        if isinstance(call.func, ast.Attribute) \
+                and leaf in _HANDLE_WRITE_LEAVES:
+            # a write/flush on something that is itself a lock is the
+            # lock API, not file I/O
+            if self.lock_id(call.func.value, cls, aliases) is None:
+                return f"file-handle `.{leaf}()`"
+            return None
+        if isinstance(call.func, ast.Attribute) \
+                and leaf in ("wait", "wait_for"):
+            lid = self.lock_id(call.func.value, cls, aliases)
+            if lid is not None and lid in held:
+                return None     # waiting on the condition you hold
+                                # releases it — the sanctioned idiom
+            return ("a `wait()` on a condition/event you do NOT "
+                    "hold (it cannot release your locks)")
+        return None
+
+
+def _methods_map(sf: SourceFile) -> Dict[Tuple[str, str], FuncInfo]:
+    out: Dict[Tuple[str, str], FuncInfo] = {}
+    for f in sf.functions:
+        if isinstance(f.node, ast.Lambda):
+            continue
+        cls = _class_of(sf, f.node)
+        if cls and f.is_method:
+            out.setdefault((cls, f.name), f)
+    return out
+
+
+def analyze(sf: SourceFile) -> ModuleLockFacts:
+    """Run the held-set scan over every function of the file."""
+    facts = collect_facts(sf)
+    if not (facts.class_locks or facts.module_locks or facts.families):
+        return facts
+    methods = _methods_map(sf)
+    scanner = _FuncScanner(facts, methods)
+    for fi in sf.functions:
+        if isinstance(fi.node, ast.Lambda):
+            continue
+        scanner.scan(fi, (), 0, None)
+    # per-method acquisition summary for the cross-module pass: which
+    # locks does calling this method (from outside) take?
+    for (cls, name), fi in methods.items():
+        probe = ModuleLockFacts(sf)
+        probe.class_locks = facts.class_locks
+        probe.families = facts.families
+        probe.module_locks = facts.module_locks
+        probe._kinds = facts._kinds
+        _FuncScanner(probe, methods).scan(fi, (), 0, None)
+        facts.method_locks.setdefault(name, set()).update(probe.acquired)
+    return facts
+
+
+# ----------------------------------------------------------- findings
+
+def _cycle_findings(sf: SourceFile,
+                    edges: Dict[Tuple[str, str], ast.AST]
+                    ) -> List[Finding]:
+    """SCCs of the lock-order graph with more than one node are
+    potential deadlocks; one finding per cycle, anchored at the
+    lexicographically-first edge site."""
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str):
+        work = [(v, iter(sorted(graph[v])))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                elif w in on:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                low[work[-1][0]] = min(low[work[-1][0]], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+
+    findings: List[Finding] = []
+    for comp in sccs:
+        if len(comp) < 2:
+            continue
+        cset = set(comp)
+        cyc_edges = sorted((a, b) for (a, b) in edges
+                           if a in cset and b in cset)
+        anchor = min((edges[e] for e in cyc_edges),
+                     key=lambda n: (getattr(n, "lineno", 0),
+                                    getattr(n, "col_offset", 0)))
+        order = " -> ".join(sorted(cset)) + f" -> {sorted(cset)[0]}"
+        findings.append(sf.finding(
+            "concurrency-lock-order", anchor,
+            f"lock-order cycle {order}: these locks are acquired in "
+            f"opposite orders on different paths — a potential "
+            f"deadlock once both run concurrently"))
+    return findings
+
+
+def _blocking_findings(sf: SourceFile,
+                       facts: ModuleLockFacts) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[int] = set()
+    for b in facts.blocks:
+        if id(b.node) in seen:
+            continue
+        seen.add(id(b.node))
+        where = (f"`{b.func}` (inlined from `{b.via}`)" if b.via
+                 else f"`{b.func}`")
+        held = ", ".join(f"`{h}`" for h in b.held)
+        findings.append(sf.finding(
+            "concurrency-blocking-under-lock", b.node,
+            f"{b.what} in {where} while holding {held} — every "
+            f"thread needing that lock stalls behind it; move it "
+            f"outside the lock or suppress with the audit reason"))
+    return findings
+
+
+def _unguarded_findings(sf: SourceFile,
+                        facts: ModuleLockFacts) -> List[Finding]:
+    lockish_attrs = {(c, a) for (c, a) in facts.class_locks} \
+        | facts.families
+    by_site: Dict[int, List[_Write]] = {}
+    for w in facts.writes:
+        if (w.cls, w.attr) in lockish_attrs:
+            continue
+        by_site.setdefault(id(w.node), []).append(w)
+    # per write SITE: the lock view of its realistic contexts — a
+    # self-called helper is judged under its callers' locks
+    sites: Dict[Tuple[str, str], List[Tuple[_Write, frozenset]]] = {}
+    for recs in by_site.values():
+        inlined = [w for w in recs if w.inlined]
+        use = inlined if inlined else recs
+        held: frozenset = frozenset()
+        for w in use:
+            held = held | w.held
+        w0 = recs[0]
+        sites.setdefault((w0.cls, w0.attr), []).append((w0, held))
+    findings: List[Finding] = []
+    for (cls, attr), recs in sorted(sites.items()):
+        total = len(recs)
+        if total < 2:
+            continue
+        counts: Dict[str, int] = {}
+        for _w, held in recs:
+            for lock in held:
+                counts[lock] = counts.get(lock, 0) + 1
+        if not counts:
+            continue
+        guard, n = max(counts.items(), key=lambda kv: (kv[1], kv[0]))
+        if n == total or n / total < GUARD_THRESHOLD:
+            continue
+        for w, held in recs:
+            if guard in held:
+                continue
+            kind = "read-modify-write" if w.rmw else "write"
+            findings.append(sf.finding(
+                "concurrency-unguarded-field", w.node,
+                f"`self.{attr}` is guarded by `{guard}` "
+                f"({n}/{total} write sites hold it) but this {kind} "
+                f"in `{w.func}` does not — it races every guarded "
+                f"writer"))
+    return findings
+
+
+def check(sf: SourceFile) -> List[Finding]:
+    facts = analyze(sf)
+    if not (facts.class_locks or facts.module_locks or facts.families):
+        return []
+    return (_cycle_findings(sf, facts.edges)
+            + _blocking_findings(sf, facts)
+            + _unguarded_findings(sf, facts))
+
+
+# ------------------------------------------------- cross-module pairs
+
+def pair_findings(sf_a: SourceFile, sf_b: SourceFile,
+                  hint_b_in_a: str, hint_a_in_b: str) -> List[Finding]:
+    """Lock-order cycles that only close ACROSS two modules: a call
+    made under a held lock in one file, whose receiver matches the
+    pair's hint regex and whose method name the partner defines, is
+    charged with every lock that partner method acquires."""
+    fa, fb = analyze(sf_a), analyze(sf_b)
+    edges: Dict[Tuple[str, str], ast.AST] = {}
+    own = set()
+    for (e, n) in list(fa.edges.items()) + list(fb.edges.items()):
+        edges.setdefault(e[0:2], n)
+        own.add(e[0:2])
+    sites: Dict[Tuple[str, str], Tuple[SourceFile, ast.AST]] = {}
+
+    def cross(src: ModuleLockFacts, dst: ModuleLockFacts,
+              src_sf: SourceFile, hint: str):
+        rx = re.compile(hint, re.IGNORECASE)
+        for c in src.ext_calls:
+            if not rx.search(c.recv_src):
+                continue
+            dst_locks = dst.method_locks.get(c.leaf) or set()
+            for h in c.held:
+                for lid in dst_locks:
+                    if h == lid:
+                        continue
+                    if (h, lid) not in edges:
+                        edges[(h, lid)] = c.node
+                        sites[(h, lid)] = (src_sf, c.node)
+
+    cross(fa, fb, sf_a, hint_b_in_a)
+    cross(fb, fa, sf_b, hint_a_in_b)
+    cross_edges = set(edges) - own
+    if not cross_edges:
+        return []
+    # cycles must involve at least one cross edge (pure in-module
+    # cycles are already reported by the per-file pass)
+    findings: List[Finding] = []
+    for f in _cycle_findings(sf_a, edges):
+        # re-anchor at a cross edge participating in the cycle, in
+        # whichever file it lives
+        hit = None
+        for e in sorted(cross_edges):
+            if e[0] in f.message and e[1] in f.message:
+                hit = e
+                break
+        if hit is None:
+            continue
+        src_sf, node = sites[hit]
+        findings.append(src_sf.finding(
+            "concurrency-lock-order", node,
+            f.message + f" (cycle closes across "
+            f"{sf_a.relpath} <-> {sf_b.relpath})"))
+    return findings

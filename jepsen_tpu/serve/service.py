@@ -1781,9 +1781,15 @@ class CheckerService:
                 # the WAL replay recovers the truth on the next delta.
                 t_dev_end = self._clock()
                 dump_ctx = None
+                # per-key postmortems FIRST, outside the cond: each
+                # _crashed_entry writes a flight dump (file I/O), and
+                # the publish lock below must only cover bookkeeping —
+                # same contract as _process's no-lock phases
+                err_rs = {id(ks): self._crashed_entry(ks, err)
+                          for ks, _ops, _seq, _final, _recs in batch}
                 with self._cond:
                     for ks, _ops, last_seq, _final, recs in batch:
-                        ks.last_result = self._crashed_entry(ks, err)
+                        ks.last_result = err_rs[id(ks)]
                         ks.needs_check = False
                         if last_seq is not None:
                             ks.applied_seq = last_seq

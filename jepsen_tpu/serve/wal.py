@@ -175,6 +175,12 @@ class DeltaWAL:
             _log.warning("WAL %s: could not repair tail (%r)", path,
                          err)
 
+    # AUDITED I/O-under-lock: the open (+ header write on a fresh
+    # segment) runs under the caller's per-key stem lock BY DESIGN —
+    # the stem lock IS the durability handoff serialization point, and
+    # only this key's writers wait behind it (the dict lock self._lock
+    # is only ever taken in short bursts around map reads/writes).
+    # jepsen-lint: disable=concurrency-blocking-under-lock
     def _open_active(self, stem: str, key, tenant: Optional[str]):
         """The active (highest-index) segment's handle, opened —
         with tail repair — on first touch; callers hold the stem
@@ -208,6 +214,12 @@ class DeltaWAL:
             self._seg[stem] = idx
         return fh
 
+    # AUDITED I/O-under-lock: write+flush+fsync under the per-key stem
+    # lock is the WAL's core contract — the ack only returns once the
+    # bytes are on disk, and the stem lock is what keeps two appends
+    # to the SAME key from interleaving records. Cross-key appends
+    # never contend (each key has its own stem lock).
+    # jepsen-lint: disable=concurrency-blocking-under-lock
     def append(self, key, seq: int, ops,
                tenant: Optional[str] = None,
                delta_id: Optional[str] = None) -> int:
@@ -270,6 +282,10 @@ class DeltaWAL:
         with slock:
             self._rotate_locked(stem)
 
+    # AUDITED I/O-under-lock: same contract as append — the fence
+    # epoch must be durable (flushed + fsynced) before touch returns,
+    # and the stem lock serializes it against this key's appends.
+    # jepsen-lint: disable=concurrency-blocking-under-lock
     def touch(self, key, tenant: Optional[str] = None) -> None:
         """Open the key's active segment NOW, writing its header if
         the file is fresh — adoption calls set_epoch + rotate + touch

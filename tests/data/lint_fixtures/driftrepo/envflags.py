@@ -1,0 +1,8 @@
+"""Drift-fixture registry: the comment-table shape the flag gate
+parses. ALPHA is documented in docs/flags.md; BETA is not (drift)."""
+
+# Registered flags (one row per flag, same grammar as the real
+# jepsen_tpu/envflags.py table):
+#
+#   JEPSEN_TPU_ALPHA         env_int     mod — a documented flag
+#   JEPSEN_TPU_BETA          env_bool    mod — an UNdocumented flag

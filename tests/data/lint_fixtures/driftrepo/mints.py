@@ -1,0 +1,19 @@
+"""Drift-fixture mints: the static mint shapes the metric gate
+collects. `app.orphan` has no doc row (drift); everything else is
+covered by docs/obs.md."""
+import obs
+
+
+def emit(tenant, key, n):
+    obs.counter("app.hits").inc()
+    obs.counter("app.misses").inc(n)
+    obs.gauge("app.depth").set(n)
+    obs.histogram(obs.labeled("app.latency", tenant=tenant)).observe(n)
+    obs.counter(f"app.dyn.{key}").inc()
+    obs.counter("app.orphan").inc()
+    plain_counter("app.not_a_metric")   # wrong receiver: not a mint
+    obs.span("app.run")                 # spans are not metrics
+
+
+def plain_counter(name):
+    return name

@@ -1,0 +1,53 @@
+"""Clean twin of locks_viol.py: every shape the lock-discipline rules
+must stay silent on.
+
+  * both nesting sites acquire A then B — consistent order, no cycle
+  * file I/O strictly outside the lock
+  * `wait()` on the condition the function HOLDS (the sanctioned
+    idiom: wait releases it)
+  * a field whose every write holds the same lock (fully guarded)
+  * explicit acquire()/release() in the same A-then-B order
+"""
+import threading
+
+
+class Clean:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._cond = threading.Condition()
+        self.count = 0
+        self.ok = False
+        threading.Thread(target=self._worker).start()
+
+    def ab1(self):
+        with self._a:
+            with self._b:
+                self.count = 1
+
+    def ab2(self):
+        with self._a:
+            with self._b:
+                self.count = 2
+
+    def _worker(self):
+        with self._a:
+            with self._b:
+                self.count = 3
+
+    def waiter(self):
+        with self._cond:
+            while not self.ok:
+                self._cond.wait(timeout=0.1)
+
+    def dump(self):
+        with self._a:
+            items = list(range(3))
+        with open("/tmp/lint_fixture_ok", "w") as fh:
+            fh.write(str(items))
+
+    def explicit(self):
+        self._a.acquire()
+        with self._b:
+            pass
+        self._a.release()

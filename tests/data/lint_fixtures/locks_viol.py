@@ -1,0 +1,96 @@
+"""Violation fixture: the lock-discipline rules (analysis/locks.py).
+
+NOT imported by anything — parsed by tests/test_lint.py, which pins
+these anchors:
+
+  concurrency-lock-order            line 29 (the A->B / B->A cycle,
+                                    anchored at the first edge site)
+  concurrency-blocking-under-lock   line 49 (flight dump under the
+                                    condition — the PR-8 regression
+                                    shape), 54, 55, 56 (open/write/
+                                    foreign wait), 61 (sleep),
+                                    68 (inlined one level from
+                                    `outer`)
+  concurrency-unguarded-field       line 96 (worker-thread RMW of a
+                                    field 9/10 guarded — the PR-11
+                                    blocking-freeze regression shape)
+"""
+import threading
+import time
+
+
+class Cycle:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                x = 1
+        return x
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                x = 2
+        return x
+
+
+class Dumper:
+    def __init__(self, obs):
+        self._cond = threading.Condition()
+        self._lock = threading.Lock()
+        self._other = threading.Condition()
+        self._obs = obs
+
+    def crash_dump(self):
+        with self._cond:
+            self._obs.flight_dump("postmortem", context={})
+            self._cond.wait(timeout=0.1)    # held cond: sanctioned
+
+    def freeze(self):
+        with self._lock:
+            fh = open("/tmp/lint_fixture", "w")
+            fh.write("x")
+            self._other.wait()              # foreign condition
+        fh.close()
+
+    def nap(self):
+        with self._lock:
+            time.sleep(0.1)
+
+    def outer(self):
+        with self._lock:
+            self._io()
+
+    def _io(self):
+        open("/tmp/lint_fixture2", "w").close()
+
+
+class Tally:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.frozen = 0
+        threading.Thread(target=self._worker).start()
+
+    def bump(self, n):
+        with self._lock:
+            self.frozen = n
+            self.frozen = n + 1
+            self.frozen = n + 2
+
+    def set_many(self):
+        with self._lock:
+            self.frozen = 3
+            self.frozen = 4
+            self.frozen = 5
+
+    def reset(self):
+        with self._lock:
+            self.frozen = -1
+            self.frozen = -2
+            self.frozen = -3
+
+    def _worker(self):
+        self.frozen += 1

@@ -1,0 +1,14 @@
+"""Cross-module pair fixture, side A: calls into the partner module
+(pair_wal.py) while holding its own lock. Clean on its own — the
+cycle only closes across the pair (locks.pair_findings)."""
+import threading
+
+
+class Service:
+    def __init__(self, wal):
+        self._lock = threading.Lock()
+        self._wal = wal
+
+    def publish(self, rec):
+        with self._lock:
+            self._wal.append(rec)

@@ -1,0 +1,14 @@
+"""Cross-module pair fixture, side B: calls BACK into side A
+(pair_svc.py) under its own lock — the opposite acquisition order, so
+the lock-order cycle exists only in the combined graph."""
+import threading
+
+
+class Wal:
+    def __init__(self, svc):
+        self._mu = threading.Lock()
+        self._svc = svc
+
+    def append(self, rec):
+        with self._mu:
+            self._svc.publish(rec)

@@ -1,0 +1,17 @@
+"""Fixture: stale vs used suppressions (rule lint-stale-suppression).
+
+The env-flag suppression below is USED (the rule really fires there,
+so the directive earns its keep); the purity-numpy-call one covers a
+line the rule cannot fire on — the stale-suppression pass must flag
+exactly that one, anchored at the directive's own line.
+"""
+import os
+
+
+def read_flag():
+    return os.environ.get("JEPSEN_TPU_DEMO")  # jepsen-lint: disable=env-flag-accessor
+
+
+def harmless():
+    x = 1 + 1   # jepsen-lint: disable=purity-numpy-call
+    return x

@@ -419,3 +419,168 @@ def test_lint_report_saves_into_store_run_dir(tmp_path):
     assert data["counts"]["total"] == len(findings)
     txt = open(os.path.join(d, "lint.txt")).read()
     assert "[suppressed]" in txt and "bad-suppression" in txt
+
+
+# ------------------------------------------- lock discipline (PR 16)
+
+
+def test_lock_order_cycle_fixture():
+    fs = _lint("locks_viol.py")
+    assert _anchors(fs, "concurrency-lock-order") == [(29, False)]
+    msg = [f.message for f in fs
+           if f.rule == "concurrency-lock-order"][0]
+    assert "Cycle._a" in msg and "Cycle._b" in msg
+
+
+def test_blocking_under_lock_fixture_and_pr8_regression():
+    fs = _lint("locks_viol.py")
+    assert _anchors(fs, "concurrency-blocking-under-lock") == [
+        (49, False), (54, False), (55, False), (56, False),
+        (61, False), (68, False)]
+    by_line = {f.line: f.message for f in fs
+               if f.rule == "concurrency-blocking-under-lock"}
+    # the PR-8 regression shape: a flight dump (file I/O) inside the
+    # service condition
+    assert "flight_dump" in by_line[49]
+    assert "Dumper._cond" in by_line[49]
+    # the one-level self.method() inlining names the calling context
+    assert "inlined from `Dumper.outer`" in by_line[68]
+    # wait() on the condition the function HOLDS (line 50) is the
+    # sanctioned idiom — wait releases it
+    assert 50 not in by_line
+
+
+def test_unguarded_field_fixture_pr11_regression():
+    """The PR-11 shape: a worker-thread write to a field every other
+    writer touches under the lock."""
+    fs = _lint("locks_viol.py")
+    assert _anchors(fs, "concurrency-unguarded-field") == [(96, False)]
+    msg = [f.message for f in fs
+           if f.rule == "concurrency-unguarded-field"][0]
+    assert "9/10" in msg and "read-modify-write" in msg
+    assert "Tally._lock" in msg
+
+
+def test_lock_rules_silent_on_clean_twin():
+    """Consistent order, I/O outside locks, wait-on-held-cond, fully
+    guarded field, explicit acquire/release: zero findings of ANY
+    rule."""
+    assert _lint("locks_ok.py") == []
+
+
+def test_cross_module_pair_cycle():
+    from jepsen_tpu.analysis import locks
+    sa = lint_core.SourceFile(
+        os.path.join(FIXTURES, "pair_svc.py"), REPO)
+    sb = lint_core.SourceFile(
+        os.path.join(FIXTURES, "pair_wal.py"), REPO)
+    fs = locks.pair_findings(sa, sb, r"wal", r"svc")
+    assert len(fs) == 1 and fs[0].rule == "concurrency-lock-order"
+    assert "closes across" in fs[0].message
+    assert "Service._lock" in fs[0].message
+    assert "Wal._mu" in fs[0].message
+    # each side alone is clean — the cycle exists only in the pair
+    # graph, which is exactly why the sweep runs the pair pass
+    assert _anchors(_lint("pair_svc.py"),
+                    "concurrency-lock-order") == []
+    assert _anchors(_lint("pair_wal.py"),
+                    "concurrency-lock-order") == []
+
+
+def test_stale_suppression_fixture():
+    fs = _lint("stale_viol.py")
+    # the dead directive is a finding anchored at ITS OWN line, and
+    # it is not suppressible
+    assert _anchors(fs, "lint-stale-suppression") == [(16, False)]
+    # the used directive is NOT stale — its finding stays reported,
+    # marked suppressed
+    assert _anchors(fs, "env-flag-accessor") == [(12, True)]
+
+
+def test_repo_suppression_inventory_is_live():
+    """The audited WAL suppressions are real: the repo sweep carries
+    SUPPRESSED blocking-under-lock findings (fsync under the per-key
+    handoff lock), and zero stale directives anywhere."""
+    findings = analysis.run_lint(root=REPO)
+    assert any(f.rule == "concurrency-blocking-under-lock"
+               and f.suppressed and f.path.endswith("serve/wal.py")
+               for f in findings)
+    assert not any(f.rule == "lint-stale-suppression"
+                   for f in findings)
+
+
+# ------------------------------------------------------- drift gates
+
+
+def test_flag_drift_fixture():
+    from jepsen_tpu.analysis import drift
+    root = os.path.join(FIXTURES, "driftrepo")
+    fs = drift.flag_findings(root, "envflags.py", ("docs/flags.md",))
+    assert sorted((f.path, f.line) for f in fs) == [
+        ("docs/flags.md", 6), ("envflags.py", 8)]
+    msgs = " ".join(f.message for f in fs)
+    assert "JEPSEN_TPU_BETA" in msgs and "JEPSEN_TPU_GAMMA" in msgs
+    # the clean flag never shows up
+    assert "JEPSEN_TPU_ALPHA" not in msgs
+
+
+def test_metric_drift_fixture():
+    from jepsen_tpu.analysis import drift
+    root = os.path.join(FIXTURES, "driftrepo")
+    fs = drift.metric_findings(root,
+                               [os.path.join(root, "mints.py")],
+                               "docs/obs.md")
+    assert sorted((f.path, f.line) for f in fs) == [
+        ("docs/obs.md", 12), ("mints.py", 13)]
+    msgs = " ".join(f.message for f in fs)
+    assert "app.orphan" in msgs and "app.ghost" in msgs
+    # shorthand/label/wildcard rows all matched their mints: the
+    # leading-dot pair, the [tenant=<t>] base, the f-string pattern
+    for clean in ("app.hits", "app.misses", "app.depth",
+                  "app.latency", "app.dyn"):
+        assert clean not in msgs
+
+
+def test_drift_gates_pass_against_live_docs():
+    """The acceptance pin: drift found during the PR was FIXED in the
+    docs, not suppressed — both gates are empty on the live tree."""
+    from jepsen_tpu.analysis import drift
+    assert drift.flag_findings(REPO) == []
+    assert drift.metric_findings(
+        REPO, lint_core.default_targets(REPO)) == []
+
+
+def test_drift_gates_skipped_for_explicit_paths():
+    """Linting one file never drags in the repo-wide doc checks."""
+    fs = analysis.run_lint([os.path.join(FIXTURES, "locks_ok.py")],
+                           root=REPO)
+    assert fs == []
+
+
+# ----------------------------------------------------- --changed mode
+
+
+def test_changed_mode_contract():
+    import contextlib
+    import io
+    buf, err = io.StringIO(), io.StringIO()
+    with contextlib.redirect_stdout(buf), \
+            contextlib.redirect_stderr(err):
+        # a bad base ref and mixing --changed with explicit paths are
+        # USAGE errors (2), never lint verdicts
+        assert analysis.main(["--changed", "no-such-ref-xyz"]) == 2
+        assert analysis.main(["jepsen_tpu", "--changed"]) == 2
+        # the fast path itself: a clean tree (or clean changed files)
+        # exits 0, same contract as the full gate
+        assert analysis.main(["--changed"]) == 0
+
+
+def test_changed_files_shape():
+    files = analysis.changed_files(root=REPO)
+    assert isinstance(files, list)
+    for p in files:
+        assert p.endswith(".py") and os.path.isfile(p)
+        rel = os.path.relpath(p, REPO)
+        top = rel.split(os.sep, 1)[0]
+        assert top in ("jepsen_tpu", "tools") \
+            or rel in ("bench.py", "__graft_entry__.py")
