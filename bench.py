@@ -262,11 +262,24 @@ def emit_reshard_advisory(e, mesh, cap0: int, max_cap: int,
 def _enable_compile_cache():
     """Persistent compilation cache: lets a child reuse a sibling's
     compile for the same shape (e.g. maxlen re-probing the 10k shape).
-    Best-effort — some backends (remote-compile tunnels) ignore it."""
+    Best-effort — some backends (remote-compile tunnels) ignore it.
+
+    The destination honors JEPSEN_TPU_COMPILE_CACHE when it names a
+    directory (the serve fleet's program cache doubles as the bench
+    cache) and otherwise lands under the run's own ``store/`` dir —
+    never a fixed world-writable /tmp path, where a planted symlink
+    or a concurrent run on a shared box could cross-wire caches (the
+    same hazard class the ci.sh serve_smoke tempdir fix closed)."""
+    from jepsen_tpu import envflags
+    # read OUTSIDE the best-effort guard: a malformed flag value must
+    # fail loudly (the envflags contract), not degrade to the default
+    dest = envflags.env_path("JEPSEN_TPU_COMPILE_CACHE",
+                             what="cache directory")
+    cache_dir = dest or os.path.join("store", "bench_jax_cache")
     try:
         import jax
-        jax.config.update("jax_compilation_cache_dir",
-                          "/tmp/jepsen_bench_jax_cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:  # noqa: BLE001
         pass

@@ -277,6 +277,45 @@ def env_path(name: str, what: str = "path") -> Optional[str]:
 #                            process's id (0-based, < NPROC)
 #   JEPSEN_TPU_ENCODE_CACHE  env_int     parallel.pipeline — encode
 #                            cache capacity in entries (0 disables)
+#   JEPSEN_TPU_COMPILE_CACHE env_path    parallel.programs — the
+#                            compile-economics master switch
+#                            (docs/performance.md "Compile
+#                            economics"): unset/"0" off (plain jit
+#                            dispatch, byte-identical results and
+#                            schemas), "1" arms the in-process program
+#                            registry (AOT lower().compile() engine
+#                            programs, engine.programs.* counters,
+#                            serve.compile_secs histogram, freeze-time
+#                            program manifests for warm rehome
+#                            handoff), <dir> additionally persists
+#                            serialized executables there so a
+#                            restarted replica cold-starts warm
+#                            (loads are version/fingerprint-guarded:
+#                            a mismatch degrades to a fresh compile,
+#                            counted load_errors — never a wrong
+#                            program); bench.py reuses the same dir
+#                            for its jax compilation cache
+#   JEPSEN_TPU_CANON_SHAPES  env_bool    parallel.programs — shape
+#                            canonicalization: quantize one-shot and
+#                            resumable-chunk event-row counts onto the
+#                            EVENT_QUANTUM ladder (the streaming
+#                            chunk-padding precedent) so the
+#                            fleet-wide program population is dozens,
+#                            not one per history length; pad rows are
+#                            scan no-ops — verdict/counterexample/
+#                            max-frontier/configs-stepped identical
+#                            (parity-pinned); opt-in until perf_ab's
+#                            compile record shows the population win
+#                            against the pad-waste telemetry
+#   JEPSEN_TPU_PRECOMPILE    env_bool    parallel.programs — ladder
+#                            precompile: a background best-effort
+#                            thread pre-compiles the next capacity
+#                            rung (N doubled, same event shapes)
+#                            above every live AOT program so a
+#                            mid-incident escalation re-dispatch
+#                            finds its program resident (counted
+#                            engine.programs.precompiles); requires
+#                            JEPSEN_TPU_COMPILE_CACHE armed
 #   JEPSEN_TPU_TEST_WEDGE    env_bool    resilience.faults — legacy
 #                            alias for the bench child-wedge seam; =1
 #                            now injects an implicit `wedge@child`

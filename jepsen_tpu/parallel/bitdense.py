@@ -37,6 +37,7 @@ from jax import lax
 
 from jepsen_tpu import envflags
 from jepsen_tpu import obs
+from jepsen_tpu.parallel import programs
 from jepsen_tpu.parallel.encode import EncodedHistory
 from jepsen_tpu.parallel.steps import STEPS
 from jepsen_tpu.resilience import supervisor as sup
@@ -457,6 +458,12 @@ def check_encoded_bitdense(e: EncodedHistory,
         timings["transfer_secs"] = perf_counter() - t0
         t0 = perf_counter()
     ts0 = perf_counter()
+    # bitdense programs are not AOT-managed (the pallas closure path);
+    # the registry still counts their shape tuples so the fleet-wide
+    # program population perf_ab records covers every engine
+    programs.track("bitdense.check", xs,
+                   (e.step_name, S, C, e.state_lo, use_pallas,
+                    interpret, closure_mode, ss))
     with obs.span("bitdense.check", S=S, C=C), \
             obs.device_annotation(f"bitdense single S{S} C{C}"):
         def _search():
@@ -621,6 +628,12 @@ class PendingBitdenseBatch:
         self._t_issue = perf_counter()
         ann = obs.device_annotation(
             f"bitdense K{len(self.encs)} S{self.S} C{self.C}")
+        # population tracking only — the batch closure program is not
+        # AOT-managed (see the single-key site)
+        programs.track("bitdense.check_batch", self.xs,
+                       (self.encs[0].step_name, self.S, self.C,
+                        self.encs[0].state_lo, self.up,
+                        self.interpret, self.mode, self.search_stats))
         try:
             with ann:
                 # supervised (resilience.supervisor): faults inject

@@ -462,7 +462,8 @@ def _round_sparse(model, encs, capacity: int, max_capacity: int,
 
                 def _search(xs=xs, state0=state0, N=N, mode=mode):
                     import jax
-                    res = engine._check_device_batch(
+                    res = engine._run_program(
+                        "engine.check_batch",
                         xs, state0, step_name, N, dedupe, probe_limit,
                         mode, ss, pack)
                     return jax.tree.map(np.asarray, res)

@@ -40,6 +40,7 @@ from jax import lax
 from jepsen_tpu import envflags
 from jepsen_tpu import obs
 from jepsen_tpu.parallel import encode as enc_mod
+from jepsen_tpu.parallel import programs
 from jepsen_tpu.parallel.encode import EncodedHistory, EncodeError
 from jepsen_tpu.parallel.steps import STEPS
 from jepsen_tpu.resilience import supervisor as sup
@@ -1141,6 +1142,48 @@ def _check_device_batch_resumable(xs, carry0, step_name: str, N: int,
     return jax.vmap(one)(xs, carry0)
 
 
+# ------------------------------------------- compile-economics seam
+
+# The AOT-managed engine entries (jepsen_tpu.parallel.programs): name
+# -> (entry attr, traced-arg count, static names in the positional
+# order every call site uses). Attrs resolve through globals() at
+# call time so a test that monkeypatches an entry keeps its patch —
+# and a patched entry without .lower() falls back to the plain call.
+_PROGRAM_STATICS = ("step_name", "N", "dedupe", "probe_limit",
+                    "sparse_pallas", "search_stats", "pack")
+_PROGRAM_ENTRIES = {
+    "engine.check": ("_check_device", 2, _PROGRAM_STATICS),
+    "engine.check_resumable": ("_check_device_resumable", 2,
+                               _PROGRAM_STATICS),
+    "engine.check_batch": ("_check_device_batch", 2,
+                           _PROGRAM_STATICS),
+    "engine.check_batch_resumable": ("_check_device_batch_resumable",
+                                     2, _PROGRAM_STATICS),
+}
+
+
+def program_entries() -> dict:
+    """name -> (jitted entry, n_traced, static_names): what
+    programs.ProgramRegistry.warm_manifest pre-warms from (the serve
+    adopter's rehome path)."""
+    return {name: (globals()[attr], n, statics)
+            for name, (attr, n, statics) in _PROGRAM_ENTRIES.items()}
+
+
+def _run_program(name: str, *args):
+    """Dispatch one engine jit entry through the program registry when
+    JEPSEN_TPU_COMPILE_CACHE arms it — AOT lower().compile(), the
+    hit/miss/compile ledger, disk persistence, ladder precompile —
+    else the plain jit call. Flag off is byte-identical: same entry,
+    same args, no registry, no new metrics."""
+    attr, n_traced, static_names = _PROGRAM_ENTRIES[name]
+    entry = globals()[attr]
+    reg = programs.registry()
+    if reg is None or not hasattr(entry, "lower"):
+        return entry(*args)
+    return reg.call(name, entry, args, n_traced, static_names)
+
+
 # ------------------------------------------------------------- host API
 
 
@@ -1169,16 +1212,25 @@ def _place_owned(tree, device=None):
     return jax.tree.map(jnp.copy, _place(tree, device))
 
 
-def _xs_from_encoded(e: EncodedHistory, device=None) -> dict:
-    """Event arrays as device arrays, placed via _place."""
-    return _place({
+def _xs_from_encoded(e: EncodedHistory, device=None,
+                     canon: bool = False) -> dict:
+    """Event arrays as device arrays, placed via _place. ``canon``
+    quantizes the event-row count onto the EVENT_QUANTUM ladder when
+    JEPSEN_TPU_CANON_SHAPES arms it (pad rows are scan no-ops —
+    parity-safe; docs/performance.md "Compile economics"); only the
+    one-shot sparse path opts in — the sharded tier's xs feed
+    shard_map layouts that size to the exact R."""
+    xs = {
         "slot_f": e.slot_f,
         "slot_a0": e.slot_a0,
         "slot_a1": e.slot_a1,
         "slot_wild": e.slot_wild,
         "slot_occ": e.slot_occ,
         "ev_slot": e.ev_slot,
-    }, device)
+    }
+    if canon:
+        xs = programs.maybe_canon_rows(xs)
+    return _place(xs, device)
 
 
 class FrontierCheckpoint:
@@ -1401,9 +1453,10 @@ def check_encoded_resumable(e: EncodedHistory, capacity: int = 1024,
             dedupe, pack)
 
         def _chunk(lo=lo, hi=hi, cp=cp, mode=mode):
-            chunk = _place({k: v[lo:hi] for k, v in xs_np.items()},
-                           device)
-            out = _check_device_resumable(
+            chunk = _place(programs.maybe_canon_rows(
+                {k: v[lo:hi] for k, v in xs_np.items()}), device)
+            out = _run_program(
+                "engine.check_resumable",
                 chunk, cp.carry(device, pack, C_enc), e.step_name,
                 cp.capacity, dedupe, probe_limit, mode, ss, pack)
             # materialize inside the supervised window: async dispatch
@@ -1800,7 +1853,7 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
     # window, not at a later host read.
     xs, state0 = sup.dispatch(
         "transfer",
-        lambda: (_xs_from_encoded(e, device),
+        lambda: (_xs_from_encoded(e, device, canon=True),
                  _place(np.int32(e.state0), device)),
         backend=platform)
     N = max(64, capacity)
@@ -1814,9 +1867,9 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
                                                 platform, dedupe, pack)
 
             def _search(N=N, mode=mode):
-                out = _check_device(xs, state0, e.step_name, N,
-                                    dedupe, probe_limit, mode, ss,
-                                    pack)
+                out = _run_program(
+                    "engine.check", xs, state0, e.step_name, N,
+                    dedupe, probe_limit, mode, ss, pack)
                 # tree map (not a list comp): the stats output is a
                 # dict of arrays riding along under search_stats
                 return jax.tree.map(np.asarray, out)
@@ -2547,9 +2600,9 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
                     backend=platform)
 
                 def _search(xs=xs, state0=state0, N=N, mode=mode):
-                    out = _check_device_batch(xs, state0, step_name, N,
-                                              dedupe, probe_limit, mode,
-                                              ss, pack)
+                    out = _run_program(
+                        "engine.check_batch", xs, state0, step_name,
+                        N, dedupe, probe_limit, mode, ss, pack)
                     # materialize inside the supervised window
                     return jax.tree.map(np.asarray, out)
 

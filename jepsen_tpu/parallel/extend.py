@@ -67,7 +67,11 @@ _log = logging.getLogger(__name__)
 # stream of arbitrary-sized deltas compiles a handful of jit shapes
 # instead of one per delta length (pad events skip: run=False, and the
 # event index does not advance on them — see engine._scan_step_factory).
-EVENT_QUANTUM = 16
+# The quantum now lives in parallel.programs — the compile-economics
+# layer generalized this ladder to the one-shot paths
+# (JEPSEN_TPU_CANON_SHAPES) — and is re-exported here for its
+# historical importers.
+from jepsen_tpu.parallel.programs import EVENT_QUANTUM  # noqa: E402
 
 
 class FrontierOverflowError(RuntimeError):
@@ -244,7 +248,8 @@ def _advance_cp(e: EncodedHistory, cp, target: int, *, dedupe: str,
             import jax as _jax
             xs = engine._place(_xs_slice(e, lo, target, R_pad, C),
                                device)
-            out = engine._check_device_resumable(
+            out = engine._run_program(
+                "engine.check_resumable",
                 xs, cp.carry(device, pack, C), e.step_name,
                 cp.capacity, dedupe, probe_limit, mode, ss, pack)
             # materialize inside the supervised window (async dispatch
@@ -754,7 +759,8 @@ def _batch_leg(pairs, N: int, C_pad: int, dedupe: str,
         xs = engine._place(xs, device)
         # owned placement: the batched-resumable jit donates carry0
         carry0 = engine._place_owned(carry0, device)
-        out = engine._check_device_batch_resumable(
+        out = engine._run_program(
+            "engine.check_batch_resumable",
             xs, carry0, step_name, N, dedupe, probe_limit, mode,
             search_stats, pack)
         if search_stats:

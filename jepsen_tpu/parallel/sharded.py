@@ -51,6 +51,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from jepsen_tpu import obs
+from jepsen_tpu.parallel import programs
 from jepsen_tpu.parallel.encode import EncodedHistory
 from jepsen_tpu.parallel.engine import (N_PROBE_BUCKETS, _empty_table,
                                         _hash_insert_append, _next_pow2,
@@ -1306,6 +1307,16 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
                     # failures/hangs surface here, not at a host read
                     return jax.tree.map(np.asarray, out)
 
+                # population tracking only: shard_map programs carry
+                # mesh-bound layouts the AOT serializer does not
+                # round-trip — the registry counts their shape tuples
+                # (per tier) without managing the executables
+                programs.track(
+                    "sharded.check2d" if hier else "sharded.check",
+                    xs,
+                    (e.step_name, Nd, n_slice if hier else n_dev,
+                     n_chip if hier else 1, exchange, dedupe,
+                     probe_limit, mode, ss, pack))
                 # supervised dispatch (resilience.supervisor): site
                 # "sharded" so the fault matrix can target the tier
                 # path; failures degrade at the callers (analysis /

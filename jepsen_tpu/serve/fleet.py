@@ -20,7 +20,13 @@ self-healing"):
     again is admitted back through the breaker's half-open probe
     (``fleet.rejoins``) — for NEW keys only; the keys it lost stay
     PINNED to their adopters (``pins``), and the epoch fence refuses
-    it the old ones regardless.
+    it the old ones regardless. With JEPSEN_TPU_COMPILE_CACHE armed
+    the rehome is additionally a WARM handoff: ``transfer_key`` ships
+    the dead replica's compiled-program manifest beside the WAL
+    segments and ``adopt_keys`` pre-warms it before replaying, so the
+    adopter's first post-adoption delta never pays first-dispatch
+    compile on the verdict SLO (docs/streaming.md, docs/performance.md
+    "Compile economics").
 
 :class:`SegmentReplicator`
     Ships a key's WAL segments to its ring successor's ``repl/``

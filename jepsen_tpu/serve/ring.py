@@ -162,12 +162,18 @@ def transfer_key(src_wal_dir: str, dst_wal_dir: str, key) -> dict:
     crashed replica's dir is evidence; the operator removes it after
     the fleet is green), and the destination files land under the
     same deterministic names ``adopt_keys``'s recovery scan reads.
-    Returns ``{"segments": n, "checkpoint": bool}``. The copied
-    segments carry any per-delta trace ids the old owner stamped
-    (``DeltaWAL.append(delta_id=...)``) — which is how a migrated
-    delta's causal chain survives the replica boundary: the adopter's
-    thaw/apply spans re-tag the same ids, and the merged fleet trace
-    (``jepsen trace``) reads one chain across both process tracks."""
+    Returns ``{"segments": n, "checkpoint": bool, "manifest": bool}``.
+    The copied segments carry any per-delta trace ids the old owner
+    stamped (``DeltaWAL.append(delta_id=...)``) — which is how a
+    migrated delta's causal chain survives the replica boundary: the
+    adopter's thaw/apply spans re-tag the same ids, and the merged
+    fleet trace (``jepsen trace``) reads one chain across both process
+    tracks. The ``.programs.json`` manifest rides along when the old
+    owner wrote one (JEPSEN_TPU_COMPILE_CACHE armed): it names the
+    compiled-program population ``adopt_keys`` pre-warms BEFORE
+    replaying, so the adopter's first post-adoption delta dispatches
+    without paying first-touch compile (docs/streaming.md
+    "warm-handoff contract")."""
     os.makedirs(dst_wal_dir, exist_ok=True)
     with obs.span("serve.ring.transfer", key=str(key)):
         segs = DeltaWAL(src_wal_dir).segments(key)
@@ -176,16 +182,21 @@ def transfer_key(src_wal_dir: str, dst_wal_dir: str, key) -> dict:
                                             os.path.basename(path)))
         stem = _safe_name(key)
         has_cp = False
+        has_manifest = False
         src_cps = os.path.join(src_wal_dir, "checkpoints")
-        for ext in (".json", ".npz"):
+        for ext in (".json", ".npz", ".programs.json"):
             p = os.path.join(src_cps, stem + ext)
             if os.path.exists(p):
                 dst_cps = os.path.join(dst_wal_dir, "checkpoints")
                 os.makedirs(dst_cps, exist_ok=True)
                 shutil.copy2(p, os.path.join(dst_cps, stem + ext))
-                has_cp = True
+                if ext == ".programs.json":
+                    has_manifest = True
+                else:
+                    has_cp = True
     obs.counter("serve.ring.keys_transferred").inc()
-    return {"segments": len(segs), "checkpoint": has_cp}
+    return {"segments": len(segs), "checkpoint": has_cp,
+            "manifest": has_manifest}
 
 
 def _key_sources(dead_wal_dir: str,
@@ -277,6 +288,7 @@ def rehome_dead_replica(dead_wal_dir: str, ring: HashRing,
     # deleted
     can_fence = os.path.isdir(dead_wal_dir)
     for node, node_keys in plan.items():
+        n_manifests = 0
         dst = wal_dirs[node]
         for key in node_keys:
             src = sources[key]
@@ -289,9 +301,12 @@ def rehome_dead_replica(dead_wal_dir: str, ring: HashRing,
                 except OSError as err:
                     _log.warning("rehome: could not fence key %r in "
                                  "%s (%r)", key, dead_wal_dir, err)
-            transfer_key(src, dst, key)
-        _log.info("rehome: %d key(s) from dead %r -> %r",
-                  len(node_keys), dead_node, node)
+            info = transfer_key(src, dst, key)
+            if info.get("manifest"):
+                n_manifests += 1
+        _log.info("rehome: %d key(s) from dead %r -> %r "
+                  "(%d program manifest(s) for warm handoff)",
+                  len(node_keys), dead_node, node, n_manifests)
     if services:
         for node in plan:
             svc = services.get(node)

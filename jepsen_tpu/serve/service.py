@@ -64,6 +64,7 @@ from typing import Dict, Optional
 from jepsen_tpu import edn, envflags, obs
 from jepsen_tpu.history import TYPES
 from jepsen_tpu.parallel import extend as ext
+from jepsen_tpu.parallel import programs
 from jepsen_tpu.serve import tenancy
 from jepsen_tpu.serve.wal import CheckpointStore, DeltaWAL
 
@@ -1258,6 +1259,12 @@ class CheckerService:
         adopted keys."""
         if self._wal is None:
             raise RuntimeError("adopt_keys needs a WAL-backed service")
+        # warm handoff, ordered BEFORE replay (docs/streaming.md
+        # contract): pre-warm every transferred program manifest so
+        # the replay itself — and the first post-adoption delta —
+        # dispatches compiled programs instead of paying first-touch
+        # compile on the verdict SLO
+        self._prewarm_programs()
         adopted = []
 
         def _replaceable(cur) -> bool:
@@ -1315,6 +1322,28 @@ class CheckerService:
             _log.info("serve: adopted %d key(s) from transferred WAL "
                       "segments", len(adopted))
         return adopted
+
+    def _prewarm_programs(self) -> None:
+        """Compile (or cache-load) every program the transferred
+        ``.programs.json`` manifests name. Runs lock-free on the
+        adopter's calling thread; a no-op unless
+        JEPSEN_TPU_COMPILE_CACHE armed the registry. Malformed
+        manifests degrade to plain first-dispatch compile — warm
+        handoff is an optimization, never a correctness gate."""
+        reg = programs.registry()
+        if reg is None or self._cps is None:
+            return
+        import glob
+
+        from jepsen_tpu.parallel import engine
+        entries = engine.program_entries()
+        warmed = 0
+        for path in sorted(glob.glob(os.path.join(
+                self._cps.root, "*.programs.json"))):
+            warmed += reg.warm_manifest(path, entries)
+        if warmed:
+            _log.info("serve: pre-warmed %d program(s) from "
+                      "transferred manifests", warmed)
 
     # -------------------------------------------------- worker side
 
@@ -1676,12 +1705,30 @@ class CheckerService:
         meta["applied_seq"] = ks.applied_seq
         meta["finalized"] = ks.finalized
         self._cps.save(ks.key, meta)
+        self._write_program_manifest(ks.key)
         if locked:
             ks.session = None
         else:
             with self._cond:
                 ks.session = None
         obs.counter("serve.evictions").inc()
+
+    def _write_program_manifest(self, key) -> None:
+        """Beside the frozen checkpoint pair, record the process's
+        compiled-program population (parallel.programs manifest) so
+        ``serve.ring.transfer_key`` ships it and the adopter pre-warms
+        before replaying — the warm-handoff half of the compile-
+        economics contract (docs/streaming.md). A no-op unless
+        JEPSEN_TPU_COMPILE_CACHE armed the registry; best-effort —
+        the freeze that just landed must not fail over telemetry."""
+        reg = programs.registry()
+        if reg is None:
+            return
+        try:
+            reg.write_manifest(self._cps.manifest_path(key))
+        except Exception as err:  # noqa: BLE001 — advisory artifact
+            _log.warning("program manifest write failed for key %r: "
+                         "%s", key, err)
 
     def freeze_key(self, key) -> bool:
         """Freeze one key NOW (the graceful-migration primitive —

@@ -585,6 +585,13 @@ class CheckpointStore:
     def checkpoint_path(self, key) -> str:
         return self._paths(key)[0]
 
+    def manifest_path(self, key) -> str:
+        """The key's compiled-program manifest (written at freeze when
+        JEPSEN_TPU_COMPILE_CACHE is armed; shipped by
+        ``serve.ring.transfer_key``; pre-warmed by ``adopt_keys``)."""
+        return os.path.join(self.root,
+                            _safe_name(key) + ".programs.json")
+
     def load(self, key) -> Tuple[Optional[object], Optional[dict]]:
         """(FrontierCheckpoint | None, meta | None)."""
         npz, jpath = self._paths(key)
@@ -610,7 +617,7 @@ class CheckpointStore:
         return cp, meta
 
     def drop(self, key) -> None:
-        for p in self._paths(key):
+        for p in self._paths(key) + (self.manifest_path(key),):
             try:
                 os.remove(p)
             except OSError:

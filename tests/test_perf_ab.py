@@ -224,3 +224,57 @@ def test_perf_ab_elastic_unknown_arm_raises():
     assert "unknown arm" in r.stderr, r.stderr[-500:]
     assert "reshards" in r.stderr
     assert "steal,reshard" in r.stderr, r.stderr[-500:]
+
+
+def _load_perf_ab():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "perf_ab", os.path.join(REPO, "tools", "perf_ab.py"))
+    perf_ab = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(perf_ab)
+    return perf_ab
+
+
+@pytest.mark.slow
+def test_compile_record_warm_beats_cold_on_chip_matrix():
+    """The compile-economics acceptance pin, at CPU scale: for both
+    chip-matrix ks (the dedupe A/B's k=8 / k=12 pair, derated to tiny
+    op counts), the warm-cache arm serves its FIRST dispatch with zero
+    fresh compiles — the registry ledger proves it (compiles == 0,
+    preloads >= 1, load_errors == 0), not a timing inference — and
+    strictly faster than the cold arm, with a bit-identical verdict
+    pin. The population record rides along: canonicalization must
+    never *increase* the distinct-program count, and the jittered
+    extra_rows here (three lengths, one quantum rung) must shrink it."""
+    perf_ab = _load_perf_ab()
+    out = perf_ab.compile_record([(200, 8), (200, 6)],
+                                 extra_rows=[100, 101, 120])
+    assert len(out["records"]) == 2
+    for rec in out["records"]:
+        assert "cold_error" not in rec and "warm_error" not in rec, rec
+        assert "pin_mismatch" not in rec, rec
+        assert rec["cold_compiles"] >= 1, rec
+        assert rec["warm_compiles"] == 0, rec
+        assert rec["warm_preloads"] >= 1, rec
+        assert rec["warm_load_errors"] == 0, rec
+        assert (rec["warm_first_dispatch_secs"]
+                < rec["cold_first_dispatch_secs"]), rec
+    pop = out["population"]
+    assert pop["canon"] <= pop["exact"], pop
+    assert pop["canon"] < pop["exact"], pop   # 100/101/120 share rungs
+    assert pop["canon"] >= 1
+
+
+def test_perf_ab_compile_invalid_value_raises():
+    """PERF_AB_COMPILE gets the same typo-protection as the other
+    selector envs: anything but 0/1 aborts at import with the valid
+    set named."""
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env.update({"PERF_AB_COMPILE": "yes", "JAX_PLATFORMS": "cpu"})
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_ab.py")],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode != 0, r.stdout[-500:]
+    assert "PERF_AB_COMPILE" in r.stderr, r.stderr[-500:]
+    assert "valid: 0,1" in r.stderr, r.stderr[-500:]

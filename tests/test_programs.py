@@ -1,0 +1,420 @@
+"""Compile-economics suite (ISSUE 17): shape canonicalization, the
+AOT program registry, cache persistence, and the warm-handoff seam.
+
+The load-bearing invariants:
+
+- Representation never changes results: with JEPSEN_TPU_CANON_SHAPES
+  armed (event rows quantized onto the EVENT_QUANTUM ladder) and with
+  executables served from the JEPSEN_TPU_COMPILE_CACHE registry —
+  in-memory or deserialized from disk — verdict, failing op/event,
+  max-frontier, and configs-stepped are pinned identical to the
+  flag-off path, per packable family, clean and corrupted.
+- The cache DEGRADES, never lies: a stale jax version, a wrong shape
+  key, truncated or unpicklable bytes each produce a counted
+  ``engine.programs.load_errors`` plus a fresh compile with the right
+  answer — never a crash, never a wrong program.
+- A restarted replica with a populated cache serves its first delta
+  with ZERO fresh compiles (the ledger proves it: compiles == 0,
+  preloads >= 1) and a verdict bit-identical to the one-shot check.
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.envflags import EnvFlagError
+from jepsen_tpu.histories import (corrupt_history, rand_fifo_history,
+                                  rand_gset_history, rand_queue_history,
+                                  rand_register_history)
+from jepsen_tpu.history import History, invoke_op, ok_op
+from jepsen_tpu.models import (CASRegister, FIFOQueue, GSet, Mutex,
+                               UnorderedQueue)
+from jepsen_tpu.parallel import encode as enc_mod, engine, programs
+from jepsen_tpu.serve import CheckerService
+
+PIN = ("valid?", "op", "fail-event", "max-frontier", "configs-stepped")
+
+
+def _pin(r):
+    return {k: r.get(k) for k in PIN}
+
+
+def _oneshot(Model, ops, capacity=128):
+    e = enc_mod.encode(Model(), History.wrap(list(ops)))
+    return engine.check_encoded(e, capacity=capacity)
+
+
+# same generators (and therefore the same compiled reference shapes)
+# as tests/test_dedupe.py / tests/test_config_pack.py — the flag-off
+# baselines here ride the jit cache those suites already warmed
+FAMILIES = [
+    ("cas-register", CASRegister,
+     lambda: rand_register_history(n_ops=40, n_processes=5, n_values=3,
+                                   crash_p=0.06, fail_p=0.08, seed=31)),
+    ("gset", GSet,
+     lambda: rand_gset_history(n_ops=36, n_processes=4, n_elements=9,
+                               crash_p=0.06, seed=33)),
+    ("uqueue", UnorderedQueue,
+     lambda: rand_queue_history(n_ops=26, n_processes=4, n_values=3,
+                                crash_p=0.06, seed=34)),
+    ("fifo", FIFOQueue,
+     lambda: rand_fifo_history(n_ops=24, n_processes=4, n_values=3,
+                               crash_p=0.05, seed=35)),
+]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry(monkeypatch):
+    """Every test starts flag-off with no process registry, and leaves
+    none behind — the suite must not warm a later test's cache."""
+    for var in ("JEPSEN_TPU_COMPILE_CACHE", "JEPSEN_TPU_CANON_SHAPES",
+                "JEPSEN_TPU_PRECOMPILE"):
+        monkeypatch.delenv(var, raising=False)
+    programs.reset()
+    yield monkeypatch
+    programs.reset()
+
+
+# ------------------------------------------------------- quantum math
+
+
+def test_quantize_rows_ladder():
+    assert programs.quantize_rows(1) == programs.EVENT_QUANTUM
+    assert programs.quantize_rows(16) == 16
+    assert programs.quantize_rows(17) == 32
+    assert programs.quantize_rows(260) == 272
+    # monotone, idempotent, never shrinks
+    prev = 0
+    for n in range(1, 200, 7):
+        q = programs.quantize_rows(n)
+        assert q >= n and q % programs.EVENT_QUANTUM == 0
+        assert q >= prev
+        assert programs.quantize_rows(q) == q
+        prev = q
+
+
+def test_population_counts_shrinks_under_canon():
+    pop = programs.population_counts([100, 101, 112, 120, 260])
+    assert pop["exact"] == 5
+    # 100/101/112 -> 112, 120 -> 128, 260 -> 272
+    assert pop["canon"] == 3
+    assert programs.population_counts([]) == {"exact": 0, "canon": 0}
+
+
+def test_pad_rows_fill_values_and_noop():
+    xs = {"ev_slot": np.array([0, 1], np.int32),
+          "f": np.array([[1, 2], [3, 4]], np.int32),
+          "b": np.array([True, False])}
+    out = programs.pad_rows(xs, 5)
+    assert out["f"].shape == (5, 2) and out["b"].shape == (5,)
+    assert (out["ev_slot"][2:] == -1).all()    # the scan-skip marker
+    assert (out["f"][:2] == xs["f"]).all()
+    assert (out["f"][2:] == -1).all()          # int pad rows are -1
+    assert (out["b"][:2] == xs["b"]).all()
+    assert not out["b"][2:].any()              # bool pad rows False
+    same = programs.pad_rows(xs, 2)            # no-op: already there
+    assert same["f"] is xs["f"]
+
+
+# ---------------------------------------------------- flag validation
+
+
+def test_flag_validation_fails_loud(_fresh_registry):
+    mp = _fresh_registry
+    mp.setenv("JEPSEN_TPU_CANON_SHAPES", "maybe")
+    with pytest.raises(EnvFlagError):
+        programs.canon_armed()
+    mp.setenv("JEPSEN_TPU_PRECOMPILE", "yes")
+    with pytest.raises(EnvFlagError):
+        programs.precompile_armed()
+    mp.setenv("JEPSEN_TPU_COMPILE_CACHE", "   ")
+    with pytest.raises(EnvFlagError):
+        programs.resolve_cache()
+
+
+def test_flag_off_means_no_registry():
+    assert programs.registry() is None
+    # track() is a no-op, not an arm-by-side-effect
+    programs.track("engine.check", {"x": np.zeros(3, np.int32)}, ("s",))
+    assert programs.registry() is None
+
+
+# ------------------------------------------------------ canon parity
+
+
+@pytest.mark.parametrize("name,Model,gen", FAMILIES,
+                         ids=[c[0] for c in FAMILIES])
+def test_canon_parity_families(_fresh_registry, name, Model, gen):
+    """Canonicalized shapes + registry dispatch == flag-off, bit for
+    bit, on every pinned field."""
+    ops = list(gen())
+    base = _pin(_oneshot(Model, ops))
+    mp = _fresh_registry
+    mp.setenv("JEPSEN_TPU_CANON_SHAPES", "1")
+    mp.setenv("JEPSEN_TPU_COMPILE_CACHE", "1")   # in-memory registry
+    programs.reset()
+    r = _oneshot(Model, ops)
+    assert _pin(r) == base, name
+    st = programs.registry().stats()
+    assert st["misses"] >= 1 and st["compiles"] >= 1, st
+
+
+def test_canon_parity_corrupted_and_mutex(_fresh_registry):
+    """The invalid verdicts (a corrupted register stream, a mutex
+    double-acquire) localize to the SAME op/event under the canon +
+    registry path — padding must never shift the counterexample."""
+    h = corrupt_history(FAMILIES[0][2](), seed=7, n_corruptions=2)
+    mx = [invoke_op(0, "acquire", None), ok_op(0, "acquire", None),
+          invoke_op(1, "acquire", None), ok_op(1, "acquire", None)]
+    base_r = _pin(_oneshot(CASRegister, list(h)))
+    base_m = _pin(_oneshot(Mutex, mx, capacity=64))
+    mp = _fresh_registry
+    mp.setenv("JEPSEN_TPU_CANON_SHAPES", "1")
+    mp.setenv("JEPSEN_TPU_COMPILE_CACHE", "1")
+    programs.reset()
+    assert _pin(_oneshot(CASRegister, list(h))) == base_r
+    rm = _oneshot(Mutex, mx, capacity=64)
+    assert rm["valid?"] is False
+    assert _pin(rm) == base_m
+
+
+def test_registry_hit_on_second_dispatch(_fresh_registry):
+    mp = _fresh_registry
+    mp.setenv("JEPSEN_TPU_COMPILE_CACHE", "1")
+    programs.reset()
+    ops = list(FAMILIES[0][2]())
+    _oneshot(CASRegister, ops)
+    st1 = programs.registry().stats()
+    _oneshot(CASRegister, ops)
+    st2 = programs.registry().stats()
+    assert st2["hits"] > st1["hits"], (st1, st2)
+    assert st2["compiles"] == st1["compiles"], (st1, st2)
+
+
+# ------------------------------------------------- disk cache + safety
+
+
+def _populate(tmp_path, mp, ops):
+    """One checked run against a fresh on-disk cache; returns the
+    cache dir and the baseline pin."""
+    cache = str(tmp_path / "progcache")
+    mp.setenv("JEPSEN_TPU_COMPILE_CACHE", cache)
+    programs.reset()
+    base = _pin(_oneshot(CASRegister, ops))
+    st = programs.registry().stats()
+    assert st["compiles"] >= 1, st
+    jprogs = [f for f in os.listdir(cache) if f.endswith(".jprog")]
+    assert jprogs, "no executable persisted"
+    return cache, base
+
+
+def test_cache_roundtrip_restart_zero_compiles(_fresh_registry,
+                                               tmp_path):
+    ops = list(FAMILIES[0][2]())
+    cache, base = _populate(tmp_path, _fresh_registry, ops)
+    programs.reset()                      # the process "restart"
+    r = _oneshot(CASRegister, ops)
+    st = programs.registry().stats()
+    assert st["compiles"] == 0, st
+    assert st["preloads"] >= 1, st
+    assert st["load_errors"] == 0, st
+    assert _pin(r) == base
+
+
+def _corrupt(path, how):
+    if how == "stale-version":
+        with open(path, "rb") as fh:
+            blob = pickle.load(fh)
+        blob["fingerprint"]["jax"] = "0.0.0"
+        with open(path, "wb") as fh:
+            pickle.dump(blob, fh)
+    elif how == "wrong-key":
+        with open(path, "rb") as fh:
+            blob = pickle.load(fh)
+        blob["fingerprint"]["key"] = "deadbeef" * 4
+        with open(path, "wb") as fh:
+            pickle.dump(blob, fh)
+    elif how == "truncated":
+        raw = open(path, "rb").read()
+        with open(path, "wb") as fh:
+            fh.write(raw[: len(raw) // 2])
+    elif how == "garbage":
+        with open(path, "wb") as fh:
+            fh.write(b"not a serialized executable")
+    else:  # pragma: no cover
+        raise AssertionError(how)
+
+
+@pytest.mark.parametrize("how", ["stale-version", "wrong-key",
+                                 "truncated", "garbage"])
+def test_cache_load_degrades_never_lies(_fresh_registry, tmp_path,
+                                        how):
+    """Every corruption mode lands in the same place: counted
+    load_errors, a fresh compile, the right answer."""
+    ops = list(FAMILIES[0][2]())
+    cache, base = _populate(tmp_path, _fresh_registry, ops)
+    for f in os.listdir(cache):
+        if f.endswith(".jprog"):
+            _corrupt(os.path.join(cache, f), how)
+    programs.reset()
+    r = _oneshot(CASRegister, ops)
+    st = programs.registry().stats()
+    assert st["load_errors"] >= 1, (how, st)
+    assert st["compiles"] >= 1, (how, st)
+    assert st["preloads"] == 0, (how, st)
+    assert _pin(r) == base, how
+
+
+def test_torn_tmp_file_is_ignored(_fresh_registry, tmp_path):
+    """A kill mid-persist leaves only a ``.tmp.<pid>`` file (the
+    os.replace discipline); the loader must not even look at it."""
+    ops = list(FAMILIES[0][2]())
+    cache, base = _populate(tmp_path, _fresh_registry, ops)
+    with open(os.path.join(cache, "0" * 32 + ".jprog.tmp.999"),
+              "wb") as fh:
+        fh.write(b"torn mid-write")
+    programs.reset()
+    r = _oneshot(CASRegister, ops)
+    st = programs.registry().stats()
+    assert st["load_errors"] == 0, st
+    assert st["preloads"] >= 1 and st["compiles"] == 0, st
+    assert _pin(r) == base
+
+
+def test_swapped_cache_files_never_serve_wrong_program(
+        _fresh_registry, tmp_path):
+    """Two populated digests with their files swapped on disk: the
+    fingerprint's embedded shape key catches both — two load_errors,
+    two fresh compiles, both verdicts still right. (A run can persist
+    more than two programs — the capacity ladder compiles one per
+    rung — so swap the first two and leave the rest alone.)"""
+    mp = _fresh_registry
+    ops_a = list(FAMILIES[0][2]())
+    ops_b = list(FAMILIES[1][2]())
+    cache = str(tmp_path / "progcache")
+    mp.setenv("JEPSEN_TPU_COMPILE_CACHE", cache)
+    programs.reset()
+    base_a = _pin(_oneshot(CASRegister, ops_a))
+    base_b = _pin(_oneshot(GSet, ops_b))
+    files = sorted(f for f in os.listdir(cache)
+                   if f.endswith(".jprog"))
+    assert len(files) >= 2, files
+    pa, pb = (os.path.join(cache, f) for f in files[:2])
+    tmp = pa + ".swap"
+    os.replace(pa, tmp)
+    os.replace(pb, pa)
+    os.replace(tmp, pb)
+    programs.reset()
+    ra = _oneshot(CASRegister, ops_a)
+    rb = _oneshot(GSet, ops_b)
+    st = programs.registry().stats()
+    assert st["load_errors"] >= 2, st
+    assert st["compiles"] >= 2, st
+    assert _pin(ra) == base_a and _pin(rb) == base_b
+
+
+# --------------------------------------------- manifests + warm serve
+
+
+def test_manifest_roundtrip_prewarms(_fresh_registry, tmp_path):
+    """write_manifest -> (restart) -> warm_manifest pre-compiles the
+    named programs from the shared disk cache, so the dispatch that
+    follows is a pure hit."""
+    mp = _fresh_registry
+    ops = list(FAMILIES[0][2]())
+    cache, base = _populate(tmp_path, mp, ops)
+    reg = programs.registry()
+    mpath = str(tmp_path / "k.programs.json")
+    assert reg.write_manifest(mpath) >= 1
+    programs.reset()
+    reg2 = programs.registry()
+    warmed = reg2.warm_manifest(mpath, engine.program_entries())
+    assert warmed >= 1
+    st = reg2.stats()
+    assert st["manifest_warms"] >= 1 and st["compiles"] == 0, st
+    r = _oneshot(CASRegister, ops)
+    st2 = reg2.stats()
+    assert st2["hits"] >= 1 and st2["compiles"] == 0, st2
+    assert _pin(r) == base
+
+
+def test_manifest_garbage_degrades(_fresh_registry, tmp_path):
+    mp = _fresh_registry
+    mp.setenv("JEPSEN_TPU_COMPILE_CACHE", str(tmp_path / "c"))
+    programs.reset()
+    bad = tmp_path / "bad.programs.json"
+    bad.write_text("{not json")
+    reg = programs.registry()
+    assert reg.warm_manifest(str(bad),
+                             engine.program_entries()) == 0
+    assert reg.stats()["load_errors"] >= 1
+
+
+def test_empty_registry_writes_no_manifest(_fresh_registry, tmp_path):
+    mp = _fresh_registry
+    mp.setenv("JEPSEN_TPU_COMPILE_CACHE", "1")
+    programs.reset()
+    mpath = str(tmp_path / "empty.programs.json")
+    assert programs.registry().write_manifest(mpath) == 0
+    assert not os.path.exists(mpath)       # no file beats an empty one
+
+
+# ------------------------------------------- restarted-replica pinned
+
+
+def test_restarted_service_first_delta_zero_compiles(_fresh_registry,
+                                                     tmp_path):
+    """The serve-fleet acceptance pin: a replica restarted against a
+    populated compile cache serves its FIRST post-restart delta (WAL
+    replay included) with zero fresh compiles, and the final answer is
+    bit-identical to the same delta stream fed flag-off with no
+    restart. (The delta-fed pin is the session's own, not the
+    one-shot's: on an escalating history the resumable scan legitimately
+    steps fewer configs than a from-scratch check — the verdict is
+    still cross-checked against the one-shot.)"""
+    mp = _fresh_registry
+    m = CASRegister()
+    h = list(rand_register_history(n_ops=64, n_processes=5, n_values=3,
+                                   crash_p=0.03, fail_p=0.05, seed=41))
+    cuts = ((0, 16), (16, 32), (32, 48), (48, 64))
+
+    # flag-off, single-process baseline
+    ref_svc = CheckerService(m, wal_dir=str(tmp_path / "wal_ref"),
+                             capacity=128)
+    try:
+        for a, b in cuts:
+            ref_svc.submit("k", h[a:b], wait=True, timeout=120)
+        base = _pin(ref_svc.finalize("k", timeout=120))
+    finally:
+        ref_svc.close()
+    assert programs.registry() is None    # baseline really was flag-off
+
+    mp.setenv("JEPSEN_TPU_COMPILE_CACHE", str(tmp_path / "progcache"))
+    mp.setenv("JEPSEN_TPU_CANON_SHAPES", "1")
+    programs.reset()
+    wal = str(tmp_path / "wal")
+    svc = CheckerService(m, wal_dir=wal, capacity=128)
+    try:
+        for a, b in cuts[:3]:
+            r = svc.submit("k", h[a:b], wait=True, timeout=120)
+            assert "valid?" in r, r
+    finally:
+        svc.close()
+    assert programs.registry().stats()["compiles"] >= 1
+
+    programs.reset()                      # the replica "restart"
+    svc2 = CheckerService(m, wal_dir=wal, capacity=128)
+    try:
+        a, b = cuts[3]
+        r = svc2.submit("k", h[a:b], wait=True, timeout=120)
+        assert "valid?" in r, r
+        st = programs.registry().stats()
+        assert st["compiles"] == 0, st
+        assert st["preloads"] >= 1, st
+        final = svc2.finalize("k", timeout=120)
+    finally:
+        svc2.close()
+    assert _pin(final) == base
+    assert final["valid?"] == _oneshot(CASRegister, h)["valid?"]

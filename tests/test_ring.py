@@ -16,7 +16,7 @@ import pytest
 from jepsen_tpu.histories import corrupt_history, rand_register_history
 from jepsen_tpu.history import History
 from jepsen_tpu.models import CASRegister
-from jepsen_tpu.parallel import encode as enc_mod, engine
+from jepsen_tpu.parallel import encode as enc_mod, engine, programs
 from jepsen_tpu.serve import CheckerService, DeltaWAL
 from jepsen_tpu.serve import ring as ring_mod
 
@@ -172,22 +172,46 @@ def _http_deltas(port, reqs, timeout=180):
                 resp.read().decode().splitlines()]
 
 
-def test_kill9_replica_rehomes_keys_bit_identical(tmp_path):
+def test_kill9_replica_rehomes_keys_bit_identical(tmp_path,
+                                                  monkeypatch):
     """THE acceptance pin: kill -9 a replica process mid-stream; its
     keys re-home onto a survivor via WAL-segment transfer + the
     frozen checkpoint (eviction froze the key before the kill, so the
     handoff exercises freeze/thaw, not just replay), and the migrated
     key's final verdict is bit-identical to an unmigrated one-shot
-    check of the same ops."""
+    check of the same ops.
+
+    Compile economics rides the same kill (ISSUE 17): the replica and
+    the survivor share one JEPSEN_TPU_COMPILE_CACHE dir (+ canonical
+    shapes, the run-it-fleet-wide posture docs/streaming.md requires);
+    the frozen key's program manifest travels with the WAL segments,
+    adoption pre-warms from it, and the survivor's first POST-adoption
+    delta is served with zero fresh compiles — the registry ledger
+    proves the warm handoff, the pin proves it changed nothing."""
     m = CASRegister()
-    h = _history(seed=7)
+    # seed=2: the stream's slot concurrency C is already at its final
+    # width by the first delta, so the canonical-shapes contract can
+    # hold exactly — the adopter's chunk shapes all match programs the
+    # dead replica compiled (canon quantizes event ROWS; a delta that
+    # widens C legitimately compiles fresh — the docs/streaming.md
+    # canonical-shapes caveat)
+    h = _history(seed=2)
+    # ref computed BEFORE arming the flags: the baseline stays
+    # flag-off, and the test-process registry ledger starts at zero —
+    # every compile it ever counts is the survivor's own
     ref = _oneshot(h)
+    cache_dir = str(tmp_path / "progcache")
+    monkeypatch.setenv("JEPSEN_TPU_COMPILE_CACHE", cache_dir)
+    monkeypatch.setenv("JEPSEN_TPU_CANON_SHAPES", "1")
+    programs.reset()
     dead_dir = str(tmp_path / "dead")
     live_dir = str(tmp_path / "live")
     script = tmp_path / "replica.py"
     script.write_text(_CHILD)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ, JAX_PLATFORMS="cpu",
+               JEPSEN_TPU_COMPILE_CACHE=cache_dir,
+               JEPSEN_TPU_CANON_SHAPES="1",
                PYTHONPATH=repo + os.pathsep
                + os.environ.get("PYTHONPATH", ""))
     env.pop("JEPSEN_TPU_FAULTS", None)
@@ -235,6 +259,16 @@ def test_kill9_replica_rehomes_keys_bit_identical(tmp_path):
         rr = survivor.result(key, timeout=150)
         assert _pin(rr) == _pin(ref), "migrated verdict diverged"
         assert rr["seq"] == 2   # the acked-but-unapplied delta landed
+        # warm handoff engaged: delta 2 — acked by the dead replica,
+        # never applied by it, so the FIRST delta the adopter serves —
+        # ran with ZERO fresh compiles: every program came through the
+        # transferred manifest / shared disk cache (the dead replica
+        # compiled it; the ledger proves the adopter never had to)
+        st = programs.registry().stats()
+        assert st["compiles"] == 0, st
+        assert st["manifest_warms"] >= 1 or st["preloads"] >= 1, st
+        assert st["hits"] >= 1, st
+        assert st["load_errors"] == 0, st
         f = survivor.finalize(key, timeout=150)
         assert _pin(f) == _pin(ref)
     finally:
@@ -242,3 +276,4 @@ def test_kill9_replica_rehomes_keys_bit_identical(tmp_path):
             proc.kill()
         if survivor is not None:
             survivor.close()
+        programs.reset()

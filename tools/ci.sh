@@ -18,7 +18,11 @@
 #      plus the two-tenant HTTP-ingress fairness wiring (flood shed
 #      with tenant attribution, quiet tenant fully acked); runs with
 #      JEPSEN_TPU_TRACE armed so the next stage can schema-validate
-#      the delta-tagged span export
+#      the delta-tagged span export, and with JEPSEN_TPU_COMPILE_CACHE
+#      armed (isolated tempdir) so the /metrics check asserts the
+#      compile-economics surface — jepsen_serve_compile_secs_bucket +
+#      the jepsen_engine_programs_* registry ledger
+#      (docs/performance.md "Compile economics")
 #   1c'. trace-schema validator — `jepsen trace --validate` over the
 #      smoke's Chrome-trace export (phase codes, pid/tid, span ids,
 #      parent resolution — the docs/observability.md export contract)

@@ -98,6 +98,16 @@ if _UNKNOWN_E:
                      f"{sorted(_UNKNOWN_E)}; valid: "
                      f"{','.join(_ELASTIC_VALID)}")
 
+# PERF_AB_COMPILE=0 skips the compile-economics record (default on) —
+# cold-start vs warm-cache first-dispatch through a shared
+# JEPSEN_TPU_COMPILE_CACHE dir, each arm its own subprocess so the
+# in-process jit cache can't leak the cold arm's compile into the warm
+# one. Same validation posture: an unrecognized value raises.
+_COMPILE = os.environ.get("PERF_AB_COMPILE", "1")
+if _COMPILE not in ("0", "1"):
+    raise SystemExit(f"PERF_AB_COMPILE: {_COMPILE!r} invalid; "
+                     f"valid: 0,1")
+
 
 def _want(name: str) -> bool:
     return name in _VARIANTS
@@ -252,6 +262,129 @@ def _probe_backend(timeout: float = 120.0):
         return None
     lines = out.stdout.strip().splitlines()
     return lines[-1] if lines else None
+
+
+# One compile-record arm, run via `python -c` in a throwaway process:
+# encode the adversarial shape, time the FIRST check_encoded dispatch
+# (trace + compile or cache load + run, fetched to host), and report
+# the program-registry ledger so the parent can tell a fresh compile
+# (cold) from a deserialized executable (warm) without guessing.
+_COMPILE_CHILD = """\
+import json, os, sys, time
+import jax
+p = os.environ.get("JAX_PLATFORMS")
+if p:
+    jax.config.update("jax_platforms", p)
+from jepsen_tpu.histories import adversarial_register_history
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.parallel import encode as enc_mod
+from jepsen_tpu.parallel import engine as eng_mod
+from jepsen_tpu.parallel import programs
+L, k = int(sys.argv[1]), int(sys.argv[2])
+e = enc_mod.encode(CASRegister(), adversarial_register_history(
+    n_ops=L, k_crashed=k, seed=7))
+cap = 1 << (k + 4)
+t0 = time.perf_counter()
+r = eng_mod.check_encoded(e, capacity=cap, max_capacity=cap * 4,
+                          dedupe="hash")
+secs = time.perf_counter() - t0
+reg = programs.registry()
+print(json.dumps({
+    "first_dispatch_secs": secs,
+    "stats": reg.stats() if reg is not None else None,
+    "rows": int(e.slot_f.shape[0]),
+    "pin": {k_: r.get(k_) for k_ in ("valid?", "op", "fail-event",
+                                     "max-frontier",
+                                     "configs-stepped")},
+}))
+"""
+
+
+def compile_record(shapes, extra_rows=(), timeout=600.0):
+    """The compile-economics record (docs/performance.md "Compile
+    economics"): per chip-matrix shape, cold-start vs warm-cache
+    first-dispatch seconds through one shared JEPSEN_TPU_COMPILE_CACHE
+    dir — each arm a THROWAWAY subprocess (the _probe_backend isolation
+    rationale: an in-process A/B would hand the warm arm the cold
+    arm's live jit cache, timing nothing), so what is measured is
+    exactly the restart a serve replica pays with and without a
+    populated cache. Also emits the program-population arithmetic
+    (distinct event-row shapes, exact vs canonicalized onto the
+    EVENT_QUANTUM ladder) over every row count measured plus
+    `extra_rows` — the JEPSEN_TPU_CANON_SHAPES sizing evidence,
+    computable with no chip. Returns the per-shape records and the
+    population dict; tests/test_perf_ab.py calls this directly on tiny
+    shapes and asserts the warm arm is strictly faster with zero fresh
+    compiles."""
+    import shutil
+    import subprocess
+    import tempfile
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    records = []
+    rows = [int(r) for r in extra_rows]
+    for L, k in shapes:
+        cache = tempfile.mkdtemp(prefix="jepsen_perf_ab_programs_")
+        line = {"shape": f"compile-{L}@2^{k}"}
+        arms = {}
+        try:
+            for arm in ("cold", "warm"):
+                env = dict(os.environ,
+                           JEPSEN_TPU_COMPILE_CACHE=cache,
+                           JEPSEN_TPU_CANON_SHAPES="1",
+                           JEPSEN_TPU_PRECOMPILE="0",
+                           PYTHONPATH=os.pathsep.join(
+                               [root,
+                                os.environ.get("PYTHONPATH", "")]
+                           ).rstrip(os.pathsep))
+                try:
+                    out = subprocess.run(
+                        [sys.executable, "-c", _COMPILE_CHILD,
+                         str(L), str(k)],
+                        capture_output=True, text=True,
+                        timeout=timeout, env=env)
+                except subprocess.TimeoutExpired:
+                    line[f"{arm}_error"] = f"timeout after {timeout}s"
+                    break
+                if out.returncode != 0:
+                    line[f"{arm}_error"] = out.stderr.strip()[-300:]
+                    break
+                arms[arm] = json.loads(
+                    out.stdout.strip().splitlines()[-1])
+        finally:
+            shutil.rmtree(cache, ignore_errors=True)
+        if "cold" in arms and "warm" in arms:
+            cold, warm = arms["cold"], arms["warm"]
+            line.update(
+                cold_first_dispatch_secs=round(
+                    cold["first_dispatch_secs"], 3),
+                warm_first_dispatch_secs=round(
+                    warm["first_dispatch_secs"], 3),
+                warm_speedup=round(
+                    cold["first_dispatch_secs"]
+                    / max(warm["first_dispatch_secs"], 1e-9), 2),
+                cold_compiles=(cold["stats"] or {}).get("compiles"),
+                warm_compiles=(warm["stats"] or {}).get("compiles"),
+                warm_preloads=(warm["stats"] or {}).get("preloads"),
+                warm_load_errors=(warm["stats"] or {}).get(
+                    "load_errors"))
+            # a cache-loaded program that answers differently is a
+            # correctness failure, not a perf detail — flag it like
+            # the variant mismatches above
+            if warm["pin"] != cold["pin"]:
+                line["pin_mismatch"] = True
+            rows.append(int(cold["rows"]))
+        emit(line)
+        records.append(line)
+    from jepsen_tpu.parallel import programs
+    pop = programs.population_counts(rows) if rows else None
+    emit({"compile_population": pop,
+          "rows_measured": sorted(set(rows)),
+          "note": "distinct event-row shapes a workload compiles, "
+                  "exact vs canonicalized onto the EVENT_QUANTUM "
+                  "ladder — the per-process program count "
+                  "JEPSEN_TPU_CANON_SHAPES buys down; pure quantum "
+                  "arithmetic, no chip needed"})
+    return {"records": records, "population": pop}
 
 
 def main():
@@ -694,6 +827,18 @@ def main():
             if any(_strip_closure(gr) != base for gr in gruns):
                 gline[f"{gname}_mismatch"] = True
         emit(gline)
+
+    # ---- compile economics (cold vs warm first-dispatch) ----
+    # the JEPSEN_TPU_COMPILE_CACHE decision record: what a replica
+    # restart costs with and without the populated AOT cache, on the
+    # same chip-matrix shapes the sparse-dedupe A/B measures; the
+    # batch encs' row counts feed the canonicalization population
+    # arithmetic (84 keys of jittered lengths is where exact-shape
+    # program count actually hurts)
+    if _COMPILE == "1":
+        compile_record(
+            [(200, 8), (200, 6)] if smoke else [(1000, 12), (1000, 8)],
+            extra_rows=[e.slot_f.shape[0] for e in encs])
 
     # analytical prior table: flops/bytes per (shape, variant) from
     # XLA's trace-time cost model — exists without any chip; once a

@@ -75,7 +75,13 @@ def _check_ops_surface(ops) -> int:
                    # the device-search telemetry series must be live
                    # on the ops surface (the ISSUE 10 wiring)
                    "jepsen_engine_search_events",
-                   "jepsen_engine_search_frontier_peak"):
+                   "jepsen_engine_search_frontier_peak",
+                   # and with JEPSEN_TPU_COMPILE_CACHE armed, so the
+                   # compile-economics histogram + registry ledger
+                   # must be live too (docs/performance.md "Compile
+                   # economics")
+                   "jepsen_serve_compile_secs_bucket",
+                   "jepsen_engine_programs_compiles"):
         if needed not in body:
             print(f"serve-smoke: /metrics missing {needed}")
             failures += 1
@@ -173,6 +179,15 @@ def main() -> int:
     # asserts the jepsen_engine_search_* series actually appear
     if "JEPSEN_TPU_SEARCH_STATS" not in os.environ:
         os.environ["JEPSEN_TPU_SEARCH_STATS"] = "1"
+    # compile economics armed the same way: verdicts stay identical
+    # (parity-pinned), and the ops-surface check asserts the
+    # jepsen_serve_compile_secs histogram + program-registry counters
+    # appear. An isolated tempdir, never a fixed path — the ci.sh
+    # serve_smoke tempdir precedent.
+    if "JEPSEN_TPU_COMPILE_CACHE" not in os.environ:
+        import tempfile
+        os.environ["JEPSEN_TPU_COMPILE_CACHE"] = tempfile.mkdtemp(
+            prefix="jepsen_smoke_programs_")
 
     from jepsen_tpu import resilience
     from jepsen_tpu.histories import corrupt_history, \
