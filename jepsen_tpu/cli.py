@@ -155,7 +155,9 @@ def base_parser(prog: str = "jepsen") -> argparse.ArgumentParser:
              "renders the JEPSEN_TPU_SEARCH_STATS per-key table "
              "(worst keys by load factor / escalations / pad waste); "
              "--slow renders the slow-delta forensics table "
-             "(JEPSEN_TPU_SLOW_DELTA_SECS stage breakdowns)")
+             "(JEPSEN_TPU_SLOW_DELTA_SECS stage breakdowns); --plan "
+             "renders the strategy-advisor table (JEPSEN_TPU_LEDGER "
+             "decision records joined with perf_ab bench evidence)")
     # listed for --help discoverability only, like lint/probe/status:
     # run_cli dispatches `trace` BEFORE parsing (obs.trace_merge owns
     # its flags and the 0/1/2 merged/invalid/unreachable contract)

@@ -495,3 +495,43 @@ def env_path(name: str, what: str = "path") -> Optional[str]:
 #                            breaker open, serve shed, or serve
 #                            worker error; unset/0 = off — span() is
 #                            then the historical no-op singleton
+#   JEPSEN_TPU_LEDGER        env_path    obs.ledger — the decision
+#                            ledger: append one durable JSONL evidence
+#                            record per device dispatch / escalation /
+#                            reshard / steal / serve publish (shape
+#                            fingerprint, strategy vector, secs,
+#                            stats digest, outcome) into bounded
+#                            segments under the given dir ("1" =
+#                            store/ledger). Aggregated on /ledger,
+#                            snapshotted into run dirs as
+#                            ledger.jsonl, joined by `jepsen report
+#                            --plan`. Unset/"0" = off — no file, no
+#                            obs.ledger.* metric, results/bench/
+#                            trace/metrics byte-identical
+#   JEPSEN_TPU_LEDGER_SEGMENT_BYTES env_int obs.ledger — rotate the
+#                            active ledger segment past this many
+#                            bytes (default 1048576, min 4096)
+#   JEPSEN_TPU_LEDGER_SEGMENTS env_int   obs.ledger — retained
+#                            segment count; older segments are
+#                            unlinked, bounding the ledger's disk
+#                            footprint (default 8, min 2)
+#   JEPSEN_TPU_LEDGER_FLOOR  env_int     obs.advisor — `jepsen report
+#                            --plan` per-cell sample floor: a
+#                            shape×strategy cell with fewer ledger
+#                            records recommends nothing
+#                            ("insufficient evidence") instead of
+#                            guessing (default 3, min 1)
+#   JEPSEN_TPU_SLO_ACK_SECS  env_float   obs.slo — the serve ack-
+#                            latency SLO target (seconds, objective
+#                            99%): arms the two-window burn-rate
+#                            gauges serve.slo.ack_burn_rate[window=
+#                            fast|slow] derived from serve.ack_secs
+#                            histogram deltas on every /metrics
+#                            refresh, and the /healthz "slo" check.
+#                            Unset/0 = off — /metrics and /healthz
+#                            byte-identical
+#   JEPSEN_TPU_SLO_BURN_MAX  env_float   obs.slo — degrade /healthz
+#                            readiness when the FAST-window burn rate
+#                            exceeds this (burn 1.0 = consuming error
+#                            budget exactly on schedule); unset/0 =
+#                            never degrade, gauges only

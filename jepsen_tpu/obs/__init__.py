@@ -22,9 +22,16 @@ docs/observability.md is the operator guide):
             recorder's crash dump (``flight_dump``).
   httpd     the live ops surface (import ``jepsen_tpu.obs.httpd``
             explicitly): ``/metrics`` Prometheus text + ``/healthz`` +
-            ``/status`` on a stdlib HTTP daemon thread behind
-            ``jepsen serve --ops-port``, plus the ``jepsen status``
-            client.
+            ``/status`` + ``/ledger`` on a stdlib HTTP daemon thread
+            behind ``jepsen serve --ops-port``, plus the ``jepsen
+            status`` client.
+
+Two sibling modules ride the same contract (import them explicitly —
+they are consumers, not core): ``ledger`` (JEPSEN_TPU_LEDGER — the
+durable per-dispatch decision ledger; ``advisor`` joins it with bench
+evidence into ``jepsen report --plan``) and ``slo``
+(JEPSEN_TPU_SLO_ACK_SECS — two-window ack burn-rate gauges over the
+serve histograms).
 
 Import-safe by construction: no JAX at import time, no device init —
 engine modules import this at module scope and must survive a wedged

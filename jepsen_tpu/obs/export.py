@@ -324,6 +324,23 @@ def write_slow_deltas(path: str, records: list) -> str:
     return _write_jsonl_records(path, records)
 
 
+def _ledger_snapshot(run_dir: str) -> Optional[str]:
+    """Copy the decision ledger's current records into the run dir as
+    ``ledger.jsonl`` (the ``jepsen report --plan`` default input).
+    Ledger off -> None, no file — run dirs stay byte-identical (the
+    search-stats/slow-delta opt-in posture)."""
+    from jepsen_tpu.obs import ledger as _ledger
+    led = _ledger.active()
+    if led is None:
+        return None
+    led.sync()
+    records, _corrupt = _ledger.read_records(led.root)
+    if not records:
+        return None
+    return _write_jsonl_records(os.path.join(run_dir, "ledger.jsonl"),
+                                records)
+
+
 # registry state at the last export_run, so each run's artifacts carry
 # the metrics THIS run moved (counters as deltas), not the process's
 # cumulative totals — a `--test-count 3` / test-all loop analyzes
@@ -365,6 +382,12 @@ def export_run(run_dir: str) -> Optional[dict]:
             arts["slow_deltas"] = write_slow_deltas(
                 os.path.join(run_dir, "slow_deltas.jsonl"),
                 slow_records)
+        # the decision ledger is its own opt-in too
+        # (JEPSEN_TPU_LEDGER): its run-dir snapshot lands whether or
+        # not tracing was also on
+        lg = _ledger_snapshot(run_dir)
+        if lg:
+            arts["ledger"] = lg
         return arts or None
     os.makedirs(run_dir, exist_ok=True)
     reg = _metrics.registry()
@@ -388,6 +411,9 @@ def export_run(run_dir: str) -> Optional[dict]:
     if slow_records:
         out["slow_deltas"] = write_slow_deltas(
             os.path.join(run_dir, "slow_deltas.jsonl"), slow_records)
+    lg = _ledger_snapshot(run_dir)
+    if lg:
+        out["ledger"] = lg
     if tr.path:
         # the buffer is drained per run, so one fixed destination would
         # only ever hold the LAST run's spans in a --test-count /

@@ -232,13 +232,23 @@ class _Handler(BaseHTTPRequestHandler):
                 # answers an empty (still valid) document; a
                 # flight-only ring exports its retained spans.
                 self._json(200, ops.trace_doc())
+            elif path == "/ledger":
+                # the decision-ledger aggregate (obs.ledger): newest-
+                # wins per shape×strategy cell, plus segment/corruption
+                # accounting. Ledger off answers an empty document
+                # ({"ledger": {"enabled": false}, "cells": {}}) — still
+                # valid JSON, so fleet scrapers need no probe.
+                from jepsen_tpu.obs import ledger as _ledger_mod
+                self._json(200, _ledger_mod.ledger_doc())
             elif path == "/":
                 self._json(200, {"endpoints": ["/metrics", "/healthz",
-                                               "/status", "/trace"]})
+                                               "/status", "/trace",
+                                               "/ledger"]})
             else:
                 self._json(404, {"error": f"unknown path {path!r}",
                                  "endpoints": ["/metrics", "/healthz",
-                                               "/status", "/trace"]})
+                                               "/status", "/trace",
+                                               "/ledger"]})
         except Exception as err:  # noqa: BLE001 — one bad render must
             # not kill the connection handler thread loop
             _log.exception("ops httpd: %s failed", path)

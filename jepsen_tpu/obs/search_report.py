@@ -15,6 +15,13 @@ Output: ``search_report.txt`` next to the input (and stdout) — a
 summary header plus worst-keys tables. Pre-parse forwarded from
 ``cli.py`` exactly like lint/probe/status; exit 0 report written,
 1 no stats found, 254 usage. Import-safe: no JAX.
+
+``--plan`` rides the same entry point: the strategy advisor
+(``obs.advisor``) joins the decision ledger (``<run_dir>/ledger.jsonl``
+or ``--ledger-dir``) with perf_ab bench JSONL (``--bench-dir``,
+default ``bench_results/``) into ``plan_report.txt`` + ``plan.json``
+— the per-shape recommended-strategy table, sample-floored so thin
+evidence says so instead of guessing.
 """
 
 from __future__ import annotations
@@ -244,14 +251,31 @@ def report_main(argv: Optional[Sequence[str]] = None) -> int:
                     "renders slow_deltas.jsonl "
                     "(JEPSEN_TPU_SLOW_DELTA_SECS) into "
                     "slow_report.txt — every slow delta's stage "
-                    "breakdown, worst first")
+                    "breakdown, worst first; --plan joins the "
+                    "decision ledger (JEPSEN_TPU_LEDGER) with "
+                    "perf_ab bench JSONL into plan_report.txt — the "
+                    "per-shape recommended-strategy table")
     p.add_argument("--search", action="store_true",
                    help="render the device-search telemetry report")
     p.add_argument("--slow", action="store_true",
                    help="render the slow-delta forensics report")
+    p.add_argument("--plan", action="store_true",
+                   help="render the strategy-advisor plan table "
+                        "(decision ledger + perf_ab + gate_coverage)")
     p.add_argument("--run-dir", default=None,
                    help="store run dir holding the report input "
                         "(default: the latest stored run)")
+    p.add_argument("--ledger-dir", default=None,
+                   help="read --plan's ledger evidence straight from "
+                        "a JEPSEN_TPU_LEDGER segment dir instead of "
+                        "the run dir's ledger.jsonl snapshot")
+    p.add_argument("--bench-dir", default=None,
+                   help="perf_ab JSONL dir for --plan's bench "
+                        "evidence (default: bench_results/ when "
+                        "present)")
+    p.add_argument("--floor", type=int, default=None,
+                   help="--plan's per-cell sample floor (default: "
+                        "JEPSEN_TPU_LEDGER_FLOOR)")
     p.add_argument("--stdout-only", action="store_true",
                    help="print the report without writing the "
                         ".txt artifact")
@@ -259,14 +283,18 @@ def report_main(argv: Optional[Sequence[str]] = None) -> int:
         args = p.parse_args(list(argv) if argv is not None else None)
     except SystemExit as e:
         return 0 if e.code in (0, None) else 254
-    if not (args.search or args.slow):
-        print("jepsen report: nothing to render — pass --search "
-              "and/or --slow", file=sys.stderr)
+    if not (args.search or args.slow or args.plan):
+        print("jepsen report: nothing to render — pass --search, "
+              "--slow, and/or --plan", file=sys.stderr)
         return 254
     # resolve the run dir ONCE so --search + --slow in one call read
-    # the same run even if a new run lands mid-render
+    # the same run even if a new run lands mid-render. --plan with an
+    # explicit --ledger-dir is the one mode that can run without a
+    # stored run at all (the fleet-debug posture: point it anywhere).
     run_dir = args.run_dir
-    if run_dir is None:
+    need_run_dir = args.search or args.slow \
+        or (args.plan and args.ledger_dir is None)
+    if run_dir is None and need_run_dir:
         from jepsen_tpu import store as jstore
         run_dir = jstore.latest()
         if run_dir is None:
@@ -304,6 +332,48 @@ def report_main(argv: Optional[Sequence[str]] = None) -> int:
                 out = os.path.join(run_dir, "slow_report.txt")
                 with open(out, "w") as fh:
                     fh.write(text)
+                print(f"report written to {out}", file=sys.stderr)
+    if args.plan:
+        from jepsen_tpu.obs import advisor, ledger as _ledger
+        if args.ledger_dir is not None:
+            records, corrupt = _ledger.read_records(args.ledger_dir)
+            if not records:
+                print(f"jepsen report: no ledger records under "
+                      f"{args.ledger_dir} — run with "
+                      f"JEPSEN_TPU_LEDGER=1 so the engines record "
+                      f"dispatch evidence (docs/observability.md)",
+                      file=sys.stderr)
+                records = None
+            elif corrupt:
+                print(f"jepsen report: skipped {corrupt} corrupt "
+                      f"ledger line(s)", file=sys.stderr)
+        else:
+            records = _load_report_input(
+                run_dir, "ledger.jsonl",
+                "run with JEPSEN_TPU_LEDGER=1 so the run dir "
+                "snapshots dispatch evidence, or pass --ledger-dir "
+                "(docs/observability.md)")
+        if records is None:
+            rc = 1
+        else:
+            bench_dir = args.bench_dir
+            if bench_dir is None and os.path.isdir("bench_results"):
+                bench_dir = "bench_results"
+            bench = (advisor.load_bench_dir(bench_dir)
+                     if bench_dir else [])
+            plan = advisor.build_plan(records, bench,
+                                      floor=args.floor)
+            text = advisor.render_plan(plan)
+            sys.stdout.write(text)
+            if not args.stdout_only:
+                dest = run_dir if run_dir is not None \
+                    else args.ledger_dir
+                out = os.path.join(dest, "plan_report.txt")
+                with open(out, "w") as fh:
+                    fh.write(text)
+                with open(os.path.join(dest, "plan.json"), "w") as fh:
+                    json.dump(plan, fh, sort_keys=True, indent=1)
+                    fh.write("\n")
                 print(f"report written to {out}", file=sys.stderr)
     return rc
 

@@ -37,6 +37,7 @@ from jax import lax
 
 from jepsen_tpu import envflags
 from jepsen_tpu import obs
+from jepsen_tpu.obs import ledger as _ledger
 from jepsen_tpu.parallel import programs
 from jepsen_tpu.parallel.encode import EncodedHistory
 from jepsen_tpu.parallel.steps import STEPS
@@ -769,6 +770,26 @@ class PendingBitdenseBatch:
                 from jepsen_tpu.parallel.encode import fail_op_fields
                 r.update(fail_op_fields(e, int(fail_r[k])))
             out.append(r)
+        led = _ledger.active()
+        if led is not None:
+            # decision-ledger evidence: one record per bitdense batch
+            # dispatch — issue-to-materialize wall from the same reads
+            # the stats blocks use ("N" is S, the dense table rows)
+            n_valid = sum(1 for r in out if r["valid?"])
+            led.record(
+                "dispatch", engine="bitdense",
+                shape={"family": self.encs[0].step_name,
+                       "N": int(self.S), "R": int(self.R),
+                       "C": int(self.C), "tier": 0, "pack": False},
+                strategy={"dedupe": "dense", "closure": closure},
+                secs=round(t1 - self._t_issue, 6),
+                keys=len(self.encs),
+                stats=_ledger.stats_digest(
+                    [r["stats"] for r in out if r.get("stats")]),
+                outcome={"valid": n_valid,
+                         "invalid": len(out) - n_valid,
+                         "overflow": 0,
+                         "fallback": self.note is not None})
         self._results = out
         return out
 

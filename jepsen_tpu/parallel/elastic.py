@@ -56,6 +56,7 @@ from typing import Optional
 import numpy as np
 
 from jepsen_tpu import envflags, obs
+from jepsen_tpu.obs import ledger as _ledger
 from jepsen_tpu.parallel import encode as enc_mod
 from jepsen_tpu.parallel import engine
 from jepsen_tpu.resilience import supervisor as sup
@@ -222,6 +223,13 @@ class KeyScheduler:
             # counter track (no-op with tracing off): the steal
             # trajectory lines up with the elastic.round spans
             obs.counter_sample("elastic.keys_stolen", self.steals)
+            led = _ledger.active()
+            if led is not None:
+                led.record(
+                    "steal", engine="elastic", moved=moved,
+                    steals=self.steals, pending=len(pending),
+                    devices=self.n_dev,
+                    round_keys=self.round_keys)
 
     def stats(self) -> dict:
         """The scheduler's accounting for steal_stats / the bench
@@ -434,6 +442,7 @@ def _round_sparse(model, encs, capacity: int, max_capacity: int,
     pending = list(range(K))
     N = max(64, capacity)
     n_tier = 0
+    led = _ledger.active()
     while pending:
         encs_t = [encs[i] for i in pending]
         # keep every tier's dispatch DEVICE-ALIGNED: place_batch only
@@ -481,6 +490,8 @@ def _round_sparse(model, encs, capacity: int, max_capacity: int,
             break
         t1 = _pc()
         retry = []
+        n_valid = n_invalid = 0
+        tier_stats: list = []
         for j, i in enumerate(pending):
             if bool(overflow[j]):
                 retry.append(i)
@@ -507,6 +518,28 @@ def _round_sparse(model, encs, capacity: int, max_capacity: int,
             if not r["valid?"]:
                 r.update(enc_mod.fail_op_fields(e, int(fail_r[j])))
             out[i] = r
+            if r["valid?"]:
+                n_valid += 1
+            else:
+                n_invalid += 1
+            if r.get("stats"):
+                tier_stats.append(r["stats"])
+        if led is not None:
+            # CONTRACT TWIN of engine._check_batch_sparse's dispatch
+            # record — the advisor compares the two executors on the
+            # `engine=` axis of the shape group
+            led.record(
+                "dispatch", engine="elastic",
+                shape={"family": step_name, "N": N, "R": int(R_pad),
+                       "C": int(C_pad), "tier": n_tier,
+                       "pack": bool(pack)},
+                strategy={"dedupe": dedupe, "closure": mode,
+                          "pack": pack_req,
+                          "probe_limit": probe_limit},
+                secs=round(t1 - t0, 6), keys=len(pending),
+                stats=_ledger.stats_digest(tier_stats),
+                outcome={"valid": n_valid, "invalid": n_invalid,
+                         "overflow": len(retry)})
         if not retry:
             break
         if N * 2 > max_capacity:

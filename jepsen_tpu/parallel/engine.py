@@ -39,6 +39,7 @@ from jax import lax
 
 from jepsen_tpu import envflags
 from jepsen_tpu import obs
+from jepsen_tpu.obs import ledger as _ledger
 from jepsen_tpu.parallel import encode as enc_mod
 from jepsen_tpu.parallel import programs
 from jepsen_tpu.parallel.encode import EncodedHistory, EncodeError
@@ -2569,6 +2570,7 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
     probe_limit = _resolve_probe_limit(probe_limit)
     ss = _resolve_search_stats(search_stats)
     pack_req = _resolve_config_pack(config_pack)
+    led = _ledger.active()
     from time import perf_counter as _pc
     # the padded batch runs one program: gate the kernel on where the
     # batch actually lives (the mesh when given), like bitdense does
@@ -2622,13 +2624,15 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
                     reason, backend=platform)
             break
         t1 = _pc()
-        if ss:
+        if ss or led is not None:
             # padded program dims for this tier: the pad-waste the
             # stats block reports is measured against what actually
             # shipped to the device
             R_pad = max(e.n_returns for e in encs_t)
             C_pad = max(e.slot_f.shape[1] for e in encs_t)
         retry = []
+        n_valid = n_invalid = 0
+        tier_stats: list = []
         for j, i in enumerate(pending):
             if bool(overflow[j]):
                 retry.append(i)
@@ -2640,6 +2644,10 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
             _tag_sparse_closure(r, mode, note)
             _tag_config_pack(r, pack, pack_req, C)
             obs.counter("engine.configs_stepped").inc(int(stepped[j]))
+            if r["valid?"]:
+                n_valid += 1
+            else:
+                n_invalid += 1
             if ss:
                 acc = SearchStats(dedupe)
                 acc.escalations = n_tier
@@ -2652,9 +2660,27 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
                     extra={"pad-waste": round(waste, 6),
                            "pad-events": int(R_pad - e.n_returns),
                            "pad-slots": int(C_pad - e.slot_f.shape[1])})
+                tier_stats.append(r["stats"])
             if not r["valid?"]:
                 r.update(enc_mod.fail_op_fields(e, int(fail_r[j])))
             out[i] = r
+        if led is not None:
+            # one evidence record per device dispatch (not per key):
+            # the padded program's shape fingerprint + the strategy
+            # vector that ran it, with the SAME perf_counter reads
+            # the span/bench splits use
+            led.record(
+                "dispatch", engine="sparse",
+                shape={"family": step_name, "N": N, "R": int(R_pad),
+                       "C": int(C_pad), "tier": n_tier,
+                       "pack": bool(pack)},
+                strategy={"dedupe": dedupe, "closure": mode,
+                          "pack": pack_req,
+                          "probe_limit": probe_limit},
+                secs=round(t1 - t0, 6), keys=len(pending),
+                stats=_ledger.stats_digest(tier_stats),
+                outcome={"valid": n_valid, "invalid": n_invalid,
+                         "overflow": len(retry)})
         if not retry:
             break
         if N * 2 > max_capacity:
@@ -2681,6 +2707,45 @@ def _escalate_overflow(e: EncodedHistory, batch_cap: int, mesh,
                        search_stats: Optional[bool] = None,
                        config_pack: Optional[bool] = None,
                        reshard: Optional[bool] = None) -> dict:
+    """Ledger-instrumented wrapper around the escalation ladder: when
+    the decision ledger is armed, each escalation lands one evidence
+    record — which tier decided (single/sharded/none), under what
+    strategy vector, and how long the whole ladder took. Semantics
+    are exactly ``_escalate_overflow_impl``'s (its docstring is the
+    contract)."""
+    led = _ledger.active()
+    if led is None:
+        return _escalate_overflow_impl(
+            e, batch_cap, mesh, dedupe=dedupe,
+            sparse_pallas=sparse_pallas, search_stats=search_stats,
+            config_pack=config_pack, reshard=reshard)
+    from time import perf_counter as _pc
+    t0 = _pc()
+    r = _escalate_overflow_impl(
+        e, batch_cap, mesh, dedupe=dedupe,
+        sparse_pallas=sparse_pallas, search_stats=search_stats,
+        config_pack=config_pack, reshard=reshard)
+    t1 = _pc()
+    led.record(
+        "escalation", engine="sparse",
+        shape={"family": e.step_name, "R": int(e.n_returns),
+               "C": int(e.slot_f.shape[1])},
+        strategy={"dedupe": dedupe, "reshard": bool(reshard)
+                  if reshard is not None else _resolve_reshard(None)},
+        secs=round(t1 - t0, 6), batch_cap=batch_cap,
+        outcome={"escalated": r.get("escalated"),
+                 "verdict": _ledger.verdict_class(r),
+                 "error": bool(r.get("error")
+                               or r.get("escalation-error"))})
+    return r
+
+
+def _escalate_overflow_impl(e: EncodedHistory, batch_cap: int, mesh,
+                            dedupe: str = "sort",
+                            sparse_pallas: Optional[bool] = None,
+                            search_stats: Optional[bool] = None,
+                            config_pack: Optional[bool] = None,
+                            reshard: Optional[bool] = None) -> dict:
     """A key too wide for the batch program escalates instead of dying
     as "unknown": first the single-key sparse engine at 4x the batch
     ceiling, then — with a mesh — the frontier-sharded engine, whose

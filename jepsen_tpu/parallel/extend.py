@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import bisect
 import logging
+from time import perf_counter
 from typing import Optional
 
 import numpy as np
@@ -56,6 +57,7 @@ import jax
 
 from jepsen_tpu import obs
 from jepsen_tpu.history import TYPES, History
+from jepsen_tpu.obs import ledger as _ledger
 from jepsen_tpu.parallel import encode as enc_mod
 from jepsen_tpu.parallel import engine
 from jepsen_tpu.parallel.encode import EncodedHistory, EncodeError
@@ -502,8 +504,6 @@ class HistorySession:
         if recovered is not None:
             r["resilience"] = recovered
         if self._stats_acc is not None and self._leg_acc is not None:
-            from time import perf_counter
-
             # registry + counter tracks get THIS check's leg only (a
             # stream republishing its lifetime totals every delta
             # would inflate every counter); the result block and the
@@ -527,6 +527,31 @@ class HistorySession:
             obs.record_search_stats(rec)
             r["stats"] = block
             self._leg_acc = None
+        led = _ledger.active()
+        if led is not None:
+            # CONTRACT TWIN of the one-shot engines' dispatch records:
+            # engine="stream" is the serve fleet's device executor, so
+            # the advisor can weigh the incremental scan against a
+            # one-shot re-check on the same shape axis
+            t0 = getattr(self, "_scan_t0", None)
+            e = self.enc
+            led.record(
+                "dispatch", engine="stream",
+                shape={"family": e.step_name, "N": tcp.capacity,
+                       "R": e.n_returns, "C": e.slot_f.shape[1]},
+                strategy={"dedupe": self.dedupe, "closure": mode,
+                          "pack": self.config_pack,
+                          "probe_limit": self.probe_limit,
+                          "batched": pack is not None},
+                secs=(round(perf_counter() - t0, 6)
+                      if t0 is not None else None),
+                keys=1,
+                key=(str(self.key) if self.key is not None else None),
+                resume=resume_ev,
+                stats=(_ledger.stats_digest([r["stats"]])
+                       if "stats" in r else None),
+                outcome={"verdict": _ledger.verdict_class(r),
+                         "degraded": recovered is not None})
         self._last_result = dict(r)
         self._dirty = False
         return r
@@ -596,6 +621,7 @@ class HistorySession:
                   config_pack=self.config_pack)
         recovered = None
         mode, note = "off", None
+        self._scan_t0 = perf_counter()
         with obs.span("stream.check", key=self.key, returns=R,
                       resume=resume_ev):
             try:
@@ -815,6 +841,7 @@ def advance_sessions(sessions, bucket: Optional[str] = None) -> list:
             continue
         cp = s._cp if s._cp is not None else s._fresh_cp()
         s._scan_cp = cp
+        s._scan_t0 = perf_counter()
         gk = (s.enc.step_name, cp.capacity,
               engine.bucket_key(s.enc.n_slots, bucket), s.dedupe,
               s.probe_limit, s.sparse_pallas, s.search_stats,

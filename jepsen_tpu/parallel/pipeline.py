@@ -63,6 +63,7 @@ from typing import Optional
 from jepsen_tpu import envflags
 from jepsen_tpu import models as model_ns
 from jepsen_tpu import obs
+from jepsen_tpu.obs import ledger as _ledger
 from jepsen_tpu.parallel import encode as enc_mod
 from jepsen_tpu.parallel import engine
 from jepsen_tpu.parallel.encode import EncodedHistory
@@ -638,6 +639,7 @@ def _stream(model, histories, capacity, max_capacity, mesh, bucket,
                 _depth(len(pending))
                 return
             _depth(len(pending))
+            t_done = perf_counter()
             tr = obs.tracer()
             if tr is not None:
                 # the chunk's whole in-flight window on a per-bucket
@@ -646,10 +648,30 @@ def _stream(model, histories, capacity, max_capacity, mesh, bucket,
                 # hides the exact kernel window; the jax.profiler
                 # capture has ground truth), but the right shape for
                 # seeing overlap in Perfetto.
-                tr.add_span("device.search", t_issue, perf_counter(),
+                tr.add_span("device.search", t_issue, t_done,
                             track=f"bucket-{bstat['tier']}",
                             chunk=chunk_no, keys=len(chunk_idxs),
                             engine=bstat["engine"])
+            led = _ledger.active()
+            if led is not None:
+                # one record per drained chunk: the in-flight window
+                # (same clock reads as the device.search span) plus
+                # the pipeline-level strategy the bitdense record
+                # cannot see (depth, steal, chunk sizing)
+                led.record(
+                    "dispatch", engine="pipeline",
+                    shape={"family": enc_of(chunk_idxs[0]).step_name,
+                           "tier": bstat["tier"]},
+                    strategy={"engine": bstat["engine"],
+                              "depth": depth,
+                              "steal": sched is not None,
+                              "chunk_keys": chunk_keys},
+                    secs=round(t_done - t_issue, 6),
+                    keys=len(chunk_idxs), chunk=chunk_no,
+                    outcome={"valid": sum(1 for r in rs
+                                          if r["valid?"] is True),
+                             "invalid": sum(1 for r in rs
+                                            if r["valid?"] is False)})
             bstat["transfer_secs"] += pb.transfer_secs
             bstat["device_wait_secs"] += pb.device_wait_secs
             for i, r in zip(chunk_idxs, rs):

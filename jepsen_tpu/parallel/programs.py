@@ -222,6 +222,26 @@ def _versions() -> Tuple[str, str]:
     return jax.__version__, jl
 
 
+def _no_persistent_cache():
+    """Scope under which registry compiles bypass jax's persistent
+    compilation cache (``jax_compilation_cache_dir``). An executable
+    satisfied from that cache does not survive a
+    ``serialize_executable`` round-trip on the CPU backend — the
+    deserialized program aborts with "Symbols not found" — so a
+    ``.jprog`` persisted from a cache-hit executable is poisoned and
+    every restart that preloads it degrades to a fresh compile. The
+    registry's own disk layer already covers these programs, so the
+    global cache is redundant here anyway. The config state is
+    context-managed (thread-local overlay): concurrent non-registry
+    jits are unaffected."""
+    try:
+        from jax._src.config import enable_compilation_cache
+        return enable_compilation_cache(False)
+    except Exception:  # noqa: BLE001 — private API; degrade to no-op
+        import contextlib
+        return contextlib.nullcontext()
+
+
 class _Program:
     __slots__ = ("compiled", "spec", "aot")
 
@@ -337,7 +357,7 @@ class ProgramRegistry:
             kw = dict(zip(static_names, statics))
             t0 = perf_counter()
             with obs.span("serve.compile", program=name,
-                          digest=digest):
+                          digest=digest), _no_persistent_cache():
                 compiled = entry.lower(*shapes, **kw).compile()
             dt = perf_counter() - t0
             self._count("compiles")

@@ -51,6 +51,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from jepsen_tpu import obs
+from jepsen_tpu.obs import ledger as _ledger
 from jepsen_tpu.parallel import programs
 from jepsen_tpu.parallel.encode import EncodedHistory
 from jepsen_tpu.parallel.engine import (N_PROBE_BUCKETS, _empty_table,
@@ -783,6 +784,8 @@ def check_encoded_sharded_elastic(e: EncodedHistory, mesh: Mesh,
     byte-identical. Per-event search-stats blocks are not produced on
     the resumable jits (the resumable-arm precedent); ``search_stats``
     is accepted for signature compatibility and ignored."""
+    from time import perf_counter as _pc
+
     from jepsen_tpu.parallel.engine import (FrontierCheckpoint,
                                             carry_fields_np,
                                             history_digest)
@@ -818,6 +821,8 @@ def check_encoded_sharded_elastic(e: EncodedHistory, mesh: Mesh,
     }
     R = e.n_returns
     mode, note = "off", None
+    led = _ledger.active()
+    t_start = _pc()
     with obs.span("sharded.elastic", devices=plan_full.n_dev,
                   dedupe=dedupe, returns=R) as sp:
         while cp.event_index < R and cp.ok:
@@ -887,6 +892,13 @@ def check_encoded_sharded_elastic(e: EncodedHistory, mesh: Mesh,
                         {"event": cp.event_index,
                          "devices": [n_dev, new_n], "capacity": N})
                     obs.counter("engine.reshard_escalations").inc()
+                    if led is not None:
+                        led.record(
+                            "reshard", engine="sharded",
+                            shape={"family": e.step_name, "R": R,
+                                   "C": C_enc},
+                            rung=rung, devices=[n_dev, new_n],
+                            capacity=N, event=cp.event_index)
                     if N > cp.capacity:
                         cp = cp.grown(N)
                     continue
@@ -922,6 +934,19 @@ def check_encoded_sharded_elastic(e: EncodedHistory, mesh: Mesh,
                        "events": reshard_events}}
     _tag_sparse_closure(out, mode, note)
     _tag_config_pack(out, pack, pack_req, C_enc)
+    if led is not None:
+        led.record(
+            "dispatch", engine="sharded",
+            shape={"family": e.step_name, "N": N, "R": R,
+                   "C": C_enc, "tier": len(reshard_events),
+                   "pack": bool(pack)},
+            strategy={"dedupe": dedupe, "closure": mode,
+                      "pack": pack_req, "probe_limit": probe_limit,
+                      "reshard": True, "devices": start_devices},
+            secs=round(_pc() - t_start, 6), keys=1,
+            outcome={"verdict": _ledger.verdict_class(out),
+                     "devices": n_dev,
+                     "resharded": len(reshard_events)})
     if not out["valid?"]:
         from jepsen_tpu.parallel.encode import fail_op_fields
         out.update(fail_op_fields(e, cp.fail_r))
@@ -1251,6 +1276,7 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
     ss = _resolve_search_stats(search_stats)
     pack_req = _resolve_config_pack(config_pack)
     pack = pack_spec_for(e) if pack_req else ()
+    led = _ledger.active()
     # A 2-D device array + "route" = the multi-slice topology: axis 0
     # is the slice (DCN) axis, axis 1 the intra-slice chip (ICI) axis,
     # and the exchange goes hierarchical. Anything else flattens onto
@@ -1350,6 +1376,21 @@ def check_encoded_sharded(e: EncodedHistory, mesh: Mesh,
         out["stats"] = eng_mod.finish_stats_block(block, t0, _pc())
     _tag_sparse_closure(out, mode, note)
     _tag_config_pack(out, pack, pack_req, e.slot_f.shape[1])
+    if led is not None:
+        led.record(
+            "dispatch", engine="sharded",
+            shape={"family": e.step_name, "N": N,
+                   "R": e.n_returns, "C": e.slot_f.shape[1],
+                   "tier": n_esc, "pack": bool(pack)},
+            strategy={"dedupe": dedupe, "closure": mode,
+                      "pack": pack_req, "probe_limit": probe_limit,
+                      "reshard": False, "devices": n_dev,
+                      "exchange": exchange},
+            secs=round(_pc() - t0, 6), keys=1,
+            stats=(_ledger.stats_digest([out["stats"]])
+                   if ss else None),
+            outcome={"verdict": _ledger.verdict_class(out),
+                     "escalations": n_esc})
     if hier:
         out["mesh"] = f"{n_slice}x{n_chip} (hierarchical exchange)"
     if not out["valid?"]:

@@ -63,6 +63,8 @@ from typing import Dict, Optional
 
 from jepsen_tpu import edn, envflags, obs
 from jepsen_tpu.history import TYPES
+from jepsen_tpu.obs import ledger as _ledger
+from jepsen_tpu.obs import slo as _slo
 from jepsen_tpu.parallel import extend as ext
 from jepsen_tpu.parallel import programs
 from jepsen_tpu.serve import tenancy
@@ -318,6 +320,10 @@ class CheckerService:
             # postmortem dumps land next to the WAL they explain
             obs.set_flight_dir(os.path.join(wal_dir, "flight"))
         self._keys: Dict = {}
+        # ack-latency SLO burn tracking (obs.slo): unarmed (the
+        # default, JEPSEN_TPU_SLO_ACK_SECS unset) it mints nothing —
+        # /metrics and /healthz stay byte-identical
+        self._slo = _slo.BurnRateTracker(clock=clock)
         self._cond = threading.Condition()
         self._pending_ops = 0
         self._inflight = 0
@@ -993,6 +999,10 @@ class CheckerService:
             # nonzero is producers outrunning fsync; growing is a
             # sick disk (the wal_dead path's precursor)
             obs.gauge("serve.wal_lag_deltas").set(wal_lag)
+        # SLO burn rates ride the same refresh: every /metrics render
+        # re-derives the two-window burn from the ack histogram (a
+        # no-op returning None when the target flag is unset)
+        self._slo.sample()
 
     def status(self) -> dict:
         """The /status document: one row per key (seq, pending,
@@ -1128,6 +1138,12 @@ class CheckerService:
             "states": {s["backend"]: s["state"] for s in snaps}}
         checks["keys"] = {"ok": poisoned == 0, "total": n_keys,
                           "poisoned": poisoned}
+        if self._slo.armed:
+            # armed only — the check key is absent, not ok:true, when
+            # JEPSEN_TPU_SLO_ACK_SECS is unset (/healthz schema
+            # parity); with JEPSEN_TPU_SLO_BURN_MAX=0 the check is
+            # informational and never degrades readiness
+            checks["slo"] = self._slo.check()
         return {"ok": all(c["ok"] for c in checks.values()),
                 "live": True, "checks": checks}
 
@@ -1618,6 +1634,22 @@ class CheckerService:
             # the worst slow delta so far gets the flight ring dumped
             # with it — outside the service lock (file I/O)
             obs.flight_dump("slow-delta", context=dump_ctx)
+        led = _ledger.active()
+        if led is not None:
+            # one evidence record per key per publish, minted OUTSIDE
+            # the service lock (ledger appends are file I/O); secs is
+            # the batch's publish stage — the same t_dev_end split
+            # _finish_recs_locked attributes
+            t_pub = self._clock()
+            for ks, _sess, _last_seq, final, err_r, recs in entries:
+                led.record(
+                    "publish", engine="serve", key=str(ks.key),
+                    tenant=ks.tenant, deltas=len(recs or ()),
+                    final=bool(final), batch=len(entries),
+                    secs=round(max(0.0, t_pub - t_dev_end), 6),
+                    outcome={"verdict": _ledger.verdict_class(
+                                 ks.last_result or {}),
+                             "crashed": err_r is not None})
 
     def _finish_recs_locked(self, ks: _Key, recs,
                             t_dev_end: float) -> Optional[dict]:

@@ -1,0 +1,428 @@
+"""Decision ledger + strategy advisor + SLO burn-rate tests (ISSUE 19).
+
+Pins the contracts docs/observability.md "Decision ledger & strategy
+advisor" documents:
+
+  * rotation at the byte cap + retention bound (the DeltaWAL-precedent
+    segment format);
+  * torn-tail tolerance: a restart truncates the never-promised
+    partial line and appends cleanly; mid-file garbage is skipped and
+    counted, never raised;
+  * flag-off byte parity: JEPSEN_TPU_LEDGER unset mints no metric,
+    touches no file, and leaves engine results identical;
+  * the advisor is deterministic on the committed fixtures (incl. the
+    insufficient-evidence floor) — byte-identical to the committed
+    golden plan;
+  * `jepsen report --plan` exit codes 0 / 1 / 254;
+  * the SLO burn-rate tracker's two-window math with an injected
+    clock, and its /healthz arming contract.
+"""
+
+import json
+import os
+
+import pytest
+
+from jepsen_tpu import envflags, obs
+from jepsen_tpu.obs import advisor, ledger
+from jepsen_tpu.obs import slo
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+LEDGER_FIXTURE = os.path.join(DATA, "ledger_fixture")
+BENCH_FIXTURE = os.path.join(DATA, "bench_fixture")
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger(monkeypatch):
+    monkeypatch.delenv("JEPSEN_TPU_LEDGER", raising=False)
+    monkeypatch.delenv("JEPSEN_TPU_LEDGER_SEGMENT_BYTES", raising=False)
+    monkeypatch.delenv("JEPSEN_TPU_LEDGER_SEGMENTS", raising=False)
+    monkeypatch.delenv("JEPSEN_TPU_LEDGER_FLOOR", raising=False)
+    ledger.reset()
+    obs.registry().reset()
+    yield
+    ledger.reset()
+    obs.registry().reset()
+
+
+def _fill(led, n, kind="dispatch", **extra):
+    for i in range(n):
+        led.record(kind, engine="test",
+                   shape={"family": "reg", "C": 6},
+                   strategy={"dedupe": "hash"},
+                   secs=0.01, pad="x" * 64, **extra)
+
+
+# ------------------------------------------------ writer / durability
+
+
+def test_rotation_at_byte_cap_and_retention(tmp_path):
+    led = ledger.DecisionLedger(str(tmp_path), segment_bytes=512,
+                                max_segments=3)
+    _fill(led, 60)
+    led.close()
+    paths = ledger.segment_paths(str(tmp_path))
+    # rotation happened (60 records of ~200 bytes >> 512), and
+    # retention kept the bound: at most max_segments sealed + the
+    # newest active
+    assert len(paths) > 1
+    assert len(paths) <= 3 + 1
+    # every retained segment stays near the cap (one record overshoot)
+    for p in paths[:-1]:
+        assert os.path.getsize(p) <= 512 + 4096
+    assert ledger.size_bytes(str(tmp_path)) \
+        <= (3 + 1) * (512 + 4096)
+    # the retained tail is still fully readable, newest records last
+    recs, corrupt = ledger.read_records(str(tmp_path))
+    assert corrupt == 0
+    assert recs
+    assert recs[-1]["n"] == 60
+    # rotation + retention were counted
+    snap = obs.registry().snapshot()
+    assert snap["obs.ledger.rotations"]["value"] >= 1
+    assert snap["obs.ledger.drops"]["value"] >= 1
+
+
+def test_torn_tail_truncated_on_restart(tmp_path):
+    led = ledger.DecisionLedger(str(tmp_path))
+    _fill(led, 5)
+    led.close()
+    active = ledger.segment_paths(str(tmp_path))[-1]
+    with open(active, "a") as fh:
+        fh.write('{"v": 1, "kind": "disp')   # the torn crash tail
+    # restart: the partial line is truncated BEFORE the first append,
+    # so the new record never concatenates onto partial bytes
+    led2 = ledger.DecisionLedger(str(tmp_path))
+    _fill(led2, 1)
+    led2.close()
+    recs, corrupt = ledger.read_records(str(tmp_path))
+    assert corrupt == 0
+    assert [r["kind"] for r in recs] == ["dispatch"] * 6
+    snap = obs.registry().snapshot()
+    assert snap["obs.ledger.corrupt_lines"]["value"] == 1
+
+
+def test_mid_file_garbage_skipped_and_counted(tmp_path):
+    led = ledger.DecisionLedger(str(tmp_path))
+    _fill(led, 3)
+    led.close()
+    active = ledger.segment_paths(str(tmp_path))[-1]
+    lines = open(active).read().splitlines()
+    lines.insert(1, "%% not json %%")
+    with open(active, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    recs, corrupt = ledger.read_records(str(tmp_path))
+    assert corrupt == 1
+    assert len(recs) == 3          # a hole costs evidence, never a read
+
+
+def test_record_drops_none_fields_and_sorts_keys(tmp_path):
+    led = ledger.DecisionLedger(str(tmp_path))
+    led.record("dispatch", engine="test", secs=None, stats=None,
+               keys=2)
+    led.close()
+    line = open(ledger.segment_paths(str(tmp_path))[-1]).read().strip()
+    rec = json.loads(line)
+    assert "secs" not in rec and "stats" not in rec   # absent, not null
+    assert rec["keys"] == 2
+    assert line == json.dumps(rec, sort_keys=True)
+
+
+# ------------------------------------------------ flag / singleton
+
+
+def test_flag_off_is_byte_parity(tmp_path, monkeypatch):
+    from jepsen_tpu.histories import rand_register_history
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.parallel import extend
+
+    ops = list(rand_register_history(n_ops=16, n_processes=3,
+                                     n_values=3, seed=5))
+
+    def run():
+        s = extend.HistorySession(CASRegister(), capacity=64,
+                                  key="parity")
+        s.extend(ops)
+        return s.check()
+
+    assert ledger.active() is None
+    r_off = run()
+    # nothing minted, nothing written
+    snap = obs.registry().snapshot()
+    assert not any(k.startswith("obs.ledger") for k in snap)
+    assert list(tmp_path.iterdir()) == []
+
+    monkeypatch.setenv("JEPSEN_TPU_LEDGER", str(tmp_path))
+    ledger.reset()
+    r_on = run()
+    assert r_on == r_off            # evidence never changes results
+    recs, _ = ledger.read_records(str(tmp_path))
+    assert [r["kind"] for r in recs] == ["dispatch"]
+    assert recs[0]["engine"] == "stream"
+    assert recs[0]["outcome"]["verdict"] in ("valid", "invalid")
+    assert isinstance(recs[0]["secs"], float)
+
+
+def test_malformed_flag_raises_loudly(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_LEDGER", "   ")
+    ledger.reset()
+    with pytest.raises(envflags.EnvFlagError):
+        ledger.active()
+
+
+def test_flag_1_means_default_dir(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_LEDGER", "1")
+    assert ledger.resolve_ledger_dir() == ledger.DEFAULT_DIR
+    monkeypatch.setenv("JEPSEN_TPU_LEDGER", "0")
+    assert ledger.resolve_ledger_dir() is None
+
+
+def test_record_helper_noop_when_off():
+    ledger.record("dispatch", engine="test")   # must not raise
+
+
+# ------------------------------------------------ aggregate / doc
+
+
+def test_aggregate_newest_wins_per_cell():
+    recs = [
+        {"t": 1.0, "n": 1, "kind": "dispatch", "engine": "e",
+         "shape": {"C": 6}, "strategy": {"dedupe": "hash"},
+         "secs": 0.1, "outcome": {"verdict": "valid"}},
+        {"t": 2.0, "n": 2, "kind": "dispatch", "engine": "e",
+         "shape": {"C": 6}, "strategy": {"dedupe": "hash"},
+         "secs": 0.3, "outcome": {"verdict": "invalid"}},
+        {"t": 1.5, "n": 3, "kind": "dispatch", "engine": "e",
+         "shape": {"C": 6}, "strategy": {"dedupe": "sort"},
+         "secs": 0.2},
+    ]
+    cells = ledger.aggregate(recs)
+    assert len(cells) == 2
+    hash_cell = cells["e/dispatch C=6|dedupe=hash"]
+    assert hash_cell["count"] == 2
+    assert hash_cell["newest"]["n"] == 2
+    assert hash_cell["mean_secs"] == 0.2
+
+
+def test_ledger_doc_off_and_on(tmp_path, monkeypatch):
+    assert ledger.ledger_doc() == {"ledger": {"enabled": False},
+                                   "cells": {}}
+    monkeypatch.setenv("JEPSEN_TPU_LEDGER", str(tmp_path))
+    ledger.reset()
+    ledger.record("dispatch", engine="e", shape={"C": 4},
+                  strategy={"dedupe": "sort"}, secs=0.5)
+    doc = ledger.ledger_doc()
+    assert doc["ledger"]["enabled"] is True
+    assert doc["ledger"]["records"] == 1
+    assert doc["ledger"]["segments"] == 1
+    assert len(doc["cells"]) == 1
+
+
+def test_httpd_ledger_endpoint(tmp_path, monkeypatch):
+    from jepsen_tpu.obs import httpd
+
+    monkeypatch.setenv("JEPSEN_TPU_LEDGER", str(tmp_path))
+    ledger.reset()
+    ledger.record("dispatch", engine="e", shape={"C": 4},
+                  strategy={"dedupe": "sort"}, secs=0.5)
+    srv = httpd.start_ops_server(0)
+    try:
+        code, body = httpd._fetch(srv.url("/ledger"))
+        doc = json.loads(body)
+        assert code == 200
+        assert doc["ledger"]["enabled"] is True
+        assert doc["cells"]
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------ the advisor
+
+
+def _fixture_inputs():
+    recs, corrupt = ledger.read_records(LEDGER_FIXTURE)
+    assert corrupt == 0
+    bench = advisor.load_bench_dir(BENCH_FIXTURE)
+    return recs, bench
+
+
+def test_advisor_plan_matches_committed_golden():
+    recs, bench = _fixture_inputs()
+    plan = advisor.build_plan(recs, bench, floor=3)
+    text = advisor.render_plan(plan)
+    golden = open(os.path.join(LEDGER_FIXTURE,
+                               "plan_golden.txt")).read()
+    assert text == golden
+    # and twice over: nothing timestamps or reorders the output
+    assert advisor.render_plan(
+        advisor.build_plan(recs, bench, floor=3)) == text
+
+
+def test_advisor_recommends_only_at_the_floor():
+    recs, bench = _fixture_inputs()
+    plan = advisor.build_plan(recs, bench, floor=3)
+    by_shape = {s["shape"]: s for s in plan["shapes"]}
+    sparse = by_shape["engine=sparse,family=register_step,C=6"]
+    assert sparse["recommend"] == \
+        "closure=pallas,dedupe=hash,pack=True,probe_limit=None"
+    assert sparse["confidence"] == "bench-agrees"
+    dense = by_shape["engine=bitdense,family=register_step,C=6"]
+    assert dense["recommend"] is None
+    assert "insufficient evidence" in dense["confidence"]
+    # raising the floor past every cell refuses everywhere — the
+    # advisor never guesses
+    plan_hi = advisor.build_plan(recs, bench, floor=100)
+    assert all(s["recommend"] is None for s in plan_hi["shapes"])
+    # floor=1 lets the 2-sample bitdense cell through
+    plan_lo = advisor.build_plan(recs, bench, floor=1)
+    by_shape = {s["shape"]: s for s in plan_lo["shapes"]}
+    assert by_shape["engine=bitdense,family=register_step,C=6"][
+        "recommend"] is not None
+
+
+def test_advisor_bench_disagreement_is_named():
+    recs = [{"t": 1.0, "n": i, "kind": "dispatch", "engine": "e",
+             "shape": {"family": "f", "C": 4},
+             "strategy": {"dedupe": "sort"}, "secs": 0.1}
+            for i in range(3)]
+    bench = [{"shape": "s", "sort_secs": 1.0, "hash_secs": 0.2}]
+    plan = advisor.build_plan(recs, bench, floor=3)
+    assert plan["shapes"][0]["confidence"] == "bench-prefers-hash"
+
+
+def test_advisor_empty_ledger_renders_hint():
+    text = advisor.render_plan(advisor.build_plan([], [], floor=3))
+    assert "no dispatch evidence" in text
+
+
+# ------------------------------------------------ report --plan
+
+
+def test_report_plan_exit_codes(tmp_path, capsys):
+    from jepsen_tpu.obs import search_report
+
+    # 0: evidence present (fixture dir; --stdout-only keeps the
+    # committed fixture pristine)
+    rc = search_report.report_main(
+        ["--plan", "--ledger-dir", LEDGER_FIXTURE,
+         "--bench-dir", BENCH_FIXTURE, "--stdout-only"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "recommend: closure=pallas,dedupe=hash" in out
+    assert "insufficient evidence" in out
+
+    # 1: no records at the named dir
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert search_report.report_main(
+        ["--plan", "--ledger-dir", str(empty)]) == 1
+
+    # 254: no mode selected at all
+    assert search_report.report_main([]) == 254
+
+
+def test_report_plan_writes_artifacts(tmp_path):
+    import shutil
+
+    from jepsen_tpu.obs import search_report
+
+    work = tmp_path / "led"
+    shutil.copytree(LEDGER_FIXTURE, work)
+    rc = search_report.report_main(
+        ["--plan", "--ledger-dir", str(work),
+         "--bench-dir", BENCH_FIXTURE])
+    assert rc == 0
+    plan = json.loads((work / "plan.json").read_text())
+    assert plan["version"] == advisor.PLAN_VERSION
+    assert (work / "plan_report.txt").read_text().startswith(
+        "# Strategy plan")
+
+
+# ------------------------------------------------ SLO burn rates
+
+
+def _observe(name, values):
+    h = obs.histogram(name)
+    for v in values:
+        h.observe(v)
+
+
+def test_burn_rate_two_windows_injected_clock():
+    name = "test.slo.ack_secs"
+    tr = slo.BurnRateTracker(hist_name=name, target_secs=0.1,
+                             burn_max=10.0, fast_window=10.0,
+                             slow_window=100.0)
+    assert tr.armed
+    now = 0.0
+    tr.sample(now=now)
+    # 98 good, 2 bad out of 100: bad fraction 0.02 over the 1% budget
+    # = burn 2.0 in both windows
+    _observe(name, [0.01] * 98 + [5.0] * 2)
+    now = 5.0
+    b = tr.sample(now=now)
+    assert b == {"fast": 2.0, "slow": 2.0}
+    assert tr.check()["ok"] is True       # 2.0 under burn_max 10
+    # an all-bad burst: the fast window sees only the burst (burn
+    # 100), the slow window still amortizes over everything
+    now = 20.0
+    tr.sample(now=now)
+    _observe(name, [5.0] * 10)
+    now = 25.0
+    b = tr.sample(now=now)
+    assert b["fast"] == 100.0
+    assert b["slow"] < b["fast"]
+    chk = tr.check()
+    assert chk["ok"] is False             # past burn_max
+    assert chk["burn_fast"] == 100.0
+    # idle: no traffic in the fast window burns nothing
+    now = 40.0
+    b = tr.sample(now=now)
+    assert b["fast"] == 0.0
+    assert tr.check()["ok"] is True
+    # the gauges were published, labeled per window
+    snap = obs.registry().snapshot()
+    assert obs.labeled("serve.slo.ack_burn_rate", window="fast") in snap
+    assert obs.labeled("serve.slo.ack_burn_rate", window="slow") in snap
+
+
+def test_burn_rate_off_ladder_target_rounds_down():
+    # 0.15 is off the bucket ladder: goodness is judged at the next
+    # ladder bound DOWN, so a 0.12s ack counts as bad (conservative)
+    name = "test.slo.offladder"
+    tr = slo.BurnRateTracker(hist_name=name, target_secs=0.15,
+                             fast_window=10.0, slow_window=100.0)
+    tr.sample(now=0.0)
+    _observe(name, [0.12] * 100)
+    assert tr.sample(now=1.0)["fast"] == 100.0
+
+
+def test_slo_unarmed_mints_nothing():
+    tr = slo.BurnRateTracker(hist_name="test.slo.unarmed")
+    assert not tr.armed
+    assert tr.sample() is None
+    snap = obs.registry().snapshot()
+    assert not any("slo" in k for k in snap)
+
+
+def test_service_healthz_slo_arming(monkeypatch):
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.serve.service import CheckerService
+
+    svc = CheckerService(CASRegister(), start_worker=False)
+    try:
+        assert "slo" not in svc.health()["checks"]   # unarmed: absent
+    finally:
+        svc.close(drain=False)
+
+    monkeypatch.setenv("JEPSEN_TPU_SLO_ACK_SECS", "0.5")
+    monkeypatch.setenv("JEPSEN_TPU_SLO_BURN_MAX", "5")
+    svc = CheckerService(CASRegister(), start_worker=False)
+    try:
+        svc.refresh_gauges()
+        h = svc.health()
+        chk = h["checks"]["slo"]
+        assert chk["ok"] is True
+        assert chk["target_secs"] == 0.5
+        assert chk["burn_max"] == 5.0
+    finally:
+        svc.close(drain=False)

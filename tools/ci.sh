@@ -22,7 +22,12 @@
 #      armed (isolated tempdir) so the /metrics check asserts the
 #      compile-economics surface — jepsen_serve_compile_secs_bucket +
 #      the jepsen_engine_programs_* registry ledger
-#      (docs/performance.md "Compile economics")
+#      (docs/performance.md "Compile economics"), and with
+#      JEPSEN_TPU_LEDGER armed (isolated tempdir) so the decision-
+#      ledger wiring is proven end to end: durable dispatch+publish
+#      records on disk, /ledger serving live aggregate cells, and
+#      the strategy advisor building a deterministic plan from them
+#      (docs/observability.md "Decision ledger & strategy advisor")
 #   1c'. trace-schema validator — `jepsen trace --validate` over the
 #      smoke's Chrome-trace export (phase codes, pid/tid, span ids,
 #      parent resolution — the docs/observability.md export contract)
@@ -30,7 +35,10 @@
 #      sustained multi-tenant load over the HTTP ingress with
 #      JEPSEN_TPU_FAULTS armed mid-run (wedge/crash/flaky/slow);
 #      asserts zero verdict flips, bounded memory, flood-tenant
-#      sheds, quiet-tenant SLOs populated per tenant on /metrics
+#      sheds, quiet-tenant SLOs populated per tenant on /metrics,
+#      and (with the decision ledger armed at a tiny segment cap)
+#      that rotation + retention keep the evidence on disk inside
+#      its documented bound
 #   1e. fleet chaos smoke — tools/chaos.py --smoke (~15 s): a real
 #      subprocess fleet under a nemesis schedule — one SIGKILL with
 #      the victim's WAL dir deleted (rehome must come from the

@@ -26,6 +26,14 @@ JEPSEN_TPU_FAULTS), and asserts:
     both on the per-tenant /metrics labels (the ISSUE 12 fairness
     wiring, end to end).
 
+  * the decision ledger records the run: with JEPSEN_TPU_LEDGER armed
+    (a tempdir, set below) durable evidence records land on disk for
+    the smoke's dispatches AND its publishes, /ledger answers the
+    aggregated shape×strategy document while the service runs, and
+    the strategy advisor (jepsen report --plan's engine) builds a
+    deterministic plan from those live records (the ISSUE 19 wiring,
+    end to end).
+
 `tools/ci.sh` runs this right after fault_smoke (and tools/soak.py
 --smoke right after it). This is a wiring check; tests/test_serve.py
 + tests/test_ingress.py + tests/test_ring.py + tests/test_obs_httpd.py
@@ -94,6 +102,59 @@ def _check_ops_surface(ops) -> int:
             print(f"serve-smoke: /status missing key {k} at seq 3: "
                   f"{row}")
             failures += 1
+    # the decision ledger is armed (tempdir, main()): /ledger must
+    # answer the aggregate with live cells while the service runs
+    code, body = _http_get(ops.url("/ledger"))
+    doc = json.loads(body)
+    hdr = doc.get("ledger") or {}
+    if code != 200 or not hdr.get("enabled") or not doc.get("cells"):
+        print(f"serve-smoke: /ledger not serving live cells: "
+              f"{code} {hdr}")
+        failures += 1
+    return failures
+
+
+def _check_ledger_evidence() -> int:
+    """The ISSUE 19 end-to-end: the smoke's records are durable on
+    disk, carry both dispatch and publish evidence, and the advisor
+    builds the same plan from them twice. Returns failures."""
+    from jepsen_tpu.obs import advisor, ledger as ledger_mod
+
+    failures = 0
+    led = ledger_mod.active()
+    if led is None:
+        print("serve-smoke: ledger armed but not active")
+        return 1
+    led.sync()
+    records, corrupt = ledger_mod.read_records(led.root)
+    if corrupt:
+        print(f"serve-smoke: ledger has {corrupt} corrupt line(s)")
+        failures += 1
+    kinds = {r.get("kind") for r in records}
+    for needed in ("dispatch", "publish"):
+        if needed not in kinds:
+            print(f"serve-smoke: no {needed} records on disk "
+                  f"(kinds={sorted(kinds)})")
+            failures += 1
+    engines = {r.get("engine") for r in records}
+    if "serve" not in engines:
+        print(f"serve-smoke: no serve-minted records "
+              f"(engines={sorted(str(e) for e in engines)})")
+        failures += 1
+    plan = advisor.build_plan(records, [])
+    if advisor.build_plan(records, []) != plan:
+        print("serve-smoke: advisor plan not deterministic on the "
+              "same records")
+        failures += 1
+    text = advisor.render_plan(plan)
+    if not plan.get("shapes") or not text.strip():
+        print(f"serve-smoke: advisor produced an empty plan from "
+              f"{len(records)} live records")
+        failures += 1
+    if failures == 0:
+        print(f"serve-smoke: ledger evidence OK — {len(records)} "
+              f"records, {len(plan['shapes'])} shape group(s), "
+              f"advisor plan renders")
     return failures
 
 
@@ -188,6 +249,13 @@ def main() -> int:
         import tempfile
         os.environ["JEPSEN_TPU_COMPILE_CACHE"] = tempfile.mkdtemp(
             prefix="jepsen_smoke_programs_")
+    # the decision ledger armed the same way (verdicts are flag-
+    # independent, parity-pinned): the ops-surface check asserts
+    # /ledger serves live cells, and _check_ledger_evidence proves
+    # records→disk→advisor end to end
+    if "JEPSEN_TPU_LEDGER" not in os.environ:
+        os.environ["JEPSEN_TPU_LEDGER"] = tempfile.mkdtemp(
+            prefix="jepsen_smoke_ledger_")
 
     from jepsen_tpu import resilience
     from jepsen_tpu.histories import corrupt_history, \
@@ -249,6 +317,7 @@ def main() -> int:
     finally:
         svc.close()
         ops.close()
+    failures += _check_ledger_evidence()
     failures += _check_ingress_two_tenants()
     for k, ref in refs.items():
         if pin(finals[k]) != pin(ref):
@@ -282,7 +351,8 @@ def main() -> int:
     print(f"serve-smoke: streamed verdicts identical to batch "
           f"(k1={finals['k1']['valid?']}, k2={finals['k2']['valid?']}), "
           f"wedge degraded cleanly, drain clean, ops endpoint "
-          f"(/healthz /metrics /status) live, two-tenant HTTP "
+          f"(/healthz /metrics /status /ledger) live, decision "
+          f"ledger durable + advisor plan built, two-tenant HTTP "
           f"ingress fair (flood shed, quiet acked)")
     return 0
 

@@ -32,7 +32,11 @@ What it asserts (each failure printed and counted; exit 1 on any):
     ``serve.ack_secs``/``verdict_secs`` histograms are populated for
     every tenant, the flood tenant's labeled shed counter moved, and
     the quiet tenants' ack p99 (computed from the scraped exposition,
-    not in-process state) is within the SLO.
+    not in-process state) is within the SLO;
+  * bounded evidence: the decision ledger (armed with a tiny segment
+    cap so rotation/retention fire under load) never outgrows
+    ``segments x segment_bytes`` on disk, and every surviving record
+    reads back clean.
 
 ``--smoke`` is the CI shape (~10 s; tools/ci.sh runs it after
 serve_smoke); the default is a ~60 s soak and ``--secs`` scales it up
@@ -80,6 +84,18 @@ def main() -> int:
     args = p.parse_args()
     if args.smoke:
         args.secs = min(args.secs, 10.0)
+
+    # the decision ledger rides the soak with a TINY segment cap so
+    # rotation + retention fire under sustained load — the post-drain
+    # assert pins the disk bound evidence can never outgrow (ISSUE
+    # 19; verdicts are flag-independent, parity-pinned)
+    if "JEPSEN_TPU_LEDGER" not in os.environ:
+        os.environ["JEPSEN_TPU_LEDGER"] = tempfile.mkdtemp(
+            prefix="jepsen_soak_ledger_")
+    if "JEPSEN_TPU_LEDGER_SEGMENT_BYTES" not in os.environ:
+        os.environ["JEPSEN_TPU_LEDGER_SEGMENT_BYTES"] = "8192"
+    if "JEPSEN_TPU_LEDGER_SEGMENTS" not in os.environ:
+        os.environ["JEPSEN_TPU_LEDGER_SEGMENTS"] = "4"
 
     from jepsen_tpu import obs, resilience
     from jepsen_tpu.histories import corrupt_history, \
@@ -282,6 +298,32 @@ def main() -> int:
             fail(f"quiet tenant {name} ack p99 {p99} past the "
                  f"{ACK_SLO_SECS}s SLO")
 
+    # --- bounded evidence: the ledger rotated under load and its
+    # on-disk footprint stayed inside retention × segment cap (plus
+    # one record of overshoot per segment and the not-yet-rotated
+    # active segment)
+    from jepsen_tpu.obs import ledger as ledger_mod
+    led = ledger_mod.active()
+    if led is None:
+        fail("decision ledger armed but not active")
+    else:
+        led.sync()
+        size = ledger_mod.size_bytes(led.root)
+        bound = (led.max_segments + 1) * (led.segment_bytes + 4096)
+        if size > bound:
+            fail(f"ledger outgrew its bound: {size} bytes > {bound} "
+                 f"({led.max_segments} segments x "
+                 f"{led.segment_bytes} bytes)")
+        n_segments = len(ledger_mod.segment_paths(led.root))
+        if n_segments > led.max_segments + 1:
+            fail(f"ledger retention never bit: {n_segments} segments "
+                 f"on disk > {led.max_segments} retained")
+        recs, corrupt = ledger_mod.read_records(led.root)
+        if corrupt:
+            fail(f"ledger read back {corrupt} corrupt line(s)")
+        if not recs:
+            fail("soak minted no ledger records")
+
     ing.close()
     ops_srv.close()
     svc.close()
@@ -293,8 +335,8 @@ def main() -> int:
     print(f"soak: OK in {dur:.1f}s — {n_deltas} quiet deltas across "
           f"{len(streams)} keys / {len(quiet)} tenants, flood shed "
           f"{trows['soak-flood']['acct']['sheds']}x, faults armed "
-          f"mid-soak, zero flips, bounded memory, per-tenant SLOs "
-          f"populated")
+          f"mid-soak, zero flips, bounded memory + bounded ledger, "
+          f"per-tenant SLOs populated")
     return 0
 
 
