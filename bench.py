@@ -569,12 +569,22 @@ def sec_adv(L: int, host_deadline: float, skip_host: bool,
             # flag off => schema byte-identical; tools/perf_ab.py's
             # hash-packed strategy owns the flip decision
             strategies.append("hash-packed")
+        if envflags.env_bool("JEPSEN_TPU_AUTO", default=False):
+            # the self-tuning planner rides the A/B the same opt-in
+            # way: an "auto" arm with every strategy axis left unset,
+            # so the live decision table routes it (docs/performance.md
+            # "Auto planner") — flag off => schema byte-identical;
+            # tools/perf_ab.py's PERF_AB_AUTO arm owns the advisory
+            # convergence reading
+            strategies.append("auto")
         for strat in strategies:
             kw = {"dedupe": strat}
             if strat == "hash-pallas":
                 kw = {"dedupe": "hash", "sparse_pallas": True}
             elif strat == "hash-packed":
                 kw = {"dedupe": "hash", "config_pack": True}
+            elif strat == "auto":
+                kw = {}
             engine.check_encoded(e_ab, capacity=cap,
                                  max_capacity=cap * 4, **kw)  # compile
             with obs.timer("bench.adv.dedupe_ab", L=L,
@@ -584,6 +594,10 @@ def sec_adv(L: int, host_deadline: float, skip_host: bool,
             ab[strat] = {"secs": round(tm.wall, 3),
                          "configs_stepped": ra.get("configs-stepped"),
                          "valid": ra.get("valid?")}
+            if strat == "auto" and ra.get("plan"):
+                # the provenance block says which vector the table
+                # routed to, and from what evidence
+                ab[strat]["plan"] = ra["plan"]
         assert all(v["valid"] is True for v in ab.values()), ab
         emit({"metric": f"adversarial single-key {L}-op sparse-engine "
                         f"dedupe A/B (advisory, 2^{k_ab} open configs)",

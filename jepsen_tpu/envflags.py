@@ -535,3 +535,27 @@ def env_path(name: str, what: str = "path") -> Optional[str]:
 #                            exceeds this (burn 1.0 = consuming error
 #                            budget exactly on schedule); unset/0 =
 #                            never degrade, gauges only
+#   JEPSEN_TPU_AUTO          env_bool    parallel.planner — the self-
+#                            tuning strategy planner: per slot-window
+#                            bucket, pick the strategy vector (dedupe,
+#                            pallas closure, pack, pipeline, steal)
+#                            from a per-shape decision table seeded by
+#                            the `jepsen report --plan` advisor join
+#                            and updated online (EWMA per
+#                            shape×strategy cell) from every
+#                            dispatch's measured secs; below the
+#                            JEPSEN_TPU_LEDGER_FLOOR sample floor the
+#                            static defaults run and the dispatch only
+#                            contributes evidence. A plan routes only
+#                            between parity-pinned paths — never a
+#                            verdict change. Results/"plan" blocks,
+#                            /status rows, kind=plan ledger records,
+#                            engine.plan.* metrics, /plan endpoint;
+#                            table durable beside the ledger segments.
+#                            Unset/"0" = off, byte-identical
+#                            (docs/performance.md "Auto planner")
+#   JEPSEN_TPU_AUTO_EXPLORE  env_int     parallel.planner — run the
+#                            least-sampled non-chosen arm every Nth
+#                            auto decision per shape group so a stale
+#                            seed self-corrects (default 8, min 0;
+#                            0 = never explore)

@@ -1,8 +1,9 @@
 """``jepsen report --plan`` — the offline strategy advisor.
 
 Joins three evidence sources into ONE per-shape recommended-strategy
-table (the artifact ROADMAP item 2's ``JEPSEN_TPU_AUTO=1`` planner
-will load, built here as read-only provenance):
+table — the artifact the ``JEPSEN_TPU_AUTO=1`` planner
+(``parallel.planner``) seeds its live decision table from, built here
+as read-only provenance:
 
   ledger   the decision ledger's dispatch/escalation/reshard/steal
            records (``obs.ledger``) — live traffic's shape×strategy
@@ -164,16 +165,33 @@ def _shape_group(rec: dict) -> Optional[str]:
 
 
 def build_plan(ledger_records: List[dict], bench_records: List[dict],
-               floor: Optional[int] = None) -> dict:
+               floor: Optional[int] = None,
+               auto_table: Optional[dict] = None) -> dict:
     """The joined plan document (machine-readable; ``render_plan``
     makes it human-readable). Per shape group, the recommended
     strategy is the strategy vector whose ledger cell has the lowest
     mean secs AMONG cells meeting the sample floor; a group with no
-    cell at the floor recommends nothing ("insufficient evidence")."""
+    cell at the floor recommends nothing ("insufficient evidence").
+
+    ``kind=plan`` records (the live planner's own decisions,
+    ``parallel.planner``) feed the FOURTH confidence tier: when the
+    newest online decision for a group picked the vector this join
+    recommends, confidence says ``auto-online`` — the fleet's live
+    table already converged there, which outranks what the synthetic
+    bench shapes prefer. ``auto_table`` (a durable ``plan_table.json``
+    document, ``planner.load_table``) rides along verbatim under
+    ``"auto"`` so one report shows the offline join AND the live
+    table."""
     floor = _ledger.sample_floor(floor)
     bench = bench_evidence(bench_records)
     groups: Dict[str, Dict[str, dict]] = {}
+    auto_latest: Dict[str, dict] = {}
     for rec in ledger_records:
+        if rec.get("kind") == "plan":
+            g = _shape_group(rec)
+            if g is not None:
+                auto_latest[g] = rec   # newest wins (segment order)
+            continue
         if rec.get("kind") not in ("dispatch", "escalation"):
             continue
         g = _shape_group(rec)
@@ -225,18 +243,32 @@ def build_plan(ledger_records: List[dict], bench_records: List[dict],
                         if str(bench_dedupe).startswith(
                             str(led_dedupe))
                         else f"bench-prefers-{bench_dedupe}")
+            pr = auto_latest.get(g)
+            if pr is not None and pr.get("source") == "online":
+                # lazy + import-safe: parallel.planner holds no JAX;
+                # its arm mapping is the one vocabulary both tables
+                # speak, so agreement is checked in it
+                from jepsen_tpu.parallel import planner as _planner_mod
+                led_arm = _planner_mod._arm_from_detail(detail)
+                vec = pr.get("strategy") or {}
+                if vec and all(led_arm.get(k) == v
+                               for k, v in vec.items()):
+                    conf = "auto-online"
             entry["confidence"] = conf
         shapes.append(entry)
-    return {"version": PLAN_VERSION, "floor": floor,
-            "shapes": shapes,
-            "bench": {"closure": bench["closure"],
-                      "dedupe": bench["dedupe"],
-                      "elastic": bench["elastic"],
-                      "closure_best": bench_closure,
-                      "dedupe_best": bench_dedupe,
-                      "verdicts": bench["verdicts"]},
-            "gates": bench["gates"],
-            "ledger_records": len(ledger_records)}
+    doc = {"version": PLAN_VERSION, "floor": floor,
+           "shapes": shapes,
+           "bench": {"closure": bench["closure"],
+                     "dedupe": bench["dedupe"],
+                     "elastic": bench["elastic"],
+                     "closure_best": bench_closure,
+                     "dedupe_best": bench_dedupe,
+                     "verdicts": bench["verdicts"]},
+           "gates": bench["gates"],
+           "ledger_records": len(ledger_records)}
+    if auto_table is not None:
+        doc["auto"] = auto_table
+    return doc
 
 
 def _fmt_secs(v) -> str:
@@ -308,5 +340,26 @@ def render_plan(plan: dict) -> str:
                          f"packable={gc.get('packable')} "
                          f"unpacked->{wr.get('unpacked')} "
                          f"packed->{wr.get('packed')}")
+        lines.append("")
+    auto = plan.get("auto")
+    if auto is not None:
+        lines.append("## Auto planner live table (JEPSEN_TPU_AUTO)")
+        lines.append("")
+        agroups = auto.get("groups") or {}
+        if not agroups:
+            lines.append("(plan_table.json present but empty)")
+        for g in sorted(agroups):
+            row = agroups[g]
+            lines.append(f"group {g}  "
+                         f"(decisions={row.get('decisions', 0)})")
+            cells = row.get("cells") or {}
+            for sig in sorted(cells):
+                c = cells[sig]
+                lines.append(
+                    f"      cell n={c.get('n', 0):<4} "
+                    f"live={c.get('n_live', 0):<4} "
+                    f"ewma={_fmt_secs(c.get('ewma', c.get('ewma_secs')))}"
+                    f"{' seeded' if c.get('seeded') else '':<8} "
+                    f"{sig}")
         lines.append("")
     return "\n".join(lines) + "\n"

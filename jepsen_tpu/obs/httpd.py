@@ -240,15 +240,26 @@ class _Handler(BaseHTTPRequestHandler):
                 # valid JSON, so fleet scrapers need no probe.
                 from jepsen_tpu.obs import ledger as _ledger_mod
                 self._json(200, _ledger_mod.ledger_doc())
+            elif path == "/plan":
+                # the auto planner's live decision table
+                # (parallel.planner): per shape-group cells, EWMA cost
+                # and evidence counts. Planner off answers
+                # {"auto": {"enabled": false}, "groups": {}} — still
+                # valid JSON, same posture as /ledger. Import is lazy
+                # AND safe: parallel.planner holds no JAX, and
+                # parallel/__init__ is docstring-only, so the ops
+                # surface keeps its wedged-runtime answering contract.
+                from jepsen_tpu.parallel import planner as _planner_mod
+                self._json(200, _planner_mod.plan_doc())
             elif path == "/":
                 self._json(200, {"endpoints": ["/metrics", "/healthz",
                                                "/status", "/trace",
-                                               "/ledger"]})
+                                               "/ledger", "/plan"]})
             else:
                 self._json(404, {"error": f"unknown path {path!r}",
                                  "endpoints": ["/metrics", "/healthz",
                                                "/status", "/trace",
-                                               "/ledger"]})
+                                               "/ledger", "/plan"]})
         except Exception as err:  # noqa: BLE001 — one bad render must
             # not kill the connection handler thread loop
             _log.exception("ops httpd: %s failed", path)

@@ -103,6 +103,14 @@ def resolve_ledger_dir() -> Optional[str]:
     return dest or DEFAULT_DIR
 
 
+def plan_table_path(root: str) -> str:
+    """Where the ``JEPSEN_TPU_AUTO`` planner persists its decision
+    table — beside the ledger segments, since the table is derived
+    evidence over them (``parallel.planner``). The dir-layout
+    knowledge lives here with the segments' own."""
+    return os.path.join(root, "plan_table.json")
+
+
 def resolve_segment_bytes(v: Optional[int] = None) -> int:
     if v is not None:
         return int(v)

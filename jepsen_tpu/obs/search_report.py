@@ -276,6 +276,11 @@ def report_main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--floor", type=int, default=None,
                    help="--plan's per-cell sample floor (default: "
                         "JEPSEN_TPU_LEDGER_FLOOR)")
+    p.add_argument("--json", action="store_true",
+                   help="with --plan: print the machine-readable "
+                        "plan document (sorted keys — the same "
+                        "schema plan.json stores) instead of the "
+                        "operator table; exit codes unchanged")
     p.add_argument("--stdout-only", action="store_true",
                    help="print the report without writing the "
                         ".txt artifact")
@@ -361,10 +366,23 @@ def report_main(argv: Optional[Sequence[str]] = None) -> int:
                 bench_dir = "bench_results"
             bench = (advisor.load_bench_dir(bench_dir)
                      if bench_dir else [])
+            # the live auto-planner table (JEPSEN_TPU_AUTO) rides the
+            # report when its durable file sits beside the ledger
+            # segments being read — one view over both evidence tiers
+            auto_table = None
+            table_dir = args.ledger_dir or _ledger.resolve_ledger_dir()
+            if table_dir:
+                from jepsen_tpu.parallel import planner as _planner_mod
+                auto_table = _planner_mod.load_table(table_dir)
             plan = advisor.build_plan(records, bench,
-                                      floor=args.floor)
+                                      floor=args.floor,
+                                      auto_table=auto_table)
             text = advisor.render_plan(plan)
-            sys.stdout.write(text)
+            if args.json:
+                sys.stdout.write(json.dumps(plan, sort_keys=True,
+                                            indent=1) + "\n")
+            else:
+                sys.stdout.write(text)
             if not args.stdout_only:
                 dest = run_dir if run_dir is not None \
                     else args.ledger_dir

@@ -59,6 +59,7 @@ from jepsen_tpu import envflags, obs
 from jepsen_tpu.obs import ledger as _ledger
 from jepsen_tpu.parallel import encode as enc_mod
 from jepsen_tpu.parallel import engine
+from jepsen_tpu.parallel import planner
 from jepsen_tpu.resilience import supervisor as sup
 
 _log = logging.getLogger(__name__)
@@ -177,9 +178,16 @@ class KeyScheduler:
                 self.observed[d] += c
                 self.observed_keys += 1
                 coh = self.cohort.get(i, d)
-                p = self.pred[coh]
-                self.pred[coh] = (c if p is None else
-                                  self.ewma * c + (1 - self.ewma) * p)
+                # the planner's shared smoothing (planner.ewma_update):
+                # the stealing scheduler's cohort predictions and the
+                # JEPSEN_TPU_AUTO table cells decay identically
+                self.pred[coh] = planner.ewma_update(
+                    self.pred[coh], c, self.ewma)
+                # the planner-relevant cost signal, visible on
+                # /metrics per cohort (docs/observability.md)
+                obs.gauge(obs.labeled("elastic.ewma_cost",
+                                      cohort=str(coh))
+                          ).set(self.pred[coh])
             v = None if lf is None else lf.get(i)
             if v is not None:
                 cur = self.lf_peak[d]

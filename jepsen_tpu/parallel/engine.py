@@ -40,6 +40,7 @@ from jax import lax
 from jepsen_tpu import envflags
 from jepsen_tpu import obs
 from jepsen_tpu.obs import ledger as _ledger
+from jepsen_tpu.parallel import planner as _planner
 from jepsen_tpu.parallel import encode as enc_mod
 from jepsen_tpu.parallel import programs
 from jepsen_tpu.parallel.encode import EncodedHistory, EncodeError
@@ -1839,13 +1840,29 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
     runs unpacked, tagged "config-pack": "unpacked"."""
     if e.n_returns == 0:
         return {"valid?": True, "max-frontier": 0, "capacity": 0}
+    C = e.slot_f.shape[1]
+    pl = _planner.active()
+    plan_prov = None
+    if pl is not None:
+        # JEPSEN_TPU_AUTO: axes the caller left unresolved are picked
+        # from the per-shape decision table — explicit arguments are
+        # never overridden, and every arm is parity-pinned, so a plan
+        # can only change wall-clock, never the verdict
+        dec = pl.decide("sparse", e.step_name, C,
+                        {"dedupe": dedupe, "pallas": sparse_pallas,
+                         "pack": config_pack}, keys=1)
+        if dec is not None:
+            chosen = dec["strategy"]
+            dedupe = chosen.get("dedupe", dedupe)
+            sparse_pallas = chosen.get("pallas", sparse_pallas)
+            config_pack = chosen.get("pack", config_pack)
+            plan_prov = dec["plan"]
     dedupe = _resolve_dedupe(dedupe)
     probe_limit = _resolve_probe_limit(probe_limit)
     ss = _resolve_search_stats(search_stats)
     pack_req = _resolve_config_pack(config_pack)
     pack = pack_spec_for(e) if pack_req else ()
     platform = getattr(device, "platform", None) or jax.default_backend()
-    C = e.slot_f.shape[1]
     # H2D placement and the search both run through the supervised
     # dispatch seam (resilience.supervisor): faults are injectable,
     # the watchdog bounds the wait, and the backend's breaker records
@@ -1880,10 +1897,13 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
             if not bool(overflow):
                 break
             if N * 2 > max_capacity:
-                return _tag_sparse_closure(
+                out = _tag_sparse_closure(
                     {"valid?": "unknown",
                      "error": f"frontier overflow at capacity {N}",
                      "capacity": N, "dedupe": dedupe}, mode, note)
+                if plan_prov is not None:
+                    out["plan"] = dict(plan_prov)
+                return out
             N *= 2
             n_esc += 1
             obs.counter("engine.capacity_escalations").inc()
@@ -1908,6 +1928,18 @@ def check_encoded(e: EncodedHistory, capacity: int = 1024,
     }
     _tag_sparse_closure(out, mode, note)
     _tag_config_pack(out, pack, pack_req, C)
+    if pl is not None:
+        # every dispatch contributes evidence, planned or not (the
+        # below-floor contract); the cell is keyed by the REQUESTED
+        # arm so decisions and observations land in the same cell
+        pallas_req = (bool(sparse_pallas) if sparse_pallas is not None
+                      else envflags.env_bool("JEPSEN_TPU_SPARSE_PALLAS",
+                                             default=False))
+        pl.observe("sparse", e.step_name, C,
+                   {"dedupe": dedupe, "pallas": pallas_req,
+                    "pack": pack_req}, _pc() - t0)
+    if plan_prov is not None:
+        out["plan"] = dict(plan_prov)
     if ss:
         acc = SearchStats(dedupe)
         acc.escalations = n_esc
@@ -2389,58 +2421,87 @@ def check_batch(model, histories, capacity: int = 512,
     JEPSEN_TPU_RESHARD) makes capacity escalation recruit mesh devices
     (sharded elastic ladder) instead of only growing tables."""
     bucket = _resolve_bucket(bucket)   # fail-fast: before the encode
-    dedupe = _resolve_dedupe(dedupe)   # likewise
-    if _resolve_pipeline(pipeline):
+    pl = _planner.active()
+    from time import perf_counter as _pc
+    if pl is None:
+        dedupe = _resolve_dedupe(dedupe)   # likewise fail-fast
+    else:
+        _resolve_dedupe(dedupe)   # fail-fast validation only — with
+        # the planner armed the dedupe REQUEST stays raw so each
+        # sparse bucket plans its own arm per shape
+        # (_check_batch_sparse); the batch-level axes (executor
+        # choice) are planned here, where they route
+        dec = pl.decide("batch", type(model).__name__, None,
+                        {"pipeline": pipeline, "steal": steal},
+                        keys=len(histories))
+        if dec is not None:
+            pipeline = dec["strategy"].get("pipeline", pipeline)
+            steal = dec["strategy"].get("steal", steal)
+        t0_plan = _pc()
+    run_pipeline = _resolve_pipeline(pipeline)
+    run_steal = bool(_resolve_steal(steal))
+    if run_pipeline:
         from jepsen_tpu.parallel import pipeline as pipe_mod
-        return pipe_mod.check_batch_pipelined(
+        res = pipe_mod.check_batch_pipelined(
             model, histories, capacity=capacity,
             max_capacity=max_capacity, mesh=mesh, bucket=bucket,
             cache=cache, stats=pipeline_stats, dedupe=dedupe,
             sparse_pallas=sparse_pallas, search_stats=search_stats,
             config_pack=config_pack, steal=steal, reshard=reshard,
             steal_stats=steal_stats)
-    if _resolve_steal(steal):
+    elif run_steal:
         from jepsen_tpu.parallel import elastic
         with obs.span("engine.check_batch", keys=len(histories),
                       bucket=bucket), obs.maybe_jax_profile():
             with obs.span("engine.encode_batch", keys=len(histories)):
                 pre = [enc_mod.encode(model, h) for h in histories]
-            return elastic.check_batch_stealing(
+            res = elastic.check_batch_stealing(
                 model, pre, capacity=capacity,
                 max_capacity=max_capacity, mesh=mesh, bucket=bucket,
                 dedupe=dedupe, sparse_pallas=sparse_pallas,
                 search_stats=search_stats, config_pack=config_pack,
                 reshard=reshard, stats=steal_stats)
-    if steal_stats is not None:
-        # same loud contract as cache/pipeline_stats below: the static
-        # path runs no scheduler and would silently leave the dict
-        # empty while the caller believes stealing was measured
-        raise ValueError(
-            "check_batch: steal_stats is an elastic-executor argument "
-            "— pass steal=True (or set JEPSEN_TPU_STEAL=1) to use it")
-    if (cache is not None and cache is not False) \
-            or pipeline_stats is not None:
-        # the serial path consults no cache and fills no stats —
-        # silently ignoring these arguments would be the same trap
-        # this PR closed in encode_batch(pad_slots, encs): the caller
-        # clearly wanted the pipelined executor, so say so. cache=False
-        # ("no caching") is exempt: the serial path already satisfies
-        # it by doing nothing, so it must not crash env-flag-dependently
-        raise ValueError(
-            "check_batch: cache/pipeline_stats are pipelined-executor "
-            "arguments — pass pipeline=True (or set "
-            "JEPSEN_TPU_PIPELINE=1) to use them")
-    with obs.span("engine.check_batch", keys=len(histories),
-                  bucket=bucket), obs.maybe_jax_profile():
-        with obs.span("engine.encode_batch", keys=len(histories)):
-            pre = [enc_mod.encode(model, h) for h in histories]
-        return check_batch_encoded(model, pre, capacity=capacity,
-                                   max_capacity=max_capacity, mesh=mesh,
-                                   bucket=bucket, dedupe=dedupe,
-                                   sparse_pallas=sparse_pallas,
-                                   search_stats=search_stats,
-                                   config_pack=config_pack,
-                                   reshard=reshard)
+    else:
+        if steal_stats is not None:
+            # same loud contract as cache/pipeline_stats below: the
+            # static path runs no scheduler and would silently leave
+            # the dict empty while the caller believes stealing was
+            # measured
+            raise ValueError(
+                "check_batch: steal_stats is an elastic-executor "
+                "argument — pass steal=True (or set "
+                "JEPSEN_TPU_STEAL=1) to use it")
+        if (cache is not None and cache is not False) \
+                or pipeline_stats is not None:
+            # the serial path consults no cache and fills no stats —
+            # silently ignoring these arguments would be the same trap
+            # this PR closed in encode_batch(pad_slots, encs): the
+            # caller clearly wanted the pipelined executor, so say so.
+            # cache=False ("no caching") is exempt: the serial path
+            # already satisfies it by doing nothing, so it must not
+            # crash env-flag-dependently
+            raise ValueError(
+                "check_batch: cache/pipeline_stats are "
+                "pipelined-executor arguments — pass pipeline=True "
+                "(or set JEPSEN_TPU_PIPELINE=1) to use them")
+        with obs.span("engine.check_batch", keys=len(histories),
+                      bucket=bucket), obs.maybe_jax_profile():
+            with obs.span("engine.encode_batch",
+                          keys=len(histories)):
+                pre = [enc_mod.encode(model, h) for h in histories]
+            res = check_batch_encoded(model, pre, capacity=capacity,
+                                      max_capacity=max_capacity,
+                                      mesh=mesh,
+                                      bucket=bucket, dedupe=dedupe,
+                                      sparse_pallas=sparse_pallas,
+                                      search_stats=search_stats,
+                                      config_pack=config_pack,
+                                      reshard=reshard)
+    if pl is not None:
+        pl.observe("batch", type(model).__name__, None,
+                   {"pipeline": run_pipeline, "steal": run_steal},
+                   _pc() - t0_plan)
+    return res
 
 
 def _resolve_bucket(bucket: Optional[str]) -> str:
@@ -2519,7 +2580,14 @@ def check_batch_encoded(model, pre, capacity: int = 512,
         _resolve_dedupe(dedupe)
         return []
     bucket = _resolve_bucket(bucket)
-    dedupe = _resolve_dedupe(dedupe)
+    if _planner.active() is None:
+        dedupe = _resolve_dedupe(dedupe)
+    else:
+        # fail-fast validation only: with the planner armed the dedupe
+        # REQUEST stays raw (None = plannable) so each sparse bucket
+        # picks its own arm per padded shape in _check_batch_sparse;
+        # bitdense buckets never consult dedupe either way
+        _resolve_dedupe(dedupe)
     from jepsen_tpu.parallel import bitdense
     out: list = [None] * len(pre)
     buckets: dict = {}
@@ -2557,7 +2625,7 @@ def check_batch_encoded(model, pre, capacity: int = 512,
 
 
 def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
-                        mesh=None, dedupe: str = "sort",
+                        mesh=None, dedupe: Optional[str] = None,
                         probe_limit: int = 0,
                         sparse_pallas: Optional[bool] = None,
                         search_stats: Optional[bool] = None,
@@ -2569,6 +2637,23 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
     out: list = [None] * K
     probe_limit = _resolve_probe_limit(probe_limit)
     ss = _resolve_search_stats(search_stats)
+    C = max(e.slot_f.shape[1] for e in pre)
+    pl = _planner.active()
+    plan_prov = None
+    if pl is not None:
+        # the plan routes this padded shape between parity-pinned
+        # strategy arms; axes the caller fixed (explicit arg or env)
+        # are never overridden — decide() only fills the None ones
+        dec = pl.decide("sparse", step_name, C,
+                        {"dedupe": dedupe, "pallas": sparse_pallas,
+                         "pack": config_pack}, keys=K)
+        if dec is not None:
+            chosen = dec["strategy"]
+            dedupe = chosen.get("dedupe", dedupe)
+            sparse_pallas = chosen.get("pallas", sparse_pallas)
+            config_pack = chosen.get("pack", config_pack)
+            plan_prov = dec["plan"]
+    dedupe = _resolve_dedupe(dedupe)
     pack_req = _resolve_config_pack(config_pack)
     led = _ledger.active()
     from time import perf_counter as _pc
@@ -2576,7 +2661,6 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
     # batch actually lives (the mesh when given), like bitdense does
     platform = (np.asarray(mesh.devices).flat[0].platform
                 if mesh is not None else jax.default_backend())
-    C = max(e.slot_f.shape[1] for e in pre)
     # one COMMON layout for the whole padded program: the state field
     # must cover every member's domain (pack_spec_for unions them)
     pack = pack_spec_for(pre, C) if pack_req else ()
@@ -2624,6 +2708,19 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
                     reason, backend=platform)
             break
         t1 = _pc()
+        if pl is not None:
+            # evidence lands on the REQUESTED arm (what decide() would
+            # hand out again), not the resolved closure mode — the
+            # platform fallback inside _resolve_sparse_pallas is the
+            # same for every arm, so the comparison stays fair
+            pallas_req = (bool(sparse_pallas)
+                          if sparse_pallas is not None
+                          else envflags.env_bool(
+                              "JEPSEN_TPU_SPARSE_PALLAS",
+                              default=False))
+            pl.observe("sparse", step_name, C,
+                       {"dedupe": dedupe, "pallas": pallas_req,
+                        "pack": pack_req}, t1 - t0)
         if ss or led is not None:
             # padded program dims for this tier: the pad-waste the
             # stats block reports is measured against what actually
@@ -2643,6 +2740,8 @@ def _check_batch_sparse(model, pre, capacity: int, max_capacity: int,
                  "configs-stepped": int(stepped[j])}
             _tag_sparse_closure(r, mode, note)
             _tag_config_pack(r, pack, pack_req, C)
+            if plan_prov is not None:
+                r["plan"] = dict(plan_prov)
             obs.counter("engine.configs_stepped").inc(int(stepped[j]))
             if r["valid?"]:
                 n_valid += 1

@@ -55,11 +55,12 @@ import numpy as np
 
 import jax
 
-from jepsen_tpu import obs
+from jepsen_tpu import envflags, obs
 from jepsen_tpu.history import TYPES, History
 from jepsen_tpu.obs import ledger as _ledger
 from jepsen_tpu.parallel import encode as enc_mod
 from jepsen_tpu.parallel import engine
+from jepsen_tpu.parallel import planner as _planner
 from jepsen_tpu.parallel.encode import EncodedHistory, EncodeError
 from jepsen_tpu.resilience import supervisor as sup
 
@@ -331,6 +332,18 @@ class HistorySession:
         self.key = key
         self.ops: list = []
         self.enc: Optional[EncodedHistory] = None
+        # with the planner armed (JEPSEN_TPU_AUTO), axes the caller
+        # left None are plannable: the decision waits for the first
+        # scan that has an encode (the plan is per padded shape) and
+        # then pins for the session's lifetime — every delta and the
+        # advance_sessions group key see ONE stable vector
+        self._auto_axes: tuple = ()
+        self._plan = None
+        if _planner.active() is not None:
+            self._auto_axes = tuple(
+                ax for ax, v in (("dedupe", dedupe),
+                                 ("pallas", sparse_pallas),
+                                 ("pack", config_pack)) if v is None)
         self.dedupe = engine._resolve_dedupe(dedupe)
         self.probe_limit = engine._resolve_probe_limit(probe_limit)
         self.sparse_pallas = sparse_pallas
@@ -474,6 +487,8 @@ class HistorySession:
             pack = (engine.pack_spec_for(e, pack_C)
                     if self.config_pack else ())
         engine._tag_config_pack(out, pack, self.config_pack, pack_C)
+        if self._plan is not None:
+            out["plan"] = dict(self._plan)
         if not out["valid?"]:
             out.update(engine._fail_op(e, cp.fail_r))
         return out
@@ -489,6 +504,43 @@ class HistorySession:
             self._leg_acc = engine.SearchStats(self.dedupe)
             self._leg_t0 = perf_counter()
         return self._leg_acc
+
+    def _apply_plan(self) -> None:
+        """One-shot strategy planning for this session
+        (JEPSEN_TPU_AUTO): fill the axes the caller left None from
+        the planner's decision table, keyed on the first encode's
+        padded shape. Runs before the first scan — the stats
+        accumulator and the advance_sessions group key both see the
+        planned vector, and it stays pinned for the session's
+        lifetime (a thawed key re-plans against the ADOPTING fleet's
+        table, since thaw rebuilds the session from ops)."""
+        if not self._auto_axes or self.enc is None:
+            return
+        pl = _planner.active()
+        if pl is None:
+            self._auto_axes = ()
+            return
+        req = {"dedupe": self.dedupe, "pallas": self.sparse_pallas,
+               "pack": self.config_pack}
+        for ax in self._auto_axes:
+            req[ax] = None
+        dec = pl.decide("stream", self.enc.step_name,
+                        self.enc.slot_f.shape[1], req, keys=1)
+        self._auto_axes = ()
+        if dec is None:
+            return
+        chosen = dec["strategy"]
+        if "dedupe" in chosen:
+            self.dedupe = chosen["dedupe"]
+            if self._stats_acc is not None:
+                # no chunks accumulated yet — this runs before the
+                # first scan, so swapping the strategy label is safe
+                self._stats_acc = engine.SearchStats(self.dedupe)
+        if "pallas" in chosen:
+            self.sparse_pallas = chosen["pallas"]
+        if "pack" in chosen:
+            self.config_pack = chosen["pack"]
+        self._plan = dec["plan"]
 
     def _finish(self, tcp, mode, note, resume_ev: int,
                 recovered, pack=None,
@@ -552,6 +604,22 @@ class HistorySession:
                        if "stats" in r else None),
                 outcome={"verdict": _ledger.verdict_class(r),
                          "degraded": recovered is not None})
+        pl = _planner.active()
+        scan_t0 = getattr(self, "_scan_t0", None)
+        if pl is not None and scan_t0 is not None:
+            # evidence on the REQUESTED arm, same convention as the
+            # batch engines — the platform fallback inside the
+            # closure resolution is identical for every arm
+            e = self.enc
+            pallas_req = (bool(self.sparse_pallas)
+                          if self.sparse_pallas is not None
+                          else envflags.env_bool(
+                              "JEPSEN_TPU_SPARSE_PALLAS",
+                              default=False))
+            pl.observe("stream", e.step_name, e.slot_f.shape[1],
+                       {"dedupe": self.dedupe, "pallas": pallas_req,
+                        "pack": self.config_pack},
+                       perf_counter() - scan_t0)
         self._last_result = dict(r)
         self._dirty = False
         return r
@@ -607,6 +675,7 @@ class HistorySession:
             return r
         if not self._dirty and self._last_result is not None:
             return dict(self._last_result)
+        self._apply_plan()
         e = self.enc
         platform = getattr(self.device, "platform", None) \
             or jax.default_backend()
@@ -839,6 +908,9 @@ def advance_sessions(sessions, bucket: Optional[str] = None) -> list:
                 or (not s._dirty and s._last_result is not None)):
             results[id(s)] = s.check()
             continue
+        # the plan must land BEFORE the group key is computed: planned
+        # sessions join batches on the vector that will actually run
+        s._apply_plan()
         cp = s._cp if s._cp is not None else s._fresh_cp()
         s._scan_cp = cp
         s._scan_t0 = perf_counter()

@@ -66,6 +66,7 @@ from jepsen_tpu import obs
 from jepsen_tpu.obs import ledger as _ledger
 from jepsen_tpu.parallel import encode as enc_mod
 from jepsen_tpu.parallel import engine
+from jepsen_tpu.parallel import planner as _planner
 from jepsen_tpu.parallel.encode import EncodedHistory
 from jepsen_tpu.resilience import supervisor as sup
 
@@ -490,7 +491,18 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
                 steal/busy accounting
     """
     bucket = engine._resolve_bucket(bucket)
-    dedupe = engine._resolve_dedupe(dedupe)
+    if _planner.active() is None:
+        dedupe = engine._resolve_dedupe(dedupe)
+        dedupe_label = dedupe
+    else:
+        # fail-fast validation only: with the planner armed a raw
+        # dedupe request flows through to the sparse tail so each
+        # bucket plans its own arm per shape (_check_batch_sparse);
+        # stats say "auto" rather than pretending the static default
+        # ran — per-key results carry the actual chosen vector in
+        # their "plan" block
+        engine._resolve_dedupe(dedupe)
+        dedupe_label = dedupe if dedupe is not None else "auto"
     search_stats = engine._resolve_search_stats(search_stats)
     steal = engine._resolve_steal(steal)
     if steal_stats is not None and not steal:
@@ -503,8 +515,8 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
     if stats is None:
         stats = {}
     K = len(histories)
-    stats.update({"n_keys": K, "bucket": bucket, "dedupe": dedupe,
-                  "buckets": []})
+    stats.update({"n_keys": K, "bucket": bucket,
+                  "dedupe": dedupe_label, "buckets": []})
     if K == 0:
         return []
     if cache is None:
@@ -521,7 +533,8 @@ def check_batch_pipelined(model, histories, capacity: int = 512,
 
     from jepsen_tpu.parallel import bitdense
 
-    root = obs.span("pipeline.run", keys=K, bucket=bucket, dedupe=dedupe)
+    root = obs.span("pipeline.run", keys=K, bucket=bucket,
+                    dedupe=dedupe_label)
     with root, obs.maybe_jax_profile():
         out = _stream(model, histories, capacity, max_capacity, mesh,
                       bucket, cache, workers, chunk_keys, depth, stats,

@@ -1051,6 +1051,12 @@ class CheckerService:
                          "delta-split-ratio", "load-factor-peak",
                          "probe-hist", "pad-waste")
                         if s.get(k) is not None}
+                if r.get("plan"):
+                    # JEPSEN_TPU_AUTO: which strategy vector the
+                    # planner routed this key's scans through, and on
+                    # what evidence — the per-key provenance twin of
+                    # the /plan table view
+                    row["plan"] = dict(r["plan"])
                 rows.append((ks.key, row))
             doc = {"pending_ops": self._pending_ops,
                    "max_pending_seen": self.max_pending_seen,

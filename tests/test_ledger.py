@@ -265,7 +265,10 @@ def test_advisor_recommends_only_at_the_floor():
     sparse = by_shape["engine=sparse,family=register_step,C=6"]
     assert sparse["recommend"] == \
         "closure=pallas,dedupe=hash,pack=True,probe_limit=None"
-    assert sparse["confidence"] == "bench-agrees"
+    # the fixture carries a kind=plan record whose newest ONLINE
+    # decision picked this vector — the live-table tier outranks
+    # bench agreement
+    assert sparse["confidence"] == "auto-online"
     dense = by_shape["engine=bitdense,family=register_step,C=6"]
     assert dense["recommend"] is None
     assert "insufficient evidence" in dense["confidence"]
@@ -295,6 +298,53 @@ def test_advisor_empty_ledger_renders_hint():
     assert "no dispatch evidence" in text
 
 
+def test_advisor_auto_online_confidence_tiers():
+    # the fourth confidence tier (ISSUE 20): a kind=plan record whose
+    # newest ONLINE decision picked the join's winning vector upgrades
+    # the group; seeded sources and disagreeing vectors never do
+    recs = [{"t": 1.0, "n": i, "kind": "dispatch", "engine": "e",
+             "shape": {"family": "f", "C": 4},
+             "strategy": {"dedupe": "hash"}, "secs": 0.1}
+            for i in range(3)]
+    agree = {"t": 2.0, "n": 9, "kind": "plan", "engine": "e",
+             "shape": {"family": "f", "C": 4},
+             "strategy": {"dedupe": "hash"}, "source": "online",
+             "explored": False, "cell_n": 3}
+    plan = advisor.build_plan(recs + [agree], [], floor=3)
+    assert plan["shapes"][0]["confidence"] == "auto-online"
+    # a seeded decision is bench-derived, not fleet-live evidence
+    plan = advisor.build_plan(
+        recs + [dict(agree, source="seeded")], [], floor=3)
+    assert plan["shapes"][0]["confidence"] == "ledger-only"
+    # a vector the join does NOT recommend claims no agreement
+    disagree = dict(agree, strategy={"dedupe": "sort"})
+    plan = advisor.build_plan(recs + [disagree], [], floor=3)
+    assert plan["shapes"][0]["confidence"] == "ledger-only"
+    # newest wins, the aggregation order: an older agreement
+    # superseded by a disagreeing decision reads the newest one
+    plan = advisor.build_plan(recs + [agree, disagree], [], floor=3)
+    assert plan["shapes"][0]["confidence"] == "ledger-only"
+
+
+def test_advisor_auto_table_rides_along():
+    # report --plan hands the durable plan_table.json through
+    # verbatim under "auto", and render_plan gains its section
+    table = {"version": 1, "floor": 3,
+             "groups": {"engine=e,family=f,C=4": {
+                 "decisions": 5, "cells": {"dedupe=hash": {
+                     "arm": {"dedupe": "hash"}, "ewma": 0.1,
+                     "n": 4, "n_live": 2, "seeded": True}}}}}
+    plan = advisor.build_plan([], [], floor=3, auto_table=table)
+    assert plan["auto"] == table
+    text = advisor.render_plan(plan)
+    assert "Auto planner live table" in text
+    assert "dedupe=hash" in text
+    # without a table the section is absent — historical renders
+    # stay byte-identical
+    assert "Auto planner live table" not in advisor.render_plan(
+        advisor.build_plan([], [], floor=3))
+
+
 # ------------------------------------------------ report --plan
 
 
@@ -319,6 +369,30 @@ def test_report_plan_exit_codes(tmp_path, capsys):
 
     # 254: no mode selected at all
     assert search_report.report_main([]) == 254
+
+
+def test_report_plan_json_output(tmp_path, capsys):
+    # --json prints the machine-readable plan document (satellite of
+    # ISSUE 20): schema pinned here, exit codes unchanged
+    from jepsen_tpu.obs import search_report
+
+    rc = search_report.report_main(
+        ["--plan", "--ledger-dir", LEDGER_FIXTURE,
+         "--bench-dir", BENCH_FIXTURE, "--stdout-only", "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == advisor.PLAN_VERSION
+    assert {"floor", "shapes", "bench", "gates",
+            "ledger_records"} <= set(doc)
+    by = {s["shape"]: s for s in doc["shapes"]}
+    sparse = by["engine=sparse,family=register_step,C=6"]
+    assert sparse["confidence"] == "auto-online"
+    assert sparse["recommend"].startswith("closure=pallas")
+    # exit codes unchanged by --json
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert search_report.report_main(
+        ["--plan", "--ledger-dir", str(empty), "--json"]) == 1
 
 
 def test_report_plan_writes_artifacts(tmp_path):

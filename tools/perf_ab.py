@@ -108,6 +108,18 @@ if _COMPILE not in ("0", "1"):
     raise SystemExit(f"PERF_AB_COMPILE: {_COMPILE!r} invalid; "
                      f"valid: 0,1")
 
+# PERF_AB_AUTO=1 adds the self-tuning planner arm (JEPSEN_TPU_AUTO,
+# parallel.planner): the same adversarial sparse shape dispatched with
+# every strategy axis left unset, so the online decision table routes
+# it from the evidence the dispatches themselves mint. ADVISORY ONLY —
+# the auto timings never feed a flip verdict (the planner only routes
+# BETWEEN arms the static lines already measured); the line exists so
+# the flag-flip campaign can see whether the table converges to the
+# measured winner. Same validation posture: a typo raises.
+_AUTO = os.environ.get("PERF_AB_AUTO", "0")
+if _AUTO not in ("0", "1"):
+    raise SystemExit(f"PERF_AB_AUTO: {_AUTO!r} invalid; valid: 0,1")
+
 
 def _want(name: str) -> bool:
     return name in _VARIANTS
@@ -612,6 +624,76 @@ def main():
                     emit({"search_stats_error": repr(err),
                           "shape": shape_key})
 
+    # ---- auto planner arm (JEPSEN_TPU_AUTO — advisory only) ----
+    # one adversarial sparse shape, every strategy axis left unset,
+    # the planner routing from a throwaway ledger dir: measures the
+    # cost of letting the online table pick vs. dispatching the static
+    # default, and records the vector the table converged to. The
+    # steady loop itself is the convergence driver — the cold run and
+    # each repeat mint evidence, so by the best-of window the table
+    # has samples past the floor. Never part of a flip verdict; the
+    # same >=1.1x / never-disagreed reading is applied to the advisory
+    # ratio so the JSONL is self-describing.
+    auto_ratios = {}
+    auto_bad = False
+    auto_plan = None
+    if _AUTO == "1":
+        import shutil
+        import tempfile
+        from jepsen_tpu.obs import ledger as led_mod
+        from jepsen_tpu.parallel import engine as eng_mod
+        from jepsen_tpu.parallel import planner as pl_mod
+        L_a, k_a = (adv_sizes[0], 6) if smoke else (1000, 8)
+        e_a = enc_mod.encode(model, adversarial_register_history(
+            n_ops=L_a, k_crashed=k_a, seed=7))
+        cap_a = 1 << (k_a + 4)
+        shape_key = f"auto-{L_a}@2^{k_a}"
+        ares = {}
+        t_static = _timed(ares, "static",
+                          lambda: eng_mod.check_encoded(
+                              e_a, capacity=cap_a,
+                              max_capacity=cap_a * 4),
+                          shape=shape_key)
+        tmp = tempfile.mkdtemp(prefix="jepsen-perf-ab-auto-")
+        saved = {k_: os.environ.get(k_)
+                 for k_ in ("JEPSEN_TPU_AUTO", "JEPSEN_TPU_LEDGER")}
+        os.environ["JEPSEN_TPU_AUTO"] = "1"
+        os.environ["JEPSEN_TPU_LEDGER"] = tmp
+        pl_mod.reset()
+        led_mod.reset()
+        try:
+            t_auto = _timed(ares, "auto",
+                            lambda: eng_mod.check_encoded(
+                                e_a, capacity=cap_a,
+                                max_capacity=cap_a * 4),
+                            shape=shape_key)
+        finally:
+            # the arm must not leak AUTO routing (or the throwaway
+            # table) into the elastic / batch blocks that follow
+            for k_, v_ in saved.items():
+                if v_ is None:
+                    os.environ.pop(k_, None)
+                else:
+                    os.environ[k_] = v_
+            pl_mod.reset()
+            led_mod.reset()
+            shutil.rmtree(tmp, ignore_errors=True)
+        pin_a = lambda r: {k_: r.get(k_) for k_ in  # noqa: E731
+                           ("valid?", "op", "fail-event",
+                            "max-frontier")}
+        base_a = pin_a(ares["static"][0])
+        auto_bad = any(pin_a(r) != base_a
+                       for r in ares["static"] + ares["auto"])
+        auto_plan = ares["auto"][-1].get("plan")
+        auto_ratios[shape_key] = t_static / max(t_auto, 1e-9)
+        emit({"shape": f"single-key {L_a}-op adversarial auto-planner "
+                       f"(2^{k_a} open configs)",
+              "static_secs": round(t_static, 3),
+              "auto_secs": round(t_auto, 3),
+              "auto_speedup": round(auto_ratios[shape_key], 2),
+              "auto_plan": auto_plan,
+              "auto_mismatch": auto_bad})
+
     # ---- elastic scheduling (steal / reshard arms) ----
     steal_ratios = {}
     reshard_ratios = {}
@@ -878,6 +960,10 @@ def main():
                          "and the per-device spread records stand "
                          "on any backend)")
         reshard_verdict = steal_verdict
+        auto_verdict = ("not-measured (PERF_AB_AUTO=0)"
+                        if _AUTO != "1" else
+                        "no-verdict (non-tpu backend; advisory either "
+                        "way — JEPSEN_TPU_AUTO stays opt-in)")
     else:
         # a variant filtered out by PERF_AB_VARIANTS was not measured —
         # its verdict line must say so, never a definitive keep/flip
@@ -965,6 +1051,21 @@ def main():
                                if reshard_ratios
                                and min(reshard_ratios.values()) >= 1.1
                                else "keep-opt-in")
+        # the auto arm is ADVISORY on every backend: the planner only
+        # routes between already-measured strategies, so its verdict
+        # line reports convergence quality, never a default flip
+        if _AUTO != "1":
+            auto_verdict = "not-measured (PERF_AB_AUTO=0)"
+        elif auto_bad:
+            auto_verdict = ("advisory-veto (ARM DISAGREED — see "
+                            "auto_mismatch; the planner routed to a "
+                            "path whose results diverged)")
+        else:
+            auto_verdict = (
+                "advisory-win (auto matched or beat static >=1.1x "
+                "and never disagreed — JEPSEN_TPU_AUTO stays opt-in)"
+                if auto_ratios and min(auto_ratios.values()) >= 1.1
+                else "advisory-keep-static")
     emit({"backend": backend, "verdict": verdict,
           "fori_verdict": fori_verdict,
           "dedupe_verdict": dedupe_verdict,
@@ -972,6 +1073,10 @@ def main():
           "config_pack_verdict": config_pack_verdict,
           "steal_verdict": steal_verdict,
           "reshard_verdict": reshard_verdict,
+          "auto_verdict": auto_verdict,
+          "auto_measured": _AUTO == "1",
+          "auto_ratios": {k: round(v, 2)
+                          for k, v in auto_ratios.items()},
           "variants_measured": sorted(_VARIANTS),
           "dedupe_measured": sorted(_DEDUPE),
           "elastic_measured": sorted(_ELASTIC),
@@ -1020,7 +1125,12 @@ def main():
                   "the grow-the-table one) flips JEPSEN_TPU_RESHARD "
                   "(engine._resolve_reshard) likewise — the "
                   "search_stats lines record the before/after "
-                  "per-device load-factor spread per shape"})
+                  "per-device load-factor spread per shape. The "
+                  "PERF_AB_AUTO=1 arm (JEPSEN_TPU_AUTO planner "
+                  "routing all axes) is ADVISORY under the same "
+                  ">=1.1x / never-disagreed reading: it reports "
+                  "whether the online table converged to the "
+                  "measured winner, and flips nothing"})
 
 
 if __name__ == "__main__":

@@ -34,6 +34,13 @@ JEPSEN_TPU_FAULTS), and asserts:
     deterministic plan from those live records (the ISSUE 19 wiring,
     end to end).
 
+  * the self-tuning planner routes the smoke's dispatches
+    (JEPSEN_TPU_AUTO=1, armed below): /status rows carry the "plan"
+    provenance block, /plan answers the live decision table, the
+    jepsen_engine_plan_* counters land on /metrics — and the streamed
+    verdicts still pin against the static batch check (the ISSUE 20
+    wiring, end to end).
+
 `tools/ci.sh` runs this right after fault_smoke (and tools/soak.py
 --smoke right after it). This is a wiring check; tests/test_serve.py
 + tests/test_ingress.py + tests/test_ring.py + tests/test_obs_httpd.py
@@ -89,7 +96,10 @@ def _check_ops_surface(ops) -> int:
                    # must be live too (docs/performance.md "Compile
                    # economics")
                    "jepsen_serve_compile_secs_bucket",
-                   "jepsen_engine_programs_compiles"):
+                   "jepsen_engine_programs_compiles",
+                   # and with JEPSEN_TPU_AUTO armed, so the planner's
+                   # decision counter must be live on the surface
+                   "jepsen_engine_plan_decisions"):
         if needed not in body:
             print(f"serve-smoke: /metrics missing {needed}")
             failures += 1
@@ -102,6 +112,20 @@ def _check_ops_surface(ops) -> int:
             print(f"serve-smoke: /status missing key {k} at seq 3: "
                   f"{row}")
             failures += 1
+        elif not (row.get("plan") or {}).get("vector"):
+            # JEPSEN_TPU_AUTO is armed (main()): every key's last
+            # result must carry the plan provenance block
+            print(f"serve-smoke: /status row {k} missing the plan "
+                  f"provenance block: {row.get('plan')}")
+            failures += 1
+    # JEPSEN_TPU_AUTO is armed: /plan must answer the live decision
+    # table while the service runs
+    code, body = _http_get(ops.url("/plan"))
+    pdoc = json.loads(body)
+    if code != 200 or not (pdoc.get("auto") or {}).get("enabled"):
+        print(f"serve-smoke: /plan not serving the live auto table: "
+              f"{code} {pdoc.get('auto')}")
+        failures += 1
     # the decision ledger is armed (tempdir, main()): /ledger must
     # answer the aggregate with live cells while the service runs
     code, body = _http_get(ops.url("/ledger"))
@@ -256,6 +280,14 @@ def main() -> int:
     if "JEPSEN_TPU_LEDGER" not in os.environ:
         os.environ["JEPSEN_TPU_LEDGER"] = tempfile.mkdtemp(
             prefix="jepsen_smoke_ledger_")
+    # the self-tuning planner armed the same way (verdicts are parity-
+    # pinned across every strategy the planner routes between, so the
+    # streamed-vs-batch pin below also proves AUTO changes nothing):
+    # the ops-surface check asserts the "plan" provenance block on
+    # /status rows, the jepsen_engine_plan_* series on /metrics, and
+    # a live /plan document
+    if "JEPSEN_TPU_AUTO" not in os.environ:
+        os.environ["JEPSEN_TPU_AUTO"] = "1"
 
     from jepsen_tpu import resilience
     from jepsen_tpu.histories import corrupt_history, \
@@ -351,9 +383,10 @@ def main() -> int:
     print(f"serve-smoke: streamed verdicts identical to batch "
           f"(k1={finals['k1']['valid?']}, k2={finals['k2']['valid?']}), "
           f"wedge degraded cleanly, drain clean, ops endpoint "
-          f"(/healthz /metrics /status /ledger) live, decision "
-          f"ledger durable + advisor plan built, two-tenant HTTP "
-          f"ingress fair (flood shed, quiet acked)")
+          f"(/healthz /metrics /status /ledger /plan) live, decision "
+          f"ledger durable + advisor plan built, auto planner "
+          f"provenance on /status, two-tenant HTTP ingress fair "
+          f"(flood shed, quiet acked)")
     return 0
 
 
